@@ -1,0 +1,16 @@
+"""kubernetes_tpu — a TPU-native scheduling framework with the capabilities of
+the kube-scheduler subsystem in warmchang/kubernetes.
+
+Layout (SURVEY.md §7):
+- api/      the v1 object-model subset the scheduler consumes
+- core/     host control plane: queue, cache/snapshot, framework runtime,
+            scheduling loop, fake control plane
+- plugins/  in-tree plugin oracle implementations (reference semantics)
+- ops/      device backend: interned SoA state mirror + the JAX batch kernel
+- parallel/ mesh/sharding for the node axis (ICI scale-out)
+- models/   assembled scheduling pipelines ("flagship" = batched device path)
+- testing/  fluent Pod/Node builders (testing/wrappers.go analogue)
+- perf/     scheduler_perf-style benchmark harness
+"""
+
+__version__ = "0.1.0"

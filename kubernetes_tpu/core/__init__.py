@@ -1,0 +1,41 @@
+from .cache import Cache, Snapshot
+from .clientset import FakeClientset
+from .framework import (
+    MAX_NODE_SCORE,
+    CycleState,
+    Diagnosis,
+    FitError,
+    Framework,
+    NodeScore,
+    PreFilterResult,
+    Status,
+)
+from .node_info import NodeInfo, PodInfo
+from .queue import Nominator, PriorityQueue, QueuedPodInfo
+from .registry import build_framework, default_profiles, fit_only_profiles
+from .scheduler import Handle, ScheduleResult, Scheduler
+
+__all__ = [
+    "Cache",
+    "Snapshot",
+    "FakeClientset",
+    "MAX_NODE_SCORE",
+    "CycleState",
+    "Diagnosis",
+    "FitError",
+    "Framework",
+    "NodeScore",
+    "PreFilterResult",
+    "Status",
+    "NodeInfo",
+    "PodInfo",
+    "Nominator",
+    "PriorityQueue",
+    "QueuedPodInfo",
+    "build_framework",
+    "default_profiles",
+    "fit_only_profiles",
+    "Handle",
+    "ScheduleResult",
+    "Scheduler",
+]

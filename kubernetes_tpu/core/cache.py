@@ -1,0 +1,258 @@
+"""Scheduler cache: assumed-pod-aware aggregate of cluster state with
+generation-based incremental snapshots.
+
+Re-expresses pkg/scheduler/backend/cache/cache.go (cacheImpl :61): the cache
+holds authoritative NodeInfos, tracks pods assumed-but-not-yet-bound
+(AssumePod/ForgetPod/ExpirePod), and refreshes an immutable per-cycle Snapshot
+incrementally — only NodeInfos whose generation advanced since the last
+UpdateSnapshot are re-cloned (cache.go:206,236-262). The same dirty-generation
+walk drives the device mirror's row scatter (kubernetes_tpu/ops.device_state).
+
+The reference's doubly-linked generation list is replaced by a dirty-name set:
+equivalent observable behavior, simpler host code.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from ..api.types import Namespace, Node, Pod
+from .node_info import NodeInfo, PodInfo, next_generation
+from .node_tree import NodeTree
+
+
+class Snapshot:
+    """Immutable per-cycle view (backend/cache/snapshot.go)."""
+
+    def __init__(self):
+        self.node_info_map: Dict[str, NodeInfo] = {}
+        self.node_info_list: List[NodeInfo] = []
+        self.have_pods_with_affinity_list: List[NodeInfo] = []
+        self.have_pods_with_required_anti_affinity_list: List[NodeInfo] = []
+        self.used_pvc_count: Dict[str, int] = {}
+        self.image_num_nodes: Dict[str, int] = {}
+        self.generation: int = 0
+
+    def get(self, name: str) -> Optional[NodeInfo]:
+        return self.node_info_map.get(name)
+
+    def num_nodes(self) -> int:
+        return len(self.node_info_list)
+
+    def rebuild_lists(self) -> None:
+        self.have_pods_with_affinity_list = [
+            ni for ni in self.node_info_list if ni.pods_with_affinity
+        ]
+        self.have_pods_with_required_anti_affinity_list = [
+            ni for ni in self.node_info_list if ni.pods_with_required_anti_affinity
+        ]
+        self.image_num_nodes = {}
+        for ni in self.node_info_list:
+            for img in ni.image_states:
+                self.image_num_nodes[img] = self.image_num_nodes.get(img, 0) + 1
+
+    # -- in-cycle what-if mutation (gang simulation, snapshot.go:545/:599) --
+
+    def assume_pod(self, pod: Pod) -> None:
+        ni = self.node_info_map.get(pod.node_name)
+        if ni is not None:
+            ni.add_pod(PodInfo.of(pod))
+
+    def forget_pod(self, pod: Pod) -> None:
+        ni = self.node_info_map.get(pod.node_name)
+        if ni is not None:
+            ni.remove_pod(pod)
+
+
+class _PodState:
+    __slots__ = ("pod", "deadline", "binding_finished")
+
+    def __init__(self, pod: Pod):
+        self.pod = pod
+        self.deadline: Optional[float] = None
+        self.binding_finished = False
+
+
+class Cache:
+    """cacheImpl (backend/cache/cache.go:61)."""
+
+    def __init__(self, ttl_seconds: float = 0.0, now: Callable[[], float] = time.monotonic):
+        self.ttl = ttl_seconds
+        self.now = now
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.node_order: List[str] = []  # stable snapshot order
+        self.node_tree = NodeTree()
+        self.assumed_pods: Set[str] = set()
+        self.pod_states: Dict[str, _PodState] = {}
+        self.namespaces: Dict[str, Namespace] = {}
+        self._dirty: Set[str] = set()
+        self._removed_since_snapshot = False
+
+    # -- nodes -------------------------------------------------------------
+
+    def add_node(self, node: Node) -> NodeInfo:
+        ni = self.nodes.get(node.name)
+        if ni is None:
+            ni = NodeInfo(node)
+            self.nodes[node.name] = ni
+            self.node_order.append(node.name)
+        else:
+            ni.set_node(node)
+        self.node_tree.add_node(node)
+        self._dirty.add(node.name)
+        return ni
+
+    def update_node(self, node: Node) -> NodeInfo:
+        return self.add_node(node)
+
+    def remove_node(self, node_name: str) -> None:
+        ni = self.nodes.pop(node_name, None)
+        if ni is not None:
+            if ni.node is not None:
+                self.node_tree.remove_node(ni.node)
+            self.node_order.remove(node_name)
+            self._removed_since_snapshot = True
+        self._dirty.discard(node_name)
+
+    # -- namespaces --------------------------------------------------------
+
+    def add_namespace(self, ns: Namespace) -> None:
+        self.namespaces[ns.name] = ns
+
+    def namespace_labels(self, name: str) -> Optional[Dict[str, str]]:
+        ns = self.namespaces.get(name)
+        return ns.labels if ns else None
+
+    # -- pods --------------------------------------------------------------
+
+    def assume_pod(self, pod: Pod) -> None:
+        """AssumePod (cache.go): optimistically place the pod on its node
+        before the bind API call completes."""
+        if pod.uid in self.pod_states:
+            raise ValueError(f"pod {pod.uid} is already assumed/added")
+        self._add_pod_to_node(pod)
+        self.assumed_pods.add(pod.uid)
+        self.pod_states[pod.uid] = _PodState(pod)
+
+    def finish_binding(self, pod: Pod) -> None:
+        st = self.pod_states.get(pod.uid)
+        if st is not None and pod.uid in self.assumed_pods:
+            st.binding_finished = True
+            if self.ttl > 0:
+                st.deadline = self.now() + self.ttl
+
+    def forget_pod(self, pod: Pod) -> None:
+        st = self.pod_states.get(pod.uid)
+        if st is None or pod.uid not in self.assumed_pods:
+            return
+        self._remove_pod_from_node(st.pod)
+        self.assumed_pods.discard(pod.uid)
+        del self.pod_states[pod.uid]
+
+    def add_pod(self, pod: Pod) -> None:
+        """Confirmed (watch-observed) pod add. Replaces the assumed copy."""
+        st = self.pod_states.get(pod.uid)
+        if st is not None:
+            if pod.uid in self.assumed_pods:
+                if st.pod.node_name != pod.node_name:
+                    self._remove_pod_from_node(st.pod)
+                    self._add_pod_to_node(pod)
+                self.assumed_pods.discard(pod.uid)
+            st.pod = pod
+            st.deadline = None
+        else:
+            self._add_pod_to_node(pod)
+            self.pod_states[pod.uid] = _PodState(pod)
+
+    def update_pod(self, old: Pod, new: Pod) -> None:
+        if new.uid in self.assumed_pods:
+            # Watch-confirmed version of a pod we assumed: treat as Add.
+            self.add_pod(new)
+            return
+        st = self.pod_states.get(old.uid)
+        if st is None:
+            self.add_pod(new)
+            return
+        self._remove_pod_from_node(st.pod)
+        self._add_pod_to_node(new)
+        st.pod = new
+
+    def remove_pod(self, pod: Pod) -> None:
+        st = self.pod_states.pop(pod.uid, None)
+        if st is not None:
+            self._remove_pod_from_node(st.pod)
+        self.assumed_pods.discard(pod.uid)
+
+    def is_assumed_pod(self, pod: Pod) -> bool:
+        return pod.uid in self.assumed_pods
+
+    def cleanup_expired_assumed_pods(self) -> None:
+        if self.ttl <= 0:
+            return
+        now = self.now()
+        for uid in list(self.assumed_pods):
+            st = self.pod_states[uid]
+            if st.binding_finished and st.deadline is not None and now > st.deadline:
+                self._remove_pod_from_node(st.pod)
+                self.assumed_pods.discard(uid)
+                del self.pod_states[uid]
+
+    def _add_pod_to_node(self, pod: Pod) -> None:
+        ni = self.nodes.get(pod.node_name)
+        if ni is None:
+            # Pod on unknown node: create a placeholder NodeInfo (reference
+            # keeps an imaginary nodeInfo so pods on deleted nodes still count).
+            ni = NodeInfo()
+            self.nodes[pod.node_name] = ni
+            self.node_order.append(pod.node_name)
+        ni.add_pod(PodInfo.of(pod))
+        self._dirty.add(pod.node_name)
+
+    def _remove_pod_from_node(self, pod: Pod) -> None:
+        ni = self.nodes.get(pod.node_name)
+        if ni is not None:
+            ni.remove_pod(pod)
+            self._dirty.add(pod.node_name)
+
+    # -- snapshot ----------------------------------------------------------
+
+    def update_snapshot(self, snapshot: Snapshot) -> Snapshot:
+        """UpdateSnapshot (cache.go:206): re-clone only dirty NodeInfos."""
+        structural = self._removed_since_snapshot or (
+            len(snapshot.node_info_list) != len(self.node_order)
+        )
+        affinity_dirty = structural
+        for name in self._dirty:
+            ni = self.nodes.get(name)
+            if ni is None:
+                continue
+            clone = ni.snapshot_clone()
+            old = snapshot.node_info_map.get(name)
+            if old is None or bool(old.pods_with_affinity) != bool(clone.pods_with_affinity) \
+                    or bool(old.pods_with_required_anti_affinity) != bool(clone.pods_with_required_anti_affinity) \
+                    or old.image_states.keys() != clone.image_states.keys():
+                affinity_dirty = True
+            snapshot.node_info_map[name] = clone
+        if structural:
+            snapshot.node_info_map = {
+                name: snapshot.node_info_map.get(name) or self.nodes[name].snapshot_clone()
+                for name in self.node_order
+            }
+        # Imaginary nodes (pods observed before their node) stay in the map for
+        # accounting but are excluded from the schedulable list, as the
+        # reference excludes nil-node entries from nodeInfoList.
+        snapshot.node_info_list = [
+            snapshot.node_info_map[n] for n in self.node_order
+            if n in snapshot.node_info_map and snapshot.node_info_map[n].node is not None
+        ]
+        if affinity_dirty or self._dirty:
+            snapshot.rebuild_lists()
+        snapshot.generation = next_generation()
+        self._dirty.clear()
+        self._removed_since_snapshot = False
+        return snapshot
+
+    def dirty_nodes(self) -> Set[str]:
+        """Names of nodes changed since the last snapshot (device mirror feed)."""
+        return set(self._dirty)

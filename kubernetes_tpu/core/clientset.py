@@ -1,0 +1,118 @@
+"""Fake control plane: an in-process pod/node store with watch-style fanout.
+
+Plays the role of client-go fake.Clientset + informers in the reference's unit
+layer (SURVEY.md §4.2): scheduler event handlers subscribe, API writes (bind,
+create, delete) synchronously fan out to them — the process-boundary analogue
+of apiserver watch streams collapsed to function calls.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, List, Optional
+
+from ..api.types import Namespace, Node, Pod
+
+
+class FakeClientset:
+    def __init__(self):
+        self.pods: Dict[str, Pod] = {}
+        self.nodes: Dict[str, Node] = {}
+        self.namespaces: Dict[str, Namespace] = {"default": Namespace(name="default")}
+        self.bindings: Dict[str, str] = {}  # pod uid -> node name
+        self._pod_handlers: List = []
+        self._node_handlers: List = []
+        self._namespace_handlers: List = []
+        self._rv = 0
+
+    # -- informer-ish registration ----------------------------------------
+
+    def on_pod_event(self, handler: Callable[[str, Optional[Pod], Pod], None]) -> None:
+        """handler(kind, old, new) with kind in add/update/delete."""
+        self._pod_handlers.append(handler)
+
+    def on_node_event(self, handler: Callable[[str, Optional[Node], Node], None]) -> None:
+        self._node_handlers.append(handler)
+
+    def on_namespace_event(self, handler: Callable[[Namespace], None]) -> None:
+        self._namespace_handlers.append(handler)
+        for ns in self.namespaces.values():  # replay existing (informer list)
+            handler(ns)
+
+    # -- writes ------------------------------------------------------------
+
+    def create_node(self, node: Node) -> Node:
+        self._rv += 1
+        node.resource_version = self._rv
+        self.nodes[node.name] = node
+        for h in self._node_handlers:
+            h("add", None, node)
+        return node
+
+    def update_node(self, node: Node) -> Node:
+        old = self.nodes.get(node.name)
+        self._rv += 1
+        node.resource_version = self._rv
+        self.nodes[node.name] = node
+        for h in self._node_handlers:
+            h("update", old, node)
+        return node
+
+    def delete_node(self, name: str) -> None:
+        node = self.nodes.pop(name, None)
+        if node is not None:
+            for h in self._node_handlers:
+                h("delete", node, node)
+
+    def create_namespace(self, ns: Namespace) -> Namespace:
+        self.namespaces[ns.name] = ns
+        for h in self._namespace_handlers:
+            h(ns)
+        return ns
+
+    def create_pod(self, pod: Pod) -> Pod:
+        self._rv += 1
+        pod.resource_version = self._rv
+        self.pods[pod.uid] = pod
+        for h in self._pod_handlers:
+            h("add", None, pod)
+        return pod
+
+    def update_pod(self, pod: Pod) -> Pod:
+        old = self.pods.get(pod.uid)
+        self._rv += 1
+        pod.resource_version = self._rv
+        self.pods[pod.uid] = pod
+        for h in self._pod_handlers:
+            h("update", old, pod)
+        return pod
+
+    def delete_pod(self, pod: Pod) -> None:
+        p = self.pods.pop(pod.uid, None)
+        if p is not None:
+            for h in self._pod_handlers:
+                h("delete", p, p)
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        """POST pods/{name}/binding (DefaultBinder target)."""
+        stored = self.pods.get(pod.uid)
+        if stored is None:
+            raise KeyError(f"pod {pod.namespace}/{pod.name} not found")
+        old = stored
+        new = copy.copy(stored)
+        new.node_name = node_name
+        self._rv += 1
+        new.resource_version = self._rv
+        self.pods[pod.uid] = new
+        self.bindings[pod.uid] = node_name
+        for h in self._pod_handlers:
+            h("update", old, new)
+
+    def patch_pod_status(self, pod: Pod, nominated_node_name: str = "", phase: str = "") -> None:
+        stored = self.pods.get(pod.uid)
+        if stored is None:
+            return
+        if nominated_node_name:
+            stored.nominated_node_name = nominated_node_name
+        if phase:
+            stored.phase = phase

@@ -1,0 +1,455 @@
+"""The scheduler framework: extension-point vocabulary, Status codes,
+CycleState, and the plugin-dispatch runtime.
+
+Re-expresses the stable plugin API of staging/src/k8s.io/kube-scheduler/framework
+(interface.go: PreEnqueue :447, QueueSort :461, PreFilter :520, Filter :549,
+PostFilter :578, PreScore :632, Score :653, Reserve :670, PreBind :686,
+PostBind :703, Permit :714, Bind :727) and the concrete dispatcher
+pkg/scheduler/framework/runtime/framework.go (frameworkImpl :58).
+
+Differences from the reference, by design (TPU-first):
+- No goroutine Parallelizer: per-node fan-out is replaced either by plain
+  loops (host oracle path) or by one dense pods×nodes device kernel
+  (kubernetes_tpu/ops.kernel) surfaced through a BatchEvaluator hook.
+- Plugins are duck-typed: a plugin implements an extension point by defining
+  the method (pre_filter/filter/score/...), mirroring Go interface checks.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..api.types import Node, Pod
+from .node_info import NodeInfo, PodInfo
+
+MAX_NODE_SCORE = 100
+MIN_NODE_SCORE = 0
+MAX_TOTAL_SCORE = (1 << 63) - 1
+
+# ---------------------------------------------------------------------------
+# Status (staging kube-scheduler framework/types.go Code)
+# ---------------------------------------------------------------------------
+
+SUCCESS = 0
+ERROR = 1
+UNSCHEDULABLE = 2
+UNSCHEDULABLE_AND_UNRESOLVABLE = 3
+WAIT = 4
+SKIP = 5
+PENDING = 6
+
+
+@dataclass
+class Status:
+    code: int = SUCCESS
+    reasons: tuple = ()
+    plugin: str = ""
+
+    @classmethod
+    def unschedulable(cls, *reasons: str, plugin: str = "") -> "Status":
+        return cls(UNSCHEDULABLE, tuple(reasons), plugin)
+
+    @classmethod
+    def unresolvable(cls, *reasons: str, plugin: str = "") -> "Status":
+        return cls(UNSCHEDULABLE_AND_UNRESOLVABLE, tuple(reasons), plugin)
+
+    @classmethod
+    def error(cls, *reasons: str, plugin: str = "") -> "Status":
+        return cls(ERROR, tuple(reasons), plugin)
+
+    @classmethod
+    def skip(cls, plugin: str = "") -> "Status":
+        return cls(SKIP, (), plugin)
+
+    def is_success(self) -> bool:
+        return self.code == SUCCESS
+
+    def is_skip(self) -> bool:
+        return self.code == SKIP
+
+    def is_rejected(self) -> bool:
+        return self.code in (UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE, PENDING)
+
+    def is_unresolvable(self) -> bool:
+        return self.code == UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def message(self) -> str:
+        return "; ".join(self.reasons)
+
+
+OK = Status()
+
+
+# ---------------------------------------------------------------------------
+# CycleState (pkg/scheduler/framework/cycle_state.go)
+# ---------------------------------------------------------------------------
+
+
+class CycleState:
+    """Per-scheduling-cycle typed KV store + skip sets."""
+
+    __slots__ = ("_data", "skip_filter_plugins", "skip_score_plugins", "skip_pre_bind_plugins",
+                 "recorded_plugin_durations")
+
+    def __init__(self):
+        self._data: Dict[str, Any] = {}
+        self.skip_filter_plugins: set = set()
+        self.skip_score_plugins: set = set()
+        self.skip_pre_bind_plugins: set = set()
+        self.recorded_plugin_durations: Dict[str, float] = {}
+
+    def write(self, key: str, value: Any) -> None:
+        self._data[key] = value
+
+    def read(self, key: str) -> Any:
+        return self._data.get(key)
+
+    def delete(self, key: str) -> None:
+        self._data.pop(key, None)
+
+    def clone(self) -> "CycleState":
+        """Clone for what-if simulation (nominated pods, preemption dry runs).
+        Mirrors cycle_state.go Clone(): values implementing clone() are deep-
+        cloned so simulations can't corrupt the real cycle's plugin state."""
+        c = CycleState()
+        c._data = {
+            k: (v.clone() if hasattr(v, "clone") else v) for k, v in self._data.items()
+        }
+        c.skip_filter_plugins = set(self.skip_filter_plugins)
+        c.skip_score_plugins = set(self.skip_score_plugins)
+        c.skip_pre_bind_plugins = set(self.skip_pre_bind_plugins)
+        return c
+
+
+# ---------------------------------------------------------------------------
+# Diagnosis (schedule_one.go Diagnosis / NodeToStatus)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Diagnosis:
+    node_to_status: Dict[str, Status] = field(default_factory=dict)
+    absent_nodes_status: Status = field(default_factory=lambda: Status(UNSCHEDULABLE_AND_UNRESOLVABLE))
+    unschedulable_plugins: set = field(default_factory=set)
+    pending_plugins: set = field(default_factory=set)
+    pre_filter_msg: str = ""
+
+
+class FitError(Exception):
+    """schedule_one.go FitError — pod didn't fit any node."""
+
+    def __init__(self, pod: Pod, num_all_nodes: int, diagnosis: Diagnosis):
+        self.pod = pod
+        self.num_all_nodes = num_all_nodes
+        self.diagnosis = diagnosis
+        rejected = sum(1 for s in diagnosis.node_to_status.values() if s.is_rejected())
+        super().__init__(
+            f"0/{num_all_nodes} nodes are available for pod {pod.namespace}/{pod.name} "
+            f"({rejected} rejected): {diagnosis.pre_filter_msg}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# PreFilterResult (interface.go PreFilterResult — node subset narrowing)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PreFilterResult:
+    node_names: Optional[set] = None  # None => all nodes
+
+    def all_nodes(self) -> bool:
+        return self.node_names is None
+
+    def merge(self, other: "PreFilterResult") -> "PreFilterResult":
+        if self.all_nodes() and other.all_nodes():
+            return PreFilterResult(None)
+        if self.all_nodes():
+            return PreFilterResult(set(other.node_names))
+        if other.all_nodes():
+            return PreFilterResult(set(self.node_names))
+        return PreFilterResult(self.node_names & other.node_names)
+
+
+@dataclass
+class NodeScore:
+    name: str
+    score: int
+
+
+# ---------------------------------------------------------------------------
+# Framework (profile) runtime
+# ---------------------------------------------------------------------------
+
+
+def default_normalize_score(max_priority: int, reverse: bool, scores: List[NodeScore]) -> None:
+    """plugins/helper/normalize_score.go DefaultNormalizeScore."""
+    max_count = 0
+    for s in scores:
+        if s.score > max_count:
+            max_count = s.score
+    if max_count == 0:
+        if reverse:
+            for s in scores:
+                s.score = max_priority
+        return
+    for s in scores:
+        score = max_priority * s.score // max_count
+        if reverse:
+            score = max_priority - score
+        s.score = score
+
+
+class Framework:
+    """One profile's plugin set + dispatch (frameworkImpl equivalent).
+
+    `plugins` is an ordered list of (plugin_instance, weight). Extension-point
+    membership is derived from which methods each plugin defines.
+    """
+
+    def __init__(
+        self,
+        profile_name: str = "default-scheduler",
+        plugins: Optional[Sequence[Tuple[Any, int]]] = None,
+        snapshot_provider: Optional[Callable[[], Any]] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        self.profile_name = profile_name
+        self._plugins: List[Tuple[Any, int]] = list(plugins or [])
+        self.snapshot_provider = snapshot_provider
+        self.rng = rng or random.Random(0)
+        self.pre_enqueue_plugins = self._having("pre_enqueue")
+        self.queue_sort_plugins = self._having("less")
+        self.pre_filter_plugins = self._having("pre_filter")
+        self.filter_plugins = self._having("filter")
+        self.post_filter_plugins = self._having("post_filter")
+        self.pre_score_plugins = self._having("pre_score")
+        self.score_plugins = self._having_weighted("score")
+        self.reserve_plugins = self._having("reserve")
+        self.unreserve_plugins = self._having("unreserve")
+        self.permit_plugins = self._having("permit")
+        self.pre_bind_plugins = self._having("pre_bind")
+        self.bind_plugins = self._having("bind")
+        self.post_bind_plugins = self._having("post_bind")
+        self.sign_plugins = self._having("sign")
+        # Optional dense batch evaluator (the TPU backend) — set by
+        # kubernetes_tpu/models pipeline when the device profile is active.
+        self.batch_evaluator = None
+
+    def _having(self, method: str) -> List[Any]:
+        return [p for p, _ in self._plugins if hasattr(p, method)]
+
+    def _having_weighted(self, method: str) -> List[Tuple[Any, int]]:
+        return [(p, w) for p, w in self._plugins if hasattr(p, method)]
+
+    def plugin(self, name: str) -> Optional[Any]:
+        for p, _ in self._plugins:
+            if p.name == name:
+                return p
+        return None
+
+    # -- queueing ----------------------------------------------------------
+
+    def run_pre_enqueue_plugins(self, pod: Pod) -> Status:
+        for p in self.pre_enqueue_plugins:
+            st = p.pre_enqueue(pod)
+            if not st.is_success():
+                st.plugin = p.name
+                return st
+        return OK
+
+    def less(self, a, b) -> bool:
+        """QueueSort comparison via the (single) queue-sort plugin."""
+        if self.queue_sort_plugins:
+            return self.queue_sort_plugins[0].less(a, b)
+        return a.timestamp < b.timestamp
+
+    # -- filtering ---------------------------------------------------------
+
+    def run_pre_filter_plugins(
+        self, state: CycleState, pod: Pod, nodes: Sequence[NodeInfo]
+    ) -> Tuple[Optional[PreFilterResult], Status]:
+        """runtime/framework.go:934 RunPreFilterPlugins: merge PreFilterResults,
+        collect Skip sets, short-circuit on rejection."""
+        result: Optional[PreFilterResult] = None
+        skipped = set()
+        for p in self.pre_filter_plugins:
+            r, st = p.pre_filter(state, pod, nodes)
+            if st.is_skip():
+                skipped.add(p.name)
+                continue
+            if not st.is_success():
+                st.plugin = p.name
+                return None, st
+            if r is not None and not r.all_nodes():
+                result = r if result is None else result.merge(r)
+                if not result.node_names:
+                    return result, Status.unresolvable(
+                        "node(s) didn't satisfy plugin(s) prefilter result", plugin=p.name
+                    )
+        state.skip_filter_plugins = skipped
+        return result, OK
+
+    def run_filter_plugins(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        """runtime/framework.go:1105 RunFilterPlugins (per node)."""
+        for p in self.filter_plugins:
+            if p.name in state.skip_filter_plugins:
+                continue
+            st = p.filter(state, pod, node_info)
+            if not st.is_success():
+                st.plugin = p.name
+                return st
+        return OK
+
+    def run_filter_plugins_with_nominated_pods(
+        self, state: CycleState, pod: Pod, node_info: NodeInfo, nominator=None
+    ) -> Status:
+        """runtime/framework.go:1275: two-pass filter — first pass simulates
+        higher/equal-priority nominated pods as if running on the node."""
+        nominated = []
+        if nominator is not None and node_info.node is not None:
+            nominated = [
+                pi for pi in nominator.nominated_pods_for_node(node_info.node.name)
+                if pi.pod.uid != pod.uid and pi.pod.priority >= pod.priority
+            ]
+        if nominated:
+            state_with = state.clone()
+            ni_with = node_info.snapshot_clone()
+            for pi in nominated:
+                ni_with.add_pod(pi)
+                for p in self.pre_filter_plugins:
+                    if p.name in state.skip_filter_plugins:
+                        continue
+                    add_pod = getattr(p, "add_pod", None)
+                    if add_pod is not None:
+                        st = add_pod(state_with, pod, pi, ni_with)
+                        if not st.is_success():
+                            st.plugin = p.name
+                            return st
+            st = self.run_filter_plugins(state_with, pod, ni_with)
+            if not st.is_success():
+                return st
+        return self.run_filter_plugins(state, pod, node_info)
+
+    def run_post_filter_plugins(self, state: CycleState, pod: Pod, filtered_status_map: Dict[str, Status]):
+        """runtime/framework.go:1152 — first non-skip result wins."""
+        for p in self.post_filter_plugins:
+            result, st = p.post_filter(state, pod, filtered_status_map)
+            if st.is_success() or st.code == UNSCHEDULABLE_AND_UNRESOLVABLE:
+                st.plugin = p.name
+                return result, st
+        return None, Status.unschedulable("no postFilter plugin made progress")
+
+    # -- scoring -----------------------------------------------------------
+
+    def run_pre_score_plugins(self, state: CycleState, pod: Pod, nodes: Sequence[NodeInfo]) -> Status:
+        skipped = set()
+        for p in self.pre_score_plugins:
+            st = p.pre_score(state, pod, nodes)
+            if st.is_skip():
+                skipped.add(p.name)
+                continue
+            if not st.is_success():
+                st.plugin = p.name
+                return st
+        state.skip_score_plugins = skipped
+        return OK
+
+    def run_score_plugins(
+        self, state: CycleState, pod: Pod, nodes: Sequence[NodeInfo]
+    ) -> Dict[str, List[NodeScore]]:
+        """runtime/framework.go:1405 RunScorePlugins: per-plugin score each
+        node, run NormalizeScore, then apply plugin weight."""
+        all_scores: Dict[str, List[NodeScore]] = {}
+        for p, weight in self.score_plugins:
+            if p.name in state.skip_score_plugins:
+                continue
+            scores = [NodeScore(ni.name, 0) for ni in nodes]
+            for i, ni in enumerate(nodes):
+                s, st = p.score(state, pod, ni)
+                if not st.is_success():
+                    raise RuntimeError(f"score plugin {p.name} failed: {st.message()}")
+                scores[i].score = s
+            normalize = getattr(p, "normalize_score", None)
+            if normalize is not None:
+                normalize(state, pod, scores)
+            for ns in scores:
+                if ns.score > MAX_NODE_SCORE or ns.score < MIN_NODE_SCORE:
+                    raise RuntimeError(
+                        f"plugin {p.name} returns an invalid score {ns.score} for node {ns.name}"
+                    )
+                ns.score *= weight
+            all_scores[p.name] = scores
+        return all_scores
+
+    # -- reserve / permit / bind ------------------------------------------
+
+    def run_reserve_plugins_reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        for p in self.reserve_plugins:
+            st = p.reserve(state, pod, node_name)
+            if not st.is_success():
+                st.plugin = p.name
+                return st
+        return OK
+
+    def run_reserve_plugins_unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        for p in reversed(self.unreserve_plugins):
+            p.unreserve(state, pod, node_name)
+
+    def run_permit_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        for p in self.permit_plugins:
+            st = p.permit(state, pod, node_name)
+            if st.is_rejected():
+                st.plugin = p.name
+                return st
+            if st.code == WAIT:
+                st.plugin = p.name
+                return st
+            if not st.is_success():
+                st.plugin = p.name
+                return st
+        return OK
+
+    def run_pre_bind_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        for p in self.pre_bind_plugins:
+            if p.name in state.skip_pre_bind_plugins:
+                continue
+            st = p.pre_bind(state, pod, node_name)
+            if not st.is_success():
+                st.plugin = p.name
+                return st
+        return OK
+
+    def run_bind_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        if not self.bind_plugins:
+            return Status.error("no bind plugin configured")
+        for p in self.bind_plugins:
+            st = p.bind(state, pod, node_name)
+            if st.is_skip():
+                continue
+            if st.is_success():
+                return st
+            # copy before stamping: plugins may return the shared OK/Status
+            # singletons, which must never be mutated.
+            return Status(st.code, st.reasons, p.name)
+        return Status.error("all bind plugins skipped")
+
+    def run_post_bind_plugins(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        for p in self.post_bind_plugins:
+            p.post_bind(state, pod, node_name)
+
+    # -- signatures (OpportunisticBatching / kernel row-block batching) ----
+
+    def sign_pod(self, pod: Pod) -> Optional[tuple]:
+        """Pod signature for batch reuse (staging framework/signers.go /
+        interface.go:774 SignPlugin). None => unsignable (never batched)."""
+        sig = []
+        for p in self.sign_plugins:
+            part = p.sign(pod)
+            if part is None:
+                return None
+            sig.append((p.name, part))
+        return tuple(sig) if sig else None

@@ -1,0 +1,62 @@
+"""Zone-interleaved node ordering (backend/cache/node_tree.go).
+
+Nodes are bucketed by zone (topology.kubernetes.io/zone + region) and listed
+round-robin across zones so that naive index-order iteration spreads load.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api.types import LABEL_REGION, LABEL_ZONE, Node
+
+
+def _zone_key(node: Node) -> str:
+    region = node.labels.get(LABEL_REGION, "")
+    zone = node.labels.get(LABEL_ZONE, "")
+    return f"{region}:\x00:{zone}"
+
+
+class NodeTree:
+    def __init__(self):
+        self.tree: Dict[str, List[str]] = {}
+        self.zones: List[str] = []
+        self.num_nodes = 0
+
+    def add_node(self, node: Node) -> None:
+        zone = _zone_key(node)
+        if zone not in self.tree:
+            self.tree[zone] = []
+            self.zones.append(zone)
+        if node.name not in self.tree[zone]:
+            self.tree[zone].append(node.name)
+            self.num_nodes += 1
+
+    def remove_node(self, node: Node) -> None:
+        zone = _zone_key(node)
+        names = self.tree.get(zone)
+        if names and node.name in names:
+            names.remove(node.name)
+            self.num_nodes -= 1
+            if not names:
+                del self.tree[zone]
+                self.zones.remove(zone)
+
+    def list(self) -> List[str]:
+        """Round-robin across zones (node_tree.go list())."""
+        out: List[str] = []
+        idx = [0] * len(self.zones)
+        remaining = self.num_nodes
+        z = 0
+        while remaining > 0 and self.zones:
+            zone = self.zones[z % len(self.zones)]
+            nodes = self.tree[zone]
+            i = idx[z % len(self.zones)]
+            if i < len(nodes):
+                out.append(nodes[i])
+                idx[z % len(self.zones)] += 1
+                remaining -= 1
+            z += 1
+            if z > 10 * (self.num_nodes + len(self.zones) + 1):
+                break
+        return out

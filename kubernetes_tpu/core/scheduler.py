@@ -1,0 +1,395 @@
+"""The scheduler: run loop + the one-pod scheduling cycle.
+
+Re-expresses pkg/scheduler/scheduler.go (Scheduler struct :69, Run :537) and
+pkg/scheduler/schedule_one.go — the hot path:
+
+    schedule_one → scheduling_cycle:
+        Cache.update_snapshot                      (cache.go:206)
+        find_nodes_that_fit_pod                    (schedule_one.go:630)
+            run_pre_filter_plugins
+            nominated-node fast path               (:722)
+            find_nodes_that_pass_filters           (:779, adaptive sampling
+                                                    :866 + rotation :816)
+        prioritize_nodes                           (:945)
+        select_host                                (:?  reservoir over max)
+        assume + reserve + permit                  (:315, :211)
+    binding cycle (sync here; async overlap is the device pipeline's job)
+        pre-bind → bind → post-bind                (:466,:478,:1100)
+    failure → handle_scheduling_failure → requeue  (:1152)
+
+TPU-first deviation: when the active profile has a `batch_evaluator` (the
+device backend), schedule_one pulls a *row-block* of same-signature pods and
+dispatches one kernel call that runs the whole greedy sequential assignment as
+a lax.scan on device (kubernetes_tpu/ops.kernel) — the generalization of
+OpportunisticBatching (runtime/batch.go) the survey calls for (§2.4).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..api.types import Pod
+from .cache import Cache, Snapshot
+from .clientset import FakeClientset
+from .framework import (
+    MAX_NODE_SCORE,
+    CycleState,
+    Diagnosis,
+    FitError,
+    Framework,
+    NodeScore,
+    Status,
+    UNSCHEDULABLE,
+    UNSCHEDULABLE_AND_UNRESOLVABLE,
+)
+from .node_info import NodeInfo
+from .queue import (
+    EVENT_ASSIGNED_POD_ADD,
+    EVENT_ASSIGNED_POD_DELETE,
+    EVENT_NODE_ADD,
+    EVENT_NODE_UPDATE,
+    PriorityQueue,
+    QueuedPodInfo,
+)
+
+MIN_FEASIBLE_NODES_TO_FIND = 100
+MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5
+
+
+@dataclass
+class ScheduleResult:
+    suggested_host: str = ""
+    evaluated_nodes: int = 0
+    feasible_nodes: int = 0
+
+
+class Handle:
+    """framework.Handle (interface.go:844) subset plugins consume."""
+
+    def __init__(self, scheduler: "Scheduler"):
+        self._scheduler = scheduler
+        self.clientset = scheduler.clientset
+
+    def snapshot(self) -> Snapshot:
+        return self._scheduler.snapshot
+
+    def namespace_labels(self, name: str):
+        return self._scheduler.cache.namespace_labels(name)
+
+    @property
+    def nominator(self):
+        return self._scheduler.queue.nominator
+
+
+class Scheduler:
+    def __init__(
+        self,
+        clientset: Optional[FakeClientset] = None,
+        profile_factory: Optional[Callable[[Handle], Dict[str, Framework]]] = None,
+        percentage_of_nodes_to_score: int = 0,
+        seed: int = 0,
+        now: Callable[[], float] = time.monotonic,
+    ):
+        self.clientset = clientset or FakeClientset()
+        self.cache = Cache(now=now)
+        self.snapshot = Snapshot()
+        self.now = now
+        self.rng = random.Random(seed)
+        self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        self.next_start_node_index = 0
+
+        handle = Handle(self)
+        if profile_factory is None:
+            from .registry import default_profiles  # local import: avoid cycle
+            self.profiles = default_profiles(handle)
+        else:
+            self.profiles = profile_factory(handle)
+        self.handle = handle
+        first = next(iter(self.profiles.values()))
+        self.queue = PriorityQueue(framework=first, now=now)
+        # metrics
+        self.attempts = 0
+        self.scheduled = 0
+        self.failures = 0
+        self.error_log: List[str] = []
+        self._wire_event_handlers()
+
+    # -- event handlers (eventhandlers.go:624 addAllEventHandlers) ---------
+
+    def _wire_event_handlers(self) -> None:
+        self.clientset.on_pod_event(self._on_pod_event)
+        self.clientset.on_node_event(self._on_node_event)
+        self.clientset.on_namespace_event(self.cache.add_namespace)
+
+    def _responsible_for_pod(self, pod: Pod) -> bool:
+        """eventhandlers.go responsibleForPod: only queue pods whose
+        schedulerName names one of our profiles."""
+        return pod.scheduler_name in self.profiles
+
+    def _on_pod_event(self, kind: str, old: Optional[Pod], new: Pod) -> None:
+        if kind == "add":
+            if new.node_name:
+                self.cache.add_pod(new)
+                self.queue.move_all_to_active_or_backoff(EVENT_ASSIGNED_POD_ADD)
+            elif self._responsible_for_pod(new):
+                self.queue.add(new)
+        elif kind == "update":
+            if new.node_name:
+                if old is not None and not old.node_name:
+                    # pending → bound transition (our own bind confirm)
+                    self.cache.add_pod(new)
+                else:
+                    self.cache.update_pod(old, new)
+            else:
+                self.queue.update(old, new)
+        elif kind == "delete":
+            if new.node_name:
+                self.cache.remove_pod(new)
+                self.queue.move_all_to_active_or_backoff(EVENT_ASSIGNED_POD_DELETE)
+            else:
+                self.queue.delete(new)
+
+    def _on_node_event(self, kind: str, old, new) -> None:
+        if kind == "add":
+            self.cache.add_node(new)
+            self.queue.move_all_to_active_or_backoff(EVENT_NODE_ADD)
+        elif kind == "update":
+            self.cache.update_node(new)
+            self.queue.move_all_to_active_or_backoff(EVENT_NODE_UPDATE)
+        elif kind == "delete":
+            self.cache.remove_node(new.name)
+
+    # -- profiles ----------------------------------------------------------
+
+    def framework_for_pod(self, pod: Pod) -> Framework:
+        fw = self.profiles.get(pod.scheduler_name)
+        if fw is None:
+            raise KeyError(f"no profile for scheduler name {pod.scheduler_name!r}")
+        return fw
+
+    # -- run loop ----------------------------------------------------------
+
+    def run_until_idle(self, max_cycles: int = 1_000_000) -> int:
+        """Drive schedule_one until the queue drains (test/bench harness)."""
+        n = 0
+        while n < max_cycles:
+            if not self.schedule_one():
+                self.queue.flush_backoff_completed()
+                if not self.schedule_one():
+                    break
+            n += 1
+        return n
+
+    # -- one cycle ---------------------------------------------------------
+
+    def schedule_one(self) -> bool:
+        qpi = self.queue.pop()
+        if qpi is None:
+            return False
+        pod = qpi.pod
+        fw = self.framework_for_pod(pod)
+        self.attempts += 1
+        state = CycleState()
+        try:
+            result = self.scheduling_cycle(fw, state, qpi)
+        except FitError as fe:
+            self.handle_scheduling_failure(fw, qpi, Status(UNSCHEDULABLE, (str(fe),)), fe.diagnosis)
+            self.queue.done(pod.uid)
+            return True
+        except Exception as e:  # noqa: BLE001
+            self.error_log.append(f"{pod.namespace}/{pod.name}: {e!r}")
+            self.handle_scheduling_failure(fw, qpi, Status.error(str(e)), None)
+            self.queue.done(pod.uid)
+            return True
+        self.run_binding_cycle(fw, state, qpi, result)
+        self.queue.done(pod.uid)
+        return True
+
+    def scheduling_cycle(self, fw: Framework, state: CycleState, qpi: QueuedPodInfo) -> ScheduleResult:
+        pod = qpi.pod
+        self.cache.update_snapshot(self.snapshot)
+        result = self.schedule_pod(fw, state, pod)
+        # assume (schedule_one.go:1060): in-memory commit before binding
+        assumed = pod
+        assumed.node_name = result.suggested_host
+        self.cache.assume_pod(assumed)
+        st = fw.run_reserve_plugins_reserve(state, assumed, result.suggested_host)
+        if not st.is_success():
+            fw.run_reserve_plugins_unreserve(state, assumed, result.suggested_host)
+            self.cache.forget_pod(assumed)
+            assumed.node_name = ""
+            raise RuntimeError(f"reserve failed: {st.message()}")
+        st = fw.run_permit_plugins(state, assumed, result.suggested_host)
+        if st.is_rejected():
+            fw.run_reserve_plugins_unreserve(state, assumed, result.suggested_host)
+            self.cache.forget_pod(assumed)
+            assumed.node_name = ""
+            raise RuntimeError(f"permit rejected: {st.message()}")
+        return result
+
+    # -- schedulePod (schedule_one.go:572) ---------------------------------
+
+    def schedule_pod(self, fw: Framework, state: CycleState, pod: Pod) -> ScheduleResult:
+        if self.snapshot.num_nodes() == 0:
+            raise FitError(pod, 0, Diagnosis(pre_filter_msg="no nodes available"))
+        feasible, diagnosis = self.find_nodes_that_fit_pod(fw, state, pod)
+        if not feasible:
+            raise FitError(pod, self.snapshot.num_nodes(), diagnosis)
+        if len(feasible) == 1:
+            return ScheduleResult(
+                suggested_host=feasible[0].name,
+                evaluated_nodes=1 + len(diagnosis.node_to_status),
+                feasible_nodes=1,
+            )
+        priority_list = self.prioritize_nodes(fw, state, pod, feasible)
+        host = self.select_host(priority_list)
+        return ScheduleResult(
+            suggested_host=host,
+            evaluated_nodes=len(feasible) + len(diagnosis.node_to_status),
+            feasible_nodes=len(feasible),
+        )
+
+    def find_nodes_that_fit_pod(
+        self, fw: Framework, state: CycleState, pod: Pod
+    ) -> Tuple[List[NodeInfo], Diagnosis]:
+        diagnosis = Diagnosis()
+        all_nodes = self.snapshot.node_info_list
+        pre_res, st = fw.run_pre_filter_plugins(state, pod, all_nodes)
+        if not st.is_success():
+            if st.is_rejected():
+                diagnosis.pre_filter_msg = st.message()
+                diagnosis.unschedulable_plugins.add(st.plugin)
+                return [], diagnosis
+            raise RuntimeError(f"prefilter failed: {st.message()}")
+
+        # Nominated-node fast path (schedule_one.go:722): if a previous
+        # preemption nominated a node, evaluate it first.
+        if pod.nominated_node_name:
+            ni = self.snapshot.get(pod.nominated_node_name)
+            if ni is not None:
+                st = fw.run_filter_plugins_with_nominated_pods(
+                    state, pod, ni, self.queue.nominator
+                )
+                if st.is_success():
+                    return [ni], diagnosis
+
+        nodes = all_nodes
+        if pre_res is not None and not pre_res.all_nodes():
+            nodes = [ni for ni in all_nodes if ni.name in pre_res.node_names]
+        feasible = self.find_nodes_that_pass_filters(fw, state, pod, diagnosis, nodes)
+        return feasible, diagnosis
+
+    def num_feasible_nodes_to_find(self, num_all_nodes: int) -> int:
+        """schedule_one.go:866 — adaptive 5–50% sampling, floor 100."""
+        if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND:
+            return num_all_nodes
+        if self.percentage_of_nodes_to_score > 0:
+            pct = self.percentage_of_nodes_to_score
+        else:
+            pct = 50 - num_all_nodes // 125
+            if pct < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
+                pct = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
+        num = num_all_nodes * pct // 100
+        return max(num, MIN_FEASIBLE_NODES_TO_FIND)
+
+    def find_nodes_that_pass_filters(
+        self,
+        fw: Framework,
+        state: CycleState,
+        pod: Pod,
+        diagnosis: Diagnosis,
+        nodes: Sequence[NodeInfo],
+    ) -> List[NodeInfo]:
+        num_nodes = len(nodes)
+        to_find = self.num_feasible_nodes_to_find(num_nodes)
+        feasible: List[NodeInfo] = []
+        start = self.next_start_node_index % max(1, num_nodes)
+        evaluated = 0
+        for i in range(num_nodes):
+            ni = nodes[(start + i) % num_nodes]
+            evaluated += 1
+            st = fw.run_filter_plugins_with_nominated_pods(state, pod, ni, self.queue.nominator)
+            if st.is_success():
+                feasible.append(ni)
+                if len(feasible) >= to_find:
+                    break
+            else:
+                diagnosis.node_to_status[ni.name] = st
+                if st.plugin:
+                    diagnosis.unschedulable_plugins.add(st.plugin)
+        self.next_start_node_index = (start + evaluated) % max(1, num_nodes)
+        return feasible
+
+    def prioritize_nodes(
+        self, fw: Framework, state: CycleState, pod: Pod, nodes: Sequence[NodeInfo]
+    ) -> List[NodeScore]:
+        st = fw.run_pre_score_plugins(state, pod, nodes)
+        if not st.is_success():
+            raise RuntimeError(f"prescore failed: {st.message()}")
+        plugin_scores = fw.run_score_plugins(state, pod, nodes)
+        total = [NodeScore(ni.name, 0) for ni in nodes]
+        for scores in plugin_scores.values():
+            for i, ns in enumerate(scores):
+                total[i].score += ns.score
+        return total
+
+    def select_host(self, node_scores: List[NodeScore]) -> str:
+        """Reservoir-sample among max-score nodes (schedule_one.go selectHost),
+        seeded RNG so runs are reproducible."""
+        best = node_scores[0]
+        cnt = 1
+        for ns in node_scores[1:]:
+            if ns.score > best.score:
+                best = ns
+                cnt = 1
+            elif ns.score == best.score:
+                cnt += 1
+                if self.rng.random() < 1.0 / cnt:
+                    best = ns
+        return best.name
+
+    # -- binding cycle (schedule_one.go:141 runBindingCycle) ---------------
+
+    def run_binding_cycle(
+        self, fw: Framework, state: CycleState, qpi: QueuedPodInfo, result: ScheduleResult
+    ) -> None:
+        pod = qpi.pod
+        node_name = result.suggested_host
+        st = fw.run_pre_bind_plugins(state, pod, node_name)
+        if not st.is_success():
+            self._unwind_binding(fw, state, qpi, node_name, st)
+            return
+        st = fw.run_bind_plugins(state, pod, node_name)
+        if not st.is_success():
+            self._unwind_binding(fw, state, qpi, node_name, st)
+            return
+        self.cache.finish_binding(pod)
+        self.queue.nominator.delete_nominated_pod(pod)
+        self.scheduled += 1
+        fw.run_post_bind_plugins(state, pod, node_name)
+
+    def _unwind_binding(self, fw, state, qpi: QueuedPodInfo, node_name: str, st: Status) -> None:
+        """handleBindingCycleError (schedule_one.go:507): unreserve, forget,
+        flush an AssignedPodDelete-equivalent event, requeue."""
+        pod = qpi.pod
+        fw.run_reserve_plugins_unreserve(state, pod, node_name)
+        self.cache.forget_pod(pod)
+        pod.node_name = ""
+        self.queue.move_all_to_active_or_backoff(EVENT_ASSIGNED_POD_DELETE)
+        self.handle_scheduling_failure(fw, qpi, st, None)
+
+    # -- failure (schedule_one.go:1152 handleSchedulingFailure) ------------
+
+    def handle_scheduling_failure(
+        self, fw: Framework, qpi: QueuedPodInfo, status: Status, diagnosis: Optional[Diagnosis]
+    ) -> None:
+        self.failures += 1
+        if diagnosis is not None:
+            qpi.unschedulable_plugins |= diagnosis.unschedulable_plugins
+            qpi.pending_plugins |= diagnosis.pending_plugins
+        if status.code == UNSCHEDULABLE_AND_UNRESOLVABLE and not qpi.unschedulable_plugins:
+            qpi.unschedulable_plugins.add(status.plugin or "unknown")
+        self.queue.add_unschedulable_if_not_present(qpi)

@@ -1,0 +1,30 @@
+from .basic import (
+    DefaultBinder,
+    ImageLocality,
+    NodeAffinity,
+    NodeName,
+    NodePorts,
+    NodeUnschedulable,
+    PrioritySort,
+    SchedulingGates,
+    TaintToleration,
+)
+from .interpodaffinity import InterPodAffinity
+from .noderesources import BalancedAllocation, Fit
+from .podtopologyspread import PodTopologySpread
+
+__all__ = [
+    "DefaultBinder",
+    "ImageLocality",
+    "NodeAffinity",
+    "NodeName",
+    "NodePorts",
+    "NodeUnschedulable",
+    "PrioritySort",
+    "SchedulingGates",
+    "TaintToleration",
+    "InterPodAffinity",
+    "BalancedAllocation",
+    "Fit",
+    "PodTopologySpread",
+]

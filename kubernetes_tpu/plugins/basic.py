@@ -1,0 +1,293 @@
+"""The "cheap" in-tree plugins: NodeName, NodeUnschedulable, NodePorts,
+SchedulingGates, PrioritySort, DefaultBinder, ImageLocality, TaintToleration,
+NodeAffinity.
+
+Each class mirrors one reference plugin package under
+pkg/scheduler/framework/plugins/ (anchor cited per class). Methods follow the
+duck-typed extension-point protocol in kubernetes_tpu/core/framework.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api.types import (
+    NO_EXECUTE,
+    NO_SCHEDULE,
+    PREFER_NO_SCHEDULE,
+    Node,
+    Pod,
+    Toleration,
+    find_matching_untolerated_taint,
+)
+from ..core.framework import (
+    MAX_NODE_SCORE,
+    OK,
+    CycleState,
+    NodeScore,
+    PreFilterResult,
+    Status,
+    default_normalize_score,
+)
+from ..core.node_info import NodeInfo
+
+# ---------------------------------------------------------------------------
+
+
+class NodeName:
+    """plugins/nodename: pod.spec.nodeName exact match."""
+
+    name = "NodeName"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if pod.node_name and pod.node_name != node_info.name:
+            return Status.unresolvable("node(s) didn't match the requested node name")
+        return OK
+
+    def sign(self, pod: Pod):
+        return pod.node_name
+
+
+class NodeUnschedulable:
+    """plugins/nodeunschedulable: gate on node.spec.unschedulable, tolerable
+    via the unschedulable taint toleration."""
+
+    name = "NodeUnschedulable"
+    TAINT_KEY = "node.kubernetes.io/unschedulable"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        node = node_info.node
+        if node is not None and node.unschedulable:
+            if not any(t.tolerates(_UNSCHED_TAINT) for t in pod.tolerations):
+                return Status.unresolvable("node(s) were unschedulable")
+        return OK
+
+    def sign(self, pod: Pod):
+        return tuple((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)
+
+
+from ..api.types import Taint as _Taint  # noqa: E402
+
+_UNSCHED_TAINT = _Taint(key=NodeUnschedulable.TAINT_KEY, effect=NO_SCHEDULE)
+
+
+class NodePorts:
+    """plugins/nodeports: reject nodes with conflicting host ports."""
+
+    name = "NodePorts"
+    _KEY = "PreFilterNodePorts"
+
+    def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Tuple[Optional[PreFilterResult], Status]:
+        ports = pod.host_ports()
+        if not ports:
+            return None, Status.skip()
+        state.write(self._KEY, ports)
+        return None, OK
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        ports = state.read(self._KEY)
+        if ports is None:
+            ports = pod.host_ports()
+        for p in ports:
+            # conflict semantics incl. 0.0.0.0 wildcard
+            # (reference nodeports.go Fits → fitsPorts).
+            for (proto, ip, port) in node_info.used_ports:
+                if port != p.host_port or proto != p.protocol:
+                    continue
+                if ip in ("", "0.0.0.0") or p.host_ip in ("", "0.0.0.0") or ip == p.host_ip:
+                    return Status.unschedulable("node(s) didn't have free ports for the requested pod ports")
+        return OK
+
+    def sign(self, pod: Pod):
+        return tuple(sorted((p.protocol, p.host_ip, p.host_port) for p in pod.host_ports()))
+
+
+class SchedulingGates:
+    """plugins/schedulinggates: PreEnqueue gate on spec.schedulingGates."""
+
+    name = "SchedulingGates"
+
+    def pre_enqueue(self, pod: Pod) -> Status:
+        if pod.scheduling_gates:
+            return Status.unresolvable(
+                "waiting for scheduling gates: " + ",".join(pod.scheduling_gates)
+            )
+        return OK
+
+
+class PrioritySort:
+    """plugins/queuesort: priority desc, then enqueue timestamp asc."""
+
+    name = "PrioritySort"
+
+    def less(self, a, b) -> bool:
+        pa = a.pod.priority
+        pb = b.pod.priority
+        if pa != pb:
+            return pa > pb
+        return a.timestamp < b.timestamp
+
+
+class DefaultBinder:
+    """plugins/defaultbinder: POST /binding via the (fake) clientset."""
+
+    name = "DefaultBinder"
+
+    def __init__(self, handle=None):
+        self.handle = handle
+
+    def bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        try:
+            self.handle.clientset.bind(pod, node_name)
+        except Exception as e:  # noqa: BLE001
+            return Status.error(str(e))
+        return OK
+
+
+class ImageLocality:
+    """plugins/imagelocality: score nodes by bytes of the pod's images already
+    present, scaled into [23Mi, 1000Mi] and spread-discounted by the fraction
+    of nodes that already have the image (imagelocality.go scaledImageScore)."""
+
+    name = "ImageLocality"
+    MIN_THRESHOLD = 23 * 1024 * 1024
+    MAX_CONTAINER_THRESHOLD = 1000 * 1024 * 1024
+
+    def __init__(self, handle=None):
+        self.handle = handle
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        total_nodes = 1
+        image_nodes = None
+        if self.handle is not None and getattr(self.handle, "snapshot", None) is not None:
+            snap = self.handle.snapshot() if callable(self.handle.snapshot) else self.handle.snapshot
+            total_nodes = max(1, len(snap.node_info_list))
+            image_nodes = getattr(snap, "image_num_nodes", None)
+        sum_scores = 0
+        for c in pod.containers:
+            size = node_info.image_states.get(c.image)
+            if size is None:
+                continue
+            spread = 1.0
+            if image_nodes is not None:
+                spread = image_nodes.get(c.image, 1) / total_nodes
+            sum_scores += int(size * spread)
+        max_threshold = self.MAX_CONTAINER_THRESHOLD * max(1, len(pod.containers))
+        if sum_scores < self.MIN_THRESHOLD:
+            return 0, OK
+        if sum_scores > max_threshold:
+            return MAX_NODE_SCORE, OK
+        return int(MAX_NODE_SCORE * (sum_scores - self.MIN_THRESHOLD) / (max_threshold - self.MIN_THRESHOLD)), OK
+
+    def sign(self, pod: Pod):
+        return tuple(sorted(c.image for c in pod.containers))
+
+
+class TaintToleration:
+    """plugins/tainttoleration (taint_toleration.go).
+
+    Filter: first NoSchedule/NoExecute taint not tolerated =>
+    UnschedulableAndUnresolvable (:133). Score: count of PreferNoSchedule
+    taints intolerable by the pod (:182-194); NormalizeScore reversed (:212).
+    """
+
+    name = "TaintToleration"
+    _KEY = "PreScoreTaintToleration"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        node = node_info.node
+        if node is None:
+            return Status.error("node not found")
+        taint = find_matching_untolerated_taint(node.taints, pod.tolerations)
+        if taint is not None:
+            return Status.unresolvable(
+                f"node(s) had untolerated taint {{{taint.key}: {taint.value}}}"
+            )
+        return OK
+
+    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+        tolerations = [
+            t for t in pod.tolerations
+            if not t.effect or t.effect == PREFER_NO_SCHEDULE
+        ]
+        state.write(self._KEY, tolerations)
+        return OK
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        tolerations = state.read(self._KEY) or []
+        count = 0
+        for taint in node_info.node.taints:
+            if taint.effect != PREFER_NO_SCHEDULE:
+                continue
+            if not any(t.tolerates(taint) for t in tolerations):
+                count += 1
+        return count, OK
+
+    def normalize_score(self, state: CycleState, pod: Pod, scores: List[NodeScore]) -> None:
+        default_normalize_score(MAX_NODE_SCORE, True, scores)
+
+    def sign(self, pod: Pod):
+        return tuple((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)
+
+
+class NodeAffinity:
+    """plugins/nodeaffinity (node_affinity.go).
+
+    Filter: nodeSelector AND required node affinity terms. PreFilter narrows
+    to specific nodes when terms pin metadata.name (node_affinity.go PreFilter),
+    and Skips when the pod expresses no node affinity. Score: sum of matching
+    preferred term weights, default-normalized.
+    """
+
+    name = "NodeAffinity"
+
+    def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Tuple[Optional[PreFilterResult], Status]:
+        na = pod.affinity.node_affinity if pod.affinity else None
+        if not pod.node_selector and (na is None or na.required is None):
+            return None, Status.skip()
+        # Narrow to named nodes when every term pins metadata.name via In.
+        if na is not None and na.required is not None and na.required.terms:
+            node_names: Optional[set] = set()
+            for term in na.required.terms:
+                term_names = None
+                for req in term.match_fields:
+                    if req.key == "metadata.name" and req.operator == "In":
+                        term_names = set(req.values)
+                if term_names is None:
+                    node_names = None
+                    break
+                node_names |= term_names
+            if node_names is not None:
+                return PreFilterResult(node_names), OK
+        return None, OK
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if not pod.required_node_selector_matches(node_info.node):
+            return Status.unresolvable("node(s) didn't match Pod's node affinity/selector")
+        return OK
+
+    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+        na = pod.affinity.node_affinity if pod.affinity else None
+        if na is None or not na.preferred:
+            return Status.skip()
+        return OK
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        na = pod.affinity.node_affinity if pod.affinity else None
+        if na is None:
+            return 0, OK
+        total = 0
+        for pref in na.preferred:
+            if pref.preference.matches(node_info.node):
+                total += pref.weight
+        return total, OK
+
+    def normalize_score(self, state: CycleState, pod: Pod, scores: List[NodeScore]) -> None:
+        default_normalize_score(MAX_NODE_SCORE, False, scores)
+
+    def sign(self, pod: Pod):
+        na = pod.affinity.node_affinity if pod.affinity else None
+        return (
+            tuple(sorted(pod.node_selector.items())),
+            repr(na) if na else "",
+        )

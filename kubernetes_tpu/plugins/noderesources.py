@@ -1,0 +1,262 @@
+"""NodeResourcesFit and NodeResourcesBalancedAllocation.
+
+Reference anchors:
+- Filter semantics:  plugins/noderesources/fit.go (fitsRequest :710 — per-
+  resource `request > allocatable − requested` rejection, Unresolvable when
+  request > allocatable).
+- LeastAllocated:    least_allocated.go:30-62
+  score = Σ_r weight_r * (allocatable_r − requested_r) * 100 / allocatable_r / Σ weight.
+- MostAllocated:     most_allocated.go (requested * 100 / allocatable).
+- RequestedToCapacityRatio: requested_to_capacity_ratio.go (piecewise-linear
+  interpolation over utilization shape points).
+- BalancedAllocation: balanced_allocation.go:204-253
+  score = (1 − std(fractions)) * 100, two-resource fast path |f1−f2|/2.
+- Non-zero defaults: framework/types.go GetNonzeroRequests (100 mCPU / 200Mi)
+  feed scoring (not filtering), via NodeInfo.non_zero_requested.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import resource as res
+from ..api.resource import Resource
+from ..api.types import Pod
+from ..core.framework import (
+    MAX_NODE_SCORE,
+    OK,
+    CycleState,
+    NodeScore,
+    PreFilterResult,
+    Status,
+)
+from ..core.node_info import NodeInfo
+
+LEAST_ALLOCATED = "LeastAllocated"
+MOST_ALLOCATED = "MostAllocated"
+REQUESTED_TO_CAPACITY_RATIO = "RequestedToCapacityRatio"
+
+DEFAULT_RESOURCES = ({"name": res.CPU, "weight": 1}, {"name": res.MEMORY, "weight": 1})
+
+
+class InsufficientResource:
+    __slots__ = ("resource_name", "requested", "used", "capacity", "unresolvable")
+
+    def __init__(self, resource_name, requested, used, capacity, unresolvable=False):
+        self.resource_name = resource_name
+        self.requested = requested
+        self.used = used
+        self.capacity = capacity
+        self.unresolvable = unresolvable
+
+
+def fits_request(pod_request: Resource, node_info: NodeInfo, num_new_pods: int = 1) -> List[InsufficientResource]:
+    """fit.go:710 fitsRequest."""
+    out: List[InsufficientResource] = []
+    alloc = node_info.allocatable
+    used = node_info.requested
+    if len(node_info.pods) + num_new_pods > alloc.allowed_pod_number:
+        out.append(InsufficientResource(res.PODS, num_new_pods, len(node_info.pods), alloc.allowed_pod_number))
+    if (
+        pod_request.milli_cpu == 0
+        and pod_request.memory == 0
+        and pod_request.ephemeral_storage == 0
+        and not pod_request.scalar_resources
+    ):
+        return out
+    if pod_request.milli_cpu > 0 and pod_request.milli_cpu > alloc.milli_cpu - used.milli_cpu:
+        out.append(InsufficientResource(
+            res.CPU, pod_request.milli_cpu, used.milli_cpu, alloc.milli_cpu,
+            unresolvable=pod_request.milli_cpu > alloc.milli_cpu))
+    if pod_request.memory > 0 and pod_request.memory > alloc.memory - used.memory:
+        out.append(InsufficientResource(
+            res.MEMORY, pod_request.memory, used.memory, alloc.memory,
+            unresolvable=pod_request.memory > alloc.memory))
+    if (
+        pod_request.ephemeral_storage > 0
+        and pod_request.ephemeral_storage > alloc.ephemeral_storage - used.ephemeral_storage
+    ):
+        out.append(InsufficientResource(
+            res.EPHEMERAL_STORAGE, pod_request.ephemeral_storage,
+            used.ephemeral_storage, alloc.ephemeral_storage,
+            unresolvable=pod_request.ephemeral_storage > alloc.ephemeral_storage))
+    for name, amount in pod_request.scalar_resources.items():
+        if amount == 0:
+            continue
+        a = alloc.scalar_resources.get(name, 0)
+        u = used.scalar_resources.get(name, 0)
+        if amount > a - u:
+            out.append(InsufficientResource(name, amount, u, a, unresolvable=amount > a))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Scoring strategies (resource_allocation.go scorer shapes)
+# ---------------------------------------------------------------------------
+
+
+def least_requested_score(requested: int, capacity: int) -> int:
+    if capacity == 0 or requested > capacity:
+        return 0
+    return (capacity - requested) * MAX_NODE_SCORE // capacity
+
+
+def most_requested_score(requested: int, capacity: int) -> int:
+    if capacity == 0:
+        return 0
+    if requested > capacity:
+        requested = capacity
+    return requested * MAX_NODE_SCORE // capacity
+
+
+def requested_to_capacity_ratio_score(requested: int, capacity: int, shape: Sequence[Tuple[int, int]]) -> int:
+    """Piecewise-linear over utilization (0-100) -> score (0-10 scaled to 0-100).
+    shape: sorted (utilization, score 0-10) points (requested_to_capacity_ratio.go
+    buildRequestedToCapacityRatioScorerFunction)."""
+    if capacity == 0:
+        utilization = 100
+    else:
+        utilization = min(100, requested * 100 // capacity)
+    if not shape:
+        return 0
+    if utilization <= shape[0][0]:
+        raw = shape[0][1]
+    elif utilization >= shape[-1][0]:
+        raw = shape[-1][1]
+    else:
+        raw = shape[-1][1]
+        for i in range(1, len(shape)):
+            if utilization < shape[i][0]:
+                u0, s0 = shape[i - 1]
+                u1, s1 = shape[i]
+                raw = s0 + (s1 - s0) * (utilization - u0) // (u1 - u0)
+                break
+    return raw * (MAX_NODE_SCORE // 10)
+
+
+class Fit:
+    """NodeResourcesFit (fit.go)."""
+
+    name = "NodeResourcesFit"
+    _KEY = "PreFilterNodeResourcesFit"
+
+    def __init__(self, scoring_strategy: str = LEAST_ALLOCATED,
+                 resources: Sequence[Dict] = DEFAULT_RESOURCES,
+                 shape: Sequence[Tuple[int, int]] = ((0, 10), (100, 0))):
+        self.scoring_strategy = scoring_strategy
+        self.resources = tuple(resources)
+        self.shape = tuple(shape)
+
+    # -- filter -----------------------------------------------------------
+
+    def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Tuple[Optional[PreFilterResult], Status]:
+        state.write(self._KEY, pod.resource_request())
+        return None, OK
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        req = state.read(self._KEY)
+        if req is None:
+            req = pod.resource_request()
+        insufficient = fits_request(req, node_info)
+        if insufficient:
+            reasons = tuple(f"Insufficient {r.resource_name}" for r in insufficient)
+            if any(r.unresolvable for r in insufficient):
+                return Status.unresolvable(*reasons)
+            return Status.unschedulable(*reasons)
+        return OK
+
+    # AddPod/RemovePod PreFilter extensions are implicit: fits_request reads
+    # live node_info aggregates, so preemption simulation just mutates the
+    # cloned NodeInfo (cheaper than the reference's state delta tracking).
+
+    # -- score ------------------------------------------------------------
+
+    def _requested_on_node(self, name: str, node_info: NodeInfo, pod_request: Resource) -> Tuple[int, int]:
+        alloc = node_info.allocatable.get(name)
+        if name == res.CPU:
+            used = node_info.non_zero_requested.milli_cpu + (pod_request.milli_cpu or NodeInfo.DEFAULT_MILLI_CPU)
+        elif name == res.MEMORY:
+            used = node_info.non_zero_requested.memory + (pod_request.memory or NodeInfo.DEFAULT_MEMORY)
+        else:
+            used = node_info.requested.get(name) + pod_request.get(name)
+        return used, alloc
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        req = state.read(self._KEY)
+        if req is None:
+            req = pod.resource_request()
+        node_score = 0
+        weight_sum = 0
+        for spec in self.resources:
+            name, weight = spec["name"], spec.get("weight", 1)
+            used, alloc = self._requested_on_node(name, node_info, req)
+            if alloc == 0:
+                continue
+            if self.scoring_strategy == LEAST_ALLOCATED:
+                rscore = least_requested_score(used, alloc)
+            elif self.scoring_strategy == MOST_ALLOCATED:
+                rscore = most_requested_score(used, alloc)
+            else:
+                rscore = requested_to_capacity_ratio_score(used, alloc, self.shape)
+            node_score += rscore * weight
+            weight_sum += weight
+        if weight_sum == 0:
+            return 0, OK
+        return node_score // weight_sum, OK
+
+    def sign(self, pod: Pod):
+        r = pod.resource_request()
+        return (
+            r.milli_cpu, r.memory, r.ephemeral_storage,
+            tuple(sorted(r.scalar_resources.items())),
+        )
+
+
+class BalancedAllocation:
+    """NodeResourcesBalancedAllocation (balanced_allocation.go)."""
+
+    name = "NodeResourcesBalancedAllocation"
+    _KEY = "PreScoreNodeResourcesBalancedAllocation"
+
+    def __init__(self, resources: Sequence[Dict] = DEFAULT_RESOURCES):
+        self.resources = tuple(resources)
+
+    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+        req = pod.resource_request()
+        # Best-effort pods skip BalancedAllocation (balanced_allocation.go
+        # PreScore Skip, kubernetes#129138).
+        if all(req.get(spec["name"]) == 0 for spec in self.resources):
+            return Status.skip()
+        state.write(self._KEY, req)
+        return OK
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        req = state.read(self._KEY)
+        if req is None:
+            req = pod.resource_request()
+        fractions: List[float] = []
+        for spec in self.resources:
+            name = spec["name"]
+            alloc = node_info.allocatable.get(name)
+            if alloc == 0:
+                continue
+            if name == res.CPU:
+                used = node_info.non_zero_requested.milli_cpu + (req.milli_cpu or NodeInfo.DEFAULT_MILLI_CPU)
+            elif name == res.MEMORY:
+                used = node_info.non_zero_requested.memory + (req.memory or NodeInfo.DEFAULT_MEMORY)
+            else:
+                used = node_info.requested.get(name) + req.get(name)
+            fractions.append(min(used / alloc, 1.0))
+        if len(fractions) < 2:
+            std = 0.0
+        elif len(fractions) == 2:
+            std = abs(fractions[0] - fractions[1]) / 2
+        else:
+            mean = sum(fractions) / len(fractions)
+            std = math.sqrt(sum((f - mean) ** 2 for f in fractions) / len(fractions))
+        return int((1 - std) * MAX_NODE_SCORE), OK
+
+    def sign(self, pod: Pod):
+        r = pod.resource_request()
+        return (r.milli_cpu, r.memory, tuple(sorted(r.scalar_resources.items())))

@@ -1,0 +1,3 @@
+from .wrappers import MakeNode, MakePod, make_node, make_pod
+
+__all__ = ["MakeNode", "MakePod", "make_node", "make_pod"]

@@ -1,0 +1,3 @@
+from .interning import Interner
+
+__all__ = ["Interner"]

@@ -1,0 +1,44 @@
+"""String interning codebooks.
+
+Everything string-ish (label keys/values, taint keys, resource names,
+namespaces, node names) must become small integer ids before it can live in
+device tensors (SURVEY.md §7 step 2). An Interner is append-only: ids are
+stable for the lifetime of the codebook, which is what makes incremental
+device uploads sound.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+
+class Interner:
+    """Append-only string -> int id codebook. id 0 is reserved for MISSING."""
+
+    MISSING = 0
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._to_id: Dict[str, int] = {}
+        self._to_str: List[Optional[str]] = [None]  # index 0 = missing
+
+    def intern(self, s: str) -> int:
+        i = self._to_id.get(s)
+        if i is None:
+            i = len(self._to_str)
+            self._to_id[s] = i
+            self._to_str.append(s)
+        return i
+
+    def lookup(self, s: str) -> int:
+        """Return existing id or MISSING (never allocates)."""
+        return self._to_id.get(s, self.MISSING)
+
+    def string(self, i: int) -> Optional[str]:
+        return self._to_str[i] if 0 < i < len(self._to_str) else None
+
+    def __len__(self) -> int:
+        return len(self._to_str)
+
+    def __contains__(self, s: str) -> bool:
+        return s in self._to_id

@@ -1,0 +1,126 @@
+"""API object model: quantities, resources, selectors, tolerations."""
+
+from kubernetes_tpu.api import labels, resource
+from kubernetes_tpu.api.resource import Resource, cpu_to_milli, parse_quantity, to_int
+from kubernetes_tpu.api.types import (
+    NO_EXECUTE,
+    NO_SCHEDULE,
+    PREFER_NO_SCHEDULE,
+    Taint,
+    Toleration,
+    find_matching_untolerated_taint,
+)
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class TestQuantity:
+    def test_plain(self):
+        assert to_int("2") == 2
+        assert to_int(7) == 7
+
+    def test_milli_cpu(self):
+        assert cpu_to_milli("100m") == 100
+        assert cpu_to_milli("1") == 1000
+        assert cpu_to_milli("1.5") == 1500
+        assert cpu_to_milli("0.1") == 100
+
+    def test_binary_suffixes(self):
+        assert to_int("1Ki") == 1024
+        assert to_int("1Mi") == 1024 * 1024
+        assert to_int("1.5Gi") == int(1.5 * 1024**3)
+
+    def test_decimal_suffixes(self):
+        assert to_int("1k") == 1000
+        assert to_int("2M") == 2_000_000
+
+    def test_rounds_up(self):
+        assert cpu_to_milli("0.0001") == 1  # sub-milli rounds up
+
+
+class TestResource:
+    def test_from_map(self):
+        r = Resource.from_map({"cpu": "500m", "memory": "1Gi", "nvidia.com/gpu": 2})
+        assert r.milli_cpu == 500
+        assert r.memory == 1024**3
+        assert r.scalar_resources["nvidia.com/gpu"] == 2
+
+    def test_add_sub(self):
+        a = Resource.from_map({"cpu": "1", "memory": "1Gi"})
+        b = Resource.from_map({"cpu": "250m", "memory": "256Mi"})
+        a.add(b)
+        assert a.milli_cpu == 1250
+        a.sub(b)
+        assert a.milli_cpu == 1000
+        assert a.memory == 1024**3
+
+
+class TestPodRequest:
+    def test_sum_of_containers_plus_overhead(self):
+        pod = (make_pod().req({"cpu": "100m"})
+               .container_req({"cpu": "200m", "memory": "1Gi"})
+               .overhead({"cpu": "50m"}).obj())
+        r = pod.resource_request()
+        assert r.milli_cpu == 350
+        assert r.memory == 1024**3
+
+    def test_init_container_max(self):
+        pod = (make_pod().req({"cpu": "100m"})
+               .init_req({"cpu": "1"}).obj())
+        assert pod.resource_request().milli_cpu == 1000
+
+    def test_sidecar_adds(self):
+        pod = (make_pod().req({"cpu": "100m"})
+               .init_req({"cpu": "300m"}, sidecar=True).obj())
+        assert pod.resource_request().milli_cpu == 400
+
+
+class TestSelectors:
+    def test_match_labels(self):
+        sel = labels.LabelSelector.of(match_labels={"app": "web"})
+        assert sel.matches({"app": "web", "x": "y"})
+        assert not sel.matches({"app": "db"})
+
+    def test_expressions(self):
+        sel = labels.LabelSelector.of(match_expressions=[
+            labels.Requirement("tier", labels.IN, ("fe", "be")),
+            labels.Requirement("canary", labels.DOES_NOT_EXIST),
+        ])
+        assert sel.matches({"tier": "fe"})
+        assert not sel.matches({"tier": "fe", "canary": "yes"})
+        assert not sel.matches({"tier": "mid"})
+
+    def test_gt_lt(self):
+        sel = labels.LabelSelector.of(match_expressions=[
+            labels.Requirement("gen", labels.GT, ("5",)),
+        ])
+        assert sel.matches({"gen": "7"})
+        assert not sel.matches({"gen": "3"})
+        assert not sel.matches({"gen": "abc"})
+
+    def test_empty_matches_everything(self):
+        assert labels.LabelSelector().matches({"anything": "goes"})
+
+
+class TestTolerations:
+    def test_exists_all(self):
+        t = Toleration(operator="Exists")
+        assert t.tolerates(Taint(key="k", value="v", effect=NO_SCHEDULE))
+
+    def test_equal(self):
+        t = Toleration(key="k", operator="Equal", value="v")
+        assert t.tolerates(Taint(key="k", value="v", effect=NO_EXECUTE))
+        assert not t.tolerates(Taint(key="k", value="other", effect=NO_SCHEDULE))
+
+    def test_effect_scoped(self):
+        t = Toleration(key="k", operator="Exists", effect=NO_SCHEDULE)
+        assert t.tolerates(Taint(key="k", effect=NO_SCHEDULE))
+        assert not t.tolerates(Taint(key="k", effect=NO_EXECUTE))
+
+    def test_find_untolerated_ignores_prefer(self):
+        taints = [Taint(key="soft", effect=PREFER_NO_SCHEDULE)]
+        assert find_matching_untolerated_taint(taints, []) is None
+
+    def test_find_untolerated(self):
+        taints = [Taint(key="hard", effect=NO_SCHEDULE)]
+        found = find_matching_untolerated_taint(taints, [])
+        assert found is not None and found.key == "hard"

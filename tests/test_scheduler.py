@@ -1,0 +1,291 @@
+"""End-to-end host-path scheduler tests (the schedule_one_test.go layer)."""
+
+import pytest
+
+from kubernetes_tpu.core import FakeClientset, Scheduler, fit_only_profiles
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def new_scheduler(profiles=None, **kw):
+    cs = FakeClientset()
+    sched = Scheduler(clientset=cs, profile_factory=profiles, **kw)
+    return cs, sched
+
+
+class TestBasicScheduling:
+    def test_single_pod_binds(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        pod = make_pod().name("p1").req({"cpu": "1"}).obj()
+        cs.create_pod(pod)
+        assert sched.schedule_one()
+        assert cs.bindings[pod.uid] == "n1"
+        assert sched.scheduled == 1
+
+    def test_pod_prefers_emptier_node(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("big").capacity({"cpu": "8", "memory": "16Gi", "pods": 10}).obj())
+        cs.create_node(make_node().name("small").capacity({"cpu": "2", "memory": "4Gi", "pods": 10}).obj())
+        # load the small node
+        filler = make_pod().name("filler").req({"cpu": "1500m"}).node("small").obj()
+        cs.create_pod(filler)
+        pod = make_pod().name("p").req({"cpu": "1"}).obj()
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert cs.bindings[pod.uid] == "big"
+
+    def test_no_fit_goes_unschedulable(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("n1").capacity({"cpu": "1", "memory": "1Gi", "pods": 10}).obj())
+        pod = make_pod().name("huge").req({"cpu": "64"}).obj()
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert pod.uid not in cs.bindings
+        assert len(sched.queue.unschedulable) == 1
+
+    def test_unschedulable_requeued_on_node_add(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("n1").capacity({"cpu": "1", "memory": "1Gi", "pods": 10}).obj())
+        pod = make_pod().name("p").req({"cpu": "4"}).obj()
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert len(sched.queue.unschedulable) == 1
+        cs.create_node(make_node().name("n2").capacity({"cpu": "8", "memory": "8Gi", "pods": 10}).obj())
+        assert len(sched.queue.unschedulable) == 0  # moved by Node/Add event
+        sched.run_until_idle()
+        assert cs.bindings[pod.uid] == "n2"
+
+    def test_many_pods_fill_cluster(self):
+        cs, sched = new_scheduler()
+        for i in range(4):
+            cs.create_node(make_node().name(f"n{i}").capacity({"cpu": "4", "memory": "8Gi", "pods": 110}).obj())
+        pods = [make_pod().name(f"p{i}").req({"cpu": "500m"}).obj() for i in range(20)]
+        for p in pods:
+            cs.create_pod(p)
+        sched.run_until_idle()
+        assert sched.scheduled == 20
+        # resource accounting: each node has at most 8 pods (4 cpu / 500m)
+        per_node = {}
+        for uid, n in cs.bindings.items():
+            per_node[n] = per_node.get(n, 0) + 1
+        assert all(v <= 8 for v in per_node.values())
+        assert sum(per_node.values()) == 20
+
+    def test_priority_order(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("n1").capacity({"cpu": "1", "memory": "8Gi", "pods": 10}).obj())
+        low = make_pod().name("low").priority(1).req({"cpu": "800m"}).obj()
+        high = make_pod().name("high").priority(100).req({"cpu": "800m"}).obj()
+        cs.create_pod(low)
+        cs.create_pod(high)
+        sched.schedule_one()  # must pick high first
+        assert high.uid in cs.bindings
+        assert low.uid not in cs.bindings
+
+
+class TestPlugins:
+    def test_taints_block(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("tainted").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                       .taint("dedicated", "gpu", "NoSchedule").obj())
+        cs.create_node(make_node().name("clean").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        pod = make_pod().name("p").req({"cpu": "1"}).obj()
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert cs.bindings[pod.uid] == "clean"
+
+    def test_toleration_allows(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("tainted").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                       .taint("dedicated", "gpu", "NoSchedule").obj())
+        pod = (make_pod().name("p").req({"cpu": "1"})
+               .toleration("dedicated", "gpu", "Equal", "NoSchedule").obj())
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert cs.bindings[pod.uid] == "tainted"
+
+    def test_node_selector(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("a").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                       .label("disk", "hdd").obj())
+        cs.create_node(make_node().name("b").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                       .label("disk", "ssd").obj())
+        pod = make_pod().name("p").req({"cpu": "1"}).node_selector({"disk": "ssd"}).obj()
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert cs.bindings[pod.uid] == "b"
+
+    def test_node_affinity_required(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("a").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                       .zone("z1").obj())
+        cs.create_node(make_node().name("b").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                       .zone("z2").obj())
+        pod = (make_pod().name("p").req({"cpu": "1"})
+               .node_affinity_in("topology.kubernetes.io/zone", ["z2"]).obj())
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert cs.bindings[pod.uid] == "b"
+
+    def test_preferred_node_affinity_scores(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("a").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                       .label("tier", "cold").obj())
+        cs.create_node(make_node().name("b").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                       .label("tier", "hot").obj())
+        pod = (make_pod().name("p").req({"cpu": "1"})
+               .preferred_node_affinity(100, "tier", ["hot"]).obj())
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert cs.bindings[pod.uid] == "b"
+
+    def test_host_port_conflict(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        cs.create_node(make_node().name("n2").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        p1 = make_pod().name("p1").req({"cpu": "100m"}).host_port(8080).obj()
+        cs.create_pod(p1)
+        sched.schedule_one()
+        p2 = make_pod().name("p2").req({"cpu": "100m"}).host_port(8080).obj()
+        cs.create_pod(p2)
+        sched.schedule_one()
+        assert cs.bindings[p1.uid] != cs.bindings[p2.uid]
+
+    def test_unschedulable_node_skipped(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("cordoned").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                       .unschedulable().obj())
+        cs.create_node(make_node().name("ok").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        pod = make_pod().name("p").req({"cpu": "1"}).obj()
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert cs.bindings[pod.uid] == "ok"
+
+    def test_scheduling_gates_hold_pod(self):
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        pod = make_pod().name("gated").req({"cpu": "1"}).scheduling_gate("wait").obj()
+        cs.create_pod(pod)
+        assert not sched.schedule_one()  # nothing poppable
+        assert len(sched.queue.unschedulable) == 1
+        # remove the gate → pod becomes schedulable
+        pod.scheduling_gates = []
+        cs.update_pod(pod)
+        sched.run_until_idle()
+        assert cs.bindings[pod.uid] == "n1"
+
+
+class TestTopologySpread:
+    def test_do_not_schedule_respects_skew(self):
+        cs, sched = new_scheduler()
+        for i, z in [(0, "z1"), (1, "z1"), (2, "z2")]:
+            cs.create_node(make_node().name(f"n{i}").capacity({"cpu": "16", "memory": "32Gi", "pods": 110})
+                           .zone(z).obj())
+        # 2 existing app pods in z1, 0 in z2 → next app pod must go z2
+        for i, n in [(0, "n0"), (1, "n1")]:
+            cs.create_pod(make_pod().name(f"pre{i}").label("app", "web").req({"cpu": "100m"}).node(n).obj())
+        pod = (make_pod().name("p").label("app", "web").req({"cpu": "100m"})
+               .spread_constraint(1, "topology.kubernetes.io/zone", match_labels={"app": "web"}).obj())
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert cs.bindings[pod.uid] == "n2"
+
+    def test_spread_sequence_balances_zones(self):
+        cs, sched = new_scheduler()
+        for i in range(4):
+            cs.create_node(make_node().name(f"n{i}").capacity({"cpu": "16", "memory": "32Gi", "pods": 110})
+                           .zone(f"z{i % 2}").obj())
+        pods = [
+            (make_pod().name(f"p{i}").label("app", "web").req({"cpu": "100m"})
+             .spread_constraint(1, "topology.kubernetes.io/zone", match_labels={"app": "web"}).obj())
+            for i in range(10)
+        ]
+        for p in pods:
+            cs.create_pod(p)
+        sched.run_until_idle()
+        zone_count = {"z0": 0, "z1": 0}
+        for p in pods:
+            n = cs.bindings[p.uid]
+            zone_count[f"z{int(n[1:]) % 2}"] += 1
+        assert abs(zone_count["z0"] - zone_count["z1"]) <= 1
+
+
+class TestInterPodAffinity:
+    def test_required_anti_affinity_spreads(self):
+        cs, sched = new_scheduler()
+        for i in range(3):
+            cs.create_node(make_node().name(f"n{i}").capacity({"cpu": "16", "memory": "32Gi", "pods": 110}).obj())
+        pods = [
+            (make_pod().name(f"p{i}").label("app", "db").req({"cpu": "100m"})
+             .pod_affinity("kubernetes.io/hostname", {"app": "db"}, anti=True).obj())
+            for i in range(3)
+        ]
+        for p in pods:
+            cs.create_pod(p)
+        sched.run_until_idle()
+        hosts = {cs.bindings[p.uid] for p in pods}
+        assert len(hosts) == 3  # one per node
+
+    def test_fourth_anti_affinity_pod_unschedulable(self):
+        cs, sched = new_scheduler()
+        for i in range(3):
+            cs.create_node(make_node().name(f"n{i}").capacity({"cpu": "16", "memory": "32Gi", "pods": 110}).obj())
+        pods = [
+            (make_pod().name(f"p{i}").label("app", "db").req({"cpu": "100m"})
+             .pod_affinity("kubernetes.io/hostname", {"app": "db"}, anti=True).obj())
+            for i in range(4)
+        ]
+        for p in pods:
+            cs.create_pod(p)
+        sched.run_until_idle()
+        assert len(cs.bindings) == 3
+        assert len(sched.queue.unschedulable) == 1
+
+    def test_required_affinity_coschedules(self):
+        cs, sched = new_scheduler()
+        for i in range(3):
+            cs.create_node(make_node().name(f"n{i}").capacity({"cpu": "16", "memory": "32Gi", "pods": 110}).obj())
+        base = make_pod().name("base").label("app", "cache").req({"cpu": "100m"}).obj()
+        cs.create_pod(base)
+        sched.schedule_one()
+        follower = (make_pod().name("f").req({"cpu": "100m"})
+                    .pod_affinity("kubernetes.io/hostname", {"app": "cache"}).obj())
+        cs.create_pod(follower)
+        sched.schedule_one()
+        assert cs.bindings[follower.uid] == cs.bindings[base.uid]
+
+    def test_self_affinity_bootstrap(self):
+        # A pod whose affinity matches its own labels can schedule on an
+        # empty cluster (filtering.go satisfyPodAffinity special case).
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("n0").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        pod = (make_pod().name("p").label("app", "x").req({"cpu": "100m"})
+               .pod_affinity("kubernetes.io/hostname", {"app": "x"}).obj())
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert pod.uid in cs.bindings
+
+
+class TestFitOnlyProfile:
+    def test_fit_only(self):
+        cs, sched = new_scheduler(profiles=fit_only_profiles)
+        cs.create_node(make_node().name("n1").capacity({"cpu": "2", "memory": "4Gi", "pods": 10}).obj())
+        pod = make_pod().name("p").req({"cpu": "1"}).obj()
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert cs.bindings[pod.uid] == "n1"
+
+
+class TestBackoff:
+    def test_backoff_duration_doubles(self):
+        from kubernetes_tpu.core.queue import PriorityQueue, QueuedPodInfo
+        from kubernetes_tpu.core.node_info import PodInfo
+        q = PriorityQueue()
+        pod = make_pod().name("p").obj()
+        qpi = QueuedPodInfo(pod_info=PodInfo.of(pod))
+        qpi.attempts = 1
+        assert q.backoff_duration(qpi) == 1.0
+        qpi.attempts = 3
+        assert q.backoff_duration(qpi) == 4.0
+        qpi.attempts = 10
+        assert q.backoff_duration(qpi) == 10.0  # capped
