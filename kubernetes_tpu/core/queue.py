@@ -148,6 +148,9 @@ class Nominator:
     def nominated_node_for_pod(self, pod: Pod) -> Optional[str]:
         return self._pod_to_node.get(pod.uid)
 
+    def has_nominated_pods(self) -> bool:
+        return bool(self._pod_to_node)
+
 
 class PriorityQueue:
     def __init__(

@@ -59,6 +59,21 @@ MIN_FEASIBLE_NODES_TO_FIND = 100
 MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5
 
 
+def num_feasible_nodes_to_find(num_all_nodes: int, percentage: int = 0) -> int:
+    """schedule_one.go:866 — adaptive 5-50% sampling, floor 100. The single
+    source of truth shared by the host loop and the device kernel's sampling
+    emulation (ops/features.py)."""
+    if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND:
+        return num_all_nodes
+    if percentage > 0:
+        pct = percentage
+    else:
+        pct = 50 - num_all_nodes // 125
+        if pct < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
+            pct = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
+    return max(num_all_nodes * pct // 100, MIN_FEASIBLE_NODES_TO_FIND)
+
+
 @dataclass
 class ScheduleResult:
     suggested_host: str = ""
@@ -91,6 +106,7 @@ class Scheduler:
         profile_factory: Optional[Callable[[Handle], Dict[str, Framework]]] = None,
         percentage_of_nodes_to_score: int = 0,
         seed: int = 0,
+        deterministic_ties: bool = False,
         now: Callable[[], float] = time.monotonic,
     ):
         self.clientset = clientset or FakeClientset()
@@ -99,6 +115,10 @@ class Scheduler:
         self.now = now
         self.rng = random.Random(seed)
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        # deterministic_ties picks the first max-score node in evaluation
+        # order instead of reservoir-sampling among ties (schedule_one.go
+        # selectHost) — required for host↔device assignment equivalence.
+        self.deterministic_ties = deterministic_ties
         self.next_start_node_index = 0
 
         handle = Handle(self)
@@ -189,6 +209,11 @@ class Scheduler:
         qpi = self.queue.pop()
         if qpi is None:
             return False
+        self.process_one(qpi)
+        return True
+
+    def process_one(self, qpi: QueuedPodInfo) -> None:
+        """One full scheduling+binding cycle for an already-popped entity."""
         pod = qpi.pod
         fw = self.framework_for_pod(pod)
         self.attempts += 1
@@ -198,15 +223,14 @@ class Scheduler:
         except FitError as fe:
             self.handle_scheduling_failure(fw, qpi, Status(UNSCHEDULABLE, (str(fe),)), fe.diagnosis)
             self.queue.done(pod.uid)
-            return True
+            return
         except Exception as e:  # noqa: BLE001
             self.error_log.append(f"{pod.namespace}/{pod.name}: {e!r}")
             self.handle_scheduling_failure(fw, qpi, Status.error(str(e)), None)
             self.queue.done(pod.uid)
-            return True
+            return
         self.run_binding_cycle(fw, state, qpi, result)
         self.queue.done(pod.uid)
-        return True
 
     def scheduling_cycle(self, fw: Framework, state: CycleState, qpi: QueuedPodInfo) -> ScheduleResult:
         pod = qpi.pod
@@ -283,17 +307,7 @@ class Scheduler:
         return feasible, diagnosis
 
     def num_feasible_nodes_to_find(self, num_all_nodes: int) -> int:
-        """schedule_one.go:866 — adaptive 5–50% sampling, floor 100."""
-        if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND:
-            return num_all_nodes
-        if self.percentage_of_nodes_to_score > 0:
-            pct = self.percentage_of_nodes_to_score
-        else:
-            pct = 50 - num_all_nodes // 125
-            if pct < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
-                pct = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
-        num = num_all_nodes * pct // 100
-        return max(num, MIN_FEASIBLE_NODES_TO_FIND)
+        return num_feasible_nodes_to_find(num_all_nodes, self.percentage_of_nodes_to_score)
 
     def find_nodes_that_pass_filters(
         self,
@@ -338,14 +352,15 @@ class Scheduler:
 
     def select_host(self, node_scores: List[NodeScore]) -> str:
         """Reservoir-sample among max-score nodes (schedule_one.go selectHost),
-        seeded RNG so runs are reproducible."""
+        seeded RNG so runs are reproducible; first-max when
+        deterministic_ties is set (device-parity mode)."""
         best = node_scores[0]
         cnt = 1
         for ns in node_scores[1:]:
             if ns.score > best.score:
                 best = ns
                 cnt = 1
-            elif ns.score == best.score:
+            elif ns.score == best.score and not self.deterministic_ties:
                 cnt += 1
                 if self.rng.random() < 1.0 / cnt:
                     best = ns

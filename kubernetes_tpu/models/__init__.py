@@ -1,0 +1,9 @@
+"""End-to-end scheduling pipelines ("models" of the framework).
+
+The flagship is TPUScheduler (tpu_scheduler.py): the host scheduling core with
+the Filter→Score hot path dispatched to the device batch kernel.
+"""
+
+from .tpu_scheduler import TPUScheduler
+
+__all__ = ["TPUScheduler"]

@@ -1,0 +1,225 @@
+"""TPUScheduler — the device-backed scheduling pipeline (the framework's
+flagship "model").
+
+Control flow (the TPU-era schedule_one, per SURVEY.md §3.2/§7.4):
+
+    pop → accumulate a row-block of consecutive same-signature pods
+        → Cache.update_snapshot (host, incremental)
+        → NodeStateMirror.sync/flush (device, dirty-row scatter)
+        → build_batch (ONE amortized O(pods) PreFilter aggregation)
+        → ops.kernel.schedule_batch (jit: the whole greedy sequential
+          assignment for the block runs on device — filters, sampling
+          emulation, scoring, selection, carry updates)
+        → per pod: assume → reserve → permit → binding cycle (host,
+          unchanged semantics; schedule_one.go:315,:211,:141)
+
+Pods whose spec exceeds the kernel's coverage (ops/features.py
+batch_supported) take the unchanged host path — the reference-shaped
+sequential cycle in core/scheduler.py — preserving exact semantics for every
+feature while the dense common case rides the device.
+
+Pod signatures come from the profile's Sign plugins
+(framework.sign_pod; staging kube-scheduler framework/signers.go), the same
+mechanism the reference's OpportunisticBatching uses (runtime/batch.go:33) —
+generalized from one-pod hint reuse to true multi-pod kernel batches.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.framework import Framework
+from ..core.queue import QueuedPodInfo
+from ..core.scheduler import Scheduler, ScheduleResult
+from ..ops.device_state import NodeStateMirror
+from ..ops.features import Unsupported, batch_supported, build_batch
+from ..ops.kernel import schedule_batch
+
+
+class TPUScheduler(Scheduler):
+    """Scheduler with the hot path on device. Falls back per-pod to the host
+    path for uncovered features; host and device paths produce identical
+    assignments (deterministic_ties is forced on)."""
+
+    def __init__(self, *args, max_batch: int = 512, **kwargs):
+        kwargs.setdefault("deterministic_ties", True)
+        super().__init__(*args, **kwargs)
+        self.max_batch = max_batch
+        self.mirror = NodeStateMirror()
+        self._holdover: Optional[QueuedPodInfo] = None
+        # metrics
+        self.device_batches = 0
+        self.device_scheduled = 0
+        self.host_path_pods = 0
+
+    # -- batch accumulation ------------------------------------------------
+
+    def _pop(self) -> Optional[QueuedPodInfo]:
+        if self._holdover is not None:
+            qpi, self._holdover = self._holdover, None
+            return qpi
+        return self.queue.pop()
+
+    def _collect_batch(self) -> Tuple[Optional[Framework], List[QueuedPodInfo], Optional[str]]:
+        """Pop a maximal run of consecutive identical-signature pods.
+        Returns (framework, batch, fallback_reason); fallback_reason set when
+        the batch head must take the host path (batch will be length 1)."""
+        head = self._pop()
+        if head is None:
+            return None, [], None
+        fw = self.framework_for_pod(head.pod)
+        reason = batch_supported(
+            head.pod, self.snapshot,
+            fit_plugin=fw.plugin("NodeResourcesFit"),
+            ba_plugin=fw.plugin("NodeResourcesBalancedAllocation"))
+        if reason is None and self.queue.nominator.has_nominated_pods():
+            reason = "nominated pods present"
+        sig = fw.sign_pod(head.pod) if reason is None else None
+        if sig is None:
+            return fw, [head], reason or "unsignable pod"
+        batch = [head]
+        while len(batch) < self.max_batch:
+            nxt = self._pop()
+            if nxt is None:
+                break
+            if (nxt.pod.scheduler_name == head.pod.scheduler_name
+                    and fw.sign_pod(nxt.pod) == sig):
+                batch.append(nxt)
+            else:
+                self._holdover = nxt
+                break
+        return fw, batch, None
+
+    # -- device dispatch ---------------------------------------------------
+
+    def _profile_weights(self, fw: Framework) -> Tuple[int, int, int, int, int]:
+        w = {p.name: weight for p, weight in fw.score_plugins}
+        return (
+            w.get("TaintToleration", 0),
+            w.get("NodeResourcesFit", 0),
+            w.get("PodTopologySpread", 0),
+            w.get("InterPodAffinity", 0),
+            w.get("NodeResourcesBalancedAllocation", 0),
+        )
+
+    def _profile_filters(self, fw: Framework) -> Tuple[bool, bool, bool, bool, bool]:
+        names = {p.name for p in fw.filter_plugins}
+        return (
+            "NodeName" in names,
+            "NodeUnschedulable" in names,
+            "TaintToleration" in names,
+            "NodeAffinity" in names,
+            "NodeResourcesFit" in names,
+        )
+
+    def _device_unsupported_profile(self, fw: Framework, pod) -> Optional[str]:
+        """PTS/IPA are always enforced by the kernel when the pod carries the
+        feature; if the profile disables the plugin, take the host path."""
+        names = {p.name for p in fw.filter_plugins}
+        if pod.topology_spread_constraints and "PodTopologySpread" not in names:
+            return "spread constraints without PodTopologySpread plugin"
+        aff = pod.affinity
+        if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity) \
+                and "InterPodAffinity" not in names:
+            return "pod affinity without InterPodAffinity plugin"
+        pts = fw.plugin("PodTopologySpread")
+        if pts is not None and getattr(pts, "default_constraints", ()) \
+                and not pod.topology_spread_constraints:
+            return "plugin-level default spread constraints"
+        return None
+
+    def schedule_batch_on_device(self, fw: Framework, batch: List[QueuedPodInfo]) -> None:
+        pods = [q.pod for q in batch]
+        self.cache.update_snapshot(self.snapshot)
+        self.mirror.sync(self.snapshot.node_info_list)
+        ipa = fw.plugin("InterPodAffinity")
+        plan = build_batch(
+            pods[0],
+            batch_size=len(pods),
+            mirror=self.mirror,
+            snapshot=self.snapshot,
+            ns_labels_fn=self.cache.namespace_labels,
+            percentage_of_nodes_to_score=self.percentage_of_nodes_to_score,
+            start_index=self.next_start_node_index,
+            weights=self._profile_weights(fw),
+            filters_on=self._profile_filters(fw),
+            hard_pod_affinity_weight=getattr(ipa, "hard_pod_affinity_weight", 1),
+            ignore_preferred_terms_of_existing_pods=getattr(
+                ipa, "ignore_preferred_terms_of_existing_pods", False),
+            fit_plugin=fw.plugin("NodeResourcesFit"),
+        )
+        state = self.mirror.flush()
+        chosen, starts = schedule_batch(
+            state, plan.features, plan.batch_pad, plan.fit_strategy, plan.vmax)
+        n = len(pods)
+        chosen = np.asarray(chosen)[:n]
+        starts = np.asarray(starts)[:n]
+        self.device_batches += 1
+
+        node_names = [ni.name for ni in self.snapshot.node_info_list]
+        for i, qpi in enumerate(batch):
+            row = int(chosen[i])
+            self.next_start_node_index = int(starts[i])
+            if row < 0:
+                # Infeasible on device: rerun on the host path for the exact
+                # FitError diagnosis (and as a safety net — equivalence is
+                # separately enforced by tests).
+                self.host_path_pods += 1
+                self.process_one(qpi)
+                continue
+            self._commit(fw, qpi, node_names[row])
+
+    def _commit(self, fw: Framework, qpi: QueuedPodInfo, node_name: str) -> None:
+        """assume → reserve → permit → binding cycle (the unchanged host tail
+        of the scheduling cycle, schedule_one.go:315 onward)."""
+        from ..core.framework import CycleState
+
+        pod = qpi.pod
+        self.attempts += 1
+        state = CycleState()
+        pod.node_name = node_name
+        self.cache.assume_pod(pod)
+        st = fw.run_reserve_plugins_reserve(state, pod, node_name)
+        if not st.is_success():
+            fw.run_reserve_plugins_unreserve(state, pod, node_name)
+            self.cache.forget_pod(pod)
+            pod.node_name = ""
+            self.handle_scheduling_failure(fw, qpi, st, None)
+            self.queue.done(pod.uid)
+            return
+        st = fw.run_permit_plugins(state, pod, node_name)
+        if st.is_rejected():
+            fw.run_reserve_plugins_unreserve(state, pod, node_name)
+            self.cache.forget_pod(pod)
+            pod.node_name = ""
+            self.handle_scheduling_failure(fw, qpi, st, None)
+            self.queue.done(pod.uid)
+            return
+        self.run_binding_cycle(fw, state, qpi, ScheduleResult(suggested_host=node_name))
+        self.device_scheduled += 1
+        self.queue.done(pod.uid)
+
+    # -- run loop ----------------------------------------------------------
+
+    def schedule_one(self) -> bool:
+        fw, batch, fallback_reason = self._collect_batch()
+        if not batch:
+            return False
+        if fallback_reason is None and len(batch) >= 1:
+            pr = self._device_unsupported_profile(fw, batch[0].pod)
+            if pr is not None:
+                fallback_reason = pr
+        if fallback_reason is not None:
+            for qpi in batch:
+                self.host_path_pods += 1
+                self.process_one(qpi)
+            return True
+        try:
+            self.schedule_batch_on_device(fw, batch)
+        except Unsupported:
+            for qpi in batch:
+                self.host_path_pods += 1
+                self.process_one(qpi)
+        return True

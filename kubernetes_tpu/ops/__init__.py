@@ -1,0 +1,14 @@
+"""Device (TPU) execution layer.
+
+The host scheduling core (kubernetes_tpu/core) stays authoritative; this
+package mirrors the node snapshot into fixed-capacity SoA tensors
+(`device_state`), extracts per-batch pod features (`features`), and evaluates
+the whole Filter→Score hot path as one jit-compiled pods×nodes kernel with a
+greedy sequential assignment scan (`kernel`) — the TPU-native replacement for
+the reference's 16-goroutine Parallelizer fan-out
+(pkg/scheduler/framework/parallelize/parallelism.go:28) per SURVEY.md §2.4/§7.
+"""
+
+from .codebook import Codebook
+
+__all__ = ["Codebook"]

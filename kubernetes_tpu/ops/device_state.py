@@ -1,0 +1,312 @@
+"""Device mirror of the scheduler snapshot: fixed-capacity SoA node tensors.
+
+This is the TPU-era equivalent of the reference's incremental snapshot refresh
+(pkg/scheduler/backend/cache/cache.go:206 UpdateSnapshot, generation walk at
+:236-262): the mirror keeps one row per node in `snapshot.node_info_list`
+order, re-encodes only rows whose NodeInfo.generation advanced (or whose list
+position changed), and flushes them to device with a scatter when few rows are
+dirty, a full upload otherwise.
+
+Row order == snapshot list order, so the kernel's rotation arithmetic
+(schedule_one.go:816 nextStartNodeIndex) operates directly on row indices.
+
+All quantities are int64: resource units are integers by construction
+(api/resource.py canonicalises CPU to millicores, memory to bytes), and the
+kernel's score math is specified in exact integer arithmetic so host oracle
+and device agree bit-for-bit (see ops/kernel.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from ..api import resource as res  # noqa: E402
+from ..core.node_info import NodeInfo  # noqa: E402
+from .codebook import EFFECT_IDS, Codebook  # noqa: E402
+
+# Resource slot layout: [cpu_milli, memory, ephemeral_storage, *scalar_slots].
+BASE_RESOURCES = 3
+SLOT_CPU = 0
+SLOT_MEMORY = 1
+SLOT_EPHEMERAL = 2
+
+
+class DeviceNodeState(NamedTuple):
+    """The pytree of node tensors the kernel consumes."""
+
+    alloc_r: jnp.ndarray      # [NP, R] i64 allocatable per resource slot
+    alloc_pods: jnp.ndarray   # [NP]    i64 allocatable pod count
+    req_r: jnp.ndarray        # [NP, R] i64 requested (assumed+bound pods)
+    nonzero: jnp.ndarray      # [NP, 2] i64 non-zero-default cpu/mem aggregate
+    pod_count: jnp.ndarray    # [NP]    i32
+    taint_key: jnp.ndarray    # [NP, T] i32 interned taint keys (0 pad)
+    taint_val: jnp.ndarray    # [NP, T] i32
+    taint_eff: jnp.ndarray    # [NP, T] i32 (EFFECT_* ids; 0 pad = inert)
+    unsched: jnp.ndarray      # [NP]    bool node.spec.unschedulable
+    valid: jnp.ndarray        # [NP]    bool row holds a live node
+    name_id: jnp.ndarray      # [NP]    i32 interned node name
+    pairs: jnp.ndarray        # [NP, L] i32 interned label (k,v) pairs (0 pad)
+    topo: jnp.ndarray         # [K, NP] i32 per-axis topology value ids (0 = absent)
+
+
+def _pow2(n: int, floor: int) -> int:
+    c = floor
+    while c < n:
+        c *= 2
+    return c
+
+
+class TopoAxis:
+    """One registered topology key (e.g. topology.kubernetes.io/zone):
+    per-key value codebook + its row in the mirror's `topo` tensor.
+
+    Value id 0 means "key absent"; a label present with an EMPTY value (legal
+    in Kubernetes, and a real domain for topology spreading) is interned under
+    a private token so it gets a distinct non-zero id."""
+
+    __slots__ = ("key", "index", "values")
+
+    _EMPTY_TOKEN = "\x00empty"
+
+    def __init__(self, key: str, index: int):
+        self.key = key
+        self.index = index
+        self.values = Codebook()
+
+    def intern_value(self, val: str) -> int:
+        return self.values.intern(val if val != "" else self._EMPTY_TOKEN)
+
+    def lookup_value(self, val: str) -> int:
+        return self.values.lookup(val if val != "" else self._EMPTY_TOKEN)
+
+
+class NodeStateMirror:
+    """Host-side staging + device flush for DeviceNodeState."""
+
+    def __init__(
+        self,
+        node_capacity: int = 64,
+        taint_capacity: int = 4,
+        label_capacity: int = 32,
+        scalar_capacity: int = 4,
+        axis_capacity: int = 4,
+        scatter_threshold: float = 0.25,
+    ):
+        self.np_cap = node_capacity
+        self.t_cap = taint_capacity
+        self.l_cap = label_capacity
+        self.s_cap = scalar_capacity
+        self.k_cap = axis_capacity
+        self.scatter_threshold = scatter_threshold
+
+        self.keys = Codebook()        # taint keys (shared with tolerations)
+        self.vals = Codebook()        # taint values
+        self.pairs = Codebook(("", ""))  # label (key, value) pairs
+        self.names = Codebook()       # node names
+        self.scalar_slots: Dict[str, int] = {}  # scalar resource -> slot >= BASE_RESOURCES
+        self.axes: Dict[str, TopoAxis] = {}
+
+        self._alloc_storage()
+        self._row_names: List[str] = []
+        self._row_gen: List[int] = []
+        self._dirty: set = set()
+        self._full_flush = True
+        self._device: Optional[DeviceNodeState] = None
+        self.num_nodes = 0
+
+    # -- storage -----------------------------------------------------------
+
+    @property
+    def r_slots(self) -> int:
+        return BASE_RESOURCES + self.s_cap
+
+    def _alloc_storage(self) -> None:
+        npc, t, l, r, k = self.np_cap, self.t_cap, self.l_cap, self.r_slots, self.k_cap
+        self.h_alloc_r = np.zeros((npc, r), np.int64)
+        self.h_alloc_pods = np.zeros(npc, np.int64)
+        self.h_req_r = np.zeros((npc, r), np.int64)
+        self.h_nonzero = np.zeros((npc, 2), np.int64)
+        self.h_pod_count = np.zeros(npc, np.int32)
+        self.h_taint_key = np.zeros((npc, t), np.int32)
+        self.h_taint_val = np.zeros((npc, t), np.int32)
+        self.h_taint_eff = np.zeros((npc, t), np.int32)
+        self.h_unsched = np.zeros(npc, bool)
+        self.h_valid = np.zeros(npc, bool)
+        self.h_name_id = np.zeros(npc, np.int32)
+        self.h_pairs = np.zeros((npc, l), np.int32)
+        self.h_topo = np.zeros((k, npc), np.int32)
+
+    def _grow(self, node_capacity=None, taint_capacity=None, label_capacity=None,
+              scalar_capacity=None, axis_capacity=None) -> None:
+        """Capacity tier change: reallocate staging and force a full re-encode
+        + full flush (shape change ⇒ the kernel recompiles once per tier,
+        SURVEY.md §7 'padding + capacity tiers and a recompile policy')."""
+        self.np_cap = node_capacity or self.np_cap
+        self.t_cap = taint_capacity or self.t_cap
+        self.l_cap = label_capacity or self.l_cap
+        self.s_cap = scalar_capacity or self.s_cap
+        self.k_cap = axis_capacity or self.k_cap
+        self._alloc_storage()
+        self._row_names = []
+        self._row_gen = []
+        self._full_flush = True
+        self._device = None
+
+    # -- axes / scalar slots ----------------------------------------------
+
+    def ensure_axis(self, key: str) -> TopoAxis:
+        ax = self.axes.get(key)
+        if ax is not None:
+            return ax
+        if len(self.axes) >= self.k_cap:
+            self._grow(axis_capacity=self.k_cap * 2)
+            # staging was reset; existing axes refill on next sync
+        ax = TopoAxis(key, len(self.axes))
+        self.axes[key] = ax
+        # Existing rows lack the new axis column: force re-encode on next sync.
+        self._full_flush = True
+        self._row_gen = [-1] * len(self._row_gen)
+        return ax
+
+    def scalar_slot(self, resource_name: str) -> int:
+        slot = self.scalar_slots.get(resource_name)
+        if slot is not None:
+            return slot
+        if len(self.scalar_slots) >= self.s_cap:
+            self._grow(scalar_capacity=self.s_cap * 2)
+        slot = BASE_RESOURCES + len(self.scalar_slots)
+        self.scalar_slots[resource_name] = slot
+        return slot
+
+    # -- row encoding ------------------------------------------------------
+
+    def _resource_vec(self, r: "res.Resource", out: np.ndarray) -> None:
+        out[:] = 0
+        out[SLOT_CPU] = r.milli_cpu
+        out[SLOT_MEMORY] = r.memory
+        out[SLOT_EPHEMERAL] = r.ephemeral_storage
+        for name, amount in r.scalar_resources.items():
+            slot = self.scalar_slot(name)
+            if slot >= out.shape[0]:
+                # scalar_slot grew the capacity tier and reallocated staging;
+                # `out` points into the orphaned old arrays — re-walk.
+                raise _Regrown()
+            out[slot] = amount
+
+    def _encode_row(self, i: int, ni: NodeInfo) -> None:
+        node = ni.node
+        self._resource_vec(ni.allocatable, self.h_alloc_r[i])
+        self.h_alloc_pods[i] = ni.allocatable.allowed_pod_number
+        self._resource_vec(ni.requested, self.h_req_r[i])
+        self.h_nonzero[i, 0] = ni.non_zero_requested.milli_cpu
+        self.h_nonzero[i, 1] = ni.non_zero_requested.memory
+        self.h_pod_count[i] = len(ni.pods)
+        taints = node.taints if node else []
+        if len(taints) > self.t_cap:
+            self._grow(taint_capacity=_pow2(len(taints), self.t_cap * 2))
+            raise _Regrown()
+        self.h_taint_key[i] = 0
+        self.h_taint_val[i] = 0
+        self.h_taint_eff[i] = 0
+        for j, t in enumerate(taints):
+            self.h_taint_key[i, j] = self.keys.intern(t.key)
+            self.h_taint_val[i, j] = self.vals.intern(t.value)
+            self.h_taint_eff[i, j] = EFFECT_IDS.get(t.effect, 0)
+        self.h_unsched[i] = bool(node and node.unschedulable)
+        self.h_valid[i] = node is not None
+        self.h_name_id[i] = self.names.intern(node.name) if node else 0
+        labels = node.labels if node else {}
+        if len(labels) > self.l_cap:
+            self._grow(label_capacity=_pow2(len(labels), self.l_cap * 2))
+            raise _Regrown()
+        self.h_pairs[i] = 0
+        for j, (k, v) in enumerate(labels.items()):
+            self.h_pairs[i, j] = self.pairs.intern((k, v))
+        for ax in self.axes.values():
+            val = labels.get(ax.key)
+            self.h_topo[ax.index, i] = ax.intern_value(val) if val is not None else 0
+
+    # -- sync --------------------------------------------------------------
+
+    def sync(self, node_info_list: Sequence[NodeInfo]) -> None:
+        """Re-encode rows whose generation or position changed (the device
+        analogue of cache.go:236-262's generation walk)."""
+        n = len(node_info_list)
+        if n > self.np_cap:
+            self._grow(node_capacity=_pow2(n, self.np_cap * 2))
+        while True:
+            try:
+                self._sync_rows(node_info_list)
+                break
+            except _Regrown:
+                continue  # capacity tier changed: staging reset, re-walk
+        self.num_nodes = n
+
+    def _sync_rows(self, node_info_list: Sequence[NodeInfo]) -> None:
+        n = len(node_info_list)
+        names, gens = self._row_names, self._row_gen
+        for i, ni in enumerate(node_info_list):
+            if i < len(names) and names[i] == ni.name and gens[i] == ni.generation:
+                continue
+            self._encode_row(i, ni)
+            if i < len(names):
+                names[i] = ni.name
+                gens[i] = ni.generation
+            else:
+                names.append(ni.name)
+                gens.append(ni.generation)
+            self._dirty.add(i)
+        if len(names) > n:  # shrink: invalidate tail rows
+            for i in range(n, len(names)):
+                self.h_valid[i] = False
+                self._dirty.add(i)
+            del names[n:]
+            del gens[n:]
+
+    # -- flush -------------------------------------------------------------
+
+    def _arrays(self):
+        return (
+            self.h_alloc_r, self.h_alloc_pods, self.h_req_r, self.h_nonzero,
+            self.h_pod_count, self.h_taint_key, self.h_taint_val,
+            self.h_taint_eff, self.h_unsched, self.h_valid, self.h_name_id,
+            self.h_pairs,
+        )
+
+    def flush(self) -> DeviceNodeState:
+        """Upload pending changes; returns the device pytree. Scatter when the
+        dirty fraction is small, full device_put otherwise."""
+        if self._device is None or self._full_flush:
+            self._device = DeviceNodeState(
+                *[jnp.asarray(a) for a in self._arrays()], jnp.asarray(self.h_topo)
+            )
+        elif self._dirty:
+            if len(self._dirty) > self.scatter_threshold * self.np_cap:
+                self._device = DeviceNodeState(
+                    *[jnp.asarray(a) for a in self._arrays()], jnp.asarray(self.h_topo)
+                )
+            else:
+                dirty = sorted(self._dirty)
+                idx = jnp.asarray(dirty, jnp.int32)
+                d = self._device
+                updated = [
+                    arr.at[idx].set(jnp.asarray(a[dirty]))
+                    for arr, a in zip(d[:-1], self._arrays())
+                ]
+                topo = d.topo.at[:, idx].set(jnp.asarray(self.h_topo[:, dirty]))
+                self._device = DeviceNodeState(*updated, topo)
+        self._dirty.clear()
+        self._full_flush = False
+        return self._device
+
+
+class _Regrown(Exception):
+    """Internal: a capacity tier changed mid-encode; re-walk the snapshot."""

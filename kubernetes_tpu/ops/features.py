@@ -1,0 +1,570 @@
+"""Per-batch pod feature extraction for the device kernel.
+
+A *batch* is a row-block of consecutive same-signature pending pods (identical
+scheduling-relevant spec — the generalization of the reference's
+OpportunisticBatching pod signatures, runtime/batch.go:33, to true kernel
+batching per SURVEY.md §2.4). Because every pod in the batch is identical, the
+expensive O(all-pods) PreFilter aggregations (PodTopologySpread
+filtering.go:241 calPreFilterState, InterPodAffinity filtering.go:287) are
+computed ONCE here on the host, and the *sequential* inter-pod dependency —
+each assignment shifting the counts the next pod sees — runs entirely on
+device inside the kernel's lax.scan carry (ops/kernel.py).
+
+Everything here mirrors the host-oracle plugin semantics exactly; equivalence
+is enforced by tests/test_device_equivalence.py.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..api import resource as res
+from ..api.types import (
+    DO_NOT_SCHEDULE,
+    HONOR,
+    LABEL_HOSTNAME,
+    NO_SCHEDULE,
+    SCHEDULE_ANYWAY,
+    Pod,
+    Taint,
+    find_matching_untolerated_taint,
+)
+from ..core.node_info import NodeInfo, PodInfo
+from ..core.scheduler import num_feasible_nodes_to_find
+from ..plugins.basic import NodeUnschedulable
+from ..plugins.helpers import compile_terms
+from ..plugins.podtopologyspread import (
+    _compile_constraints,
+    _count_pods_matching,
+    PodTopologySpread,
+)
+from .codebook import EFFECT_IDS, OP_EQUAL, OP_EXISTS
+from .device_state import BASE_RESOURCES, NodeStateMirror
+
+_UNSCHED_TAINT = Taint(key=NodeUnschedulable.TAINT_KEY, effect=NO_SCHEDULE)
+
+DEFAULT_BA_RESOURCES = (res.CPU, res.MEMORY)
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    if n <= 0:
+        return 0
+    c = floor
+    while c < n:
+        c *= 2
+    return c
+
+
+class BatchFeatures(NamedTuple):
+    """Dynamic (traced) inputs to the batch kernel. All count tables are
+    [*, VMAX]; VMAX and every leading dimension are padded to power-of-two
+    tiers so jit recompiles are bounded (SURVEY.md §7 'capacity tiers')."""
+
+    # resources
+    request: jnp.ndarray          # [R] i64
+    nz_request: jnp.ndarray       # [2] i64 (cpu/mem with non-zero defaults)
+    has_request: jnp.ndarray      # i64 scalar (0 => all-zero request)
+    ba_skip: jnp.ndarray          # i64 scalar (BalancedAllocation PreScore skip)
+    # tolerations (pad eff = -1 rows never tolerate)
+    tol_key: jnp.ndarray          # [LT] i32
+    tol_val: jnp.ndarray          # [LT] i32
+    tol_eff: jnp.ndarray          # [LT] i32
+    tol_op: jnp.ndarray           # [LT] i32
+    # cheap filters
+    node_name_id: jnp.ndarray     # i32 (0 = unset)
+    tolerates_unsched: jnp.ndarray  # i32
+    sel_pairs: jnp.ndarray        # [Q] i32 required label (k,v) pair ids (0 pad)
+    # PodTopologySpread DoNotSchedule
+    dns_axis: jnp.ndarray         # [C1] i32 axis row in state.topo
+    dns_active: jnp.ndarray       # [C1] i32 (0 = padding row, never rejects)
+    dns_max_skew: jnp.ndarray     # [C1] i64
+    dns_self: jnp.ndarray         # [C1] i32 selector matches the batch pod itself
+    dns_forced0: jnp.ndarray      # [C1] i32 min-match forced to 0 (minDomains)
+    dns_honor_aff: jnp.ndarray    # [C1] i32 nodeAffinityPolicy == Honor
+    dns_honor_taints: jnp.ndarray  # [C1] i32 nodeTaintsPolicy == Honor
+    dns_counts: jnp.ndarray       # [C1, V] i32
+    dns_dom: jnp.ndarray          # [C1, V] bool eligible-domain mask
+    # PodTopologySpread ScheduleAnyway
+    sa_axis: jnp.ndarray          # [C2] i32
+    sa_wq: jnp.ndarray            # [C2] i64 round(log(size+2)*1024)
+    sa_skew: jnp.ndarray          # [C2] i64
+    sa_self: jnp.ndarray          # [C2] i32
+    sa_counts: jnp.ndarray        # [C2, V] i32
+    # InterPodAffinity required
+    anti_axis: jnp.ndarray        # [A1] i32
+    anti_self: jnp.ndarray        # [A1] i32
+    anti_counts: jnp.ndarray      # [A1, V] i32 (own anti ∪ landed contributions)
+    exist_anti: jnp.ndarray       # [NP] i32 existing pods' anti-affinity hits
+    aff_axis: jnp.ndarray         # [A2] i32
+    aff_self: jnp.ndarray         # [A2] i32
+    aff_active: jnp.ndarray       # [A2] i32 (0 = padding row, auto-pass)
+    aff_counts: jnp.ndarray       # [A2, V] i32
+    aff_own_all: jnp.ndarray      # i32 incoming matches all its own terms
+    # InterPodAffinity scoring
+    ipa_base: jnp.ndarray         # [NP] i64
+    ipa_axis: jnp.ndarray         # [KD] i32
+    ipa_wland: jnp.ndarray        # [KD] i64 score delta per landing at axis value
+    # Fit / BalancedAllocation scoring config
+    fit_slots: jnp.ndarray        # [FR] i32 resource slot per scored resource
+    fit_weights: jnp.ndarray      # [FR] i64
+    # plugin weights: [tt, fit, pts, ipa, ba]
+    weights: jnp.ndarray          # [5] i64
+    # filter enablement from the profile's filter plugin set:
+    # [NodeName, NodeUnschedulable, TaintToleration, NodeAffinity, NodeResourcesFit]
+    enable: jnp.ndarray           # [5] i32
+    # sampling / loop
+    num_nodes: jnp.ndarray        # i32
+    start_index: jnp.ndarray      # i32
+    to_find: jnp.ndarray          # i32
+
+
+@dataclass
+class BatchPlan:
+    """A built batch: kernel inputs + host bookkeeping."""
+
+    features: BatchFeatures
+    batch_pad: int                # scan length (>= len(pods))
+    fit_strategy: int             # 0 = LeastAllocated, 1 = MostAllocated
+    vmax: int
+
+
+class Unsupported(Exception):
+    """Pod uses a feature outside the device kernel's coverage — the caller
+    must take the host path (SURVEY.md §7.4 'sequential fallback')."""
+
+
+def batch_supported(pod: Pod, snapshot, fit_plugin=None, ba_plugin=None) -> Optional[str]:
+    """Returns a reason string when the pod needs the host path, else None."""
+    if pod.nominated_node_name:
+        return "nominated node fast path"
+    if pod.host_ports():
+        return "host ports"
+    aff = pod.affinity
+    if aff is not None and aff.node_affinity is not None:
+        na = aff.node_affinity
+        if na.preferred:
+            return "preferred node affinity scoring"
+        if na.required is not None:
+            return "node affinity expressions"
+    for c in pod.containers:
+        if c.image and c.image in snapshot.image_num_nodes:
+            return "image locality scoring"
+    if fit_plugin is not None and fit_plugin.scoring_strategy not in ("LeastAllocated", "MostAllocated"):
+        return "requestedToCapacityRatio strategy"
+    if ba_plugin is not None and tuple(
+            spec["name"] for spec in ba_plugin.resources) != DEFAULT_BA_RESOURCES:
+        return "balanced-allocation custom resources"
+    return None
+
+
+def _resource_vec(mirror: NodeStateMirror, r: "res.Resource") -> np.ndarray:
+    out = np.zeros(mirror.r_slots, np.int64)
+    out[0] = r.milli_cpu
+    out[1] = r.memory
+    out[2] = r.ephemeral_storage
+    for name, amount in r.scalar_resources.items():
+        out[mirror.scalar_slot(name)] = amount
+    return out
+
+
+def build_batch(
+    pod: Pod,
+    batch_size: int,
+    mirror: NodeStateMirror,
+    snapshot,
+    ns_labels_fn=None,
+    *,
+    percentage_of_nodes_to_score: int = 0,
+    start_index: int = 0,
+    weights: Tuple[int, int, int, int, int] = (3, 1, 2, 2, 1),
+    filters_on: Tuple[bool, bool, bool, bool, bool] = (True, True, True, True, True),
+    hard_pod_affinity_weight: int = 1,
+    ignore_preferred_terms_of_existing_pods: bool = False,
+    fit_plugin=None,
+) -> BatchPlan:
+    """Build kernel inputs for a batch of `batch_size` pods identical to `pod`.
+
+    `mirror` must already be synced to `snapshot`. Raises Unsupported for
+    feature combinations the kernel does not cover.
+    """
+    reason = batch_supported(pod, snapshot, fit_plugin=fit_plugin)
+    if reason:
+        raise Unsupported(reason)
+
+    nodes: List[NodeInfo] = snapshot.node_info_list
+    n = len(nodes)
+    i32, i64 = np.int32, np.int64
+
+    # -- resources (slot interning only; vectors are built after the
+    # re-sync point, since interning can grow the slot capacity) -----------
+    req = pod.resource_request()
+    for name in req.scalar_resources:
+        mirror.scalar_slot(name)
+    if fit_plugin is not None:
+        specs = fit_plugin.resources
+        strategy = {"LeastAllocated": 0, "MostAllocated": 1}[fit_plugin.scoring_strategy]
+    else:
+        specs = ({"name": res.CPU, "weight": 1}, {"name": res.MEMORY, "weight": 1})
+        strategy = 0
+    for spec in specs:
+        if spec["name"] not in (res.CPU, res.MEMORY, res.EPHEMERAL_STORAGE, res.PODS):
+            mirror.scalar_slot(spec["name"])
+    has_request = i64(0 if req.is_zero() else 1)
+    ba_skip = i64(1 if (req.milli_cpu == 0 and req.memory == 0) else 0)
+
+    # -- tolerations ------------------------------------------------------
+    tols = pod.tolerations
+    lt = _pow2(len(tols))
+    tol_key = np.zeros(lt, i32)
+    tol_val = np.zeros(lt, i32)
+    tol_eff = np.full(lt, -1, i32)  # pad: never tolerates
+    tol_op = np.zeros(lt, i32)
+    for j, t in enumerate(tols):
+        tol_key[j] = mirror.keys.intern(t.key)
+        tol_val[j] = mirror.vals.intern(t.value)
+        tol_eff[j] = EFFECT_IDS.get(t.effect, 0)
+        tol_op[j] = OP_EXISTS if t.operator == "Exists" else OP_EQUAL
+    tolerates_unsched = i32(
+        1 if any(t.tolerates(_UNSCHED_TAINT) for t in tols) else 0)
+
+    # -- cheap filters ----------------------------------------------------
+    node_name_id = i32(mirror.names.lookup(pod.node_name) if pod.node_name else 0)
+    if pod.node_name and node_name_id == -1:
+        # Requested node not in the snapshot: no node can match.
+        node_name_id = i32(-2)
+    sel_items = sorted(pod.node_selector.items())
+    q = _pow2(len(sel_items))
+    sel_pairs = np.zeros(q, i32)
+    for j, kv in enumerate(sel_items):
+        sel_pairs[j] = mirror.pairs.lookup(kv)  # -1 if no node has the pair
+
+    # Host-side per-node predicates reused by the topology aggregations below
+    # (identical to the plugin oracles' helpers).
+    sel_match_host = [pod.required_node_selector_matches(ni.node) for ni in nodes]
+    taint_ok_host = [
+        find_matching_untolerated_taint(ni.node.taints, tols) is None for ni in nodes
+    ]
+
+    # -- PodTopologySpread ------------------------------------------------
+    dns = _compile_constraints(pod, DO_NOT_SCHEDULE)
+    sa = _compile_constraints(pod, SCHEDULE_ANYWAY)
+    for c in dns + sa:
+        mirror.ensure_axis(c.topology_key)
+
+    # -- InterPodAffinity terms -------------------------------------------
+    pi = PodInfo.of(pod)
+    aff_terms = compile_terms(pi.required_affinity_terms, pod)
+    anti_terms = compile_terms(pi.required_anti_affinity_terms, pod)
+    pref_aff = [(w.weight, t) for w, t in
+                ((w, compile_terms((w.term,), pod)[0]) for w in pi.preferred_affinity_terms)]
+    pref_anti = [(w.weight, t) for w, t in
+                 ((w, compile_terms((w.term,), pod)[0]) for w in pi.preferred_anti_affinity_terms)]
+    for t in list(aff_terms) + list(anti_terms):
+        mirror.ensure_axis(t.topology_key)
+    for _, t in pref_aff + pref_anti:
+        mirror.ensure_axis(t.topology_key)
+    # Existing pods' terms introduce axes too; collect before building tables.
+    existing_term_cache: Dict[str, tuple] = {}
+
+    def existing_terms(epi: PodInfo, which: str):
+        ck = (epi.pod.uid, which)
+        terms = existing_term_cache.get(ck)
+        if terms is None:
+            raw = getattr(epi, which)
+            terms = compile_terms(raw, epi.pod)
+            existing_term_cache[ck] = terms
+        return terms
+
+    for ni in nodes:
+        for epi in ni.pods_with_affinity:
+            for which in ("required_anti_affinity_terms", "required_affinity_terms",
+                          "preferred_affinity_terms", "preferred_anti_affinity_terms"):
+                raw = getattr(epi, which)
+                for item in raw:
+                    key = item.term.topology_key if hasattr(item, "term") else item.topology_key
+                    mirror.ensure_axis(key)
+
+    if mirror._full_flush:
+        # New axes or capacity tiers were registered: rows must re-encode
+        # before any vid/slot gathers below.
+        mirror.sync(nodes)
+
+    npc = mirror.np_cap
+    request = _resource_vec(mirror, req)
+    nz_request = np.array(
+        [req.milli_cpu or NodeInfo.DEFAULT_MILLI_CPU,
+         req.memory or NodeInfo.DEFAULT_MEMORY], i64)
+
+    vmax = _pow2(max((len(ax.values) for ax in mirror.axes.values()), default=1) + 1, 64)
+
+    # ---- DNS tables ------------------------------------------------------
+    c1 = _pow2(len(dns))
+    dns_axis = np.zeros(c1, i32)
+    dns_active = np.zeros(c1, i32)            # pad rows: inert
+    dns_max_skew = np.full(c1, 1 << 40, i64)  # pad: never rejects
+    dns_self = np.zeros(c1, i32)
+    dns_forced0 = np.ones(c1, i32)            # pad: min 0
+    dns_honor_aff = np.zeros(c1, i32)
+    dns_honor_taints = np.zeros(c1, i32)
+    dns_counts = np.zeros((c1, vmax), i32)
+    dns_dom = np.zeros((c1, vmax), bool)
+    for ci, c in enumerate(dns):
+        ax = mirror.axes[c.topology_key]
+        dns_axis[ci] = ax.index
+        dns_active[ci] = 1
+        dns_max_skew[ci] = c.max_skew
+        dns_self[ci] = 1 if c.selector.matches(pod.labels) else 0
+        dns_honor_aff[ci] = 1 if c.node_affinity_policy == HONOR else 0
+        dns_honor_taints[ci] = 1 if c.node_taints_policy == HONOR else 0
+        vids = mirror.h_topo[ax.index]
+        n_domains = set()
+        for r_i, ni in enumerate(nodes):
+            node = ni.node
+            if c.topology_key not in node.labels:
+                continue
+            if dns_honor_aff[ci] and not sel_match_host[r_i]:
+                continue
+            if dns_honor_taints[ci] and not taint_ok_host[r_i]:
+                continue
+            vid = vids[r_i]
+            dns_dom[ci, vid] = True
+            n_domains.add(vid)
+            dns_counts[ci, vid] += _count_pods_matching(ni, c.selector, pod.namespace)
+        forced = c.min_domains is not None and len(n_domains) < c.min_domains
+        dns_forced0[ci] = 1 if (forced or not n_domains) else 0
+
+    # ---- SA tables -------------------------------------------------------
+    c2 = _pow2(len(sa))
+    sa_axis = np.zeros(c2, i32)
+    sa_wq = np.zeros(c2, i64)
+    sa_skew = np.ones(c2, i64)
+    sa_self = np.zeros(c2, i32)
+    sa_counts = np.zeros((c2, vmax), i32)
+    if sa:
+        # scoring.go initPreScoreState: a node is ignored when it misses any
+        # constraint's topology key or fails the pod's required node affinity.
+        sa_ignored = [
+            (not all(c.topology_key in ni.node.labels for c in sa)) or not sel_match_host[r_i]
+            for r_i, ni in enumerate(nodes)
+        ]
+        for ci, c in enumerate(sa):
+            ax = mirror.axes[c.topology_key]
+            sa_axis[ci] = ax.index
+            sa_skew[ci] = c.max_skew
+            sa_self[ci] = 1 if c.selector.matches(pod.labels) else 0
+            vids = mirror.h_topo[ax.index]
+            domains = set()
+            size_hostname = 0
+            for r_i, ni in enumerate(nodes):
+                if sa_ignored[r_i]:
+                    continue
+                vid = vids[r_i]
+                cnt = _count_pods_matching(ni, c.selector, pod.namespace)
+                sa_counts[ci, vid] += cnt
+                domains.add(vid)
+                size_hostname += 1
+            if c.topology_key == LABEL_HOSTNAME:
+                size = size_hostname
+            else:
+                size = len(domains)
+            sa_wq[ci] = int(round(math.log(size + 2) * 1024))
+
+    # ---- IPA required tables --------------------------------------------
+    a1 = _pow2(len(anti_terms))
+    anti_axis = np.zeros(a1, i32)
+    anti_self = np.zeros(a1, i32)
+    anti_counts = np.zeros((a1, vmax), i32)
+    a2 = _pow2(len(aff_terms))
+    aff_axis = np.zeros(a2, i32)
+    aff_self = np.zeros(a2, i32)
+    aff_active = np.zeros(a2, i32)
+    aff_counts = np.zeros((a2, vmax), i32)
+    exist_anti = np.zeros(npc, i32)
+    for ti, t in enumerate(anti_terms):
+        anti_axis[ti] = mirror.axes[t.topology_key].index
+        anti_self[ti] = 1 if t.matches(pod, ns_labels_fn) else 0
+    for ti, t in enumerate(aff_terms):
+        aff_axis[ti] = mirror.axes[t.topology_key].index
+        aff_self[ti] = 1 if t.matches(pod, ns_labels_fn) else 0
+        aff_active[ti] = 1
+    aff_own_all = i32(1 if aff_terms and all(
+        t.matches(pod, ns_labels_fn) for t in aff_terms) else 0)
+
+    # Existing pods' required anti-affinity vs the incoming pod
+    # (filtering.go:217-241) — accumulated per (axis, value) then broadcast to
+    # a per-row hit count.
+    exist_pairs: Dict[Tuple[int, int], int] = {}
+    for r_i, ni in enumerate(nodes):
+        if not ni.pods_with_required_anti_affinity:
+            continue
+        node = ni.node
+        for epi in ni.pods_with_required_anti_affinity:
+            for term in existing_terms(epi, "required_anti_affinity_terms"):
+                tp_val = node.labels.get(term.topology_key)
+                if tp_val is None:
+                    continue
+                if term.matches(pod, ns_labels_fn):
+                    ax = mirror.axes[term.topology_key]
+                    key = (ax.index, ax.lookup_value(tp_val))
+                    exist_pairs[key] = exist_pairs.get(key, 0) + 1
+    for (ax_i, vid), cnt in exist_pairs.items():
+        if cnt > 0 and vid >= 0:
+            exist_anti[:n] += (mirror.h_topo[ax_i, :n] == vid).astype(i32)
+
+    # Incoming pod's required terms vs all existing pods (filtering.go:247-284).
+    if aff_terms or anti_terms:
+        for r_i, ni in enumerate(nodes):
+            if not ni.pods:
+                continue
+            for epi in ni.pods:
+                ep = epi.pod
+                for ti, term in enumerate(aff_terms):
+                    vid = mirror.h_topo[mirror.axes[term.topology_key].index, r_i]
+                    if vid > 0 and term.matches(ep, ns_labels_fn):
+                        aff_counts[ti, vid] += 1
+                for ti, term in enumerate(anti_terms):
+                    vid = mirror.h_topo[mirror.axes[term.topology_key].index, r_i]
+                    if vid > 0 and term.matches(ep, ns_labels_fn):
+                        anti_counts[ti, vid] += 1
+
+    # ---- IPA scoring -----------------------------------------------------
+    # Base per-node preferred-term score (scoring.go PreScore accumulation),
+    # plus per-axis landing deltas for batch-internal contributions.
+    topology_score: Dict[str, Dict[str, int]] = {}
+
+    def _add_score(tp_key: str, tp_val: str, w: int) -> None:
+        if w == 0:
+            return
+        topology_score.setdefault(tp_key, {})
+        topology_score[tp_key][tp_val] = topology_score[tp_key].get(tp_val, 0) + w
+
+    has_pref = bool(pref_aff or pref_anti)
+    scan_nodes = nodes if has_pref else snapshot.have_pods_with_affinity_list
+    for ni in scan_nodes:
+        node = ni.node
+        if node is None:
+            continue
+        pods_iter = ni.pods if has_pref else ni.pods_with_affinity
+        for epi in pods_iter:
+            ep = epi.pod
+            for weight, term in pref_aff:
+                tp_val = node.labels.get(term.topology_key)
+                if tp_val is not None and term.matches(ep, ns_labels_fn):
+                    _add_score(term.topology_key, tp_val, weight)
+            for weight, term in pref_anti:
+                tp_val = node.labels.get(term.topology_key)
+                if tp_val is not None and term.matches(ep, ns_labels_fn):
+                    _add_score(term.topology_key, tp_val, -weight)
+            if hard_pod_affinity_weight > 0:
+                for term in existing_terms(epi, "required_affinity_terms"):
+                    tp_val = node.labels.get(term.topology_key)
+                    if tp_val is not None and term.matches(pod, ns_labels_fn):
+                        _add_score(term.topology_key, tp_val, hard_pod_affinity_weight)
+            if not ignore_preferred_terms_of_existing_pods:
+                for wt in epi.preferred_affinity_terms:
+                    term = compile_terms((wt.term,), ep)[0]
+                    tp_val = node.labels.get(term.topology_key)
+                    if tp_val is not None and term.matches(pod, ns_labels_fn):
+                        _add_score(term.topology_key, tp_val, wt.weight)
+                for wt in epi.preferred_anti_affinity_terms:
+                    term = compile_terms((wt.term,), ep)[0]
+                    tp_val = node.labels.get(term.topology_key)
+                    if tp_val is not None and term.matches(pod, ns_labels_fn):
+                        _add_score(term.topology_key, tp_val, -wt.weight)
+
+    ipa_base = np.zeros(npc, i64)
+    for tp_key, vals in topology_score.items():
+        ax = mirror.axes.get(tp_key)
+        if ax is None:
+            continue  # key only on deleted nodes; no live node can match
+        col = np.zeros(vmax, i64)
+        for v, w in vals.items():
+            vid = ax.lookup_value(v)
+            if vid >= 0:
+                col[vid] = w
+        ipa_base[:n] += col[np.clip(mirror.h_topo[ax.index, :n], 0, vmax - 1)]
+        ipa_base[:n][mirror.h_topo[ax.index, :n] == 0] -= col[0]  # absent key adds nothing
+
+    # Landing deltas: contributions a landed batch pod makes to the *next*
+    # batch pod's topology_score, aggregated per axis. Both directions of each
+    # preferred term apply for identical pods (pre_score's a/c loops).
+    land: Dict[int, int] = {}
+    mult = 1 if ignore_preferred_terms_of_existing_pods else 2
+    for weight, term in pref_aff:
+        if term.matches(pod, ns_labels_fn):
+            ax_i = mirror.axes[term.topology_key].index
+            land[ax_i] = land.get(ax_i, 0) + weight * mult
+    for weight, term in pref_anti:
+        if term.matches(pod, ns_labels_fn):
+            ax_i = mirror.axes[term.topology_key].index
+            land[ax_i] = land.get(ax_i, 0) - weight * mult
+    if hard_pod_affinity_weight > 0:
+        for term in aff_terms:
+            if term.matches(pod, ns_labels_fn):
+                ax_i = mirror.axes[term.topology_key].index
+                land[ax_i] = land.get(ax_i, 0) + hard_pod_affinity_weight
+    kd = _pow2(len(land))
+    ipa_axis = np.zeros(kd, i32)
+    ipa_wland = np.zeros(kd, i64)
+    for j, (ax_i, w) in enumerate(sorted(land.items())):
+        ipa_axis[j] = ax_i
+        ipa_wland[j] = w
+
+    # ---- Fit scoring config (slots pre-interned above) ------------------
+    fr = _pow2(len(specs))
+    fit_slots = np.zeros(fr, i32)
+    fit_weights = np.zeros(fr, i64)  # pad weight 0: excluded
+    slot_of = {res.CPU: 0, res.MEMORY: 1, res.EPHEMERAL_STORAGE: 2}
+    for j, spec in enumerate(specs):
+        name = spec["name"]
+        fit_slots[j] = slot_of.get(name, mirror.scalar_slot(name) if name not in slot_of else 0)
+        fit_weights[j] = spec.get("weight", 1)
+
+    to_find = num_feasible_nodes_to_find(n, percentage_of_nodes_to_score)
+
+    feats = BatchFeatures(
+        request=jnp.asarray(request),
+        nz_request=jnp.asarray(nz_request),
+        has_request=jnp.asarray(has_request),
+        ba_skip=jnp.asarray(ba_skip),
+        tol_key=jnp.asarray(tol_key), tol_val=jnp.asarray(tol_val),
+        tol_eff=jnp.asarray(tol_eff), tol_op=jnp.asarray(tol_op),
+        node_name_id=jnp.asarray(node_name_id),
+        tolerates_unsched=jnp.asarray(tolerates_unsched),
+        sel_pairs=jnp.asarray(sel_pairs),
+        dns_axis=jnp.asarray(dns_axis), dns_active=jnp.asarray(dns_active),
+        dns_max_skew=jnp.asarray(dns_max_skew),
+        dns_self=jnp.asarray(dns_self), dns_forced0=jnp.asarray(dns_forced0),
+        dns_honor_aff=jnp.asarray(dns_honor_aff),
+        dns_honor_taints=jnp.asarray(dns_honor_taints),
+        dns_counts=jnp.asarray(dns_counts), dns_dom=jnp.asarray(dns_dom),
+        sa_axis=jnp.asarray(sa_axis), sa_wq=jnp.asarray(sa_wq),
+        sa_skew=jnp.asarray(sa_skew), sa_self=jnp.asarray(sa_self),
+        sa_counts=jnp.asarray(sa_counts),
+        anti_axis=jnp.asarray(anti_axis), anti_self=jnp.asarray(anti_self),
+        anti_counts=jnp.asarray(anti_counts),
+        exist_anti=jnp.asarray(exist_anti),
+        aff_axis=jnp.asarray(aff_axis), aff_self=jnp.asarray(aff_self),
+        aff_active=jnp.asarray(aff_active), aff_counts=jnp.asarray(aff_counts),
+        aff_own_all=jnp.asarray(aff_own_all),
+        ipa_base=jnp.asarray(ipa_base),
+        ipa_axis=jnp.asarray(ipa_axis), ipa_wland=jnp.asarray(ipa_wland),
+        fit_slots=jnp.asarray(fit_slots), fit_weights=jnp.asarray(fit_weights),
+        weights=jnp.asarray(np.array(weights, i64)),
+        enable=jnp.asarray(np.array([1 if b else 0 for b in filters_on], i32)),
+        num_nodes=jnp.asarray(np.int32(n)),
+        start_index=jnp.asarray(np.int32(start_index % max(1, n))),
+        to_find=jnp.asarray(np.int32(to_find)),
+    )
+    return BatchPlan(
+        features=feats,
+        batch_pad=_pow2(batch_size),
+        fit_strategy=strategy,
+        vmax=vmax,
+    )

@@ -1,0 +1,315 @@
+"""The batch scheduling kernel: the whole Filter→Score hot path
+(schedule_one.go findNodesThatFitPod :630 / prioritizeNodes :945) as ONE
+jit-compiled dense pods×nodes evaluation, with the greedy sequential
+assignment loop running on device as a lax.scan.
+
+Replaces the reference's per-node goroutine fan-out
+(parallelize/parallelism.go:28 Parallelizer, 16 goroutines, √n chunks) with
+vectorized masks over the node axis, and the reference's per-pod scheduling
+cycles with a scan whose carry holds exactly the state one pod's placement
+changes for the next pod: per-node requested vectors, per-domain topology
+match counts, and inter-pod-affinity count tables.
+
+Semantics parity (bit-exact vs the host oracle, enforced by
+tests/test_device_equivalence.py):
+- feasibility: NodeName, NodeUnschedulable, TaintToleration,
+  node_selector, NodeResourcesFit (fit.go:710 fitsRequest),
+  PodTopologySpread DoNotSchedule skew test (filtering.go:358),
+  InterPodAffinity required terms incl. the bootstrap case
+  (filtering.go:368-426);
+- adaptive sampling + rotation: numFeasibleNodesToFind truncation and
+  nextStartNodeIndex advance (schedule_one.go:779-892) are emulated with a
+  rotation-order cumulative count, so the device picks the IDENTICAL node the
+  sequential host loop would;
+- scoring: TaintToleration (×3), NodeResourcesFit LeastAllocated/MostAllocated
+  (×1), BalancedAllocation integer-quantized (×1), PodTopologySpread
+  ScheduleAnyway (×2), InterPodAffinity (×2), each normalized over the kept
+  (sampled feasible) set exactly as runtime/framework.go:1526-1582 does;
+- selection: max total score, ties broken by first position in rotation order
+  (the host's deterministic-tie mode; the reference randomizes ties,
+  schedule_one.go selectHost).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .codebook import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    EFFECT_PREFER_NO_SCHEDULE,
+    OP_EXISTS,
+)
+from .device_state import DeviceNodeState
+from .features import BatchFeatures
+
+MAX_NODE_SCORE = 100
+_BIG = jnp.int32(1 << 30)
+_INF64 = jnp.int64(1 << 60)
+
+
+def _tolerates(f: BatchFeatures, taint_key, taint_val, taint_eff):
+    """tolerated[n, t] — any toleration row matches the taint
+    (component-helpers ToleratesTaint, api/types.py Toleration.tolerates)."""
+    tk = f.tol_key[None, None, :]
+    tv = f.tol_val[None, None, :]
+    te = f.tol_eff[None, None, :]
+    to = f.tol_op[None, None, :]
+    k = taint_key[:, :, None]
+    v = taint_val[:, :, None]
+    e = taint_eff[:, :, None]
+    eff_ok = (te == 0) | (te == e)
+    key_ok = (tk == 0) | (tk == k)
+    val_ok = (to == OP_EXISTS) | (tv == v)
+    return eff_ok & key_ok & val_ok  # [N, T, L]
+
+
+def _static_masks(state: DeviceNodeState, f: BatchFeatures):
+    """Per-batch node predicates that no assignment can change."""
+    # taints
+    m = _tolerates(f, state.taint_key, state.taint_val, state.taint_eff)
+    tolerated = m.any(axis=2) if f.tol_key.shape[0] else jnp.zeros(state.taint_key.shape, bool)
+    sched_relevant = (state.taint_eff == EFFECT_NO_SCHEDULE) | (
+        state.taint_eff == EFFECT_NO_EXECUTE)
+    taint_ok = ~(sched_relevant & ~tolerated).any(axis=1)  # [N]
+    # PreferNoSchedule score counts (taint_toleration.go:182-194)
+    pns_tol_ok = (f.tol_eff == 0) | (f.tol_eff == EFFECT_PREFER_NO_SCHEDULE)
+    if f.tol_key.shape[0]:
+        pns_tolerated = (m & pns_tol_ok[None, None, :]).any(axis=2)
+    else:
+        pns_tolerated = jnp.zeros(state.taint_key.shape, bool)
+    pns_cnt = ((state.taint_eff == EFFECT_PREFER_NO_SCHEDULE) & ~pns_tolerated).sum(
+        axis=1).astype(jnp.int64)  # [N]
+    # node_selector equality pairs
+    if f.sel_pairs.shape[0]:
+        hit = (state.pairs[:, :, None] == f.sel_pairs[None, None, :]).any(axis=1)
+        sel_ok = ((f.sel_pairs[None, :] == 0) | hit).all(axis=1)
+    else:
+        sel_ok = jnp.ones(state.valid.shape, bool)
+    # cheap gates
+    name_ok = (f.node_name_id == 0) | (state.name_id == f.node_name_id)
+    unsched_ok = ~state.unsched | (f.tolerates_unsched == 1)
+    exist_anti_ok = f.exist_anti == 0
+    # Profile filter enablement (a disabled filter plugin never rejects).
+    name_ok |= f.enable[0] == 0
+    unsched_ok |= f.enable[1] == 0
+    taint_ok |= f.enable[2] == 0
+    sel_ok |= f.enable[3] == 0
+    return taint_ok, pns_cnt, sel_ok, name_ok, unsched_ok, exist_anti_ok
+
+
+def _normalize_default_reverse(raw, kept):
+    """default_normalize_score(max=100, reverse=True) over the kept set."""
+    mx = jnp.max(jnp.where(kept, raw, 0))
+    return jnp.where(mx > 0, MAX_NODE_SCORE - MAX_NODE_SCORE * raw // mx,
+                     jnp.int64(MAX_NODE_SCORE))
+
+
+@partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax"))
+def schedule_batch(
+    state: DeviceNodeState,
+    f: BatchFeatures,
+    batch_pad: int,
+    fit_strategy: int,
+    vmax: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy-assign `batch_pad` identical pods. Returns (chosen[B] row index
+    or -1, start_index_after[B]). Callers slice [:actual_batch_size]."""
+    NP = state.valid.shape[0]
+    C1 = f.dns_axis.shape[0]
+    C2 = f.sa_axis.shape[0]
+    A1 = f.anti_axis.shape[0]
+    A2 = f.aff_axis.shape[0]
+    KD = f.ipa_axis.shape[0]
+    idx = jnp.arange(NP, dtype=jnp.int32)
+    num = jnp.maximum(f.num_nodes, 1)
+
+    taint_ok, pns_cnt, sel_ok, name_ok, unsched_ok, exist_anti_ok = _static_masks(state, f)
+
+    # Static topology vid gathers [C, NP].
+    dns_vid = state.topo[f.dns_axis] if C1 else jnp.zeros((0, NP), jnp.int32)
+    sa_vid = state.topo[f.sa_axis] if C2 else jnp.zeros((0, NP), jnp.int32)
+    anti_vid = state.topo[f.anti_axis] if A1 else jnp.zeros((0, NP), jnp.int32)
+    aff_vid = state.topo[f.aff_axis] if A2 else jnp.zeros((0, NP), jnp.int32)
+    ipa_vid = state.topo[f.ipa_axis] if KD else jnp.zeros((0, NP), jnp.int32)
+
+    # DNS eligibility for count updates (node_eligible, filtering.go AddPod).
+    if C1:
+        dns_elig = (dns_vid > 0)
+        dns_elig &= jnp.where(f.dns_honor_aff[:, None] == 1, sel_ok[None, :], True)
+        dns_elig &= jnp.where(f.dns_honor_taints[:, None] == 1, taint_ok[None, :], True)
+    else:
+        dns_elig = jnp.zeros((0, NP), bool)
+    # SA ignored nodes (scoring.go initPreScoreState).
+    if C2:
+        sa_ignored = ~(sa_vid > 0).all(axis=0) | ~sel_ok
+    else:
+        sa_ignored = jnp.zeros(NP, bool)
+
+    static_ok = (state.valid & name_ok & unsched_ok & taint_ok & sel_ok & exist_anti_ok)
+
+    w_tt, w_fit, w_pts, w_ipa, w_ba = (f.weights[i] for i in range(5))
+
+    def step(carry, _):
+        (req_r, nonzero, pod_count, dns_counts, sa_counts,
+         anti_counts, aff_counts, ipa_delta, start) = carry
+
+        # ---- Fit filter (fit.go:710) --------------------------------------
+        pods_ok = (pod_count + 1).astype(jnp.int64) <= state.alloc_pods
+        viol = ((f.request[None, :] > 0) &
+                (f.request[None, :] > state.alloc_r - req_r)).any(axis=1)
+        fit_ok = (pods_ok & (~viol | (f.has_request == 0))) | (f.enable[4] == 0)
+
+        # ---- PTS DoNotSchedule filter (filtering.go:318-362) --------------
+        if C1:
+            cnt64 = dns_counts.astype(jnp.int64)
+            min_match = jnp.where(
+                f.dns_dom, cnt64, _INF64).min(axis=1)          # [C1]
+            min_match = jnp.where(f.dns_forced0 == 1, 0, min_match)
+            match_num = jnp.take_along_axis(cnt64, dns_vid.astype(jnp.int64), axis=1)  # [C1, NP]
+            skew_bad = (match_num + f.dns_self[:, None].astype(jnp.int64)
+                        - min_match[:, None]) > f.dns_max_skew[:, None]
+            dns_reject = (f.dns_active[:, None] == 1) & (~(dns_vid > 0) | skew_bad)
+            dns_ok = ~dns_reject.any(axis=0)
+        else:
+            dns_ok = jnp.ones(NP, bool)
+
+        # ---- IPA required filter (filtering.go:368-426) -------------------
+        if A1:
+            a_cnt = jnp.take_along_axis(anti_counts, anti_vid, axis=1)  # [A1, NP]
+            anti_ok = ~((anti_vid > 0) & (a_cnt > 0)).any(axis=0)
+        else:
+            anti_ok = jnp.ones(NP, bool)
+        if A2:
+            f_cnt = jnp.take_along_axis(aff_counts, aff_vid, axis=1)    # [A2, NP]
+            term_ok = (f.aff_active[:, None] == 0) | ((aff_vid > 0) & (f_cnt > 0))
+            all_matched = term_ok.all(axis=0)
+            total = (aff_counts * (f.aff_active[:, None] == 1)).sum()
+            bootstrap = (total == 0) & (f.aff_own_all == 1)
+            aff_ok = all_matched | bootstrap
+        else:
+            aff_ok = jnp.ones(NP, bool)
+
+        ok = static_ok & fit_ok & dns_ok & anti_ok & aff_ok
+
+        # ---- sampling truncation + rotation (schedule_one.go:779-892) -----
+        rot_rows = (start + idx) % num                     # rotation order -> row
+        feas_rot = jnp.where(idx < num, ok[rot_rows], False)
+        cum = jnp.cumsum(feas_rot.astype(jnp.int32))
+        kept_rot = feas_rot & (cum <= f.to_find)
+        stop_pos = jnp.min(jnp.where(feas_rot & (cum == f.to_find), idx, _BIG))
+        evaluated = jnp.where(stop_pos < _BIG, stop_pos + 1, num)
+        rot_of_row = (idx - start) % num                   # row -> rotation pos
+        kept = jnp.where(idx < num, kept_rot[rot_of_row], False) & ok
+
+        # ---- scores over the kept set ------------------------------------
+        # TaintToleration ×w_tt (reverse-normalized)
+        tt = _normalize_default_reverse(pns_cnt, kept)
+        # NodeResourcesFit ×w_fit
+        used0 = nonzero[:, 0] + f.nz_request[0]
+        used1 = nonzero[:, 1] + f.nz_request[1]
+        # Per-node weight_sum excludes resources with alloc==0, as the host
+        # oracle's `if alloc == 0: continue` does (noderesources.py Fit.score).
+        fit_num = jnp.zeros(NP, jnp.int64)
+        fit_den = jnp.zeros(NP, jnp.int64)
+        for j in range(f.fit_slots.shape[0]):
+            slot = f.fit_slots[j]
+            w = f.fit_weights[j]
+            alloc = state.alloc_r[:, slot]
+            used = jnp.where(slot == 0, used0,
+                             jnp.where(slot == 1, used1,
+                                       req_r[:, slot] + f.request[slot]))
+            if fit_strategy == 0:  # LeastAllocated
+                rscore = jnp.where((alloc > 0) & (used <= alloc),
+                                   (alloc - used) * MAX_NODE_SCORE // jnp.maximum(alloc, 1), 0)
+            else:  # MostAllocated
+                rscore = jnp.where(alloc > 0,
+                                   jnp.minimum(used, alloc) * MAX_NODE_SCORE // jnp.maximum(alloc, 1), 0)
+            fit_num = fit_num + jnp.where(alloc > 0, rscore * w, 0)
+            fit_den = fit_den + jnp.where(alloc > 0, w, 0)
+        fit_sc = jnp.where(fit_den > 0, fit_num // jnp.maximum(fit_den, 1), 0)
+        # BalancedAllocation ×w_ba (integer-quantized two-resource path)
+        SCALE = jnp.int64(1_000_000)
+        a_cpu = state.alloc_r[:, 0]
+        a_mem = state.alloc_r[:, 1]
+        q_cpu = jnp.minimum(used0 * SCALE // jnp.maximum(a_cpu, 1), SCALE)
+        q_mem = jnp.minimum(used1 * SCALE // jnp.maximum(a_mem, 1), SCALE)
+        both = (a_cpu > 0) & (a_mem > 0)
+        ba_val = jnp.where(
+            both,
+            (MAX_NODE_SCORE * SCALE - 50 * jnp.abs(q_cpu - q_mem)) // SCALE,
+            jnp.int64(MAX_NODE_SCORE))
+        ba = jnp.where(f.ba_skip == 1, 0, ba_val)
+        # PodTopologySpread ScheduleAnyway ×w_pts (scoring.go)
+        if C2:
+            s_cnt = jnp.take_along_axis(sa_counts.astype(jnp.int64), sa_vid.astype(jnp.int64), axis=1)
+            raw_sa = (s_cnt * f.sa_wq[:, None] +
+                      (f.sa_skew[:, None] - 1) * 1024).sum(axis=0)
+            live = kept & ~sa_ignored
+            mn = jnp.min(jnp.where(live, raw_sa, _INF64))
+            mx = jnp.max(jnp.where(live, raw_sa, 0))
+            norm = jnp.where(mx > 0,
+                             MAX_NODE_SCORE * (mx + jnp.minimum(mn, mx) - raw_sa) // jnp.maximum(mx, 1),
+                             jnp.int64(MAX_NODE_SCORE))
+            pts = jnp.where(sa_ignored, 0, norm)
+        else:
+            pts = jnp.zeros(NP, jnp.int64)
+        # InterPodAffinity ×w_ipa (scoring.go:258-289)
+        raw_ipa = f.ipa_base
+        if KD:
+            d = jnp.take_along_axis(ipa_delta, ipa_vid.astype(jnp.int64), axis=1)
+            raw_ipa = raw_ipa + (d * jnp.where(ipa_vid > 0, 1, 0)).sum(axis=0)
+        mn_i = jnp.min(jnp.where(kept, raw_ipa, _INF64))
+        mx_i = jnp.max(jnp.where(kept, raw_ipa, -_INF64))
+        diff = mx_i - mn_i
+        ipa = jnp.where(diff > 0,
+                        MAX_NODE_SCORE * (raw_ipa - mn_i) // jnp.maximum(diff, 1), 0)
+
+        total = (w_tt * tt + w_fit * fit_sc + w_ba * ba + w_pts * pts + w_ipa * ipa)
+
+        # ---- select (schedule_one.go selectHost, deterministic ties) ------
+        any_kept = kept.any()
+        best = jnp.max(jnp.where(kept, total, -_INF64))
+        cand_rot = jnp.where(kept & (total == best), rot_of_row, _BIG)
+        chosen_rot = jnp.min(cand_rot)
+        chosen = jnp.where(any_kept, (start + chosen_rot) % num, -1).astype(jnp.int32)
+
+        # ---- carry updates ------------------------------------------------
+        row = jnp.maximum(chosen, 0)
+        apply = jnp.where(any_kept, 1, 0).astype(jnp.int64)
+        req_r = req_r.at[row].add(f.request * apply)
+        nonzero = nonzero.at[row].add(f.nz_request * apply)
+        pod_count = pod_count.at[row].add(apply.astype(jnp.int32))
+        if C1:
+            upd = (f.dns_self * dns_elig[jnp.arange(C1), row].astype(jnp.int32)
+                   * apply.astype(jnp.int32))
+            dns_counts = dns_counts.at[jnp.arange(C1), dns_vid[:, row]].add(upd)
+        if C2:
+            upd = (f.sa_self * jnp.where(sa_ignored[row], 0, 1) * apply.astype(jnp.int32))
+            sa_counts = sa_counts.at[jnp.arange(C2), sa_vid[:, row]].add(upd)
+        if A1:
+            upd = f.anti_self * (anti_vid[:, row] > 0).astype(jnp.int32) * apply.astype(jnp.int32)
+            anti_counts = anti_counts.at[jnp.arange(A1), anti_vid[:, row]].add(upd)
+        if A2:
+            upd = f.aff_self * (aff_vid[:, row] > 0).astype(jnp.int32) * apply.astype(jnp.int32)
+            aff_counts = aff_counts.at[jnp.arange(A2), aff_vid[:, row]].add(upd)
+        if KD:
+            upd = f.ipa_wland * (ipa_vid[:, row] > 0) * apply
+            ipa_delta = ipa_delta.at[jnp.arange(KD), ipa_vid[:, row]].add(upd)
+        start = ((start + evaluated) % num).astype(jnp.int32)
+
+        new_carry = (req_r, nonzero, pod_count, dns_counts, sa_counts,
+                     anti_counts, aff_counts, ipa_delta, start)
+        return new_carry, (chosen, start)
+
+    ipa_delta0 = jnp.zeros((KD, vmax), jnp.int64)
+    carry0 = (state.req_r, state.nonzero, state.pod_count,
+              f.dns_counts, f.sa_counts, f.anti_counts, f.aff_counts,
+              ipa_delta0, f.start_index)
+    _, (chosen, starts) = lax.scan(step, carry0, None, length=batch_pad)
+    return chosen, starts

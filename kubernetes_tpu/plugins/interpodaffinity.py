@@ -289,7 +289,9 @@ class InterPodAffinity:
         diff = max_count - min_count
         for s in scores:
             if diff > 0:
-                s.score = int(MAX_NODE_SCORE * (s.score - min_count) / diff)
+                # floor division: identical to the reference's float-then-trunc
+                # for the non-negative numerator, and exact on device int64.
+                s.score = MAX_NODE_SCORE * (s.score - min_count) // diff
             else:
                 s.score = 0
 

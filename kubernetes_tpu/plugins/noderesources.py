@@ -231,11 +231,17 @@ class BalancedAllocation:
         state.write(self._KEY, req)
         return OK
 
+    # Utilization fractions are quantized to millionths (integer math) so the
+    # host oracle and the device kernel (int64 tensors, ops/kernel.py) agree
+    # bit-for-bit; the reference's float64 std (balanced_allocation.go:204-253)
+    # differs from this by < 1e-4 score units.
+    FRACTION_SCALE = 1_000_000
+
     def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
         req = state.read(self._KEY)
         if req is None:
             req = pod.resource_request()
-        fractions: List[float] = []
+        qs: List[int] = []
         for spec in self.resources:
             name = spec["name"]
             alloc = node_info.allocatable.get(name)
@@ -247,14 +253,15 @@ class BalancedAllocation:
                 used = node_info.non_zero_requested.memory + (req.memory or NodeInfo.DEFAULT_MEMORY)
             else:
                 used = node_info.requested.get(name) + req.get(name)
-            fractions.append(min(used / alloc, 1.0))
-        if len(fractions) < 2:
-            std = 0.0
-        elif len(fractions) == 2:
-            std = abs(fractions[0] - fractions[1]) / 2
-        else:
-            mean = sum(fractions) / len(fractions)
-            std = math.sqrt(sum((f - mean) ** 2 for f in fractions) / len(fractions))
+            qs.append(min(used * self.FRACTION_SCALE // alloc, self.FRACTION_SCALE))
+        if len(qs) < 2:
+            return MAX_NODE_SCORE, OK
+        if len(qs) == 2:
+            # floor(100 - 50*|f1-f2|) in exact integer arithmetic.
+            return (MAX_NODE_SCORE * self.FRACTION_SCALE - 50 * abs(qs[0] - qs[1])) // self.FRACTION_SCALE, OK
+        fractions = [q / self.FRACTION_SCALE for q in qs]
+        mean = sum(fractions) / len(fractions)
+        std = math.sqrt(sum((f - mean) ** 2 for f in fractions) / len(fractions))
         return int((1 - std) * MAX_NODE_SCORE), OK
 
     def sign(self, pod: Pod):

@@ -271,13 +271,16 @@ class PodTopologySpread:
                 tp_val = node.labels[c.topology_key]
                 cnt = _count_pods_matching(ni, c.selector, pod.namespace)
                 tp_counts[i][tp_val] = tp_counts[i].get(tp_val, 0) + cnt
+        # Domain weights are quantized to 1/1024ths (w_q = round(log(size+2)
+        # * 1024)) so scores are exact integers on both the host oracle and the
+        # device kernel; the reference keeps float64 (scoring.go scoreForCount).
         weights = []
         for i, c in enumerate(constraints):
             if c.topology_key == LABEL_HOSTNAME:
                 size = sum(1 for ni in all_nodes if ni.node is not None and ni.node.name not in ignored_nodes)
             else:
                 size = len(tp_counts[i])
-            weights.append(math.log(size + 2))
+            weights.append(int(round(math.log(size + 2) * 1024)))
         state.write(self._SKEY, (constraints, tp_counts, weights, ignored_nodes))
         return OK
 
@@ -289,7 +292,7 @@ class PodTopologySpread:
         node = node_info.node
         if node.name in ignored:
             return 0, OK
-        score = 0.0
+        score = 0
         for i, c in enumerate(constraints):
             tp_val = node.labels.get(c.topology_key)
             if tp_val is None:
@@ -298,8 +301,8 @@ class PodTopologySpread:
                 cnt = _count_pods_matching(node_info, c.selector, pod.namespace)
             else:
                 cnt = tp_counts[i].get(tp_val, 0)
-            score += cnt * weights[i] + (c.max_skew - 1)
-        return int(round(score)), OK
+            score += cnt * weights[i] + (c.max_skew - 1) * 1024
+        return score, OK
 
     def normalize_score(self, state: CycleState, pod: Pod, scores: List[NodeScore]) -> None:
         data = state.read(self._SKEY)

@@ -1,0 +1,202 @@
+"""Device↔host equivalence: the TPU batch kernel must produce IDENTICAL
+pod→node assignments to the host-oracle sequential scheduler on randomized
+cluster states (SURVEY.md §4 'device/host equivalence suite'; the
+"identical pod→node assignments" requirement in BASELINE.json).
+
+Both paths run with deterministic_ties so reservoir tie-breaking can't
+diverge; everything else — adaptive sampling, rotation, integer score math —
+must line up exactly.
+"""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.core.scheduler import Scheduler
+from kubernetes_tpu.models.tpu_scheduler import TPUScheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+HOSTNAME = "kubernetes.io/hostname"
+
+
+def _mk_cluster(sched, n_nodes, seed=0, zones=4, taint_frac=0.0, unsched_frac=0.0):
+    rng = random.Random(seed)
+    for i in range(n_nodes):
+        b = (make_node().name(f"node-{i}")
+             .capacity({"cpu": rng.choice([2, 4, 8, 16]),
+                        "memory": f"{rng.choice([4, 8, 16, 32])}Gi",
+                        "pods": 110})
+             .zone(f"zone-{i % zones}")
+             .label("disk", rng.choice(["ssd", "hdd"])))
+        if taint_frac and rng.random() < taint_frac:
+            b = b.taint("dedicated", "infra", "NoSchedule")
+        if unsched_frac and rng.random() < unsched_frac:
+            b = b.unschedulable()
+        sched.clientset.create_node(b.obj())
+
+
+def _assignments(sched):
+    return {p.name: p.node_name for p in sched.clientset.pods.values()}
+
+
+def _run_pair(n_nodes, pods_fn, seed=0, **cluster_kw):
+    host = Scheduler(deterministic_ties=True)
+    dev = TPUScheduler()
+    _mk_cluster(host, n_nodes, seed=seed, **cluster_kw)
+    _mk_cluster(dev, n_nodes, seed=seed, **cluster_kw)
+    for p in pods_fn():
+        host.clientset.create_pod(p)
+    for p in pods_fn():
+        dev.clientset.create_pod(p)
+    host.run_until_idle()
+    dev.run_until_idle()
+    a_host = _assignments(host)
+    a_dev = _assignments(dev)
+    diffs = {k: (a_host[k], a_dev.get(k)) for k in a_host if a_host[k] != a_dev.get(k)}
+    assert not diffs, f"host/device assignment divergence: {diffs}"
+    return host, dev
+
+
+def _basic_pods(n, cpu="500m", mem="256Mi", labels=None, build=None):
+    def fn():
+        pods = []
+        for i in range(n):
+            b = make_pod().name(f"pod-{i}").req({"cpu": cpu, "memory": mem})
+            if labels:
+                b = b.labels(dict(labels))
+            if build:
+                b = build(b)
+            pods.append(b.obj())
+        return pods
+    return fn
+
+
+class TestFitEquivalence:
+    def test_basic_fit_least_allocated(self):
+        host, dev = _run_pair(23, _basic_pods(40))
+        assert dev.device_scheduled == 40
+        assert dev.host_path_pods == 0
+
+    def test_fill_until_infeasible(self):
+        # More pods than capacity: both paths must fail the same pods.
+        host, dev = _run_pair(5, _basic_pods(30, cpu="2"))
+        assert host.scheduled == dev.scheduled
+        assert host.failures > 0
+
+    def test_sampling_truncation_rotation(self):
+        # >100 nodes triggers numFeasibleNodesToFind truncation + rotation.
+        _run_pair(140, _basic_pods(60))
+
+    def test_zero_request_pods(self):
+        _run_pair(9, _basic_pods(12, cpu="0", mem="0"))
+
+
+class TestTaintEquivalence:
+    def test_taints_reject(self):
+        _run_pair(16, _basic_pods(20), taint_frac=0.5)
+
+    def test_tolerated_taints(self):
+        _run_pair(16, _basic_pods(
+            20, build=lambda b: b.toleration("dedicated", "infra", "Equal", "NoSchedule")),
+            taint_frac=0.5)
+
+    def test_unschedulable_nodes(self):
+        _run_pair(16, _basic_pods(20), unsched_frac=0.3)
+
+
+class TestSelectorEquivalence:
+    def test_node_selector(self):
+        _run_pair(20, _basic_pods(15, build=lambda b: b.node_selector({"disk": "ssd"})))
+
+    def test_node_name_pin(self):
+        def fn():
+            return [make_pod().name(f"pin-{i}").req({"cpu": "100m"})
+                    .node(f"node-{i % 3}").obj() for i in range(6)]
+        _run_pair(8, fn)
+
+
+class TestSpreadEquivalence:
+    def test_do_not_schedule_spread(self):
+        _run_pair(12, _basic_pods(
+            24, labels={"app": "web"},
+            build=lambda b: b.spread_constraint(1, ZONE, "DoNotSchedule", {"app": "web"})))
+
+    def test_schedule_anyway_spread_scoring(self):
+        _run_pair(10, _basic_pods(
+            20, labels={"app": "api"},
+            build=lambda b: b.spread_constraint(1, ZONE, "ScheduleAnyway", {"app": "api"})))
+
+    def test_hostname_spread(self):
+        _run_pair(7, _basic_pods(
+            14, labels={"app": "db"},
+            build=lambda b: b.spread_constraint(2, HOSTNAME, "DoNotSchedule", {"app": "db"})))
+
+
+class TestAffinityEquivalence:
+    def test_required_anti_affinity(self):
+        _run_pair(10, _basic_pods(
+            8, labels={"app": "solo"},
+            build=lambda b: b.pod_affinity(HOSTNAME, {"app": "solo"}, anti=True)))
+
+    def test_required_affinity_bootstrap(self):
+        _run_pair(12, _basic_pods(
+            9, labels={"app": "pack"},
+            build=lambda b: b.pod_affinity(ZONE, {"app": "pack"})))
+
+    def test_preferred_anti_affinity_scoring(self):
+        _run_pair(8, _basic_pods(
+            16, labels={"app": "spread-me"},
+            build=lambda b: b.pod_affinity(ZONE, {"app": "spread-me"}, anti=True, weight=10)))
+
+
+class TestMixedWorkload:
+    def test_mixed_signatures(self):
+        """Multiple interleaved deployments → multiple batches per run."""
+        def fn():
+            pods = []
+            for i in range(10):
+                pods.append(make_pod().name(f"a-{i}").req({"cpu": "250m", "memory": "128Mi"})
+                            .labels({"app": "a"})
+                            .spread_constraint(1, ZONE, "DoNotSchedule", {"app": "a"}).obj())
+            for i in range(10):
+                pods.append(make_pod().name(f"b-{i}").req({"cpu": "1", "memory": "1Gi"})
+                            .labels({"app": "b"}).obj())
+            for i in range(5):
+                pods.append(make_pod().name(f"c-{i}").labels({"app": "c"}).obj())
+            return pods
+        host, dev = _run_pair(15, fn)
+        assert dev.device_batches >= 3
+
+
+class TestFuzzEquivalence:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_randomized_clusters(self, seed):
+        rng = random.Random(1000 + seed)
+        n_nodes = rng.randint(4, 60)
+
+        def fn():
+            rng2 = random.Random(2000 + seed)
+            pods = []
+            n_deploys = rng2.randint(1, 4)
+            for d in range(n_deploys):
+                n = rng2.randint(1, 12)
+                cpu = rng2.choice(["100m", "250m", "1", "2"])
+                mem = rng2.choice(["64Mi", "512Mi", "2Gi"])
+                labels = {"app": f"d{d}"}
+                r = rng2.random()
+                for i in range(n):
+                    b = (make_pod().name(f"d{d}-{i}")
+                         .req({"cpu": cpu, "memory": mem}).labels(dict(labels)))
+                    if r < 0.3:
+                        b = b.spread_constraint(
+                            rng2.choice([1, 2]), ZONE,
+                            rng2.choice(["DoNotSchedule", "ScheduleAnyway"]), labels)
+                    elif r < 0.5:
+                        b = b.pod_affinity(HOSTNAME, labels, anti=True)
+                    elif r < 0.6:
+                        b = b.node_selector({"disk": "ssd"})
+                    pods.append(b.obj())
+            return pods
+
+        _run_pair(n_nodes, fn, seed=seed, taint_frac=0.2, unsched_frac=0.1)
