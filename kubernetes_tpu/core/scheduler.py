@@ -370,21 +370,23 @@ class Scheduler:
 
     def run_binding_cycle(
         self, fw: Framework, state: CycleState, qpi: QueuedPodInfo, result: ScheduleResult
-    ) -> None:
+    ) -> bool:
+        """Returns True iff the pod was bound (False: unwound + requeued)."""
         pod = qpi.pod
         node_name = result.suggested_host
         st = fw.run_pre_bind_plugins(state, pod, node_name)
         if not st.is_success():
             self._unwind_binding(fw, state, qpi, node_name, st)
-            return
+            return False
         st = fw.run_bind_plugins(state, pod, node_name)
         if not st.is_success():
             self._unwind_binding(fw, state, qpi, node_name, st)
-            return
+            return False
         self.cache.finish_binding(pod)
         self.queue.nominator.delete_nominated_pod(pod)
         self.scheduled += 1
         fw.run_post_bind_plugins(state, pod, node_name)
+        return True
 
     def _unwind_binding(self, fw, state, qpi: QueuedPodInfo, node_name: str, st: Status) -> None:
         """handleBindingCycleError (schedule_one.go:507): unreserve, forget,

@@ -43,7 +43,7 @@ class TPUScheduler(Scheduler):
     path for uncovered features; host and device paths produce identical
     assignments (deterministic_ties is forced on)."""
 
-    def __init__(self, *args, max_batch: int = 512, **kwargs):
+    def __init__(self, *args, max_batch: int = 1024, **kwargs):
         kwargs.setdefault("deterministic_ties", True)
         super().__init__(*args, **kwargs)
         self.max_batch = max_batch
@@ -130,14 +130,16 @@ class TPUScheduler(Scheduler):
             return "plugin-level default spread constraints"
         return None
 
-    def schedule_batch_on_device(self, fw: Framework, batch: List[QueuedPodInfo]) -> None:
-        pods = [q.pod for q in batch]
+    def build_plan(self, fw: Framework, pod, batch_size: int):
+        """Snapshot → mirror sync → batch feature build → device flush.
+        Returns (device_state, BatchPlan). Also the graft/bench entry's way
+        to produce kernel inputs."""
         self.cache.update_snapshot(self.snapshot)
         self.mirror.sync(self.snapshot.node_info_list)
         ipa = fw.plugin("InterPodAffinity")
         plan = build_batch(
-            pods[0],
-            batch_size=len(pods),
+            pod,
+            batch_size=batch_size,
             mirror=self.mirror,
             snapshot=self.snapshot,
             ns_labels_fn=self.cache.namespace_labels,
@@ -151,17 +153,33 @@ class TPUScheduler(Scheduler):
             fit_plugin=fw.plugin("NodeResourcesFit"),
         )
         state = self.mirror.flush()
-        chosen, starts = schedule_batch(
-            state, plan.features, plan.batch_pad, plan.fit_strategy, plan.vmax)
+        return state, plan
+
+    def schedule_batch_on_device(self, fw: Framework, batch: List[QueuedPodInfo]) -> None:
+        pods = [q.pod for q in batch]
+        state, plan = self.build_plan(fw, pods[0], len(pods))
         n = len(pods)
-        chosen = np.asarray(chosen)[:n]
-        starts = np.asarray(starts)[:n]
+        results, req_f, nz_f, pc_f = schedule_batch(
+            state, plan.features, plan.batch_pad, plan.fit_strategy, plan.vmax,
+            n_active=np.int32(n))
+        results = np.asarray(results)  # one device→host fetch
+        chosen, starts = results[0, :n], results[1, :n]
         self.device_batches += 1
 
         node_names = [ni.name for ni in self.snapshot.node_info_list]
+        ok_rows: List[int] = []
+        dirty_rows: List[int] = []
+        diverged = False
         for i, qpi in enumerate(batch):
             row = int(chosen[i])
             self.next_start_node_index = int(starts[i])
+            if diverged:
+                # A previous commit in this batch failed, so every later
+                # device choice was computed against state that no longer
+                # holds — fall back to the host path for the rest.
+                self.host_path_pods += 1
+                self.process_one(qpi)
+                continue
             if row < 0:
                 # Infeasible on device: rerun on the host path for the exact
                 # FitError diagnosis (and as a safety net — equivalence is
@@ -169,11 +187,25 @@ class TPUScheduler(Scheduler):
                 self.host_path_pods += 1
                 self.process_one(qpi)
                 continue
-            self._commit(fw, qpi, node_names[row])
+            if self._commit(fw, qpi, node_names[row]):
+                ok_rows.append(row)
+            else:
+                # Host rejected what the device applied in its carry: the
+                # carry diverged for this row — resync it the normal way.
+                dirty_rows.append(row)
+                diverged = True
+        # Keep the device state resident: the carry already reflects every
+        # successful placement, so (absent external events) the next flush
+        # uploads nothing. Do NOT sync here — adopt aligns generations itself;
+        # other changes are picked up by the next build_plan's sync.
+        self.cache.update_snapshot(self.snapshot)
+        self.mirror.adopt(self.snapshot.node_info_list, ok_rows,
+                          req_f, nz_f, pc_f, dirty_rows=dirty_rows)
 
-    def _commit(self, fw: Framework, qpi: QueuedPodInfo, node_name: str) -> None:
+    def _commit(self, fw: Framework, qpi: QueuedPodInfo, node_name: str) -> bool:
         """assume → reserve → permit → binding cycle (the unchanged host tail
-        of the scheduling cycle, schedule_one.go:315 onward)."""
+        of the scheduling cycle, schedule_one.go:315 onward). Returns False
+        when the host rejected the placement (carry divergence)."""
         from ..core.framework import CycleState
 
         pod = qpi.pod
@@ -188,7 +220,7 @@ class TPUScheduler(Scheduler):
             pod.node_name = ""
             self.handle_scheduling_failure(fw, qpi, st, None)
             self.queue.done(pod.uid)
-            return
+            return False
         st = fw.run_permit_plugins(state, pod, node_name)
         if st.is_rejected():
             fw.run_reserve_plugins_unreserve(state, pod, node_name)
@@ -196,10 +228,13 @@ class TPUScheduler(Scheduler):
             pod.node_name = ""
             self.handle_scheduling_failure(fw, qpi, st, None)
             self.queue.done(pod.uid)
-            return
-        self.run_binding_cycle(fw, state, qpi, ScheduleResult(suggested_host=node_name))
+            return False
+        if not self.run_binding_cycle(fw, state, qpi, ScheduleResult(suggested_host=node_name)):
+            self.queue.done(pod.uid)
+            return False  # bind failed and unwound
         self.device_scheduled += 1
         self.queue.done(pod.uid)
+        return True
 
     # -- run loop ----------------------------------------------------------
 

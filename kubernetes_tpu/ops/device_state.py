@@ -308,5 +308,42 @@ class NodeStateMirror:
         return self._device
 
 
+    # -- carry adoption (device-resident steady state) ---------------------
+
+    def adopt(
+        self,
+        node_info_list: Sequence[NodeInfo],
+        rows: Sequence[int],
+        req_r: jnp.ndarray,
+        nonzero: jnp.ndarray,
+        pod_count: jnp.ndarray,
+        dirty_rows: Sequence[int] = (),
+    ) -> None:
+        """After a device batch: the kernel's final carry already holds the
+        updated per-node aggregates, so install those arrays directly and
+        bring the host staging + generations in line WITHOUT marking rows
+        dirty — the next flush() then uploads nothing. Rows whose host commit
+        failed (carry diverged from cache) go through the normal dirty path.
+
+        This is the device-resident analogue of cache.go's incremental
+        UpdateSnapshot: in steady state the only node changes are the batch's
+        own placements, which the device already has."""
+        if self._device is None or self._full_flush:
+            return  # a full upload from (authoritative) staging is pending
+        try:
+            for i in rows:
+                if i < len(node_info_list):
+                    self._encode_row(i, node_info_list[i])
+                    if i < len(self._row_names):
+                        self._row_names[i] = node_info_list[i].name
+                        self._row_gen[i] = node_info_list[i].generation
+        except _Regrown:
+            return  # staging reset; full flush will rebuild everything
+        self._device = self._device._replace(
+            req_r=req_r, nonzero=nonzero, pod_count=pod_count)
+        for i in dirty_rows:
+            self._dirty.add(i)
+
+
 class _Regrown(Exception):
     """Internal: a capacity tier changed mid-encode; re-walk the snapshot."""

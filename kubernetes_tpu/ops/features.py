@@ -564,7 +564,18 @@ def build_batch(
     )
     return BatchPlan(
         features=feats,
-        batch_pad=_pow2(batch_size),
+        batch_pad=_batch_tier(batch_size),
         fit_strategy=strategy,
         vmax=vmax,
     )
+
+
+def _batch_tier(n: int) -> int:
+    """Coarse scan-length tiers: each distinct tier is a separate XLA compile
+    (~1 min on first use), so bound them to {8, 64, 512, 1024, ...}. Padded
+    steps cost device time but sliced-off outputs keep semantics exact."""
+    if n <= 8:
+        return 8
+    if n <= 64:
+        return 64
+    return _pow2(n, 512)

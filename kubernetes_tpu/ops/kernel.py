@@ -33,7 +33,7 @@ tests/test_device_equivalence.py):
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -117,9 +117,15 @@ def schedule_batch(
     batch_pad: int,
     fit_strategy: int,
     vmax: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Greedy-assign `batch_pad` identical pods. Returns (chosen[B] row index
-    or -1, start_index_after[B]). Callers slice [:actual_batch_size]."""
+    n_active: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, ...]:
+    """Greedy-assign up to `batch_pad` identical pods (`n_active` of them
+    real; padded steps are inert so the returned carry stays exact).
+
+    Returns (results, req_r, nonzero, pod_count) where results is the stacked
+    [2, B] array of (chosen row or -1, start_index_after) — one array so the
+    host fetches with a single transfer; slice results[:, :n_active]. The
+    final per-node aggregates support NodeStateMirror.adopt."""
     NP = state.valid.shape[0]
     C1 = f.dns_axis.shape[0]
     C2 = f.sa_axis.shape[0]
@@ -155,9 +161,12 @@ def schedule_batch(
 
     w_tt, w_fit, w_pts, w_ipa, w_ba = (f.weights[i] for i in range(5))
 
-    def step(carry, _):
+    n_act = jnp.int32(batch_pad) if n_active is None else n_active.astype(jnp.int32)
+
+    def step(carry, t):
         (req_r, nonzero, pod_count, dns_counts, sa_counts,
          anti_counts, aff_counts, ipa_delta, start) = carry
+        active = t < n_act
 
         # ---- Fit filter (fit.go:710) --------------------------------------
         pods_ok = (pod_count + 1).astype(jnp.int64) <= state.alloc_pods
@@ -273,13 +282,13 @@ def schedule_batch(
         total = (w_tt * tt + w_fit * fit_sc + w_ba * ba + w_pts * pts + w_ipa * ipa)
 
         # ---- select (schedule_one.go selectHost, deterministic ties) ------
-        any_kept = kept.any()
+        any_kept = kept.any() & active
         best = jnp.max(jnp.where(kept, total, -_INF64))
         cand_rot = jnp.where(kept & (total == best), rot_of_row, _BIG)
         chosen_rot = jnp.min(cand_rot)
         chosen = jnp.where(any_kept, (start + chosen_rot) % num, -1).astype(jnp.int32)
 
-        # ---- carry updates ------------------------------------------------
+        # ---- carry updates (inert when this step is padding) --------------
         row = jnp.maximum(chosen, 0)
         apply = jnp.where(any_kept, 1, 0).astype(jnp.int64)
         req_r = req_r.at[row].add(f.request * apply)
@@ -301,7 +310,7 @@ def schedule_batch(
         if KD:
             upd = f.ipa_wland * (ipa_vid[:, row] > 0) * apply
             ipa_delta = ipa_delta.at[jnp.arange(KD), ipa_vid[:, row]].add(upd)
-        start = ((start + evaluated) % num).astype(jnp.int32)
+        start = jnp.where(active, (start + evaluated) % num, start).astype(jnp.int32)
 
         new_carry = (req_r, nonzero, pod_count, dns_counts, sa_counts,
                      anti_counts, aff_counts, ipa_delta, start)
@@ -311,5 +320,11 @@ def schedule_batch(
     carry0 = (state.req_r, state.nonzero, state.pod_count,
               f.dns_counts, f.sa_counts, f.anti_counts, f.aff_counts,
               ipa_delta0, f.start_index)
-    _, (chosen, starts) = lax.scan(step, carry0, None, length=batch_pad)
-    return chosen, starts
+    final, (chosen, starts) = lax.scan(
+        step, carry0, jnp.arange(batch_pad, dtype=jnp.int32))
+    # chosen+starts stacked into ONE array: the host fetches results with a
+    # single device→host transfer (each fetch pays a full RTT on tunneled
+    # TPUs). Final per-node aggregates ride back so the host can keep the
+    # device state resident across batches (NodeStateMirror.adopt) instead of
+    # re-uploading — the device-side analogue of the incremental snapshot.
+    return jnp.stack([chosen, starts]), final[0], final[1], final[2]

@@ -1,0 +1,18 @@
+"""Distribution layer: device meshes and sharded kernel dispatch.
+
+The reference's distribution fabric is etcd watch → apiserver watch cache →
+client-go informers (SURVEY.md §5 'distributed communication backend'); its
+intra-cycle parallelism is a 16-goroutine chunked fan-out (§2.4). The
+TPU-native equivalents here:
+
+- the NODE axis of the cluster-state tensors shards across chips over ICI
+  (tensor-parallel style: the "model" being sharded is the cluster state);
+- independent scheduling *cells* (Borg-style cells / multi-cluster shards)
+  map to a data-parallel mesh axis;
+- XLA inserts the collectives (the cross-shard argmax/min/max reductions in
+  the kernel) — no hand-written communication.
+"""
+
+from .mesh import make_mesh, shard_node_state, sharded_schedule_batch
+
+__all__ = ["make_mesh", "shard_node_state", "sharded_schedule_batch"]

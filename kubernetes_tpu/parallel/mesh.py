@@ -1,0 +1,99 @@
+"""Mesh construction + sharded dispatch of the batch scheduling kernel.
+
+Sharding layout (scaling-book recipe: pick a mesh, annotate shardings, let
+XLA insert collectives):
+
+- `DeviceNodeState` row-major arrays shard their node dimension over the
+  `"nodes"` mesh axis (`topo` is [K, NP] → shard dim 1).
+- Per-node feature arrays (`exist_anti`, `ipa_base`) shard the same way;
+  count tables ([C, VMAX]) and pod-level features replicate.
+- An optional leading `"cells"` axis runs independent scheduling cells
+  (separate clusters / Borg cells) data-parallel: every leaf gains a leading
+  cell dimension and the kernel is vmapped over it.
+
+The kernel's cross-node reductions (rotation cumsum, masked max/min, argmax
+select) become XLA collectives over ICI; the scan carry's scatter updates
+land on whichever shard owns the chosen row.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.device_state import DeviceNodeState
+from ..ops.features import BatchFeatures
+from ..ops.kernel import schedule_batch
+
+
+def make_mesh(
+    n_cells: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Mesh over all (or given) devices: ("cells", "nodes"). With n_cells=1
+    every chip shards the node axis of one cluster."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if n % max(n_cells, 1) != 0:
+        raise ValueError(f"{n} devices not divisible into {n_cells} cells")
+    arr = np.array(devs).reshape(n_cells, n // n_cells)
+    return Mesh(arr, axis_names=("cells", "nodes"))
+
+
+# PartitionSpecs per DeviceNodeState field (node dim sharded).
+_STATE_SPECS = DeviceNodeState(
+    alloc_r=P("nodes", None), alloc_pods=P("nodes"), req_r=P("nodes", None),
+    nonzero=P("nodes", None), pod_count=P("nodes"),
+    taint_key=P("nodes", None), taint_val=P("nodes", None), taint_eff=P("nodes", None),
+    unsched=P("nodes"), valid=P("nodes"), name_id=P("nodes"),
+    pairs=P("nodes", None), topo=P(None, "nodes"),
+)
+
+
+def _feature_specs() -> BatchFeatures:
+    """Per-node feature arrays shard over "nodes"; the rest replicate."""
+    specs = {name: P() for name in BatchFeatures._fields}
+    specs["exist_anti"] = P("nodes")
+    specs["ipa_base"] = P("nodes")
+    return BatchFeatures(**specs)
+
+
+def shard_node_state(state: DeviceNodeState, mesh: Mesh) -> DeviceNodeState:
+    """Place a single cell's node state onto the mesh's "nodes" axis."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state, _STATE_SPECS)
+
+
+def sharded_schedule_batch(mesh: Mesh, batch_pad: int, fit_strategy: int, vmax: int):
+    """Build the mesh-sharded (and, when the mesh has >1 cell, cell-vmapped)
+    compiled kernel. Call with (state, feats) whose leaves carry a leading
+    cell dimension iff n_cells > 1."""
+    n_cells = mesh.shape["cells"]
+    kernel = partial(schedule_batch, batch_pad=batch_pad,
+                     fit_strategy=fit_strategy, vmax=vmax)
+
+    def run(state: DeviceNodeState, feats: BatchFeatures):
+        return kernel(state, feats)
+
+    if n_cells > 1:
+        run = jax.vmap(run)
+
+    def add_cells(spec: P) -> P:
+        return P("cells", *spec) if n_cells > 1 else spec
+
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+    state_specs = jax.tree_util.tree_map(add_cells, _STATE_SPECS, is_leaf=is_spec)
+    feat_specs = jax.tree_util.tree_map(add_cells, _feature_specs(), is_leaf=is_spec)
+    in_shardings = (
+        jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), state_specs, is_leaf=is_spec),
+        jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), feat_specs, is_leaf=is_spec),
+    )
+    # jit built ONCE: repeated calls hit the dispatch cache.
+    return jax.jit(run, in_shardings=in_shardings)

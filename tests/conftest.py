@@ -5,11 +5,16 @@ __graft_entry__.dryrun_multichip)."""
 import os
 import sys
 
-# Force CPU even when the session env points at real TPU hardware (e.g.
-# JAX_PLATFORMS=axon): unit tests must be hermetic and fast.
+# Force CPU even when the session env points at real TPU hardware. NOTE: the
+# axon PJRT plugin ignores the JAX_PLATFORMS env var, so the config API must
+# be used (before any backend initialization).
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
