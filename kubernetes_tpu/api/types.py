@@ -259,6 +259,10 @@ class Pod:
     priority_class_name: str = ""
     preemption_policy: str = "PreemptLowerPriority"  # or "Never"
     scheduling_gates: List[str] = field(default_factory=list)
+    # Gang scheduling (fork's GenericWorkload surface): pods naming a
+    # PodGroup are scheduled all-or-nothing with their peers
+    # (schedule_one_podgroup.go; membership via workload reference).
+    pod_group: str = ""  # PodGroup name in the pod's namespace ("" = none)
     volumes: List[Volume] = field(default_factory=list)
     host_network: bool = False
     # status

@@ -11,7 +11,7 @@ from __future__ import annotations
 import copy
 from typing import Callable, Dict, List, Optional
 
-from ..api.types import Namespace, Node, Pod
+from ..api.types import Namespace, Node, Pod, PodGroup
 
 
 class FakeClientset:
@@ -19,10 +19,12 @@ class FakeClientset:
         self.pods: Dict[str, Pod] = {}
         self.nodes: Dict[str, Node] = {}
         self.namespaces: Dict[str, Namespace] = {"default": Namespace(name="default")}
+        self.pod_groups: Dict[str, PodGroup] = {}  # "ns/name" -> group
         self.bindings: Dict[str, str] = {}  # pod uid -> node name
         self._pod_handlers: List = []
         self._node_handlers: List = []
         self._namespace_handlers: List = []
+        self._pod_group_handlers: List = []
         self._rv = 0
 
     # -- informer-ish registration ----------------------------------------
@@ -38,6 +40,11 @@ class FakeClientset:
         self._namespace_handlers.append(handler)
         for ns in self.namespaces.values():  # replay existing (informer list)
             handler(ns)
+
+    def on_pod_group_event(self, handler: Callable[[PodGroup], None]) -> None:
+        self._pod_group_handlers.append(handler)
+        for g in self.pod_groups.values():
+            handler(g)
 
     # -- writes ------------------------------------------------------------
 
@@ -69,6 +76,12 @@ class FakeClientset:
         for h in self._namespace_handlers:
             h(ns)
         return ns
+
+    def create_pod_group(self, group: PodGroup) -> PodGroup:
+        self.pod_groups[f"{group.namespace}/{group.name}"] = group
+        for h in self._pod_group_handlers:
+            h(group)
+        return group
 
     def create_pod(self, pod: Pod) -> Pod:
         self._rv += 1

@@ -1,0 +1,87 @@
+"""Feature gates (component-base/featuregate/feature_gate.go mechanism;
+gate inventory from pkg/features/kube_features.go — the scheduler-relevant
+subset of the reference's 189 gates, plus this framework's own).
+
+Usage:
+    gates = FeatureGates()                  # defaults
+    gates = FeatureGates({"TPUBatchScheduling": False})
+    if gates.enabled(GENERIC_WORKLOAD): ...
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Mapping, Optional, Tuple
+
+ALPHA = "Alpha"
+BETA = "Beta"
+GA = "GA"
+
+
+@dataclass(frozen=True)
+class FeatureSpec:
+    default: bool
+    stage: str = BETA
+    # Gates that must be enabled for this one to take effect
+    # (kube_features.go dependency graph :2534-2740).
+    depends_on: Tuple[str, ...] = ()
+
+
+# Reference gates the scheduler consumes (kube_features.go anchors).
+GENERIC_WORKLOAD = "GenericWorkload"                      # :441 gang scheduling
+COMPOSITE_POD_GROUP = "CompositePodGroup"                 # :158
+OPPORTUNISTIC_BATCHING = "OpportunisticBatching"          # :818 KEP-5598
+SCHEDULER_ASYNC_API_CALLS = "SchedulerAsyncAPICalls"      # :1048
+SCHEDULER_POP_FROM_BACKOFF_Q = "SchedulerPopFromBackoffQ"  # :1062
+NOMINATED_NODE_NAME_FOR_EXPECTATION = "NominatedNodeNameForExpectation"  # :812
+SCHEDULER_QUEUEING_HINTS = "SchedulerQueueingHints"
+NODE_DECLARED_FEATURES = "NodeDeclaredFeatures"
+DYNAMIC_RESOURCE_ALLOCATION = "DynamicResourceAllocation"
+MATCH_LABEL_KEYS_IN_POD_TOPOLOGY_SPREAD = "MatchLabelKeysInPodTopologySpread"
+# TPU-native framework gates.
+TPU_BATCH_SCHEDULING = "TPUBatchScheduling"               # the device hot path
+TPU_STATE_RESIDENCY = "TPUStateResidency"                 # carry adoption
+
+DEFAULT_FEATURES: Dict[str, FeatureSpec] = {
+    GENERIC_WORKLOAD: FeatureSpec(True, BETA),
+    COMPOSITE_POD_GROUP: FeatureSpec(False, ALPHA, depends_on=(GENERIC_WORKLOAD,)),
+    OPPORTUNISTIC_BATCHING: FeatureSpec(True, BETA),
+    SCHEDULER_ASYNC_API_CALLS: FeatureSpec(True, BETA),
+    SCHEDULER_POP_FROM_BACKOFF_Q: FeatureSpec(True, BETA),
+    NOMINATED_NODE_NAME_FOR_EXPECTATION: FeatureSpec(True, BETA),
+    SCHEDULER_QUEUEING_HINTS: FeatureSpec(True, BETA),
+    NODE_DECLARED_FEATURES: FeatureSpec(False, ALPHA),
+    DYNAMIC_RESOURCE_ALLOCATION: FeatureSpec(False, ALPHA),
+    MATCH_LABEL_KEYS_IN_POD_TOPOLOGY_SPREAD: FeatureSpec(True, GA),
+    TPU_BATCH_SCHEDULING: FeatureSpec(True, BETA),
+    TPU_STATE_RESIDENCY: FeatureSpec(True, BETA, depends_on=(TPU_BATCH_SCHEDULING,)),
+}
+
+
+class FeatureGates:
+    def __init__(self, overrides: Optional[Mapping[str, bool]] = None,
+                 known: Optional[Mapping[str, FeatureSpec]] = None):
+        self._known = dict(known or DEFAULT_FEATURES)
+        self._enabled: Dict[str, bool] = {
+            name: spec.default for name, spec in self._known.items()}
+        for name, val in (overrides or {}).items():
+            if name not in self._known:
+                raise ValueError(f"unknown feature gate {name!r}")
+            self._enabled[name] = bool(val)
+        self._validate_dependencies()
+
+    def _validate_dependencies(self) -> None:
+        for name, spec in self._known.items():
+            if self._enabled[name]:
+                for dep in spec.depends_on:
+                    if not self._enabled.get(dep, False):
+                        raise ValueError(
+                            f"feature {name} requires {dep} to be enabled")
+
+    def enabled(self, name: str) -> bool:
+        if name not in self._known:
+            raise ValueError(f"unknown feature gate {name!r}")
+        return self._enabled[name]
+
+    def known(self) -> Dict[str, FeatureSpec]:
+        return dict(self._known)

@@ -339,8 +339,8 @@ class Framework:
         for p in self.post_filter_plugins:
             result, st = p.post_filter(state, pod, filtered_status_map)
             if st.is_success() or st.code == UNSCHEDULABLE_AND_UNRESOLVABLE:
-                st.plugin = p.name
-                return result, st
+                # copy before stamping: plugins may return shared singletons
+                return result, Status(st.code, st.reasons, p.name)
         return None, Status.unschedulable("no postFilter plugin made progress")
 
     # -- scoring -----------------------------------------------------------

@@ -1,0 +1,207 @@
+"""Scheduler metrics: Prometheus-style registry + the reference's series.
+
+Re-expresses pkg/scheduler/metrics/metrics.go (names at :265-615) over a
+dependency-free metrics core (component-base/metrics analogue). Series are
+registered on a module-level Registry; `expose()` renders the Prometheus text
+format for a /metrics endpoint.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# Histogram buckets (metrics.go uses exponential buckets starting 0.001).
+DURATION_BUCKETS = (0.001, 0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128,
+                    0.256, 0.512, 1.024, 2.048, 4.096, 8.192, 16.384)
+
+
+class Metric:
+    def __init__(self, name: str, help_text: str, label_names: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+
+
+class Counter(Metric):
+    def __init__(self, name, help_text, label_names=()):
+        super().__init__(name, help_text, tuple(label_names))
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, *labels: str, value: float = 1.0) -> None:
+        key = tuple(labels)
+        self._values[key] = self._values.get(key, 0.0) + value
+
+    def value(self, *labels: str) -> float:
+        return self._values.get(tuple(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v}")
+        return out
+
+
+class Gauge(Metric):
+    def __init__(self, name, help_text, label_names=(), fn: Optional[Callable] = None):
+        super().__init__(name, help_text, tuple(label_names))
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._fn = fn  # callback gauge
+
+    def set(self, value: float, *labels: str) -> None:
+        self._values[tuple(labels)] = value
+
+    def value(self, *labels: str) -> float:
+        return self._values.get(tuple(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        values = self._fn() if self._fn is not None else self._values
+        for key, v in sorted(values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v}")
+        return out
+
+
+class Histogram(Metric):
+    def __init__(self, name, help_text, label_names=(), buckets=DURATION_BUCKETS):
+        super().__init__(name, help_text, tuple(label_names))
+        self.buckets = tuple(buckets)
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, *labels: str) -> None:
+        key = tuple(labels)
+        # +1 slot: the +Inf bucket (cumulative == count, Prometheus contract)
+        counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                counts[i] += 1
+        counts[-1] += 1
+        self._sums[key] = self._sums.get(key, 0.0) + value
+        self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, *labels: str) -> int:
+        return self._totals.get(tuple(labels), 0)
+
+    def sum(self, *labels: str) -> float:
+        return self._sums.get(tuple(labels), 0.0)
+
+    def percentile(self, q: float, *labels: str) -> float:
+        """Bucket-interpolated percentile (perf collector support); mass in
+        the +Inf bucket reports the top finite bound."""
+        key = tuple(labels)
+        total = self._totals.get(key, 0)
+        if total == 0:
+            return 0.0
+        target = q * total
+        cum_prev = 0
+        for i, b in enumerate(self.buckets):
+            cum = self._counts[key][i]
+            if cum >= target:
+                lo = self.buckets[i - 1] if i else 0.0
+                span = cum - cum_prev
+                frac = (target - cum_prev) / span if span else 1.0
+                return lo + (b - lo) * frac
+            cum_prev = cum
+        return self.buckets[-1]
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for key in sorted(self._totals):
+            for i, b in enumerate(self.buckets):
+                labels = _fmt_labels(self.label_names + ("le",), key + (str(b),))
+                out.append(f"{self.name}_bucket{labels} {self._counts[key][i]}")
+            inf = _fmt_labels(self.label_names + ("le",), key + ("+Inf",))
+            out.append(f"{self.name}_bucket{inf} {self._counts[key][-1]}")
+            out.append(f"{self.name}_sum{_fmt_labels(self.label_names, key)} {self._sums[key]}")
+            out.append(f"{self.name}_count{_fmt_labels(self.label_names, key)} {self._totals[key]}")
+        return out
+
+
+def _fmt_labels(names: Tuple[str, ...], values: Tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    pairs = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + pairs + "}"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List[Metric] = []
+
+    def register(self, m: Metric) -> Metric:
+        self._metrics.append(m)
+        return m
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        for m in self._metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+class SchedulerMetrics:
+    """The scheduler's series (metrics/metrics.go:265-615 subset that the
+    perf harness and tests consume)."""
+
+    def __init__(self):
+        self.registry = Registry()
+        r = self.registry.register
+        self.schedule_attempts = r(Counter(
+            "scheduler_schedule_attempts_total",
+            "Number of attempts to schedule pods, by result and profile.",
+            ("result", "profile")))
+        self.scheduling_attempt_duration = r(Histogram(
+            "scheduler_scheduling_attempt_duration_seconds",
+            "Scheduling attempt latency (scheduling algorithm + binding).",
+            ("result", "profile")))
+        self.pod_scheduling_sli_duration = r(Histogram(
+            "scheduler_pod_scheduling_sli_duration_seconds",
+            "E2e latency for a pod being scheduled, from first attempt.",
+            ("attempts",)))
+        self.framework_extension_point_duration = r(Histogram(
+            "scheduler_framework_extension_point_duration_seconds",
+            "Latency per extension point.", ("extension_point", "status", "profile")))
+        self.plugin_execution_duration = r(Histogram(
+            "scheduler_plugin_execution_duration_seconds",
+            "Plugin execution latency.", ("plugin", "extension_point", "status")))
+        self.pending_pods = r(Gauge(
+            "scheduler_pending_pods",
+            "Pending pods by queue (active/backoff/unschedulable/gated).",
+            ("queue",)))
+        self.queue_incoming_pods = r(Counter(
+            "scheduler_queue_incoming_pods_total",
+            "Pods added to queues by event and queue.", ("queue", "event")))
+        self.preemption_attempts = r(Counter(
+            "scheduler_preemption_attempts_total", "Preemption attempts."))
+        self.preemption_victims = r(Histogram(
+            "scheduler_preemption_victims", "Victims per preemption.",
+            buckets=(1, 2, 4, 8, 16, 32, 64)))
+        self.batch_attempts = r(Counter(
+            "scheduler_batch_attempts_total",
+            "Device batch dispatches, by outcome.", ("result",)))
+        self.batch_size = r(Histogram(
+            "scheduler_batch_size", "Pods per device batch.",
+            buckets=(1, 8, 64, 256, 512, 1024, 2048, 4096)))
+        self.podgroup_schedule_attempts = r(Counter(
+            "scheduler_podgroup_schedule_attempts_total",
+            "Gang scheduling attempts, by result.", ("result",)))
+        self.goroutines = r(Gauge(
+            "scheduler_device_dispatches_active",
+            "In-flight device dispatches (Parallelizer-goroutines analogue).",
+            ()))
+        self.cache_size = r(Gauge(
+            "scheduler_scheduler_cache_size", "Cache object counts.", ("type",)))
+
+    def expose(self) -> str:
+        return self.registry.expose()
+
+
+@dataclass
+class _Timer:
+    start: float = field(default_factory=time.perf_counter)
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.start

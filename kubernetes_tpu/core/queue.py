@@ -58,6 +58,41 @@ class QueuedPodInfo:
     def pod(self) -> Pod:
         return self.pod_info.pod
 
+    @property
+    def uid(self) -> str:
+        return self.pod_info.pod.uid
+
+
+@dataclass
+class QueuedPodGroupInfo:
+    """The gang-scheduling queue entity (scheduling_queue.go
+    QueuedPodGroupInfo; invariants :196-206): a PodGroup whose member pods
+    have all arrived pops as ONE unit and is scheduled all-or-nothing."""
+
+    group: "object"  # api.types.PodGroup
+    members: List[QueuedPodInfo] = field(default_factory=list)
+    timestamp: float = 0.0
+    attempts: int = 0
+    initial_attempt_timestamp: Optional[float] = None
+    unschedulable_plugins: Set[str] = field(default_factory=set)
+    pending_plugins: Set[str] = field(default_factory=set)
+    gated: bool = False
+    consecutive_backoff_exempt: bool = False
+
+    @property
+    def pod(self) -> Pod:
+        """Queue-ordering shim: group entities sort by group priority and
+        arrival (the reference's workload-aware lessFn)."""
+        return self.members[0].pod if self.members else Pod(name="(empty-group)")
+
+    @property
+    def pods(self) -> List[Pod]:
+        return [m.pod for m in self.members]
+
+    @property
+    def uid(self) -> str:
+        return f"pg:{self.group.namespace}/{self.group.name}"
+
 
 class _Heap:
     """Stable heap with O(log n) update/delete by key (backend/heap/heap.go)."""
@@ -78,8 +113,8 @@ class _Heap:
         def __lt__(self, other):
             return self.less(self.qpi, other.qpi)
 
-    def push(self, qpi: QueuedPodInfo) -> None:
-        uid = qpi.pod.uid
+    def push(self, qpi) -> None:
+        uid = qpi.uid
         self.delete(uid)
         entry = [self._Key(qpi, self._less), next(self._seq), qpi, True]
         self._by_uid[uid] = entry
@@ -89,7 +124,7 @@ class _Heap:
         while self._entries:
             entry = heapq.heappop(self._entries)
             if entry[3]:
-                del self._by_uid[entry[2].pod.uid]
+                del self._by_uid[entry[2].uid]
                 return entry[2]
         return None
 
@@ -161,6 +196,7 @@ class PriorityQueue:
         max_in_unschedulable: float = DEFAULT_MAX_IN_UNSCHEDULABLE_DURATION,
         now: Callable[[], float] = time.monotonic,
         pop_from_backoff_q: bool = True,
+        gang_enabled: bool = True,
     ):
         self.framework = framework
         self.now = now
@@ -168,6 +204,7 @@ class PriorityQueue:
         self.max_backoff = max_backoff
         self.max_in_unschedulable = max_in_unschedulable
         self.pop_from_backoff_q = pop_from_backoff_q
+        self.gang_enabled = gang_enabled
 
         less = framework.less if framework is not None else (lambda a, b: a.timestamp < b.timestamp)
         self.active_q = _Heap(less)
@@ -176,6 +213,11 @@ class PriorityQueue:
         self.nominator = Nominator()
         self._in_flight: Dict[str, List[str]] = {}  # uid -> events seen while in flight
         self.moved_count = 0  # schedulingCycle analogue of moveRequestCycle
+        # Gang scheduling (workload_forest.go / pod_group_member_pods.go):
+        # member pods buffer until their group has min_count arrivals, then
+        # the whole group enters the queue as one entity.
+        self.pod_groups: Dict[Tuple[str, str], object] = {}
+        self._group_members: Dict[Tuple[str, str], List[QueuedPodInfo]] = {}
 
     # -- backoff (backoff_queue.go:249) ------------------------------------
 
@@ -216,10 +258,84 @@ class PriorityQueue:
                 qpi.unschedulable_plugins.add(st.plugin)
                 self.unschedulable[pod.uid] = qpi
                 return
+        if pod.pod_group and self.gang_enabled:
+            self._add_group_member(qpi)
+            return
         self.active_q.push(qpi)
+
+    # -- gang scheduling ---------------------------------------------------
+
+    def register_pod_group(self, group) -> None:
+        """PodGroup informer event: record the group and activate it if its
+        members already arrived (scheduling_queue.go pod-group invariants)."""
+        key = (group.namespace, group.name)
+        self.pod_groups[key] = group
+        self._maybe_activate_group(key)
+
+    def _add_group_member(self, qpi: QueuedPodInfo) -> None:
+        pod = qpi.pod
+        key = (pod.namespace, pod.pod_group)
+        members = self._group_members.setdefault(key, [])
+        members.append(qpi)
+        existing = self._group_entity(key)
+        if existing is not None:
+            existing.members = list(members)  # late joiner widens the gang
+            return
+        self._maybe_activate_group(key)
+
+    def _group_entity(self, key) -> Optional[QueuedPodGroupInfo]:
+        group = self.pod_groups.get(key)
+        if group is None:
+            return None
+        uid = f"pg:{key[0]}/{key[1]}"
+        ent = self.active_q.get(uid) or self.backoff_q.get(uid) or self.unschedulable.get(uid)
+        return ent
+
+    def _maybe_activate_group(self, key) -> None:
+        """PodGroupPodsCount gate: the group becomes schedulable once
+        min_count members are pending (podgrouppodscount/)."""
+        group = self.pod_groups.get(key)
+        members = self._group_members.get(key, [])
+        if group is None or len(members) < max(1, group.min_count):
+            return
+        if self._group_entity(key) is not None or f"pg:{key[0]}/{key[1]}" in self._in_flight:
+            return
+        ent = QueuedPodGroupInfo(
+            group=group, members=list(members), timestamp=self.now())
+        self.active_q.push(ent)
+
+    def remove_group_member(self, pod: Pod) -> None:
+        key = (pod.namespace, pod.pod_group)
+        members = self._group_members.get(key)
+        if not members:
+            return
+        self._group_members[key] = [m for m in members if m.pod.uid != pod.uid]
+        ent = self._group_entity(key)
+        if ent is not None:
+            ent.members = [m for m in ent.members if m.pod.uid != pod.uid]
+            group = self.pod_groups.get(key)
+            if group is not None and len(ent.members) < max(1, group.min_count):
+                self.active_q.delete(ent.uid)
+                self.backoff_q.delete(ent.uid)
+                self.unschedulable.pop(ent.uid, None)
+
+    def clear_group_members(self, group_key: Tuple[str, str], uids) -> None:
+        """Members successfully scheduled leave the buffer."""
+        members = self._group_members.get(group_key)
+        if members:
+            self._group_members[group_key] = [
+                m for m in members if m.pod.uid not in uids]
 
     def update(self, old: Optional[Pod], new: Pod) -> None:
         uid = new.uid
+        if new.pod_group and self.gang_enabled:
+            # A buffered gang member updates in place — falling through to
+            # add() would append a duplicate member entry.
+            key = (new.namespace, new.pod_group)
+            for m in self._group_members.get(key, ()):
+                if m.pod.uid == uid:
+                    m.pod_info = PodInfo.of(new)
+                    return
         if uid in self.unschedulable:
             qpi = self.unschedulable.pop(uid)
             qpi.pod_info = PodInfo.of(new)
@@ -255,6 +371,8 @@ class PriorityQueue:
             self.add(new)
 
     def delete(self, pod: Pod) -> None:
+        if pod.pod_group:
+            self.remove_group_member(pod)
         self.active_q.delete(pod.uid)
         self.backoff_q.delete(pod.uid)
         self.unschedulable.pop(pod.uid, None)
@@ -274,7 +392,7 @@ class PriorityQueue:
         qpi.attempts += 1
         if qpi.initial_attempt_timestamp is None:
             qpi.initial_attempt_timestamp = self.now()
-        self._in_flight[qpi.pod.uid] = []
+        self._in_flight[qpi.uid] = []
         return qpi
 
     def done(self, uid: str) -> None:
@@ -289,11 +407,12 @@ class PriorityQueue:
 
     # -- requeue on failure -------------------------------------------------
 
-    def add_unschedulable_if_not_present(self, qpi: QueuedPodInfo, pod_scheduling_cycle: int = 0) -> None:
+    def add_unschedulable_if_not_present(self, qpi, pod_scheduling_cycle: int = 0) -> None:
         """AddUnschedulablePodIfNotPresent (scheduling_queue.go:1058): if a
-        relevant event arrived while the pod was in flight, skip the
-        unschedulable pool and go straight to backoff/active."""
-        uid = qpi.pod.uid
+        relevant event arrived while the entity was in flight, skip the
+        unschedulable pool and go straight to backoff/active. Entities key by
+        their queue uid (pod uid, or "pg:ns/name" for gangs)."""
+        uid = qpi.uid
         events = self._in_flight.get(uid, [])
         qpi.timestamp = self.now()
         if events and self._events_relevant(qpi, events):
@@ -307,9 +426,9 @@ class PriorityQueue:
         # hint fn is to requeue). Per-plugin hints refine this later.
         return True
 
-    def _move_to_active_or_backoff(self, qpi: QueuedPodInfo) -> None:
+    def _move_to_active_or_backoff(self, qpi) -> None:
         if qpi.gated:
-            self.unschedulable[qpi.pod.uid] = qpi
+            self.unschedulable[qpi.uid] = qpi
             return
         if self.is_backing_off(qpi):
             self.backoff_q.push(qpi)

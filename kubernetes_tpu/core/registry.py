@@ -27,6 +27,7 @@ from ..plugins.basic import (
 from ..plugins.interpodaffinity import InterPodAffinity
 from ..plugins.noderesources import BalancedAllocation, Fit
 from ..plugins.podtopologyspread import PodTopologySpread
+from ..plugins.preemption import DefaultPreemption
 from .framework import Framework
 
 # name -> factory(handle, args) (plugins/registry.go NewInTreeRegistry)
@@ -43,6 +44,7 @@ IN_TREE_REGISTRY: Dict[str, Callable] = {
     "InterPodAffinity": lambda h, **kw: InterPodAffinity(handle=h, **kw),
     "NodeResourcesBalancedAllocation": lambda h, **kw: BalancedAllocation(**kw),
     "ImageLocality": lambda h, **kw: ImageLocality(handle=h),
+    "DefaultPreemption": lambda h, **kw: DefaultPreemption(handle=h, **kw),
     "DefaultBinder": lambda h, **kw: DefaultBinder(handle=h),
 }
 
@@ -58,6 +60,7 @@ DEFAULT_PLUGINS: Tuple[Tuple[str, int], ...] = (
     ("NodeResourcesFit", 1),
     ("PodTopologySpread", 2),
     ("InterPodAffinity", 2),
+    ("DefaultPreemption", 0),
     ("NodeResourcesBalancedAllocation", 1),
     ("ImageLocality", 1),
     ("DefaultBinder", 0),
@@ -75,7 +78,15 @@ def build_framework(
     for name, weight in plugins:
         factory = IN_TREE_REGISTRY[name]
         instances.append((factory(handle, **plugin_args.get(name, {})), weight))
-    return Framework(profile_name=profile_name, plugins=instances)
+    fw = Framework(profile_name=profile_name, plugins=instances)
+    # Late-bind plugins that dispatch back into the framework (preemption's
+    # dry runs re-enter RunFilterPlugins — reference wires this through
+    # framework.Handle; here a post-construction hook avoids the cycle).
+    for p, _ in instances:
+        hook = getattr(p, "set_framework", None)
+        if hook is not None:
+            hook(fw)
+    return fw
 
 
 def default_profiles(handle) -> Dict[str, Framework]:

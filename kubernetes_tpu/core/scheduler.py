@@ -52,6 +52,7 @@ from .queue import (
     EVENT_NODE_ADD,
     EVENT_NODE_UPDATE,
     PriorityQueue,
+    QueuedPodGroupInfo,
     QueuedPodInfo,
 )
 
@@ -98,6 +99,14 @@ class Handle:
     def nominator(self):
         return self._scheduler.queue.nominator
 
+    @property
+    def metrics(self):
+        return self._scheduler.metrics
+
+    @property
+    def gates(self):
+        return self._scheduler.gates
+
 
 class Scheduler:
     def __init__(
@@ -107,14 +116,27 @@ class Scheduler:
         percentage_of_nodes_to_score: int = 0,
         seed: int = 0,
         deterministic_ties: bool = False,
+        config=None,  # SchedulerConfiguration (core/config.py)
         now: Callable[[], float] = time.monotonic,
     ):
+        from .config import SchedulerConfiguration  # local: avoid cycle
+        from .features import (
+            GENERIC_WORKLOAD,
+            SCHEDULER_POP_FROM_BACKOFF_Q,
+            FeatureGates,
+        )
+        from .metrics import SchedulerMetrics
+
+        self.config: SchedulerConfiguration = config or SchedulerConfiguration()
+        self.gates: "FeatureGates" = self.config.gates()
+        self.metrics = SchedulerMetrics()
         self.clientset = clientset or FakeClientset()
         self.cache = Cache(now=now)
         self.snapshot = Snapshot()
         self.now = now
         self.rng = random.Random(seed)
-        self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        self.percentage_of_nodes_to_score = (
+            percentage_of_nodes_to_score or self.config.percentage_of_nodes_to_score)
         # deterministic_ties picks the first max-score node in evaluation
         # order instead of reservoir-sampling among ties (schedule_one.go
         # selectHost) — required for host↔device assignment equivalence.
@@ -122,14 +144,29 @@ class Scheduler:
         self.next_start_node_index = 0
 
         handle = Handle(self)
-        if profile_factory is None:
-            from .registry import default_profiles  # local import: avoid cycle
-            self.profiles = default_profiles(handle)
-        else:
+        if profile_factory is not None:
             self.profiles = profile_factory(handle)
+        elif config is not None:
+            from .registry import build_framework
+            self.profiles = {
+                p.scheduler_name: build_framework(
+                    handle, profile_name=p.scheduler_name,
+                    plugins=p.plugins.resolve(), plugin_args=p.plugin_config)
+                for p in self.config.profiles
+            }
+        else:
+            from .registry import default_profiles
+            self.profiles = default_profiles(handle)
         self.handle = handle
         first = next(iter(self.profiles.values()))
-        self.queue = PriorityQueue(framework=first, now=now)
+        self.queue = PriorityQueue(
+            framework=first,
+            initial_backoff=self.config.pod_initial_backoff_seconds,
+            max_backoff=self.config.pod_max_backoff_seconds,
+            now=now,
+            pop_from_backoff_q=self.gates.enabled(SCHEDULER_POP_FROM_BACKOFF_Q),
+            gang_enabled=self.gates.enabled(GENERIC_WORKLOAD),
+        )
         # metrics
         self.attempts = 0
         self.scheduled = 0
@@ -143,6 +180,7 @@ class Scheduler:
         self.clientset.on_pod_event(self._on_pod_event)
         self.clientset.on_node_event(self._on_node_event)
         self.clientset.on_namespace_event(self.cache.add_namespace)
+        self.clientset.on_pod_group_event(self.queue.register_pod_group)
 
     def _responsible_for_pod(self, pod: Pod) -> bool:
         """eventhandlers.go responsibleForPod: only queue pods whose
@@ -212,25 +250,51 @@ class Scheduler:
         self.process_one(qpi)
         return True
 
-    def process_one(self, qpi: QueuedPodInfo) -> None:
+    def process_one(self, qpi) -> None:
         """One full scheduling+binding cycle for an already-popped entity."""
+        if isinstance(qpi, QueuedPodGroupInfo):
+            self.schedule_pod_group(qpi)
+            return
         pod = qpi.pod
         fw = self.framework_for_pod(pod)
         self.attempts += 1
+        t0 = time.perf_counter()
         state = CycleState()
         try:
             result = self.scheduling_cycle(fw, state, qpi)
         except FitError as fe:
+            # PostFilter (preemption): schedule_one.go:1152
+            # handleSchedulingFailure runs after RunPostFilterPlugins produced
+            # a nominating info (schedule_one.go:169 schedulingCycle tail).
+            if fw.post_filter_plugins:
+                result, post_st = fw.run_post_filter_plugins(
+                    state, pod, fe.diagnosis.node_to_status)
+                nominated = getattr(result, "nominating_info", None) if result else None
+                if post_st.is_success() and nominated:
+                    pod.nominated_node_name = nominated
+                    self.clientset.patch_pod_status(pod, nominated_node_name=nominated)
+                    self.queue.nominator.add_nominated_pod(qpi.pod_info, nominated)
             self.handle_scheduling_failure(fw, qpi, Status(UNSCHEDULABLE, (str(fe),)), fe.diagnosis)
             self.queue.done(pod.uid)
+            self.metrics.schedule_attempts.inc("unschedulable", fw.profile_name)
+            self.metrics.scheduling_attempt_duration.observe(
+                time.perf_counter() - t0, "unschedulable", fw.profile_name)
             return
         except Exception as e:  # noqa: BLE001
             self.error_log.append(f"{pod.namespace}/{pod.name}: {e!r}")
             self.handle_scheduling_failure(fw, qpi, Status.error(str(e)), None)
             self.queue.done(pod.uid)
+            self.metrics.schedule_attempts.inc("error", fw.profile_name)
             return
-        self.run_binding_cycle(fw, state, qpi, result)
+        bound = self.run_binding_cycle(fw, state, qpi, result)
         self.queue.done(pod.uid)
+        elapsed = time.perf_counter() - t0
+        self.metrics.schedule_attempts.inc("scheduled" if bound else "error", fw.profile_name)
+        self.metrics.scheduling_attempt_duration.observe(
+            elapsed, "scheduled" if bound else "error", fw.profile_name)
+        if bound and qpi.initial_attempt_timestamp is not None:
+            self.metrics.pod_scheduling_sli_duration.observe(
+                self.now() - qpi.initial_attempt_timestamp, str(qpi.attempts))
 
     def scheduling_cycle(self, fw: Framework, state: CycleState, qpi: QueuedPodInfo) -> ScheduleResult:
         pod = qpi.pod
@@ -253,6 +317,76 @@ class Scheduler:
             assumed.node_name = ""
             raise RuntimeError(f"permit rejected: {st.message()}")
         return result
+
+    # -- gang cycle (schedule_one_podgroup.go) -----------------------------
+
+    def schedule_pod_group(self, qgpi: QueuedPodGroupInfo) -> None:
+        """All-or-nothing group scheduling (scheduleOnePodGroup :81 →
+        podGroupCycle :428 → default algorithm :556): each member is placed
+        against the SNAPSHOT (assumed into the snapshot, not the cache,
+        schedule_one.go:1077-1082) with LIFO revert on any failure
+        (revertFns :50-75); success commits every member's binding cycle."""
+        self.attempts += 1
+        members = sorted(
+            qgpi.members,
+            key=lambda m: (-m.pod.priority, m.timestamp))
+        if not members:
+            self.queue.done(qgpi.uid)
+            return
+        fw = self.framework_for_pod(members[0].pod)
+        self.cache.update_snapshot(self.snapshot)
+
+        placed: List[Tuple[QueuedPodInfo, CycleState, ScheduleResult]] = []
+        failure: Optional[FitError] = None
+        for m in members:
+            state = CycleState()
+            try:
+                result = self.schedule_pod(fw, state, m.pod)
+            except FitError as fe:
+                failure = fe
+                qgpi.unschedulable_plugins |= fe.diagnosis.unschedulable_plugins
+                break
+            m.pod.node_name = result.suggested_host
+            self.snapshot.assume_pod(m.pod)  # simulate in-snapshot only
+            placed.append((m, state, result))
+
+        if failure is not None:
+            # LIFO revert: the snapshot returns to the pre-cycle view.
+            for m, _, _ in reversed(placed):
+                self.snapshot.forget_pod(m.pod)
+                m.pod.node_name = ""
+            self.failures += 1
+            qgpi.timestamp = self.now()
+            self.queue.add_unschedulable_if_not_present(qgpi)
+            self.queue.done(qgpi.uid)
+            self.metrics.podgroup_schedule_attempts.inc("unschedulable")
+            return
+
+        # Commit (submitPodGroupAlgorithmResult :812): assume into the cache
+        # and run each member's binding cycle. Every attempted member leaves
+        # the group buffer — commit failures are requeued individually
+        # (handle_scheduling_failure) and must not be double-tracked.
+        committed_uids = set()
+        attempted_uids = set()
+        for m, state, result in placed:
+            attempted_uids.add(m.pod.uid)
+            self.cache.assume_pod(m.pod)
+            st = fw.run_reserve_plugins_reserve(state, m.pod, result.suggested_host)
+            if st.is_success():
+                st = fw.run_permit_plugins(state, m.pod, result.suggested_host)
+            if not st.is_success():
+                fw.run_reserve_plugins_unreserve(state, m.pod, result.suggested_host)
+                self.cache.forget_pod(m.pod)
+                m.pod.node_name = ""
+                self.handle_scheduling_failure(fw, m, st, None)
+                continue
+            if self.run_binding_cycle(fw, state, m, result):
+                committed_uids.add(m.pod.uid)
+        group_key = (qgpi.group.namespace, qgpi.group.name)
+        self.queue.clear_group_members(group_key, attempted_uids)
+        self.queue.done(qgpi.uid)
+        self.metrics.podgroup_schedule_attempts.inc(
+            "scheduled" if committed_uids else "unschedulable")
 
     # -- schedulePod (schedule_one.go:572) ---------------------------------
 
@@ -399,6 +533,20 @@ class Scheduler:
         self.handle_scheduling_failure(fw, qpi, st, None)
 
     # -- failure (schedule_one.go:1152 handleSchedulingFailure) ------------
+
+    def update_pending_metrics(self) -> None:
+        """Refresh the pending_pods gauges (metrics.go pending_pods)."""
+        active, backoff, unsched = self.queue.pending_counts()
+        gated = sum(1 for q in self.queue.unschedulable.values() if q.gated)
+        self.metrics.pending_pods.set(active, "active")
+        self.metrics.pending_pods.set(backoff, "backoff")
+        self.metrics.pending_pods.set(unsched - gated, "unschedulable")
+        self.metrics.pending_pods.set(gated, "gated")
+
+    def expose_metrics(self) -> str:
+        """/metrics (app/server.go:376)."""
+        self.update_pending_metrics()
+        return self.metrics.expose()
 
     def handle_scheduling_failure(
         self, fw: Framework, qpi: QueuedPodInfo, status: Status, diagnosis: Optional[Diagnosis]

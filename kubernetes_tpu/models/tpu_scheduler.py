@@ -31,7 +31,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..core.framework import Framework
-from ..core.queue import QueuedPodInfo
+from ..core.queue import QueuedPodGroupInfo, QueuedPodInfo
 from ..core.scheduler import Scheduler, ScheduleResult
 from ..ops.device_state import NodeStateMirror
 from ..ops.features import Unsupported, batch_supported, build_batch
@@ -43,10 +43,12 @@ class TPUScheduler(Scheduler):
     path for uncovered features; host and device paths produce identical
     assignments (deterministic_ties is forced on)."""
 
-    def __init__(self, *args, max_batch: int = 1024, **kwargs):
+    def __init__(self, *args, max_batch: Optional[int] = None, **kwargs):
         kwargs.setdefault("deterministic_ties", True)
         super().__init__(*args, **kwargs)
-        self.max_batch = max_batch
+        from ..core.features import TPU_BATCH_SCHEDULING
+        self.device_enabled = self.gates.enabled(TPU_BATCH_SCHEDULING)
+        self.max_batch = max_batch if max_batch is not None else self.config.max_batch
         self.mirror = NodeStateMirror()
         self._holdover: Optional[QueuedPodInfo] = None
         # metrics
@@ -69,6 +71,10 @@ class TPUScheduler(Scheduler):
         head = self._pop()
         if head is None:
             return None, [], None
+        if isinstance(head, QueuedPodGroupInfo):
+            # Gang entities take the host group cycle (device gang batching
+            # is a later ring — SURVEY.md §7.7).
+            return self.framework_for_pod(head.pod), [head], "pod group entity"
         fw = self.framework_for_pod(head.pod)
         reason = batch_supported(
             head.pod, self.snapshot,
@@ -84,7 +90,8 @@ class TPUScheduler(Scheduler):
             nxt = self._pop()
             if nxt is None:
                 break
-            if (nxt.pod.scheduler_name == head.pod.scheduler_name
+            if (not isinstance(nxt, QueuedPodGroupInfo)
+                    and nxt.pod.scheduler_name == head.pod.scheduler_name
                     and fw.sign_pod(nxt.pod) == sig):
                 batch.append(nxt)
             else:
@@ -155,6 +162,26 @@ class TPUScheduler(Scheduler):
         state = self.mirror.flush()
         return state, plan
 
+    def warm_for(self, pod, batch_sizes: Optional[List[int]] = None) -> None:
+        """Compile the kernel shapes a workload of `pod`-shaped pods will hit,
+        WITHOUT scheduling anything: dispatches with n_active=0 are fully
+        inert (every scan step is padding). Benchmark harnesses call this so
+        XLA compilation lands outside the measured window."""
+        fw = self.framework_for_pod(pod)
+        if batch_supported(pod, self.snapshot,
+                           fit_plugin=fw.plugin("NodeResourcesFit")) is not None:
+            return
+        warmed = set()
+        for size in batch_sizes or (self.max_batch,):
+            state, plan = self.build_plan(fw, pod, size)
+            if plan.batch_pad in warmed:
+                continue
+            warmed.add(plan.batch_pad)
+            out = schedule_batch(
+                state, plan.features, plan.batch_pad, plan.fit_strategy,
+                plan.vmax, n_active=np.int32(0))
+            np.asarray(out[0])  # block until compiled + executed
+
     def schedule_batch_on_device(self, fw: Framework, batch: List[QueuedPodInfo]) -> None:
         pods = [q.pod for q in batch]
         state, plan = self.build_plan(fw, pods[0], len(pods))
@@ -165,6 +192,8 @@ class TPUScheduler(Scheduler):
         results = np.asarray(results)  # one device→host fetch
         chosen, starts = results[0, :n], results[1, :n]
         self.device_batches += 1
+        self.metrics.batch_attempts.inc("dispatched")
+        self.metrics.batch_size.observe(n)
 
         node_names = [ni.name for ni in self.snapshot.node_info_list]
         ok_rows: List[int] = []
@@ -239,6 +268,8 @@ class TPUScheduler(Scheduler):
     # -- run loop ----------------------------------------------------------
 
     def schedule_one(self) -> bool:
+        if not self.device_enabled:
+            return super().schedule_one()  # TPUBatchScheduling gate off
         fw, batch, fallback_reason = self._collect_batch()
         if not batch:
             return False

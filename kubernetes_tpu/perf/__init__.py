@@ -1,0 +1,12 @@
+"""scheduler_perf: the data-driven performance/integration harness.
+
+Re-expresses test/integration/scheduler_perf — YAML workloads executed by an
+opcode interpreter (scheduler_perf.go:64-80: createNodes, createPods,
+createPodGroups, churn, barrier, sleep, start/stopCollectingMetrics), with
+SchedulingThroughput Average/P50/P90/P95/P99 collectors (util.go:477,686-694)
+and per-workload thresholds (scheduler_perf.go:282-368).
+"""
+
+from .harness import PerfResult, Workload, load_config, run_workload
+
+__all__ = ["PerfResult", "Workload", "load_config", "run_workload"]

@@ -1,0 +1,56 @@
+"""CLI: run scheduler_perf workloads.
+
+    python -m kubernetes_tpu.perf                      # all [performance]
+    python -m kubernetes_tpu.perf --labels short       # CI subset
+    python -m kubernetes_tpu.perf --scale 0.1          # scaled-down
+    python -m kubernetes_tpu.perf --filter SchedulingBasic
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .harness import load_config, run_workload
+
+DEFAULT_CONFIG = os.path.join(os.path.dirname(__file__), "configs",
+                              "performance-config.yaml")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default=DEFAULT_CONFIG)
+    ap.add_argument("--labels", default="performance",
+                    help="comma-separated label filter")
+    ap.add_argument("--filter", default="", help="testcase/workload substring")
+    ap.add_argument("--scale", type=float, default=1.0)
+    args = ap.parse_args()
+
+    labels = set(args.labels.split(",")) if args.labels else set()
+    failed = 0
+    for wl in load_config(args.config, scale=args.scale):
+        if labels and not labels & set(wl.labels):
+            continue
+        full = f"{wl.testcase}/{wl.name}"
+        if args.filter and args.filter not in full:
+            continue
+        res = run_workload(wl)
+        ok = res.meets_thresholds()
+        failed += 0 if ok else 1
+        print(json.dumps({
+            "workload": full,
+            "ok": ok,
+            "scheduled": res.scheduled,
+            "failed_attempts": res.failed,
+            "elapsed_s": round(res.elapsed, 2),
+            "thresholds": wl.thresholds,
+            "metrics": {k: {kk: round(vv, 1) for kk, vv in v.items()}
+                        for k, v in res.metrics.items()},
+        }))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

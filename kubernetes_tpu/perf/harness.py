@@ -1,0 +1,299 @@
+"""The scheduler_perf opcode interpreter.
+
+Config format (mirrors test/integration/scheduler_perf/*/performance-config.yaml):
+
+    - name: SchedulingBasic
+      defaultPodTemplate: &pod
+        cpu: 100m
+        memory: 128Mi
+      workloadTemplate:
+      - opcode: createNodes
+        countParam: $nodes
+        nodeTemplate: {cpu: 32, memory: 256Gi, pods: 110, zones: 50}
+      - opcode: createPods
+        countParam: $measurePods
+        podTemplate: *pod
+        collectMetrics: true
+      workloads:
+      - name: 5000Nodes_10000Pods
+        labels: [performance]
+        params: {nodes: 5000, measurePods: 10000}
+        thresholds: {SchedulingThroughput: 680}
+
+Opcodes: createNodes, createPods, createPodGroups, churn, barrier, sleep,
+startCollectingMetrics/stopCollectingMetrics (scheduler_perf.go:64-80).
+`barrier` drains the scheduler, sampling throughput; createPods with
+collectMetrics wraps itself in start/barrier implicitly, as the reference
+does for measured pods.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import yaml
+
+from ..api.types import PodGroup
+from ..core.scheduler import Scheduler
+from ..testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+HOSTNAME = "kubernetes.io/hostname"
+
+
+@dataclass
+class Workload:
+    name: str
+    testcase: str
+    labels: List[str]
+    params: Dict[str, Any]
+    thresholds: Dict[str, float]
+    ops: List[Dict[str, Any]]
+    default_pod_template: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class PerfResult:
+    workload: Workload
+    scheduled: int = 0
+    failed: int = 0
+    elapsed: float = 0.0
+    metrics: Dict[str, Dict[str, float]] = field(default_factory=dict)
+
+    def meets_thresholds(self) -> bool:
+        """Thresholds gate `performance`-labeled runs only — the reference
+        asserts them on perf hardware, not on integration-test variants
+        (scheduler_perf.go:282-368 / misc/performance-config.yaml:1-19)."""
+        if "performance" not in self.workload.labels:
+            return True
+        for name, floor in self.workload.thresholds.items():
+            got = self.metrics.get(name, {}).get("Average", 0.0)
+            if got < floor:
+                return False
+        return True
+
+
+def load_config(path: str, scale: float = 1.0) -> List[Workload]:
+    """Load testcases → one Workload per (testcase, workload) pair.
+    `scale` multiplies every count param (CI runs scaled-down clusters;
+    thresholds scale linearly with the count scale)."""
+    with open(path) as f:
+        testcases = yaml.safe_load(f)
+    out: List[Workload] = []
+    for tc in testcases:
+        for wl in tc.get("workloads", ()):
+            params = dict(wl.get("params", {}))
+            if scale != 1.0:
+                params = {k: max(1, int(v * scale)) if isinstance(v, int) else v
+                          for k, v in params.items()}
+            thresholds = {
+                k: v * scale if scale != 1.0 else v
+                for k, v in wl.get("thresholds", {}).items()}
+            out.append(Workload(
+                name=wl["name"],
+                testcase=tc["name"],
+                labels=list(wl.get("labels", ())),
+                params=params,
+                thresholds=thresholds,
+                ops=tc.get("workloadTemplate", []),
+                default_pod_template=tc.get("defaultPodTemplate"),
+            ))
+    return out
+
+
+def _resolve_count(op: Dict[str, Any], params: Dict[str, Any]) -> int:
+    if "count" in op:
+        return int(op["count"])
+    ref = op.get("countParam", "")
+    return int(params[ref.lstrip("$")])
+
+
+class _ThroughputCollector:
+    """SchedulingThroughput (util.go:477): samples pods-scheduled per
+    interval while collecting; summarizes Average + percentiles."""
+
+    INTERVAL = 0.1
+
+    def __init__(self, sched: Scheduler):
+        self.sched = sched
+        self.samples: List[float] = []
+        self._last_t = 0.0
+        self._last_n = 0
+        self._t0 = 0.0
+        self._n0 = 0
+        self.active = False
+
+    def start(self) -> None:
+        self.active = True
+        self._t0 = self._last_t = time.perf_counter()
+        self._n0 = self._last_n = self.sched.scheduled
+
+    def tick(self) -> None:
+        if not self.active:
+            return
+        now = time.perf_counter()
+        if now - self._last_t >= self.INTERVAL:
+            rate = (self.sched.scheduled - self._last_n) / (now - self._last_t)
+            self.samples.append(rate)
+            self._last_t, self._last_n = now, self.sched.scheduled
+
+    def stop(self) -> Dict[str, float]:
+        self.active = False
+        elapsed = time.perf_counter() - self._t0
+        total = self.sched.scheduled - self._n0
+        avg = total / elapsed if elapsed > 0 else 0.0
+        s = sorted(self.samples) or [avg]
+
+        def pct(q: float) -> float:
+            return s[min(len(s) - 1, int(q * len(s)))]
+
+        return {"Average": avg, "Perc50": pct(0.50), "Perc90": pct(0.90),
+                "Perc95": pct(0.95), "Perc99": pct(0.99)}
+
+
+def _make_node_from_template(i: int, tpl: Dict[str, Any]):
+    zones = int(tpl.get("zones", 0))
+    b = make_node().name(f"node-{i}").capacity({
+        "cpu": tpl.get("cpu", 32),
+        "memory": tpl.get("memory", "256Gi"),
+        "pods": tpl.get("pods", 110),
+    })
+    if zones:
+        b = b.zone(f"zone-{i % zones}")
+    for k, v in tpl.get("labels", {}).items():
+        b = b.label(k, v)
+    for t in tpl.get("taints", ()):
+        b = b.taint(t["key"], t.get("value", ""), t.get("effect", "NoSchedule"))
+    return b.obj()
+
+
+def _make_pod_from_template(name: str, tpl: Dict[str, Any]):
+    b = make_pod().name(name).req({
+        "cpu": tpl.get("cpu", "100m"), "memory": tpl.get("memory", "128Mi")})
+    for k, v in tpl.get("labels", {}).items():
+        b = b.label(k, v)
+    if tpl.get("nodeSelector"):
+        b = b.node_selector(dict(tpl["nodeSelector"]))
+    for tol in tpl.get("tolerations", ()):
+        b = b.toleration(tol["key"], tol.get("value", ""),
+                         tol.get("operator", "Equal"), tol.get("effect", ""))
+    for c in tpl.get("topologySpreadConstraints", ()):
+        b = b.spread_constraint(
+            c.get("maxSkew", 1),
+            c.get("topologyKey", ZONE),
+            c.get("whenUnsatisfiable", "DoNotSchedule"),
+            c.get("labelSelector", tpl.get("labels", {})))
+    aff = tpl.get("podAntiAffinity")
+    if aff:
+        b = b.pod_affinity(aff.get("topologyKey", HOSTNAME),
+                           aff.get("matchLabels", tpl.get("labels", {})),
+                           anti=True, weight=aff.get("weight", 0))
+    aff = tpl.get("podAffinity")
+    if aff:
+        b = b.pod_affinity(aff.get("topologyKey", ZONE),
+                           aff.get("matchLabels", tpl.get("labels", {})),
+                           weight=aff.get("weight", 0))
+    if tpl.get("priority"):
+        b = b.priority(int(tpl["priority"]))
+    pod = b.obj()
+    if tpl.get("podGroup"):
+        pod.pod_group = tpl["podGroup"]
+    return pod
+
+
+def _drain(sched: Scheduler, collector: _ThroughputCollector, max_cycles: int = 10_000_000) -> None:
+    """barrier opcode: drive scheduling until the queue stops yielding."""
+    n = 0
+    while n < max_cycles:
+        progressed = sched.schedule_one()
+        collector.tick()
+        if not progressed:
+            sched.queue.flush_backoff_completed()
+            if not sched.schedule_one():
+                break
+        n += 1
+
+
+def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
+    """Execute one workload's opcode list (the RunBenchmarkPerfScheduling
+    inner loop, scheduler_perf.go:282+)."""
+    from ..models.tpu_scheduler import TPUScheduler
+
+    sched = sched or TPUScheduler()
+    cs = sched.clientset
+    collector = _ThroughputCollector(sched)
+    params = wl.params
+    pod_seq = 0
+    result = PerfResult(workload=wl)
+    t0 = time.perf_counter()
+
+    for op in wl.ops:
+        opcode = op["opcode"]
+        if opcode == "createNodes":
+            count = _resolve_count(op, params)
+            tpl = op.get("nodeTemplate", {})
+            for i in range(count):
+                cs.create_node(_make_node_from_template(i, tpl))
+        elif opcode == "createPods":
+            count = _resolve_count(op, params)
+            tpl = op.get("podTemplate") or wl.default_pod_template or {}
+            collect = bool(op.get("collectMetrics"))
+            if collect:
+                # Compile the kernel shapes outside the measured window
+                # (the reference's measured runs start against a warm
+                # scheduler process; XLA compilation is our cold-start).
+                warm = getattr(sched, "warm_for", None)
+                if warm is not None:
+                    mb = getattr(sched, "max_batch", count)
+                    sizes = [min(count, mb)]
+                    if count > mb and count % mb:
+                        sizes.append(count % mb)
+                    warm(_make_pod_from_template("warm-template", tpl),
+                         batch_sizes=sizes)
+                collector.start()
+            for i in range(count):
+                cs.create_pod(_make_pod_from_template(f"pod-{pod_seq}", tpl))
+                pod_seq += 1
+            _drain(sched, collector)
+            if collect:
+                result.metrics["SchedulingThroughput"] = collector.stop()
+        elif opcode == "createPodGroups":
+            count = _resolve_count(op, params)
+            size = int(op.get("groupSize", 2))
+            tpl = dict(op.get("podTemplate") or wl.default_pod_template or {})
+            for g in range(count):
+                name = f"group-{g}"
+                cs.create_pod_group(PodGroup(name=name, min_count=size))
+                tpl_g = dict(tpl, podGroup=name)
+                for i in range(size):
+                    cs.create_pod(_make_pod_from_template(f"pod-{pod_seq}", tpl_g))
+                    pod_seq += 1
+            _drain(sched, collector)
+        elif opcode == "churn":
+            # simplified: n create→schedule→delete rounds (scheduler_perf.go:72)
+            rounds = int(op.get("number", 10))
+            tpl = op.get("podTemplate") or wl.default_pod_template or {}
+            for i in range(rounds):
+                p = _make_pod_from_template(f"churn-{i}", tpl)
+                cs.create_pod(p)
+                _drain(sched, collector)
+                cs.delete_pod(p)
+        elif opcode == "barrier":
+            _drain(sched, collector)
+        elif opcode == "sleep":
+            time.sleep(float(op.get("duration", 0.1)))
+        elif opcode == "startCollectingMetrics":
+            collector.start()
+        elif opcode == "stopCollectingMetrics":
+            result.metrics["SchedulingThroughput"] = collector.stop()
+        else:
+            raise ValueError(f"unknown opcode {opcode!r}")
+
+    result.elapsed = time.perf_counter() - t0
+    result.scheduled = sched.scheduled
+    result.failed = sched.failures
+    # in-flight invariant (scheduler_perf.go:878-880 checkEmptyInFlightEvents)
+    assert not sched.queue._in_flight, "in-flight events remain after workload"
+    return result

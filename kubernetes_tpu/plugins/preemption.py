@@ -1,0 +1,221 @@
+"""Preemption: the DefaultPreemption PostFilter plugin + the dry-run
+Evaluator.
+
+Reference anchors:
+- pkg/scheduler/framework/preemption/preemption.go — Evaluator.Preempt :181,
+  findCandidates :201, DryRunPreemption :425 (per-node victim simulation),
+  SelectCandidate / pickOneNodeForPreemption :286;
+- plugins/defaultpreemption/default_preemption.go — PostFilter → Evaluator,
+  victim ordering (lower priority first, then earlier start later),
+  PodEligibleToPreemptOthers;
+- async victim deletion (executor.go:171) is synchronous here; the
+  APIDispatcher integration arrives with the async-writes subsystem.
+
+The dry run is the host-side "what-if" path; its device-batched analogue
+(DryRunPreemption as a second kernel, SURVEY.md §7.7) can replace the inner
+loop later without changing this control flow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api.types import Pod
+from ..core.framework import (
+    OK,
+    CycleState,
+    Status,
+    UNSCHEDULABLE,
+    UNSCHEDULABLE_AND_UNRESOLVABLE,
+)
+from ..core.node_info import NodeInfo, PodInfo
+
+
+@dataclass
+class Candidate:
+    """One feasible preemption plan (preemption.go candidate)."""
+
+    node_name: str
+    victims: List[PodInfo] = field(default_factory=list)
+    num_pdb_violations: int = 0
+
+
+@dataclass
+class PostFilterResult:
+    nominating_info: Optional[str] = None  # nominated node name
+
+
+class Evaluator:
+    """Preemption dry-run machinery (preemption.go Evaluator)."""
+
+    def __init__(self, handle, framework):
+        self.handle = handle
+        self.fw = framework
+
+    # -- eligibility (default_preemption.go PodEligibleToPreemptOthers) ----
+
+    def pod_eligible(self, pod: Pod, snapshot) -> Tuple[bool, str]:
+        if pod.preemption_policy == "Never":
+            return False, "not eligible due to preemptionPolicy=Never"
+        if pod.nominated_node_name:
+            ni = snapshot.get(pod.nominated_node_name)
+            if ni is not None:
+                # A lower-priority pod already terminating on the nominated
+                # node means preemption is in flight: don't preempt again.
+                for pi in ni.pods:
+                    if pi.pod.priority < pod.priority and pi.pod.deletion_ts is not None:
+                        return False, "a terminating victim already exists on the nominated node"
+        return True, ""
+
+    # -- per-node dry run (preemption.go DryRunPreemption / SimulatePreemption)
+
+    def dry_run_on_node(
+        self, state: CycleState, pod: Pod, node_info: NodeInfo
+    ) -> Optional[Candidate]:
+        """Can `pod` fit on this node after evicting some lower-priority pods?
+        Returns the minimal victim set (reprieve pass), or None."""
+        ni = node_info.snapshot_clone()
+        sim_state = state.clone()
+        potential = [pi for pi in ni.pods if pi.pod.priority < pod.priority]
+        if not potential:
+            return None
+
+        def remove_pod(pi: PodInfo) -> bool:
+            if not ni.remove_pod(pi.pod):
+                return False
+            for p in self.fw.pre_filter_plugins:
+                fn = getattr(p, "remove_pod", None)
+                if fn is not None and not fn(sim_state, pod, pi, ni).is_success():
+                    return False
+            return True
+
+        def add_pod(pi: PodInfo) -> bool:
+            ni.add_pod(pi)
+            for p in self.fw.pre_filter_plugins:
+                fn = getattr(p, "add_pod", None)
+                if fn is not None and not fn(sim_state, pod, pi, ni).is_success():
+                    return False
+            return True
+
+        for pi in potential:
+            if not remove_pod(pi):
+                return None
+        st = self.fw.run_filter_plugins(sim_state, pod, ni)
+        if not st.is_success():
+            return None
+
+        # Reprieve: re-add victims most-important first — higher priority,
+        # then EARLIER start time (MoreImportantPod; preemption.go:480-520) —
+        # keeping those that still fit.
+        potential.sort(key=lambda pi: (-pi.pod.priority, pi.pod.creation_ts))
+        victims: List[PodInfo] = []
+        for pi in potential:
+            if not add_pod(pi):
+                return None
+            st = self.fw.run_filter_plugins(sim_state, pod, ni)
+            if not st.is_success():
+                # can't keep it: evict for real
+                if not remove_pod(pi):
+                    return None
+                victims.append(pi)
+        if not victims:
+            return None  # pod fit without evicting anyone — not a preemption
+        return Candidate(node_name=ni.name, victims=victims)
+
+    def find_candidates(
+        self, state: CycleState, pod: Pod, node_to_status: Dict[str, Status]
+    ) -> List[Candidate]:
+        snapshot = self.handle.snapshot() if callable(self.handle.snapshot) else self.handle.snapshot
+        candidates = []
+        for ni in snapshot.node_info_list:
+            st = node_to_status.get(ni.name)
+            # Unresolvable rejections can't be fixed by evicting pods
+            # (preemption.go nodesWherePreemptionMightHelp).
+            if st is not None and st.code == UNSCHEDULABLE_AND_UNRESOLVABLE:
+                continue
+            cand = self.dry_run_on_node(state, pod, ni)
+            if cand is not None:
+                candidates.append(cand)
+        return candidates
+
+    # -- selection (preemption.go pickOneNodeForPreemption) ----------------
+
+    @staticmethod
+    def select_candidate(candidates: List[Candidate]) -> Optional[Candidate]:
+        if not candidates:
+            return None
+        if len(candidates) == 1:
+            return candidates[0]
+
+        def key(c: Candidate):
+            highest = max(pi.pod.priority for pi in c.victims)
+            prio_sum = sum(pi.pod.priority for pi in c.victims)
+            latest_start = max(pi.pod.creation_ts for pi in c.victims)
+            return (
+                c.num_pdb_violations,   # fewest PDB violations
+                highest,                # lowest highest-victim priority
+                prio_sum,               # lowest priority sum
+                len(c.victims),         # fewest victims
+                -latest_start,          # latest victim start time survives
+            )
+
+        return min(candidates, key=key)
+
+    # -- commit (preemption.go prepareCandidate) ---------------------------
+
+    def prepare_candidate(self, cand: Candidate, pod: Pod) -> None:
+        cs = self.handle.clientset
+        for pi in cand.victims:
+            cs.delete_pod(pi.pod)
+        # Lower-priority pods nominated to this node lose their nomination
+        # (preemption.go prepareCandidate → ClearNominatedNodeName).
+        nominator = getattr(self.handle, "nominator", None)
+        if nominator is not None:
+            for pi in list(nominator.nominated_pods_for_node(cand.node_name)):
+                if pi.pod.priority < pod.priority:
+                    nominator.delete_nominated_pod(pi.pod)
+                    pi.pod.nominated_node_name = ""
+
+
+class DefaultPreemption:
+    """plugins/defaultpreemption — PostFilter extension point."""
+
+    name = "DefaultPreemption"
+
+    def __init__(self, handle=None, framework=None):
+        self.handle = handle
+        self._evaluator: Optional[Evaluator] = None
+        self._framework = framework
+
+    def set_framework(self, fw) -> None:
+        self._framework = fw
+        self._evaluator = None
+
+    @property
+    def evaluator(self) -> Evaluator:
+        if self._evaluator is None:
+            self._evaluator = Evaluator(self.handle, self._framework)
+        return self._evaluator
+
+    def post_filter(
+        self, state: CycleState, pod: Pod, filtered_status_map: Dict[str, Status]
+    ) -> Tuple[Optional[PostFilterResult], Status]:
+        snapshot = self.handle.snapshot() if callable(self.handle.snapshot) else self.handle.snapshot
+        ok, msg = self.evaluator.pod_eligible(pod, snapshot)
+        if not ok:
+            return None, Status.unresolvable(f"preemption: {msg}")
+        metrics = getattr(self.handle, "metrics", None)
+        if metrics is not None:
+            metrics.preemption_attempts.inc()
+        candidates = self.evaluator.find_candidates(state, pod, filtered_status_map)
+        if not candidates:
+            return None, Status.unresolvable(
+                "preemption: 0/%d nodes are available" % max(1, snapshot.num_nodes()))
+        best = self.evaluator.select_candidate(candidates)
+        self.evaluator.prepare_candidate(best, pod)
+        if metrics is not None:
+            metrics.preemption_victims.observe(len(best.victims))
+        # Success: the scheduler records the nomination and requeues
+        # (preemption.go Preempt returns Success + nominated node).
+        return PostFilterResult(nominating_info=best.node_name), OK

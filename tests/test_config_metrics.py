@@ -1,0 +1,119 @@
+"""ComponentConfig, feature gates, and metrics (SURVEY.md §5)."""
+
+import pytest
+
+from kubernetes_tpu.core.config import PluginSet, ProfileConfig, SchedulerConfiguration
+from kubernetes_tpu.core.features import (
+    FeatureGates,
+    GENERIC_WORKLOAD,
+    TPU_BATCH_SCHEDULING,
+    TPU_STATE_RESIDENCY,
+)
+from kubernetes_tpu.core.scheduler import Scheduler
+from kubernetes_tpu.models.tpu_scheduler import TPUScheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+class TestFeatureGates:
+    def test_defaults(self):
+        g = FeatureGates()
+        assert g.enabled(GENERIC_WORKLOAD)
+        assert g.enabled(TPU_BATCH_SCHEDULING)
+
+    def test_override_and_unknown(self):
+        g = FeatureGates({TPU_BATCH_SCHEDULING: False, TPU_STATE_RESIDENCY: False})
+        assert not g.enabled(TPU_BATCH_SCHEDULING)
+        with pytest.raises(ValueError):
+            FeatureGates({"NoSuchGate": True})
+
+    def test_dependency_validation(self):
+        with pytest.raises(ValueError):
+            FeatureGates({TPU_BATCH_SCHEDULING: False})  # residency depends on it
+
+
+class TestComponentConfig:
+    def test_plugin_set_resolve(self):
+        ps = PluginSet(enabled=(("TaintToleration", 5),), disabled=("ImageLocality",))
+        resolved = dict(ps.resolve())
+        assert resolved["TaintToleration"] == 5
+        assert "ImageLocality" not in resolved
+
+    def test_from_dict_profile(self):
+        cfg = SchedulerConfiguration.from_dict({
+            "profiles": [{
+                "schedulerName": "custom",
+                "plugins": {"disabled": ["InterPodAffinity"]},
+                "pluginConfig": [
+                    {"name": "NodeResourcesFit",
+                     "args": {"scoring_strategy": "MostAllocated"}}],
+            }],
+            "percentageOfNodesToScore": 20,
+            "featureGates": {"GenericWorkload": True},
+        })
+        s = Scheduler(config=cfg)
+        assert "custom" in s.profiles
+        fw = s.profiles["custom"]
+        assert fw.plugin("InterPodAffinity") is None
+        assert fw.plugin("NodeResourcesFit").scoring_strategy == "MostAllocated"
+        assert s.percentage_of_nodes_to_score == 20
+
+    def test_custom_profile_schedules(self):
+        cfg = SchedulerConfiguration.from_dict({
+            "profiles": [{"schedulerName": "custom"}]})
+        s = Scheduler(config=cfg)
+        s.clientset.create_node(
+            make_node().name("n0").capacity({"cpu": "4", "pods": 10}).obj())
+        p = make_pod().name("p").req({"cpu": "1"}).scheduler_name("custom").obj()
+        s.clientset.create_pod(p)
+        s.run_until_idle()
+        assert s.scheduled == 1
+
+    def test_device_gate_off_uses_host_path(self):
+        cfg = SchedulerConfiguration.from_dict({
+            "featureGates": {"TPUBatchScheduling": False,
+                             "TPUStateResidency": False}})
+        s = TPUScheduler(config=cfg)
+        s.clientset.create_node(
+            make_node().name("n0").capacity({"cpu": "4", "pods": 10}).obj())
+        s.clientset.create_pod(make_pod().name("p").req({"cpu": "1"}).obj())
+        s.run_until_idle()
+        assert s.scheduled == 1
+        assert s.device_batches == 0
+
+
+class TestMetrics:
+    def test_schedule_attempt_series(self):
+        s = Scheduler()
+        s.clientset.create_node(
+            make_node().name("n0").capacity({"cpu": "2", "pods": 10}).obj())
+        s.clientset.create_pod(make_pod().name("fits").req({"cpu": "1"}).obj())
+        s.clientset.create_pod(make_pod().name("huge").req({"cpu": "64"}).obj())
+        s.run_until_idle()
+        m = s.metrics
+        assert m.schedule_attempts.value("scheduled", "default-scheduler") == 1
+        assert m.schedule_attempts.value("unschedulable", "default-scheduler") >= 1
+        assert m.scheduling_attempt_duration.count("scheduled", "default-scheduler") == 1
+        text = s.expose_metrics()
+        assert "scheduler_schedule_attempts_total" in text
+        assert 'scheduler_pending_pods{queue="unschedulable"}' in text
+
+    def test_preemption_metrics(self):
+        s = Scheduler()
+        s.clientset.create_node(
+            make_node().name("n0").capacity({"cpu": "2", "pods": 10}).obj())
+        s.clientset.create_pod(make_pod().name("low").req({"cpu": "2"}).priority(1).obj())
+        s.run_until_idle()
+        s.clientset.create_pod(make_pod().name("hi").req({"cpu": "2"}).priority(9).obj())
+        s.run_until_idle()
+        assert s.metrics.preemption_attempts.value() >= 1
+        assert s.metrics.preemption_victims.count() == 1
+
+    def test_batch_metrics(self):
+        s = TPUScheduler()
+        s.clientset.create_node(
+            make_node().name("n0").capacity({"cpu": "8", "pods": 20}).obj())
+        for i in range(5):
+            s.clientset.create_pod(make_pod().name(f"p{i}").req({"cpu": "1"}).obj())
+        s.run_until_idle()
+        assert s.metrics.batch_attempts.value("dispatched") >= 1
+        assert s.metrics.batch_size.count() >= 1

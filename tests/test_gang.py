@@ -1,0 +1,87 @@
+"""Gang (PodGroup) scheduling: all-or-nothing group cycles with snapshot
+simulation and LIFO revert (reference schedule_one_podgroup.go)."""
+
+from kubernetes_tpu.api.types import PodGroup
+from kubernetes_tpu.core.scheduler import Scheduler
+from kubernetes_tpu.models.tpu_scheduler import TPUScheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _nodes(s, n, cpu="4"):
+    for i in range(n):
+        s.clientset.create_node(
+            make_node().name(f"node-{i}")
+            .capacity({"cpu": cpu, "memory": "8Gi", "pods": 10}).obj())
+
+
+def _group_pods(s, name, count, cpu="1"):
+    for i in range(count):
+        p = make_pod().name(f"{name}-{i}").req({"cpu": cpu}).obj()
+        p.pod_group = name
+        s.clientset.create_pod(p)
+
+
+class TestGangScheduling:
+    def test_group_waits_for_min_count(self):
+        s = Scheduler()
+        _nodes(s, 2)
+        s.clientset.create_pod_group(PodGroup(name="gang", min_count=3))
+        _group_pods(s, "gang", 2)
+        s.run_until_idle()
+        assert s.scheduled == 0  # only 2 of 3 members present
+        _group_pods_extra = make_pod().name("gang-late").req({"cpu": "1"}).obj()
+        _group_pods_extra.pod_group = "gang"
+        s.clientset.create_pod(_group_pods_extra)
+        s.run_until_idle()
+        assert s.scheduled == 3
+
+    def test_all_or_nothing_revert(self):
+        """Group needing more capacity than exists schedules NO members."""
+        s = Scheduler()
+        _nodes(s, 1, cpu="2")
+        s.clientset.create_pod_group(PodGroup(name="big", min_count=3))
+        _group_pods(s, "big", 3, cpu="1")  # needs 3 cpu, node has 2
+        s.run_until_idle()
+        assert s.scheduled == 0
+        assert not s.clientset.bindings
+        # Snapshot must be clean: a fitting individual pod still schedules.
+        s.clientset.create_pod(make_pod().name("solo").req({"cpu": "2"}).obj())
+        s.run_until_idle()
+        assert len(s.clientset.bindings) == 1
+
+    def test_group_schedules_atomically(self):
+        s = Scheduler()
+        _nodes(s, 3, cpu="2")
+        s.clientset.create_pod_group(PodGroup(name="trio", min_count=3))
+        _group_pods(s, "trio", 3, cpu="2")
+        s.run_until_idle()
+        assert s.scheduled == 3
+        nodes_used = set(s.clientset.bindings.values())
+        assert len(nodes_used) == 3  # one full node each
+
+    def test_group_retry_after_node_add(self):
+        s = Scheduler()
+        _nodes(s, 1, cpu="2")
+        s.clientset.create_pod_group(PodGroup(name="pair", min_count=2))
+        _group_pods(s, "pair", 2, cpu="2")
+        s.run_until_idle()
+        assert s.scheduled == 0
+        _nodes_extra = make_node().name("node-extra").capacity(
+            {"cpu": "2", "memory": "8Gi", "pods": 10}).obj()
+        s.clientset.create_node(_nodes_extra)
+        s.run_until_idle()
+        assert s.scheduled == 2
+
+    def test_gang_through_tpu_scheduler(self):
+        """Gang entities fall back to the host group cycle in the device
+        pipeline; plain pods still batch on device."""
+        s = TPUScheduler()
+        _nodes(s, 3, cpu="4")
+        s.clientset.create_pod_group(PodGroup(name="g", min_count=2))
+        _group_pods(s, "g", 2, cpu="1")
+        for i in range(4):
+            s.clientset.create_pod(
+                make_pod().name(f"plain-{i}").req({"cpu": "1"}).obj())
+        s.run_until_idle()
+        assert s.scheduled == 6
+        assert s.device_scheduled >= 4

@@ -1,0 +1,45 @@
+"""The scheduler_perf harness doubles as integration tests via label filters
+(reference misc/performance-config.yaml:1-19)."""
+
+import os
+
+import pytest
+
+from kubernetes_tpu.perf import load_config, run_workload
+
+CONFIG = os.path.join(os.path.dirname(__file__), "..", "kubernetes_tpu",
+                      "perf", "configs", "performance-config.yaml")
+
+
+def _short_workloads():
+    return [wl for wl in load_config(CONFIG)
+            if "integration-test" in wl.labels and "short" in wl.labels]
+
+
+@pytest.mark.parametrize("wl", _short_workloads(),
+                         ids=lambda wl: f"{wl.testcase}/{wl.name}")
+def test_short_workload(wl):
+    res = run_workload(wl)
+    # Every measured pod must land (these configs are satisfiable).
+    assert res.failed == 0 or wl.testcase == "PreemptionAsync"
+    assert res.scheduled > 0
+    assert "SchedulingThroughput" in res.metrics
+    # CPU-mode smoke thresholds are intentionally loose; the perf labels run
+    # full-scale on TPU with the reference floors.
+    assert res.metrics["SchedulingThroughput"]["Average"] > 0
+
+
+def test_all_performance_workloads_parse():
+    wls = load_config(CONFIG)
+    names = {f"{w.testcase}/{w.name}" for w in wls}
+    assert "SchedulingBasic/5000Nodes_10000Pods" in names
+    assert "SchedulingGangs/1000Nodes_250Groups" in names
+    for w in wls:
+        assert w.ops, f"{w.name} has no ops"
+
+
+def test_scale_param():
+    wls = [w for w in load_config(CONFIG, scale=0.01)
+           if w.testcase == "SchedulingBasic" and w.name == "5000Nodes_10000Pods"]
+    assert wls[0].params["nodes"] == 50
+    assert wls[0].thresholds["SchedulingThroughput"] == pytest.approx(6.8)

@@ -1,0 +1,124 @@
+"""Preemption: DefaultPreemption PostFilter + dry-run Evaluator
+(reference framework/preemption/preemption.go, defaultpreemption/).
+"""
+
+from kubernetes_tpu.core.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _small_cluster(sched, n=2, cpu="2"):
+    for i in range(n):
+        sched.clientset.create_node(
+            make_node().name(f"node-{i}").capacity({"cpu": cpu, "memory": "4Gi", "pods": 10}).obj())
+
+
+class TestPreemption:
+    def test_high_priority_pod_preempts(self):
+        s = Scheduler(deterministic_ties=True)
+        _small_cluster(s, n=2, cpu="2")
+        # Fill both nodes with low-priority pods.
+        for i in range(2):
+            s.clientset.create_pod(
+                make_pod().name(f"low-{i}").req({"cpu": "2"}).priority(1).obj())
+        s.run_until_idle()
+        assert s.scheduled == 2
+        # High-priority pod doesn't fit anywhere → must preempt.
+        high = make_pod().name("high").req({"cpu": "2"}).priority(100).obj()
+        s.clientset.create_pod(high)
+        s.run_until_idle()
+        bound = {s.clientset.pods[u].name: n for u, n in s.clientset.bindings.items()
+                 if u in s.clientset.pods}
+        assert "high" in bound, f"high-priority pod not scheduled: {bound}"
+        # Exactly one victim was deleted.
+        remaining = {p.name for p in s.clientset.pods.values()}
+        assert len(remaining & {"low-0", "low-1"}) == 1
+        assert high.nominated_node_name  # nomination recorded
+
+    def test_no_preemption_when_policy_never(self):
+        s = Scheduler(deterministic_ties=True)
+        _small_cluster(s, n=1, cpu="2")
+        s.clientset.create_pod(
+            make_pod().name("low").req({"cpu": "2"}).priority(1).obj())
+        s.run_until_idle()
+        never = make_pod().name("never").req({"cpu": "2"}).priority(100).obj()
+        never.preemption_policy = "Never"
+        s.clientset.create_pod(never)
+        s.run_until_idle()
+        assert {p.name for p in s.clientset.pods.values()} == {"low", "never"}
+        assert "never" not in {
+            s.clientset.pods[u].name for u in s.clientset.bindings
+            if u in s.clientset.pods}
+
+    def test_no_preemption_of_equal_priority(self):
+        s = Scheduler(deterministic_ties=True)
+        _small_cluster(s, n=1, cpu="2")
+        s.clientset.create_pod(
+            make_pod().name("peer").req({"cpu": "2"}).priority(50).obj())
+        s.run_until_idle()
+        s.clientset.create_pod(
+            make_pod().name("same").req({"cpu": "2"}).priority(50).obj())
+        s.run_until_idle()
+        assert {p.name for p in s.clientset.pods.values()} == {"peer", "same"}
+
+    def test_minimal_victim_set(self):
+        """Reprieve keeps pods that don't need to die: two 1-cpu victims,
+        incoming needs 1 cpu → only one is evicted."""
+        s = Scheduler(deterministic_ties=True)
+        _small_cluster(s, n=1, cpu="2")
+        for i in range(2):
+            s.clientset.create_pod(
+                make_pod().name(f"small-{i}").req({"cpu": "1"}).priority(1).obj())
+        s.run_until_idle()
+        assert s.scheduled == 2
+        s.clientset.create_pod(
+            make_pod().name("high").req({"cpu": "1"}).priority(100).obj())
+        s.run_until_idle()
+        names = {p.name for p in s.clientset.pods.values()}
+        assert "high" in names
+        assert len(names & {"small-0", "small-1"}) == 1  # exactly one victim
+
+    def test_picks_lowest_priority_victims(self):
+        """Candidate selection prefers the node whose victims have the lowest
+        highest-priority (pickOneNodeForPreemption)."""
+        s = Scheduler(deterministic_ties=True)
+        _small_cluster(s, n=2, cpu="2")
+        s.clientset.create_pod(
+            make_pod().name("mid").req({"cpu": "2"}).priority(10)
+            .node_selector({}).obj())
+        s.run_until_idle()
+        # Force placement of second pod on the other node.
+        s.clientset.create_pod(
+            make_pod().name("lowest").req({"cpu": "2"}).priority(1).obj())
+        s.run_until_idle()
+        assert s.scheduled == 2
+        s.clientset.create_pod(
+            make_pod().name("high").req({"cpu": "2"}).priority(100).obj())
+        s.run_until_idle()
+        names = {p.name for p in s.clientset.pods.values()}
+        assert "high" in names
+        assert "mid" in names, "should have preempted the lowest-priority victim"
+        assert "lowest" not in names
+
+    def test_preemption_with_spread_constraints_prefilter_state(self):
+        """AddPod/RemovePod PreFilter extensions keep spread state coherent
+        during dry runs."""
+        s = Scheduler(deterministic_ties=True)
+        for i in range(2):
+            s.clientset.create_node(
+                make_node().name(f"node-{i}")
+                .capacity({"cpu": "2", "memory": "4Gi", "pods": 10})
+                .zone(f"z{i}").obj())
+        for i in range(2):
+            s.clientset.create_pod(
+                make_pod().name(f"low-{i}").req({"cpu": "2"}).priority(1)
+                .labels({"app": "w"}).obj())
+        s.run_until_idle()
+        p = (make_pod().name("spread").req({"cpu": "1"}).priority(100)
+             .labels({"app": "w"})
+             .spread_constraint(1, "topology.kubernetes.io/zone",
+                                "DoNotSchedule", {"app": "w"}).obj())
+        s.clientset.create_pod(p)
+        s.run_until_idle()
+        assert "spread" in {
+            s.clientset.pods[u].name for u in s.clientset.bindings
+            if u in s.clientset.pods}
