@@ -1,0 +1,84 @@
+"""Dynamic Resource Allocation (DRA) API objects — structured parameters.
+
+Scheduling-relevant slices of resource.k8s.io/v1 (reference:
+staging/src/k8s.io/dynamic-resource-allocation, 33.1k LoC;
+plugins/dynamicresources/ 2152 LoC core): ResourceSlice publishes a node's
+devices, ResourceClaim requests devices by class/selector, DeviceClass names
+a device category. The reference's CEL device selectors are expressed here as
+attribute equality maps (the dominant production shape); CEL itself is out of
+scope for the scheduler's hot path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .types import _next_uid
+
+
+@dataclass
+class Device:
+    name: str
+    attributes: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceSlice:
+    """resource.k8s.io ResourceSlice: one node's devices for one driver."""
+
+    node_name: str
+    driver: str
+    devices: List[Device] = field(default_factory=list)
+
+
+@dataclass
+class DeviceClass:
+    """DeviceClass: a named device category; `selectors` are attribute
+    equality requirements every matching device must satisfy."""
+
+    name: str
+    selectors: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class DeviceRequest:
+    """One request inside a claim (spec.devices.requests[*])."""
+
+    name: str = "req"
+    device_class: str = ""
+    count: int = 1
+    selectors: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class AllocatedDevice:
+    driver: str
+    device: str
+
+    def key(self) -> Tuple[str, str]:
+        return (self.driver, self.device)
+
+
+@dataclass
+class ResourceClaim:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    requests: List[DeviceRequest] = field(default_factory=list)
+    # status
+    allocated_node: str = ""                      # "" = unallocated
+    allocations: List[AllocatedDevice] = field(default_factory=list)
+    reserved_for: List[str] = field(default_factory=list)  # pod uids
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = _next_uid("claim")
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @property
+    def allocated(self) -> bool:
+        return bool(self.allocated_node)

@@ -1,0 +1,88 @@
+"""Storage API objects the scheduler consumes.
+
+Scheduling-relevant slices of core/v1 PersistentVolume / PersistentVolumeClaim
+and storage.k8s.io/v1 StorageClass + CSINode (reference:
+staging/src/k8s.io/api/core/v1/types.go, storage/v1/types.go) — the inputs to
+the VolumeBinding / NodeVolumeLimits / VolumeZone / VolumeRestrictions
+plugins (pkg/scheduler/framework/plugins/volumebinding, nodevolumelimits, ...).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .resource import to_int
+from .types import NodeSelector, _next_uid
+
+# volumeBindingMode (storage/v1/types.go)
+IMMEDIATE = "Immediate"
+WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
+
+# access modes
+RWO = "ReadWriteOnce"
+ROX = "ReadOnlyMany"
+RWX = "ReadWriteMany"
+RWOP = "ReadWriteOncePod"
+
+
+@dataclass
+class StorageClass:
+    name: str = ""
+    provisioner: str = ""
+    volume_binding_mode: str = IMMEDIATE
+    allowed_topologies: Optional[NodeSelector] = None
+
+
+@dataclass
+class PersistentVolume:
+    name: str = ""
+    uid: str = ""
+    capacity: int = 0                    # bytes
+    access_modes: Tuple[str, ...] = (RWO,)
+    storage_class: str = ""
+    node_affinity: Optional[NodeSelector] = None  # pv.spec.nodeAffinity.required
+    labels: Dict[str, str] = field(default_factory=dict)
+    claim_ref: str = ""                  # "ns/name" of bound PVC ("" = available)
+    csi_driver: str = ""                 # spec.csi.driver ("" = non-CSI)
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = _next_uid("pv")
+
+    @classmethod
+    def of(cls, name: str, capacity, **kw) -> "PersistentVolume":
+        return cls(name=name, capacity=to_int(capacity), **kw)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    request: int = 0                     # bytes
+    access_modes: Tuple[str, ...] = (RWO,)
+    storage_class: str = ""
+    volume_name: str = ""                # bound PV ("" = pending)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = _next_uid("pvc")
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @classmethod
+    def of(cls, name: str, request, **kw) -> "PersistentVolumeClaim":
+        return cls(name=name, request=to_int(request), **kw)
+
+
+@dataclass
+class CSINode:
+    """storage/v1 CSINode: per-node driver attach limits
+    (nodevolumelimits/csi.go reads .spec.drivers[].allocatable.count)."""
+
+    node_name: str = ""
+    driver_limits: Dict[str, int] = field(default_factory=dict)  # driver -> max volumes

@@ -263,6 +263,9 @@ class Pod:
     # PodGroup are scheduled all-or-nothing with their peers
     # (schedule_one_podgroup.go; membership via workload reference).
     pod_group: str = ""  # PodGroup name in the pod's namespace ("" = none)
+    # DRA: names of ResourceClaims in the pod's namespace
+    # (spec.resourceClaims; api/dra.py, plugins/dynamicresources.py).
+    resource_claims: List[str] = field(default_factory=list)
     volumes: List[Volume] = field(default_factory=list)
     host_network: bool = False
     # status

@@ -1,0 +1,136 @@
+"""Async API dispatcher: decouples scheduling cycles from API write RTT.
+
+Re-expresses pkg/scheduler/backend/api_dispatcher/ (APIDispatcher
+api_dispatcher.go:32, relevance-merging call_queue.go) and the call
+implementations in framework/api_calls/ (pod_binding.go:32 PodBindingCall,
+pod_status_patch.go). Gated by SchedulerAsyncAPICalls
+(kube_features.go:1048).
+
+Execution modes:
+- inline  — calls run at enqueue (deterministic; default for tests/bench
+  where the "API server" is an in-process dict and there is no RTT to hide);
+- thread  — a worker thread drains the queue, overlapping binding writes
+  with the next scheduling cycle exactly like the reference's goroutine.
+
+Merging semantics (call_queue.go): one pending slot per (call_type, object
+uid); a newly enqueued call replaces a queued one when its relevance is >=
+the queued call's (e.g. a binding supersedes a pending status patch).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+# Call types + relevance (api_calls/ relevances: binding > status patch).
+CALL_STATUS_PATCH = "pod_status_patch"
+CALL_BINDING = "pod_binding"
+RELEVANCE = {CALL_STATUS_PATCH: 1, CALL_BINDING: 2}
+
+
+@dataclass
+class APICall:
+    call_type: str
+    object_uid: str
+    execute: Callable[[], None]
+    on_error: Optional[Callable[[Exception], None]] = None
+
+    @property
+    def relevance(self) -> int:
+        return RELEVANCE.get(self.call_type, 0)
+
+
+class APIDispatcher:
+    def __init__(self, mode: str = "inline"):
+        assert mode in ("inline", "thread")
+        self.mode = mode
+        self._pending: Dict[Tuple[str, str], APICall] = {}
+        self._order: List[Tuple[str, str]] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.executed = 0
+        self.merged = 0
+        self.errors: List[str] = []
+        if mode == "thread":
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    # -- enqueue (api_dispatcher.go Add) -----------------------------------
+
+    def add(self, call: APICall) -> None:
+        if self.mode == "inline":
+            self._execute(call)
+            return
+        key = (call.call_type, call.object_uid)
+        skip_key = (CALL_STATUS_PATCH, call.object_uid) \
+            if call.call_type == CALL_BINDING else None
+        with self._lock:
+            if key in self._pending:
+                self.merged += 1  # replace: newest call wins its slot
+                self._pending[key] = call
+            else:
+                self._pending[key] = call
+                self._order.append(key)
+            # A binding makes a queued status patch for the same pod
+            # irrelevant (call_queue.go relevance merging).
+            if skip_key and skip_key in self._pending:
+                self._pending.pop(skip_key)
+                self._order.remove(skip_key)
+                self.merged += 1
+        self._wake.set()
+
+    def _execute(self, call: APICall) -> None:
+        try:
+            call.execute()
+            self.executed += 1
+        except Exception as e:  # noqa: BLE001
+            self.errors.append(f"{call.call_type}/{call.object_uid}: {e!r}")
+            if call.on_error is not None:
+                call.on_error(e)
+
+    # -- worker ------------------------------------------------------------
+
+    def _next(self) -> Optional[APICall]:
+        with self._lock:
+            while self._order:
+                key = self._order.pop(0)
+                call = self._pending.pop(key, None)
+                if call is not None:
+                    return call
+        return None
+
+    def _run(self) -> None:
+        while not self._stop:
+            call = self._next()
+            if call is None:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            self._execute(call)
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Drain everything (test/bench determinism barrier)."""
+        if self.mode == "inline":
+            return
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._order:
+                    return
+            self._wake.set()
+            time.sleep(0.001)
+
+    def close(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._order)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import copy
 from typing import Callable, Dict, List, Optional
 
+from ..api.storage import CSINode, PersistentVolume, PersistentVolumeClaim, StorageClass
 from ..api.types import Namespace, Node, Pod, PodGroup
 
 
@@ -20,11 +21,19 @@ class FakeClientset:
         self.nodes: Dict[str, Node] = {}
         self.namespaces: Dict[str, Namespace] = {"default": Namespace(name="default")}
         self.pod_groups: Dict[str, PodGroup] = {}  # "ns/name" -> group
+        self.pvs: Dict[str, PersistentVolume] = {}
+        self.pvcs: Dict[str, PersistentVolumeClaim] = {}  # "ns/name" -> pvc
+        self.storage_classes: Dict[str, StorageClass] = {}
+        self.csi_nodes: Dict[str, CSINode] = {}
+        self.resource_slices: Dict[str, List] = {}   # node -> [ResourceSlice]
+        self.resource_claims: Dict[str, object] = {}  # "ns/name" -> ResourceClaim
+        self.device_classes: Dict[str, object] = {}
         self.bindings: Dict[str, str] = {}  # pod uid -> node name
         self._pod_handlers: List = []
         self._node_handlers: List = []
         self._namespace_handlers: List = []
         self._pod_group_handlers: List = []
+        self._storage_handlers: List = []
         self._rv = 0
 
     # -- informer-ish registration ----------------------------------------
@@ -45,6 +54,15 @@ class FakeClientset:
         self._pod_group_handlers.append(handler)
         for g in self.pod_groups.values():
             handler(g)
+
+    def on_storage_event(self, handler: Callable[[str, object], None]) -> None:
+        """handler(kind, obj) for PV/PVC/StorageClass/CSINode/DRA writes —
+        the informer feed behind the Storage/Add queueing hints."""
+        self._storage_handlers.append(handler)
+
+    def _fire_storage(self, kind: str, obj) -> None:
+        for h in self._storage_handlers:
+            h(kind, obj)
 
     # -- writes ------------------------------------------------------------
 
@@ -82,6 +100,63 @@ class FakeClientset:
         for h in self._pod_group_handlers:
             h(group)
         return group
+
+    # -- storage (PV controller surface the volume plugins consume) --------
+
+    def create_pv(self, pv: PersistentVolume) -> PersistentVolume:
+        self.pvs[pv.name] = pv
+        self._fire_storage("pv", pv)
+        return pv
+
+    def create_pvc(self, pvc: PersistentVolumeClaim) -> PersistentVolumeClaim:
+        self.pvcs[pvc.key] = pvc
+        self._fire_storage("pvc", pvc)
+        return pvc
+
+    def create_storage_class(self, sc: StorageClass) -> StorageClass:
+        self.storage_classes[sc.name] = sc
+        self._fire_storage("storage_class", sc)
+        return sc
+
+    def create_csi_node(self, cn: CSINode) -> CSINode:
+        self.csi_nodes[cn.node_name] = cn
+        self._fire_storage("csi_node", cn)
+        return cn
+
+    def create_resource_slice(self, sl) -> object:
+        self.resource_slices.setdefault(sl.node_name, []).append(sl)
+        self._fire_storage("resource_slice", sl)
+        return sl
+
+    def create_resource_claim(self, claim) -> object:
+        self.resource_claims[claim.key] = claim
+        self._fire_storage("resource_claim", claim)
+        return claim
+
+    def create_device_class(self, dc) -> object:
+        self.device_classes[dc.name] = dc
+        self._fire_storage("device_class", dc)
+        return dc
+
+    def bind_volume(self, pvc: PersistentVolumeClaim, pv_name: str, node_name: str) -> None:
+        """VolumeBinding PreBind writes: bind the claim to a matching PV, or
+        simulate the external provisioner for WaitForFirstConsumer classes
+        (reference sets volume.kubernetes.io/selected-node and waits)."""
+        if pv_name:
+            pv = self.pvs[pv_name]
+            pv.claim_ref = pvc.key
+            pvc.volume_name = pv_name
+            return
+        from ..api.types import NodeSelector, NodeSelectorTerm
+        from ..api.labels import IN, Requirement
+        provisioned = PersistentVolume(
+            name=f"pvc-{pvc.uid}", capacity=pvc.request,
+            access_modes=pvc.access_modes, storage_class=pvc.storage_class,
+            node_affinity=NodeSelector(terms=(NodeSelectorTerm(
+                match_fields=(Requirement("metadata.name", IN, (node_name,)),)),)),
+            claim_ref=pvc.key)
+        self.pvs[provisioned.name] = provisioned
+        pvc.volume_name = provisioned.name
 
     def create_pod(self, pod: Pod) -> Pod:
         self._rv += 1

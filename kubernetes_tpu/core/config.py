@@ -62,6 +62,9 @@ class SchedulerConfiguration:
     # TPU batch knobs (replace `parallelism`, types.go:48-49).
     max_batch: int = 1024
     extenders: List[dict] = field(default_factory=list)
+    # Async API writes run on a worker thread when set (the reference's
+    # dispatcher goroutine); inline otherwise for determinism.
+    async_dispatch_threads: bool = False
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "SchedulerConfiguration":
@@ -88,6 +91,7 @@ class SchedulerConfiguration:
             feature_gates=dict(d.get("featureGates", {})),
             max_batch=d.get("maxBatch", 1024),
             extenders=list(d.get("extenders", ())),
+            async_dispatch_threads=bool(d.get("asyncDispatchThreads", False)),
         )
 
     def gates(self) -> FeatureGates:

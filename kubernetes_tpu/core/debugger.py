@@ -1,0 +1,79 @@
+"""Cache debugger: dump + compare scheduler state against the API truth.
+
+Re-expresses pkg/scheduler/backend/cache/debugger/ (debugger.go:59
+ListenForSignal — SIGUSR2 triggers CompareCache + Dump): the comparer diffs
+the scheduler cache against the clientset's authoritative objects (the
+informer stand-in), the dumper renders queue + cache contents.
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Dict, List
+
+
+class CacheDebugger:
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+
+    # -- comparer (debugger/comparer.go) -----------------------------------
+
+    def compare(self) -> List[str]:
+        """Differences between the cache and the clientset truth."""
+        s = self.scheduler
+        problems: List[str] = []
+        api_nodes = set(s.clientset.nodes)
+        cache_nodes = {n for n, ni in s.cache.nodes.items() if ni.node is not None}
+        for missing in api_nodes - cache_nodes:
+            problems.append(f"node {missing} in API but not in cache")
+        for stale in cache_nodes - api_nodes:
+            problems.append(f"node {stale} in cache but deleted from API")
+        api_assigned = {
+            uid: p.node_name for uid, p in s.clientset.pods.items() if p.node_name}
+        cache_pods = {
+            uid: st.pod.node_name for uid, st in s.cache.pod_states.items()}
+        for uid, node in api_assigned.items():
+            got = cache_pods.get(uid)
+            if got is None:
+                problems.append(f"pod {uid} assigned to {node} in API but not cached")
+            elif got != node:
+                problems.append(f"pod {uid} cached on {got}, API says {node}")
+        for uid in set(cache_pods) - set(api_assigned):
+            if uid not in s.cache.assumed_pods:
+                problems.append(f"pod {uid} cached but not assigned in API")
+        return problems
+
+    # -- dumper (debugger/dumper.go) ---------------------------------------
+
+    def dump(self) -> str:
+        s = self.scheduler
+        lines = ["Dump of cached NodeInfo:"]
+        for name, ni in s.cache.nodes.items():
+            lines.append(
+                f"  {name}: pods={len(ni.pods)} "
+                f"requested(cpu={ni.requested.milli_cpu}m mem={ni.requested.memory}) "
+                f"allocatable(cpu={ni.allocatable.milli_cpu}m mem={ni.allocatable.memory}) "
+                f"gen={ni.generation}")
+        lines.append(f"Assumed pods: {sorted(s.cache.assumed_pods)}")
+        active, backoff, unsched = s.queue.pending_counts()
+        lines.append(f"Queue: active={active} backoff={backoff} unschedulable={unsched}")
+        for q in s.queue.active_q.items():
+            lines.append(f"  activeQ: {q.pod.namespace}/{q.pod.name}")
+        for q in s.queue.backoff_q.items():
+            lines.append(f"  backoffQ: {q.pod.namespace}/{q.pod.name}")
+        for uid, q in s.queue.unschedulable.items():
+            lines.append(
+                f"  unschedulable: {q.pod.namespace}/{q.pod.name} "
+                f"plugins={sorted(q.unschedulable_plugins)}")
+        return "\n".join(lines)
+
+    def listen_for_signal(self, signum: int = signal.SIGUSR2) -> None:
+        """debugger.go:59 ListenForSignal."""
+
+        def handler(_sig, _frame):
+            problems = self.compare()
+            print(self.dump())
+            for p in problems:
+                print("cache mismatch:", p)
+
+        signal.signal(signum, handler)

@@ -1,0 +1,143 @@
+"""Scheduler extenders: the webhook extension surface.
+
+Re-expresses pkg/scheduler/extender.go (HTTPExtender :44; verbs filter /
+prioritize / bind / preempt :46-49) and the extender wiring in
+schedule_one.go:894 findNodesThatPassExtenders and :989-1048 extender scoring.
+
+Transport is pluggable: production uses HTTP POST of JSON args (urllib),
+tests inject an in-process callable — the same seam the reference's
+fake_extender.go uses (SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..api.types import Pod
+from ..core.framework import NodeScore, Status
+from ..core.node_info import NodeInfo
+
+MAX_EXTENDER_PRIORITY = 10  # extender/v1 MaxExtenderPriority
+
+
+def http_transport(url_prefix: str, timeout: float = 5.0):
+    def call(verb: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            f"{url_prefix.rstrip('/')}/{verb}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    return call
+
+
+@dataclass
+class Extender:
+    """One configured extender (config ExtenderConfig → HTTPExtender)."""
+
+    name: str = "extender"
+    filter_verb: str = ""        # "" = extender doesn't filter
+    prioritize_verb: str = ""
+    bind_verb: str = ""
+    weight: int = 1
+    node_cache_capable: bool = False     # send node names only
+    ignorable: bool = False              # errors don't fail scheduling
+    managed_resources: Tuple[str, ...] = ()  # only pods requesting these
+    transport: Optional[Callable[[str, dict], dict]] = None
+
+    def is_interested(self, pod: Pod) -> bool:
+        """extender.go IsInterested: no managedResources = all pods."""
+        if not self.managed_resources:
+            return True
+        req = pod.resource_request()
+        names = set(req.scalar_resources) | {
+            n for n in ("cpu", "memory") if req.get(n) > 0}
+        return bool(names & set(self.managed_resources))
+
+    def supports_filter(self) -> bool:
+        return bool(self.filter_verb)
+
+    def supports_prioritize(self) -> bool:
+        return bool(self.prioritize_verb)
+
+    def supports_bind(self) -> bool:
+        return bool(self.bind_verb)
+
+    # -- verbs -------------------------------------------------------------
+
+    def filter(self, pod: Pod, nodes: Sequence[NodeInfo]) -> Tuple[List[NodeInfo], Dict[str, str], Optional[str]]:
+        """Returns (feasible, failed_and_unresolvable?, error). Response shape
+        mirrors extender/v1 ExtenderFilterResult (NodeNames/FailedNodes)."""
+        payload = {
+            "pod": {"name": pod.name, "namespace": pod.namespace, "uid": pod.uid},
+            "nodenames": [ni.name for ni in nodes],
+        }
+        try:
+            resp = self.transport("filter", payload)
+        except Exception as e:  # noqa: BLE001
+            return (list(nodes), {}, None) if self.ignorable else ([], {}, str(e))
+        if resp.get("error"):
+            return (list(nodes), {}, None) if self.ignorable else ([], {}, resp["error"])
+        keep = resp.get("nodenames")
+        failed = dict(resp.get("failedNodes", {}))
+        if keep is None:
+            return list(nodes), failed, None
+        keep_set = set(keep)
+        return [ni for ni in nodes if ni.name in keep_set], failed, None
+
+    def prioritize(self, pod: Pod, nodes: Sequence[NodeInfo]) -> Dict[str, int]:
+        """extender/v1 HostPriorityList → {node: score*weight}."""
+        payload = {
+            "pod": {"name": pod.name, "namespace": pod.namespace, "uid": pod.uid},
+            "nodenames": [ni.name for ni in nodes],
+        }
+        try:
+            resp = self.transport("prioritize", payload)
+        except Exception:  # noqa: BLE001
+            return {}
+        out = {}
+        for item in resp.get("hostPriorityList", []):
+            out[item["host"]] = int(item["score"]) * self.weight
+        return out
+
+    def bind(self, pod: Pod, node_name: str) -> Optional[str]:
+        try:
+            resp = self.transport("bind", {
+                "podName": pod.name, "podNamespace": pod.namespace,
+                "podUID": pod.uid, "node": node_name})
+        except Exception as e:  # noqa: BLE001
+            return str(e)
+        return resp.get("error") or None
+
+
+def run_extender_filters(
+    extenders: Sequence[Extender], pod: Pod, feasible: List[NodeInfo], diagnosis
+) -> Tuple[List[NodeInfo], Optional[Status]]:
+    """schedule_one.go:894 findNodesThatPassExtenders."""
+    for ext in extenders:
+        if not feasible:
+            break
+        if not ext.supports_filter() or not ext.is_interested(pod):
+            continue
+        feasible, failed, err = ext.filter(pod, feasible)
+        if err is not None:
+            return [], Status.error(f"extender {ext.name}: {err}")
+        for node, reason in failed.items():
+            diagnosis.node_to_status[node] = Status.unschedulable(reason)
+    return feasible, None
+
+
+def run_extender_prioritize(
+    extenders: Sequence[Extender], pod: Pod, nodes: Sequence[NodeInfo],
+    scores: List[NodeScore],
+) -> None:
+    """schedule_one.go:989-1048: extender scores add onto plugin totals."""
+    for ext in extenders:
+        if not ext.supports_prioritize() or not ext.is_interested(pod):
+            continue
+        ext_scores = ext.prioritize(pod, nodes)
+        for ns in scores:
+            ns.score += ext_scores.get(ns.name, 0)

@@ -1,0 +1,103 @@
+"""Leader election: active/passive HA for the scheduler.
+
+Re-expresses client-go tools/leaderelection/leaderelection.go (573 LoC) over
+a lease store: candidates acquire/renew a Lease record; the holder runs, the
+others watch and take over when the lease expires (kube-scheduler wiring at
+cmd/kube-scheduler/app/server.go:310-342).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease (the modern resourcelock)."""
+
+    name: str
+    holder: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration: float = 15.0
+    transitions: int = 0
+
+
+class LeaseStore:
+    """The apiserver-side lease objects (shared by all candidates)."""
+
+    def __init__(self):
+        self.leases: Dict[str, Lease] = {}
+
+    def get_or_create(self, name: str, duration: float) -> Lease:
+        if name not in self.leases:
+            self.leases[name] = Lease(name=name, lease_duration=duration)
+        return self.leases[name]
+
+
+class LeaderElector:
+    """leaderelection.go LeaderElector: tryAcquireOrRenew loop semantics,
+    driven by explicit tick() calls (no background goroutine)."""
+
+    def __init__(
+        self,
+        store: LeaseStore,
+        identity: str,
+        lease_name: str = "kube-scheduler",
+        lease_duration: float = 15.0,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        now: Callable[[], float] = time.monotonic,
+    ):
+        self.store = store
+        self.identity = identity
+        self.lease_name = lease_name
+        self.lease_duration = lease_duration
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.now = now
+        self._leading = False
+
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def tick(self) -> bool:
+        """One tryAcquireOrRenew: returns True iff leading after the call."""
+        lease = self.store.get_or_create(self.lease_name, self.lease_duration)
+        now = self.now()
+        expired = lease.renew_time + lease.lease_duration <= now
+        if lease.holder == self.identity:
+            lease.renew_time = now  # renew
+            if not self._leading:
+                self._leading = True
+                if self.on_started_leading:
+                    self.on_started_leading()
+            return True
+        if not lease.holder or expired:
+            # acquire (the observed holder failed to renew)
+            lease.holder = self.identity
+            lease.acquire_time = lease.renew_time = now
+            lease.transitions += 1
+            self._leading = True
+            if self.on_started_leading:
+                self.on_started_leading()
+            return True
+        if self._leading:
+            # we lost the lease (another identity holds it)
+            self._leading = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+        return False
+
+    def release(self) -> None:
+        """Voluntary step-down (ReleaseOnCancel)."""
+        lease = self.store.leases.get(self.lease_name)
+        if lease is not None and lease.holder == self.identity:
+            lease.holder = ""
+            lease.renew_time = 0.0
+        if self._leading:
+            self._leading = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()

@@ -39,6 +39,33 @@ EVENT_NODE_ADD = "Node/Add"
 EVENT_NODE_UPDATE = "Node/Update"
 EVENT_UNSCHEDULABLE_TIMEOUT = "UnschedulableTimeout"
 EVENT_FORCE_ACTIVATE = "ForceActivate"
+EVENT_STORAGE_ADD = "Storage/Add"  # PV/PVC/StorageClass/CSINode changes
+
+# QueueingHints (scheduling_queue.go:582 isPodWorthRequeuing; per-plugin
+# EnqueueExtensions): which cluster events can unblock a pod rejected by a
+# given plugin. Plugins absent from the map requeue on any event (the
+# reference's default when no hint fn is registered).
+QUEUEING_HINTS: Dict[str, Set[str]] = {
+    "NodeResourcesFit": {EVENT_NODE_ADD, EVENT_NODE_UPDATE,
+                         EVENT_ASSIGNED_POD_DELETE, EVENT_POD_DELETE},
+    "NodeAffinity": {EVENT_NODE_ADD, EVENT_NODE_UPDATE},
+    "NodeName": {EVENT_NODE_ADD, EVENT_NODE_UPDATE},
+    "NodeUnschedulable": {EVENT_NODE_ADD, EVENT_NODE_UPDATE},
+    "TaintToleration": {EVENT_NODE_ADD, EVENT_NODE_UPDATE},
+    "NodePorts": {EVENT_NODE_ADD, EVENT_ASSIGNED_POD_DELETE, EVENT_POD_DELETE},
+    "PodTopologySpread": {EVENT_NODE_ADD, EVENT_NODE_UPDATE, EVENT_ASSIGNED_POD_ADD,
+                          EVENT_ASSIGNED_POD_DELETE, EVENT_POD_DELETE},
+    "InterPodAffinity": {EVENT_NODE_ADD, EVENT_NODE_UPDATE, EVENT_ASSIGNED_POD_ADD,
+                         EVENT_ASSIGNED_POD_DELETE, EVENT_POD_DELETE},
+    "DefaultPreemption": {EVENT_ASSIGNED_POD_DELETE, EVENT_POD_DELETE},
+    "VolumeBinding": {EVENT_NODE_ADD, EVENT_NODE_UPDATE, EVENT_STORAGE_ADD},
+    "VolumeZone": {EVENT_NODE_ADD, EVENT_NODE_UPDATE, EVENT_STORAGE_ADD},
+    "NodeVolumeLimits": {EVENT_NODE_ADD, EVENT_ASSIGNED_POD_DELETE,
+                         EVENT_POD_DELETE, EVENT_STORAGE_ADD},
+    "VolumeRestrictions": {EVENT_ASSIGNED_POD_DELETE, EVENT_POD_DELETE},
+    "DynamicResources": {EVENT_NODE_ADD, EVENT_NODE_UPDATE, EVENT_STORAGE_ADD,
+                         EVENT_ASSIGNED_POD_DELETE, EVENT_POD_DELETE},
+}
 
 
 @dataclass
@@ -346,7 +373,10 @@ class PriorityQueue:
                     if st.is_success():
                         qpi.gated = False
                         qpi.timestamp = self.now()
-                        self.active_q.push(qpi)
+                        if new.pod_group and self.gang_enabled:
+                            self._add_group_member(qpi)  # rejoin the gang
+                        else:
+                            self.active_q.push(qpi)
                         return
                 self.unschedulable[uid] = qpi
                 return
@@ -420,11 +450,21 @@ class PriorityQueue:
             return
         self.unschedulable[uid] = qpi
 
-    def _events_relevant(self, qpi: QueuedPodInfo, events: List[str]) -> bool:
-        # QueueingHint approximation: any cluster event can unblock any
-        # unschedulable pod (reference default when a plugin registers no
-        # hint fn is to requeue). Per-plugin hints refine this later.
-        return True
+    def _events_relevant(self, qpi, events: List[str]) -> bool:
+        """isPodWorthRequeuing (scheduling_queue.go:582): does any of the
+        events plausibly resolve one of the plugins that rejected this
+        entity? Unknown rejection causes requeue on anything."""
+        plugins = qpi.unschedulable_plugins
+        if not plugins:
+            return True
+        for event in events:
+            if event in (EVENT_UNSCHEDULABLE_TIMEOUT, EVENT_FORCE_ACTIVATE):
+                return True
+            for p in plugins:
+                hints = QUEUEING_HINTS.get(p)
+                if hints is None or event in hints:
+                    return True
+        return False
 
     def _move_to_active_or_backoff(self, qpi) -> None:
         if qpi.gated:
@@ -444,11 +484,14 @@ class PriorityQueue:
             self.active_q.push(qpi)
 
     def move_all_to_active_or_backoff(self, event: str) -> None:
-        """MoveAllToActiveOrBackoffQueue (scheduling_queue.go:1817)."""
+        """MoveAllToActiveOrBackoffQueue (scheduling_queue.go:1817), with
+        per-plugin QueueingHint filtering."""
         self.moved_count += 1
         for uid in list(self.unschedulable.keys()):
             qpi = self.unschedulable[uid]
             if qpi.gated and event != EVENT_FORCE_ACTIVATE:
+                continue
+            if not self._events_relevant(qpi, [event]):
                 continue
             del self.unschedulable[uid]
             self._move_to_active_or_backoff(qpi)

@@ -27,7 +27,19 @@ from ..plugins.basic import (
 from ..plugins.interpodaffinity import InterPodAffinity
 from ..plugins.noderesources import BalancedAllocation, Fit
 from ..plugins.podtopologyspread import PodTopologySpread
+from ..plugins.extras import (
+    DeferredPodScheduling,
+    GangScheduling,
+    NodeDeclaredFeatures,
+)
+from ..plugins.dynamicresources import DynamicResources
 from ..plugins.preemption import DefaultPreemption
+from ..plugins.volumes import (
+    NodeVolumeLimits,
+    VolumeBinding,
+    VolumeRestrictions,
+    VolumeZone,
+)
 from .framework import Framework
 
 # name -> factory(handle, args) (plugins/registry.go NewInTreeRegistry)
@@ -45,6 +57,14 @@ IN_TREE_REGISTRY: Dict[str, Callable] = {
     "NodeResourcesBalancedAllocation": lambda h, **kw: BalancedAllocation(**kw),
     "ImageLocality": lambda h, **kw: ImageLocality(handle=h),
     "DefaultPreemption": lambda h, **kw: DefaultPreemption(handle=h, **kw),
+    "VolumeRestrictions": lambda h, **kw: VolumeRestrictions(handle=h),
+    "NodeVolumeLimits": lambda h, **kw: NodeVolumeLimits(handle=h),
+    "VolumeBinding": lambda h, **kw: VolumeBinding(handle=h),
+    "VolumeZone": lambda h, **kw: VolumeZone(handle=h),
+    "NodeDeclaredFeatures": lambda h, **kw: NodeDeclaredFeatures(),
+    "DynamicResources": lambda h, **kw: DynamicResources(handle=h),
+    "DeferredPodScheduling": lambda h, **kw: DeferredPodScheduling(**kw),
+    "GangScheduling": lambda h, **kw: GangScheduling(handle=h, **kw),
     "DefaultBinder": lambda h, **kw: DefaultBinder(handle=h),
 }
 
@@ -58,6 +78,10 @@ DEFAULT_PLUGINS: Tuple[Tuple[str, int], ...] = (
     ("NodeAffinity", 2),
     ("NodePorts", 0),
     ("NodeResourcesFit", 1),
+    ("VolumeRestrictions", 0),
+    ("NodeVolumeLimits", 0),
+    ("VolumeBinding", 0),
+    ("VolumeZone", 0),
     ("PodTopologySpread", 2),
     ("InterPodAffinity", 2),
     ("DefaultPreemption", 0),

@@ -44,6 +44,7 @@ from .framework import (
     Status,
     UNSCHEDULABLE,
     UNSCHEDULABLE_AND_UNRESOLVABLE,
+    WAIT,
 )
 from .node_info import NodeInfo
 from .queue import (
@@ -80,6 +81,7 @@ class ScheduleResult:
     suggested_host: str = ""
     evaluated_nodes: int = 0
     feasible_nodes: int = 0
+    waiting: bool = False  # a Permit plugin returned WAIT
 
 
 class Handle:
@@ -106,6 +108,58 @@ class Handle:
     @property
     def gates(self):
         return self._scheduler.gates
+
+    @property
+    def api_dispatcher(self):
+        return self._scheduler.api_dispatcher
+
+    # waiting pods (Permit WAIT; framework.Handle IterateOverWaitingPods /
+    # GetWaitingPod surface, collapsed to allow/reject by uid)
+    def allow_waiting_pod(self, uid: str) -> bool:
+        return self._scheduler.allow_waiting_pod(uid)
+
+    def reject_waiting_pod(self, uid: str, reason: str = "rejected") -> bool:
+        return self._scheduler.reject_waiting_pod(uid, reason)
+
+    def on_async_bind_error(self, pod, exc: Exception) -> None:
+        """Async dispatcher bind failure: unwind the optimistic commit."""
+        s = self._scheduler
+        s.cache.forget_pod(pod)
+        pod.node_name = ""
+        s.scheduled = max(0, s.scheduled - 1)
+        s.failures += 1
+        s.error_log.append(f"async bind {pod.namespace}/{pod.name}: {exc!r}")
+        s.queue.add(pod)
+
+    # storage listers (volume plugins)
+    @property
+    def pvs(self):
+        return self._scheduler.clientset.pvs
+
+    @property
+    def pvcs(self):
+        return self._scheduler.clientset.pvcs
+
+    @property
+    def storage_classes(self):
+        return self._scheduler.clientset.storage_classes
+
+    @property
+    def csi_nodes(self):
+        return self._scheduler.clientset.csi_nodes
+
+    # DRA listers (plugins/dynamicresources.py)
+    @property
+    def resource_slices(self):
+        return self._scheduler.clientset.resource_slices
+
+    @property
+    def resource_claims(self):
+        return self._scheduler.clientset.resource_claims
+
+    @property
+    def device_classes(self):
+        return self._scheduler.clientset.device_classes
 
 
 class Scheduler:
@@ -167,6 +221,35 @@ class Scheduler:
             pop_from_backoff_q=self.gates.enabled(SCHEDULER_POP_FROM_BACKOFF_Q),
             gang_enabled=self.gates.enabled(GENERIC_WORKLOAD),
         )
+        # Extenders (extender.go; config extenders or injected objects).
+        from .extender import Extender, http_transport
+        self.extenders: List[Extender] = []
+        for e in self.config.extenders:
+            if isinstance(e, Extender):
+                self.extenders.append(e)
+            else:
+                ext = Extender(
+                    name=e.get("name", e.get("urlPrefix", "extender")),
+                    filter_verb=e.get("filterVerb", ""),
+                    prioritize_verb=e.get("prioritizeVerb", ""),
+                    bind_verb=e.get("bindVerb", ""),
+                    weight=e.get("weight", 1),
+                    ignorable=e.get("ignorable", False),
+                    managed_resources=tuple(e.get("managedResources", ())),
+                    transport=http_transport(e["urlPrefix"]),
+                )
+                self.extenders.append(ext)
+        # Async API dispatcher (backend/api_dispatcher; SchedulerAsyncAPICalls).
+        from .api_dispatcher import APIDispatcher
+        from .features import SCHEDULER_ASYNC_API_CALLS
+        mode = "inline"
+        if self.gates.enabled(SCHEDULER_ASYNC_API_CALLS) and getattr(
+                self.config, "async_dispatch_threads", False):
+            mode = "thread"
+        self.api_dispatcher = APIDispatcher(mode=mode)
+        # Waiting pods (Permit WAIT; framework.go waitingPods registry).
+        self.waiting_pods: Dict[str, tuple] = {}
+        self.permit_wait_timeout = 60.0
         # metrics
         self.attempts = 0
         self.scheduled = 0
@@ -181,6 +264,11 @@ class Scheduler:
         self.clientset.on_node_event(self._on_node_event)
         self.clientset.on_namespace_event(self.cache.add_namespace)
         self.clientset.on_pod_group_event(self.queue.register_pod_group)
+        self.clientset.on_storage_event(self._on_storage_event)
+
+    def _on_storage_event(self, kind: str, obj) -> None:
+        from .queue import EVENT_STORAGE_ADD
+        self.queue.move_all_to_active_or_backoff(EVENT_STORAGE_ADD)
 
     def _responsible_for_pod(self, pod: Pod) -> bool:
         """eventhandlers.go responsibleForPod: only queue pods whose
@@ -236,6 +324,7 @@ class Scheduler:
         while n < max_cycles:
             if not self.schedule_one():
                 self.queue.flush_backoff_completed()
+                self.flush_expired_waiters()
                 if not self.schedule_one():
                     break
             n += 1
@@ -286,6 +375,14 @@ class Scheduler:
             self.queue.done(pod.uid)
             self.metrics.schedule_attempts.inc("error", fw.profile_name)
             return
+        if result.waiting:
+            # WaitOnPermit (framework.go:2097): the pod stays reserved
+            # (assumed in the cache) until a Permit plugin allows or rejects
+            # it, or the wait times out (flush_expired_waiters).
+            self.waiting_pods[pod.uid] = (
+                fw, state, qpi, result, self.now() + self.permit_wait_timeout)
+            self.queue.done(pod.uid)
+            return
         bound = self.run_binding_cycle(fw, state, qpi, result)
         self.queue.done(pod.uid)
         elapsed = time.perf_counter() - t0
@@ -316,6 +413,8 @@ class Scheduler:
             self.cache.forget_pod(assumed)
             assumed.node_name = ""
             raise RuntimeError(f"permit rejected: {st.message()}")
+        if st.code == WAIT:
+            result.waiting = True  # parks in waiting_pods; binds on Allow
         return result
 
     # -- gang cycle (schedule_one_podgroup.go) -----------------------------
@@ -438,6 +537,11 @@ class Scheduler:
         if pre_res is not None and not pre_res.all_nodes():
             nodes = [ni for ni in all_nodes if ni.name in pre_res.node_names]
         feasible = self.find_nodes_that_pass_filters(fw, state, pod, diagnosis, nodes)
+        if feasible and self.extenders:
+            from .extender import run_extender_filters
+            feasible, err = run_extender_filters(self.extenders, pod, feasible, diagnosis)
+            if err is not None:
+                raise RuntimeError(f"extender filter failed: {err.message()}")
         return feasible, diagnosis
 
     def num_feasible_nodes_to_find(self, num_all_nodes: int) -> int:
@@ -482,6 +586,9 @@ class Scheduler:
         for scores in plugin_scores.values():
             for i, ns in enumerate(scores):
                 total[i].score += ns.score
+        if self.extenders:
+            from .extender import run_extender_prioritize
+            run_extender_prioritize(self.extenders, pod, nodes, total)
         return total
 
     def select_host(self, node_scores: List[NodeScore]) -> str:
@@ -512,7 +619,16 @@ class Scheduler:
         if not st.is_success():
             self._unwind_binding(fw, state, qpi, node_name, st)
             return False
-        st = fw.run_bind_plugins(state, pod, node_name)
+        # Extender bind delegation (schedule_one.go:1100 bind: an interested
+        # extender with a bind verb binds instead of the bind plugins).
+        bind_ext = next(
+            (e for e in self.extenders
+             if e.supports_bind() and e.is_interested(pod)), None)
+        if bind_ext is not None:
+            err = bind_ext.bind(pod, node_name)
+            st = Status() if err is None else Status.error(err)
+        else:
+            st = fw.run_bind_plugins(state, pod, node_name)
         if not st.is_success():
             self._unwind_binding(fw, state, qpi, node_name, st)
             return False
@@ -533,6 +649,36 @@ class Scheduler:
         self.handle_scheduling_failure(fw, qpi, st, None)
 
     # -- failure (schedule_one.go:1152 handleSchedulingFailure) ------------
+
+    # -- waiting pods (Permit WAIT) ----------------------------------------
+
+    def allow_waiting_pod(self, uid: str) -> bool:
+        """A Permit plugin allowed a parked pod: run its binding cycle
+        (waitingPod.Allow → WaitOnPermit unblocks)."""
+        entry = self.waiting_pods.pop(uid, None)
+        if entry is None:
+            return False
+        fw, state, qpi, result, _ = entry
+        self.run_binding_cycle(fw, state, qpi, result)
+        return True
+
+    def reject_waiting_pod(self, uid: str, reason: str = "rejected") -> bool:
+        entry = self.waiting_pods.pop(uid, None)
+        if entry is None:
+            return False
+        fw, state, qpi, result, _ = entry
+        fw.run_reserve_plugins_unreserve(state, qpi.pod, result.suggested_host)
+        self.cache.forget_pod(qpi.pod)
+        qpi.pod.node_name = ""
+        self.handle_scheduling_failure(fw, qpi, Status.unschedulable(reason), None)
+        return True
+
+    def flush_expired_waiters(self) -> int:
+        now = self.now()
+        expired = [uid for uid, e in self.waiting_pods.items() if e[4] <= now]
+        for uid in expired:
+            self.reject_waiting_pod(uid, "permit wait timed out")
+        return len(expired)
 
     def update_pending_metrics(self) -> None:
         """Refresh the pending_pods gauges (metrics.go pending_pods)."""

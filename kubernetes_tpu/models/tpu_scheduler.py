@@ -82,6 +82,10 @@ class TPUScheduler(Scheduler):
             ba_plugin=fw.plugin("NodeResourcesBalancedAllocation"))
         if reason is None and self.queue.nominator.has_nominated_pods():
             reason = "nominated pods present"
+        if reason is None and self.extenders:
+            interested = [e for e in self.extenders if e.is_interested(head.pod)]
+            if interested:
+                reason = "extender-managed pod"
         sig = fw.sign_pod(head.pod) if reason is None else None
         if sig is None:
             return fw, [head], reason or "unsignable pod"

@@ -145,6 +145,10 @@ def batch_supported(pod: Pod, snapshot, fit_plugin=None, ba_plugin=None) -> Opti
         return "nominated node fast path"
     if pod.host_ports():
         return "host ports"
+    if any(v.pvc_name for v in pod.volumes):
+        return "pvc-backed volumes"
+    if getattr(pod, "resource_claims", None):
+        return "dynamic resource claims"
     aff = pod.affinity
     if aff is not None and aff.node_affinity is not None:
         na = aff.node_affinity

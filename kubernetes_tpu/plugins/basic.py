@@ -129,7 +129,9 @@ class PrioritySort:
 
 
 class DefaultBinder:
-    """plugins/defaultbinder: POST /binding via the (fake) clientset."""
+    """plugins/defaultbinder: POST /binding — routed through the async API
+    dispatcher when available (framework/api_calls/pod_binding.go:32
+    PodBindingCall via APIDispatcher; inline mode executes immediately)."""
 
     name = "DefaultBinder"
 
@@ -137,8 +139,21 @@ class DefaultBinder:
         self.handle = handle
 
     def bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        dispatcher = getattr(self.handle, "api_dispatcher", None)
         try:
-            self.handle.clientset.bind(pod, node_name)
+            if dispatcher is not None:
+                from ..core.api_dispatcher import APICall, CALL_BINDING
+                on_error = getattr(self.handle, "on_async_bind_error", None)
+                errors_before = len(dispatcher.errors)
+                dispatcher.add(APICall(
+                    call_type=CALL_BINDING, object_uid=pod.uid,
+                    execute=lambda: self.handle.clientset.bind(pod, node_name),
+                    on_error=(lambda e, _p=pod: on_error(_p, e))
+                    if (on_error is not None and dispatcher.mode == "thread") else None))
+                if dispatcher.mode == "inline" and len(dispatcher.errors) > errors_before:
+                    return Status.error(dispatcher.errors[-1])
+            else:
+                self.handle.clientset.bind(pod, node_name)
         except Exception as e:  # noqa: BLE001
             return Status.error(str(e))
         return OK

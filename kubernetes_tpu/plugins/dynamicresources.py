@@ -1,0 +1,172 @@
+"""DynamicResources plugin: DRA claim allocation during scheduling.
+
+Reference anchors: plugins/dynamicresources/ (dynamicresources.go 2152 LoC,
+dra_manager.go 512): PreFilter fetches the pod's claims (missing ⇒
+unresolvable; allocated ⇒ node pinned to the allocation), Filter runs a
+per-node allocation attempt over the node's ResourceSlices (structured
+parameters), Reserve assumes the winning allocation in the shared assume
+cache, Unreserve reverts, PreBind writes claim status + reservedFor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..api.dra import AllocatedDevice, ResourceClaim
+from ..api.types import Pod
+from ..core.framework import OK, CycleState, PreFilterResult, Status
+from ..core.node_info import NodeInfo
+
+ERR_CLAIM_NOT_FOUND = 'resourceclaim "%s" not found'
+ERR_ALLOCATED_ELSEWHERE = "resourceclaim was allocated for a different node"
+ERR_NO_DEVICES = "node(s) didn't have enough free devices for the claims"
+
+
+class DynamicResources:
+    name = "DynamicResources"
+    _KEY = "PreFilterDynamicResources"
+
+    def __init__(self, handle=None):
+        self.handle = handle
+        # Assume cache (dra_manager.go / assumecache): device keys held by
+        # in-flight reservations, per claim.
+        self.assumed: Dict[str, List[AllocatedDevice]] = {}  # claim key -> devices
+        self.assumed_nodes: Dict[str, str] = {}              # claim key -> node
+
+    # -- listers -----------------------------------------------------------
+
+    def _claims_for(self, pod: Pod) -> List[Optional[ResourceClaim]]:
+        return [self.handle.resource_claims.get(f"{pod.namespace}/{name}")
+                for name in getattr(pod, "resource_claims", ())]
+
+    def _in_use(self) -> Set[Tuple[str, str, str]]:
+        """(node, driver, device) triples already allocated or assumed."""
+        used: Set[Tuple[str, str, str]] = set()
+        for claim in self.handle.resource_claims.values():
+            if claim.allocated:
+                for d in claim.allocations:
+                    used.add((claim.allocated_node, d.driver, d.device))
+        for key, devices in self.assumed.items():
+            node = self.assumed_nodes.get(key, "")
+            for d in devices:
+                used.add((node, d.driver, d.device))
+        return used
+
+    # -- PreFilter ---------------------------------------------------------
+
+    @dataclass
+    class _State:
+        claims: List[ResourceClaim] = field(default_factory=list)
+        pinned_node: str = ""  # allocation already fixes the node
+        # node -> [(claim, devices)]
+        node_allocations: Dict[str, List[Tuple[ResourceClaim, List[AllocatedDevice]]]] = field(default_factory=dict)
+
+        def clone(self) -> "DynamicResources._State":
+            return DynamicResources._State(
+                claims=list(self.claims),
+                pinned_node=self.pinned_node,
+                node_allocations={k: list(v) for k, v in self.node_allocations.items()},
+            )
+
+    def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Tuple[Optional[PreFilterResult], Status]:
+        names = getattr(pod, "resource_claims", ())
+        if not names:
+            return None, Status.skip()
+        s = self._State()
+        pinned: Optional[str] = None
+        for name in names:
+            claim = self.handle.resource_claims.get(f"{pod.namespace}/{name}")
+            if claim is None:
+                return None, Status.unresolvable(ERR_CLAIM_NOT_FOUND % name)
+            s.claims.append(claim)
+            if claim.allocated:
+                if pinned is not None and claim.allocated_node != pinned:
+                    return None, Status.unresolvable(ERR_ALLOCATED_ELSEWHERE)
+                pinned = claim.allocated_node
+        state.write(self._KEY, s)
+        if pinned is not None:
+            s.pinned_node = pinned
+            return PreFilterResult({pinned}), OK
+        return None, OK
+
+    # -- Filter: per-node allocation attempt -------------------------------
+
+    def _resolve_selectors(self, req) -> Dict[str, str]:
+        sel = dict(req.selectors)
+        if req.device_class:
+            dc = self.handle.device_classes.get(req.device_class)
+            if dc is not None:
+                sel.update(dc.selectors)
+        return sel
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        s: Optional[DynamicResources._State] = state.read(self._KEY)
+        if s is None:
+            return OK
+        node_name = node_info.name
+        if s.pinned_node:
+            return OK if node_name == s.pinned_node else Status.unschedulable(
+                ERR_ALLOCATED_ELSEWHERE)
+        in_use = self._in_use()
+        taken: Set[Tuple[str, str]] = set()
+        allocations: List[Tuple[ResourceClaim, List[AllocatedDevice]]] = []
+        slices = self.handle.resource_slices.get(node_name, [])
+        for claim in s.claims:
+            if claim.allocated:
+                continue
+            devices: List[AllocatedDevice] = []
+            for req in claim.requests:
+                sel = self._resolve_selectors(req)
+                found = 0
+                for sl in slices:
+                    for dev in sl.devices:
+                        if found >= req.count:
+                            break
+                        key = (sl.driver, dev.name)
+                        if key in taken or (node_name, sl.driver, dev.name) in in_use:
+                            continue
+                        if all(dev.attributes.get(k) == v for k, v in sel.items()):
+                            devices.append(AllocatedDevice(sl.driver, dev.name))
+                            taken.add(key)
+                            found += 1
+                if found < req.count:
+                    return Status.unschedulable(ERR_NO_DEVICES)
+            allocations.append((claim, devices))
+        s.node_allocations[node_name] = allocations
+        return OK
+
+    # -- Reserve / Unreserve / PreBind -------------------------------------
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        s: Optional[DynamicResources._State] = state.read(self._KEY)
+        if s is None:
+            return OK
+        for claim, devices in s.node_allocations.get(node_name, ()):
+            self.assumed[claim.key] = devices
+            self.assumed_nodes[claim.key] = node_name
+        return OK
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        s: Optional[DynamicResources._State] = state.read(self._KEY)
+        if s is None:
+            return
+        for claim, _ in s.node_allocations.get(node_name, ()):
+            self.assumed.pop(claim.key, None)
+            self.assumed_nodes.pop(claim.key, None)
+
+    def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        s: Optional[DynamicResources._State] = state.read(self._KEY)
+        if s is None:
+            return OK
+        for claim, devices in s.node_allocations.get(node_name, ()):
+            claim.allocated_node = node_name
+            claim.allocations = list(devices)
+            if pod.uid not in claim.reserved_for:
+                claim.reserved_for.append(pod.uid)
+            self.assumed.pop(claim.key, None)
+            self.assumed_nodes.pop(claim.key, None)
+        for claim in s.claims:
+            if claim.allocated and pod.uid not in claim.reserved_for:
+                claim.reserved_for.append(pod.uid)
+        return OK

@@ -1,0 +1,119 @@
+"""Fork-addition plugins: NodeDeclaredFeatures, DeferredPodScheduling,
+GangScheduling (Permit barrier).
+
+Reference anchors:
+- nodedeclaredfeatures/ (215 LoC): match pod feature requirements against
+  NodeInfo.DeclaredFeatures.
+- deferredpodscheduling/: KEP-style deferred scheduling — pods annotated for
+  deferral are gated until the deferral window passes / annotation clears.
+- gangscheduling/gangscheduling.go:45-47 (521 LoC): all-or-nothing
+  enforcement via a Permit-based co-scheduling barrier for pods scheduled
+  individually (the group-cycle path in core/scheduler.py covers entities
+  that pop as one unit; this plugin covers the feature-gated per-pod mode).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+from ..api.types import Pod
+from ..core.framework import OK, CycleState, Status, WAIT
+from ..core.node_info import NodeInfo
+
+DEFER_ANNOTATION = "scheduling.k8s.io/defer-until"
+
+
+class NodeDeclaredFeatures:
+    """Filter: every feature the pod requires must be declared true by the
+    node (pod annotation `features.k8s.io/required: f1,f2`)."""
+
+    name = "NodeDeclaredFeatures"
+    REQUIRED_ANNOTATION = "features.k8s.io/required"
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        required = pod.annotations.get(self.REQUIRED_ANNOTATION, "")
+        if not required:
+            return OK
+        declared = node_info.node.declared_features if node_info.node else {}
+        for feat in required.split(","):
+            feat = feat.strip()
+            if feat and not declared.get(feat, False):
+                return Status.unschedulable(
+                    "node(s) didn't declare required feature " + feat)
+        return OK
+
+    def sign(self, pod: Pod):
+        return pod.annotations.get(self.REQUIRED_ANNOTATION, "")
+
+
+class DeferredPodScheduling:
+    """PreEnqueue gate: pods carrying a defer-until timestamp stay gated
+    until the deadline passes (fork's deferred scheduling addition)."""
+
+    name = "DeferredPodScheduling"
+
+    def __init__(self, now=time.time):
+        self.now = now
+
+    def pre_enqueue(self, pod: Pod) -> Status:
+        raw = pod.annotations.get(DEFER_ANNOTATION, "")
+        if not raw:
+            return OK
+        try:
+            deadline = float(raw)
+        except ValueError:
+            return OK
+        if self.now() < deadline:
+            return Status.unresolvable(
+                f"pod scheduling deferred until {deadline}")
+        return OK
+
+
+class GangScheduling:
+    """Permit-based co-scheduling barrier (gangscheduling.go): a gang member
+    scheduled individually WAITs at Permit until min_count peers hold
+    reservations; the barrier rejects (unwinding all waiters) on timeout."""
+
+    name = "GangScheduling"
+
+    def __init__(self, handle=None, timeout_seconds: float = 60.0, now=time.monotonic):
+        self.handle = handle
+        self.timeout = timeout_seconds
+        self.now = now
+        # group key -> {pod uid: deadline}
+        self.waiting: Dict[Tuple[str, str], Dict[str, float]] = {}
+
+    def _group(self, pod: Pod):
+        if not pod.pod_group:
+            return None
+        groups = getattr(self.handle.clientset, "pod_groups", {})
+        return groups.get(f"{pod.namespace}/{pod.pod_group}")
+
+    def permit(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        group = self._group(pod)
+        if group is None:
+            return OK
+        key = (pod.namespace, pod.pod_group)
+        waiters = self.waiting.setdefault(key, {})
+        waiters[pod.uid] = self.now() + self.timeout
+        if len(waiters) >= max(1, group.min_count):
+            # Barrier satisfied: Allow() every parked peer (waitingPod.Allow,
+            # gangscheduling.go); the current pod proceeds synchronously.
+            released = self.waiting.pop(key)
+            allow = getattr(self.handle, "allow_waiting_pod", None)
+            if allow is not None:
+                for uid in released:
+                    if uid != pod.uid:
+                        allow(uid)
+            return OK
+        return Status(WAIT, (f"waiting for {group.min_count} gang members",),
+                      self.name)
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        key = (pod.namespace, pod.pod_group)
+        waiters = self.waiting.get(key)
+        if waiters is not None:
+            waiters.pop(pod.uid, None)
+            if not waiters:
+                self.waiting.pop(key, None)

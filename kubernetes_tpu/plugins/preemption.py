@@ -49,9 +49,13 @@ class PostFilterResult:
 class Evaluator:
     """Preemption dry-run machinery (preemption.go Evaluator)."""
 
+    MIN_CANDIDATE_NODES_PERCENTAGE = 10   # preemption.go minCandidateNodesPercentage
+    MIN_CANDIDATE_NODES_ABSOLUTE = 100    # preemption.go minCandidateNodesAbsolute
+
     def __init__(self, handle, framework):
         self.handle = handle
         self.fw = framework
+        self._offset = 0  # rotating start, GetOffsetAndNumCandidates
 
     # -- eligibility (default_preemption.go PodEligibleToPreemptOthers) ----
 
@@ -126,9 +130,22 @@ class Evaluator:
     def find_candidates(
         self, state: CycleState, pod: Pod, node_to_status: Dict[str, Status]
     ) -> List[Candidate]:
+        """DryRunPreemption over candidate nodes, capped at ~10% of the
+        cluster (floor 100) from a rotating offset — the reference's
+        GetOffsetAndNumCandidates (preemption.go:201,425)."""
         snapshot = self.handle.snapshot() if callable(self.handle.snapshot) else self.handle.snapshot
-        candidates = []
-        for ni in snapshot.node_info_list:
+        nodes = snapshot.node_info_list
+        n = len(nodes)
+        if n == 0:
+            return []
+        num_candidates = max(
+            n * self.MIN_CANDIDATE_NODES_PERCENTAGE // 100,
+            self.MIN_CANDIDATE_NODES_ABSOLUTE)
+        start = self._offset % n
+        self._offset += 1
+        candidates: List[Candidate] = []
+        for i in range(n):
+            ni = nodes[(start + i) % n]
             st = node_to_status.get(ni.name)
             # Unresolvable rejections can't be fixed by evicting pods
             # (preemption.go nodesWherePreemptionMightHelp).
@@ -137,6 +154,8 @@ class Evaluator:
             cand = self.dry_run_on_node(state, pod, ni)
             if cand is not None:
                 candidates.append(cand)
+                if len(candidates) >= num_candidates:
+                    break
         return candidates
 
     # -- selection (preemption.go pickOneNodeForPreemption) ----------------

@@ -1,0 +1,301 @@
+"""Volume plugins: VolumeBinding, NodeVolumeLimits (CSI), VolumeZone,
+VolumeRestrictions.
+
+Reference anchors:
+- volumebinding/ (binder.go 1131 + volume_binding.go 659): PVC partition in
+  PreFilter (bound / unbound-delayed / unbound-immediate), per-node
+  FindPodVolumes in Filter (bound-PV node affinity; matching available PVs
+  for unbound claims; dynamic provisioning check), AssumePodVolumes in
+  Reserve, BindPodVolumes API writes in PreBind, revert in Unreserve.
+- nodevolumelimits/csi.go (706): per-driver attach counting vs CSINode
+  allocatable limits.
+- volumezone/ (415): bound PV zone/region labels must match node labels.
+- volumerestrictions/ (432): ReadWriteOncePod conflicts (+ pre-existing
+  single-attach rules for legacy in-tree drivers, which are CSI-migrated and
+  not re-implemented here).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api.storage import (
+    IMMEDIATE,
+    RWOP,
+    WAIT_FOR_FIRST_CONSUMER,
+    PersistentVolume,
+    PersistentVolumeClaim,
+)
+from ..api.types import LABEL_ZONE, LABEL_REGION, Pod
+from ..core.framework import OK, CycleState, PreFilterResult, Status
+from ..core.node_info import NodeInfo
+
+ERR_UNBOUND_IMMEDIATE = "pod has unbound immediate PersistentVolumeClaims"
+ERR_NODE_CONFLICT = "node(s) had volume node affinity conflict"
+ERR_NO_MATCH = "node(s) didn't find available persistent volumes to bind"
+ERR_ZONE = "node(s) had no available volume zone"
+ERR_RWOP = "pod uses a ReadWriteOncePod PVC that is already in use by another pod"
+ERR_LIMIT = "node(s) exceed max volume count"
+
+
+def _pod_pvc_names(pod: Pod) -> List[str]:
+    return [v.pvc_name for v in pod.volumes if v.pvc_name]
+
+
+class VolumeBinding:
+    """volumebinding/volume_binding.go."""
+
+    name = "VolumeBinding"
+    _KEY = "PreFilterVolumeBinding"
+
+    def __init__(self, handle=None):
+        self.handle = handle
+        # PV assume layer (binder.go AssumeCache): pv name -> pvc key, held
+        # until the PVC's bind is observed or the reservation unwinds.
+        self.assumed: Dict[str, str] = {}
+
+    # -- listers -----------------------------------------------------------
+
+    def _pvc(self, ns: str, name: str) -> Optional[PersistentVolumeClaim]:
+        return self.handle.pvcs.get(f"{ns}/{name}")
+
+    def _pv(self, name: str) -> Optional[PersistentVolume]:
+        return self.handle.pvs.get(name)
+
+    def _class(self, name: str):
+        return self.handle.storage_classes.get(name)
+
+    # -- PreFilter ---------------------------------------------------------
+
+    @dataclass
+    class _State:
+        bound: List[PersistentVolumeClaim] = field(default_factory=list)
+        unbound_delayed: List[PersistentVolumeClaim] = field(default_factory=list)
+        # node name -> [(pvc, pv_name or "" for provisioning)]
+        node_decisions: Dict[str, List[Tuple[PersistentVolumeClaim, str]]] = field(default_factory=dict)
+
+        def clone(self) -> "VolumeBinding._State":
+            return VolumeBinding._State(
+                bound=list(self.bound),
+                unbound_delayed=list(self.unbound_delayed),
+                node_decisions={k: list(v) for k, v in self.node_decisions.items()},
+            )
+
+    def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Tuple[Optional[PreFilterResult], Status]:
+        names = _pod_pvc_names(pod)
+        if not names:
+            return None, Status.skip()
+        s = self._State()
+        for name in names:
+            pvc = self._pvc(pod.namespace, name)
+            if pvc is None:
+                return None, Status.unresolvable(
+                    f'persistentvolumeclaim "{name}" not found')
+            if pvc.volume_name:
+                s.bound.append(pvc)
+                continue
+            sc = self._class(pvc.storage_class)
+            if sc is not None and sc.volume_binding_mode == WAIT_FOR_FIRST_CONSUMER:
+                s.unbound_delayed.append(pvc)
+            else:
+                # Immediate-mode claims must be bound by the PV controller
+                # before scheduling (volume_binding.go PreFilter).
+                return None, Status.unresolvable(ERR_UNBOUND_IMMEDIATE)
+        state.write(self._KEY, s)
+        return None, OK
+
+    # -- Filter (binder.go FindPodVolumes) ---------------------------------
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        s: Optional[VolumeBinding._State] = state.read(self._KEY)
+        if s is None:
+            return OK
+        node = node_info.node
+        for pvc in s.bound:
+            pv = self._pv(pvc.volume_name)
+            if pv is None:
+                return Status.unresolvable(f'persistentvolume "{pvc.volume_name}" not found')
+            if pv.node_affinity is not None and not pv.node_affinity.matches(node):
+                return Status.unschedulable(ERR_NODE_CONFLICT)
+        if not s.unbound_delayed:
+            return OK
+        decisions: List[Tuple[PersistentVolumeClaim, str]] = []
+        used = set()
+        for pvc in s.unbound_delayed:
+            pv = self._find_matching_pv(pvc, node, used)
+            if pv is not None:
+                used.add(pv.name)
+                decisions.append((pvc, pv.name))
+                continue
+            sc = self._class(pvc.storage_class)
+            if sc is not None and sc.provisioner:
+                # Dynamic provisioning possible; honor allowedTopologies.
+                if sc.allowed_topologies is not None and not sc.allowed_topologies.matches(node):
+                    return Status.unschedulable(ERR_NO_MATCH)
+                decisions.append((pvc, ""))
+                continue
+            return Status.unschedulable(ERR_NO_MATCH)
+        s.node_decisions[node.name] = decisions
+        return OK
+
+    def _find_matching_pv(self, pvc: PersistentVolumeClaim, node, used) -> Optional[PersistentVolume]:
+        """binder.go findMatchingVolume: smallest available PV satisfying
+        class/modes/capacity/affinity."""
+        best = None
+        for pv in self.handle.pvs.values():
+            if pv.name in used or pv.claim_ref or pv.name in self.assumed:
+                continue
+            if pv.storage_class != pvc.storage_class:
+                continue
+            if not set(pvc.access_modes) <= set(pv.access_modes):
+                continue
+            if pv.capacity < pvc.request:
+                continue
+            if pv.node_affinity is not None and not pv.node_affinity.matches(node):
+                continue
+            if best is None or pv.capacity < best.capacity:
+                best = pv
+        return best
+
+    # -- Reserve / Unreserve / PreBind -------------------------------------
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        s: Optional[VolumeBinding._State] = state.read(self._KEY)
+        if s is None:
+            return OK
+        for pvc, pv_name in s.node_decisions.get(node_name, ()):
+            if pv_name:
+                self.assumed[pv_name] = pvc.key
+        return OK
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        s: Optional[VolumeBinding._State] = state.read(self._KEY)
+        if s is None:
+            return
+        for pvc, pv_name in s.node_decisions.get(node_name, ()):
+            if pv_name and self.assumed.get(pv_name) == pvc.key:
+                del self.assumed[pv_name]
+
+    def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        """BindPodVolumes (binder.go): write the PV↔PVC binds (and node
+        selection for provisioning) through the API."""
+        s: Optional[VolumeBinding._State] = state.read(self._KEY)
+        if s is None:
+            return OK
+        for pvc, pv_name in s.node_decisions.get(node_name, ()):
+            try:
+                self.handle.clientset.bind_volume(pvc, pv_name, node_name)
+            except Exception as e:  # noqa: BLE001
+                return Status.error(str(e))
+            self.assumed.pop(pv_name, None)
+        return OK
+
+
+class NodeVolumeLimits:
+    """nodevolumelimits/csi.go: per-CSI-driver attach limits."""
+
+    name = "NodeVolumeLimits"
+
+    def __init__(self, handle=None):
+        self.handle = handle
+
+    def _driver_of(self, pvc: PersistentVolumeClaim) -> str:
+        if pvc.volume_name:
+            pv = self.handle.pvs.get(pvc.volume_name)
+            if pv is not None and pv.csi_driver:
+                return pv.csi_driver
+        sc = self.handle.storage_classes.get(pvc.storage_class)
+        return sc.provisioner if sc is not None else ""
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        names = _pod_pvc_names(pod)
+        if not names:
+            return OK
+        csinode = self.handle.csi_nodes.get(node_info.name)
+        if csinode is None or not csinode.driver_limits:
+            return OK
+        new_per_driver: Dict[str, int] = {}
+        for name in names:
+            pvc = self.handle.pvcs.get(f"{pod.namespace}/{name}")
+            if pvc is None:
+                continue
+            d = self._driver_of(pvc)
+            if d:
+                new_per_driver[d] = new_per_driver.get(d, 0) + 1
+        if not new_per_driver:
+            return OK
+        # Existing attachments: the node's pods' PVC-backed volumes per driver
+        # (NodeInfo.pvc_ref_counts holds the per-node claim keys).
+        existing: Dict[str, int] = {}
+        for key, cnt in node_info.pvc_ref_counts.items():
+            pvc = self.handle.pvcs.get(key)
+            if pvc is None:
+                continue
+            d = self._driver_of(pvc)
+            if d:
+                existing[d] = existing.get(d, 0) + 1
+        for d, n_new in new_per_driver.items():
+            limit = csinode.driver_limits.get(d)
+            if limit is not None and existing.get(d, 0) + n_new > limit:
+                return Status.unschedulable(ERR_LIMIT)
+        return OK
+
+
+class VolumeZone:
+    """volumezone/: bound PVs' zone/region labels must match the node."""
+
+    name = "VolumeZone"
+    TOPOLOGY_KEYS = (LABEL_ZONE, LABEL_REGION,
+                     "failure-domain.beta.kubernetes.io/zone",
+                     "failure-domain.beta.kubernetes.io/region")
+
+    def __init__(self, handle=None):
+        self.handle = handle
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        node = node_info.node
+        for name in _pod_pvc_names(pod):
+            pvc = self.handle.pvcs.get(f"{pod.namespace}/{name}")
+            if pvc is None or not pvc.volume_name:
+                continue
+            pv = self.handle.pvs.get(pvc.volume_name)
+            if pv is None:
+                continue
+            for key in self.TOPOLOGY_KEYS:
+                pv_val = pv.labels.get(key)
+                if pv_val is None:
+                    continue
+                node_val = node.labels.get(key)
+                if node_val is None or node_val != pv_val:
+                    return Status.unschedulable(ERR_ZONE)
+        return OK
+
+
+class VolumeRestrictions:
+    """volumerestrictions/: ReadWriteOncePod access-mode conflicts."""
+
+    name = "VolumeRestrictions"
+
+    def __init__(self, handle=None):
+        self.handle = handle
+
+    def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Tuple[Optional[PreFilterResult], Status]:
+        names = _pod_pvc_names(pod)
+        if not names:
+            return None, Status.skip()
+        for name in names:
+            pvc = self.handle.pvcs.get(f"{pod.namespace}/{name}")
+            if pvc is None or RWOP not in pvc.access_modes:
+                continue
+            # RWOP: no other pod anywhere may use this claim
+            # (volumerestrictions isRWOPConflict via snapshot PVC refcounts).
+            snap = self.handle.snapshot() if callable(self.handle.snapshot) else self.handle.snapshot
+            key = f"{pod.namespace}/{name}"
+            for ni in snap.node_info_list:
+                if ni.pvc_ref_counts.get(key, 0) > 0:
+                    return None, Status.unschedulable(ERR_RWOP)
+        return None, OK
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        return OK

@@ -1,0 +1,140 @@
+"""Auxiliary subsystems: cache debugger, leader election, scheduler server,
+extra plugins (SURVEY.md §5, §2.3 tail)."""
+
+import json
+import urllib.request
+
+from kubernetes_tpu.core.config import PluginSet, ProfileConfig, SchedulerConfiguration
+from kubernetes_tpu.core.debugger import CacheDebugger
+from kubernetes_tpu.core.leaderelection import LeaderElector, LeaseStore
+from kubernetes_tpu.core.scheduler import Scheduler
+from kubernetes_tpu.core.server import SchedulerServer
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _basic_sched():
+    s = Scheduler()
+    s.clientset.create_node(
+        make_node().name("n0").capacity({"cpu": "4", "pods": 10}).obj())
+    s.clientset.create_pod(make_pod().name("p").req({"cpu": "1"}).obj())
+    s.run_until_idle()
+    return s
+
+
+class TestCacheDebugger:
+    def test_dump_and_compare_clean(self):
+        s = _basic_sched()
+        d = CacheDebugger(s)
+        out = d.dump()
+        assert "n0" in out and "Queue:" in out
+        assert d.compare() == []
+
+    def test_compare_detects_divergence(self):
+        s = _basic_sched()
+        # sabotage: drop the node from the cache behind the scheduler's back
+        s.cache.remove_node("n0")
+        d = CacheDebugger(s)
+        problems = d.compare()
+        assert any("n0" in p for p in problems)
+
+
+class TestLeaderElection:
+    def test_single_candidate_acquires(self):
+        store = LeaseStore()
+        t = [0.0]
+        e = LeaderElector(store, "a", now=lambda: t[0])
+        assert e.tick() and e.is_leader()
+
+    def test_failover_after_expiry(self):
+        store = LeaseStore()
+        t = [0.0]
+        a = LeaderElector(store, "a", now=lambda: t[0])
+        b = LeaderElector(store, "b", now=lambda: t[0])
+        assert a.tick()
+        assert not b.tick()  # a holds the lease
+        t[0] = 20.0          # a missed renewals past leaseDuration (15s)
+        assert b.tick() and b.is_leader()
+        assert not a.tick()  # a observes the takeover and steps down
+        assert not a.is_leader()
+
+    def test_voluntary_release(self):
+        store = LeaseStore()
+        a = LeaderElector(store, "a")
+        b = LeaderElector(store, "b")
+        a.tick()
+        a.release()
+        assert b.tick()
+
+
+class TestSchedulerServer:
+    def test_endpoints(self):
+        s = _basic_sched()
+        srv = SchedulerServer(s)
+        port = srv.serve()
+        try:
+            def get(path):
+                with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+                    return r.status, r.read().decode()
+
+            assert get("/healthz")[0] == 200
+            assert get("/readyz")[0] == 200
+            status, body = get("/metrics")
+            assert status == 200 and "scheduler_schedule_attempts_total" in body
+            status, body = get("/debug/cache")
+            assert status == 200 and "n0" in body
+            status, body = get("/debug/comparer")
+            assert status == 200 and json.loads(body) == []
+        finally:
+            srv.shutdown()
+
+    def test_run_cycles_requires_leadership(self):
+        store = LeaseStore()
+        s1 = Scheduler()
+        srv1 = SchedulerServer(s1, identity="a", lease_store=store, leader_elect=True)
+        s2 = Scheduler()
+        srv2 = SchedulerServer(s2, identity="b", lease_store=store, leader_elect=True)
+        for srv in (srv1, srv2):
+            srv.scheduler.clientset.create_node(
+                make_node().name("n0").capacity({"cpu": "4", "pods": 10}).obj())
+            srv.scheduler.clientset.create_pod(
+                make_pod().name("p").req({"cpu": "1"}).obj())
+        srv1.run_cycles()
+        srv2.run_cycles()
+        assert s1.scheduled == 1   # leader scheduled
+        assert s2.scheduled == 0   # standby did nothing
+
+
+class TestExtraPlugins:
+    def test_node_declared_features(self):
+        cfg = SchedulerConfiguration(profiles=[ProfileConfig(
+            plugins=PluginSet(enabled=(("NodeDeclaredFeatures", 0),)))])
+        s = Scheduler(config=cfg, deterministic_ties=True)
+        n_plain = make_node().name("plain").capacity({"cpu": "4", "pods": 10}).obj()
+        n_feat = make_node().name("featured").capacity({"cpu": "4", "pods": 10}).obj()
+        n_feat.declared_features = {"fast-net": True}
+        s.clientset.create_node(n_plain)
+        s.clientset.create_node(n_feat)
+        p = make_pod().name("p").req({"cpu": "1"}).obj()
+        p.annotations["features.k8s.io/required"] = "fast-net"
+        s.clientset.create_pod(p)
+        s.run_until_idle()
+        assert list(s.clientset.bindings.values()) == ["featured"]
+
+    def test_deferred_pod_scheduling(self):
+        t = [100.0]
+        cfg = SchedulerConfiguration(profiles=[ProfileConfig(
+            plugins=PluginSet(enabled=(("DeferredPodScheduling", 0),)),
+            plugin_config={"DeferredPodScheduling": {"now": lambda: t[0]}})])
+        s = Scheduler(config=cfg)
+        s.clientset.create_node(
+            make_node().name("n0").capacity({"cpu": "4", "pods": 10}).obj())
+        p = make_pod().name("deferred").req({"cpu": "1"}).obj()
+        p.annotations["scheduling.k8s.io/defer-until"] = "200.0"
+        s.clientset.create_pod(p)
+        s.run_until_idle()
+        assert s.scheduled == 0  # gated
+        t[0] = 250.0
+        updated = p  # annotation unchanged; deadline passed
+        s.clientset.update_pod(updated)
+        s.run_until_idle()
+        assert s.scheduled == 1

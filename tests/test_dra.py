@@ -1,0 +1,101 @@
+"""DynamicResources (DRA) plugin: structured-parameter claim allocation
+(reference plugins/dynamicresources/)."""
+
+from kubernetes_tpu.api.dra import (
+    Device,
+    DeviceClass,
+    DeviceRequest,
+    ResourceClaim,
+    ResourceSlice,
+)
+from kubernetes_tpu.core.config import PluginSet, ProfileConfig, SchedulerConfiguration
+from kubernetes_tpu.core.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _dra_sched():
+    cfg = SchedulerConfiguration(profiles=[ProfileConfig(
+        plugins=PluginSet(enabled=(("DynamicResources", 0),)))])
+    return Scheduler(config=cfg, deterministic_ties=True)
+
+
+def _gpu_node(s, name, n_gpus, gpu_type="a100"):
+    s.clientset.create_node(
+        make_node().name(name).capacity({"cpu": "16", "pods": 20}).obj())
+    s.clientset.create_resource_slice(ResourceSlice(
+        node_name=name, driver="gpu.example.com",
+        devices=[Device(name=f"{name}-gpu{i}", attributes={"type": gpu_type})
+                 for i in range(n_gpus)]))
+
+
+def _claim_pod(s, pod_name, claim_name, count=1, selectors=None, device_class=""):
+    s.clientset.create_resource_claim(ResourceClaim(
+        name=claim_name,
+        requests=[DeviceRequest(count=count, selectors=selectors or {},
+                                device_class=device_class)]))
+    p = make_pod().name(pod_name).req({"cpu": "1"}).obj()
+    p.resource_claims.append(claim_name)
+    s.clientset.create_pod(p)
+    return p
+
+
+class TestDynamicResources:
+    def test_allocates_devices_on_fitting_node(self):
+        s = _dra_sched()
+        _gpu_node(s, "cpu-only", 0)
+        _gpu_node(s, "gpu-node", 2)
+        _claim_pod(s, "p", "claim-a", count=2)
+        s.run_until_idle()
+        assert list(s.clientset.bindings.values()) == ["gpu-node"]
+        claim = s.clientset.resource_claims["default/claim-a"]
+        assert claim.allocated_node == "gpu-node"
+        assert len(claim.allocations) == 2
+        assert claim.reserved_for  # pod recorded
+
+    def test_devices_are_exclusive(self):
+        s = _dra_sched()
+        _gpu_node(s, "gpu-node", 1)
+        _claim_pod(s, "p1", "c1", count=1)
+        _claim_pod(s, "p2", "c2", count=1)
+        s.run_until_idle()
+        assert s.scheduled == 1  # second claim can't get the only GPU
+
+    def test_selector_matching(self):
+        s = _dra_sched()
+        _gpu_node(s, "a100-node", 1, gpu_type="a100")
+        _gpu_node(s, "h100-node", 1, gpu_type="h100")
+        _claim_pod(s, "p", "c", selectors={"type": "h100"})
+        s.run_until_idle()
+        assert list(s.clientset.bindings.values()) == ["h100-node"]
+
+    def test_device_class_selectors(self):
+        s = _dra_sched()
+        s.clientset.create_device_class(DeviceClass(
+            name="big-gpu", selectors={"type": "h100"}))
+        _gpu_node(s, "small", 4, gpu_type="a100")
+        _gpu_node(s, "big", 1, gpu_type="h100")
+        _claim_pod(s, "p", "c", device_class="big-gpu")
+        s.run_until_idle()
+        assert list(s.clientset.bindings.values()) == ["big"]
+
+    def test_preallocated_claim_pins_node(self):
+        s = _dra_sched()
+        _gpu_node(s, "n0", 1)
+        _gpu_node(s, "n1", 1)
+        claim = ResourceClaim(name="pinned", requests=[DeviceRequest(count=1)])
+        claim.allocated_node = "n1"
+        s.clientset.create_resource_claim(claim)
+        p = make_pod().name("p").req({"cpu": "1"}).obj()
+        p.resource_claims.append("pinned")
+        s.clientset.create_pod(p)
+        s.run_until_idle()
+        assert list(s.clientset.bindings.values()) == ["n1"]
+
+    def test_missing_claim_unresolvable(self):
+        s = _dra_sched()
+        _gpu_node(s, "n0", 1)
+        p = make_pod().name("p").req({"cpu": "1"}).obj()
+        p.resource_claims.append("no-such-claim")
+        s.clientset.create_pod(p)
+        s.run_until_idle()
+        assert s.scheduled == 0

@@ -1,0 +1,162 @@
+"""Extenders (extender.go), async API dispatcher (backend/api_dispatcher),
+and QueueingHints (scheduling_queue.go:582)."""
+
+import time
+
+from kubernetes_tpu.core.api_dispatcher import (
+    APICall,
+    APIDispatcher,
+    CALL_BINDING,
+    CALL_STATUS_PATCH,
+)
+from kubernetes_tpu.core.config import SchedulerConfiguration
+from kubernetes_tpu.core.extender import Extender
+from kubernetes_tpu.core.queue import (
+    EVENT_ASSIGNED_POD_DELETE,
+    EVENT_NODE_ADD,
+)
+from kubernetes_tpu.core.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _fake_transport(behavior):
+    """behavior: dict verb -> callable(payload) -> dict (fake_extender.go)."""
+    def call(verb, payload):
+        return behavior[verb](payload)
+    return call
+
+
+class TestExtender:
+    def _sched(self, ext):
+        cfg = SchedulerConfiguration()
+        cfg.extenders = [ext]
+        s = Scheduler(config=cfg, deterministic_ties=True)
+        for i in range(4):
+            s.clientset.create_node(
+                make_node().name(f"n{i}").capacity({"cpu": "4", "pods": 10}).obj())
+        return s
+
+    def test_extender_filter_narrows(self):
+        ext = Extender(name="x", filter_verb="filter", transport=_fake_transport({
+            "filter": lambda p: {"nodenames": ["n2"]}}))
+        s = self._sched(ext)
+        s.clientset.create_pod(make_pod().name("p").req({"cpu": "1"}).obj())
+        s.run_until_idle()
+        assert list(s.clientset.bindings.values()) == ["n2"]
+
+    def test_extender_prioritize(self):
+        ext = Extender(name="x", prioritize_verb="prioritize", weight=10,
+                       transport=_fake_transport({
+                           "prioritize": lambda p: {"hostPriorityList": [
+                               {"host": "n3", "score": 10}]}}))
+        s = self._sched(ext)
+        s.clientset.create_pod(make_pod().name("p").req({"cpu": "1"}).obj())
+        s.run_until_idle()
+        assert list(s.clientset.bindings.values()) == ["n3"]
+
+    def test_extender_bind(self):
+        bound = {}
+
+        def do_bind(p):
+            bound[p["podUID"]] = p["node"]
+            return {}
+
+        ext = Extender(name="x", bind_verb="bind",
+                       transport=_fake_transport({"bind": do_bind}))
+        s = self._sched(ext)
+        pod = make_pod().name("p").req({"cpu": "1"}).obj()
+        s.clientset.create_pod(pod)
+        s.run_until_idle()
+        assert bound.get(pod.uid)  # bind went through the extender
+
+    def test_ignorable_extender_error(self):
+        def boom(p):
+            raise RuntimeError("down")
+        ext = Extender(name="x", filter_verb="filter", ignorable=True,
+                       transport=_fake_transport({"filter": boom}))
+        s = self._sched(ext)
+        s.clientset.create_pod(make_pod().name("p").req({"cpu": "1"}).obj())
+        s.run_until_idle()
+        assert s.scheduled == 1  # ignorable: scheduling proceeds
+
+    def test_managed_resources_gating(self):
+        calls = []
+        ext = Extender(name="x", filter_verb="filter",
+                       managed_resources=("example.com/gpu",),
+                       transport=_fake_transport({
+                           "filter": lambda p: calls.append(1) or {"nodenames": []}}))
+        s = self._sched(ext)
+        s.clientset.create_pod(make_pod().name("cpu-only").req({"cpu": "1"}).obj())
+        s.run_until_idle()
+        assert s.scheduled == 1 and not calls  # not interested → not called
+
+
+class TestAPIDispatcher:
+    def test_inline_executes_immediately(self):
+        d = APIDispatcher(mode="inline")
+        hit = []
+        d.add(APICall(CALL_BINDING, "u1", lambda: hit.append(1)))
+        assert hit == [1] and d.executed == 1
+
+    def test_thread_mode_merging(self):
+        d = APIDispatcher(mode="thread")
+        try:
+            import threading
+            gate = threading.Event()
+            done = []
+            # Block the worker with one slow call, then pile up mergeable calls.
+            d.add(APICall(CALL_BINDING, "slow", lambda: gate.wait(2)))
+            time.sleep(0.05)
+            d.add(APICall(CALL_STATUS_PATCH, "p1", lambda: done.append("patch1")))
+            d.add(APICall(CALL_STATUS_PATCH, "p1", lambda: done.append("patch2")))
+            d.add(APICall(CALL_BINDING, "p1", lambda: done.append("bind")))
+            gate.set()
+            d.flush()
+            # patch slot was replaced then superseded by the binding.
+            assert done == ["bind"], done
+            assert d.merged == 2
+        finally:
+            d.close()
+
+    def test_scheduler_thread_dispatch(self):
+        cfg = SchedulerConfiguration(async_dispatch_threads=True)
+        s = Scheduler(config=cfg)
+        s.clientset.create_node(
+            make_node().name("n0").capacity({"cpu": "4", "pods": 10}).obj())
+        s.clientset.create_pod(make_pod().name("p").req({"cpu": "1"}).obj())
+        s.run_until_idle()
+        s.api_dispatcher.flush()
+        assert len(s.clientset.bindings) == 1
+        s.api_dispatcher.close()
+
+
+class TestQueueingHints:
+    def test_node_add_requeues_fit_failure(self):
+        s = Scheduler()
+        s.clientset.create_node(
+            make_node().name("small").capacity({"cpu": "1", "pods": 10}).obj())
+        s.clientset.create_pod(make_pod().name("big").req({"cpu": "8"}).obj())
+        s.run_until_idle()
+        assert s.scheduled == 0
+        s.clientset.create_node(
+            make_node().name("big-node").capacity({"cpu": "16", "pods": 10}).obj())
+        s.run_until_idle()
+        assert s.scheduled == 1
+
+    def test_irrelevant_event_does_not_requeue(self):
+        s = Scheduler()
+        s.clientset.create_node(
+            make_node().name("n0").capacity({"cpu": "4", "pods": 10})
+            .label("disk", "hdd").obj())
+        s.clientset.create_pod(
+            make_pod().name("needs-ssd").req({"cpu": "1"})
+            .node_selector({"disk": "ssd"}).obj())
+        s.run_until_idle()
+        assert s.scheduled == 0
+        # An assigned-pod delete can't fix a NodeAffinity rejection.
+        victim = make_pod().name("v").req({"cpu": "1"}).obj()
+        s.clientset.create_pod(victim)
+        s.run_until_idle()
+        s.clientset.delete_pod(victim)
+        active, backoff, unsched = s.queue.pending_counts()
+        assert unsched == 1 and active == 0 and backoff == 0

@@ -1,0 +1,79 @@
+"""Permit WAIT / waitingPods (framework.go:2097 WaitOnPermit) with the
+GangScheduling barrier plugin, and Storage/Add queueing-hint requeue."""
+
+from kubernetes_tpu.api.storage import WAIT_FOR_FIRST_CONSUMER, PersistentVolumeClaim, StorageClass
+from kubernetes_tpu.api.types import NodeSelector, NodeSelectorTerm, PodGroup, Volume
+from kubernetes_tpu.api.labels import IN, Requirement
+from kubernetes_tpu.api.storage import PersistentVolume
+from kubernetes_tpu.core.config import PluginSet, ProfileConfig, SchedulerConfiguration
+from kubernetes_tpu.core.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+class TestPermitBarrier:
+    def _sched(self):
+        # Gang entity mode OFF: members go through the per-pod Permit barrier
+        # (the feature-gated co-scheduling mode, gangscheduling.go).
+        cfg = SchedulerConfiguration(
+            feature_gates={"GenericWorkload": False, "CompositePodGroup": False},
+            profiles=[ProfileConfig(plugins=PluginSet(
+                enabled=(("GangScheduling", 0),)))])
+        s = Scheduler(config=cfg, deterministic_ties=True)
+        for i in range(4):
+            s.clientset.create_node(
+                make_node().name(f"n{i}").capacity({"cpu": "4", "pods": 10}).obj())
+        return s
+
+    def test_members_wait_then_release_together(self):
+        s = self._sched()
+        s.clientset.create_pod_group(PodGroup(name="gang", min_count=3))
+        for i in range(2):
+            p = make_pod().name(f"g{i}").req({"cpu": "1"}).obj()
+            p.pod_group = "gang"
+            s.clientset.create_pod(p)
+        s.run_until_idle()
+        # Two members parked at the barrier: reserved (assumed) but unbound.
+        assert s.scheduled == 0
+        assert len(s.waiting_pods) == 2
+        assert len(s.cache.assumed_pods) == 2
+        p = make_pod().name("g2").req({"cpu": "1"}).obj()
+        p.pod_group = "gang"
+        s.clientset.create_pod(p)
+        s.run_until_idle()
+        # Third member satisfied the quorum: all three bind.
+        assert s.scheduled == 3
+        assert not s.waiting_pods
+
+    def test_barrier_timeout_unwinds(self):
+        s = self._sched()
+        s.permit_wait_timeout = -1.0  # every wait is immediately expired
+        s.clientset.create_pod_group(PodGroup(name="gang", min_count=2))
+        p = make_pod().name("g0").req({"cpu": "1"}).obj()
+        p.pod_group = "gang"
+        s.clientset.create_pod(p)
+        s.run_until_idle()
+        assert s.scheduled == 0
+        assert not s.waiting_pods          # expired and unwound
+        assert not s.cache.assumed_pods    # reservation released
+
+
+class TestStorageEventRequeue:
+    def test_pv_creation_requeues_volume_failure(self):
+        s = Scheduler(deterministic_ties=True)
+        s.clientset.create_node(
+            make_node().name("n0").capacity({"cpu": "4", "pods": 10}).obj())
+        s.clientset.create_storage_class(StorageClass(
+            name="wffc", volume_binding_mode=WAIT_FOR_FIRST_CONSUMER))
+        s.clientset.create_pvc(PersistentVolumeClaim.of("c", "5Gi", storage_class="wffc"))
+        pod = make_pod().name("p").req({"cpu": "1"}).obj()
+        pod.volumes.append(Volume(name="data", pvc_name="c"))
+        s.clientset.create_pod(pod)
+        s.run_until_idle()
+        assert s.scheduled == 0  # no PV, no provisioner
+        # A matching PV appears → Storage/Add hint requeues the pod.
+        s.clientset.create_pv(PersistentVolume.of(
+            "pv-late", "10Gi", storage_class="wffc",
+            node_affinity=NodeSelector(terms=(NodeSelectorTerm(
+                match_fields=(Requirement("metadata.name", IN, ("n0",)),)),))))
+        s.run_until_idle()
+        assert s.scheduled == 1

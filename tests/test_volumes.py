@@ -1,0 +1,151 @@
+"""Volume plugins: VolumeBinding, NodeVolumeLimits, VolumeZone,
+VolumeRestrictions (reference plugins/volumebinding, nodevolumelimits/csi.go,
+volumezone, volumerestrictions)."""
+
+from kubernetes_tpu.api.labels import IN, Requirement
+from kubernetes_tpu.api.storage import (
+    RWO,
+    RWOP,
+    WAIT_FOR_FIRST_CONSUMER,
+    CSINode,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+)
+from kubernetes_tpu.api.types import NodeSelector, NodeSelectorTerm, Volume
+from kubernetes_tpu.core.scheduler import Scheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def _pv_on(name, node_name, capacity="10Gi", sc="fast", **kw):
+    return PersistentVolume.of(
+        name, capacity, storage_class=sc,
+        node_affinity=NodeSelector(terms=(NodeSelectorTerm(
+            match_fields=(Requirement("metadata.name", IN, (node_name,)),)),)),
+        **kw)
+
+
+def _pod_with_pvc(name, pvc_name, cpu="100m"):
+    p = make_pod().name(name).req({"cpu": cpu}).obj()
+    p.volumes.append(Volume(name="data", pvc_name=pvc_name))
+    return p
+
+
+class TestVolumeBinding:
+    def test_bound_pvc_node_affinity(self):
+        s = Scheduler(deterministic_ties=True)
+        for i in range(3):
+            s.clientset.create_node(
+                make_node().name(f"n{i}").capacity({"cpu": "4", "pods": 10}).obj())
+        pv = _pv_on("pv-1", "n2")
+        pvc = PersistentVolumeClaim.of("claim", "5Gi", storage_class="fast",
+                                       volume_name="pv-1")
+        s.clientset.create_pv(pv)
+        s.clientset.create_pvc(pvc)
+        s.clientset.create_pod(_pod_with_pvc("p", "claim"))
+        s.run_until_idle()
+        assert list(s.clientset.bindings.values()) == ["n2"]
+
+    def test_unbound_immediate_is_unresolvable(self):
+        s = Scheduler()
+        s.clientset.create_node(make_node().name("n0").capacity({"cpu": "4", "pods": 10}).obj())
+        s.clientset.create_storage_class(StorageClass(name="std", provisioner="x"))
+        s.clientset.create_pvc(PersistentVolumeClaim.of("c", "1Gi", storage_class="std"))
+        s.clientset.create_pod(_pod_with_pvc("p", "c"))
+        s.run_until_idle()
+        assert s.scheduled == 0 and s.failures >= 1
+
+    def test_wait_for_first_consumer_binds_pv(self):
+        s = Scheduler(deterministic_ties=True)
+        for i in range(2):
+            s.clientset.create_node(
+                make_node().name(f"n{i}").capacity({"cpu": "4", "pods": 10}).obj())
+        s.clientset.create_storage_class(StorageClass(
+            name="wffc", volume_binding_mode=WAIT_FOR_FIRST_CONSUMER))
+        s.clientset.create_pv(_pv_on("pv-a", "n1", sc="wffc"))
+        pvc = PersistentVolumeClaim.of("c", "5Gi", storage_class="wffc")
+        s.clientset.create_pvc(pvc)
+        s.clientset.create_pod(_pod_with_pvc("p", "c"))
+        s.run_until_idle()
+        assert list(s.clientset.bindings.values()) == ["n1"]
+        assert pvc.volume_name == "pv-a"
+        assert s.clientset.pvs["pv-a"].claim_ref == "default/c"
+
+    def test_wffc_dynamic_provisioning(self):
+        s = Scheduler(deterministic_ties=True)
+        s.clientset.create_node(make_node().name("n0").capacity({"cpu": "4", "pods": 10}).obj())
+        s.clientset.create_storage_class(StorageClass(
+            name="wffc", provisioner="csi.example.com",
+            volume_binding_mode=WAIT_FOR_FIRST_CONSUMER))
+        pvc = PersistentVolumeClaim.of("c", "5Gi", storage_class="wffc")
+        s.clientset.create_pvc(pvc)
+        s.clientset.create_pod(_pod_with_pvc("p", "c"))
+        s.run_until_idle()
+        assert s.scheduled == 1
+        assert pvc.volume_name.startswith("pvc-")  # provisioned PV
+
+    def test_two_claims_one_pv_conflict(self):
+        """Second pod must not reuse the PV the first pod's claim assumed."""
+        s = Scheduler(deterministic_ties=True)
+        for i in range(2):
+            s.clientset.create_node(
+                make_node().name(f"n{i}").capacity({"cpu": "4", "pods": 10}).obj())
+        s.clientset.create_storage_class(StorageClass(
+            name="wffc", volume_binding_mode=WAIT_FOR_FIRST_CONSUMER))
+        s.clientset.create_pv(_pv_on("only-pv", "n0", sc="wffc"))
+        s.clientset.create_pvc(PersistentVolumeClaim.of("c1", "1Gi", storage_class="wffc"))
+        s.clientset.create_pvc(PersistentVolumeClaim.of("c2", "1Gi", storage_class="wffc"))
+        s.clientset.create_pod(_pod_with_pvc("p1", "c1"))
+        s.clientset.create_pod(_pod_with_pvc("p2", "c2"))
+        s.run_until_idle()
+        assert s.scheduled == 1  # second claim has no PV and no provisioner
+
+
+class TestVolumeZone:
+    def test_zone_mismatch_rejected(self):
+        s = Scheduler(deterministic_ties=True)
+        s.clientset.create_node(
+            make_node().name("n0").capacity({"cpu": "4", "pods": 10}).zone("z1").obj())
+        s.clientset.create_node(
+            make_node().name("n1").capacity({"cpu": "4", "pods": 10}).zone("z2").obj())
+        pv = PersistentVolume.of("pv-z", "10Gi", storage_class="fast",
+                                 labels={ZONE: "z2"})
+        s.clientset.create_pv(pv)
+        s.clientset.create_pvc(PersistentVolumeClaim.of(
+            "c", "5Gi", storage_class="fast", volume_name="pv-z"))
+        s.clientset.create_pod(_pod_with_pvc("p", "c"))
+        s.run_until_idle()
+        assert list(s.clientset.bindings.values()) == ["n1"]
+
+
+class TestNodeVolumeLimits:
+    def test_csi_attach_limit(self):
+        s = Scheduler(deterministic_ties=True)
+        s.clientset.create_node(make_node().name("n0").capacity({"cpu": "8", "pods": 10}).obj())
+        s.clientset.create_csi_node(CSINode(node_name="n0",
+                                            driver_limits={"csi.x": 1}))
+        s.clientset.create_storage_class(StorageClass(
+            name="csi", provisioner="csi.x",
+            volume_binding_mode=WAIT_FOR_FIRST_CONSUMER))
+        for i in range(2):
+            s.clientset.create_pvc(PersistentVolumeClaim.of(
+                f"c{i}", "1Gi", storage_class="csi"))
+            s.clientset.create_pod(_pod_with_pvc(f"p{i}", f"c{i}"))
+        s.run_until_idle()
+        assert s.scheduled == 1  # limit 1 volume per node for driver csi.x
+
+
+class TestVolumeRestrictions:
+    def test_rwop_conflict(self):
+        s = Scheduler(deterministic_ties=True)
+        s.clientset.create_node(make_node().name("n0").capacity({"cpu": "8", "pods": 10}).obj())
+        s.clientset.create_pv(_pv_on("pv-1", "n0", sc="fast"))
+        pvc = PersistentVolumeClaim.of("c", "1Gi", storage_class="fast",
+                                       volume_name="pv-1", access_modes=(RWOP,))
+        s.clientset.create_pvc(pvc)
+        s.clientset.create_pod(_pod_with_pvc("p1", "c"))
+        s.clientset.create_pod(_pod_with_pvc("p2", "c"))
+        s.run_until_idle()
+        assert s.scheduled == 1  # second user of the RWOP claim is rejected
