@@ -110,6 +110,49 @@ def _normalize_default_reverse(raw, kept):
                      jnp.int64(MAX_NODE_SCORE))
 
 
+def _resource_eval(f: BatchFeatures, fit_strategy: int,
+                   alloc_r, alloc_pods, req_r, nonzero, pod_count):
+    """Fit filter (fit.go:710) + LeastAllocated/MostAllocated score +
+    integer-quantized BalancedAllocation for any leading shape (all nodes
+    pre-scan; a single updated row inside the scan — these values only change
+    at the row a pod landed on, so the scan carries them instead of
+    recomputing [NP, R] work per step)."""
+    pods_ok = (pod_count + 1).astype(jnp.int64) <= alloc_pods
+    viol = ((f.request > 0) & (f.request > alloc_r - req_r)).any(axis=-1)
+    fit_ok = (pods_ok & (~viol | (f.has_request == 0))) | (f.enable[4] == 0)
+    used0 = nonzero[..., 0] + f.nz_request[0]
+    used1 = nonzero[..., 1] + f.nz_request[1]
+    fit_num = jnp.zeros_like(used0)
+    fit_den = jnp.zeros_like(used0)
+    for j in range(f.fit_slots.shape[0]):
+        slot = f.fit_slots[j]
+        w = f.fit_weights[j]
+        alloc = jnp.take(alloc_r, slot, axis=-1)
+        used = jnp.where(slot == 0, used0,
+                         jnp.where(slot == 1, used1,
+                                   jnp.take(req_r, slot, axis=-1) + f.request[slot]))
+        if fit_strategy == 0:  # LeastAllocated
+            rscore = jnp.where((alloc > 0) & (used <= alloc),
+                               (alloc - used) * MAX_NODE_SCORE // jnp.maximum(alloc, 1), 0)
+        else:  # MostAllocated
+            rscore = jnp.where(alloc > 0,
+                               jnp.minimum(used, alloc) * MAX_NODE_SCORE // jnp.maximum(alloc, 1), 0)
+        fit_num = fit_num + jnp.where(alloc > 0, rscore * w, 0)
+        fit_den = fit_den + jnp.where(alloc > 0, w, 0)
+    fit_sc = jnp.where(fit_den > 0, fit_num // jnp.maximum(fit_den, 1), 0)
+    SCALE = jnp.int64(1_000_000)
+    a_cpu = alloc_r[..., 0]
+    a_mem = alloc_r[..., 1]
+    q_cpu = jnp.minimum(used0 * SCALE // jnp.maximum(a_cpu, 1), SCALE)
+    q_mem = jnp.minimum(used1 * SCALE // jnp.maximum(a_mem, 1), SCALE)
+    both = (a_cpu > 0) & (a_mem > 0)
+    ba_val = jnp.where(both,
+                       (MAX_NODE_SCORE * SCALE - 50 * jnp.abs(q_cpu - q_mem)) // SCALE,
+                       jnp.int64(MAX_NODE_SCORE))
+    ba = jnp.where(f.ba_skip == 1, 0, ba_val)
+    return fit_ok, fit_sc, ba
+
+
 @partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax"))
 def schedule_batch(
     state: DeviceNodeState,
@@ -164,15 +207,9 @@ def schedule_batch(
     n_act = jnp.int32(batch_pad) if n_active is None else n_active.astype(jnp.int32)
 
     def step(carry, t):
-        (req_r, nonzero, pod_count, dns_counts, sa_counts,
-         anti_counts, aff_counts, ipa_delta, start) = carry
+        (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
+         dns_counts, sa_counts, anti_counts, aff_counts, ipa_delta, start) = carry
         active = t < n_act
-
-        # ---- Fit filter (fit.go:710) --------------------------------------
-        pods_ok = (pod_count + 1).astype(jnp.int64) <= state.alloc_pods
-        viol = ((f.request[None, :] > 0) &
-                (f.request[None, :] > state.alloc_r - req_r)).any(axis=1)
-        fit_ok = (pods_ok & (~viol | (f.has_request == 0))) | (f.enable[4] == 0)
 
         # ---- PTS DoNotSchedule filter (filtering.go:318-362) --------------
         if C1:
@@ -207,53 +244,22 @@ def schedule_batch(
         ok = static_ok & fit_ok & dns_ok & anti_ok & aff_ok
 
         # ---- sampling truncation + rotation (schedule_one.go:779-892) -----
-        rot_rows = (start + idx) % num                     # rotation order -> row
-        feas_rot = jnp.where(idx < num, ok[rot_rows], False)
-        cum = jnp.cumsum(feas_rot.astype(jnp.int32))
-        kept_rot = feas_rot & (cum <= f.to_find)
-        stop_pos = jnp.min(jnp.where(feas_rot & (cum == f.to_find), idx, _BIG))
-        evaluated = jnp.where(stop_pos < _BIG, stop_pos + 1, num)
+        # Gather-free formulation: rank[row] = #feasible rows at rotation
+        # positions <= rot(row), from ONE row-order cumsum with wrap
+        # adjustment (feasible count in [start..row] resp. wrapped).
+        okd = ok & (idx < num)
+        F = jnp.cumsum(okd.astype(jnp.int32))              # inclusive, row order
+        total_feas = F[-1]
+        f_start = jnp.where(start > 0, F[jnp.maximum(start - 1, 0)], 0)
+        rank = jnp.where(idx >= start, F - f_start, F + total_feas - f_start)
+        kept = okd & (rank <= f.to_find)
         rot_of_row = (idx - start) % num                   # row -> rotation pos
-        kept = jnp.where(idx < num, kept_rot[rot_of_row], False) & ok
+        evaluated = jnp.min(jnp.where(okd & (rank == f.to_find), rot_of_row + 1, num))
 
         # ---- scores over the kept set ------------------------------------
-        # TaintToleration ×w_tt (reverse-normalized)
+        # TaintToleration ×w_tt (reverse-normalized); fit_sc/ba ride the
+        # carry (recomputed only for the landed row).
         tt = _normalize_default_reverse(pns_cnt, kept)
-        # NodeResourcesFit ×w_fit
-        used0 = nonzero[:, 0] + f.nz_request[0]
-        used1 = nonzero[:, 1] + f.nz_request[1]
-        # Per-node weight_sum excludes resources with alloc==0, as the host
-        # oracle's `if alloc == 0: continue` does (noderesources.py Fit.score).
-        fit_num = jnp.zeros(NP, jnp.int64)
-        fit_den = jnp.zeros(NP, jnp.int64)
-        for j in range(f.fit_slots.shape[0]):
-            slot = f.fit_slots[j]
-            w = f.fit_weights[j]
-            alloc = state.alloc_r[:, slot]
-            used = jnp.where(slot == 0, used0,
-                             jnp.where(slot == 1, used1,
-                                       req_r[:, slot] + f.request[slot]))
-            if fit_strategy == 0:  # LeastAllocated
-                rscore = jnp.where((alloc > 0) & (used <= alloc),
-                                   (alloc - used) * MAX_NODE_SCORE // jnp.maximum(alloc, 1), 0)
-            else:  # MostAllocated
-                rscore = jnp.where(alloc > 0,
-                                   jnp.minimum(used, alloc) * MAX_NODE_SCORE // jnp.maximum(alloc, 1), 0)
-            fit_num = fit_num + jnp.where(alloc > 0, rscore * w, 0)
-            fit_den = fit_den + jnp.where(alloc > 0, w, 0)
-        fit_sc = jnp.where(fit_den > 0, fit_num // jnp.maximum(fit_den, 1), 0)
-        # BalancedAllocation ×w_ba (integer-quantized two-resource path)
-        SCALE = jnp.int64(1_000_000)
-        a_cpu = state.alloc_r[:, 0]
-        a_mem = state.alloc_r[:, 1]
-        q_cpu = jnp.minimum(used0 * SCALE // jnp.maximum(a_cpu, 1), SCALE)
-        q_mem = jnp.minimum(used1 * SCALE // jnp.maximum(a_mem, 1), SCALE)
-        both = (a_cpu > 0) & (a_mem > 0)
-        ba_val = jnp.where(
-            both,
-            (MAX_NODE_SCORE * SCALE - 50 * jnp.abs(q_cpu - q_mem)) // SCALE,
-            jnp.int64(MAX_NODE_SCORE))
-        ba = jnp.where(f.ba_skip == 1, 0, ba_val)
         # PodTopologySpread ScheduleAnyway ×w_pts (scoring.go)
         if C2:
             s_cnt = jnp.take_along_axis(sa_counts.astype(jnp.int64), sa_vid.astype(jnp.int64), axis=1)
@@ -294,6 +300,14 @@ def schedule_batch(
         req_r = req_r.at[row].add(f.request * apply)
         nonzero = nonzero.at[row].add(f.nz_request * apply)
         pod_count = pod_count.at[row].add(apply.astype(jnp.int32))
+        # Re-evaluate ONLY the landed row's resource-derived values (when
+        # nothing was applied the inputs are unchanged, so this is identity).
+        r_ok, r_fit, r_ba = _resource_eval(
+            f, fit_strategy, state.alloc_r[row], state.alloc_pods[row],
+            req_r[row], nonzero[row], pod_count[row])
+        fit_ok = fit_ok.at[row].set(r_ok)
+        fit_sc = fit_sc.at[row].set(r_fit)
+        ba = ba.at[row].set(r_ba)
         if C1:
             upd = (f.dns_self * dns_elig[jnp.arange(C1), row].astype(jnp.int32)
                    * apply.astype(jnp.int32))
@@ -312,12 +326,17 @@ def schedule_batch(
             ipa_delta = ipa_delta.at[jnp.arange(KD), ipa_vid[:, row]].add(upd)
         start = jnp.where(active, (start + evaluated) % num, start).astype(jnp.int32)
 
-        new_carry = (req_r, nonzero, pod_count, dns_counts, sa_counts,
-                     anti_counts, aff_counts, ipa_delta, start)
+        new_carry = (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
+                     dns_counts, sa_counts, anti_counts, aff_counts,
+                     ipa_delta, start)
         return new_carry, (chosen, start)
 
+    fit_ok0, fit_sc0, ba0 = _resource_eval(
+        f, fit_strategy, state.alloc_r, state.alloc_pods,
+        state.req_r, state.nonzero, state.pod_count)
     ipa_delta0 = jnp.zeros((KD, vmax), jnp.int64)
     carry0 = (state.req_r, state.nonzero, state.pod_count,
+              fit_ok0, fit_sc0, ba0,
               f.dns_counts, f.sa_counts, f.anti_counts, f.aff_counts,
               ipa_delta0, f.start_index)
     final, (chosen, starts) = lax.scan(
