@@ -49,12 +49,18 @@ class APIDispatcher:
         self._pending: Dict[Tuple[str, str], APICall] = {}
         self._order: List[Tuple[str, str]] = []
         self._lock = threading.Lock()
-        self._wake = threading.Event()
+        self._cv = threading.Condition(self._lock)
+        self._in_flight = 0
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self.executed = 0
         self.merged = 0
         self.errors: List[str] = []
+        # Thread-mode failures land here instead of running on_error on the
+        # worker thread: on_error handlers mutate cache/queue state owned by
+        # the scheduling loop, so the loop drains this inbox itself
+        # (drain_errors), keeping all cache/queue mutation single-threaded.
+        self._error_inbox: List[Tuple[APICall, Exception]] = []
         if mode == "thread":
             self._thread = threading.Thread(target=self._run, daemon=True)
             self._thread.start()
@@ -68,7 +74,7 @@ class APIDispatcher:
         key = (call.call_type, call.object_uid)
         skip_key = (CALL_STATUS_PATCH, call.object_uid) \
             if call.call_type == CALL_BINDING else None
-        with self._lock:
+        with self._cv:
             if key in self._pending:
                 self.merged += 1  # replace: newest call wins its slot
                 self._pending[key] = call
@@ -81,53 +87,68 @@ class APIDispatcher:
                 self._pending.pop(skip_key)
                 self._order.remove(skip_key)
                 self.merged += 1
-        self._wake.set()
+            self._cv.notify_all()
 
-    def _execute(self, call: APICall) -> None:
+    def _execute(self, call: APICall, defer_errors: bool = False) -> None:
         try:
             call.execute()
             self.executed += 1
         except Exception as e:  # noqa: BLE001
             self.errors.append(f"{call.call_type}/{call.object_uid}: {e!r}")
-            if call.on_error is not None:
+            if call.on_error is None:
+                return
+            if defer_errors:
+                with self._cv:
+                    self._error_inbox.append((call, e))
+            else:
                 call.on_error(e)
 
     # -- worker ------------------------------------------------------------
 
-    def _next(self) -> Optional[APICall]:
-        with self._lock:
-            while self._order:
-                key = self._order.pop(0)
-                call = self._pending.pop(key, None)
-                if call is not None:
-                    return call
-        return None
-
     def _run(self) -> None:
         while not self._stop:
-            call = self._next()
-            if call is None:
-                self._wake.wait(timeout=0.05)
-                self._wake.clear()
-                continue
-            self._execute(call)
+            with self._cv:
+                call = None
+                while self._order:
+                    key = self._order.pop(0)
+                    call = self._pending.pop(key, None)
+                    if call is not None:
+                        break
+                if call is None:
+                    self._cv.wait(timeout=0.05)
+                    continue
+                self._in_flight += 1
+            try:
+                self._execute(call, defer_errors=True)
+            finally:
+                with self._cv:
+                    self._in_flight -= 1
+                    self._cv.notify_all()
+
+    def has_errors(self) -> bool:
+        """Cheap emptiness probe (list read is atomic under the GIL)."""
+        return bool(self._error_inbox)
+
+    def drain_errors(self) -> List[Tuple[APICall, Exception]]:
+        """Take pending (call, exception) failures. The scheduling loop calls
+        this and runs on_error handlers on its own thread."""
+        with self._cv:
+            out, self._error_inbox = self._error_inbox, []
+        return out
 
     def flush(self, timeout: float = 5.0) -> None:
-        """Drain everything (test/bench determinism barrier)."""
+        """True drain barrier: waits until the queue is empty AND no call is
+        mid-execution on the worker (test/bench determinism barrier)."""
         if self.mode == "inline":
             return
-        import time
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self._lock:
-                if not self._order:
-                    return
-            self._wake.set()
-            time.sleep(0.001)
+        with self._cv:
+            self._cv.wait_for(
+                lambda: not self._order and self._in_flight == 0, timeout=timeout)
 
     def close(self) -> None:
         self._stop = True
-        self._wake.set()
+        with self._cv:
+            self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=1.0)
 

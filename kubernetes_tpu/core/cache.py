@@ -81,7 +81,14 @@ class Cache:
         self.ttl = ttl_seconds
         self.now = now
         self.nodes: Dict[str, NodeInfo] = {}
-        self.node_order: List[str] = []  # stable snapshot order
+        # Snapshot order = zone-interleaved NodeTree order + imaginary
+        # placeholders; rebuilt lazily when tree membership changes, so
+        # truncated sampling spreads across zones exactly as the reference's
+        # updateNodeInfoSnapshotList does (backend/cache/snapshot.go,
+        # node_tree.go list()).
+        self.node_order: List[str] = []
+        self._imaginary: List[str] = []  # pods observed before their node
+        self._order_dirty = False
         self.node_tree = NodeTree()
         self.assumed_pods: Set[str] = set()
         self.pod_states: Dict[str, _PodState] = {}
@@ -96,10 +103,13 @@ class Cache:
         if ni is None:
             ni = NodeInfo(node)
             self.nodes[node.name] = ni
-            self.node_order.append(node.name)
         else:
             ni.set_node(node)
-        self.node_tree.add_node(node)
+        if node.name in self._imaginary:  # placeholder became real
+            self._imaginary.remove(node.name)
+            self._order_dirty = True
+        if self.node_tree.add_node(node):
+            self._order_dirty = True
         self._dirty.add(node.name)
         return ni
 
@@ -111,7 +121,9 @@ class Cache:
         if ni is not None:
             if ni.node is not None:
                 self.node_tree.remove_node(ni.node)
-            self.node_order.remove(node_name)
+            if node_name in self._imaginary:
+                self._imaginary.remove(node_name)
+            self._order_dirty = True
             self._removed_since_snapshot = True
         self._dirty.discard(node_name)
 
@@ -205,7 +217,8 @@ class Cache:
             # keeps an imaginary nodeInfo so pods on deleted nodes still count).
             ni = NodeInfo()
             self.nodes[pod.node_name] = ni
-            self.node_order.append(pod.node_name)
+            self._imaginary.append(pod.node_name)
+            self._order_dirty = True
         ni.add_pod(PodInfo.of(pod))
         self._dirty.add(pod.node_name)
 
@@ -219,6 +232,9 @@ class Cache:
 
     def update_snapshot(self, snapshot: Snapshot) -> Snapshot:
         """UpdateSnapshot (cache.go:206): re-clone only dirty NodeInfos."""
+        if self._order_dirty:
+            self.node_order = self.node_tree.list() + list(self._imaginary)
+            self._order_dirty = False
         structural = self._removed_since_snapshot or (
             len(snapshot.node_info_list) != len(self.node_order)
         )

@@ -21,26 +21,39 @@ class NodeTree:
     def __init__(self):
         self.tree: Dict[str, List[str]] = {}
         self.zones: List[str] = []
+        self.node_zone: Dict[str, str] = {}
         self.num_nodes = 0
 
-    def add_node(self, node: Node) -> None:
+    def add_node(self, node: Node) -> bool:
+        """Add or re-bucket a node. Returns True when tree structure changed
+        (new node, or an existing node moved zones — node_tree.go updateNode)."""
         zone = _zone_key(node)
+        old_zone = self.node_zone.get(node.name)
+        if old_zone == zone:
+            return False
+        if old_zone is not None:
+            self._remove_from_zone(node.name, old_zone)
         if zone not in self.tree:
             self.tree[zone] = []
             self.zones.append(zone)
-        if node.name not in self.tree[zone]:
-            self.tree[zone].append(node.name)
-            self.num_nodes += 1
+        self.tree[zone].append(node.name)
+        self.node_zone[node.name] = zone
+        self.num_nodes += 1
+        return True
 
-    def remove_node(self, node: Node) -> None:
-        zone = _zone_key(node)
+    def _remove_from_zone(self, name: str, zone: str) -> None:
         names = self.tree.get(zone)
-        if names and node.name in names:
-            names.remove(node.name)
+        if names and name in names:
+            names.remove(name)
             self.num_nodes -= 1
             if not names:
                 del self.tree[zone]
                 self.zones.remove(zone)
+        self.node_zone.pop(name, None)
+
+    def remove_node(self, node: Node) -> None:
+        zone = self.node_zone.get(node.name, _zone_key(node))
+        self._remove_from_zone(node.name, zone)
 
     def list(self) -> List[str]:
         """Round-robin across zones (node_tree.go list())."""

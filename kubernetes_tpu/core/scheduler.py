@@ -27,6 +27,7 @@ OpportunisticBatching (runtime/batch.go) the survey calls for (§2.4).
 from __future__ import annotations
 
 import random
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -255,16 +256,47 @@ class Scheduler:
         self.scheduled = 0
         self.failures = 0
         self.error_log: List[str] = []
+        # Off-thread watch-event inbox (see _threaded): deque append/popleft
+        # are atomic under the GIL, so no lock is needed.
+        from collections import deque
+        self._event_inbox = deque()
+        self._loop_thread = threading.current_thread()
         self._wire_event_handlers()
 
     # -- event handlers (eventhandlers.go:624 addAllEventHandlers) ---------
 
     def _wire_event_handlers(self) -> None:
-        self.clientset.on_pod_event(self._on_pod_event)
-        self.clientset.on_node_event(self._on_node_event)
-        self.clientset.on_namespace_event(self.cache.add_namespace)
-        self.clientset.on_pod_group_event(self.queue.register_pod_group)
-        self.clientset.on_storage_event(self._on_storage_event)
+        self.clientset.on_pod_event(self._threaded(self._on_pod_event))
+        self.clientset.on_node_event(self._threaded(self._on_node_event))
+        self.clientset.on_namespace_event(self._threaded(self.cache.add_namespace))
+        self.clientset.on_pod_group_event(self._threaded(self.queue.register_pod_group))
+        self.clientset.on_storage_event(self._threaded(self._on_storage_event))
+
+    def _threaded(self, handler):
+        """Watch events raised off the scheduling thread (e.g. the thread-mode
+        dispatcher's bind fanning out through the clientset) are parked in an
+        inbox and replayed by the scheduling loop — the DeltaFIFO seam
+        (client-go delta_fifo.go): cache/queue mutation stays single-threaded.
+        Events raised on the scheduling thread dispatch inline, preserving the
+        synchronous semantics tests rely on."""
+        def dispatch(*args):
+            if threading.current_thread() is self._loop_thread:
+                handler(*args)
+            else:
+                self._event_inbox.append((handler, args))
+        return dispatch
+
+    def drain_event_inbox(self) -> int:
+        """Replay off-thread watch events on the scheduling loop."""
+        n = 0
+        while self._event_inbox:
+            try:
+                handler, args = self._event_inbox.popleft()
+            except IndexError:
+                break
+            handler(*args)
+            n += 1
+        return n
 
     def _on_storage_event(self, kind: str, obj) -> None:
         from .queue import EVENT_STORAGE_ADD
@@ -325,14 +357,33 @@ class Scheduler:
             if not self.schedule_one():
                 self.queue.flush_backoff_completed()
                 self.flush_expired_waiters()
+                # Drain async bind failures on THIS thread (the inbox keeps
+                # cache/queue mutation off the dispatcher worker), then
+                # re-check: an unwound pod goes back onto the queue.
+                self.api_dispatcher.flush()
+                self.process_async_api_errors()
                 if not self.schedule_one():
                     break
             n += 1
         return n
 
+    def process_async_api_errors(self) -> int:
+        """Run deferred thread-mode on_error handlers on the scheduling loop
+        (the reference's dispatcher invokes onError on the scheduling side via
+        the cache adapter; backend/api_dispatcher/). Also replays off-thread
+        watch events parked by _threaded. Cheap no-op when both are empty."""
+        self.drain_event_inbox()
+        if not self.api_dispatcher.has_errors():
+            return 0
+        drained = self.api_dispatcher.drain_errors()
+        for call, exc in drained:
+            call.on_error(exc)
+        return len(drained)
+
     # -- one cycle ---------------------------------------------------------
 
     def schedule_one(self) -> bool:
+        self.process_async_api_errors()
         qpi = self.queue.pop()
         if qpi is None:
             return False
@@ -473,6 +524,14 @@ class Scheduler:
             st = fw.run_reserve_plugins_reserve(state, m.pod, result.suggested_host)
             if st.is_success():
                 st = fw.run_permit_plugins(state, m.pod, result.suggested_host)
+            if st.code == WAIT:
+                # WaitOnPermit (framework.go:2097): the member stays reserved
+                # and parks until a Permit plugin allows/rejects it or the
+                # wait times out — not a failure.
+                self.waiting_pods[m.pod.uid] = (
+                    fw, state, m, result, self.now() + self.permit_wait_timeout)
+                committed_uids.add(m.pod.uid)
+                continue
             if not st.is_success():
                 fw.run_reserve_plugins_unreserve(state, m.pod, result.suggested_host)
                 self.cache.forget_pod(m.pod)

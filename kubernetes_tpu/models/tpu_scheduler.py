@@ -30,7 +30,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from ..core.framework import Framework
+from ..core.framework import WAIT, Framework
 from ..core.queue import QueuedPodGroupInfo, QueuedPodInfo
 from ..core.scheduler import Scheduler, ScheduleResult
 from ..ops.device_state import NodeStateMirror
@@ -209,7 +209,12 @@ class TPUScheduler(Scheduler):
             if diverged:
                 # A previous commit in this batch failed, so every later
                 # device choice was computed against state that no longer
-                # holds — fall back to the host path for the rest.
+                # holds — fall back to the host path for the rest. The carry
+                # still charged those pods' placements to their device-chosen
+                # rows, so mark them dirty for re-upload (the host path may
+                # have placed them elsewhere, or failed).
+                if row >= 0:
+                    dirty_rows.append(row)
                 self.host_path_pods += 1
                 self.process_one(qpi)
                 continue
@@ -262,6 +267,17 @@ class TPUScheduler(Scheduler):
             self.handle_scheduling_failure(fw, qpi, st, None)
             self.queue.done(pod.uid)
             return False
+        if st.code == WAIT:
+            # WaitOnPermit (framework.go:2097): park exactly as process_one
+            # does — the pod stays assumed on the node, so the device carry
+            # remains correct (no divergence).
+            self.waiting_pods[pod.uid] = (
+                fw, state, qpi, ScheduleResult(suggested_host=node_name),
+                self.now() + self.permit_wait_timeout)
+            self.queue.done(pod.uid)
+            # Not counted in device_scheduled yet: the bind outcome is only
+            # known when the waiter is allowed/rejected.
+            return True
         if not self.run_binding_cycle(fw, state, qpi, ScheduleResult(suggested_host=node_name)):
             self.queue.done(pod.uid)
             return False  # bind failed and unwound
@@ -274,6 +290,7 @@ class TPUScheduler(Scheduler):
     def schedule_one(self) -> bool:
         if not self.device_enabled:
             return super().schedule_one()  # TPUBatchScheduling gate off
+        self.process_async_api_errors()
         fw, batch, fallback_reason = self._collect_batch()
         if not batch:
             return False

@@ -236,7 +236,11 @@ def schedule_batch(
             term_ok = (f.aff_active[:, None] == 0) | ((aff_vid > 0) & (f_cnt > 0))
             all_matched = term_ok.all(axis=0)
             total = (aff_counts * (f.aff_active[:, None] == 1)).sum()
-            bootstrap = (total == 0) & (f.aff_own_all == 1)
+            # Bootstrap only applies on nodes carrying every requested
+            # topology key (satisfyPodAffinity checks key presence before the
+            # no-matches-anywhere case, filtering.go:398-426).
+            has_keys = ((f.aff_active[:, None] == 0) | (aff_vid > 0)).all(axis=0)
+            bootstrap = (total == 0) & (f.aff_own_all == 1) & has_keys
             aff_ok = all_matched | bootstrap
         else:
             aff_ok = jnp.ones(NP, bool)

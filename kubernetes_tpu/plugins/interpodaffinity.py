@@ -179,16 +179,24 @@ class InterPodAffinity:
         # incoming pod's affinity (filtering.go:398 satisfyPodAffinity).
         if s.affinity_terms:
             all_matched = True
+            has_all_keys = True
             for i, term in enumerate(s.affinity_terms):
                 tp_val = node.labels.get(term.topology_key)
-                if tp_val is None or s.affinity_counts[i].get(tp_val, 0) == 0:
+                if tp_val is None:
+                    # satisfyPodAffinity (interpodaffinity/filtering.go:398):
+                    # a node missing any term's topology key can never satisfy
+                    # the term — not even via the bootstrap case below. Keep
+                    # walking all terms so has_all_keys reflects every key.
+                    has_all_keys = False
                     all_matched = False
-                    break
+                elif s.affinity_counts[i].get(tp_val, 0) == 0:
+                    all_matched = False
             if not all_matched:
                 # Bootstrap special case: no pod anywhere matches any term and
-                # the incoming pod matches its own terms => allow.
+                # the incoming pod matches its own terms => allow (on nodes
+                # that carry every requested topology key).
                 no_matches_anywhere = all(not c for c in s.affinity_counts)
-                if no_matches_anywhere and all(
+                if has_all_keys and no_matches_anywhere and all(
                     term.matches(pod, self._ns_labels) for term in s.affinity_terms
                 ):
                     return OK

@@ -29,7 +29,7 @@ from ..api.storage import (
 )
 from ..api.types import LABEL_ZONE, LABEL_REGION, Pod
 from ..core.framework import OK, CycleState, PreFilterResult, Status
-from ..core.node_info import NodeInfo
+from ..core.node_info import NodeInfo, PodInfo
 
 ERR_UNBOUND_IMMEDIATE = "pod has unbound immediate PersistentVolumeClaims"
 ERR_NODE_CONFLICT = "node(s) had volume node affinity conflict"
@@ -280,22 +280,62 @@ class VolumeRestrictions:
     def __init__(self, handle=None):
         self.handle = handle
 
+    _KEY = "PreFilterVolumeRestrictions"
+
     def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Tuple[Optional[PreFilterResult], Status]:
         names = _pod_pvc_names(pod)
         if not names:
             return None, Status.skip()
+        # RWOP: no other pod anywhere may use these claims. The cluster-wide
+        # refcount rides cycle state so preemption dry-runs can adjust it via
+        # add_pod/remove_pod and discover victims whose eviction clears the
+        # conflict (volumerestrictions isRWOPConflict + AddPod/RemovePod).
+        rwop_keys = set()
         for name in names:
             pvc = self.handle.pvcs.get(f"{pod.namespace}/{name}")
-            if pvc is None or RWOP not in pvc.access_modes:
-                continue
-            # RWOP: no other pod anywhere may use this claim
-            # (volumerestrictions isRWOPConflict via snapshot PVC refcounts).
+            if pvc is not None and RWOP in pvc.access_modes:
+                rwop_keys.add(f"{pod.namespace}/{name}")
+        conflicts = 0
+        if rwop_keys:
             snap = self.handle.snapshot() if callable(self.handle.snapshot) else self.handle.snapshot
-            key = f"{pod.namespace}/{name}"
             for ni in snap.node_info_list:
-                if ni.pvc_ref_counts.get(key, 0) > 0:
-                    return None, Status.unschedulable(ERR_RWOP)
+                for key in rwop_keys:
+                    conflicts += ni.pvc_ref_counts.get(key, 0)
+        state.write(self._KEY, _RWOPState(rwop_keys, conflicts))
         return None, OK
 
-    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+    def _uses_rwop(self, s: "_RWOPState", pi: PodInfo) -> int:
+        n = 0
+        for name in _pod_pvc_names(pi.pod):
+            if f"{pi.pod.namespace}/{name}" in s.rwop_keys:
+                n += 1
+        return n
+
+    def add_pod(self, state: CycleState, pod: Pod, added: PodInfo, node_info: NodeInfo) -> Status:
+        s = state.read(self._KEY)
+        if s is not None and s.rwop_keys:
+            s.conflicts += self._uses_rwop(s, added)
         return OK
+
+    def remove_pod(self, state: CycleState, pod: Pod, removed: PodInfo, node_info: NodeInfo) -> Status:
+        s = state.read(self._KEY)
+        if s is not None and s.rwop_keys:
+            s.conflicts -= self._uses_rwop(s, removed)
+        return OK
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        s = state.read(self._KEY)
+        if s is not None and s.conflicts > 0:
+            return Status.unschedulable(ERR_RWOP)
+        return OK
+
+
+@dataclass
+class _RWOPState:
+    """RWOP conflict refcount, cloned per what-if simulation."""
+
+    rwop_keys: set
+    conflicts: int
+
+    def clone(self) -> "_RWOPState":
+        return _RWOPState(self.rwop_keys, self.conflicts)

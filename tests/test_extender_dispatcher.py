@@ -160,3 +160,46 @@ class TestQueueingHints:
         s.clientset.delete_pod(victim)
         active, backoff, unsched = s.queue.pending_counts()
         assert unsched == 1 and active == 0 and backoff == 0
+
+
+class TestDispatcherBarrierAndErrors:
+    def test_flush_waits_for_in_flight_call(self):
+        """flush() is a true drain barrier: it waits for the worker to FINISH
+        the popped call, not just for the queue to empty."""
+        import threading
+        import time as _t
+        d = APIDispatcher(mode="thread")
+        started = threading.Event()
+        done = []
+
+        def slow():
+            started.set()
+            _t.sleep(0.2)
+            done.append(True)
+
+        d.add(APICall("pod_binding", "u1", execute=slow))
+        started.wait(1.0)
+        d.flush()
+        assert done, "flush returned while the call was still executing"
+        d.close()
+
+    def test_thread_mode_on_error_deferred_to_inbox(self):
+        """Worker-thread failures do NOT run on_error on the worker; the
+        scheduling loop drains them via drain_errors()."""
+        import threading
+        d = APIDispatcher(mode="thread")
+        seen = []
+
+        def boom():
+            raise RuntimeError("api down")
+
+        d.add(APICall("pod_binding", "u1", execute=boom,
+                      on_error=lambda e: seen.append(threading.current_thread())))
+        d.flush()
+        assert not seen, "on_error ran on the worker thread"
+        drained = d.drain_errors()
+        assert len(drained) == 1
+        call, exc = drained[0]
+        call.on_error(exc)
+        assert seen and seen[0] is threading.main_thread()
+        d.close()

@@ -266,6 +266,34 @@ class TestInterPodAffinity:
         assert pod.uid in cs.bindings
 
 
+    def test_self_affinity_bootstrap_requires_topology_key(self):
+        # satisfyPodAffinity returns false when a node misses any term's
+        # topology key — the bootstrap case never overrides that
+        # (filtering.go:398-426).
+        cs, sched = new_scheduler()
+        n = make_node().name("nokey").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+        n.labels.pop("kubernetes.io/hostname", None)
+        cs.create_node(n)
+        pod = (make_pod().name("p").label("app", "x").req({"cpu": "100m"})
+               .pod_affinity("kubernetes.io/hostname", {"app": "x"}).obj())
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert pod.uid not in cs.bindings
+
+
+    def test_bootstrap_checks_keys_across_all_terms(self):
+        # Two affinity terms: first key present (0 matches), second key absent
+        # — the missing-key check must survive the first term's miss.
+        cs, sched = new_scheduler()
+        cs.create_node(make_node().name("n0").capacity({"cpu": "4", "pods": 10}).obj())
+        pod = (make_pod().name("p").label("app", "x").req({"cpu": "100m"})
+               .pod_affinity("kubernetes.io/hostname", {"app": "x"})
+               .pod_affinity("rack", {"app": "x"}).obj())
+        cs.create_pod(pod)
+        sched.schedule_one()
+        assert pod.uid not in cs.bindings
+
+
 class TestFitOnlyProfile:
     def test_fit_only(self):
         cs, sched = new_scheduler(profiles=fit_only_profiles)
@@ -289,3 +317,32 @@ class TestBackoff:
         assert q.backoff_duration(qpi) == 4.0
         qpi.attempts = 10
         assert q.backoff_duration(qpi) == 10.0  # capped
+
+
+class TestZoneInterleavedOrder:
+    """Snapshot node order follows NodeTree's zone round-robin
+    (backend/cache/node_tree.go list(), wired via updateNodeInfoSnapshotList)."""
+
+    def test_snapshot_order_interleaves_zones(self):
+        cs = FakeClientset()
+        sched = Scheduler(clientset=cs)
+        # Two zones added in blocks: a-0 a-1 a-2 then b-0 b-1 b-2.
+        for z, names in (("zone-a", ["a-0", "a-1", "a-2"]),
+                         ("zone-b", ["b-0", "b-1", "b-2"])):
+            for n in names:
+                cs.create_node(make_node().name(n).capacity({"cpu": 4}).zone(z).obj())
+        sched.cache.update_snapshot(sched.snapshot)
+        order = [ni.name for ni in sched.snapshot.node_info_list]
+        assert order == ["a-0", "b-0", "a-1", "b-1", "a-2", "b-2"]
+
+    def test_zone_change_rebuckets(self):
+        cs = FakeClientset()
+        sched = Scheduler(clientset=cs)
+        cs.create_node(make_node().name("a-0").capacity({"cpu": 4}).zone("zone-a").obj())
+        cs.create_node(make_node().name("b-0").capacity({"cpu": 4}).zone("zone-b").obj())
+        sched.cache.update_snapshot(sched.snapshot)
+        cs.update_node(make_node().name("a-0").capacity({"cpu": 4}).zone("zone-b").obj())
+        sched.cache.update_snapshot(sched.snapshot)
+        order = [ni.name for ni in sched.snapshot.node_info_list]
+        assert order == ["b-0", "a-0"]
+        assert sched.cache.node_tree.num_nodes == 2

@@ -149,3 +149,25 @@ class TestVolumeRestrictions:
         s.clientset.create_pod(_pod_with_pvc("p2", "c"))
         s.run_until_idle()
         assert s.scheduled == 1  # second user of the RWOP claim is rejected
+
+    def test_rwop_conflict_resolvable_by_preemption(self):
+        """Preemption dry-runs replay filter with add_pod/remove_pod; the
+        RWOP refcount rides cycle state so evicting the current user clears
+        the conflict (volumerestrictions AddPod/RemovePod)."""
+        s = Scheduler(deterministic_ties=True)
+        s.clientset.create_node(make_node().name("n0").capacity({"cpu": "8", "pods": 10}).obj())
+        s.clientset.create_pv(_pv_on("pv-1", "n0", sc="fast"))
+        pvc = PersistentVolumeClaim.of("c", "1Gi", storage_class="fast",
+                                       volume_name="pv-1", access_modes=(RWOP,))
+        s.clientset.create_pvc(pvc)
+        low = _pod_with_pvc("low", "c")
+        low.priority = 1
+        s.clientset.create_pod(low)
+        s.run_until_idle()
+        assert s.scheduled == 1
+        high = _pod_with_pvc("high", "c")
+        high.priority = 100
+        s.clientset.create_pod(high)
+        s.run_until_idle()
+        bound = {p.name: p.node_name for p in s.clientset.pods.values() if p.node_name}
+        assert bound.get("high") == "n0", f"high not scheduled via preemption: {bound}"
