@@ -54,7 +54,9 @@ def main():
 
     cs, sched = build_cluster(n_nodes)
 
-    # Warmup: same pod signature and batch tier → compiles the kernel shapes.
+    # Warmup: compile both kernel traces (fresh + chained carry) with inert
+    # n_active=0 dispatches, then run one real warm block for host caches.
+    sched.warm_for(make_pods(1, "warmshape")[0])
     for p in make_pods(warmup, "warm"):
         cs.create_pod(p)
     sched.run_until_idle()

@@ -292,6 +292,9 @@ class Pod:
           (restartPolicy=Always) init requests added to the running total;
           then add pod overhead.
         """
+        cached = getattr(self, "_req_cache", None)
+        if cached is not None:
+            return cached
         total = Resource()
         for c in self.containers:
             total.add(c.requests)
@@ -312,15 +315,29 @@ class Pod:
         total.set_max(init_max)
         if self.overhead is not None:
             total.add(self.overhead)
+        # Memoized: container requests are spec (immutable once created);
+        # callers must not mutate the returned Resource (they clone()).
+        self._req_cache = total
         return total
 
     def host_ports(self) -> List[ContainerPort]:
+        cached = getattr(self, "_hp_cache", None)
+        if cached is not None:
+            return cached
         out = []
         for c in self.containers:
             for p in c.ports:
                 if p.host_port > 0:
                     out.append(p)
+        self._hp_cache = out  # container ports are immutable spec
         return out
+
+    def __copy__(self) -> "Pod":
+        # Hand-rolled shallow copy: the dataclass default routes through
+        # copyreg._reconstruct, which is ~5x slower; binds copy every pod.
+        new = object.__new__(Pod)
+        new.__dict__.update(self.__dict__)
+        return new
 
     def required_node_selector_matches(self, node: "Node") -> bool:
         """nodeSelector AND requiredDuringScheduling node affinity

@@ -267,6 +267,14 @@ class Framework:
             return self.queue_sort_plugins[0].less(a, b)
         return a.timestamp < b.timestamp
 
+    @property
+    def queue_sort_key(self):
+        """Tuple-key form of the queue-sort comparison when the plugin
+        provides one (heap entries then compare at C speed)."""
+        if self.queue_sort_plugins:
+            return getattr(self.queue_sort_plugins[0], "sort_key", None)
+        return lambda qpi: (qpi.timestamp,)
+
     # -- filtering ---------------------------------------------------------
 
     def run_pre_filter_plugins(
@@ -445,11 +453,21 @@ class Framework:
 
     def sign_pod(self, pod: Pod) -> Optional[tuple]:
         """Pod signature for batch reuse (staging framework/signers.go /
-        interface.go:774 SignPlugin). None => unsignable (never batched)."""
+        interface.go:774 SignPlugin). None => unsignable (never batched).
+        Memoized per (pod identity, resource_version): batch collection signs
+        every popped pod, and the spec can't change without a version bump."""
+        key = (id(self), pod.resource_version)
+        cached = getattr(pod, "_sig_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         sig = []
+        out: Optional[tuple] = None
         for p in self.sign_plugins:
             part = p.sign(pod)
             if part is None:
-                return None
+                break
             sig.append((p.name, part))
-        return tuple(sig) if sig else None
+        else:
+            out = tuple(sig) if sig else None
+        pod._sig_cache = (key, out)
+        return out

@@ -122,10 +122,16 @@ class QueuedPodGroupInfo:
 
 
 class _Heap:
-    """Stable heap with O(log n) update/delete by key (backend/heap/heap.go)."""
+    """Stable heap with O(log n) update/delete by key (backend/heap/heap.go).
 
-    def __init__(self, less: Callable[[QueuedPodInfo, QueuedPodInfo], bool]):
+    When the queue-sort comparison exposes a `sort_key(qpi)` (PrioritySort
+    does), entries carry a plain tuple compared at C speed; otherwise a
+    comparison shim routes through the less function."""
+
+    def __init__(self, less: Callable[[QueuedPodInfo, QueuedPodInfo], bool],
+                 sort_key: Optional[Callable[[QueuedPodInfo], tuple]] = None):
         self._less = less
+        self._sort_key = sort_key
         self._entries: List[List] = []  # [sortkey_tiebreak, seq, qpi, valid]
         self._by_uid: Dict[str, List] = {}
         self._seq = itertools.count()
@@ -143,7 +149,9 @@ class _Heap:
     def push(self, qpi) -> None:
         uid = qpi.uid
         self.delete(uid)
-        entry = [self._Key(qpi, self._less), next(self._seq), qpi, True]
+        key = (self._sort_key(qpi) if self._sort_key is not None
+               else self._Key(qpi, self._less))
+        entry = [key, next(self._seq), qpi, True]
         self._by_uid[uid] = entry
         heapq.heappush(self._entries, entry)
 
@@ -234,7 +242,8 @@ class PriorityQueue:
         self.gang_enabled = gang_enabled
 
         less = framework.less if framework is not None else (lambda a, b: a.timestamp < b.timestamp)
-        self.active_q = _Heap(less)
+        sort_key = framework.queue_sort_key if framework is not None else None
+        self.active_q = _Heap(less, sort_key=sort_key)
         self.backoff_q = _Heap(self._backoff_less)
         self.unschedulable: Dict[str, QueuedPodInfo] = {}
         self.nominator = Nominator()

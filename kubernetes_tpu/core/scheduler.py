@@ -256,6 +256,8 @@ class Scheduler:
         self.scheduled = 0
         self.failures = 0
         self.error_log: List[str] = []
+        # Versions node-state-relevant cluster changes (see _on_pod_event).
+        self.cluster_event_seq = 0
         # Off-thread watch-event inbox (see _threaded): deque append/popleft
         # are atomic under the GIL, so no lock is needed.
         from collections import deque
@@ -268,9 +270,17 @@ class Scheduler:
     def _wire_event_handlers(self) -> None:
         self.clientset.on_pod_event(self._threaded(self._on_pod_event))
         self.clientset.on_node_event(self._threaded(self._on_node_event))
-        self.clientset.on_namespace_event(self._threaded(self.cache.add_namespace))
-        self.clientset.on_pod_group_event(self._threaded(self.queue.register_pod_group))
+        self.clientset.on_namespace_event(self._threaded(self._bump(self.cache.add_namespace)))
+        self.clientset.on_pod_group_event(self._threaded(self._bump(self.queue.register_pod_group)))
         self.clientset.on_storage_event(self._threaded(self._on_storage_event))
+
+    def _bump(self, handler):
+        """Wrap a handler so it versions cluster_event_seq (namespace labels
+        and pod-group registrations affect scheduling outcomes)."""
+        def h(*args):
+            self.cluster_event_seq += 1
+            handler(*args)
+        return h
 
     def _threaded(self, handler):
         """Watch events raised off the scheduling thread (e.g. the thread-mode
@@ -300,6 +310,7 @@ class Scheduler:
 
     def _on_storage_event(self, kind: str, obj) -> None:
         from .queue import EVENT_STORAGE_ADD
+        self.cluster_event_seq += 1
         self.queue.move_all_to_active_or_backoff(EVENT_STORAGE_ADD)
 
     def _responsible_for_pod(self, pod: Pod) -> bool:
@@ -308,6 +319,22 @@ class Scheduler:
         return pod.scheduler_name in self.profiles
 
     def _on_pod_event(self, kind: str, old: Optional[Pod], new: Pod) -> None:
+        # cluster_event_seq versions node-state-relevant cluster changes so a
+        # device batch session (models/tpu_scheduler.py) knows whether the
+        # on-device carry still reflects the cluster. Benign for the carry:
+        # pending-pod adds (queue-only) and our own bind confirms (the carry
+        # already holds that placement via the assume).
+        if kind == "add" and not new.node_name:
+            pass
+        elif (kind == "update" and new.node_name
+                and self.cache.is_assumed_pod(new)):
+            # Our own bind confirm: the scheduler already assumed this pod
+            # onto the node (note `old` may alias the scheduler's mutated
+            # object, so old.node_name can't distinguish the transition —
+            # the assumed set can).
+            pass
+        else:
+            self.cluster_event_seq += 1
         if kind == "add":
             if new.node_name:
                 self.cache.add_pod(new)
@@ -331,6 +358,7 @@ class Scheduler:
                 self.queue.delete(new)
 
     def _on_node_event(self, kind: str, old, new) -> None:
+        self.cluster_event_seq += 1
         if kind == "add":
             self.cache.add_node(new)
             self.queue.move_all_to_active_or_backoff(EVENT_NODE_ADD)

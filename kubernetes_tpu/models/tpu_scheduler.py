@@ -49,6 +49,9 @@ class TPUScheduler(Scheduler):
         from ..core.features import TPU_BATCH_SCHEDULING
         self.device_enabled = self.gates.enabled(TPU_BATCH_SCHEDULING)
         self.max_batch = max_batch if max_batch is not None else self.config.max_batch
+        # Dispatch pipeline depth: how many batches may be in flight on
+        # device while the host commits retired ones (2 = double buffering).
+        self.pipeline_depth = getattr(self.config, "pipeline_depth", 2)
         self.mirror = NodeStateMirror()
         self._holdover: Optional[QueuedPodInfo] = None
         # metrics
@@ -170,7 +173,8 @@ class TPUScheduler(Scheduler):
         """Compile the kernel shapes a workload of `pod`-shaped pods will hit,
         WITHOUT scheduling anything: dispatches with n_active=0 are fully
         inert (every scan step is padding). Benchmark harnesses call this so
-        XLA compilation lands outside the measured window."""
+        XLA compilation lands outside the measured window. Warms both the
+        fresh-carry and chained-carry traces."""
         fw = self.framework_for_pod(pod)
         if batch_supported(pod, self.snapshot,
                            fit_plugin=fw.plugin("NodeResourcesFit")) is not None:
@@ -181,38 +185,134 @@ class TPUScheduler(Scheduler):
             if plan.batch_pad in warmed:
                 continue
             warmed.add(plan.batch_pad)
-            out = schedule_batch(
+            results, carry = schedule_batch(
                 state, plan.features, plan.batch_pad, plan.fit_strategy,
-                plan.vmax, n_active=np.int32(0))
-            np.asarray(out[0])  # block until compiled + executed
+                plan.vmax, n_active=np.int32(0),
+                has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base)
+            results2, _ = schedule_batch(
+                state, plan.features, plan.batch_pad, plan.fit_strategy,
+                plan.vmax, n_active=np.int32(0), carry_in=carry,
+                has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base)
+            np.asarray(results2)  # block until compiled + executed
 
-    def schedule_batch_on_device(self, fw: Framework, batch: List[QueuedPodInfo]) -> None:
-        pods = [q.pod for q in batch]
-        state, plan = self.build_plan(fw, pods[0], len(pods))
-        n = len(pods)
-        results, req_f, nz_f, pc_f = schedule_batch(
-            state, plan.features, plan.batch_pad, plan.fit_strategy, plan.vmax,
-            n_active=np.int32(n))
-        results = np.asarray(results)  # one device→host fetch
-        chosen, starts = results[0, :n], results[1, :n]
-        self.device_batches += 1
-        self.metrics.batch_attempts.inc("dispatched")
-        self.metrics.batch_size.observe(n)
+    # -- device session ----------------------------------------------------
+    #
+    # A *session* is a run of same-signature batches chained on device: the
+    # ScanCarry returned by batch N is passed straight back as batch N+1's
+    # carry_in (no feature rebuild, no state re-upload), and the host commits
+    # batch N's pods while the device computes batch N+1 — the TPU-era form
+    # of the reference's scheduling/binding-cycle overlap
+    # (schedule_one.go:141 go runBindingCycle). The session ends when the
+    # queue yields something incompatible, a commit diverges from the host
+    # oracle, or any external cluster event arrives
+    # (Scheduler.cluster_event_seq).
 
+    def _session_compatible(self, head: QueuedPodInfo, fw: Framework, sig) -> bool:
+        if isinstance(head, QueuedPodGroupInfo):
+            return False
+        return (head.pod.scheduler_name in self.profiles
+                and self.framework_for_pod(head.pod) is fw
+                and fw.sign_pod(head.pod) == sig)
+
+    def _collect_session_batch(self, fw: Framework, sig) -> List[QueuedPodInfo]:
+        """Pop up to max_batch pods matching the session signature; an
+        incompatible entity goes to the holdover slot and ends the refill."""
+        batch: List[QueuedPodInfo] = []
+        while len(batch) < self.max_batch:
+            nxt = self._pop()
+            if nxt is None:
+                break
+            if self._session_compatible(nxt, fw, sig):
+                batch.append(nxt)
+            else:
+                self._holdover = nxt
+                break
+        return batch
+
+    def run_device_session(self, fw: Framework, first_batch: List[QueuedPodInfo]) -> None:
+        state, plan = self.build_plan(fw, first_batch[0].pod, self.max_batch)
+        sig = fw.sign_pod(first_batch[0].pod)
+        start_seq = self.cluster_event_seq
         node_names = [ni.name for ni in self.snapshot.node_info_list]
+        inflight: List[Tuple[List[QueuedPodInfo], object]] = []
+        carry = None
         ok_rows: List[int] = []
         dirty_rows: List[int] = []
-        diverged = False
-        for i, qpi in enumerate(batch):
-            row = int(chosen[i])
-            self.next_start_node_index = int(starts[i])
-            if diverged:
-                # A previous commit in this batch failed, so every later
-                # device choice was computed against state that no longer
-                # holds — fall back to the host path for the rest. The carry
-                # still charged those pods' placements to their device-chosen
-                # rows, so mark them dirty for re-upload (the host path may
-                # have placed them elsewhere, or failed).
+        invalidated = False
+        batch: Optional[List[QueuedPodInfo]] = first_batch
+
+        while True:
+            # Refill the dispatch pipeline (depth-bounded): dispatch is
+            # async — these calls enqueue device work and return immediately.
+            while not invalidated and len(inflight) < self.pipeline_depth:
+                if batch is None:
+                    batch = self._collect_session_batch(fw, sig) or None
+                    if batch is None:
+                        break
+                results, carry = schedule_batch(
+                    state, plan.features, plan.batch_pad, plan.fit_strategy,
+                    plan.vmax, n_active=np.int32(len(batch)), carry_in=carry,
+                    has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base)
+                # Start the device→host copy NOW: on a tunneled TPU the
+                # result fetch pays a full pipeline-flush RTT (~10s of ms);
+                # issuing it at dispatch time overlaps that latency with the
+                # host commit loop of the previous batch.
+                try:
+                    results.copy_to_host_async()
+                except AttributeError:
+                    pass
+                self.device_batches += 1
+                self.metrics.batch_attempts.inc("dispatched")
+                self.metrics.batch_size.observe(len(batch))
+                inflight.append((batch, results))
+                batch = None
+            if not inflight:
+                break
+            # Retire the oldest batch: block on its results (the device is
+            # already computing the NEXT batch), then run the host tail.
+            b, results = inflight.pop(0)
+            res = np.asarray(results)  # one device→host fetch
+            if not invalidated:
+                invalidated = self._commit_batch(
+                    b, res, fw, node_names, ok_rows, dirty_rows)
+                if self.cluster_event_seq != start_seq:
+                    invalidated = True
+                    start_seq = self.cluster_event_seq
+            else:
+                # A previous batch diverged: every later device choice is
+                # stale. Host-path the pods and charge their rows dirty.
+                for i, qpi in enumerate(b):
+                    row = int(res[0, i])
+                    if row >= 0:
+                        dirty_rows.append(row)
+                    self.host_path_pods += 1
+                    self.process_one(qpi)
+
+        if batch:  # popped but never dispatched (invalidated mid-refill)
+            for qpi in batch:
+                self.host_path_pods += 1
+                self.process_one(qpi)
+
+        self.cache.update_snapshot(self.snapshot)
+        if invalidated:
+            # The carry charged host-diverged placements; staging is the
+            # authority again — force a full re-encode + upload.
+            self.mirror.invalidate()
+        else:
+            # Keep the device state resident: the final carry reflects every
+            # successful placement, so the next flush uploads nothing.
+            self.mirror.adopt(self.snapshot.node_info_list, ok_rows,
+                              carry.req_r, carry.nonzero, carry.pod_count,
+                              dirty_rows=dirty_rows)
+
+    def _commit_batch(self, b, res, fw, node_names, ok_rows, dirty_rows) -> bool:
+        """Host tail for one retired batch. Returns True when the session
+        must invalidate (host/device divergence or host-path interleaving)."""
+        invalidated = False
+        for i, qpi in enumerate(b):
+            row = int(res[0, i])
+            self.next_start_node_index = int(res[1, i])
+            if invalidated:
                 if row >= 0:
                     dirty_rows.append(row)
                 self.host_path_pods += 1
@@ -220,25 +320,20 @@ class TPUScheduler(Scheduler):
                 continue
             if row < 0:
                 # Infeasible on device: rerun on the host path for the exact
-                # FitError diagnosis (and as a safety net — equivalence is
-                # separately enforced by tests).
+                # FitError diagnosis. The host attempt may mutate state
+                # (preemption nomination), so the session cannot continue on
+                # the chained carry.
                 self.host_path_pods += 1
                 self.process_one(qpi)
+                invalidated = True
                 continue
             if self._commit(fw, qpi, node_names[row]):
                 ok_rows.append(row)
             else:
-                # Host rejected what the device applied in its carry: the
-                # carry diverged for this row — resync it the normal way.
+                # Host rejected what the device applied in its carry.
                 dirty_rows.append(row)
-                diverged = True
-        # Keep the device state resident: the carry already reflects every
-        # successful placement, so (absent external events) the next flush
-        # uploads nothing. Do NOT sync here — adopt aligns generations itself;
-        # other changes are picked up by the next build_plan's sync.
-        self.cache.update_snapshot(self.snapshot)
-        self.mirror.adopt(self.snapshot.node_info_list, ok_rows,
-                          req_f, nz_f, pc_f, dirty_rows=dirty_rows)
+                invalidated = True
+        return invalidated
 
     def _commit(self, fw: Framework, qpi: QueuedPodInfo, node_name: str) -> bool:
         """assume → reserve → permit → binding cycle (the unchanged host tail
@@ -304,7 +399,7 @@ class TPUScheduler(Scheduler):
                 self.process_one(qpi)
             return True
         try:
-            self.schedule_batch_on_device(fw, batch)
+            self.run_device_session(fw, batch)
         except Unsupported:
             for qpi in batch:
                 self.host_path_pods += 1

@@ -308,6 +308,13 @@ class NodeStateMirror:
         return self._device
 
 
+    def invalidate(self) -> None:
+        """Force a full staging re-encode + full upload on the next
+        sync/flush (used when a device session diverged from the host: the
+        carry can no longer be trusted as the device truth)."""
+        self._full_flush = True
+        self._row_gen = [-1] * len(self._row_gen)
+
     # -- carry adoption (device-resident steady state) ---------------------
 
     def adopt(
@@ -333,10 +340,18 @@ class NodeStateMirror:
         try:
             for i in rows:
                 if i < len(node_info_list):
-                    self._encode_row(i, node_info_list[i])
+                    ni = node_info_list[i]
+                    # Only the resource aggregates change on our own
+                    # placements — re-encode just those columns (the full
+                    # row encode is ~3x the work and taints/labels/topology
+                    # can't have moved without a generation-bumping event,
+                    # which ends the session before adopt).
+                    self._resource_vec(ni.requested, self.h_req_r[i])
+                    self.h_nonzero[i, 0] = ni.non_zero_requested.milli_cpu
+                    self.h_nonzero[i, 1] = ni.non_zero_requested.memory
+                    self.h_pod_count[i] = len(ni.pods)
                     if i < len(self._row_names):
-                        self._row_names[i] = node_info_list[i].name
-                        self._row_gen[i] = node_info_list[i].generation
+                        self._row_gen[i] = ni.generation
         except _Regrown:
             return  # staging reset; full flush will rebuild everything
         self._device = self._device._replace(

@@ -44,7 +44,7 @@ from ..plugins.podtopologyspread import (
     _count_pods_matching,
     PodTopologySpread,
 )
-from .codebook import EFFECT_IDS, OP_EQUAL, OP_EXISTS
+from .codebook import EFFECT_IDS, EFFECT_PREFER_NO_SCHEDULE, OP_EQUAL, OP_EXISTS
 from .device_state import BASE_RESOURCES, NodeStateMirror
 
 _UNSCHED_TAINT = Taint(key=NodeUnschedulable.TAINT_KEY, effect=NO_SCHEDULE)
@@ -132,6 +132,10 @@ class BatchPlan:
     batch_pad: int                # scan length (>= len(pods))
     fit_strategy: int             # 0 = LeastAllocated, 1 = MostAllocated
     vmax: int
+    # Host-known batch facts passed as static jit args so the kernel can drop
+    # dead score reductions from the scan body (ops/kernel.py fast paths).
+    has_pns: bool = True          # any PreferNoSchedule taint staged
+    has_ipa_base: bool = True     # any nonzero preferred-affinity base score
 
 
 class Unsupported(Exception):
@@ -571,6 +575,8 @@ def build_batch(
         batch_pad=_batch_tier(batch_size),
         fit_strategy=strategy,
         vmax=vmax,
+        has_pns=bool((mirror.h_taint_eff[:n] == EFFECT_PREFER_NO_SCHEDULE).any()),
+        has_ipa_base=bool((ipa_base != 0).any()),
     )
 
 

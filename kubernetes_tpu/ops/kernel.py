@@ -33,7 +33,7 @@ tests/test_device_equivalence.py):
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +51,28 @@ from .features import BatchFeatures
 MAX_NODE_SCORE = 100
 _BIG = jnp.int32(1 << 30)
 _INF64 = jnp.int64(1 << 60)
+
+
+class ScanCarry(NamedTuple):
+    """The kernel's dynamic state. Returned by schedule_batch and accepted
+    back as `carry_in`, so consecutive same-signature batches CHAIN on device
+    with no host roundtrip or feature rebuild between them — the device-
+    resident generalization of keeping the snapshot incremental
+    (cache.go:206): in steady state the only state changes are the batch's
+    own placements, which the carry already holds."""
+
+    req_r: jnp.ndarray        # [NP, R] i64 requested per node
+    nonzero: jnp.ndarray      # [NP, 2] i64 non-zero-default cpu/mem
+    pod_count: jnp.ndarray    # [NP]    i32
+    fit_ok: jnp.ndarray       # [NP]    bool
+    fit_sc: jnp.ndarray       # [NP]    i64
+    ba: jnp.ndarray           # [NP]    i64
+    dns_counts: jnp.ndarray   # [C1, V] i32
+    sa_counts: jnp.ndarray    # [C2, V] i32
+    anti_counts: jnp.ndarray  # [A1, V] i32
+    aff_counts: jnp.ndarray   # [A2, V] i32
+    ipa_delta: jnp.ndarray    # [KD, V] i64
+    start: jnp.ndarray        # i32 rotation index
 
 
 def _tolerates(f: BatchFeatures, taint_key, taint_val, taint_eff):
@@ -153,7 +175,9 @@ def _resource_eval(f: BatchFeatures, fit_strategy: int,
     return fit_ok, fit_sc, ba
 
 
-@partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax"))
+@partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax",
+                                   "has_pns", "has_ipa_base"),
+         donate_argnames=("carry_in",))
 def schedule_batch(
     state: DeviceNodeState,
     f: BatchFeatures,
@@ -161,14 +185,27 @@ def schedule_batch(
     fit_strategy: int,
     vmax: int,
     n_active: Optional[jnp.ndarray] = None,
-) -> Tuple[jnp.ndarray, ...]:
+    carry_in: Optional[ScanCarry] = None,
+    has_pns: bool = True,
+    has_ipa_base: bool = True,
+) -> Tuple[jnp.ndarray, ScanCarry]:
     """Greedy-assign up to `batch_pad` identical pods (`n_active` of them
     real; padded steps are inert so the returned carry stays exact).
 
-    Returns (results, req_r, nonzero, pod_count) where results is the stacked
-    [2, B] array of (chosen row or -1, start_index_after) — one array so the
-    host fetches with a single transfer; slice results[:, :n_active]. The
-    final per-node aggregates support NodeStateMirror.adopt."""
+    Returns (results, carry) where results is the stacked [2, B] array of
+    (chosen row or -1, start_index_after) — one array so the host fetches
+    with a single transfer; slice results[:, :n_active]. Passing the returned
+    ScanCarry back as `carry_in` chains the NEXT batch of identical pods
+    without re-uploading features or node state (dispatch pipelining: the
+    host commits batch N while the device computes batch N+1 — the TPU-era
+    form of schedule_one.go:141's async binding-cycle overlap).
+
+    `has_pns` / `has_ipa_base` are host-known batch facts (any
+    PreferNoSchedule taints staged; any nonzero preferred-affinity base
+    score). When false the corresponding score terms are constant and the
+    scan body drops their per-step reductions — with no topology features at
+    all, the whole score vector rides the carry and each step reduces to
+    window selection + one-row updates."""
     NP = state.valid.shape[0]
     C1 = f.dns_axis.shape[0]
     C2 = f.sa_axis.shape[0]
@@ -177,6 +214,15 @@ def schedule_batch(
     KD = f.ipa_axis.shape[0]
     idx = jnp.arange(NP, dtype=jnp.int32)
     num = jnp.maximum(f.num_nodes, 1)
+
+    # Feasibility can change only at the landed row when no topology filter
+    # is active — then the rotation prefix-sum updates incrementally instead
+    # of a full per-step cumsum.
+    incremental_feas = C1 == 0 and A1 == 0 and A2 == 0
+    # All score terms that depend on the evolving kept-set are absent — the
+    # total score vector is carried and updated only at the landed row.
+    static_scores = (incremental_feas and C2 == 0 and KD == 0
+                     and not has_pns and not has_ipa_base)
 
     taint_ok, pns_cnt, sel_ok, name_ok, unsched_ok, exist_anti_ok = _static_masks(state, f)
 
@@ -206,12 +252,51 @@ def schedule_batch(
 
     n_act = jnp.int32(batch_pad) if n_active is None else n_active.astype(jnp.int32)
 
-    def step(carry, t):
-        (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
-         dns_counts, sa_counts, anti_counts, aff_counts, ipa_delta, start) = carry
-        active = t < n_act
+    def total_score(fit_sc, ba, kept, sa_counts, ipa_delta):
+        """Weighted per-node score over the kept set
+        (runtime/framework.go:1526-1582 normalize + weight)."""
+        # TaintToleration ×w_tt (reverse-normalized). With no
+        # PreferNoSchedule taints staged, pns_cnt ≡ 0 ⇒ tt ≡ 100.
+        if has_pns:
+            tt = _normalize_default_reverse(pns_cnt, kept)
+        else:
+            tt = jnp.int64(MAX_NODE_SCORE)
+        # PodTopologySpread ScheduleAnyway ×w_pts (scoring.go)
+        if C2:
+            s_cnt = jnp.take_along_axis(sa_counts.astype(jnp.int64), sa_vid.astype(jnp.int64), axis=1)
+            raw_sa = (s_cnt * f.sa_wq[:, None] +
+                      (f.sa_skew[:, None] - 1) * 1024).sum(axis=0)
+            live = kept & ~sa_ignored
+            mn = jnp.min(jnp.where(live, raw_sa, _INF64))
+            mx = jnp.max(jnp.where(live, raw_sa, 0))
+            norm = jnp.where(mx > 0,
+                             MAX_NODE_SCORE * (mx + jnp.minimum(mn, mx) - raw_sa) // jnp.maximum(mx, 1),
+                             jnp.int64(MAX_NODE_SCORE))
+            pts = jnp.where(sa_ignored, 0, norm)
+        else:
+            pts = jnp.int64(0)
+        # InterPodAffinity ×w_ipa (scoring.go:258-289). All-zero raw scores
+        # normalize to 0 (diff == 0), so the reduction is skipped entirely
+        # when no base score nor landing delta exists.
+        if KD or has_ipa_base:
+            raw_ipa = f.ipa_base
+            if KD:
+                d = jnp.take_along_axis(ipa_delta, ipa_vid.astype(jnp.int64), axis=1)
+                raw_ipa = raw_ipa + (d * jnp.where(ipa_vid > 0, 1, 0)).sum(axis=0)
+            mn_i = jnp.min(jnp.where(kept, raw_ipa, _INF64))
+            mx_i = jnp.max(jnp.where(kept, raw_ipa, -_INF64))
+            diff = mx_i - mn_i
+            ipa = jnp.where(diff > 0,
+                            MAX_NODE_SCORE * (raw_ipa - mn_i) // jnp.maximum(diff, 1), 0)
+        else:
+            ipa = jnp.int64(0)
+        return w_tt * tt + w_fit * fit_sc + w_ba * ba + w_pts * pts + w_ipa * ipa
 
-        # ---- PTS DoNotSchedule filter (filtering.go:318-362) --------------
+    def feasibility(fit_ok, dns_counts, anti_counts, aff_counts):
+        """Per-node ok mask from the dynamic filters
+        (findNodesThatPassFilters; PTS skew filtering.go:318-362, IPA
+        required filtering.go:368-426)."""
+        # ---- PTS DoNotSchedule filter -------------------------------------
         if C1:
             cnt64 = dns_counts.astype(jnp.int64)
             min_match = jnp.where(
@@ -224,8 +309,7 @@ def schedule_batch(
             dns_ok = ~dns_reject.any(axis=0)
         else:
             dns_ok = jnp.ones(NP, bool)
-
-        # ---- IPA required filter (filtering.go:368-426) -------------------
+        # ---- IPA required filter ------------------------------------------
         if A1:
             a_cnt = jnp.take_along_axis(anti_counts, anti_vid, axis=1)  # [A1, NP]
             anti_ok = ~((anti_vid > 0) & (a_cnt > 0)).any(axis=0)
@@ -244,15 +328,22 @@ def schedule_batch(
             aff_ok = all_matched | bootstrap
         else:
             aff_ok = jnp.ones(NP, bool)
+        return static_ok & fit_ok & dns_ok & anti_ok & aff_ok & (idx < num)
 
-        ok = static_ok & fit_ok & dns_ok & anti_ok & aff_ok
+    def step(carry, t):
+        (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
+         dns_counts, sa_counts, anti_counts, aff_counts, ipa_delta, start,
+         okd, F, total) = carry
+        active = t < n_act
+
+        if not incremental_feas:
+            okd = feasibility(fit_ok, dns_counts, anti_counts, aff_counts)
+            F = jnp.cumsum(okd.astype(jnp.int32))          # inclusive, row order
 
         # ---- sampling truncation + rotation (schedule_one.go:779-892) -----
         # Gather-free formulation: rank[row] = #feasible rows at rotation
-        # positions <= rot(row), from ONE row-order cumsum with wrap
+        # positions <= rot(row), from the row-order prefix-sum with wrap
         # adjustment (feasible count in [start..row] resp. wrapped).
-        okd = ok & (idx < num)
-        F = jnp.cumsum(okd.astype(jnp.int32))              # inclusive, row order
         total_feas = F[-1]
         f_start = jnp.where(start > 0, F[jnp.maximum(start - 1, 0)], 0)
         rank = jnp.where(idx >= start, F - f_start, F + total_feas - f_start)
@@ -260,42 +351,22 @@ def schedule_batch(
         rot_of_row = (idx - start) % num                   # row -> rotation pos
         evaluated = jnp.min(jnp.where(okd & (rank == f.to_find), rot_of_row + 1, num))
 
-        # ---- scores over the kept set ------------------------------------
-        # TaintToleration ×w_tt (reverse-normalized); fit_sc/ba ride the
-        # carry (recomputed only for the landed row).
-        tt = _normalize_default_reverse(pns_cnt, kept)
-        # PodTopologySpread ScheduleAnyway ×w_pts (scoring.go)
-        if C2:
-            s_cnt = jnp.take_along_axis(sa_counts.astype(jnp.int64), sa_vid.astype(jnp.int64), axis=1)
-            raw_sa = (s_cnt * f.sa_wq[:, None] +
-                      (f.sa_skew[:, None] - 1) * 1024).sum(axis=0)
-            live = kept & ~sa_ignored
-            mn = jnp.min(jnp.where(live, raw_sa, _INF64))
-            mx = jnp.max(jnp.where(live, raw_sa, 0))
-            norm = jnp.where(mx > 0,
-                             MAX_NODE_SCORE * (mx + jnp.minimum(mn, mx) - raw_sa) // jnp.maximum(mx, 1),
-                             jnp.int64(MAX_NODE_SCORE))
-            pts = jnp.where(sa_ignored, 0, norm)
-        else:
-            pts = jnp.zeros(NP, jnp.int64)
-        # InterPodAffinity ×w_ipa (scoring.go:258-289)
-        raw_ipa = f.ipa_base
-        if KD:
-            d = jnp.take_along_axis(ipa_delta, ipa_vid.astype(jnp.int64), axis=1)
-            raw_ipa = raw_ipa + (d * jnp.where(ipa_vid > 0, 1, 0)).sum(axis=0)
-        mn_i = jnp.min(jnp.where(kept, raw_ipa, _INF64))
-        mx_i = jnp.max(jnp.where(kept, raw_ipa, -_INF64))
-        diff = mx_i - mn_i
-        ipa = jnp.where(diff > 0,
-                        MAX_NODE_SCORE * (raw_ipa - mn_i) // jnp.maximum(diff, 1), 0)
-
-        total = (w_tt * tt + w_fit * fit_sc + w_ba * ba + w_pts * pts + w_ipa * ipa)
+        if not static_scores:
+            total = total_score(fit_sc, ba, kept, sa_counts, ipa_delta)
 
         # ---- select (schedule_one.go selectHost, deterministic ties) ------
-        any_kept = kept.any() & active
-        best = jnp.max(jnp.where(kept, total, -_INF64))
-        cand_rot = jnp.where(kept & (total == best), rot_of_row, _BIG)
-        chosen_rot = jnp.min(cand_rot)
+        if static_scores:
+            # Scores are non-negative ⇒ max-score-then-min-rotation packs
+            # into ONE reduction: key = total * NP + (NP-1-rot).
+            any_kept = (total_feas > 0) & active
+            key = total * NP + (jnp.int32(NP - 1) - rot_of_row)
+            best_key = jnp.max(jnp.where(kept, key, -1))
+            chosen_rot = jnp.int32(NP - 1) - (best_key % NP).astype(jnp.int32)
+        else:
+            any_kept = kept.any() & active
+            best = jnp.max(jnp.where(kept, total, -_INF64))
+            cand_rot = jnp.where(kept & (total == best), rot_of_row, _BIG)
+            chosen_rot = jnp.min(cand_rot)
         chosen = jnp.where(any_kept, (start + chosen_rot) % num, -1).astype(jnp.int32)
 
         # ---- carry updates (inert when this step is padding) --------------
@@ -312,6 +383,16 @@ def schedule_batch(
         fit_ok = fit_ok.at[row].set(r_ok)
         fit_sc = fit_sc.at[row].set(r_fit)
         ba = ba.at[row].set(r_ba)
+        if incremental_feas:
+            # Feasibility flips only at the landed row: patch okd and shift
+            # the prefix-sum tail by the delta (replaces the full cumsum).
+            new_ok_row = static_ok[row] & r_ok & (row < num)
+            delta = new_ok_row.astype(jnp.int32) - okd[row].astype(jnp.int32)
+            okd = okd.at[row].set(new_ok_row)
+            F = F + jnp.where(idx >= row, delta, 0)
+        if static_scores:
+            total = total.at[row].set(
+                w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * r_fit + w_ba * r_ba)
         if C1:
             upd = (f.dns_self * dns_elig[jnp.arange(C1), row].astype(jnp.int32)
                    * apply.astype(jnp.int32))
@@ -332,22 +413,135 @@ def schedule_batch(
 
         new_carry = (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
                      dns_counts, sa_counts, anti_counts, aff_counts,
-                     ipa_delta, start)
+                     ipa_delta, start, okd, F, total)
         return new_carry, (chosen, start)
 
-    fit_ok0, fit_sc0, ba0 = _resource_eval(
-        f, fit_strategy, state.alloc_r, state.alloc_pods,
-        state.req_r, state.nonzero, state.pod_count)
-    ipa_delta0 = jnp.zeros((KD, vmax), jnp.int64)
-    carry0 = (state.req_r, state.nonzero, state.pod_count,
-              fit_ok0, fit_sc0, ba0,
-              f.dns_counts, f.sa_counts, f.anti_counts, f.aff_counts,
-              ipa_delta0, f.start_index)
+    if carry_in is None:
+        fit_ok0, fit_sc0, ba0 = _resource_eval(
+            f, fit_strategy, state.alloc_r, state.alloc_pods,
+            state.req_r, state.nonzero, state.pod_count)
+        ipa_delta0 = jnp.zeros((KD, vmax), jnp.int64)
+        ext0 = ScanCarry(state.req_r, state.nonzero, state.pod_count,
+                         fit_ok0, fit_sc0, ba0,
+                         f.dns_counts, f.sa_counts, f.anti_counts,
+                         f.aff_counts, ipa_delta0, f.start_index)
+    else:
+        ext0 = carry_in
+    # okd/F/total are derivable from the external carry; seed them once per
+    # call (the scan keeps them incrementally fresh on the fast paths, and
+    # recomputes them per step otherwise).
+    okd0 = feasibility(ext0.fit_ok, ext0.dns_counts, ext0.anti_counts,
+                       ext0.aff_counts)
+    F0 = jnp.cumsum(okd0.astype(jnp.int32))
+    if static_scores:
+        return _lap_schedule(state, f, batch_pad, fit_strategy,
+                             ext0, static_ok, n_act, idx, num,
+                             w_tt, w_fit, w_ba)
+    total0 = jnp.zeros(NP, jnp.int64)
+    carry0 = tuple(ext0) + (okd0, F0, total0)
     final, (chosen, starts) = lax.scan(
         step, carry0, jnp.arange(batch_pad, dtype=jnp.int32))
     # chosen+starts stacked into ONE array: the host fetches results with a
     # single device→host transfer (each fetch pays a full RTT on tunneled
-    # TPUs). Final per-node aggregates ride back so the host can keep the
-    # device state resident across batches (NodeStateMirror.adopt) instead of
-    # re-uploading — the device-side analogue of the incremental snapshot.
-    return jnp.stack([chosen, starts]), final[0], final[1], final[2]
+    # TPUs). The final ScanCarry rides back (device-resident) so the host can
+    # chain the next batch (carry_in) and keep the mirror resident
+    # (NodeStateMirror.adopt) instead of re-uploading — the device-side
+    # analogue of the incremental snapshot.
+    return jnp.stack([chosen, starts]), ScanCarry(*final[:12])
+
+
+# Max pods placed per lap iteration (bounds the segment tensors; L_full =
+# total_feasible // to_find never exceeds ~20 for the reference's adaptive
+# percentage formula, schedule_one.go:866, but custom percentageOfNodesToScore
+# can push it higher — excess windows spill to later laps).
+LAP_MAX = 32
+
+
+def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
+                  static_ok, n_act, idx, num, w_tt, w_fit, w_ba):
+    """Lap-vectorized greedy assignment for the static-score case.
+
+    Key fact: with adaptive sampling live (schedule_one.go:866-892), pod i
+    examines the window holding the first `to_find` feasible nodes after its
+    start index, and pod i+1's window begins where pod i's ended. Windows of
+    consecutive pods are therefore DISJOINT until the rotation laps the
+    cluster — and with no topology features, a placement changes scores and
+    feasibility only at its own landed row, which later windows in the same
+    lap never see. So all `L = total_feasible // to_find` pods of one lap are
+    independent: one segmented argmax places them all. The sequential scan
+    (1 pod/step) collapses to ~B·to_find/N steps — at 5k nodes the 1024-pod
+    batch runs in ~100 lap iterations of which each does ONE pass over the
+    node tensors. This is the TPU-shaped replacement for the goroutine pool:
+    maximal vector work per sequential dependency, not per worker."""
+    NP = state.valid.shape[0]
+    tf = jnp.maximum(f.to_find, 1)
+    B = batch_pad
+    SEG = LAP_MAX + 1  # window segments + 1 dump lane
+
+    lanes = jnp.arange(LAP_MAX, dtype=jnp.int32)             # [LAP_MAX]
+
+    def cond(c):
+        return c[0] < n_act
+
+    def body(c):
+        (done, req_r, nonzero, pod_count, start, out) = c
+        # Dense per-lap recompute (no scatters/gathers — TPU scatters
+        # serialize per index, so one-hot masked vector ops win):
+        fit_ok, fit_sc, ba = _resource_eval(
+            f, fit_strategy, state.alloc_r, state.alloc_pods,
+            req_r, nonzero, pod_count)
+        okd = static_ok & fit_ok & (idx < num)
+        F = jnp.cumsum(okd.astype(jnp.int32))
+        total = w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * fit_sc + w_ba * ba
+        total_feas = F[-1]
+        f_start = jnp.where(start > 0, F[jnp.maximum(start - 1, 0)], 0)
+        rank = jnp.where(idx >= start, F - f_start, F + total_feas - f_start)
+        rot = (idx - start) % num
+        l_full = total_feas // tf
+        L = jnp.clip(jnp.minimum(l_full, n_act - done), 1, LAP_MAX)
+        # window of each feasible row; singleton window 0 when sampling
+        # truncation is inactive (total_feas <= to_find ⇒ all rows rank<=tf)
+        w = jnp.minimum((rank - 1) // tf, LAP_MAX)
+        seg = jnp.where(okd & (w < L), w, LAP_MAX)           # [NP]
+        in_w = seg[None, :] == lanes[:, None]                # [LAP_MAX, NP]
+        # max-score-then-min-rotation packed argmax per window
+        key = total * NP + (jnp.int32(NP - 1) - rot)
+        key_w = jnp.max(jnp.where(in_w, key[None, :], -1), axis=1)
+        has_w = (lanes < L) & (key_w >= 0)
+        rot_w = jnp.int32(NP - 1) - (key_w % NP).astype(jnp.int32)
+        row_w = jnp.where(has_w, (start + rot_w) % num, -1).astype(jnp.int32)
+        # window end boundaries: the row with feasible rank (w+1)*to_find is
+        # the last one examined for window w (numFeasibleNodesToFind cut);
+        # empty ⇒ the window ran to the end of the rotation (evaluated=num).
+        is_b = okd & (rank % tf == 0)
+        seg_b = jnp.where(is_b, jnp.minimum(rank // tf - 1, LAP_MAX), LAP_MAX)
+        in_b = seg_b[None, :] == lanes[:, None]
+        ev_w = jnp.min(jnp.where(in_b, rot[None, :] + 1, num), axis=1)  # [LAP_MAX]
+        # per-pod cumulative start: start_after lane w = boundary of its window
+        start_w = (start + ev_w) % num                        # [LAP_MAX]
+        # ---- apply the L placements (windows are disjoint ⇒ each row gets
+        # at most one pod: a one-hot sum over lanes is an exact update) -----
+        chosen_1h = (idx[None, :] == row_w[:, None]) & has_w[:, None]
+        cnt = chosen_1h.any(axis=0)                           # [NP] bool
+        c64 = cnt.astype(jnp.int64)
+        req_r = req_r + f.request[None, :] * c64[:, None]
+        nonzero = nonzero + f.nz_request[None, :] * c64[:, None]
+        pod_count = pod_count + cnt.astype(jnp.int32)
+        # ---- emit results (positions >= n_act are sliced off by the host) -
+        chosen_w = jnp.where(has_w, row_w, -1)
+        block = jnp.stack([chosen_w, start_w.astype(jnp.int32)])  # [2, LAP_MAX]
+        out = lax.dynamic_update_slice(out, block, (jnp.int32(0), done))
+        start = start_w[jnp.maximum(L - 1, 0)]
+        return (done + L, req_r, nonzero, pod_count, start, out)
+
+    out0 = jnp.full((2, B + LAP_MAX), -1, jnp.int32)
+    c0 = (jnp.int32(0), ext0.req_r, ext0.nonzero, ext0.pod_count,
+          ext0.start, out0)
+    done, req_r, nonzero, pod_count, start, out = lax.while_loop(cond, body, c0)
+    fit_ok, fit_sc, ba = _resource_eval(
+        f, fit_strategy, state.alloc_r, state.alloc_pods,
+        req_r, nonzero, pod_count)
+    carry = ScanCarry(req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
+                      ext0.dns_counts, ext0.sa_counts, ext0.anti_counts,
+                      ext0.aff_counts, ext0.ipa_delta, start)
+    return out[:, :B], carry

@@ -127,6 +127,11 @@ class PrioritySort:
             return pa > pb
         return a.timestamp < b.timestamp
 
+    @staticmethod
+    def sort_key(qpi) -> tuple:
+        """Tuple equivalent of less() for C-speed heap comparisons."""
+        return (-qpi.pod.priority, qpi.timestamp)
+
 
 class DefaultBinder:
     """plugins/defaultbinder: POST /binding — routed through the async API

@@ -200,3 +200,70 @@ class TestFuzzEquivalence:
             return pods
 
         _run_pair(n_nodes, fn, seed=seed, taint_frac=0.2, unsched_frac=0.1)
+
+
+class TestSessionEquivalence:
+    """The chained-carry session + lap-vectorized kernel against the host
+    oracle at scales where adaptive sampling makes multi-pod laps (L>1) and
+    multiple chained batches."""
+
+    def test_multi_lap_scale(self):
+        # 600 nodes → to_find=max(600*45//100,100)=270 → L=2 laps; enough
+        # pods for several chained batches at max_batch=64.
+        host = Scheduler(deterministic_ties=True)
+        dev = TPUScheduler(max_batch=64)
+        _mk_cluster(host, 600, seed=7)
+        _mk_cluster(dev, 600, seed=7)
+        for s in (host, dev):
+            for p in _basic_pods(300, cpu="250m", mem="128Mi")():
+                s.clientset.create_pod(p)
+        host.run_until_idle()
+        dev.run_until_idle()
+        a_host, a_dev = _assignments(host), _assignments(dev)
+        diffs = {k: (a_host[k], a_dev.get(k)) for k in a_host if a_host[k] != a_dev.get(k)}
+        assert not diffs, f"divergence ({len(diffs)}): {dict(list(diffs.items())[:5])}"
+        assert dev.device_batches >= 4
+        assert dev.host_path_pods == 0
+
+    def test_lap_boundary_with_infeasible_rows(self):
+        # Tight capacities make nodes fill mid-session: feasibility flips
+        # inside laps, exercising window-boundary recomputation.
+        host = Scheduler(deterministic_ties=True)
+        dev = TPUScheduler(max_batch=32)
+        for s in (host, dev):
+            for i in range(150):
+                s.clientset.create_node(
+                    make_node().name(f"node-{i}")
+                    .capacity({"cpu": 1, "memory": "1Gi", "pods": 3})
+                    .zone(f"zone-{i % 3}").obj())
+            for p in _basic_pods(260, cpu="300m", mem="300Mi")():
+                s.clientset.create_pod(p)
+        host.run_until_idle()
+        dev.run_until_idle()
+        a_host, a_dev = _assignments(host), _assignments(dev)
+        assert a_host == a_dev
+        assert host.scheduled == dev.scheduled
+
+    def test_churn_between_runs_invalidates_session(self):
+        # Node add mid-workload: the session must abandon the device carry
+        # (cluster_event_seq) and still match a host run seeing the same
+        # sequence.
+        host = Scheduler(deterministic_ties=True)
+        dev = TPUScheduler(max_batch=16)
+        for s in (host, dev):
+            for i in range(120):
+                s.clientset.create_node(
+                    make_node().name(f"node-{i}").capacity({"cpu": 8, "pods": 20})
+                    .zone(f"zone-{i % 4}").obj())
+            for p in _basic_pods(48)():
+                s.clientset.create_pod(p)
+            s.run_until_idle()
+            # churn: new node + another wave
+            s.clientset.create_node(
+                make_node().name("late-node").capacity({"cpu": 8, "pods": 20})
+                .zone("zone-0").obj())
+            for i in range(48):
+                s.clientset.create_pod(
+                    make_pod().name(f"wave2-{i}").req({"cpu": "500m", "memory": "256Mi"}).obj())
+            s.run_until_idle()
+        assert _assignments(host) == _assignments(dev)
