@@ -60,7 +60,14 @@ def main():
     for p in make_pods(warmup, "warm"):
         cs.create_pod(p)
     sched.run_until_idle()
+    # Snapshot every counter so the detail below covers ONLY the measured
+    # window (previously device_scheduled was cumulative and exceeded
+    # `scheduled` by exactly the warmup pods, which read as double-counting).
     warm_sched = sched.scheduled
+    warm_failures = sched.failures
+    warm_dev_sched = sched.device_scheduled
+    warm_dev_batches = sched.device_batches
+    warm_host_pods = sched.host_path_pods
 
     for p in make_pods(n_pods, "bench"):
         cs.create_pod(p)
@@ -77,11 +84,11 @@ def main():
         "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
         "detail": {
             "scheduled": scheduled,
-            "failures": sched.failures,
+            "failures": sched.failures - warm_failures,
             "elapsed_s": round(elapsed, 2),
-            "device_batches": sched.device_batches,
-            "device_scheduled": sched.device_scheduled,
-            "host_path_pods": sched.host_path_pods,
+            "device_batches": sched.device_batches - warm_dev_batches,
+            "device_scheduled": sched.device_scheduled - warm_dev_sched,
+            "host_path_pods": sched.host_path_pods - warm_host_pods,
             "platform": os.environ.get("JAX_PLATFORMS", "default"),
         },
     }

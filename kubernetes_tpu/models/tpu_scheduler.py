@@ -26,6 +26,7 @@ generalized from one-pod hint reuse to true multi-pod kernel batches.
 
 from __future__ import annotations
 
+import time as _time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -58,6 +59,14 @@ class TPUScheduler(Scheduler):
         self.device_batches = 0
         self.device_scheduled = 0
         self.host_path_pods = 0
+        # Host/device time split (schedule_one.go:574-style step accounting,
+        # re-shaped for the batch pipeline): plan_build_s = snapshot→features
+        # host work, device_wait_s = time blocked on a device result fetch,
+        # host_commit_s = assume/reserve/permit/bind tails. Exported by the
+        # perf harness so perf regressions are attributable, not guessed.
+        self.plan_build_s = 0.0
+        self.device_wait_s = 0.0
+        self.host_commit_s = 0.0
 
     # -- batch accumulation ------------------------------------------------
 
@@ -174,26 +183,29 @@ class TPUScheduler(Scheduler):
         WITHOUT scheduling anything: dispatches with n_active=0 are fully
         inert (every scan step is padding). Benchmark harnesses call this so
         XLA compilation lands outside the measured window. Warms both the
-        fresh-carry and chained-carry traces."""
+        fresh-carry and chained-carry traces.
+
+        The warm calls MUST be call-signature-identical to the session's
+        dispatch (run_device_session) — `carry_in=None` passed explicitly is
+        a DIFFERENT kwargs pytree than omitting the kwarg, and a mismatch
+        recompiles (~1 min) inside the measured window. Sessions always plan
+        with self.max_batch, so that is the only batch_pad tier to warm;
+        `batch_sizes` is accepted for compatibility but ignored."""
+        del batch_sizes
         fw = self.framework_for_pod(pod)
         if batch_supported(pod, self.snapshot,
                            fit_plugin=fw.plugin("NodeResourcesFit")) is not None:
             return
-        warmed = set()
-        for size in batch_sizes or (self.max_batch,):
-            state, plan = self.build_plan(fw, pod, size)
-            if plan.batch_pad in warmed:
-                continue
-            warmed.add(plan.batch_pad)
-            results, carry = schedule_batch(
-                state, plan.features, plan.batch_pad, plan.fit_strategy,
-                plan.vmax, n_active=np.int32(0),
-                has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base)
-            results2, _ = schedule_batch(
-                state, plan.features, plan.batch_pad, plan.fit_strategy,
-                plan.vmax, n_active=np.int32(0), carry_in=carry,
-                has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base)
-            np.asarray(results2)  # block until compiled + executed
+        state, plan = self.build_plan(fw, pod, self.max_batch)
+        results, carry = schedule_batch(
+            state, plan.features, plan.batch_pad, plan.fit_strategy,
+            plan.vmax, n_active=np.int32(0), carry_in=None,
+            has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base)
+        results2, _ = schedule_batch(
+            state, plan.features, plan.batch_pad, plan.fit_strategy,
+            plan.vmax, n_active=np.int32(0), carry_in=carry,
+            has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base)
+        np.asarray(results2)  # block until compiled + executed
 
     # -- device session ----------------------------------------------------
     #
@@ -230,7 +242,9 @@ class TPUScheduler(Scheduler):
         return batch
 
     def run_device_session(self, fw: Framework, first_batch: List[QueuedPodInfo]) -> None:
+        _t0 = _time.perf_counter()
         state, plan = self.build_plan(fw, first_batch[0].pod, self.max_batch)
+        self.plan_build_s += _time.perf_counter() - _t0
         sig = fw.sign_pod(first_batch[0].pod)
         start_seq = self.cluster_event_seq
         node_names = [ni.name for ni in self.snapshot.node_info_list]
@@ -271,10 +285,14 @@ class TPUScheduler(Scheduler):
             # Retire the oldest batch: block on its results (the device is
             # already computing the NEXT batch), then run the host tail.
             b, results = inflight.pop(0)
+            _t0 = _time.perf_counter()
             res = np.asarray(results)  # one device→host fetch
+            _t1 = _time.perf_counter()
+            self.device_wait_s += _t1 - _t0
             if not invalidated:
                 invalidated = self._commit_batch(
                     b, res, fw, node_names, ok_rows, dirty_rows)
+                self.host_commit_s += _time.perf_counter() - _t1
                 if self.cluster_event_seq != start_seq:
                     invalidated = True
                     start_seq = self.cluster_event_seq

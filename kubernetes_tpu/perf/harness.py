@@ -61,6 +61,9 @@ class PerfResult:
     failed: int = 0
     elapsed: float = 0.0
     metrics: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    # Device/host attribution (TPUScheduler counters; zero on host-only runs):
+    # which path the pods took and where the wall-clock went.
+    detail: Dict[str, Any] = field(default_factory=dict)
 
     def meets_thresholds(self) -> bool:
         """Thresholds gate `performance`-labeled runs only — the reference
@@ -246,12 +249,7 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
                 # scheduler process; XLA compilation is our cold-start).
                 warm = getattr(sched, "warm_for", None)
                 if warm is not None:
-                    mb = getattr(sched, "max_batch", count)
-                    sizes = [min(count, mb)]
-                    if count > mb and count % mb:
-                        sizes.append(count % mb)
-                    warm(_make_pod_from_template("warm-template", tpl),
-                         batch_sizes=sizes)
+                    warm(_make_pod_from_template("warm-template", tpl))
                 collector.start()
             for i in range(count):
                 cs.create_pod(_make_pod_from_template(f"pod-{pod_seq}", tpl))
@@ -294,6 +292,11 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
     result.elapsed = time.perf_counter() - t0
     result.scheduled = sched.scheduled
     result.failed = sched.failures
+    for attr in ("device_batches", "device_scheduled", "host_path_pods",
+                 "plan_build_s", "device_wait_s", "host_commit_s"):
+        v = getattr(sched, attr, None)
+        if v is not None:
+            result.detail[attr] = round(v, 3) if isinstance(v, float) else v
     # in-flight invariant (scheduler_perf.go:878-880 checkEmptyInFlightEvents)
     assert not sched.queue._in_flight, "in-flight events remain after workload"
     return result
