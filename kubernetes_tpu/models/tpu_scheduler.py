@@ -34,7 +34,7 @@ import numpy as np
 from ..core.framework import WAIT, Framework
 from ..core.queue import QueuedPodGroupInfo, QueuedPodInfo
 from ..core.scheduler import Scheduler, ScheduleResult
-from ..ops.device_state import NodeStateMirror
+from ..ops.device_state import NodeStateMirror, enable_persistent_compilation_cache
 from ..ops.features import Unsupported, batch_supported, build_batch
 from ..ops.kernel import schedule_batch
 
@@ -53,6 +53,7 @@ class TPUScheduler(Scheduler):
         # Dispatch pipeline depth: how many batches may be in flight on
         # device while the host commits retired ones (2 = double buffering).
         self.pipeline_depth = getattr(self.config, "pipeline_depth", 2)
+        enable_persistent_compilation_cache()
         self.mirror = NodeStateMirror()
         self._holdover: Optional[QueuedPodInfo] = None
         # metrics
@@ -197,15 +198,30 @@ class TPUScheduler(Scheduler):
                            fit_plugin=fw.plugin("NodeResourcesFit")) is not None:
             return
         state, plan = self.build_plan(fw, pod, self.max_batch)
-        results, carry = schedule_batch(
-            state, plan.features, plan.batch_pad, plan.fit_strategy,
-            plan.vmax, n_active=np.int32(0), carry_in=None,
-            has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base)
-        results2, _ = schedule_batch(
-            state, plan.features, plan.batch_pad, plan.fit_strategy,
-            plan.vmax, n_active=np.int32(0), carry_in=carry,
-            has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base)
+        results, carry = self._dispatch(state, plan, 0, None)
+        results2, _ = self._dispatch(state, plan, 0, carry)
         np.asarray(results2)  # block until compiled + executed
+        if plan.anti_rowlocal:
+            # anti_rowlocal is topology-derived (all anti axes singleton) and
+            # can flip to False mid-workload (e.g. churn adds a node sharing a
+            # hostname-like value): warm the conservative fallback trace too
+            # so the flip can't put a compile inside the measured window.
+            import dataclasses
+            fb = dataclasses.replace(plan, anti_rowlocal=False)
+            r1, c1 = self._dispatch(state, fb, 0, None)
+            r2, _ = self._dispatch(state, fb, 0, c1)
+            np.asarray(r2)
+
+    def _dispatch(self, state, plan, n_active: int, carry):
+        """The ONLY schedule_batch call site. Every dispatch — warm or live —
+        must be call-signature-identical (kwarg set included: static kwargs
+        are part of jit's cache-key pytree structure), or the warmed trace
+        misses and a ~1min XLA compile lands inside the measured window."""
+        return schedule_batch(
+            state, plan.features, plan.batch_pad, plan.fit_strategy,
+            plan.vmax, n_active=np.int32(n_active), carry_in=carry,
+            has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base,
+            anti_rowlocal=plan.anti_rowlocal)
 
     # -- device session ----------------------------------------------------
     #
@@ -263,10 +279,7 @@ class TPUScheduler(Scheduler):
                     batch = self._collect_session_batch(fw, sig) or None
                     if batch is None:
                         break
-                results, carry = schedule_batch(
-                    state, plan.features, plan.batch_pad, plan.fit_strategy,
-                    plan.vmax, n_active=np.int32(len(batch)), carry_in=carry,
-                    has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base)
+                results, carry = self._dispatch(state, plan, len(batch), carry)
                 # Start the device→host copy NOW: on a tunneled TPU the
                 # result fetch pays a full pipeline-flush RTT (~10s of ms);
                 # issuing it at dispatch time overlaps that latency with the

@@ -28,16 +28,24 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-# Persistent XLA compilation cache: kernel compiles run 30-90s on TPU, and the
-# perf/bench harnesses start fresh processes per run — without this every
-# process pays every compile again. Opt out with KUBERNETES_TPU_NO_XLA_CACHE=1.
-if not os.environ.get("KUBERNETES_TPU_NO_XLA_CACHE"):
-    _cache_dir = os.environ.get(
+
+def enable_persistent_compilation_cache() -> None:
+    """Persistent XLA compilation cache: kernel compiles run 30-90s on TPU,
+    and the perf/bench harnesses start fresh processes per run — without this
+    every process pays every compile again. Called from TPUScheduler.__init__
+    (constructing the device-backed scheduler is the opt-in; merely importing
+    the library must not redirect an embedding application's JAX caching).
+    Opt out with KUBERNETES_TPU_NO_XLA_CACHE=1."""
+    if os.environ.get("KUBERNETES_TPU_NO_XLA_CACHE"):
+        return
+    if jax.config.jax_compilation_cache_dir:
+        return  # the application already configured a cache; respect it
+    cache_dir = os.environ.get(
         "KUBERNETES_TPU_XLA_CACHE_DIR",
         os.path.join(os.path.expanduser("~"), ".cache", "kubernetes_tpu_xla"))
     try:
-        os.makedirs(_cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except (OSError, AttributeError):  # read-only FS or old jax: best-effort
         pass

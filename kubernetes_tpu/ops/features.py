@@ -136,6 +136,10 @@ class BatchPlan:
     # dead score reductions from the scan body (ops/kernel.py fast paths).
     has_pns: bool = True          # any PreferNoSchedule taint staged
     has_ipa_base: bool = True     # any nonzero preferred-affinity base score
+    # Every required anti-affinity term is keyed to a singleton-per-node
+    # topology axis (kubernetes.io/hostname-like): a landing can only block
+    # its own row, so the kernel's lap-vectorized path stays exact.
+    anti_rowlocal: bool = False
 
 
 class Unsupported(Exception):
@@ -394,9 +398,16 @@ def build_batch(
     aff_active = np.zeros(a2, i32)
     aff_counts = np.zeros((a2, vmax), i32)
     exist_anti = np.zeros(npc, i32)
+    anti_rowlocal = bool(anti_terms)
     for ti, t in enumerate(anti_terms):
-        anti_axis[ti] = mirror.axes[t.topology_key].index
+        ax = mirror.axes[t.topology_key]
+        anti_axis[ti] = ax.index
         anti_self[ti] = 1 if t.matches(pod, ns_labels_fn) else 0
+        if anti_rowlocal:
+            vids = mirror.h_topo[ax.index, :n]
+            nz = vids[vids > 0]
+            if nz.size and np.bincount(nz).max() > 1:
+                anti_rowlocal = False  # shared domains: cross-window coupling
     for ti, t in enumerate(aff_terms):
         aff_axis[ti] = mirror.axes[t.topology_key].index
         aff_self[ti] = 1 if t.matches(pod, ns_labels_fn) else 0
@@ -577,6 +588,7 @@ def build_batch(
         vmax=vmax,
         has_pns=bool((mirror.h_taint_eff[:n] == EFFECT_PREFER_NO_SCHEDULE).any()),
         has_ipa_base=bool((ipa_base != 0).any()),
+        anti_rowlocal=anti_rowlocal,
     )
 
 

@@ -10,6 +10,20 @@ cycles with a scan whose carry holds exactly the state one pod's placement
 changes for the next pod: per-node requested vectors, per-domain topology
 match counts, and inter-pod-affinity count tables.
 
+Performance shape (measured on TPU-via-tunnel, where each vector op in a
+sequential dependency chain pays ~60µs of latency regardless of width):
+the scan body is written to MINIMIZE DEPENDENT STAGES, not op count —
+- per-step domain-count lookups ride the carry as per-NODE projections
+  (mnum/scnt/acnt/fcnt/dproj) updated with elementwise compares against the
+  landed row's topology value, instead of take_along_axis gathers (a TPU
+  gather serializes and costs ~40µs alone);
+- all windowed normalization min/max reductions collapse into ONE stacked
+  [k, NP] max-reduction (mins ride as negated lanes), and selection is a
+  second single reduction over a packed (score, rotation) key;
+- batches whose score vector cannot change except at the landed row carry
+  the total score; batches with no cross-window coupling at all take the
+  lap-vectorized path (_lap_schedule) which places L pods per iteration.
+
 Semantics parity (bit-exact vs the host oracle, enforced by
 tests/test_device_equivalence.py):
 - feasibility: NodeName, NodeUnschedulable, TaintToleration,
@@ -125,9 +139,9 @@ def _static_masks(state: DeviceNodeState, f: BatchFeatures):
     return taint_ok, pns_cnt, sel_ok, name_ok, unsched_ok, exist_anti_ok
 
 
-def _normalize_default_reverse(raw, kept):
-    """default_normalize_score(max=100, reverse=True) over the kept set."""
-    mx = jnp.max(jnp.where(kept, raw, 0))
+def _normalize_default_reverse(raw, mx):
+    """default_normalize_score(max=100, reverse=True); mx precomputed over
+    the kept set (one lane of the step's batched reduction)."""
     return jnp.where(mx > 0, MAX_NODE_SCORE - MAX_NODE_SCORE * raw // mx,
                      jnp.int64(MAX_NODE_SCORE))
 
@@ -176,7 +190,7 @@ def _resource_eval(f: BatchFeatures, fit_strategy: int,
 
 
 @partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax",
-                                   "has_pns", "has_ipa_base"),
+                                   "has_pns", "has_ipa_base", "anti_rowlocal"),
          donate_argnames=("carry_in",))
 def schedule_batch(
     state: DeviceNodeState,
@@ -188,6 +202,7 @@ def schedule_batch(
     carry_in: Optional[ScanCarry] = None,
     has_pns: bool = True,
     has_ipa_base: bool = True,
+    anti_rowlocal: bool = False,
 ) -> Tuple[jnp.ndarray, ScanCarry]:
     """Greedy-assign up to `batch_pad` identical pods (`n_active` of them
     real; padded steps are inert so the returned carry stays exact).
@@ -200,12 +215,12 @@ def schedule_batch(
     host commits batch N while the device computes batch N+1 — the TPU-era
     form of schedule_one.go:141's async binding-cycle overlap).
 
-    `has_pns` / `has_ipa_base` are host-known batch facts (any
-    PreferNoSchedule taints staged; any nonzero preferred-affinity base
-    score). When false the corresponding score terms are constant and the
-    scan body drops their per-step reductions — with no topology features at
-    all, the whole score vector rides the carry and each step reduces to
-    window selection + one-row updates."""
+    `has_pns` / `has_ipa_base` / `anti_rowlocal` are host-known batch facts
+    (any PreferNoSchedule taints staged; any nonzero preferred-affinity base
+    score; every required anti-affinity term keyed to a singleton-per-node
+    topology axis, i.e. kubernetes.io/hostname-like). They let the kernel
+    drop dead score reductions and — when a placement can only affect its own
+    landed row — take the lap-vectorized path."""
     NP = state.valid.shape[0]
     C1 = f.dns_axis.shape[0]
     C2 = f.sa_axis.shape[0]
@@ -215,14 +230,17 @@ def schedule_batch(
     idx = jnp.arange(NP, dtype=jnp.int32)
     num = jnp.maximum(f.num_nodes, 1)
 
-    # Feasibility can change only at the landed row when no topology filter
-    # is active — then the rotation prefix-sum updates incrementally instead
-    # of a full per-step cumsum.
-    incremental_feas = C1 == 0 and A1 == 0 and A2 == 0
-    # All score terms that depend on the evolving kept-set are absent — the
-    # total score vector is carried and updated only at the landed row.
-    static_scores = (incremental_feas and C2 == 0 and KD == 0
-                     and not has_pns and not has_ipa_base)
+    # Feasibility can change only at the landed row when no cross-window
+    # topology filter is active — DNS skew and required-affinity counts
+    # couple whole domains, but a required ANTI term on a singleton axis
+    # (hostname) only ever blocks the landed row itself.
+    incremental_feas = C1 == 0 and A2 == 0 and (A1 == 0 or anti_rowlocal)
+    # The total score vector changes only at the landed row (no kept-set
+    # normalization terms): it rides the carry instead of being recomputed.
+    scores_carried = (C2 == 0 and KD == 0 and not has_pns and not has_ipa_base)
+    # No cross-window coupling at all: place a whole lap of pods per
+    # iteration (the fast path for fit-only and hostname-anti-affinity pods).
+    static_scores = incremental_feas and scores_carried
 
     taint_ok, pns_cnt, sel_ok, name_ok, unsched_ok, exist_anti_ok = _static_masks(state, f)
 
@@ -245,6 +263,13 @@ def schedule_batch(
         sa_ignored = ~(sa_vid > 0).all(axis=0) | ~sel_ok
     else:
         sa_ignored = jnp.zeros(NP, bool)
+    # Bootstrap only applies on nodes carrying every requested topology key
+    # (satisfyPodAffinity checks key presence before the no-matches-anywhere
+    # case, filtering.go:398-426). Static per batch.
+    if A2:
+        aff_has_keys = ((f.aff_active[:, None] == 0) | (aff_vid > 0)).all(axis=0)
+    else:
+        aff_has_keys = jnp.ones(NP, bool)
 
     static_ok = (state.valid & name_ok & unsched_ok & taint_ok & sel_ok & exist_anti_ok)
 
@@ -252,92 +277,36 @@ def schedule_batch(
 
     n_act = jnp.int32(batch_pad) if n_active is None else n_active.astype(jnp.int32)
 
-    def total_score(fit_sc, ba, kept, sa_counts, ipa_delta):
-        """Weighted per-node score over the kept set
-        (runtime/framework.go:1526-1582 normalize + weight)."""
-        # TaintToleration ×w_tt (reverse-normalized). With no
-        # PreferNoSchedule taints staged, pns_cnt ≡ 0 ⇒ tt ≡ 100.
-        if has_pns:
-            tt = _normalize_default_reverse(pns_cnt, kept)
-        else:
-            tt = jnp.int64(MAX_NODE_SCORE)
-        # PodTopologySpread ScheduleAnyway ×w_pts (scoring.go)
-        if C2:
-            s_cnt = jnp.take_along_axis(sa_counts.astype(jnp.int64), sa_vid.astype(jnp.int64), axis=1)
-            raw_sa = (s_cnt * f.sa_wq[:, None] +
-                      (f.sa_skew[:, None] - 1) * 1024).sum(axis=0)
-            live = kept & ~sa_ignored
-            mn = jnp.min(jnp.where(live, raw_sa, _INF64))
-            mx = jnp.max(jnp.where(live, raw_sa, 0))
-            norm = jnp.where(mx > 0,
-                             MAX_NODE_SCORE * (mx + jnp.minimum(mn, mx) - raw_sa) // jnp.maximum(mx, 1),
-                             jnp.int64(MAX_NODE_SCORE))
-            pts = jnp.where(sa_ignored, 0, norm)
-        else:
-            pts = jnp.int64(0)
-        # InterPodAffinity ×w_ipa (scoring.go:258-289). All-zero raw scores
-        # normalize to 0 (diff == 0), so the reduction is skipped entirely
-        # when no base score nor landing delta exists.
-        if KD or has_ipa_base:
-            raw_ipa = f.ipa_base
-            if KD:
-                d = jnp.take_along_axis(ipa_delta, ipa_vid.astype(jnp.int64), axis=1)
-                raw_ipa = raw_ipa + (d * jnp.where(ipa_vid > 0, 1, 0)).sum(axis=0)
-            mn_i = jnp.min(jnp.where(kept, raw_ipa, _INF64))
-            mx_i = jnp.max(jnp.where(kept, raw_ipa, -_INF64))
-            diff = mx_i - mn_i
-            ipa = jnp.where(diff > 0,
-                            MAX_NODE_SCORE * (raw_ipa - mn_i) // jnp.maximum(diff, 1), 0)
-        else:
-            ipa = jnp.int64(0)
-        return w_tt * tt + w_fit * fit_sc + w_ba * ba + w_pts * pts + w_ipa * ipa
-
-    def feasibility(fit_ok, dns_counts, anti_counts, aff_counts):
+    def feasibility_proj(fit_ok, dns_counts, mnum, acnt, fcnt, aff_total):
         """Per-node ok mask from the dynamic filters
         (findNodesThatPassFilters; PTS skew filtering.go:318-362, IPA
-        required filtering.go:368-426)."""
-        # ---- PTS DoNotSchedule filter -------------------------------------
+        required filtering.go:368-426), reading the carried per-node
+        projections — no gathers on the critical path."""
+        ok = static_ok & fit_ok & (idx < num)
         if C1:
             cnt64 = dns_counts.astype(jnp.int64)
-            min_match = jnp.where(
-                f.dns_dom, cnt64, _INF64).min(axis=1)          # [C1]
+            min_match = jnp.where(f.dns_dom, cnt64, _INF64).min(axis=1)  # [C1]
             min_match = jnp.where(f.dns_forced0 == 1, 0, min_match)
-            match_num = jnp.take_along_axis(cnt64, dns_vid.astype(jnp.int64), axis=1)  # [C1, NP]
-            skew_bad = (match_num + f.dns_self[:, None].astype(jnp.int64)
+            skew_bad = (mnum.astype(jnp.int64) + f.dns_self[:, None].astype(jnp.int64)
                         - min_match[:, None]) > f.dns_max_skew[:, None]
             dns_reject = (f.dns_active[:, None] == 1) & (~(dns_vid > 0) | skew_bad)
-            dns_ok = ~dns_reject.any(axis=0)
-        else:
-            dns_ok = jnp.ones(NP, bool)
-        # ---- IPA required filter ------------------------------------------
+            ok &= ~dns_reject.any(axis=0)
         if A1:
-            a_cnt = jnp.take_along_axis(anti_counts, anti_vid, axis=1)  # [A1, NP]
-            anti_ok = ~((anti_vid > 0) & (a_cnt > 0)).any(axis=0)
-        else:
-            anti_ok = jnp.ones(NP, bool)
+            ok &= ~((anti_vid > 0) & (acnt > 0)).any(axis=0)
         if A2:
-            f_cnt = jnp.take_along_axis(aff_counts, aff_vid, axis=1)    # [A2, NP]
-            term_ok = (f.aff_active[:, None] == 0) | ((aff_vid > 0) & (f_cnt > 0))
-            all_matched = term_ok.all(axis=0)
-            total = (aff_counts * (f.aff_active[:, None] == 1)).sum()
-            # Bootstrap only applies on nodes carrying every requested
-            # topology key (satisfyPodAffinity checks key presence before the
-            # no-matches-anywhere case, filtering.go:398-426).
-            has_keys = ((f.aff_active[:, None] == 0) | (aff_vid > 0)).all(axis=0)
-            bootstrap = (total == 0) & (f.aff_own_all == 1) & has_keys
-            aff_ok = all_matched | bootstrap
-        else:
-            aff_ok = jnp.ones(NP, bool)
-        return static_ok & fit_ok & dns_ok & anti_ok & aff_ok & (idx < num)
+            term_ok = (f.aff_active[:, None] == 0) | ((aff_vid > 0) & (fcnt > 0))
+            bootstrap = (aff_total == 0) & (f.aff_own_all == 1) & aff_has_keys
+            ok &= term_ok.all(axis=0) | bootstrap
+        return ok
 
     def step(carry, t):
         (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
          dns_counts, sa_counts, anti_counts, aff_counts, ipa_delta, start,
-         okd, F, total) = carry
+         okd, F, total, mnum, scnt, acnt, fcnt, dproj, aff_total) = carry
         active = t < n_act
 
         if not incremental_feas:
-            okd = feasibility(fit_ok, dns_counts, anti_counts, aff_counts)
+            okd = feasibility_proj(fit_ok, dns_counts, mnum, acnt, fcnt, aff_total)
             F = jnp.cumsum(okd.astype(jnp.int32))          # inclusive, row order
 
         # ---- sampling truncation + rotation (schedule_one.go:779-892) -----
@@ -349,24 +318,60 @@ def schedule_batch(
         rank = jnp.where(idx >= start, F - f_start, F + total_feas - f_start)
         kept = okd & (rank <= f.to_find)
         rot_of_row = (idx - start) % num                   # row -> rotation pos
-        evaluated = jnp.min(jnp.where(okd & (rank == f.to_find), rot_of_row + 1, num))
 
-        if not static_scores:
-            total = total_score(fit_sc, ba, kept, sa_counts, ipa_delta)
+        # ---- round 1: all min/max reductions as ONE stacked max -----------
+        # lane 0: window-boundary rotation (evaluated); mins ride negated.
+        lanes = [jnp.where(okd & (rank == f.to_find),
+                           (num - 1 - rot_of_row).astype(jnp.int64), 0)]
+        if has_pns:
+            lanes.append(jnp.where(kept, pns_cnt, 0))              # mx_pns
+        if C2:
+            raw_sa = (scnt.astype(jnp.int64) * f.sa_wq[:, None] +
+                      (f.sa_skew[:, None] - 1) * 1024).sum(axis=0)
+            live = kept & ~sa_ignored
+            lanes.append(jnp.where(live, raw_sa, 0))               # mx_sa
+            lanes.append(jnp.where(live, -raw_sa, -_INF64))        # -mn_sa
+        if KD or has_ipa_base:
+            raw_ipa = f.ipa_base
+            if KD:
+                raw_ipa = raw_ipa + dproj.sum(axis=0)
+            lanes.append(jnp.where(kept, raw_ipa, -_INF64))        # mx_ipa
+            lanes.append(jnp.where(kept, -raw_ipa, -_INF64))       # -mn_ipa
+        red = jnp.max(jnp.stack(lanes), axis=1)
+        evaluated = (num - red[0]).astype(jnp.int32)
+        li = 1
 
-        # ---- select (schedule_one.go selectHost, deterministic ties) ------
-        if static_scores:
-            # Scores are non-negative ⇒ max-score-then-min-rotation packs
-            # into ONE reduction: key = total * NP + (NP-1-rot).
-            any_kept = (total_feas > 0) & active
-            key = total * NP + (jnp.int32(NP - 1) - rot_of_row)
-            best_key = jnp.max(jnp.where(kept, key, -1))
-            chosen_rot = jnp.int32(NP - 1) - (best_key % NP).astype(jnp.int32)
-        else:
-            any_kept = kept.any() & active
-            best = jnp.max(jnp.where(kept, total, -_INF64))
-            cand_rot = jnp.where(kept & (total == best), rot_of_row, _BIG)
-            chosen_rot = jnp.min(cand_rot)
+        # ---- score assembly (runtime/framework.go:1526-1582) --------------
+        if not scores_carried:
+            if has_pns:
+                tt = _normalize_default_reverse(pns_cnt, red[li]); li += 1
+            else:
+                tt = jnp.int64(MAX_NODE_SCORE)
+            if C2:
+                mx, mn = red[li], -red[li + 1]; li += 2
+                norm = jnp.where(
+                    mx > 0,
+                    MAX_NODE_SCORE * (mx + jnp.minimum(mn, mx) - raw_sa) // jnp.maximum(mx, 1),
+                    jnp.int64(MAX_NODE_SCORE))
+                pts = jnp.where(sa_ignored, 0, norm)
+            else:
+                pts = jnp.int64(0)
+            if KD or has_ipa_base:
+                mx_i, mn_i = red[li], -red[li + 1]; li += 2
+                diff = mx_i - mn_i
+                ipa = jnp.where(diff > 0,
+                                MAX_NODE_SCORE * (raw_ipa - mn_i) // jnp.maximum(diff, 1), 0)
+            else:
+                ipa = jnp.int64(0)
+            total = w_tt * tt + w_fit * fit_sc + w_ba * ba + w_pts * pts + w_ipa * ipa
+
+        # ---- round 2: packed selection (schedule_one.go selectHost, -------
+        # deterministic ties). Scores are non-negative ⇒ max-score-then-
+        # min-rotation packs into ONE reduction.
+        key = total * NP + (jnp.int32(NP - 1) - rot_of_row)
+        best_key = jnp.max(jnp.where(kept, key, -1))
+        any_kept = (best_key >= 0) & active
+        chosen_rot = jnp.int32(NP - 1) - (best_key % NP).astype(jnp.int32)
         chosen = jnp.where(any_kept, (start + chosen_rot) % num, -1).astype(jnp.int32)
 
         # ---- carry updates (inert when this step is padding) --------------
@@ -383,37 +388,46 @@ def schedule_batch(
         fit_ok = fit_ok.at[row].set(r_ok)
         fit_sc = fit_sc.at[row].set(r_fit)
         ba = ba.at[row].set(r_ba)
-        if incremental_feas:
-            # Feasibility flips only at the landed row: patch okd and shift
-            # the prefix-sum tail by the delta (replaces the full cumsum).
-            new_ok_row = static_ok[row] & r_ok & (row < num)
-            delta = new_ok_row.astype(jnp.int32) - okd[row].astype(jnp.int32)
-            okd = okd.at[row].set(new_ok_row)
-            F = F + jnp.where(idx >= row, delta, 0)
-        if static_scores:
-            total = total.at[row].set(
-                w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * r_fit + w_ba * r_ba)
         if C1:
             upd = (f.dns_self * dns_elig[jnp.arange(C1), row].astype(jnp.int32)
                    * apply.astype(jnp.int32))
             dns_counts = dns_counts.at[jnp.arange(C1), dns_vid[:, row]].add(upd)
+            mnum = mnum + upd[:, None] * (dns_vid == dns_vid[:, row][:, None])
         if C2:
             upd = (f.sa_self * jnp.where(sa_ignored[row], 0, 1) * apply.astype(jnp.int32))
             sa_counts = sa_counts.at[jnp.arange(C2), sa_vid[:, row]].add(upd)
+            scnt = scnt + upd[:, None] * (sa_vid == sa_vid[:, row][:, None])
         if A1:
             upd = f.anti_self * (anti_vid[:, row] > 0).astype(jnp.int32) * apply.astype(jnp.int32)
             anti_counts = anti_counts.at[jnp.arange(A1), anti_vid[:, row]].add(upd)
+            acnt = acnt + upd[:, None] * (anti_vid == anti_vid[:, row][:, None])
         if A2:
             upd = f.aff_self * (aff_vid[:, row] > 0).astype(jnp.int32) * apply.astype(jnp.int32)
             aff_counts = aff_counts.at[jnp.arange(A2), aff_vid[:, row]].add(upd)
+            fcnt = fcnt + upd[:, None] * (aff_vid == aff_vid[:, row][:, None])
+            aff_total = aff_total + upd.sum()
         if KD:
             upd = f.ipa_wland * (ipa_vid[:, row] > 0) * apply
             ipa_delta = ipa_delta.at[jnp.arange(KD), ipa_vid[:, row]].add(upd)
+            dproj = dproj + upd[:, None] * (ipa_vid == ipa_vid[:, row][:, None])
+        if incremental_feas:
+            # Feasibility flips only at the landed row: patch okd and shift
+            # the prefix-sum tail by the delta (replaces the full cumsum).
+            new_ok_row = static_ok[row] & r_ok & (row < num)
+            if A1:
+                new_ok_row &= ~((anti_vid[:, row] > 0) & (acnt[:, row] > 0)).any()
+            delta = new_ok_row.astype(jnp.int32) - okd[row].astype(jnp.int32)
+            okd = okd.at[row].set(new_ok_row)
+            F = F + jnp.where(idx >= row, delta, 0)
+        if scores_carried:
+            total = total.at[row].set(
+                w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * r_fit + w_ba * r_ba)
         start = jnp.where(active, (start + evaluated) % num, start).astype(jnp.int32)
 
         new_carry = (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
                      dns_counts, sa_counts, anti_counts, aff_counts,
-                     ipa_delta, start, okd, F, total)
+                     ipa_delta, start, okd, F, total,
+                     mnum, scnt, acnt, fcnt, dproj, aff_total)
         return new_carry, (chosen, start)
 
     if carry_in is None:
@@ -427,18 +441,37 @@ def schedule_batch(
                          f.aff_counts, ipa_delta0, f.start_index)
     else:
         ext0 = carry_in
-    # okd/F/total are derivable from the external carry; seed them once per
-    # call (the scan keeps them incrementally fresh on the fast paths, and
-    # recomputes them per step otherwise).
-    okd0 = feasibility(ext0.fit_ok, ext0.dns_counts, ext0.anti_counts,
-                       ext0.aff_counts)
-    F0 = jnp.cumsum(okd0.astype(jnp.int32))
     if static_scores:
         return _lap_schedule(state, f, batch_pad, fit_strategy,
                              ext0, static_ok, n_act, idx, num,
-                             w_tt, w_fit, w_ba)
-    total0 = jnp.zeros(NP, jnp.int64)
-    carry0 = tuple(ext0) + (okd0, F0, total0)
+                             w_tt, w_fit, w_ba, anti_vid)
+    # Per-node projections of the count tables (one gather per table per
+    # CALL, kept elementwise-fresh by the scan) + okd/F seeds.
+    i64v = jnp.int64
+    mnum0 = (jnp.take_along_axis(ext0.dns_counts, dns_vid.astype(i64v), axis=1)
+             if C1 else jnp.zeros((0, NP), jnp.int32))
+    scnt0 = (jnp.take_along_axis(ext0.sa_counts, sa_vid.astype(i64v), axis=1)
+             if C2 else jnp.zeros((0, NP), jnp.int32))
+    acnt0 = (jnp.take_along_axis(ext0.anti_counts, anti_vid.astype(i64v), axis=1)
+             if A1 else jnp.zeros((0, NP), jnp.int32))
+    fcnt0 = (jnp.take_along_axis(ext0.aff_counts, aff_vid.astype(i64v), axis=1)
+             if A2 else jnp.zeros((0, NP), jnp.int32))
+    if KD:
+        d0 = jnp.take_along_axis(ext0.ipa_delta, ipa_vid.astype(i64v), axis=1)
+        dproj0 = d0 * jnp.where(ipa_vid > 0, 1, 0)
+    else:
+        dproj0 = jnp.zeros((0, NP), jnp.int64)
+    aff_total0 = (ext0.aff_counts * (f.aff_active[:, None] == 1)).sum()
+    okd0 = feasibility_proj(ext0.fit_ok, ext0.dns_counts, mnum0, acnt0,
+                            fcnt0, aff_total0)
+    F0 = jnp.cumsum(okd0.astype(jnp.int32))
+    if scores_carried:
+        total0 = (w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * ext0.fit_sc
+                  + w_ba * ext0.ba)
+    else:
+        total0 = jnp.zeros(NP, jnp.int64)
+    carry0 = tuple(ext0) + (okd0, F0, total0,
+                            mnum0, scnt0, acnt0, fcnt0, dproj0, aff_total0)
     final, (chosen, starts) = lax.scan(
         step, carry0, jnp.arange(batch_pad, dtype=jnp.int32))
     # chosen+starts stacked into ONE array: the host fetches results with a
@@ -458,22 +491,28 @@ LAP_MAX = 32
 
 
 def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
-                  static_ok, n_act, idx, num, w_tt, w_fit, w_ba):
+                  static_ok, n_act, idx, num, w_tt, w_fit, w_ba, anti_vid):
     """Lap-vectorized greedy assignment for the static-score case.
 
     Key fact: with adaptive sampling live (schedule_one.go:866-892), pod i
     examines the window holding the first `to_find` feasible nodes after its
     start index, and pod i+1's window begins where pod i's ended. Windows of
     consecutive pods are therefore DISJOINT until the rotation laps the
-    cluster — and with no topology features, a placement changes scores and
-    feasibility only at its own landed row, which later windows in the same
-    lap never see. So all `L = total_feasible // to_find` pods of one lap are
-    independent: one segmented argmax places them all. The sequential scan
-    (1 pod/step) collapses to ~B·to_find/N steps — at 5k nodes the 1024-pod
-    batch runs in ~100 lap iterations of which each does ONE pass over the
-    node tensors. This is the TPU-shaped replacement for the goroutine pool:
-    maximal vector work per sequential dependency, not per worker."""
+    cluster — and with no cross-window topology coupling, a placement changes
+    scores and feasibility only at its own landed row, which later windows in
+    the same lap never see. So all `L = total_feasible // to_find` pods of
+    one lap are independent: one segmented argmax places them all. The
+    sequential scan (1 pod/step) collapses to ~B·to_find/N steps — at 5k
+    nodes the 1024-pod batch runs in ~100 lap iterations of which each does
+    ONE pass over the node tensors. This is the TPU-shaped replacement for
+    the goroutine pool: maximal vector work per sequential dependency, not
+    per worker.
+
+    Required anti-affinity terms on singleton axes (hostname) ride this path
+    too: a landing only blocks its own row, which later windows never
+    examine; `anti_counts` is refreshed per lap from the placements."""
     NP = state.valid.shape[0]
+    A1 = anti_vid.shape[0]
     tf = jnp.maximum(f.to_find, 1)
     B = batch_pad
     SEG = LAP_MAX + 1  # window segments + 1 dump lane
@@ -484,13 +523,16 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
         return c[0] < n_act
 
     def body(c):
-        (done, req_r, nonzero, pod_count, start, out) = c
+        (done, req_r, nonzero, pod_count, anti_counts, start, out) = c
         # Dense per-lap recompute (no scatters/gathers — TPU scatters
         # serialize per index, so one-hot masked vector ops win):
         fit_ok, fit_sc, ba = _resource_eval(
             f, fit_strategy, state.alloc_r, state.alloc_pods,
             req_r, nonzero, pod_count)
         okd = static_ok & fit_ok & (idx < num)
+        if A1:
+            acnt = jnp.take_along_axis(anti_counts, anti_vid.astype(jnp.int64), axis=1)
+            okd &= ~((anti_vid > 0) & (acnt > 0)).any(axis=0)
         F = jnp.cumsum(okd.astype(jnp.int32))
         total = w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * fit_sc + w_ba * ba
         total_feas = F[-1]
@@ -527,21 +569,30 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
         req_r = req_r + f.request[None, :] * c64[:, None]
         nonzero = nonzero + f.nz_request[None, :] * c64[:, None]
         pod_count = pod_count + cnt.astype(jnp.int32)
+        if A1:
+            # hostname-anti landings: +self at each landed row's own value
+            # (duplicate vids cannot occur — the axis is singleton-per-node).
+            rr = jnp.maximum(row_w, 0)
+            upd = (f.anti_self[:, None] * (anti_vid[:, rr] > 0).astype(jnp.int32)
+                   * has_w[None, :].astype(jnp.int32))        # [A1, LAP_MAX]
+            anti_counts = anti_counts.at[
+                jnp.arange(A1)[:, None], anti_vid[:, rr]].add(upd)
         # ---- emit results (positions >= n_act are sliced off by the host) -
         chosen_w = jnp.where(has_w, row_w, -1)
         block = jnp.stack([chosen_w, start_w.astype(jnp.int32)])  # [2, LAP_MAX]
         out = lax.dynamic_update_slice(out, block, (jnp.int32(0), done))
         start = start_w[jnp.maximum(L - 1, 0)]
-        return (done + L, req_r, nonzero, pod_count, start, out)
+        return (done + L, req_r, nonzero, pod_count, anti_counts, start, out)
 
     out0 = jnp.full((2, B + LAP_MAX), -1, jnp.int32)
     c0 = (jnp.int32(0), ext0.req_r, ext0.nonzero, ext0.pod_count,
-          ext0.start, out0)
-    done, req_r, nonzero, pod_count, start, out = lax.while_loop(cond, body, c0)
+          ext0.anti_counts, ext0.start, out0)
+    done, req_r, nonzero, pod_count, anti_counts, start, out = lax.while_loop(
+        cond, body, c0)
     fit_ok, fit_sc, ba = _resource_eval(
         f, fit_strategy, state.alloc_r, state.alloc_pods,
         req_r, nonzero, pod_count)
     carry = ScanCarry(req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
-                      ext0.dns_counts, ext0.sa_counts, ext0.anti_counts,
+                      ext0.dns_counts, ext0.sa_counts, anti_counts,
                       ext0.aff_counts, ext0.ipa_delta, start)
     return out[:, :B], carry

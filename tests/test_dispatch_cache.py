@@ -1,0 +1,65 @@
+"""Warm/live dispatch trace identity.
+
+warm_for exists to put XLA compilation OUTSIDE measured windows; that only
+works if every live dispatch is call-signature-identical to the warm ones
+(static kwargs are part of jit's cache-key pytree structure — an omitted-vs-
+explicit kwarg is a different structure and retraces). Round 2's headline
+"regression" (TopologySpreading at 0.22x baseline) was exactly such a
+mismatch: a ~1min compile inside every measured window. These tests pin the
+invariant with jit's trace-cache size so it can never silently return.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.core import FakeClientset
+from kubernetes_tpu.models import TPUScheduler
+from kubernetes_tpu.ops.kernel import schedule_batch
+from kubernetes_tpu.testing import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def _cache_size():
+    try:
+        return schedule_batch._cache_size()
+    except AttributeError:  # pragma: no cover - jax internals moved
+        pytest.skip("jit cache size introspection unavailable")
+
+
+def _cluster(n_nodes=40):
+    cs = FakeClientset()
+    s = TPUScheduler(clientset=cs)
+    for i in range(n_nodes):
+        cs.create_node(
+            make_node().name(f"n{i}")
+            .capacity({"cpu": 16, "memory": "64Gi", "pods": 110})
+            .zone(f"z{i % 4}").obj())
+    return cs, s
+
+
+@pytest.mark.parametrize("template", ["basic", "spread", "anti"])
+def test_no_retrace_after_warm(template):
+    cs, s = _cluster()
+
+    def pod(name):
+        b = make_pod().name(name).req({"cpu": "100m"})
+        if template == "spread":
+            b = b.label("app", "t").spread_constraint(
+                1, ZONE, "DoNotSchedule", {"app": "t"})
+        elif template == "anti":
+            b = b.label("app", "t").pod_affinity(
+                "kubernetes.io/hostname", {"app": "t"}, anti=True)
+        return b.obj()
+
+    s.warm_for(pod("warm-template"))
+    warmed = _cache_size()
+    # Enough pods for two chained batches: exercises the fresh-carry AND
+    # chained-carry live dispatches.
+    for i in range(30):
+        cs.create_pod(pod(f"p{i}"))
+    s.run_until_idle()
+    assert s.scheduled == 30 and s.host_path_pods == 0
+    assert _cache_size() == warmed, (
+        "live dispatch retraced schedule_batch after warm_for — a compile "
+        "would land inside the measured window on real hardware")
