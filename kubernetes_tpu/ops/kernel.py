@@ -284,11 +284,12 @@ def schedule_batch(
         projections — no gathers on the critical path."""
         ok = static_ok & fit_ok & (idx < num)
         if C1:
-            cnt64 = dns_counts.astype(jnp.int64)
-            min_match = jnp.where(f.dns_dom, cnt64, _INF64).min(axis=1)  # [C1]
+            # All-int32 skew math (counts are pods-per-domain, far below 2^31;
+            # int64 vector ops cost ~2x in the per-op-latency regime).
+            min_match = jnp.where(f.dns_dom, dns_counts, _BIG).min(axis=1)  # [C1]
             min_match = jnp.where(f.dns_forced0 == 1, 0, min_match)
-            skew_bad = (mnum.astype(jnp.int64) + f.dns_self[:, None].astype(jnp.int64)
-                        - min_match[:, None]) > f.dns_max_skew[:, None]
+            skew_bad = (mnum + f.dns_self[:, None] - min_match[:, None]
+                        ) > jnp.minimum(f.dns_max_skew, _BIG)[:, None]
             dns_reject = (f.dns_active[:, None] == 1) & (~(dns_vid > 0) | skew_bad)
             ok &= ~dns_reject.any(axis=0)
         if A1:
@@ -319,30 +320,39 @@ def schedule_batch(
         kept = okd & (rank <= f.to_find)
         rot_of_row = (idx - start) % num                   # row -> rotation pos
 
-        # ---- round 1: all min/max reductions as ONE stacked max -----------
-        # lane 0: window-boundary rotation (evaluated); mins ride negated.
-        lanes = [jnp.where(okd & (rank == f.to_find),
-                           (num - 1 - rot_of_row).astype(jnp.int64), 0)]
-        if has_pns:
-            lanes.append(jnp.where(kept, pns_cnt, 0))              # mx_pns
-        if C2:
-            raw_sa = (scnt.astype(jnp.int64) * f.sa_wq[:, None] +
-                      (f.sa_skew[:, None] - 1) * 1024).sum(axis=0)
-            live = kept & ~sa_ignored
-            lanes.append(jnp.where(live, raw_sa, 0))               # mx_sa
-            lanes.append(jnp.where(live, -raw_sa, -_INF64))        # -mn_sa
-        if KD or has_ipa_base:
-            raw_ipa = f.ipa_base
-            if KD:
-                raw_ipa = raw_ipa + dproj.sum(axis=0)
-            lanes.append(jnp.where(kept, raw_ipa, -_INF64))        # mx_ipa
-            lanes.append(jnp.where(kept, -raw_ipa, -_INF64))       # -mn_ipa
-        red = jnp.max(jnp.stack(lanes), axis=1)
-        evaluated = (num - red[0]).astype(jnp.int32)
-        li = 1
-
-        # ---- score assembly (runtime/framework.go:1526-1582) --------------
-        if not scores_carried:
+        # ---- reductions: everything as stacked maxes (mins ride negated) --
+        # lane 0: window-boundary rotation (evaluated).
+        bound_lane = jnp.where(okd & (rank == f.to_find),
+                               (num - 1 - rot_of_row).astype(jnp.int64), 0)
+        if scores_carried:
+            # total is already known: boundary + packed selection key
+            # (max-score-then-min-rotation; scores non-negative) collapse
+            # into ONE reduction round.
+            key = total * NP + (jnp.int32(NP - 1) - rot_of_row)
+            red = jnp.max(jnp.stack(
+                [jnp.where(kept, key, -1), bound_lane]), axis=1)
+            best_key = red[0]
+            evaluated = (num - red[1]).astype(jnp.int32)
+        else:
+            lanes = [bound_lane]
+            if has_pns:
+                lanes.append(jnp.where(kept, pns_cnt, 0))              # mx_pns
+            if C2:
+                raw_sa = (scnt.astype(jnp.int64) * f.sa_wq[:, None] +
+                          (f.sa_skew[:, None] - 1) * 1024).sum(axis=0)
+                live = kept & ~sa_ignored
+                lanes.append(jnp.where(live, raw_sa, 0))               # mx_sa
+                lanes.append(jnp.where(live, -raw_sa, -_INF64))        # -mn_sa
+            if KD or has_ipa_base:
+                raw_ipa = f.ipa_base
+                if KD:
+                    raw_ipa = raw_ipa + dproj.sum(axis=0)
+                lanes.append(jnp.where(kept, raw_ipa, -_INF64))        # mx_ipa
+                lanes.append(jnp.where(kept, -raw_ipa, -_INF64))       # -mn_ipa
+            red = jnp.max(jnp.stack(lanes), axis=1)
+            evaluated = (num - red[0]).astype(jnp.int32)
+            li = 1
+            # ---- score assembly (runtime/framework.go:1526-1582) ----------
             if has_pns:
                 tt = _normalize_default_reverse(pns_cnt, red[li]); li += 1
             else:
@@ -364,12 +374,9 @@ def schedule_batch(
             else:
                 ipa = jnp.int64(0)
             total = w_tt * tt + w_fit * fit_sc + w_ba * ba + w_pts * pts + w_ipa * ipa
-
-        # ---- round 2: packed selection (schedule_one.go selectHost, -------
-        # deterministic ties). Scores are non-negative ⇒ max-score-then-
-        # min-rotation packs into ONE reduction.
-        key = total * NP + (jnp.int32(NP - 1) - rot_of_row)
-        best_key = jnp.max(jnp.where(kept, key, -1))
+            # second reduction round: packed selection over the fresh scores
+            key = total * NP + (jnp.int32(NP - 1) - rot_of_row)
+            best_key = jnp.max(jnp.where(kept, key, -1))
         any_kept = (best_key >= 0) & active
         chosen_rot = jnp.int32(NP - 1) - (best_key % NP).astype(jnp.int32)
         chosen = jnp.where(any_kept, (start + chosen_rot) % num, -1).astype(jnp.int32)
