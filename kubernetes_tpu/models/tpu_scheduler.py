@@ -118,7 +118,7 @@ class TPUScheduler(Scheduler):
 
     # -- device dispatch ---------------------------------------------------
 
-    def _profile_weights(self, fw: Framework) -> Tuple[int, int, int, int, int]:
+    def _profile_weights(self, fw: Framework) -> Tuple[int, int, int, int, int, int, int]:
         w = {p.name: weight for p, weight in fw.score_plugins}
         return (
             w.get("TaintToleration", 0),
@@ -126,6 +126,8 @@ class TPUScheduler(Scheduler):
             w.get("PodTopologySpread", 0),
             w.get("InterPodAffinity", 0),
             w.get("NodeResourcesBalancedAllocation", 0),
+            w.get("NodeAffinity", 0),
+            w.get("ImageLocality", 0),
         )
 
     def _profile_filters(self, fw: Framework) -> Tuple[bool, bool, bool, bool, bool]:
@@ -171,6 +173,10 @@ class TPUScheduler(Scheduler):
             start_index=self.next_start_node_index,
             weights=self._profile_weights(fw),
             filters_on=self._profile_filters(fw),
+            extra_filters={
+                name: name in {p.name for p in fw.filter_plugins}
+                for name in ("NodePorts", "NodeDeclaredFeatures")
+            },
             hard_pod_affinity_weight=getattr(ipa, "hard_pod_affinity_weight", 1),
             ignore_preferred_terms_of_existing_pods=getattr(
                 ipa, "ignore_preferred_terms_of_existing_pods", False),
@@ -221,7 +227,8 @@ class TPUScheduler(Scheduler):
             state, plan.features, plan.batch_pad, plan.fit_strategy,
             plan.vmax, n_active=np.int32(n_active), carry_in=carry,
             has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base,
-            anti_rowlocal=plan.anti_rowlocal)
+            anti_rowlocal=plan.anti_rowlocal, has_na_pref=plan.has_na_pref,
+            port_selfblock=plan.port_selfblock)
 
     # -- device session ----------------------------------------------------
     #

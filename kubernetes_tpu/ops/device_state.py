@@ -77,7 +77,6 @@ class DeviceNodeState(NamedTuple):
     unsched: jnp.ndarray      # [NP]    bool node.spec.unschedulable
     valid: jnp.ndarray        # [NP]    bool row holds a live node
     name_id: jnp.ndarray      # [NP]    i32 interned node name
-    pairs: jnp.ndarray        # [NP, L] i32 interned label (k,v) pairs (0 pad)
     topo: jnp.ndarray         # [K, NP] i32 per-axis topology value ids (0 = absent)
 
 
@@ -133,7 +132,6 @@ class NodeStateMirror:
 
         self.keys = Codebook()        # taint keys (shared with tolerations)
         self.vals = Codebook()        # taint values
-        self.pairs = Codebook(("", ""))  # label (key, value) pairs
         self.names = Codebook()       # node names
         self.scalar_slots: Dict[str, int] = {}  # scalar resource -> slot >= BASE_RESOURCES
         self.axes: Dict[str, TopoAxis] = {}
@@ -165,7 +163,6 @@ class NodeStateMirror:
         self.h_unsched = np.zeros(npc, bool)
         self.h_valid = np.zeros(npc, bool)
         self.h_name_id = np.zeros(npc, np.int32)
-        self.h_pairs = np.zeros((npc, l), np.int32)
         self.h_topo = np.zeros((k, npc), np.int32)
 
     def _grow(self, node_capacity=None, taint_capacity=None, label_capacity=None,
@@ -248,12 +245,6 @@ class NodeStateMirror:
         self.h_valid[i] = node is not None
         self.h_name_id[i] = self.names.intern(node.name) if node else 0
         labels = node.labels if node else {}
-        if len(labels) > self.l_cap:
-            self._grow(label_capacity=_pow2(len(labels), self.l_cap * 2))
-            raise _Regrown()
-        self.h_pairs[i] = 0
-        for j, (k, v) in enumerate(labels.items()):
-            self.h_pairs[i, j] = self.pairs.intern((k, v))
         for ax in self.axes.values():
             val = labels.get(ax.key)
             self.h_topo[ax.index, i] = ax.intern_value(val) if val is not None else 0
@@ -302,7 +293,6 @@ class NodeStateMirror:
             self.h_alloc_r, self.h_alloc_pods, self.h_req_r, self.h_nonzero,
             self.h_pod_count, self.h_taint_key, self.h_taint_val,
             self.h_taint_eff, self.h_unsched, self.h_valid, self.h_name_id,
-            self.h_pairs,
         )
 
     def flush(self) -> DeviceNodeState:

@@ -79,7 +79,19 @@ class BatchFeatures(NamedTuple):
     # cheap filters
     node_name_id: jnp.ndarray     # i32 (0 = unset)
     tolerates_unsched: jnp.ndarray  # i32
-    sel_pairs: jnp.ndarray        # [Q] i32 required label (k,v) pair ids (0 pad)
+    # Full node-selector + required-node-affinity verdict per node, evaluated
+    # host-side with the oracle semantics (matchExpressions In/NotIn/Exists/
+    # DoesNotExist/Gt/Lt AND matchFields metadata.name — node_affinity.go
+    # Filter). Static per batch: node labels cannot change mid-session.
+    sel_match: jnp.ndarray        # [NP] bool
+    # Extra host-evaluated static filters folded into static_ok: NodePorts
+    # conflicts vs existing pods (nodeports.go Fits) and NodeDeclaredFeatures
+    # (fork plugin). Placement-dependent port self-conflicts ride the carry's
+    # `blocked` vector instead (BatchPlan.port_selfblock).
+    extra_ok: jnp.ndarray         # [NP] bool
+    # Static additive / normalized score inputs.
+    il_score: jnp.ndarray         # [NP] i64 ImageLocality score (0-100, no norm)
+    na_raw: jnp.ndarray           # [NP] i64 preferred-node-affinity raw sum
     # PodTopologySpread DoNotSchedule
     dns_axis: jnp.ndarray         # [C1] i32 axis row in state.topo
     dns_active: jnp.ndarray       # [C1] i32 (0 = padding row, never rejects)
@@ -113,8 +125,8 @@ class BatchFeatures(NamedTuple):
     # Fit / BalancedAllocation scoring config
     fit_slots: jnp.ndarray        # [FR] i32 resource slot per scored resource
     fit_weights: jnp.ndarray      # [FR] i64
-    # plugin weights: [tt, fit, pts, ipa, ba]
-    weights: jnp.ndarray          # [5] i64
+    # plugin weights: [tt, fit, pts, ipa, ba, na, il]
+    weights: jnp.ndarray          # [7] i64
     # filter enablement from the profile's filter plugin set:
     # [NodeName, NodeUnschedulable, TaintToleration, NodeAffinity, NodeResourcesFit]
     enable: jnp.ndarray           # [5] i32
@@ -140,6 +152,13 @@ class BatchPlan:
     # topology axis (kubernetes.io/hostname-like): a landing can only block
     # its own row, so the kernel's lap-vectorized path stays exact.
     anti_rowlocal: bool = False
+    # Pod carries preferred node-affinity terms (na_raw nonzero possible):
+    # adds a kept-set normalization, disabling the carried-score fast path.
+    has_na_pref: bool = False
+    # Pod requests host ports: a landing occupies them, so the landed row
+    # blocks itself for the rest of the session (identical pods always
+    # port-conflict with each other) — row-local, lap-path compatible.
+    port_selfblock: bool = False
 
 
 class Unsupported(Exception):
@@ -148,25 +167,29 @@ class Unsupported(Exception):
 
 
 def batch_supported(pod: Pod, snapshot, fit_plugin=None, ba_plugin=None) -> Optional[str]:
-    """Returns a reason string when the pod needs the host path, else None."""
+    """Returns a reason string when the pod needs the host path, else None.
+
+    Host ports, node-affinity expressions (required AND preferred), image
+    locality, and NodeDeclaredFeatures are covered on device since round 3
+    via host-evaluated static per-node vectors (sel_match / extra_ok /
+    na_raw / il_score) — only genuinely stateful host machinery (volume
+    binding, DRA allocation, nominated-pod two-pass) still falls back."""
     if pod.nominated_node_name:
         return "nominated node fast path"
-    if pod.host_ports():
-        return "host ports"
+    aff = pod.affinity
+    na = aff.node_affinity if aff is not None else None
+    if na is not None and na.required is not None:
+        # matchFields metadata.name pins trigger the NodeAffinity
+        # PreFilterResult narrowing (node_affinity.go PreFilter): the host
+        # cycle then rotates/samples over the NARROWED node list, which the
+        # kernel's full-cluster rotation cannot reproduce — and the narrowed
+        # universe is tiny, so the host cycle is already O(1) per pod.
+        if any(t.match_fields for t in na.required.terms):
+            return "node-affinity metadata.name narrowing"
     if any(v.pvc_name for v in pod.volumes):
         return "pvc-backed volumes"
     if getattr(pod, "resource_claims", None):
         return "dynamic resource claims"
-    aff = pod.affinity
-    if aff is not None and aff.node_affinity is not None:
-        na = aff.node_affinity
-        if na.preferred:
-            return "preferred node affinity scoring"
-        if na.required is not None:
-            return "node affinity expressions"
-    for c in pod.containers:
-        if c.image and c.image in snapshot.image_num_nodes:
-            return "image locality scoring"
     if fit_plugin is not None and fit_plugin.scoring_strategy not in ("LeastAllocated", "MostAllocated"):
         return "requestedToCapacityRatio strategy"
     if ba_plugin is not None and tuple(
@@ -194,8 +217,9 @@ def build_batch(
     *,
     percentage_of_nodes_to_score: int = 0,
     start_index: int = 0,
-    weights: Tuple[int, int, int, int, int] = (3, 1, 2, 2, 1),
+    weights: Tuple[int, ...] = (3, 1, 2, 2, 1, 2, 1),
     filters_on: Tuple[bool, bool, bool, bool, bool] = (True, True, True, True, True),
+    extra_filters: Optional[Dict[str, bool]] = None,
     hard_pod_affinity_weight: int = 1,
     ignore_preferred_terms_of_existing_pods: bool = False,
     fit_plugin=None,
@@ -250,18 +274,63 @@ def build_batch(
     if pod.node_name and node_name_id == -1:
         # Requested node not in the snapshot: no node can match.
         node_name_id = i32(-2)
-    sel_items = sorted(pod.node_selector.items())
-    q = _pow2(len(sel_items))
-    sel_pairs = np.zeros(q, i32)
-    for j, kv in enumerate(sel_items):
-        sel_pairs[j] = mirror.pairs.lookup(kv)  # -1 if no node has the pair
 
     # Host-side per-node predicates reused by the topology aggregations below
-    # (identical to the plugin oracles' helpers).
+    # (identical to the plugin oracles' helpers). sel_match_host carries the
+    # FULL node-selector + required-node-affinity semantics and is shipped to
+    # the kernel verbatim — affinity matchExpressions/matchFields need no
+    # device re-implementation because they are static per batch.
     sel_match_host = [pod.required_node_selector_matches(ni.node) for ni in nodes]
     taint_ok_host = [
         find_matching_untolerated_taint(ni.node.taints, tols) is None for ni in nodes
     ]
+
+    extra = extra_filters or {}
+
+    # -- extra static filters: NodeDeclaredFeatures + NodePorts -------------
+    extra_ok_host = np.ones(len(nodes), bool)
+    req_feats = [s.strip() for s in pod.annotations.get(
+        "features.k8s.io/required", "").split(",") if s.strip()]
+    if req_feats and extra.get("NodeDeclaredFeatures", True):
+        for r_i, ni in enumerate(nodes):
+            declared = ni.node.declared_features if ni.node else {}
+            if not all(declared.get(ft, False) for ft in req_feats):
+                extra_ok_host[r_i] = False
+    ports = pod.host_ports()
+    port_selfblock = False
+    if ports and extra.get("NodePorts", True):
+        # Identical pods always conflict with their own ports, so a landing
+        # blocks its row (kernel carry `blocked`); existing-pod conflicts are
+        # static — evaluated with the host plugin's own predicate.
+        from ..plugins.basic import host_ports_conflict
+        port_selfblock = True
+        for r_i, ni in enumerate(nodes):
+            if host_ports_conflict(ports, ni.used_ports):
+                extra_ok_host[r_i] = False
+
+    # -- ImageLocality static score (imagelocality.go scaledImageScore) -----
+    il_host = None
+    if weights[6] and any(c.image for c in pod.containers):
+        from ..plugins.basic import ImageLocality
+        total_nodes = max(1, len(nodes))
+        il_host = np.zeros(len(nodes), np.int64)
+        for r_i, ni in enumerate(nodes):
+            il_host[r_i] = ImageLocality.scaled_score(
+                pod, ni, snapshot.image_num_nodes, total_nodes)
+
+    # -- preferred node affinity raw score (node_affinity.go Score) ---------
+    na_host = None
+    has_na_pref = False
+    na_spec = pod.affinity.node_affinity if pod.affinity else None
+    if na_spec is not None and na_spec.preferred and weights[5]:
+        has_na_pref = True
+        na_host = np.zeros(len(nodes), np.int64)
+        for r_i, ni in enumerate(nodes):
+            t = 0
+            for pref in na_spec.preferred:
+                if pref.preference.matches(ni.node):
+                    t += pref.weight
+            na_host[r_i] = t
 
     # -- PodTopologySpread ------------------------------------------------
     dns = _compile_constraints(pod, DO_NOT_SCHEDULE)
@@ -556,7 +625,10 @@ def build_batch(
         tol_eff=jnp.asarray(tol_eff), tol_op=jnp.asarray(tol_op),
         node_name_id=jnp.asarray(node_name_id),
         tolerates_unsched=jnp.asarray(tolerates_unsched),
-        sel_pairs=jnp.asarray(sel_pairs),
+        sel_match=jnp.asarray(_pad_bool(sel_match_host, npc)),
+        extra_ok=jnp.asarray(_pad_bool(extra_ok_host, npc, default=True)),
+        il_score=jnp.asarray(_pad_i64(il_host, npc)),
+        na_raw=jnp.asarray(_pad_i64(na_host, npc)),
         dns_axis=jnp.asarray(dns_axis), dns_active=jnp.asarray(dns_active),
         dns_max_skew=jnp.asarray(dns_max_skew),
         dns_self=jnp.asarray(dns_self), dns_forced0=jnp.asarray(dns_forced0),
@@ -589,7 +661,23 @@ def build_batch(
         has_pns=bool((mirror.h_taint_eff[:n] == EFFECT_PREFER_NO_SCHEDULE).any()),
         has_ipa_base=bool((ipa_base != 0).any()),
         anti_rowlocal=anti_rowlocal,
+        has_na_pref=has_na_pref,
+        port_selfblock=port_selfblock,
     )
+
+
+def _pad_bool(vals, npc: int, default: bool = False) -> np.ndarray:
+    out = np.full(npc, default, bool)
+    if vals is not None:
+        out[:len(vals)] = vals
+    return out
+
+
+def _pad_i64(vals, npc: int) -> np.ndarray:
+    out = np.zeros(npc, np.int64)
+    if vals is not None:
+        out[:len(vals)] = vals
+    return out
 
 
 def _batch_tier(n: int) -> int:

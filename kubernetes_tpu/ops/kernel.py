@@ -87,6 +87,7 @@ class ScanCarry(NamedTuple):
     aff_counts: jnp.ndarray   # [A2, V] i32
     ipa_delta: jnp.ndarray    # [KD, V] i64
     start: jnp.ndarray        # i32 rotation index
+    blocked: jnp.ndarray      # [NP] bool rows self-blocked by a landing (ports)
 
 
 def _tolerates(f: BatchFeatures, taint_key, taint_val, taint_eff):
@@ -121,12 +122,9 @@ def _static_masks(state: DeviceNodeState, f: BatchFeatures):
         pns_tolerated = jnp.zeros(state.taint_key.shape, bool)
     pns_cnt = ((state.taint_eff == EFFECT_PREFER_NO_SCHEDULE) & ~pns_tolerated).sum(
         axis=1).astype(jnp.int64)  # [N]
-    # node_selector equality pairs
-    if f.sel_pairs.shape[0]:
-        hit = (state.pairs[:, :, None] == f.sel_pairs[None, None, :]).any(axis=1)
-        sel_ok = ((f.sel_pairs[None, :] == 0) | hit).all(axis=1)
-    else:
-        sel_ok = jnp.ones(state.valid.shape, bool)
+    # Full node-selector + required-node-affinity verdict, host-evaluated
+    # (static per batch — ops/features.py sel_match).
+    sel_ok = f.sel_match
     # cheap gates
     name_ok = (f.node_name_id == 0) | (state.name_id == f.node_name_id)
     unsched_ok = ~state.unsched | (f.tolerates_unsched == 1)
@@ -190,7 +188,8 @@ def _resource_eval(f: BatchFeatures, fit_strategy: int,
 
 
 @partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax",
-                                   "has_pns", "has_ipa_base", "anti_rowlocal"),
+                                   "has_pns", "has_ipa_base", "anti_rowlocal",
+                                   "has_na_pref", "port_selfblock"),
          donate_argnames=("carry_in",))
 def schedule_batch(
     state: DeviceNodeState,
@@ -203,6 +202,8 @@ def schedule_batch(
     has_pns: bool = True,
     has_ipa_base: bool = True,
     anti_rowlocal: bool = False,
+    has_na_pref: bool = False,
+    port_selfblock: bool = False,
 ) -> Tuple[jnp.ndarray, ScanCarry]:
     """Greedy-assign up to `batch_pad` identical pods (`n_active` of them
     real; padded steps are inert so the returned carry stays exact).
@@ -237,7 +238,8 @@ def schedule_batch(
     incremental_feas = C1 == 0 and A2 == 0 and (A1 == 0 or anti_rowlocal)
     # The total score vector changes only at the landed row (no kept-set
     # normalization terms): it rides the carry instead of being recomputed.
-    scores_carried = (C2 == 0 and KD == 0 and not has_pns and not has_ipa_base)
+    scores_carried = (C2 == 0 and KD == 0 and not has_pns
+                      and not has_ipa_base and not has_na_pref)
     # No cross-window coupling at all: place a whole lap of pods per
     # iteration (the fast path for fit-only and hostname-anti-affinity pods).
     static_scores = incremental_feas and scores_carried
@@ -271,18 +273,25 @@ def schedule_batch(
     else:
         aff_has_keys = jnp.ones(NP, bool)
 
-    static_ok = (state.valid & name_ok & unsched_ok & taint_ok & sel_ok & exist_anti_ok)
+    static_ok = (state.valid & name_ok & unsched_ok & taint_ok & sel_ok
+                 & exist_anti_ok & f.extra_ok)
 
-    w_tt, w_fit, w_pts, w_ipa, w_ba = (f.weights[i] for i in range(5))
+    w_tt, w_fit, w_pts, w_ipa, w_ba, w_na, w_il = (f.weights[i] for i in range(7))
+    # ImageLocality has no normalization: a static additive score term that
+    # rides every path (including carried totals — landings can't change it).
+    il_term = w_il * f.il_score
 
     n_act = jnp.int32(batch_pad) if n_active is None else n_active.astype(jnp.int32)
 
-    def feasibility_proj(fit_ok, dns_counts, mnum, acnt, fcnt, aff_total):
+    def feasibility_proj(fit_ok, dns_counts, mnum, acnt, fcnt, aff_total,
+                         blocked):
         """Per-node ok mask from the dynamic filters
         (findNodesThatPassFilters; PTS skew filtering.go:318-362, IPA
         required filtering.go:368-426), reading the carried per-node
         projections — no gathers on the critical path."""
         ok = static_ok & fit_ok & (idx < num)
+        if port_selfblock:
+            ok &= ~blocked
         if C1:
             # All-int32 skew math (counts are pods-per-domain, far below 2^31;
             # int64 vector ops cost ~2x in the per-op-latency regime).
@@ -303,11 +312,12 @@ def schedule_batch(
     def step(carry, t):
         (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
          dns_counts, sa_counts, anti_counts, aff_counts, ipa_delta, start,
-         okd, F, total, mnum, scnt, acnt, fcnt, dproj, aff_total) = carry
+         blocked, okd, F, total, mnum, scnt, acnt, fcnt, dproj, aff_total) = carry
         active = t < n_act
 
         if not incremental_feas:
-            okd = feasibility_proj(fit_ok, dns_counts, mnum, acnt, fcnt, aff_total)
+            okd = feasibility_proj(fit_ok, dns_counts, mnum, acnt, fcnt,
+                                   aff_total, blocked)
             F = jnp.cumsum(okd.astype(jnp.int32))          # inclusive, row order
 
         # ---- sampling truncation + rotation (schedule_one.go:779-892) -----
@@ -349,6 +359,8 @@ def schedule_batch(
                     raw_ipa = raw_ipa + dproj.sum(axis=0)
                 lanes.append(jnp.where(kept, raw_ipa, -_INF64))        # mx_ipa
                 lanes.append(jnp.where(kept, -raw_ipa, -_INF64))       # -mn_ipa
+            if has_na_pref:
+                lanes.append(jnp.where(kept, f.na_raw, 0))             # mx_na
             red = jnp.max(jnp.stack(lanes), axis=1)
             evaluated = (num - red[0]).astype(jnp.int32)
             li = 1
@@ -373,7 +385,16 @@ def schedule_batch(
                                 MAX_NODE_SCORE * (raw_ipa - mn_i) // jnp.maximum(diff, 1), 0)
             else:
                 ipa = jnp.int64(0)
-            total = w_tt * tt + w_fit * fit_sc + w_ba * ba + w_pts * pts + w_ipa * ipa
+            if has_na_pref:
+                # default_normalize_score(max=100, reverse=False): raw*100//mx
+                # over the kept set; all-zero raws stay zero.
+                mx_na = red[li]; li += 1
+                na = jnp.where(mx_na > 0,
+                               MAX_NODE_SCORE * f.na_raw // jnp.maximum(mx_na, 1), 0)
+            else:
+                na = jnp.int64(0)
+            total = (w_tt * tt + w_fit * fit_sc + w_ba * ba + w_pts * pts
+                     + w_ipa * ipa + w_na * na + il_term)
             # second reduction round: packed selection over the fresh scores
             key = total * NP + (jnp.int32(NP - 1) - rot_of_row)
             best_key = jnp.max(jnp.where(kept, key, -1))
@@ -417,23 +438,28 @@ def schedule_batch(
             upd = f.ipa_wland * (ipa_vid[:, row] > 0) * apply
             ipa_delta = ipa_delta.at[jnp.arange(KD), ipa_vid[:, row]].add(upd)
             dproj = dproj + upd[:, None] * (ipa_vid == ipa_vid[:, row][:, None])
+        if port_selfblock:
+            blocked = blocked.at[row].set(blocked[row] | any_kept)
         if incremental_feas:
             # Feasibility flips only at the landed row: patch okd and shift
             # the prefix-sum tail by the delta (replaces the full cumsum).
             new_ok_row = static_ok[row] & r_ok & (row < num)
             if A1:
                 new_ok_row &= ~((anti_vid[:, row] > 0) & (acnt[:, row] > 0)).any()
+            if port_selfblock:
+                new_ok_row &= ~blocked[row]
             delta = new_ok_row.astype(jnp.int32) - okd[row].astype(jnp.int32)
             okd = okd.at[row].set(new_ok_row)
             F = F + jnp.where(idx >= row, delta, 0)
         if scores_carried:
             total = total.at[row].set(
-                w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * r_fit + w_ba * r_ba)
+                w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * r_fit + w_ba * r_ba
+                + il_term[row])
         start = jnp.where(active, (start + evaluated) % num, start).astype(jnp.int32)
 
         new_carry = (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
                      dns_counts, sa_counts, anti_counts, aff_counts,
-                     ipa_delta, start, okd, F, total,
+                     ipa_delta, start, blocked, okd, F, total,
                      mnum, scnt, acnt, fcnt, dproj, aff_total)
         return new_carry, (chosen, start)
 
@@ -445,13 +471,15 @@ def schedule_batch(
         ext0 = ScanCarry(state.req_r, state.nonzero, state.pod_count,
                          fit_ok0, fit_sc0, ba0,
                          f.dns_counts, f.sa_counts, f.anti_counts,
-                         f.aff_counts, ipa_delta0, f.start_index)
+                         f.aff_counts, ipa_delta0, f.start_index,
+                         jnp.zeros(NP, bool))
     else:
         ext0 = carry_in
     if static_scores:
         return _lap_schedule(state, f, batch_pad, fit_strategy,
                              ext0, static_ok, n_act, idx, num,
-                             w_tt, w_fit, w_ba, anti_vid)
+                             w_tt, w_fit, w_ba, il_term, anti_vid,
+                             port_selfblock)
     # Per-node projections of the count tables (one gather per table per
     # CALL, kept elementwise-fresh by the scan) + okd/F seeds.
     i64v = jnp.int64
@@ -470,11 +498,11 @@ def schedule_batch(
         dproj0 = jnp.zeros((0, NP), jnp.int64)
     aff_total0 = (ext0.aff_counts * (f.aff_active[:, None] == 1)).sum()
     okd0 = feasibility_proj(ext0.fit_ok, ext0.dns_counts, mnum0, acnt0,
-                            fcnt0, aff_total0)
+                            fcnt0, aff_total0, ext0.blocked)
     F0 = jnp.cumsum(okd0.astype(jnp.int32))
     if scores_carried:
         total0 = (w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * ext0.fit_sc
-                  + w_ba * ext0.ba)
+                  + w_ba * ext0.ba + il_term)
     else:
         total0 = jnp.zeros(NP, jnp.int64)
     carry0 = tuple(ext0) + (okd0, F0, total0,
@@ -487,7 +515,7 @@ def schedule_batch(
     # chain the next batch (carry_in) and keep the mirror resident
     # (NodeStateMirror.adopt) instead of re-uploading — the device-side
     # analogue of the incremental snapshot.
-    return jnp.stack([chosen, starts]), ScanCarry(*final[:12])
+    return jnp.stack([chosen, starts]), ScanCarry(*final[:13])
 
 
 # Max pods placed per lap iteration (bounds the segment tensors; L_full =
@@ -498,7 +526,8 @@ LAP_MAX = 32
 
 
 def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
-                  static_ok, n_act, idx, num, w_tt, w_fit, w_ba, anti_vid):
+                  static_ok, n_act, idx, num, w_tt, w_fit, w_ba, il_term,
+                  anti_vid, port_selfblock):
     """Lap-vectorized greedy assignment for the static-score case.
 
     Key fact: with adaptive sampling live (schedule_one.go:866-892), pod i
@@ -530,18 +559,21 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
         return c[0] < n_act
 
     def body(c):
-        (done, req_r, nonzero, pod_count, anti_counts, start, out) = c
+        (done, req_r, nonzero, pod_count, anti_counts, blocked, start, out) = c
         # Dense per-lap recompute (no scatters/gathers — TPU scatters
         # serialize per index, so one-hot masked vector ops win):
         fit_ok, fit_sc, ba = _resource_eval(
             f, fit_strategy, state.alloc_r, state.alloc_pods,
             req_r, nonzero, pod_count)
         okd = static_ok & fit_ok & (idx < num)
+        if port_selfblock:
+            okd &= ~blocked
         if A1:
             acnt = jnp.take_along_axis(anti_counts, anti_vid.astype(jnp.int64), axis=1)
             okd &= ~((anti_vid > 0) & (acnt > 0)).any(axis=0)
         F = jnp.cumsum(okd.astype(jnp.int32))
-        total = w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * fit_sc + w_ba * ba
+        total = (w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * fit_sc
+                 + w_ba * ba + il_term)
         total_feas = F[-1]
         f_start = jnp.where(start > 0, F[jnp.maximum(start - 1, 0)], 0)
         rank = jnp.where(idx >= start, F - f_start, F + total_feas - f_start)
@@ -576,6 +608,8 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
         req_r = req_r + f.request[None, :] * c64[:, None]
         nonzero = nonzero + f.nz_request[None, :] * c64[:, None]
         pod_count = pod_count + cnt.astype(jnp.int32)
+        if port_selfblock:
+            blocked |= cnt
         if A1:
             # hostname-anti landings: +self at each landed row's own value
             # (duplicate vids cannot occur — the axis is singleton-per-node).
@@ -589,17 +623,18 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
         block = jnp.stack([chosen_w, start_w.astype(jnp.int32)])  # [2, LAP_MAX]
         out = lax.dynamic_update_slice(out, block, (jnp.int32(0), done))
         start = start_w[jnp.maximum(L - 1, 0)]
-        return (done + L, req_r, nonzero, pod_count, anti_counts, start, out)
+        return (done + L, req_r, nonzero, pod_count, anti_counts, blocked,
+                start, out)
 
     out0 = jnp.full((2, B + LAP_MAX), -1, jnp.int32)
     c0 = (jnp.int32(0), ext0.req_r, ext0.nonzero, ext0.pod_count,
-          ext0.anti_counts, ext0.start, out0)
-    done, req_r, nonzero, pod_count, anti_counts, start, out = lax.while_loop(
-        cond, body, c0)
+          ext0.anti_counts, ext0.blocked, ext0.start, out0)
+    (done, req_r, nonzero, pod_count, anti_counts, blocked, start,
+     out) = lax.while_loop(cond, body, c0)
     fit_ok, fit_sc, ba = _resource_eval(
         f, fit_strategy, state.alloc_r, state.alloc_pods,
         req_r, nonzero, pod_count)
     carry = ScanCarry(req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
                       ext0.dns_counts, ext0.sa_counts, anti_counts,
-                      ext0.aff_counts, ext0.ipa_delta, start)
+                      ext0.aff_counts, ext0.ipa_delta, start, blocked)
     return out[:, :B], carry

@@ -52,15 +52,16 @@ _STATE_SPECS = DeviceNodeState(
     nonzero=P("nodes", None), pod_count=P("nodes"),
     taint_key=P("nodes", None), taint_val=P("nodes", None), taint_eff=P("nodes", None),
     unsched=P("nodes"), valid=P("nodes"), name_id=P("nodes"),
-    pairs=P("nodes", None), topo=P(None, "nodes"),
+    topo=P(None, "nodes"),
 )
 
 
 def _feature_specs() -> BatchFeatures:
     """Per-node feature arrays shard over "nodes"; the rest replicate."""
     specs = {name: P() for name in BatchFeatures._fields}
-    specs["exist_anti"] = P("nodes")
-    specs["ipa_base"] = P("nodes")
+    for per_node in ("exist_anti", "ipa_base", "sel_match", "extra_ok",
+                     "il_score", "na_raw"):
+        specs[per_node] = P("nodes")
     return BatchFeatures(**specs)
 
 

@@ -71,6 +71,19 @@ from ..api.types import Taint as _Taint  # noqa: E402
 _UNSCHED_TAINT = _Taint(key=NodeUnschedulable.TAINT_KEY, effect=NO_SCHEDULE)
 
 
+def host_ports_conflict(ports, used_ports) -> bool:
+    """nodeports.go Fits → fitsPorts, incl. the 0.0.0.0 wildcard semantics.
+    The single source of truth for host AND device paths (the device path
+    evaluates this host-side into a static per-node mask — ops/features.py)."""
+    for p in ports:
+        for (proto, ip, port) in used_ports:
+            if port != p.host_port or proto != p.protocol:
+                continue
+            if ip in ("", "0.0.0.0") or p.host_ip in ("", "0.0.0.0") or ip == p.host_ip:
+                return True
+    return False
+
+
 class NodePorts:
     """plugins/nodeports: reject nodes with conflicting host ports."""
 
@@ -88,14 +101,8 @@ class NodePorts:
         ports = state.read(self._KEY)
         if ports is None:
             ports = pod.host_ports()
-        for p in ports:
-            # conflict semantics incl. 0.0.0.0 wildcard
-            # (reference nodeports.go Fits → fitsPorts).
-            for (proto, ip, port) in node_info.used_ports:
-                if port != p.host_port or proto != p.protocol:
-                    continue
-                if ip in ("", "0.0.0.0") or p.host_ip in ("", "0.0.0.0") or ip == p.host_ip:
-                    return Status.unschedulable("node(s) didn't have free ports for the requested pod ports")
+        if host_ports_conflict(ports, node_info.used_ports):
+            return Status.unschedulable("node(s) didn't have free ports for the requested pod ports")
         return OK
 
     def sign(self, pod: Pod):
@@ -176,13 +183,11 @@ class ImageLocality:
     def __init__(self, handle=None):
         self.handle = handle
 
-    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
-        total_nodes = 1
-        image_nodes = None
-        if self.handle is not None and getattr(self.handle, "snapshot", None) is not None:
-            snap = self.handle.snapshot() if callable(self.handle.snapshot) else self.handle.snapshot
-            total_nodes = max(1, len(snap.node_info_list))
-            image_nodes = getattr(snap, "image_num_nodes", None)
+    @classmethod
+    def scaled_score(cls, pod: Pod, node_info: NodeInfo, image_nodes, total_nodes: int) -> int:
+        """Pure scoring math (imagelocality.go scaledImageScore + thresholds):
+        the single source of truth for host AND device paths — the device path
+        precomputes this per node into a static score vector (ops/features.py)."""
         sum_scores = 0
         for c in pod.containers:
             size = node_info.image_states.get(c.image)
@@ -192,12 +197,21 @@ class ImageLocality:
             if image_nodes is not None:
                 spread = image_nodes.get(c.image, 1) / total_nodes
             sum_scores += int(size * spread)
-        max_threshold = self.MAX_CONTAINER_THRESHOLD * max(1, len(pod.containers))
-        if sum_scores < self.MIN_THRESHOLD:
-            return 0, OK
+        max_threshold = cls.MAX_CONTAINER_THRESHOLD * max(1, len(pod.containers))
+        if sum_scores < cls.MIN_THRESHOLD:
+            return 0
         if sum_scores > max_threshold:
-            return MAX_NODE_SCORE, OK
-        return int(MAX_NODE_SCORE * (sum_scores - self.MIN_THRESHOLD) / (max_threshold - self.MIN_THRESHOLD)), OK
+            return MAX_NODE_SCORE
+        return int(MAX_NODE_SCORE * (sum_scores - cls.MIN_THRESHOLD) / (max_threshold - cls.MIN_THRESHOLD))
+
+    def score(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        total_nodes = 1
+        image_nodes = None
+        if self.handle is not None and getattr(self.handle, "snapshot", None) is not None:
+            snap = self.handle.snapshot() if callable(self.handle.snapshot) else self.handle.snapshot
+            total_nodes = max(1, len(snap.node_info_list))
+            image_nodes = getattr(snap, "image_num_nodes", None)
+        return self.scaled_score(pod, node_info, image_nodes, total_nodes), OK
 
     def sign(self, pod: Pod):
         return tuple(sorted(c.image for c in pod.containers))

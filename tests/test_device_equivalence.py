@@ -267,3 +267,126 @@ class TestSessionEquivalence:
                     make_pod().name(f"wave2-{i}").req({"cpu": "500m", "memory": "256Mi"}).obj())
             s.run_until_idle()
         assert _assignments(host) == _assignments(dev)
+
+
+class TestWidenedCoverageEquivalence:
+    """Round-3 kernel coverage: node-affinity expressions, preferred node
+    affinity, host ports, image locality, NodeDeclaredFeatures — previously
+    host-path fallbacks, now device-evaluated via host-built static vectors
+    (ops/features.py sel_match / na_raw / extra_ok / il_score). Reference:
+    nodeaffinity/node_affinity.go, nodeports/, imagelocality/."""
+
+    def test_node_affinity_expressions(self):
+        host, dev = _run_pair(24, _basic_pods(
+            18, build=lambda b: b.node_affinity_in("disk", ["ssd"])))
+        assert dev.host_path_pods == 0
+
+    def test_node_affinity_hostname_label(self):
+        # Required affinity over the hostname LABEL (matchExpressions):
+        # static per batch, rides the device via sel_match.
+        def fn():
+            pods = []
+            for i in range(8):
+                b = make_pod().name(f"ds-{i}").req({"cpu": "100m"})
+                b = b.node_affinity_in("kubernetes.io/hostname", [f"node-{i % 4}"])
+                pods.append(b.obj())
+            return pods
+        host, dev = _run_pair(12, fn)
+        assert dev.host_path_pods == 0
+
+    def test_node_affinity_match_fields_narrowing(self):
+        # Daemonset shape: matchFields metadata.name pin (daemonset-pod.yaml)
+        # triggers the NodeAffinity PreFilterResult narrowing, which changes
+        # the rotation/sampling universe — these pods MUST take the host path
+        # (batch_supported), and assignments must still match the oracle.
+        from kubernetes_tpu.api.labels import IN, Requirement
+        from kubernetes_tpu.api.types import Affinity, NodeAffinity as NA, NodeSelector, NodeSelectorTerm
+
+        def fn():
+            pods = []
+            for i in range(10):
+                p = make_pod().name(f"ds-{i}").req({"cpu": "100m"}).obj()
+                term = NodeSelectorTerm(match_fields=(
+                    Requirement("metadata.name", IN, (f"node-{i % 4}",)),))
+                p.affinity = Affinity(node_affinity=NA(required=NodeSelector((term,))))
+                pods.append(p)
+            return pods
+        host, dev = _run_pair(12, fn)
+        assert dev.host_path_pods == 10  # PreFilterResult narrowing: host path
+
+    def test_preferred_node_affinity_scoring(self):
+        host, dev = _run_pair(20, _basic_pods(
+            16, build=lambda b: b.preferred_node_affinity(7, "disk", ["hdd"])))
+        assert dev.host_path_pods == 0
+
+    def test_host_ports_self_blocking(self):
+        # Identical pods with a host port: at most one per node; both paths
+        # must fail the overflow pods identically.
+        host, dev = _run_pair(6, _basic_pods(
+            9, cpu="100m", build=lambda b: b.host_port(8080)))
+        # The 6 placements ride the device; the 3 infeasible overflow pods
+        # intentionally re-run host-side for the exact FitError diagnosis.
+        assert dev.device_scheduled == 6
+        assert host.scheduled == dev.scheduled == 6
+        assert host.failures > 0
+
+    def test_image_locality_scoring(self):
+        def cluster(sched):
+            for i in range(15):
+                b = (make_node().name(f"node-{i}")
+                     .capacity({"cpu": 8, "memory": "32Gi", "pods": 110}))
+                if i % 3 == 0:
+                    b = b.image("registry/app:v1", 400 * 1024 * 1024)
+                sched.clientset.create_node(b.obj())
+        host = Scheduler(deterministic_ties=True)
+        dev = TPUScheduler()
+        cluster(host)
+        cluster(dev)
+        def pods():
+            return [make_pod().name(f"p-{i}").req({"cpu": "100m"})
+                    .image("registry/app:v1").obj() for i in range(10)]
+        for p in pods():
+            host.clientset.create_pod(p)
+        for p in pods():
+            dev.clientset.create_pod(p)
+        host.run_until_idle()
+        dev.run_until_idle()
+        assert _assignments(host) == _assignments(dev)
+        assert dev.host_path_pods == 0
+
+    def test_node_declared_features(self):
+        # NDF is feature-gated off by default (reference kube_features.go):
+        # build a profile that enables the plugin on both paths.
+        from kubernetes_tpu.core.registry import DEFAULT_PLUGINS, build_framework
+        plugins = DEFAULT_PLUGINS + (("NodeDeclaredFeatures", 0),)
+        factory = lambda h: {"default-scheduler": build_framework(h, plugins=plugins)}  # noqa: E731
+
+        def cluster(sched):
+            for i in range(12):
+                b = (make_node().name(f"node-{i}")
+                     .capacity({"cpu": 8, "memory": "32Gi", "pods": 110}))
+                n = b.obj()
+                if i % 2 == 0:
+                    n.declared_features = {"feat.a": True, "feat.b": True}
+                sched.clientset.create_node(n)
+        host = Scheduler(deterministic_ties=True, profile_factory=factory)
+        dev = TPUScheduler(profile_factory=factory)
+        cluster(host)
+        cluster(dev)
+        def pods():
+            out = []
+            for i in range(8):
+                p = make_pod().name(f"p-{i}").req({"cpu": "100m"}).obj()
+                p.annotations["features.k8s.io/required"] = "feat.a,feat.b"
+                out.append(p)
+            return out
+        for p in pods():
+            host.clientset.create_pod(p)
+        for p in pods():
+            dev.clientset.create_pod(p)
+        host.run_until_idle()
+        dev.run_until_idle()
+        assert _assignments(host) == _assignments(dev)
+        assert dev.host_path_pods == 0
+        bound = {n for n in _assignments(dev).values() if n}
+        assert all(int(n.split("-")[1]) % 2 == 0 for n in bound)
