@@ -275,6 +275,11 @@ class Pod:
     creation_ts: float = 0.0
     resource_version: int = 0
     deletion_ts: Optional[float] = None
+    # metadata.finalizers: a delete with finalizers present only sets
+    # deletion_ts; the object persists until the finalizers are removed
+    # (apimachinery graceful-deletion semantics; exercised by the
+    # SchedulingDeletedPodsWithFinalizers perf workload).
+    finalizers: List[str] = field(default_factory=list)
 
     def __post_init__(self):
         if not self.uid:

@@ -176,8 +176,34 @@ class FakeClientset:
         return pod
 
     def delete_pod(self, pod: Pod) -> None:
-        p = self.pods.pop(pod.uid, None)
-        if p is not None:
+        p = self.pods.get(pod.uid)
+        if p is None:
+            return
+        if p.finalizers:
+            # Graceful deletion: finalizers park the object with a
+            # deletionTimestamp; watchers see an update, not a delete, and
+            # repeated deletes cannot complete it — only finalizer removal
+            # can (pkg/registry/core/pod strategy + apimachinery finalizers).
+            if p.deletion_ts is None:
+                import time as _t
+                p.deletion_ts = _t.time()
+                self._rv += 1
+                p.resource_version = self._rv
+                for h in self._pod_handlers:
+                    h("update", p, p)
+            return
+        self.pods.pop(pod.uid, None)
+        for h in self._pod_handlers:
+            h("delete", p, p)
+
+    def remove_pod_finalizers(self, pod: Pod) -> None:
+        """Clear finalizers; if a delete is pending, it completes now."""
+        p = self.pods.get(pod.uid)
+        if p is None:
+            return
+        p.finalizers = []
+        if p.deletion_ts is not None:
+            self.pods.pop(p.uid, None)
             for h in self._pod_handlers:
                 h("delete", p, p)
 

@@ -222,6 +222,37 @@ class Nominator:
         return bool(self._pod_to_node)
 
 
+class _UnschedulableMap(dict):
+    """unschedulableEntities map with a non-gated uid index, so cluster-event
+    requeues (move_all_to_active_or_backoff) never iterate gated pods. The
+    index is keyed on insert-time `gated` — every flow that ungates a pod
+    pops it from the map first (queue.update / activate), so the value can't
+    go stale while stored."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.non_gated = set(u for u, q in self.items() if not q.gated)
+
+    def __setitem__(self, uid, qpi):
+        super().__setitem__(uid, qpi)
+        if qpi.gated:
+            self.non_gated.discard(uid)
+        else:
+            self.non_gated.add(uid)
+
+    def __delitem__(self, uid):
+        super().__delitem__(uid)
+        self.non_gated.discard(uid)
+
+    def pop(self, uid, *default):
+        self.non_gated.discard(uid)
+        return super().pop(uid, *default)
+
+    def clear(self):
+        super().clear()
+        self.non_gated.clear()
+
+
 class PriorityQueue:
     def __init__(
         self,
@@ -245,7 +276,7 @@ class PriorityQueue:
         sort_key = framework.queue_sort_key if framework is not None else None
         self.active_q = _Heap(less, sort_key=sort_key)
         self.backoff_q = _Heap(self._backoff_less)
-        self.unschedulable: Dict[str, QueuedPodInfo] = {}
+        self.unschedulable: "_UnschedulableMap" = _UnschedulableMap()
         self.nominator = Nominator()
         self._in_flight: Dict[str, List[str]] = {}  # uid -> events seen while in flight
         self.moved_count = 0  # schedulingCycle analogue of moveRequestCycle
@@ -494,10 +525,17 @@ class PriorityQueue:
 
     def move_all_to_active_or_backoff(self, event: str) -> None:
         """MoveAllToActiveOrBackoffQueue (scheduling_queue.go:1817), with
-        per-plugin QueueingHint filtering."""
+        per-plugin QueueingHint filtering. Gated pods are skipped via the
+        map's non-gated index — cluster events must cost O(requeue-able
+        pods), not O(gated pods) (the SchedulingWhileGated perf contract:
+        10k parked gated pods while deletes fire during the window)."""
         self.moved_count += 1
-        for uid in list(self.unschedulable.keys()):
-            qpi = self.unschedulable[uid]
+        uids = (list(self.unschedulable.keys()) if event == EVENT_FORCE_ACTIVATE
+                else list(self.unschedulable.non_gated))
+        for uid in uids:
+            qpi = self.unschedulable.get(uid)
+            if qpi is None:
+                continue
             if qpi.gated and event != EVENT_FORCE_ACTIVATE:
                 continue
             if not self._events_relevant(qpi, [event]):

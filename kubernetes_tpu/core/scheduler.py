@@ -424,6 +424,11 @@ class Scheduler:
             self.schedule_pod_group(qpi)
             return
         pod = qpi.pod
+        if pod.deletion_ts is not None:
+            # skipPodSchedule (schedule_one.go:93): the pod is being deleted;
+            # don't attempt it — the delete event will clear it from the queue.
+            self.queue.done(pod.uid)
+            return
         fw = self.framework_for_pod(pod)
         self.attempts += 1
         t0 = time.perf_counter()

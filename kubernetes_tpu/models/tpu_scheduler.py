@@ -72,10 +72,19 @@ class TPUScheduler(Scheduler):
     # -- batch accumulation ------------------------------------------------
 
     def _pop(self) -> Optional[QueuedPodInfo]:
-        if self._holdover is not None:
-            qpi, self._holdover = self._holdover, None
+        while True:
+            if self._holdover is not None:
+                qpi, self._holdover = self._holdover, None
+            else:
+                qpi = self.queue.pop()
+            if qpi is None:
+                return None
+            if (not isinstance(qpi, QueuedPodGroupInfo)
+                    and qpi.pod.deletion_ts is not None):
+                # skipPodSchedule: deleting pods never dispatch to device.
+                self.queue.done(qpi.pod.uid)
+                continue
             return qpi
-        return self.queue.pop()
 
     def _collect_batch(self) -> Tuple[Optional[Framework], List[QueuedPodInfo], Optional[str]]:
         """Pop a maximal run of consecutive identical-signature pods.

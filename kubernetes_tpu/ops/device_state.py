@@ -111,6 +111,15 @@ class TopoAxis:
         return self.values.lookup(val if val != "" else self._EMPTY_TOKEN)
 
 
+@jax.jit
+def _scatter_rows(state: DeviceNodeState, idx, rows: DeviceNodeState) -> DeviceNodeState:
+    """Dirty-row scatter as ONE compiled executable (13 per-array scatters
+    fused; a separate jit per array would compile 13 executables per tier)."""
+    updated = [arr.at[idx].set(r) for arr, r in zip(state[:-1], rows[:-1])]
+    topo = state.topo.at[:, idx].set(rows.topo)
+    return DeviceNodeState(*updated, topo)
+
+
 class NodeStateMirror:
     """Host-side staging + device flush for DeviceNodeState."""
 
@@ -309,14 +318,16 @@ class NodeStateMirror:
                 )
             else:
                 dirty = sorted(self._dirty)
+                # Pad to a pow2 tier by repeating the last index (scatter-set
+                # with duplicate indices writes the same value), so the jitted
+                # scatter compiles once per tier, not once per dirty-count.
+                tier = _pow2(len(dirty), 1)
+                dirty = dirty + [dirty[-1]] * (tier - len(dirty))
                 idx = jnp.asarray(dirty, jnp.int32)
-                d = self._device
-                updated = [
-                    arr.at[idx].set(jnp.asarray(a[dirty]))
-                    for arr, a in zip(d[:-1], self._arrays())
-                ]
-                topo = d.topo.at[:, idx].set(jnp.asarray(self.h_topo[:, dirty]))
-                self._device = DeviceNodeState(*updated, topo)
+                rows = DeviceNodeState(
+                    *[jnp.asarray(a[dirty]) for a in self._arrays()],
+                    jnp.asarray(self.h_topo[:, dirty]))
+                self._device = _scatter_rows(self._device, idx, rows)
         self._dirty.clear()
         self._full_flush = False
         return self._device

@@ -35,7 +35,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import yaml
 
-from ..api.types import PodGroup
+from ..api.types import Namespace, PodGroup, Volume
 from ..core.scheduler import Scheduler
 from ..testing.wrappers import make_node, make_pod
 
@@ -158,23 +158,33 @@ class _ThroughputCollector:
 
 def _make_node_from_template(i: int, tpl: Dict[str, Any]):
     zones = int(tpl.get("zones", 0))
-    b = make_node().name(f"node-{i}").capacity({
+    cap = {
         "cpu": tpl.get("cpu", 32),
         "memory": tpl.get("memory", "256Gi"),
         "pods": tpl.get("pods", 110),
-    })
+    }
+    # extended/scalar resources (node-with-extended-resource.yaml shape)
+    cap.update(tpl.get("extended", {}))
+    b = make_node().name(tpl.get("name", f"node-{i}")).capacity(cap)
     if zones:
         b = b.zone(f"zone-{i % zones}")
     for k, v in tpl.get("labels", {}).items():
         b = b.label(k, v)
     for t in tpl.get("taints", ()):
         b = b.taint(t["key"], t.get("value", ""), t.get("effect", "NoSchedule"))
-    return b.obj()
+    for img in tpl.get("images", ()):
+        b = b.image(img["name"], int(img.get("sizeBytes", 0)))
+    node = b.obj()
+    nf = int(tpl.get("declaredFeatures", 0))
+    if nf:
+        node.declared_features = {f"feature-{j}": True for j in range(nf)}
+    return node
 
 
-def _make_pod_from_template(name: str, tpl: Dict[str, Any]):
-    b = make_pod().name(name).req({
-        "cpu": tpl.get("cpu", "100m"), "memory": tpl.get("memory", "128Mi")})
+def _make_pod_from_template(name: str, tpl: Dict[str, Any], namespace: str = "default"):
+    req = {"cpu": tpl.get("cpu", "100m"), "memory": tpl.get("memory", "128Mi")}
+    req.update(tpl.get("extended", {}))  # extended-resource requests
+    b = make_pod().name(name).namespace(namespace).req(req)
     for k, v in tpl.get("labels", {}).items():
         b = b.label(k, v)
     if tpl.get("nodeSelector"):
@@ -187,33 +197,125 @@ def _make_pod_from_template(name: str, tpl: Dict[str, Any]):
             c.get("maxSkew", 1),
             c.get("topologyKey", ZONE),
             c.get("whenUnsatisfiable", "DoNotSchedule"),
-            c.get("labelSelector", tpl.get("labels", {})))
-    aff = tpl.get("podAntiAffinity")
-    if aff:
-        b = b.pod_affinity(aff.get("topologyKey", HOSTNAME),
-                           aff.get("matchLabels", tpl.get("labels", {})),
-                           anti=True, weight=aff.get("weight", 0))
-    aff = tpl.get("podAffinity")
-    if aff:
-        b = b.pod_affinity(aff.get("topologyKey", ZONE),
-                           aff.get("matchLabels", tpl.get("labels", {})),
-                           weight=aff.get("weight", 0))
+            c.get("labelSelector", tpl.get("labels", {})),
+            node_taints_policy=c.get("nodeTaintsPolicy", "Ignore"))
+    for kind, anti in (("podAntiAffinity", True), ("podAffinity", False)):
+        aff = tpl.get(kind)
+        if aff:
+            b = b.pod_affinity(
+                aff.get("topologyKey", HOSTNAME if anti else ZONE),
+                aff.get("matchLabels", tpl.get("labels", {})),
+                anti=anti, weight=aff.get("weight", 0),
+                ns_labels=aff.get("namespaceSelector"))
+    na = tpl.get("nodeAffinityIn")
+    if na:
+        b = b.node_affinity_in(na["key"], list(na["values"]))
+    pna = tpl.get("preferredNodeAffinity")
+    if pna:
+        b = b.preferred_node_affinity(
+            int(pna.get("weight", 1)), pna["key"], list(pna["values"]))
+    if tpl.get("nodeAffinityName"):
+        # daemonset-pod.yaml shape: matchFields metadata.name In [node]
+        b = b.node_affinity_name(tpl["nodeAffinityName"])
+    if tpl.get("hostPort"):
+        b = b.host_port(int(tpl["hostPort"]))
+    for g in tpl.get("schedulingGates", ()):
+        b = b.scheduling_gate(g)
+    if tpl.get("image"):
+        b = b.image(tpl["image"])
     if tpl.get("priority"):
         b = b.priority(int(tpl["priority"]))
     pod = b.obj()
+    if tpl.get("requiredFeatures"):
+        nf = int(tpl["requiredFeatures"])
+        pod.annotations["features.k8s.io/required"] = ",".join(
+            f"feature-{j}" for j in range(nf))
+    if tpl.get("finalizers"):
+        pod.finalizers = list(tpl["finalizers"])
+    for j in range(int(tpl.get("secretVolumes", 0))):
+        pod.volumes.append(Volume(name=f"secret-{j}"))
+    if tpl.get("pvc"):
+        pod.volumes.append(Volume(name="data", pvc_name=tpl["pvc"].format(name=name)))
     if tpl.get("podGroup"):
         pod.pod_group = tpl["podGroup"]
     return pod
 
 
-def _drain(sched: Scheduler, collector: _ThroughputCollector, max_cycles: int = 10_000_000) -> None:
-    """barrier opcode: drive scheduling until the queue stops yielding."""
+class _RateDeleter:
+    """deletePods opcode with skipWaitToCompletion: deletes pods at a fixed
+    rate CONCURRENTLY with the measured scheduling window (the reference
+    runs this in a goroutine — scheduler_perf.go deletePodsOp)."""
+
+    def __init__(self, cs, pods: List, per_second: float, now=time.perf_counter):
+        self.cs = cs
+        self.pods = list(pods)
+        self.per_second = max(per_second, 1e-9)
+        self.now = now
+        self._t0 = now()
+        self._done = 0
+
+    def tick(self) -> bool:
+        due = int((self.now() - self._t0) * self.per_second)
+        while self._done < min(due, len(self.pods)):
+            self.cs.delete_pod(self.pods[self._done])
+            self._done += 1
+        return self._done < len(self.pods)
+
+
+class _Churner:
+    """churn opcode (scheduler_perf.go:72): every interval, create/delete (or
+    recreate) objects WHILE the measured window runs — exercising mid-session
+    invalidations, queue moves, and device-mirror refreshes for real."""
+
+    def __init__(self, cs, pod_tpl: Dict[str, Any], number: int,
+                 interval_ms: float, mode: str = "recreate",
+                 churn_nodes: bool = False, now=time.perf_counter):
+        self.cs = cs
+        self.pod_tpl = pod_tpl
+        self.number = number
+        self.interval = max(interval_ms, 1.0) / 1000.0
+        self.mode = mode
+        self.churn_nodes = churn_nodes
+        self.now = now
+        self._next = now()
+        self._seq = 0
+        self._live_pods: List = []
+        self._live_nodes: List = []
+
+    def tick(self) -> bool:
+        while self.now() >= self._next:
+            self._next += self.interval
+            self._seq += 1
+            p = _make_pod_from_template(f"churn-pod-{self._seq}", self.pod_tpl)
+            self.cs.create_pod(p)
+            self._live_pods.append(p)
+            if self.churn_nodes:
+                n = _make_node_from_template(0, {"name": f"churn-node-{self._seq}"})
+                self.cs.create_node(n)
+                self._live_nodes.append(n)
+            if self.mode == "recreate" and len(self._live_pods) > self.number:
+                self.cs.delete_pod(self._live_pods.pop(0))
+                if len(self._live_nodes) > self.number:
+                    self.cs.delete_node(self._live_nodes.pop(0).name)
+        return True  # churns for the whole workload
+
+
+def _drain(sched: Scheduler, collector: _ThroughputCollector,
+           tickers: Optional[List] = None, max_cycles: int = 10_000_000) -> None:
+    """barrier opcode: drive scheduling until the queue stops yielding.
+    Active tickers (churners, rate deleters) run interleaved with the
+    scheduling loop — i.e. concurrently with the measured window."""
     n = 0
+    tickers = tickers if tickers is not None else []
     while n < max_cycles:
+        for t in list(tickers):
+            if not t.tick():
+                tickers.remove(t)
         progressed = sched.schedule_one()
         collector.tick()
         if not progressed:
             sched.queue.flush_backoff_completed()
+            sched.flush_expired_waiters()
             if not sched.schedule_one():
                 break
         n += 1
@@ -229,19 +331,62 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
     collector = _ThroughputCollector(sched)
     params = wl.params
     pod_seq = 0
+    node_seq = 0
     result = PerfResult(workload=wl)
+    tickers: List = []
+    created_pods: Dict[str, List] = {}  # namespace -> pods (deletePods targets)
     t0 = time.perf_counter()
+
+    def _create_pods(op, tpl, namespace, count):
+        nonlocal pod_seq
+        batch = []
+        for _ in range(count):
+            p = _make_pod_from_template(f"pod-{pod_seq}", tpl, namespace=namespace)
+            pod_seq += 1
+            cs.create_pod(p)
+            batch.append(p)
+        created_pods.setdefault(namespace, []).extend(batch)
+        return batch
 
     for op in wl.ops:
         opcode = op["opcode"]
         if opcode == "createNodes":
             count = _resolve_count(op, params)
             tpl = op.get("nodeTemplate", {})
+            if tpl.get("name"):
+                # Named template (node-with-name.yaml): names must be unique,
+                # so multi-count named ops get an index suffix.
+                for i in range(count):
+                    t = dict(tpl, name=tpl["name"] if count == 1 else f"{tpl['name']}-{i}")
+                    cs.create_node(_make_node_from_template(i, t))
+            else:
+                # Continue the node name sequence across ops: a second
+                # unnamed createNodes in the same workload must not overwrite
+                # the first op's node-<i> names.
+                for i in range(count):
+                    cs.create_node(_make_node_from_template(node_seq + i, tpl))
+                node_seq += count
+        elif opcode == "createNamespaces":
+            count = _resolve_count(op, params) if ("count" in op or "countParam" in op) else 1
+            prefix = op.get("prefix", "ns")
+            labels = dict(op.get("labels", {}))
             for i in range(count):
-                cs.create_node(_make_node_from_template(i, tpl))
+                cs.create_namespace(Namespace(name=f"{prefix}-{i}", labels=labels))
+        elif opcode == "createPodSets":
+            # one createPods op per namespace prefix-i (affinity NS-selector
+            # configs; scheduler_perf.go createPodSetsOp)
+            count = _resolve_count(op, params)
+            prefix = op.get("namespacePrefix", "ns")
+            inner = op["createPodsOp"]
+            tpl = inner.get("podTemplate") or wl.default_pod_template or {}
+            per_ns = _resolve_count(inner, params)
+            for i in range(count):
+                _create_pods(inner, tpl, f"{prefix}-{i}", per_ns)
+            _drain(sched, collector, tickers)
         elif opcode == "createPods":
             count = _resolve_count(op, params)
             tpl = op.get("podTemplate") or wl.default_pod_template or {}
+            namespace = op.get("namespace", "default")
             collect = bool(op.get("collectMetrics"))
             if collect:
                 # Compile the kernel shapes outside the measured window
@@ -249,14 +394,24 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
                 # scheduler process; XLA compilation is our cold-start).
                 warm = getattr(sched, "warm_for", None)
                 if warm is not None:
-                    warm(_make_pod_from_template("warm-template", tpl))
+                    warm(_make_pod_from_template("warm-template", tpl,
+                                                 namespace=namespace))
                 collector.start()
-            for i in range(count):
-                cs.create_pod(_make_pod_from_template(f"pod-{pod_seq}", tpl))
-                pod_seq += 1
-            _drain(sched, collector)
+            _create_pods(op, tpl, namespace, count)
+            if not op.get("skipWaitToCompletion"):
+                _drain(sched, collector, tickers)
             if collect:
                 result.metrics["SchedulingThroughput"] = collector.stop()
+        elif opcode == "deletePods":
+            namespace = op.get("namespace", "default")
+            targets = created_pods.get(namespace, [])
+            rate = float(op.get("deletePodsPerSecond", 100))
+            deleter = _RateDeleter(cs, targets, rate)
+            if op.get("skipWaitToCompletion"):
+                tickers.append(deleter)  # deletes overlap the measured window
+            else:
+                while deleter.tick():
+                    time.sleep(0.001)
         elif opcode == "createPodGroups":
             count = _resolve_count(op, params)
             size = int(op.get("groupSize", 2))
@@ -268,24 +423,31 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
                 for i in range(size):
                     cs.create_pod(_make_pod_from_template(f"pod-{pod_seq}", tpl_g))
                     pod_seq += 1
-            _drain(sched, collector)
+            _drain(sched, collector, tickers)
         elif opcode == "churn":
-            # simplified: n create→schedule→delete rounds (scheduler_perf.go:72)
-            rounds = int(op.get("number", 10))
-            tpl = op.get("podTemplate") or wl.default_pod_template or {}
-            for i in range(rounds):
-                p = _make_pod_from_template(f"churn-{i}", tpl)
-                cs.create_pod(p)
-                _drain(sched, collector)
-                cs.delete_pod(p)
+            # Concurrent churn (scheduler_perf.go:72): the churner ticks
+            # inside _drain, i.e. DURING the measured window.
+            tickers.append(_Churner(
+                cs,
+                op.get("podTemplate") or wl.default_pod_template or {"cpu": "4"},
+                number=int(op.get("number", 1)),
+                interval_ms=float(op.get("intervalMilliseconds", 1000)),
+                mode=op.get("mode", "recreate"),
+                churn_nodes=bool(op.get("churnNodes", True)),
+            ))
         elif opcode == "barrier":
-            _drain(sched, collector)
+            _drain(sched, collector, tickers)
         elif opcode == "sleep":
             time.sleep(float(op.get("duration", 0.1)))
         elif opcode == "startCollectingMetrics":
             collector.start()
         elif opcode == "stopCollectingMetrics":
             result.metrics["SchedulingThroughput"] = collector.stop()
+        elif opcode == "allocResourceClaims":
+            # DRA pre-allocation (dra/performance-config.yaml): allocate all
+            # pending claims against the current ResourceSlices.
+            from ..plugins.dynamicresources import allocate_pending_claims
+            allocate_pending_claims(cs)
         else:
             raise ValueError(f"unknown opcode {opcode!r}")
 

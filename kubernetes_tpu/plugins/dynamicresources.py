@@ -170,3 +170,52 @@ class DynamicResources:
             if claim.allocated and pod.uid not in claim.reserved_for:
                 claim.reserved_for.append(pod.uid)
         return OK
+
+
+def allocate_pending_claims(clientset) -> int:
+    """allocResourceClaims opcode (scheduler_perf dra configs): allocate every
+    pending claim greedily against the cluster's ResourceSlices — the harness
+    analogue of the DRA controller pre-allocating claims so measured pods only
+    validate the pinned node. Returns the number of claims allocated."""
+    used: Set[Tuple[str, str, str]] = set()
+    for claim in clientset.resource_claims.values():
+        if claim.allocated:
+            for d in claim.allocations:
+                used.add((claim.allocated_node, d.driver, d.device))
+    n_alloc = 0
+    for claim in clientset.resource_claims.values():
+        if claim.allocated:
+            continue
+        for node_name, slices in clientset.resource_slices.items():
+            taken: Set[Tuple[str, str]] = set()
+            devices: List[AllocatedDevice] = []
+            ok = True
+            for req in claim.requests:
+                sel = dict(req.selectors)
+                if req.device_class:
+                    dc = clientset.device_classes.get(req.device_class)
+                    if dc is not None:
+                        sel.update(dc.selectors)
+                found = 0
+                for sl in slices:
+                    for dev in sl.devices:
+                        if found >= req.count:
+                            break
+                        key = (sl.driver, dev.name)
+                        if key in taken or (node_name, sl.driver, dev.name) in used:
+                            continue
+                        if all(dev.attributes.get(k) == v for k, v in sel.items()):
+                            devices.append(AllocatedDevice(sl.driver, dev.name))
+                            taken.add(key)
+                            found += 1
+                if found < req.count:
+                    ok = False
+                    break
+            if ok:
+                claim.allocated_node = node_name
+                claim.allocations = devices
+                for d in devices:
+                    used.add((node_name, d.driver, d.device))
+                n_alloc += 1
+                break
+    return n_alloc

@@ -143,11 +143,29 @@ class MakePod:
         )
         return self
 
+    def node_affinity_name(self, node_name: str) -> "MakePod":
+        """Required affinity pinning metadata.name via matchFields
+        (templates/daemonset-pod.yaml shape)."""
+        term = NodeSelectorTerm(match_fields=(
+            Requirement("metadata.name", IN, (node_name,)),))
+        a = self._affinity()
+        existing = a.node_affinity.required.terms if a.node_affinity and a.node_affinity.required else ()
+        self._pod.affinity = Affinity(
+            node_affinity=NodeAffinity(required=NodeSelector(existing + (term,)),
+                                       preferred=a.node_affinity.preferred if a.node_affinity else ()),
+            pod_affinity=a.pod_affinity,
+            pod_anti_affinity=a.pod_anti_affinity,
+        )
+        return self
+
     def pod_affinity(self, topology_key: str, match_labels: Dict[str, str],
-                     anti: bool = False, weight: int = 0) -> "MakePod":
+                     anti: bool = False, weight: int = 0,
+                     ns_labels: Optional[Dict[str, str]] = None) -> "MakePod":
         term = PodAffinityTerm(
             label_selector=LabelSelector.of(match_labels=match_labels),
             topology_key=topology_key,
+            namespace_selector=(LabelSelector.of(match_labels=dict(ns_labels))
+                                if ns_labels is not None else None),
         )
         a = self._affinity()
         pa = a.pod_affinity or PodAffinity()
