@@ -413,6 +413,11 @@ class PodGroup:
     min_count: int = 0  # minimum members that must schedule together
     priority: int = 0
     labels: Dict[str, str] = field(default_factory=dict)
+    # spec.schedulingConstraints.topology[*].key — placement-based scheduling
+    # groups candidate node subsets by these topology domains (the fork's
+    # topology-aware placement; topology_placement.go:120 getTopologyKey uses
+    # only the first key today, and so do we).
+    topology_keys: tuple = ()
 
     def __post_init__(self):
         if not self.uid:

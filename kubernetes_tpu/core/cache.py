@@ -64,6 +64,28 @@ class Snapshot:
         if ni is not None:
             ni.remove_pod(pod)
 
+    # -- placement mutation session (snapshot.go:276 StartMutations / :317
+    # EndMutations / :708 AssumePlacement): restrict the visible node list to
+    # a candidate placement while simulating a pod group against it. NodeInfo
+    # objects are shared with the full list, so in-simulation assume/forget
+    # stay visible after the placement is forgotten.
+
+    def assume_placement(self, node_names) -> None:
+        assert not hasattr(self, "_placement_saved"), "placement already assumed"
+        wanted = set(node_names)
+        self._placement_saved = self.node_info_list
+        self.node_info_list = [ni for ni in self._placement_saved
+                               if ni.name in wanted]
+        self.rebuild_lists()
+
+    def forget_placement(self) -> None:
+        self.node_info_list = self._placement_saved
+        del self._placement_saved
+        self.rebuild_lists()
+
+    def placement_active(self) -> bool:
+        return hasattr(self, "_placement_saved")
+
 
 class _PodState:
     __slots__ = ("pod", "deadline", "binding_finished")

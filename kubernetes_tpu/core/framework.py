@@ -203,6 +203,37 @@ def default_normalize_score(max_priority: int, reverse: bool, scores: List[NodeS
         s.score = score
 
 
+@dataclass
+class Placement:
+    """A named candidate node subset for pod-group scheduling (the fork's
+    staging kube-scheduler framework Placement; topology_placement.go
+    produces one per topology domain)."""
+
+    name: str
+    node_names: List[str]
+
+
+@dataclass
+class PlacementProgress:
+    """Mid-simulation group progress handed to PlacementFeasible plugins
+    (framework.go:2160; GangScheduling gates on scheduled >= min_count)."""
+
+    scheduled: int = 0
+    failed: int = 0
+    total: int = 0
+
+
+@dataclass
+class PodGroupAssignments:
+    """One successful placement simulation: the proposed member→node
+    assignments plus the placement's node views — the input PlacementScore
+    plugins score (staging framework PodGroupAssignments)."""
+
+    placement: Placement
+    proposed: List[Tuple[Pod, str]] = field(default_factory=list)
+    nodes: List[Any] = field(default_factory=list)  # NodeInfo
+
+
 class Framework:
     """One profile's plugin set + dispatch (frameworkImpl equivalent).
 
@@ -235,6 +266,14 @@ class Framework:
         self.bind_plugins = self._having("bind")
         self.post_bind_plugins = self._having("post_bind")
         self.sign_plugins = self._having("sign")
+        # Pod-group / placement extension points (fork additions —
+        # runtime/framework.go:1212 RunPodGroupPostFilterPlugins, :2208
+        # RunPlacementGeneratePlugins, :2160 RunPlacementFeasiblePlugins,
+        # :1625 RunPlacementScorePlugins).
+        self.placement_generate_plugins = self._having("generate_placements")
+        self.placement_feasible_plugins = self._having("placement_feasible")
+        self.placement_score_plugins = self._having_weighted("score_placement")
+        self.pod_group_post_filter_plugins = self._having("pod_group_post_filter")
         # Optional dense batch evaluator (the TPU backend) — set by
         # kubernetes_tpu/models pipeline when the device profile is active.
         self.batch_evaluator = None
@@ -352,6 +391,71 @@ class Framework:
         return None, Status.unschedulable("no postFilter plugin made progress")
 
     # -- scoring -----------------------------------------------------------
+
+    # -- placement extension points (fork: framework.go:2208,:2160,:1625,
+    # :1212) ---------------------------------------------------------------
+
+    def run_placement_generate_plugins(
+        self, state: CycleState, group, members, parent: Placement
+    ) -> Tuple[List[Placement], Status]:
+        """RunPlacementGeneratePlugins: each plugin refines the previous
+        round's placements (the reference chains generators through the
+        parent placement; with one generator this is one pass)."""
+        placements = [parent]
+        for p in self.placement_generate_plugins:
+            nxt: List[Placement] = []
+            for parent_pl in placements:
+                out, st = p.generate_placements(state, group, members, parent_pl)
+                if not st.is_success():
+                    st.plugin = p.name
+                    return [], st
+                nxt.extend(out)
+            placements = nxt
+        return placements, OK
+
+    def run_placement_feasible_plugins(
+        self, state: CycleState, group, progress: PlacementProgress
+    ) -> Status:
+        """RunPlacementFeasiblePlugins: group-level gate on the simulation
+        outcome (GangScheduling: scheduled >= min_count)."""
+        for p in self.placement_feasible_plugins:
+            st = p.placement_feasible(state, group, progress)
+            if not st.is_success():
+                st.plugin = p.name
+                return st
+        return OK
+
+    def run_placement_score_plugins(
+        self, state: CycleState, group, assignments: List[PodGroupAssignments]
+    ) -> List[int]:
+        """RunPlacementScorePlugins: per-plugin score each candidate
+        placement's assignments, normalize, weight, sum — one total per
+        placement (deterministic ties: the caller picks the first max)."""
+        totals = [0] * len(assignments)
+        for p, weight in self.placement_score_plugins:
+            scores = []
+            for pga in assignments:
+                s, st = p.score_placement(state, group, pga)
+                if not st.is_success():
+                    raise RuntimeError(
+                        f"placement score {p.name} failed: {st.message()}")
+                scores.append(s)
+            norm = getattr(p, "normalize_placement_score", None)
+            if norm is not None:
+                scores = norm(group, scores)
+            for i, s in enumerate(scores):
+                totals[i] += weight * s
+        return totals
+
+    def run_pod_group_post_filter_plugins(self, state: CycleState, group, members, diagnosis):
+        """RunPodGroupPostFilterPlugins (framework.go:1212): give plugins a
+        chance to make room for the whole group (pod-group preemption)."""
+        for p in self.pod_group_post_filter_plugins:
+            result, st = p.pod_group_post_filter(state, group, members, diagnosis)
+            if st.is_success() or st.code not in (UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE):
+                st.plugin = p.name
+                return result, st
+        return None, Status.unschedulable("no pod-group post filter made room")
 
     def run_pre_score_plugins(self, state: CycleState, pod: Pod, nodes: Sequence[NodeInfo]) -> Status:
         skipped = set()

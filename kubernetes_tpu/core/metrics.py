@@ -188,6 +188,11 @@ class SchedulerMetrics:
         self.podgroup_schedule_attempts = r(Counter(
             "scheduler_podgroup_schedule_attempts_total",
             "Gang scheduling attempts, by result.", ("result",)))
+        self.generated_placements = r(Histogram(
+            "scheduler_podgroup_generated_placements",
+            "Candidate placements generated per pod-group cycle "
+            "(metrics.RecordGeneratedPlacements).",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128)))
         self.goroutines = r(Gauge(
             "scheduler_device_dispatches_active",
             "In-flight device dispatches (Parallelizer-goroutines analogue).",

@@ -33,6 +33,7 @@ from ..plugins.extras import (
     NodeDeclaredFeatures,
 )
 from ..plugins.dynamicresources import DynamicResources
+from ..plugins.topologyaware import PodGroupPodsCount, TopologyPlacementGenerator
 from ..plugins.preemption import DefaultPreemption
 from ..plugins.volumes import (
     NodeVolumeLimits,
@@ -62,6 +63,8 @@ IN_TREE_REGISTRY: Dict[str, Callable] = {
     "VolumeBinding": lambda h, **kw: VolumeBinding(handle=h),
     "VolumeZone": lambda h, **kw: VolumeZone(handle=h),
     "NodeDeclaredFeatures": lambda h, **kw: NodeDeclaredFeatures(),
+    "TopologyPlacementGenerator": lambda h, **kw: TopologyPlacementGenerator(handle=h),
+    "PodGroupPodsCount": lambda h, **kw: PodGroupPodsCount(handle=h),
     "DynamicResources": lambda h, **kw: DynamicResources(handle=h),
     "DeferredPodScheduling": lambda h, **kw: DeferredPodScheduling(**kw),
     "GangScheduling": lambda h, **kw: GangScheduling(handle=h, **kw),
@@ -115,6 +118,21 @@ def build_framework(
 
 def default_profiles(handle) -> Dict[str, Framework]:
     return {"default-scheduler": build_framework(handle)}
+
+
+# DEFAULT_PLUGINS + the gang/placement set (GenericWorkload-gated in the
+# reference: gangscheduling.go, topology_placement.go, podgroup_pods_count.go;
+# NodeResourcesFit already implements PlacementScore).
+GANG_PLACEMENT_PLUGINS: Tuple[Tuple[str, int], ...] = DEFAULT_PLUGINS + (
+    ("GangScheduling", 0),
+    ("TopologyPlacementGenerator", 0),
+    ("PodGroupPodsCount", 1),
+)
+
+
+def gang_placement_profiles(handle) -> Dict[str, Framework]:
+    return {"default-scheduler": build_framework(
+        handle, plugins=GANG_PLACEMENT_PLUGINS)}
 
 
 def fit_only_profiles(handle) -> Dict[str, Framework]:

@@ -504,11 +504,17 @@ class Scheduler:
     # -- gang cycle (schedule_one_podgroup.go) -----------------------------
 
     def schedule_pod_group(self, qgpi: QueuedPodGroupInfo) -> None:
-        """All-or-nothing group scheduling (scheduleOnePodGroup :81 →
-        podGroupCycle :428 → default algorithm :556): each member is placed
-        against the SNAPSHOT (assumed into the snapshot, not the cache,
-        schedule_one.go:1077-1082) with LIFO revert on any failure
-        (revertFns :50-75); success commits every member's binding cycle."""
+        """Pod-group scheduling (scheduleOnePodGroup :81 → podGroupCycle :428).
+
+        With placement plugins and a topology-constrained group, the
+        PLACEMENT algorithm runs (schedule_one_podgroup.go:971
+        podGroupSchedulingPlacementAlgorithm): generate candidate node
+        subsets, simulate the group against each under a snapshot placement
+        session, gate with PlacementFeasible, score the successful candidates
+        with PlacementScore plugins, and commit the best. Otherwise the
+        default algorithm (:556): member-wise placement against the snapshot
+        (assumed into the snapshot, not the cache, schedule_one.go:1077-1082)
+        with LIFO revert on any failure (revertFns :50-75)."""
         self.attempts += 1
         members = sorted(
             qgpi.members,
@@ -518,6 +524,15 @@ class Scheduler:
             return
         fw = self.framework_for_pod(members[0].pod)
         self.cache.update_snapshot(self.snapshot)
+
+        group = qgpi.group
+        if fw.placement_generate_plugins and getattr(group, "topology_keys", ()):
+            # A topology-constrained group is scheduled ONLY through the
+            # placement algorithm — falling back to unconstrained member-wise
+            # placement would violate the constraint (the reference returns
+            # "0/N placements are available" in that case).
+            self._schedule_group_with_placements(fw, qgpi, members)
+            return
 
         placed: List[Tuple[QueuedPodInfo, CycleState, ScheduleResult]] = []
         failure: Optional[FitError] = None
@@ -538,46 +553,162 @@ class Scheduler:
             for m, _, _ in reversed(placed):
                 self.snapshot.forget_pod(m.pod)
                 m.pod.node_name = ""
-            self.failures += 1
-            qgpi.timestamp = self.now()
-            self.queue.add_unschedulable_if_not_present(qgpi)
-            self.queue.done(qgpi.uid)
-            self.metrics.podgroup_schedule_attempts.inc("unschedulable")
+            self._fail_pod_group(fw, qgpi, members, failure.diagnosis)
             return
 
         # Commit (submitPodGroupAlgorithmResult :812): assume into the cache
-        # and run each member's binding cycle. Every attempted member leaves
-        # the group buffer — commit failures are requeued individually
-        # (handle_scheduling_failure) and must not be double-tracked.
-        committed_uids = set()
+        # and run each member's binding cycle (each member keeps ITS
+        # simulation CycleState — stateful plugins wrote PreFilter/Reserve
+        # data there). Every attempted member leaves the group buffer —
+        # commit failures are requeued individually and must not be
+        # double-tracked.
+        committed = 0
         attempted_uids = set()
         for m, state, result in placed:
             attempted_uids.add(m.pod.uid)
             self.cache.assume_pod(m.pod)
-            st = fw.run_reserve_plugins_reserve(state, m.pod, result.suggested_host)
-            if st.is_success():
-                st = fw.run_permit_plugins(state, m.pod, result.suggested_host)
-            if st.code == WAIT:
-                # WaitOnPermit (framework.go:2097): the member stays reserved
-                # and parks until a Permit plugin allows/rejects it or the
-                # wait times out — not a failure.
-                self.waiting_pods[m.pod.uid] = (
-                    fw, state, m, result, self.now() + self.permit_wait_timeout)
-                committed_uids.add(m.pod.uid)
-                continue
-            if not st.is_success():
-                fw.run_reserve_plugins_unreserve(state, m.pod, result.suggested_host)
-                self.cache.forget_pod(m.pod)
-                m.pod.node_name = ""
-                self.handle_scheduling_failure(fw, m, st, None)
-                continue
-            if self.run_binding_cycle(fw, state, m, result):
-                committed_uids.add(m.pod.uid)
+            if self._commit_group_member(fw, m, state, result):
+                committed += 1
         group_key = (qgpi.group.namespace, qgpi.group.name)
         self.queue.clear_group_members(group_key, attempted_uids)
         self.queue.done(qgpi.uid)
         self.metrics.podgroup_schedule_attempts.inc(
-            "scheduled" if committed_uids else "unschedulable")
+            "scheduled" if committed else "unschedulable")
+
+    def _schedule_group_with_placements(
+        self, fw: Framework, qgpi: QueuedPodGroupInfo,
+        members: List[QueuedPodInfo],
+    ) -> bool:
+        """podGroupSchedulingPlacementAlgorithm (schedule_one_podgroup.go:971)
+        + findBestPodGroupPlacement (:1173). Owns the whole cycle: commits
+        the best feasible placement, or parks the group unschedulable ("0/N
+        placements are available")."""
+        from .framework import Placement, PlacementProgress, PodGroupAssignments
+
+        group = qgpi.group
+        pg_state = CycleState()
+        parent = Placement("", [ni.name for ni in self.snapshot.node_info_list])
+        placements, st = fw.run_placement_generate_plugins(
+            pg_state, group, members, parent)
+        if not st.is_success() or not placements:
+            self._fail_pod_group(fw, qgpi, members, None)
+            return False
+        self.metrics.generated_placements.observe(len(placements))
+
+        start_save = self.next_start_node_index
+        candidates: List[Tuple[Placement, Dict[str, str], PodGroupAssignments]] = []
+        for placement in placements:
+            self.snapshot.assume_placement(placement.node_names)
+            self.next_start_node_index = start_save  # identical rotation per sim
+            placed: List[QueuedPodInfo] = []
+            failed = 0
+            try:
+                for m in members:
+                    try:
+                        result = self.schedule_pod(fw, CycleState(), m.pod)
+                    except FitError:
+                        failed += 1
+                        continue
+                    m.pod.node_name = result.suggested_host
+                    self.snapshot.assume_pod(m.pod)
+                    placed.append(m)
+                progress = PlacementProgress(len(placed), failed, len(members))
+                feasible = placed and fw.run_placement_feasible_plugins(
+                    pg_state, group, progress).is_success()
+                assignment = {m.pod.uid: m.pod.node_name for m in placed}
+            finally:
+                # LIFO revert: the snapshot returns to the placement view,
+                # then the full view (snapshot.go revertFns + ForgetPlacement)
+                # — even on an unexpected plugin exception, or every later
+                # cycle would see the restricted node subset.
+                for m in reversed(placed):
+                    self.snapshot.forget_pod(m.pod)
+                    m.pod.node_name = ""
+                self.snapshot.forget_placement()
+            if feasible:
+                pga = PodGroupAssignments(
+                    placement,
+                    proposed=[(m.pod, assignment[m.pod.uid]) for m in placed],
+                    nodes=[self.snapshot.get(n) for n in placement.node_names])
+                candidates.append((placement, assignment, pga))
+        self.next_start_node_index = start_save
+
+        if not candidates:
+            # "0/N placements are available" (schedule_one_podgroup.go:1038)
+            self._fail_pod_group(fw, qgpi, members, None)
+            return False
+
+        totals = fw.run_placement_score_plugins(
+            pg_state, group, [pga for _, _, pga in candidates])
+        best_i = max(range(len(totals)), key=lambda i: (totals[i], -i))
+        best_placement, assignment, _pga = candidates[best_i]
+        self.metrics.generated_placements.observe(len(placements))
+
+        # Commit the winning placement's assignments: assume into the cache
+        # and run each member's binding cycle; members the placement could
+        # not fit are requeued individually (submitPodGroupAlgorithmResult).
+        committed = 0
+        attempted_uids = set()
+        for m in members:
+            attempted_uids.add(m.pod.uid)
+            node = assignment.get(m.pod.uid)
+            if node is None:
+                self.handle_scheduling_failure(
+                    fw, m, Status.unschedulable(
+                        f"did not fit placement {best_placement.name!r}"), None)
+                continue
+            m.pod.node_name = node
+            self.cache.assume_pod(m.pod)
+            if self._commit_group_member(fw, m, CycleState(),
+                                         ScheduleResult(suggested_host=node)):
+                committed += 1
+        group_key = (group.namespace, group.name)
+        self.queue.clear_group_members(group_key, attempted_uids)
+        self.queue.done(qgpi.uid)
+        self.metrics.podgroup_schedule_attempts.inc(
+            "scheduled" if committed else "unschedulable")
+        return True
+
+    def _commit_group_member(self, fw: Framework, m: QueuedPodInfo,
+                             state: CycleState, result: ScheduleResult) -> bool:
+        """Reserve → permit → binding cycle for one group member whose pod is
+        already assumed into the cache with node_name set. Returns True when
+        the member is committed (bound or parked at Permit WAIT)."""
+        node = result.suggested_host
+        st = fw.run_reserve_plugins_reserve(state, m.pod, node)
+        if st.is_success():
+            st = fw.run_permit_plugins(state, m.pod, node)
+        if st.code == WAIT:
+            self.waiting_pods[m.pod.uid] = (
+                fw, state, m, result, self.now() + self.permit_wait_timeout)
+            return True
+        if not st.is_success():
+            fw.run_reserve_plugins_unreserve(state, m.pod, node)
+            self.cache.forget_pod(m.pod)
+            m.pod.node_name = ""
+            self.handle_scheduling_failure(fw, m, st, None)
+            return False
+        return self.run_binding_cycle(fw, state, m, result)
+
+    def _fail_pod_group(self, fw: Framework, qgpi: QueuedPodGroupInfo,
+                        members: List[QueuedPodInfo], diagnosis) -> None:
+        """Group-unschedulable tail shared by the placement and default
+        algorithms: PodGroupPostFilter hook (framework.go:1212 — a chance to
+        make room via pod-group preemption), then park the group."""
+        if fw.pod_group_post_filter_plugins:
+            _, post_st = fw.run_pod_group_post_filter_plugins(
+                CycleState(), qgpi.group, members, diagnosis)
+            if post_st.is_success():
+                qgpi.timestamp = self.now()
+                self.queue.add_unschedulable_if_not_present(qgpi)
+                self.queue.done(qgpi.uid)
+                self.metrics.podgroup_schedule_attempts.inc("post_filter")
+                return
+        self.failures += 1
+        qgpi.timestamp = self.now()
+        self.queue.add_unschedulable_if_not_present(qgpi)
+        self.queue.done(qgpi.uid)
+        self.metrics.podgroup_schedule_attempts.inc("unschedulable")
 
     # -- schedulePod (schedule_one.go:572) ---------------------------------
 

@@ -1,9 +1,14 @@
-"""CLI: run scheduler_perf workloads.
+"""Run the scheduler_perf workload table.
 
     python -m kubernetes_tpu.perf                      # all [performance]
     python -m kubernetes_tpu.perf --labels short       # CI subset
     python -m kubernetes_tpu.perf --scale 0.1          # scaled-down
-    python -m kubernetes_tpu.perf --filter SchedulingBasic
+    python -m kubernetes_tpu.perf --only SchedulingBasic --out PERF.json
+
+Each workload runs in a fresh TPUScheduler (shared process: the jit cache and
+the persistent XLA compilation cache amortize compiles across workloads).
+With --out, results stream to the file after every workload so partial runs
+are usable.
 """
 
 from __future__ import annotations
@@ -12,6 +17,8 @@ import argparse
 import json
 import os
 import sys
+import time
+import traceback
 
 from .harness import load_config, run_workload
 
@@ -19,37 +26,66 @@ DEFAULT_CONFIG = os.path.join(os.path.dirname(__file__), "configs",
                               "performance-config.yaml")
 
 
-def main() -> int:
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default=DEFAULT_CONFIG)
     ap.add_argument("--labels", default="performance",
-                    help="comma-separated label filter")
-    ap.add_argument("--filter", default="", help="testcase/workload substring")
+                    help="comma-separated label filter (empty = all)")
+    ap.add_argument("--only", "--filter", dest="only", default="",
+                    help="TESTCASE or TESTCASE/WORKLOAD substring filter")
     ap.add_argument("--scale", type=float, default=1.0)
-    args = ap.parse_args()
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
 
-    labels = set(args.labels.split(",")) if args.labels else set()
-    failed = 0
-    for wl in load_config(args.config, scale=args.scale):
-        if labels and not labels & set(wl.labels):
-            continue
-        full = f"{wl.testcase}/{wl.name}"
-        if args.filter and args.filter not in full:
-            continue
-        res = run_workload(wl)
-        ok = res.meets_thresholds()
-        failed += 0 if ok else 1
-        print(json.dumps({
-            "workload": full,
-            "ok": ok,
-            "scheduled": res.scheduled,
-            "failed_attempts": res.failed,
-            "elapsed_s": round(res.elapsed, 2),
-            "thresholds": wl.thresholds,
-            "metrics": {k: {kk: round(vv, 1) for kk, vv in v.items()}
-                        for k, v in res.metrics.items()},
-        }))
-    return 1 if failed else 0
+    wanted = [s for s in args.labels.split(",") if s]
+    wls = load_config(args.config, scale=args.scale)
+    if wanted:
+        wls = [w for w in wls if all(lb in w.labels for lb in wanted)]
+    if args.only:
+        wls = [w for w in wls if args.only in f"{w.testcase}/{w.name}"]
+
+    results = []
+    meta = {
+        "config": args.config,
+        "scale": args.scale,
+        "platform": os.environ.get("JAX_PLATFORMS", "default"),
+        "started": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    below = 0
+    for wl in wls:
+        key = f"{wl.testcase}/{wl.name}"
+        t0 = time.perf_counter()
+        entry = {"workload": key,
+                 "threshold": wl.thresholds.get("SchedulingThroughput")}
+        try:
+            res = run_workload(wl)
+            tp = res.metrics.get("SchedulingThroughput", {})
+            avg = tp.get("Average", 0.0)
+            thr = entry["threshold"] or 0
+            entry.update({
+                "pods_per_second": round(avg, 1),
+                "vs_baseline": round(avg / thr, 2) if thr else None,
+                "meets_threshold": res.meets_thresholds(),
+                "percentiles": {k: round(v, 1) for k, v in tp.items()},
+                "scheduled": res.scheduled,
+                "failed_attempts": res.failed,
+                "wall_s": round(time.perf_counter() - t0, 1),
+                "detail": res.detail,
+            })
+            below += 0 if res.meets_thresholds() else 1
+        except Exception as e:  # noqa: BLE001
+            entry.update({"error": repr(e),
+                          "trace": traceback.format_exc(limit=4),
+                          "wall_s": round(time.perf_counter() - t0, 1)})
+            below += 1
+        results.append(entry)
+        print(json.dumps(entry), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"meta": meta, "results": results}, f, indent=1)
+    ok = sum(1 for r in results if r.get("meets_threshold"))
+    print(f"# {ok}/{len(results)} workloads met their thresholds", flush=True)
+    return 1 if below else 0
 
 
 if __name__ == "__main__":

@@ -326,7 +326,15 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
     inner loop, scheduler_perf.go:282+)."""
     from ..models.tpu_scheduler import TPUScheduler
 
-    sched = sched or TPUScheduler()
+    if sched is None:
+        if any(op.get("topologyKey") for op in wl.ops
+               if op.get("opcode") == "createPodGroups"):
+            # Topology-constrained gangs need the placement plugin set
+            # (GenericWorkload-gated in the reference).
+            from ..core.registry import gang_placement_profiles
+            sched = TPUScheduler(profile_factory=gang_placement_profiles)
+        else:
+            sched = TPUScheduler()
     cs = sched.clientset
     collector = _ThroughputCollector(sched)
     params = wl.params
@@ -415,10 +423,12 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
         elif opcode == "createPodGroups":
             count = _resolve_count(op, params)
             size = int(op.get("groupSize", 2))
+            tkeys = (op["topologyKey"],) if op.get("topologyKey") else ()
             tpl = dict(op.get("podTemplate") or wl.default_pod_template or {})
             for g in range(count):
                 name = f"group-{g}"
-                cs.create_pod_group(PodGroup(name=name, min_count=size))
+                cs.create_pod_group(PodGroup(name=name, min_count=size,
+                                             topology_keys=tkeys))
                 tpl_g = dict(tpl, podGroup=name)
                 for i in range(size):
                     cs.create_pod(_make_pod_from_template(f"pod-{pod_seq}", tpl_g))

@@ -110,6 +110,17 @@ class GangScheduling:
         return Status(WAIT, (f"waiting for {group.min_count} gang members",),
                       self.name)
 
+    def placement_feasible(self, state: CycleState, group, progress) -> Status:
+        """PlacementFeasible gate (gangscheduling.go via framework.go:2160):
+        a candidate placement stands only if it schedules at least min_count
+        members of the group."""
+        need = max(1, getattr(group, "min_count", 1))
+        if progress.scheduled >= need:
+            return OK
+        return Status.unschedulable(
+            f"placement schedules {progress.scheduled}/{progress.total} "
+            f"members, need {need}")
+
     def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
         key = (pod.namespace, pod.pod_group)
         waiters = self.waiting.get(key)

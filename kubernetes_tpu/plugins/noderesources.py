@@ -212,6 +212,49 @@ class Fit:
             tuple(sorted(r.scalar_resources.items())),
         )
 
+    # -- placement scoring (resource_allocation.go:505 scorePlacement) ------
+
+    def score_placement(self, state, group, pga) -> Tuple[int, "Status"]:
+        """Score a whole candidate placement: the strategy formula over the
+        placement-AGGREGATE requested/allocatable, where requested includes
+        both the nodes' existing pods and the proposed group assignments
+        (fit.go:873 ScorePlacement)."""
+        node_score = 0
+        weight_sum = 0
+        for spec in self.resources:
+            name, weight = spec["name"], spec.get("weight", 1)
+            used = 0
+            for pod, _node in pga.proposed:
+                req = pod.resource_request()
+                if name == res.CPU:
+                    used += req.milli_cpu or NodeInfo.DEFAULT_MILLI_CPU
+                elif name == res.MEMORY:
+                    used += req.memory or NodeInfo.DEFAULT_MEMORY
+                else:
+                    used += req.get(name)
+            alloc = 0
+            for ni in pga.nodes:
+                alloc += ni.allocatable.get(name)
+                if name == res.CPU:
+                    used += ni.non_zero_requested.milli_cpu
+                elif name == res.MEMORY:
+                    used += ni.non_zero_requested.memory
+                else:
+                    used += ni.requested.get(name)
+            if alloc == 0:
+                continue
+            if self.scoring_strategy == LEAST_ALLOCATED:
+                rscore = least_requested_score(used, alloc)
+            elif self.scoring_strategy == MOST_ALLOCATED:
+                rscore = most_requested_score(used, alloc)
+            else:
+                rscore = requested_to_capacity_ratio_score(used, alloc, self.shape)
+            node_score += rscore * weight
+            weight_sum += weight
+        if weight_sum == 0:
+            return 0, OK
+        return node_score // weight_sum, OK
+
 
 class BalancedAllocation:
     """NodeResourcesBalancedAllocation (balanced_allocation.go)."""

@@ -1,0 +1,140 @@
+"""Placement-based gang scheduling (schedule_one_podgroup.go:971
+podGroupSchedulingPlacementAlgorithm + topology_placement.go +
+podgroup_pods_count.go + findBestPodGroupPlacement :1173).
+
+A topology-constrained PodGroup generates one candidate placement per
+topology domain, simulates the group against each, gates with
+PlacementFeasible (GangScheduling min_count), scores candidates with
+PlacementScore plugins, and commits the best — packing the gang into ONE
+domain instead of spreading it like member-wise scheduling would.
+"""
+
+import pytest
+
+from kubernetes_tpu.api.types import PodGroup
+from kubernetes_tpu.core import FakeClientset, Scheduler
+from kubernetes_tpu.core.registry import gang_placement_profiles
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def _sched(**kw):
+    cs = FakeClientset()
+    s = Scheduler(clientset=cs, profile_factory=gang_placement_profiles,
+                  deterministic_ties=True, **kw)
+    return cs, s
+
+
+def _gang(cs, name, size, cpu="1", min_count=None, topology_keys=(ZONE,)):
+    cs.create_pod_group(PodGroup(
+        name=name, min_count=min_count if min_count is not None else size,
+        topology_keys=tuple(topology_keys)))
+    pods = []
+    for i in range(size):
+        p = make_pod().name(f"{name}-{i}").req({"cpu": cpu}).obj()
+        p.pod_group = name
+        cs.create_pod(p)
+        pods.append(p)
+    return pods
+
+
+def _zones_of(cs, pods):
+    return {cs.nodes[p.node_name].labels[ZONE] for p in pods if p.node_name}
+
+
+class TestPlacementAlgorithm:
+    def test_gang_packs_into_one_zone(self):
+        cs, s = _sched()
+        # 3 zones x 4 nodes; without placements a 4-pod gang would spread
+        # (LeastAllocated balances), with the topology constraint it must
+        # land entirely inside one zone.
+        for i in range(12):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": 8, "memory": "32Gi", "pods": 110})
+                           .zone(f"z{i % 3}").obj())
+        pods = _gang(cs, "train", 4)
+        s.run_until_idle()
+        assert all(p.node_name for p in pods), [p.node_name for p in pods]
+        assert len(_zones_of(cs, pods)) == 1
+
+    def test_best_placement_most_members(self):
+        cs, s = _sched()
+        # z0 fits only 2 gang pods, z1 fits all 4: PodGroupPodsCount must
+        # pick z1 even though z0 sorts first.
+        for i in range(2):
+            cs.create_node(make_node().name(f"small{i}")
+                           .capacity({"cpu": 4, "memory": "32Gi", "pods": 110})
+                           .zone("z0").obj())
+        for i in range(4):
+            cs.create_node(make_node().name(f"big{i}")
+                           .capacity({"cpu": 8, "memory": "32Gi", "pods": 110})
+                           .zone("z1").obj())
+        pods = _gang(cs, "train", 4, cpu="4", min_count=2)
+        s.run_until_idle()
+        placed = [p for p in pods if p.node_name]
+        assert len(placed) == 4
+        assert _zones_of(cs, placed) == {"z1"}
+
+    def test_min_count_gate_rejects_thin_domains(self):
+        cs, s = _sched()
+        # Every zone fits only 2 of the 3 required members: no placement is
+        # feasible, the group parks unschedulable, nothing commits.
+        for i in range(4):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": 2, "memory": "32Gi", "pods": 110})
+                           .zone(f"z{i % 2}").obj())
+        pods = _gang(cs, "train", 3, cpu="2", min_count=3)
+        s.run_until_idle()
+        assert all(not p.node_name for p in pods)
+        assert s.scheduled == 0
+
+    def test_partial_gang_when_min_count_met(self):
+        cs, s = _sched()
+        # One zone fits 3 of 4 members with min_count 2: the placement is
+        # feasible, 3 commit, the 4th member fails individually.
+        for i in range(3):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": 2, "memory": "32Gi", "pods": 110})
+                           .zone("z0").obj())
+        pods = _gang(cs, "train", 4, cpu="2", min_count=2)
+        s.run_until_idle()
+        placed = [p for p in pods if p.node_name]
+        assert len(placed) == 3
+        assert _zones_of(cs, placed) == {"z0"}
+
+    def test_scheduled_members_pin_the_domain(self):
+        cs, s = _sched()
+        for i in range(6):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": 8, "memory": "32Gi", "pods": 110})
+                           .zone(f"z{i % 3}").obj())
+        # A group pod already bound in z2 forces the generator to emit only
+        # the z2 placement (topology_placement.go requiredDomain).
+        cs.create_pod_group(PodGroup(name="train", min_count=2,
+                                     topology_keys=(ZONE,)))
+        bound = make_pod().name("train-bound").req({"cpu": "1"}).obj()
+        bound.pod_group = "train"
+        bound.node_name = "n2"  # z2
+        cs.create_pod(bound)
+        pods = []
+        for i in range(2):
+            p = make_pod().name(f"train-{i}").req({"cpu": "1"}).obj()
+            p.pod_group = "train"
+            cs.create_pod(p)
+            pods.append(p)
+        s.run_until_idle()
+        assert all(p.node_name for p in pods)
+        assert _zones_of(cs, pods) == {"z2"}
+
+    def test_no_topology_keys_uses_default_algorithm(self):
+        cs, s = _sched()
+        for i in range(4):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": 8, "memory": "32Gi", "pods": 110})
+                           .zone(f"z{i % 2}").obj())
+        pods = _gang(cs, "plain", 4, topology_keys=())
+        s.run_until_idle()
+        assert all(p.node_name for p in pods)
+        # default member-wise algorithm spreads across zones (LeastAllocated)
+        assert len(_zones_of(cs, pods)) == 2
