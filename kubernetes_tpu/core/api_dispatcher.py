@@ -24,10 +24,11 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
-# Call types + relevance (api_calls/ relevances: binding > status patch).
+# Call types + relevance (api_calls/ relevances: deletion > binding > patch).
 CALL_STATUS_PATCH = "pod_status_patch"
 CALL_BINDING = "pod_binding"
-RELEVANCE = {CALL_STATUS_PATCH: 1, CALL_BINDING: 2}
+CALL_DELETE = "pod_deletion"
+RELEVANCE = {CALL_STATUS_PATCH: 1, CALL_BINDING: 2, CALL_DELETE: 3}
 
 
 @dataclass

@@ -122,6 +122,15 @@ class Handle:
     def reject_waiting_pod(self, uid: str, reason: str = "rejected") -> bool:
         return self._scheduler.reject_waiting_pod(uid, reason)
 
+    def simulate_pod_group(self, group, members) -> bool:
+        """Feasibility probe for pod-group preemption (the
+        podGroupSchedulingFunc handed to PodGroupEvaluator.Preempt): would the
+        group schedule against the CURRENT snapshot, under the SAME algorithm
+        a real cycle would use (placement-constrained when the group carries
+        topology constraints)? Leaves the snapshot unchanged; the caller owns
+        any NodeInfo mutations (victim removals) around this probe."""
+        return self._scheduler.group_feasible(group, members)
+
     def on_async_bind_error(self, pod, exc: Exception) -> None:
         """Async dispatcher bind failure: unwind the optimistic commit."""
         s = self._scheduler
@@ -598,37 +607,13 @@ class Scheduler:
         start_save = self.next_start_node_index
         candidates: List[Tuple[Placement, Dict[str, str], PodGroupAssignments]] = []
         for placement in placements:
-            self.snapshot.assume_placement(placement.node_names)
-            self.next_start_node_index = start_save  # identical rotation per sim
-            placed: List[QueuedPodInfo] = []
-            failed = 0
-            try:
-                for m in members:
-                    try:
-                        result = self.schedule_pod(fw, CycleState(), m.pod)
-                    except FitError:
-                        failed += 1
-                        continue
-                    m.pod.node_name = result.suggested_host
-                    self.snapshot.assume_pod(m.pod)
-                    placed.append(m)
-                progress = PlacementProgress(len(placed), failed, len(members))
-                feasible = placed and fw.run_placement_feasible_plugins(
-                    pg_state, group, progress).is_success()
-                assignment = {m.pod.uid: m.pod.node_name for m in placed}
-            finally:
-                # LIFO revert: the snapshot returns to the placement view,
-                # then the full view (snapshot.go revertFns + ForgetPlacement)
-                # — even on an unexpected plugin exception, or every later
-                # cycle would see the restricted node subset.
-                for m in reversed(placed):
-                    self.snapshot.forget_pod(m.pod)
-                    m.pod.node_name = ""
-                self.snapshot.forget_placement()
-            if feasible:
+            assignment = self._evaluate_placement(
+                fw, pg_state, group, members, placement, start_save)
+            if assignment is not None:
                 pga = PodGroupAssignments(
                     placement,
-                    proposed=[(m.pod, assignment[m.pod.uid]) for m in placed],
+                    proposed=[(m.pod, assignment[m.pod.uid]) for m in members
+                              if m.pod.uid in assignment],
                     nodes=[self.snapshot.get(n) for n in placement.node_names])
                 candidates.append((placement, assignment, pga))
         self.next_start_node_index = start_save
@@ -668,6 +653,89 @@ class Scheduler:
         self.metrics.podgroup_schedule_attempts.inc(
             "scheduled" if committed else "unschedulable")
         return True
+
+    def _evaluate_placement(self, fw: Framework, pg_state: CycleState,
+                            group, members: List[QueuedPodInfo], placement,
+                            start_index: int) -> Optional[Dict[str, str]]:
+        """Simulate the group against one candidate placement under a
+        snapshot placement session. Returns {pod uid: node} when the
+        PlacementFeasible gate passes, else None. The snapshot is ALWAYS
+        restored (placement and pod assumptions), even on plugin exceptions."""
+        from .framework import PlacementProgress
+
+        self.snapshot.assume_placement(placement.node_names)
+        self.next_start_node_index = start_index  # identical rotation per sim
+        placed: List[QueuedPodInfo] = []
+        failed = 0
+        try:
+            for m in members:
+                try:
+                    result = self.schedule_pod(fw, CycleState(), m.pod)
+                except FitError:
+                    failed += 1
+                    continue
+                m.pod.node_name = result.suggested_host
+                self.snapshot.assume_pod(m.pod)
+                placed.append(m)
+            progress = PlacementProgress(len(placed), failed, len(members))
+            feasible = placed and fw.run_placement_feasible_plugins(
+                pg_state, group, progress).is_success()
+            assignment = {m.pod.uid: m.pod.node_name for m in placed}
+        finally:
+            # LIFO revert: the snapshot returns to the placement view, then
+            # the full view (snapshot.go revertFns + ForgetPlacement).
+            for m in reversed(placed):
+                self.snapshot.forget_pod(m.pod)
+                m.pod.node_name = ""
+            self.snapshot.forget_placement()
+        return assignment if feasible else None
+
+    def group_feasible(self, group, members: List[QueuedPodInfo]) -> bool:
+        """Would this group schedule right now, under the same algorithm a
+        real cycle would use? The feasibility probe behind pod-group
+        preemption (podgrouppreemption.go podGroupSchedulingFunc): a
+        topology-constrained group must fit some CANDIDATE PLACEMENT, not
+        just the unconstrained cluster."""
+        from .framework import Placement
+
+        members = [m for m in members]
+        if not members:
+            return False
+        fw = self.framework_for_pod(members[0].pod)
+        start_save = self.next_start_node_index
+        pg_state = CycleState()
+        if fw.placement_generate_plugins and getattr(group, "topology_keys", ()):
+            parent = Placement("", [ni.name for ni in self.snapshot.node_info_list])
+            placements, st = fw.run_placement_generate_plugins(
+                pg_state, group, members, parent)
+            if not st.is_success():
+                return False
+            try:
+                return any(
+                    self._evaluate_placement(fw, pg_state, group, members,
+                                             placement, start_save) is not None
+                    for placement in placements)
+            finally:
+                self.next_start_node_index = start_save
+        # Unconstrained default algorithm: all members must fit.
+        placed: List[QueuedPodInfo] = []
+        ok = True
+        try:
+            for m in members:
+                try:
+                    result = self.schedule_pod(fw, CycleState(), m.pod)
+                except FitError:
+                    ok = False
+                    break
+                m.pod.node_name = result.suggested_host
+                self.snapshot.assume_pod(m.pod)
+                placed.append(m)
+        finally:
+            for m in reversed(placed):
+                self.snapshot.forget_pod(m.pod)
+                m.pod.node_name = ""
+            self.next_start_node_index = start_save
+        return ok
 
     def _commit_group_member(self, fw: Framework, m: QueuedPodInfo,
                              state: CycleState, result: ScheduleResult) -> bool:

@@ -181,12 +181,25 @@ class Evaluator:
 
         return min(candidates, key=key)
 
-    # -- commit (preemption.go prepareCandidate) ---------------------------
+    # -- commit (preemption.go prepareCandidate / executor.go:171
+    # prepareCandidateAsync) ------------------------------------------------
 
     def prepare_candidate(self, cand: Candidate, pod: Pod) -> None:
+        """Evict the victims. Deletions route through the APIDispatcher
+        (executor.go:171 prepareCandidateAsync: the scheduling cycle moves on
+        while the API calls drain; in thread mode they physically run off the
+        loop, in inline mode they complete immediately with identical
+        semantics)."""
         cs = self.handle.clientset
+        dispatcher = getattr(self.handle, "api_dispatcher", None)
         for pi in cand.victims:
-            cs.delete_pod(pi.pod)
+            if dispatcher is not None:
+                from ..core.api_dispatcher import APICall, CALL_DELETE
+                dispatcher.add(APICall(
+                    call_type=CALL_DELETE, object_uid=pi.pod.uid,
+                    execute=lambda p=pi.pod: cs.delete_pod(p)))
+            else:
+                cs.delete_pod(pi.pod)
         # Lower-priority pods nominated to this node lose their nomination
         # (preemption.go prepareCandidate → ClearNominatedNodeName).
         nominator = getattr(self.handle, "nominator", None)
@@ -195,6 +208,58 @@ class Evaluator:
                 if pi.pod.priority < pod.priority:
                     nominator.delete_nominated_pod(pi.pod)
                     pi.pod.nominated_node_name = ""
+
+
+class PodGroupEvaluator:
+    """Pod-group preemption (preemption/podgrouppreemption.go:42
+    PodGroupEvaluator): the preemptor is a whole group and the domain is the
+    whole cluster. Remove every preemptible lower-priority pod, check the
+    group schedules, then reprieve victims most-important-first while the
+    group still fits (:139 selectVictimsOnDomain)."""
+
+    def __init__(self, handle):
+        self.handle = handle
+
+    def preempt(self, group, members, simulate_fn) -> Tuple[List[PodInfo], Status]:
+        """Returns (victims, status). `simulate_fn()` must attempt the whole
+        group against the live snapshot and return True on feasibility,
+        leaving the snapshot unchanged. NodeInfos are mutated during
+        evaluation and ALWAYS restored before returning."""
+        snapshot = self.handle.snapshot() if callable(self.handle.snapshot) else self.handle.snapshot
+        preemptor_prio = max((m.pod.priority for m in members), default=0)
+        potential: List[Tuple[NodeInfo, PodInfo]] = []
+        for ni in snapshot.node_info_list:
+            for pi in ni.pods:
+                if (pi.pod.priority < preemptor_prio
+                        and pi.pod.deletion_ts is None):
+                    potential.append((ni, pi))
+        if not potential:
+            return [], Status.unresolvable(
+                "pod-group preemption: no lower-priority pods")
+
+        removed: List[Tuple[NodeInfo, PodInfo]] = []
+        try:
+            for ni, pi in potential:
+                if ni.remove_pod(pi.pod):
+                    removed.append((ni, pi))
+            if not simulate_fn():
+                return [], Status.unschedulable(
+                    "pod-group preemption: group does not fit even after "
+                    "removing all lower-priority pods")
+            # Reprieve most-important-first (MoreImportantPod ordering).
+            removed.sort(key=lambda t: (-t[1].pod.priority, t[1].pod.creation_ts))
+            victims: List[PodInfo] = []
+            for ni, pi in list(removed):
+                ni.add_pod(pi)
+                if simulate_fn():
+                    removed.remove((ni, pi))  # reprieved: stays restored
+                else:
+                    ni.remove_pod(pi.pod)
+                    victims.append(pi)
+            return victims, OK
+        finally:
+            for ni, pi in removed:  # restore every still-removed victim
+                ni.add_pod(pi)
 
 
 class DefaultPreemption:
@@ -238,3 +303,32 @@ class DefaultPreemption:
         # Success: the scheduler records the nomination and requeues
         # (preemption.go Preempt returns Success + nominated node).
         return PostFilterResult(nominating_info=best.node_name), OK
+
+    # -- pod-group preemption (PodGroupPostFilter; podgrouppreemption.go) ---
+
+    def pod_group_post_filter(
+        self, state: CycleState, group, members, diagnosis
+    ) -> Tuple[Optional[PostFilterResult], Status]:
+        simulate = getattr(self.handle, "simulate_pod_group", None)
+        if simulate is None or not members:
+            return None, Status.unschedulable("pod-group preemption unavailable")
+        ev = PodGroupEvaluator(self.handle)
+        victims, st = ev.preempt(group, members, lambda: simulate(group, members))
+        if not st.is_success() or not victims:
+            return None, st if not st.is_success() else Status.unschedulable(
+                "pod-group preemption found no victim set")
+        metrics = getattr(self.handle, "metrics", None)
+        if metrics is not None:
+            metrics.preemption_attempts.inc()
+            metrics.preemption_victims.observe(len(victims))
+        cs = self.handle.clientset
+        dispatcher = getattr(self.handle, "api_dispatcher", None)
+        for pi in victims:
+            if dispatcher is not None:
+                from ..core.api_dispatcher import APICall, CALL_DELETE
+                dispatcher.add(APICall(
+                    call_type=CALL_DELETE, object_uid=pi.pod.uid,
+                    execute=lambda p=pi.pod: cs.delete_pod(p)))
+            else:
+                cs.delete_pod(pi.pod)
+        return PostFilterResult(), OK

@@ -1,0 +1,102 @@
+"""Pod-group preemption (preemption/podgrouppreemption.go PodGroupEvaluator
+via the PodGroupPostFilter extension point) and async victim deletion
+(executor.go:171 prepareCandidateAsync via the APIDispatcher)."""
+
+from kubernetes_tpu.api.types import PodGroup
+from kubernetes_tpu.core import FakeClientset, Scheduler
+from kubernetes_tpu.core.config import SchedulerConfiguration
+from kubernetes_tpu.core.registry import gang_placement_profiles
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def _full_cluster(cs, n_nodes=4, cpu=4, fill_prio=1):
+    """n nodes, each filled by one low-priority 4-cpu pod."""
+    filler = []
+    for i in range(n_nodes):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": cpu, "memory": "32Gi", "pods": 110})
+                       .zone(f"z{i % 2}").obj())
+    for i in range(n_nodes):
+        p = make_pod().name(f"low-{i}").req({"cpu": str(cpu)}).priority(fill_prio).obj()
+        p.node_name = f"n{i}"
+        cs.create_pod(p)
+        filler.append(p)
+    return filler
+
+
+class TestPodGroupPreemption:
+    def test_gang_preempts_enough_victims(self):
+        cs = FakeClientset()
+        s = Scheduler(clientset=cs, profile_factory=gang_placement_profiles,
+                      deterministic_ties=True)
+        filler = _full_cluster(cs, n_nodes=4)
+        cs.create_pod_group(PodGroup(name="train", min_count=2))
+        gang = []
+        for i in range(2):
+            p = make_pod().name(f"hi-{i}").req({"cpu": "4"}).priority(100).obj()
+            p.pod_group = "train"
+            cs.create_pod(p)
+            gang.append(p)
+        s.run_until_idle()
+        # Exactly 2 victims evicted (reprieve keeps the other 2), gang bound.
+        assert sum(1 for p in filler if p.uid not in cs.pods) == 2
+        assert all(p.node_name for p in gang), [p.node_name for p in gang]
+
+    def test_no_preemption_for_lower_priority_gang(self):
+        cs = FakeClientset()
+        s = Scheduler(clientset=cs, profile_factory=gang_placement_profiles,
+                      deterministic_ties=True)
+        filler = _full_cluster(cs, n_nodes=2, fill_prio=50)
+        cs.create_pod_group(PodGroup(name="train", min_count=2))
+        for i in range(2):
+            p = make_pod().name(f"lo-{i}").req({"cpu": "4"}).priority(10).obj()
+            p.pod_group = "train"
+            cs.create_pod(p)
+        s.run_until_idle()
+        assert all(p.uid in cs.pods for p in filler)  # nobody evicted
+        assert s.scheduled == 0
+
+    def test_placement_constrained_gang_preempts_within_domain(self):
+        cs = FakeClientset()
+        s = Scheduler(clientset=cs, profile_factory=gang_placement_profiles,
+                      deterministic_ties=True)
+        filler = _full_cluster(cs, n_nodes=4)
+        cs.create_pod_group(PodGroup(name="train", min_count=2,
+                                     topology_keys=(ZONE,)))
+        gang = []
+        for i in range(2):
+            p = make_pod().name(f"hi-{i}").req({"cpu": "4"}).priority(100).obj()
+            p.pod_group = "train"
+            cs.create_pod(p)
+            gang.append(p)
+        s.run_until_idle()
+        assert all(p.node_name for p in gang)
+        zones = {cs.nodes[p.node_name].labels[ZONE] for p in gang}
+        assert len(zones) == 1  # preempted AND packed into one zone
+
+
+class TestAsyncPreemption:
+    def test_victims_deleted_through_thread_dispatcher(self):
+        cfg = SchedulerConfiguration(async_dispatch_threads=True)
+        cs = FakeClientset()
+        s = Scheduler(clientset=cs, config=cfg, deterministic_ties=True)
+        assert s.api_dispatcher.mode == "thread"
+        for i in range(2):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": 4, "memory": "16Gi", "pods": 110}).obj())
+        low = []
+        for i in range(2):
+            p = make_pod().name(f"low-{i}").req({"cpu": "4"}).priority(1).obj()
+            p.node_name = f"n{i}"
+            cs.create_pod(p)
+            low.append(p)
+        hi = make_pod().name("hi").req({"cpu": "4"}).priority(100).obj()
+        cs.create_pod(hi)
+        s.run_until_idle()
+        s.api_dispatcher.flush()
+        s.run_until_idle()
+        assert hi.node_name, (s.error_log, hi.nominated_node_name)
+        assert sum(1 for p in low if p.uid not in cs.pods) == 1
+        s.api_dispatcher.close()
