@@ -33,6 +33,8 @@ class Snapshot:
         self.used_pvc_count: Dict[str, int] = {}
         self.image_num_nodes: Dict[str, int] = {}
         self.generation: int = 0
+        self._index: Dict[str, int] = {}
+        self._list_members: set = set()
 
     def get(self, name: str) -> Optional[NodeInfo]:
         return self.node_info_map.get(name)
@@ -51,6 +53,10 @@ class Snapshot:
         for ni in self.node_info_list:
             for img in ni.image_states:
                 self.image_num_nodes[img] = self.image_num_nodes.get(img, 0) + 1
+        self._index = {ni.name: i for i, ni in enumerate(self.node_info_list)}
+        self._list_members = (
+            {ni.name for ni in self.have_pods_with_affinity_list}
+            | {ni.name for ni in self.have_pods_with_required_anti_affinity_list})
 
     # -- in-cycle what-if mutation (gang simulation, snapshot.go:545/:599) --
 
@@ -253,14 +259,21 @@ class Cache:
     # -- snapshot ----------------------------------------------------------
 
     def update_snapshot(self, snapshot: Snapshot) -> Snapshot:
-        """UpdateSnapshot (cache.go:206): re-clone only dirty NodeInfos."""
+        """UpdateSnapshot (cache.go:206): re-clone only dirty NodeInfos, and
+        patch them into the snapshot's lists IN PLACE — the reference's
+        generation walk touches O(changed) nodes per cycle, and the daemonset
+        workload (15k nodes, one dirty node per bind) holds this to the same
+        bound. Full list rebuilds happen only on structural changes or when
+        an affinity/image-relevant membership changed."""
+        order_refreshed = self._order_dirty
         if self._order_dirty:
             self.node_order = self.node_tree.list() + list(self._imaginary)
             self._order_dirty = False
-        structural = self._removed_since_snapshot or (
+        structural = order_refreshed or self._removed_since_snapshot or (
             len(snapshot.node_info_list) != len(self.node_order)
         )
         affinity_dirty = structural
+        replaced = []
         for name in self._dirty:
             ni = self.nodes.get(name)
             if ni is None:
@@ -271,21 +284,38 @@ class Cache:
                     or bool(old.pods_with_required_anti_affinity) != bool(clone.pods_with_required_anti_affinity) \
                     or old.image_states.keys() != clone.image_states.keys():
                 affinity_dirty = True
+            elif name in getattr(snapshot, "_list_members", ()):
+                # The re-cloned node sits in an affinity sublist: the list
+                # entry must point at the fresh clone.
+                affinity_dirty = True
             snapshot.node_info_map[name] = clone
+            replaced.append((name, clone))
         if structural:
             snapshot.node_info_map = {
                 name: snapshot.node_info_map.get(name) or self.nodes[name].snapshot_clone()
                 for name in self.node_order
             }
-        # Imaginary nodes (pods observed before their node) stay in the map for
-        # accounting but are excluded from the schedulable list, as the
-        # reference excludes nil-node entries from nodeInfoList.
-        snapshot.node_info_list = [
-            snapshot.node_info_map[n] for n in self.node_order
-            if n in snapshot.node_info_map and snapshot.node_info_map[n].node is not None
-        ]
-        if affinity_dirty or self._dirty:
+            # Imaginary nodes (pods observed before their node) stay in the
+            # map for accounting but are excluded from the schedulable list,
+            # as the reference excludes nil-node entries from nodeInfoList.
+            snapshot.node_info_list = [
+                snapshot.node_info_map[n] for n in self.node_order
+                if n in snapshot.node_info_map and snapshot.node_info_map[n].node is not None
+            ]
             snapshot.rebuild_lists()
+        else:
+            index = getattr(snapshot, "_index", None)
+            if index is None:
+                snapshot.rebuild_lists()
+                index = snapshot._index
+            for name, clone in replaced:
+                idx = index.get(name)
+                if idx is not None and clone.node is not None:
+                    snapshot.node_info_list[idx] = clone
+                elif clone.node is not None:
+                    affinity_dirty = True  # newly visible node: full rebuild
+            if affinity_dirty:
+                snapshot.rebuild_lists()
         snapshot.generation = next_generation()
         self._dirty.clear()
         self._removed_since_snapshot = False

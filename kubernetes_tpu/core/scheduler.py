@@ -445,22 +445,7 @@ class Scheduler:
         try:
             result = self.scheduling_cycle(fw, state, qpi)
         except FitError as fe:
-            # PostFilter (preemption): schedule_one.go:1152
-            # handleSchedulingFailure runs after RunPostFilterPlugins produced
-            # a nominating info (schedule_one.go:169 schedulingCycle tail).
-            if fw.post_filter_plugins:
-                result, post_st = fw.run_post_filter_plugins(
-                    state, pod, fe.diagnosis.node_to_status)
-                nominated = getattr(result, "nominating_info", None) if result else None
-                if post_st.is_success() and nominated:
-                    pod.nominated_node_name = nominated
-                    self.clientset.patch_pod_status(pod, nominated_node_name=nominated)
-                    self.queue.nominator.add_nominated_pod(qpi.pod_info, nominated)
-            self.handle_scheduling_failure(fw, qpi, Status(UNSCHEDULABLE, (str(fe),)), fe.diagnosis)
-            self.queue.done(pod.uid)
-            self.metrics.schedule_attempts.inc("unschedulable", fw.profile_name)
-            self.metrics.scheduling_attempt_duration.observe(
-                time.perf_counter() - t0, "unschedulable", fw.profile_name)
+            self.handle_fit_error(fw, state, qpi, fe, t0)
             return
         except Exception as e:  # noqa: BLE001
             self.error_log.append(f"{pod.namespace}/{pod.name}: {e!r}")
@@ -485,6 +470,27 @@ class Scheduler:
         if bound and qpi.initial_attempt_timestamp is not None:
             self.metrics.pod_scheduling_sli_duration.observe(
                 self.now() - qpi.initial_attempt_timestamp, str(qpi.attempts))
+
+    def handle_fit_error(self, fw: Framework, state: CycleState,
+                         qpi: QueuedPodInfo, fe: FitError, t0: float) -> None:
+        """The scheduling-cycle FitError tail (schedule_one.go:169 tail +
+        :1152 handleSchedulingFailure): PostFilter (preemption) with the
+        diagnosis, nomination recording, requeue, metrics. Shared by the host
+        cycle and the device path's vectorized diagnosis."""
+        pod = qpi.pod
+        if fw.post_filter_plugins:
+            result, post_st = fw.run_post_filter_plugins(
+                state, pod, fe.diagnosis.node_to_status)
+            nominated = getattr(result, "nominating_info", None) if result else None
+            if post_st.is_success() and nominated:
+                pod.nominated_node_name = nominated
+                self.clientset.patch_pod_status(pod, nominated_node_name=nominated)
+                self.queue.nominator.add_nominated_pod(qpi.pod_info, nominated)
+        self.handle_scheduling_failure(fw, qpi, Status(UNSCHEDULABLE, (str(fe),)), fe.diagnosis)
+        self.queue.done(pod.uid)
+        self.metrics.schedule_attempts.inc("unschedulable", fw.profile_name)
+        self.metrics.scheduling_attempt_duration.observe(
+            time.perf_counter() - t0, "unschedulable", fw.profile_name)
 
     def scheduling_cycle(self, fw: Framework, state: CycleState, qpi: QueuedPodInfo) -> ScheduleResult:
         pod = qpi.pod
@@ -826,7 +832,15 @@ class Scheduler:
 
         nodes = all_nodes
         if pre_res is not None and not pre_res.all_nodes():
-            nodes = [ni for ni in all_nodes if ni.name in pre_res.node_names]
+            if len(pre_res.node_names) == 1:
+                # The daemonset shape narrows 15k nodes to ONE per pod: a map
+                # lookup, not an O(all nodes) scan per pod.
+                ni = self.snapshot.get(next(iter(pre_res.node_names)))
+                nodes = [ni] if ni is not None else []
+            else:
+                # Preserve snapshot order (rotation parity over the narrowed
+                # list, schedule_one.go:630).
+                nodes = [ni for ni in all_nodes if ni.name in pre_res.node_names]
         feasible = self.find_nodes_that_pass_filters(fw, state, pod, diagnosis, nodes)
         if feasible and self.extenders:
             from .extender import run_extender_filters

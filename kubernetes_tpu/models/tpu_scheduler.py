@@ -54,6 +54,19 @@ class TPUScheduler(Scheduler):
         # device while the host commits retired ones (2 = double buffering).
         self.pipeline_depth = getattr(self.config, "pipeline_depth", 2)
         enable_persistent_compilation_cache()
+        # Multi-chip: with >1 device the node axis shards over a
+        # ("cells", "nodes") mesh and the SAME jitted kernel compiles SPMD
+        # (GSPMD from committed input shardings; reductions ride ICI
+        # collectives — parallelize/parallelism.go:28's scale axis, done the
+        # scaling-book way). Single chip runs unsharded, zero overhead.
+        self.mesh = None
+        try:
+            import jax
+            if len(jax.devices()) > 1:
+                from ..parallel import make_mesh
+                self.mesh = make_mesh(n_cells=1)
+        except Exception:  # noqa: BLE001 - backend probing must never kill init
+            self.mesh = None
         self.mirror = NodeStateMirror()
         self._holdover: Optional[QueuedPodInfo] = None
         # metrics
@@ -68,6 +81,9 @@ class TPUScheduler(Scheduler):
         self.plan_build_s = 0.0
         self.device_wait_s = 0.0
         self.host_commit_s = 0.0
+        # Terminal-failure memo: (state key, unschedulable plugins, message)
+        # of the last side-effect-free host diagnosis (see _fail_from_memo).
+        self._fail_memo = None
 
     # -- batch accumulation ------------------------------------------------
 
@@ -192,6 +208,10 @@ class TPUScheduler(Scheduler):
             fit_plugin=fw.plugin("NodeResourcesFit"),
         )
         state = self.mirror.flush()
+        if self.mesh is not None:
+            from ..parallel import shard_features, shard_node_state
+            state = shard_node_state(state, self.mesh)
+            plan.features = shard_features(plan.features, self.mesh)
         return state, plan
 
     def warm_for(self, pod, batch_sizes: Optional[List[int]] = None) -> None:
@@ -366,12 +386,30 @@ class TPUScheduler(Scheduler):
                 self.process_one(qpi)
                 continue
             if row < 0:
+                if self._fail_from_memo(fw, qpi):
+                    # Identical pod, identical state, known terminal outcome:
+                    # park it with the memoized diagnosis. No state mutated,
+                    # so the session carry stays valid — an unschedulable
+                    # FLOOD (10k hopeless pods + churn) must not tear down
+                    # the measured pods' session per flood pod.
+                    continue
+                if self._fail_with_vector_diagnosis(fw, qpi):
+                    # Exact Diagnosis built from the mirror arrays (numpy)
+                    # instead of a 0.3s pure-Python cluster scan; when the
+                    # PostFilter made no nomination, no state moved and the
+                    # session continues.
+                    if qpi.pod.nominated_node_name or qpi.pod.node_name:
+                        invalidated = True
+                    else:
+                        self._memoize_failure(fw, qpi)
+                    continue
                 # Infeasible on device: rerun on the host path for the exact
                 # FitError diagnosis. The host attempt may mutate state
                 # (preemption nomination), so the session cannot continue on
                 # the chained carry.
                 self.host_path_pods += 1
                 self.process_one(qpi)
+                self._memoize_failure(fw, qpi)
                 invalidated = True
                 continue
             if self._commit(fw, qpi, node_names[row]):
@@ -381,6 +419,65 @@ class TPUScheduler(Scheduler):
                 dirty_rows.append(row)
                 invalidated = True
         return invalidated
+
+    def _fail_state_key(self, fw: Framework, pod) -> tuple:
+        """Everything a scheduling outcome can depend on, versioned: the pod
+        spec (signature), external cluster changes, our own binds, and
+        nominations (sessions never run with nominated pods present, but the
+        key guards the invariant)."""
+        return (fw.sign_pod(pod), self.cluster_event_seq, self.scheduled,
+                self.queue.nominator.has_nominated_pods())
+
+    def _fail_from_memo(self, fw: Framework, qpi: QueuedPodInfo) -> bool:
+        """An identical pod was already host-diagnosed unschedulable against
+        this exact state with NO side effects (no nomination, no preemption):
+        the rerun would reproduce the same diagnosis, so park the pod from
+        the memo. Keeps the device session alive through unschedulable
+        floods (Unschedulable/5kNodes perf contract)."""
+        memo = self._fail_memo
+        if memo is None or memo[0] != self._fail_state_key(fw, qpi.pod):
+            return False
+        _, plugins, message = memo
+        self.attempts += 1
+        qpi.unschedulable_plugins |= plugins
+        from ..core.framework import Status
+        self.handle_scheduling_failure(fw, qpi, Status.unschedulable(message), None)
+        self.queue.done(qpi.pod.uid)
+        self.metrics.schedule_attempts.inc("unschedulable", fw.profile_name)
+        return True
+
+    def _fail_with_vector_diagnosis(self, fw: Framework, qpi: QueuedPodInfo) -> bool:
+        """Build the FitError diagnosis for a device-infeasible pod from the
+        mirror's staging arrays and run the standard fit-error tail
+        (PostFilter/preemption included). Returns False when the pod's
+        feature set needs the exact host rerun (topology features)."""
+        import time as _t
+        from ..core.framework import CycleState, FitError
+        from ..ops.features import diagnose_unschedulable
+
+        t0 = _t.perf_counter()
+        self.cache.update_snapshot(self.snapshot)
+        self.mirror.sync(self.snapshot.node_info_list)
+        diag = diagnose_unschedulable(qpi.pod, self.mirror, self.snapshot, fw)
+        if diag is None:
+            return False
+        self.attempts += 1
+        fe = FitError(qpi.pod, self.snapshot.num_nodes(), diag)
+        self.handle_fit_error(fw, CycleState(), qpi, fe, t0)
+        return True
+
+    def _memoize_failure(self, fw: Framework, qpi: QueuedPodInfo) -> None:
+        """Record the host diagnosis IF the attempt was terminal and
+        side-effect-free (keyed on the post-attempt state)."""
+        pod = qpi.pod
+        if pod.node_name or pod.nominated_node_name:
+            self._fail_memo = None  # scheduled after all, or nominated
+            return
+        self._fail_memo = (
+            self._fail_state_key(fw, pod),
+            frozenset(qpi.unschedulable_plugins),
+            f"0/{self.snapshot.num_nodes()} nodes are available",
+        )
 
     def _commit(self, fw: Framework, qpi: QueuedPodInfo, node_name: str) -> bool:
         """assume → reserve → permit → binding cycle (the unchanged host tail

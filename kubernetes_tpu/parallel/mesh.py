@@ -72,6 +72,17 @@ def shard_node_state(state: DeviceNodeState, mesh: Mesh) -> DeviceNodeState:
         state, _STATE_SPECS)
 
 
+def shard_features(feats: BatchFeatures, mesh: Mesh) -> BatchFeatures:
+    """Place batch features: per-node vectors shard over "nodes", count
+    tables and pod-level scalars replicate. With the inputs committed to
+    these shardings, the ordinary jitted kernel compiles SPMD over the mesh
+    (GSPMD propagation; cross-node reductions become ICI collectives) — the
+    production TPUScheduler path needs no separate sharded kernel."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        feats, _feature_specs())
+
+
 def sharded_schedule_batch(mesh: Mesh, batch_pad: int, fit_strategy: int, vmax: int):
     """Build the mesh-sharded (and, when the mesh has >1 cell, cell-vmapped)
     compiled kernel. Call with (state, feats) whose leaves carry a leading

@@ -390,3 +390,52 @@ class TestWidenedCoverageEquivalence:
         assert dev.host_path_pods == 0
         bound = {n for n in _assignments(dev).values() if n}
         assert all(int(n.split("-")[1]) % 2 == 0 for n in bound)
+
+
+class TestInfeasibleDiagnosisEquivalence:
+    """Device-infeasible pods produce the same outcome (failure accounting,
+    unschedulable plugin attribution for queueing hints, preemption
+    PostFilter behavior) whether diagnosed by the vectorized mirror path or
+    the host rerun — and identical floods don't tear down the session."""
+
+    def test_flood_outcomes_match_host(self):
+        def pods():
+            out = []
+            for i in range(25):
+                out.append(make_pod().name(f"flood-{i}").req({"cpu": "900"}).obj())
+            for i in range(30):
+                out.append(make_pod().name(f"ok-{i}").req({"cpu": "100m"}).obj())
+            return out
+        host, dev = _run_pair(30, pods)
+        assert host.scheduled == dev.scheduled == 30
+        assert host.failures == dev.failures == 25
+        h_plugins = {q.uid: tuple(sorted(q.unschedulable_plugins))
+                     for q in host.queue.unschedulable.values()}
+        d_plugins = {q.uid: tuple(sorted(q.unschedulable_plugins))
+                     for q in dev.queue.unschedulable.values()}
+        assert set(h_plugins.values()) == set(d_plugins.values())
+
+    def test_preemptable_infeasible_still_preempts(self):
+        # Infeasible only because nodes are FULL (not over-capacity): the
+        # diagnosis must leave preemption viable and the high-priority pod
+        # must evict a victim on both paths.
+        def build(cls):
+            from kubernetes_tpu.core import FakeClientset
+            cs = FakeClientset()
+            s = cls(clientset=cs) if cls is TPUScheduler else cls(
+                clientset=cs, deterministic_ties=True)
+            for i in range(3):
+                cs.create_node(make_node().name(f"n{i}").capacity(
+                    {"cpu": 4, "memory": "16Gi", "pods": 110}).obj())
+            for i in range(3):
+                p = make_pod().name(f"low-{i}").req({"cpu": "4"}).priority(1).obj()
+                p.node_name = f"n{i}"
+                cs.create_pod(p)
+            hi = make_pod().name("hi").req({"cpu": "4"}).priority(50).obj()
+            cs.create_pod(hi)
+            s.run_until_idle()
+            return cs, s, hi
+        cs_h, s_h, hi_h = build(Scheduler)
+        cs_d, s_d, hi_d = build(TPUScheduler)
+        assert hi_h.node_name and hi_d.node_name
+        assert hi_h.node_name == hi_d.node_name
