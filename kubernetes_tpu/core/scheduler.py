@@ -258,8 +258,16 @@ class Scheduler:
             mode = "thread"
         self.api_dispatcher = APIDispatcher(mode=mode)
         # Waiting pods (Permit WAIT; framework.go waitingPods registry).
+        # _next_wait_deadline makes expiry TIMER-DRIVEN: schedule_one checks
+        # it every cycle (O(1)), so a parked pod times out even while the
+        # scheduler is continuously busy (runtime/framework.go:2097
+        # WaitOnPermit runs on its own timer in the reference).
         self.waiting_pods: Dict[str, tuple] = {}
         self.permit_wait_timeout = 60.0
+        self._next_wait_deadline = float("inf")
+        # Event recorder + step tracing (schedule_one.go:1138, :574).
+        from .tracing import EventRecorder
+        self.recorder = EventRecorder()
         # metrics
         self.attempts = 0
         self.scheduled = 0
@@ -421,6 +429,8 @@ class Scheduler:
 
     def schedule_one(self) -> bool:
         self.process_async_api_errors()
+        if self.waiting_pods and self.now() >= self._next_wait_deadline:
+            self.flush_expired_waiters()
         qpi = self.queue.pop()
         if qpi is None:
             return False
@@ -438,14 +448,19 @@ class Scheduler:
             # don't attempt it — the delete event will clear it from the queue.
             self.queue.done(pod.uid)
             return
+        from .tracing import StepTrace
         fw = self.framework_for_pod(pod)
         self.attempts += 1
         t0 = time.perf_counter()
+        trace = StepTrace("Scheduling", pod=f"{pod.namespace}/{pod.name}")
         state = CycleState()
         try:
             result = self.scheduling_cycle(fw, state, qpi)
+            trace.step("scheduling cycle done")
         except FitError as fe:
             self.handle_fit_error(fw, state, qpi, fe, t0)
+            trace.step("unschedulable")
+            trace.log_if_long()
             return
         except Exception as e:  # noqa: BLE001
             self.error_log.append(f"{pod.namespace}/{pod.name}: {e!r}")
@@ -457,12 +472,13 @@ class Scheduler:
             # WaitOnPermit (framework.go:2097): the pod stays reserved
             # (assumed in the cache) until a Permit plugin allows or rejects
             # it, or the wait times out (flush_expired_waiters).
-            self.waiting_pods[pod.uid] = (
-                fw, state, qpi, result, self.now() + self.permit_wait_timeout)
+            self.park_waiting_pod(fw, state, qpi, result)
             self.queue.done(pod.uid)
             return
         bound = self.run_binding_cycle(fw, state, qpi, result)
         self.queue.done(pod.uid)
+        trace.step("binding cycle done")
+        trace.log_if_long()
         elapsed = time.perf_counter() - t0
         self.metrics.schedule_attempts.inc("scheduled" if bound else "error", fw.profile_name)
         self.metrics.scheduling_attempt_duration.observe(
@@ -753,8 +769,7 @@ class Scheduler:
         if st.is_success():
             st = fw.run_permit_plugins(state, m.pod, node)
         if st.code == WAIT:
-            self.waiting_pods[m.pod.uid] = (
-                fw, state, m, result, self.now() + self.permit_wait_timeout)
+            self.park_waiting_pod(fw, state, m, result)
             return True
         if not st.is_success():
             fw.run_reserve_plugins_unreserve(state, m.pod, node)
@@ -940,6 +955,9 @@ class Scheduler:
         self.cache.finish_binding(pod)
         self.queue.nominator.delete_nominated_pod(pod)
         self.scheduled += 1
+        self.recorder.eventf(
+            f"{pod.namespace}/{pod.name}", "Normal", "Scheduled",
+            f"Successfully assigned {pod.namespace}/{pod.name} to {node_name}")
         fw.run_post_bind_plugins(state, pod, node_name)
         return True
 
@@ -978,11 +996,23 @@ class Scheduler:
         self.handle_scheduling_failure(fw, qpi, Status.unschedulable(reason), None)
         return True
 
+    def park_waiting_pod(self, fw, state, qpi, result) -> None:
+        """Park a WAITing pod and arm the expiry timer (WaitOnPermit)."""
+        deadline = self.now() + self.permit_wait_timeout
+        self.waiting_pods[qpi.pod.uid] = (fw, state, qpi, result, deadline)
+        if deadline < self._next_wait_deadline:
+            self._next_wait_deadline = deadline
+
+    def _rearm_wait_deadline(self) -> None:
+        self._next_wait_deadline = min(
+            (e[4] for e in self.waiting_pods.values()), default=float("inf"))
+
     def flush_expired_waiters(self) -> int:
         now = self.now()
         expired = [uid for uid, e in self.waiting_pods.items() if e[4] <= now]
         for uid in expired:
             self.reject_waiting_pod(uid, "permit wait timed out")
+        self._rearm_wait_deadline()
         return len(expired)
 
     def update_pending_metrics(self) -> None:
@@ -1008,4 +1038,8 @@ class Scheduler:
             qpi.pending_plugins |= diagnosis.pending_plugins
         if status.code == UNSCHEDULABLE_AND_UNRESOLVABLE and not qpi.unschedulable_plugins:
             qpi.unschedulable_plugins.add(status.plugin or "unknown")
+        pod = qpi.pod
+        self.recorder.eventf(
+            f"{pod.namespace}/{pod.name}", "Warning", "FailedScheduling",
+            status.message())
         self.queue.add_unschedulable_if_not_present(qpi)

@@ -1,0 +1,88 @@
+"""Lightweight scheduling-step tracing + event recording.
+
+The reference logs any scheduling step that exceeds 100ms through utiltrace
+(schedule_one.go:574-575) and emits API Events per scheduling outcome
+(EventRecorder, schedule_one.go:1138). This module is the framework's
+equivalent: a per-cycle trace with a slow-step threshold wired to Python
+logging (structured key=value formatting, klog-style), plus a bounded
+in-memory event recorder the server can expose.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("kubernetes_tpu")
+
+SLOW_STEP_THRESHOLD_S = 0.1  # schedule_one.go:574 — log any step > 100ms
+
+
+class StepTrace:
+    """utiltrace.New analogue: one trace per scheduling attempt; steps are
+    recorded with durations and the whole trace is logged when it crosses
+    the threshold."""
+
+    __slots__ = ("name", "fields", "t0", "steps", "_last")
+
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.t0 = time.perf_counter()
+        self._last = self.t0
+        self.steps: List[Tuple[str, float]] = []
+
+    def step(self, msg: str) -> None:
+        now = time.perf_counter()
+        self.steps.append((msg, now - self._last))
+        self._last = now
+
+    def log_if_long(self, threshold: float = SLOW_STEP_THRESHOLD_S) -> float:
+        total = time.perf_counter() - self.t0
+        if total > threshold:
+            kv = " ".join(f"{k}={v}" for k, v in self.fields.items())
+            parts = "; ".join(f"{m}: {d*1000:.0f}ms" for m, d in self.steps)
+            logger.warning("slow scheduling step: %s %s total=%.0fms (%s)",
+                           self.name, kv, total * 1000, parts)
+        return total
+
+
+@dataclass
+class Event:
+    """A minimal core/v1 Event (reason + message + involved object)."""
+
+    object_key: str
+    reason: str
+    message: str
+    type: str = "Normal"
+    count: int = 1
+    timestamp: float = field(default_factory=time.time)
+
+
+class EventRecorder:
+    """EventRecorder (client-go tools/record) analogue: bounded buffer with
+    reference-style aggregation by (object, reason)."""
+
+    def __init__(self, capacity: int = 1000):
+        self.events: Deque[Event] = deque(maxlen=capacity)
+        self._agg: Dict[Tuple[str, str], Event] = {}
+
+    def eventf(self, object_key: str, event_type: str, reason: str,
+               message: str) -> None:
+        key = (object_key, reason)
+        existing = self._agg.get(key)
+        if existing is not None and existing in self.events:
+            existing.count += 1
+            existing.message = message
+            existing.timestamp = time.time()
+            return
+        ev = Event(object_key=object_key, reason=reason, message=message,
+                   type=event_type)
+        self._agg[key] = ev
+        self.events.append(ev)
+
+    def for_object(self, object_key: str) -> List[Event]:
+        return [e for e in self.events if e.object_key == object_key]

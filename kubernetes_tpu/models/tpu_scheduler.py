@@ -510,9 +510,8 @@ class TPUScheduler(Scheduler):
             # WaitOnPermit (framework.go:2097): park exactly as process_one
             # does — the pod stays assumed on the node, so the device carry
             # remains correct (no divergence).
-            self.waiting_pods[pod.uid] = (
-                fw, state, qpi, ScheduleResult(suggested_host=node_name),
-                self.now() + self.permit_wait_timeout)
+            self.park_waiting_pod(
+                fw, state, qpi, ScheduleResult(suggested_host=node_name))
             self.queue.done(pod.uid)
             # Not counted in device_scheduled yet: the bind outcome is only
             # known when the waiter is allowed/rejected.
