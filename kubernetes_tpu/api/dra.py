@@ -21,6 +21,7 @@ from .types import _next_uid
 class Device:
     name: str
     attributes: Dict[str, str] = field(default_factory=dict)
+    capacity: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -49,6 +50,9 @@ class DeviceRequest:
     device_class: str = ""
     count: int = 1
     selectors: Dict[str, str] = field(default_factory=dict)
+    # CEL-equivalent device selector (compile_device_expression below);
+    # evaluated per candidate device in addition to the equality selectors.
+    expression: str = ""
 
 
 @dataclass
@@ -82,3 +86,90 @@ class ResourceClaim:
     @property
     def allocated(self) -> bool:
         return bool(self.allocated_node)
+
+
+# ---------------------------------------------------------------------------
+# Device selection expressions — the structured-parameters CEL equivalent
+# (staging dynamic-resource-allocation/cel; resource.k8s.io DeviceSelector
+# `cel.expression`). A restricted Python-syntax expression evaluated per
+# device with the same surface the reference exposes:
+#
+#     device.attributes["gpu.example.com/model"] == "a100"
+#     device.capacity["memory"] >= 40 and device.driver == "gpu.example.com"
+#
+# The AST is validated against a whitelist (comparisons, boolean logic,
+# arithmetic, subscripts on device.attributes/capacity, literals) — no
+# calls, no imports, no dunder access. Parse once per request, evaluate per
+# device (the reference compiles CEL programs the same way).
+# ---------------------------------------------------------------------------
+
+import ast as _ast
+
+_ALLOWED_NODES = (
+    _ast.Expression, _ast.BoolOp, _ast.And, _ast.Or, _ast.UnaryOp, _ast.Not,
+    _ast.USub, _ast.Compare, _ast.Eq, _ast.NotEq, _ast.Lt, _ast.LtE, _ast.Gt,
+    _ast.GtE, _ast.In, _ast.NotIn, _ast.BinOp, _ast.Add, _ast.Sub, _ast.Mult,
+    _ast.Div, _ast.Mod, _ast.Constant, _ast.Name, _ast.Load, _ast.Attribute,
+    _ast.Subscript, _ast.Index, _ast.Tuple, _ast.List,
+)
+
+
+class ExpressionError(ValueError):
+    """Invalid or disallowed device selector expression."""
+
+
+def compile_device_expression(expr: str):
+    """Validate + compile a device selector expression. Returns a callable
+    (device, driver) -> bool. Raises ExpressionError on disallowed syntax."""
+    try:
+        tree = _ast.parse(expr, mode="eval")
+    except SyntaxError as e:
+        raise ExpressionError(f"invalid expression: {e}") from e
+    for node in _ast.walk(tree):
+        if not isinstance(node, _ALLOWED_NODES):
+            raise ExpressionError(
+                f"disallowed syntax {type(node).__name__!r} in device expression")
+        if isinstance(node, _ast.Name) and node.id != "device":
+            raise ExpressionError(f"unknown identifier {node.id!r}")
+        if isinstance(node, _ast.Attribute):
+            if node.attr.startswith("__") or node.attr not in (
+                    "attributes", "capacity", "driver", "name"):
+                raise ExpressionError(f"unknown device field {node.attr!r}")
+    code = compile(tree, "<device-selector>", "eval")
+
+    class _DeviceView:
+        __slots__ = ("attributes", "capacity", "driver", "name")
+
+        def __init__(self, device, driver):
+            self.attributes = _CoercingMap(device.attributes)
+            self.capacity = _CoercingMap(getattr(device, "capacity", {}) or {})
+            self.driver = driver
+            self.name = device.name
+
+    def matcher(device, driver="") -> bool:
+        try:
+            return bool(eval(code, {"__builtins__": {}},  # noqa: S307 - AST-whitelisted
+                             {"device": _DeviceView(device, driver)}))
+        except Exception:
+            # CEL runtime errors make the device non-matching (the reference
+            # treats evaluation errors as "does not satisfy selector").
+            return False
+
+    return matcher
+
+
+class _CoercingMap(dict):
+    """Attribute/capacity map that compares numerically when both sides are
+    numeric (quantity semantics: "40" >= 32 must hold)."""
+
+    def __getitem__(self, key):
+        v = dict.get(self, key)
+        if isinstance(v, str):
+            try:
+                return int(v)
+            except ValueError:
+                try:
+                    return float(v)
+                except ValueError:
+                    return v
+        return v

@@ -333,6 +333,13 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
             # (GenericWorkload-gated in the reference).
             from ..core.registry import gang_placement_profiles
             sched = TPUScheduler(profile_factory=gang_placement_profiles)
+        elif any(op.get("opcode") == "createResourceSlices" for op in wl.ops):
+            # DRA workloads need the DynamicResources plugin
+            # (DynamicResourceAllocation-gated in the reference).
+            from ..core.registry import DEFAULT_PLUGINS, build_framework
+            plugins = DEFAULT_PLUGINS + (("DynamicResources", 0),)
+            sched = TPUScheduler(profile_factory=lambda h: {
+                "default-scheduler": build_framework(h, plugins=plugins)})
         else:
             sched = TPUScheduler()
     cs = sched.clientset
@@ -347,9 +354,23 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
 
     def _create_pods(op, tpl, namespace, count):
         nonlocal pod_seq
+        claim_tpl = tpl.get("resourceClaimTemplate")
         batch = []
         for _ in range(count):
             p = _make_pod_from_template(f"pod-{pod_seq}", tpl, namespace=namespace)
+            if claim_tpl:
+                # resourceClaimTemplate: one generated claim per pod
+                # (dra/performance-config.yaml SchedulingWithResourceClaimTemplate)
+                from ..api.dra import DeviceRequest, ResourceClaim
+                cname = f"{p.name}-claim"
+                cs.create_resource_claim(ResourceClaim(
+                    name=cname, namespace=namespace,
+                    requests=[DeviceRequest(
+                        name="req",
+                        count=int(claim_tpl.get("count", 1)),
+                        selectors=dict(claim_tpl.get("selectors", {})),
+                        expression=claim_tpl.get("expression", ""))]))
+                p.resource_claims = [cname]
             pod_seq += 1
             cs.create_pod(p)
             batch.append(p)
@@ -453,6 +474,19 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
             collector.start()
         elif opcode == "stopCollectingMetrics":
             result.metrics["SchedulingThroughput"] = collector.stop()
+        elif opcode == "createResourceSlices":
+            # One slice per node with N devices (dra configs' resource-slice
+            # prep; devices get a model attribute for selector exercises).
+            from ..api.dra import Device, ResourceSlice
+            count = _resolve_count(op, params)
+            per_node = int(op.get("devicesPerNode", 4))
+            driver = op.get("driver", "gpu.example.com")
+            for i in range(count):
+                cs.create_resource_slice(ResourceSlice(
+                    node_name=f"node-{i}", driver=driver,
+                    devices=[Device(name=f"node-{i}-dev{j}",
+                                    attributes={"model": "a100", "index": str(j)})
+                             for j in range(per_node)]))
         elif opcode == "allocResourceClaims":
             # DRA pre-allocation (dra/performance-config.yaml): allocate all
             # pending claims against the current ResourceSlices.

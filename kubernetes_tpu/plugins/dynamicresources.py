@@ -100,6 +100,20 @@ class DynamicResources:
                 sel.update(dc.selectors)
         return sel
 
+    @staticmethod
+    def _matcher_for(req):
+        """Compiled expression selector, memoized on the request object
+        (dynamic-resource-allocation/cel compiles each CEL program once)."""
+        expr = getattr(req, "expression", "")
+        if not expr:
+            return None
+        cached = getattr(req, "_compiled_expr", None)
+        if cached is None:
+            from ..api.dra import compile_device_expression
+            cached = compile_device_expression(expr)
+            req._compiled_expr = cached
+        return cached
+
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         s: Optional[DynamicResources._State] = state.read(self._KEY)
         if s is None:
@@ -126,10 +140,14 @@ class DynamicResources:
                         key = (sl.driver, dev.name)
                         if key in taken or (node_name, sl.driver, dev.name) in in_use:
                             continue
-                        if all(dev.attributes.get(k) == v for k, v in sel.items()):
-                            devices.append(AllocatedDevice(sl.driver, dev.name))
-                            taken.add(key)
-                            found += 1
+                        if not all(dev.attributes.get(k) == v for k, v in sel.items()):
+                            continue
+                        matcher = self._matcher_for(req)
+                        if matcher is not None and not matcher(dev, sl.driver):
+                            continue
+                        devices.append(AllocatedDevice(sl.driver, dev.name))
+                        taken.add(key)
+                        found += 1
                 if found < req.count:
                     return Status.unschedulable(ERR_NO_DEVICES)
             allocations.append((claim, devices))
@@ -204,10 +222,16 @@ def allocate_pending_claims(clientset) -> int:
                         key = (sl.driver, dev.name)
                         if key in taken or (node_name, sl.driver, dev.name) in used:
                             continue
-                        if all(dev.attributes.get(k) == v for k, v in sel.items()):
-                            devices.append(AllocatedDevice(sl.driver, dev.name))
-                            taken.add(key)
-                            found += 1
+                        if not all(dev.attributes.get(k) == v for k, v in sel.items()):
+                            continue
+                        expr = getattr(req, "expression", "")
+                        if expr:
+                            from ..api.dra import compile_device_expression
+                            if not compile_device_expression(expr)(dev, sl.driver):
+                                continue
+                        devices.append(AllocatedDevice(sl.driver, dev.name))
+                        taken.add(key)
+                        found += 1
                 if found < req.count:
                     ok = False
                     break

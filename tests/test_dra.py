@@ -99,3 +99,75 @@ class TestDynamicResources:
         s.clientset.create_pod(p)
         s.run_until_idle()
         assert s.scheduled == 0
+
+
+class TestExpressionSelectors:
+    """Structured parameters with CEL-equivalent device selector expressions
+    (staging dynamic-resource-allocation/cel; DeviceSelector.cel.expression)."""
+
+    def _cluster(self):
+        from kubernetes_tpu.api.dra import Device, ResourceSlice
+        from kubernetes_tpu.testing.wrappers import make_node
+        s = _dra_sched()
+        cs = s.clientset
+        for i in range(4):
+            cs.create_node(make_node().name(f"n{i}").capacity(
+                {"cpu": 8, "memory": "32Gi", "pods": 110}).obj())
+            model = "a100" if i % 2 == 0 else "t4"
+            cs.create_resource_slice(ResourceSlice(
+                node_name=f"n{i}", driver="gpu.example.com",
+                devices=[Device(name=f"gpu-{i}-{j}",
+                                attributes={"model": model, "mem": "40" if model == "a100" else "16"})
+                         for j in range(2)]))
+        return cs, s
+
+    def test_expression_picks_matching_devices(self):
+        from kubernetes_tpu.api.dra import DeviceRequest, ResourceClaim
+        from kubernetes_tpu.testing.wrappers import make_pod
+        cs, s = self._cluster()
+        claim = ResourceClaim(name="big-gpu", requests=[DeviceRequest(
+            name="gpu", count=1,
+            expression='device.attributes["model"] == "a100" and device.attributes["mem"] >= 32')])
+        cs.create_resource_claim(claim)
+        p = make_pod().name("train").req({"cpu": "1"}).obj()
+        p.resource_claims = ["big-gpu"]
+        cs.create_pod(p)
+        s.run_until_idle()
+        assert p.node_name in ("n0", "n2"), p.node_name  # a100 nodes only
+        assert claim.allocated and claim.allocated_node == p.node_name
+
+    def test_expression_no_match_unschedulable(self):
+        from kubernetes_tpu.api.dra import DeviceRequest, ResourceClaim
+        from kubernetes_tpu.testing.wrappers import make_pod
+        cs, s = self._cluster()
+        claim = ResourceClaim(name="h100", requests=[DeviceRequest(
+            name="gpu", count=1,
+            expression='device.attributes["model"] == "h100"')])
+        cs.create_resource_claim(claim)
+        p = make_pod().name("train").req({"cpu": "1"}).obj()
+        p.resource_claims = ["h100"]
+        cs.create_pod(p)
+        s.run_until_idle()
+        assert not p.node_name and s.failures >= 1
+
+    def test_alloc_claims_opcode_respects_expressions(self):
+        from kubernetes_tpu.api.dra import DeviceRequest, ResourceClaim
+        from kubernetes_tpu.plugins.dynamicresources import allocate_pending_claims
+        cs, s = self._cluster()
+        for i in range(3):
+            cs.create_resource_claim(ResourceClaim(
+                name=f"c{i}", requests=[DeviceRequest(
+                    name="gpu", count=1,
+                    expression='device.attributes["model"] == "t4"')]))
+        n = allocate_pending_claims(cs)
+        assert n == 3
+        nodes = {cs.resource_claims[f"default/c{i}"].allocated_node for i in range(3)}
+        assert nodes <= {"n1", "n3"}
+
+    def test_disallowed_expression_rejected(self):
+        import pytest
+        from kubernetes_tpu.api.dra import ExpressionError, compile_device_expression
+        for bad in ('__import__("os").system("true")', 'open("/etc/passwd")',
+                    'device.__class__', 'x + 1'):
+            with pytest.raises(ExpressionError):
+                compile_device_expression(bad)
