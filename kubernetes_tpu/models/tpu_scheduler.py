@@ -132,9 +132,7 @@ class TPUScheduler(Scheduler):
             nxt = self._pop()
             if nxt is None:
                 break
-            if (not isinstance(nxt, QueuedPodGroupInfo)
-                    and nxt.pod.scheduler_name == head.pod.scheduler_name
-                    and fw.sign_pod(nxt.pod) == sig):
+            if self._session_compatible(nxt, fw, sig):
                 batch.append(nxt)
             else:
                 self._holdover = nxt
@@ -276,7 +274,15 @@ class TPUScheduler(Scheduler):
             return False
         return (head.pod.scheduler_name in self.profiles
                 and self.framework_for_pod(head.pod) is fw
-                and fw.sign_pod(head.pod) == sig)
+                and fw.sign_pod(head.pod) == sig
+                # Signatures only cover the Sign plugins; a member with a
+                # feature outside the kernel (PVC volumes, DRA claims) shares
+                # the head's signature but must NOT ride the device — it
+                # would silently skip that feature's filters.
+                and batch_supported(
+                    head.pod, self.snapshot,
+                    fit_plugin=fw.plugin("NodeResourcesFit"),
+                    ba_plugin=fw.plugin("NodeResourcesBalancedAllocation")) is None)
 
     def _collect_session_batch(self, fw: Framework, sig) -> List[QueuedPodInfo]:
         """Pop up to max_batch pods matching the session signature; an
