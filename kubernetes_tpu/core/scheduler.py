@@ -455,12 +455,20 @@ class Scheduler:
         trace = StepTrace("Scheduling", pod=f"{pod.namespace}/{pod.name}")
         state = CycleState()
         try:
+            self._process_one_traced(fw, state, qpi, trace, t0)
+        finally:
+            # utiltrace logs via defer: slow cycles are reported on EVERY
+            # outcome — bound, unschedulable, Permit WAIT, or error.
+            trace.log_if_long()
+
+    def _process_one_traced(self, fw, state, qpi, trace, t0) -> None:
+        pod = qpi.pod
+        try:
             result = self.scheduling_cycle(fw, state, qpi)
             trace.step("scheduling cycle done")
         except FitError as fe:
             self.handle_fit_error(fw, state, qpi, fe, t0)
             trace.step("unschedulable")
-            trace.log_if_long()
             return
         except Exception as e:  # noqa: BLE001
             self.error_log.append(f"{pod.namespace}/{pod.name}: {e!r}")
@@ -478,7 +486,6 @@ class Scheduler:
         bound = self.run_binding_cycle(fw, state, qpi, result)
         self.queue.done(pod.uid)
         trace.step("binding cycle done")
-        trace.log_if_long()
         elapsed = time.perf_counter() - t0
         self.metrics.schedule_attempts.inc("scheduled" if bound else "error", fw.profile_name)
         self.metrics.scheduling_attempt_duration.observe(

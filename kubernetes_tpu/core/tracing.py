@@ -60,11 +60,15 @@ class Event:
     type: str = "Normal"
     count: int = 1
     timestamp: float = field(default_factory=time.time)
+    evicted: bool = False
 
 
 class EventRecorder:
     """EventRecorder (client-go tools/record) analogue: bounded buffer with
-    reference-style aggregation by (object, reason)."""
+    reference-style aggregation by (object, reason). The aggregation index
+    is pruned in step with deque eviction, so memory stays O(capacity) and
+    every eventf is O(1) — this runs once per scheduled pod on a path
+    benchmarked at >10k pods/s."""
 
     def __init__(self, capacity: int = 1000):
         self.events: Deque[Event] = deque(maxlen=capacity)
@@ -74,13 +78,19 @@ class EventRecorder:
                message: str) -> None:
         key = (object_key, reason)
         existing = self._agg.get(key)
-        if existing is not None and existing in self.events:
+        if existing is not None and not existing.evicted:
             existing.count += 1
             existing.message = message
             existing.timestamp = time.time()
             return
         ev = Event(object_key=object_key, reason=reason, message=message,
                    type=event_type)
+        if self.events.maxlen and len(self.events) == self.events.maxlen:
+            old = self.events[0]  # about to be evicted by the append
+            old.evicted = True
+            okey = (old.object_key, old.reason)
+            if self._agg.get(okey) is old:
+                del self._agg[okey]
         self._agg[key] = ev
         self.events.append(ev)
 

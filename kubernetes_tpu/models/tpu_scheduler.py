@@ -44,9 +44,11 @@ class TPUScheduler(Scheduler):
     path for uncovered features; host and device paths produce identical
     assignments (deterministic_ties is forced on)."""
 
-    def __init__(self, *args, max_batch: Optional[int] = None, **kwargs):
+    def __init__(self, *args, max_batch: Optional[int] = None, mesh="auto",
+                 **kwargs):
         kwargs.setdefault("deterministic_ties", True)
         super().__init__(*args, **kwargs)
+        self._mesh_arg = mesh
         from ..core.features import TPU_BATCH_SCHEDULING
         self.device_enabled = self.gates.enabled(TPU_BATCH_SCHEDULING)
         self.max_batch = max_batch if max_batch is not None else self.config.max_batch
@@ -60,13 +62,16 @@ class TPUScheduler(Scheduler):
         # collectives — parallelize/parallelism.go:28's scale axis, done the
         # scaling-book way). Single chip runs unsharded, zero overhead.
         self.mesh = None
-        try:
-            import jax
-            if len(jax.devices()) > 1:
-                from ..parallel import make_mesh
-                self.mesh = make_mesh(n_cells=1)
-        except Exception:  # noqa: BLE001 - backend probing must never kill init
-            self.mesh = None
+        if mesh == "auto":
+            try:
+                import jax
+                if len(jax.devices()) > 1:
+                    from ..parallel import make_mesh
+                    self.mesh = make_mesh(n_cells=1)
+            except Exception:  # noqa: BLE001 - probing must never kill init
+                self.mesh = None
+        else:
+            self.mesh = mesh  # explicit Mesh, or None to force single-device
         self.mirror = NodeStateMirror()
         self._holdover: Optional[QueuedPodInfo] = None
         # metrics

@@ -347,6 +347,7 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
     params = wl.params
     pod_seq = 0
     node_seq = 0
+    created_nodes: List[str] = []
     result = PerfResult(workload=wl)
     tickers: List = []
     created_pods: Dict[str, List] = {}  # namespace -> pods (deletePods targets)
@@ -387,13 +388,14 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
                 # so multi-count named ops get an index suffix.
                 for i in range(count):
                     t = dict(tpl, name=tpl["name"] if count == 1 else f"{tpl['name']}-{i}")
-                    cs.create_node(_make_node_from_template(i, t))
+                    created_nodes.append(cs.create_node(_make_node_from_template(i, t)).name)
             else:
                 # Continue the node name sequence across ops: a second
                 # unnamed createNodes in the same workload must not overwrite
                 # the first op's node-<i> names.
                 for i in range(count):
-                    cs.create_node(_make_node_from_template(node_seq + i, tpl))
+                    created_nodes.append(
+                        cs.create_node(_make_node_from_template(node_seq + i, tpl)).name)
                 node_seq += count
         elif opcode == "createNamespaces":
             count = _resolve_count(op, params) if ("count" in op or "countParam" in op) else 1
@@ -477,14 +479,17 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
         elif opcode == "createResourceSlices":
             # One slice per node with N devices (dra configs' resource-slice
             # prep; devices get a model attribute for selector exercises).
+            # Slices attach to the MOST RECENTLY created `count` nodes — the
+            # dra configs create the DRA nodes immediately before this op.
             from ..api.dra import Device, ResourceSlice
             count = _resolve_count(op, params)
             per_node = int(op.get("devicesPerNode", 4))
             driver = op.get("driver", "gpu.example.com")
-            for i in range(count):
+            targets = created_nodes[-count:]
+            for name in targets:
                 cs.create_resource_slice(ResourceSlice(
-                    node_name=f"node-{i}", driver=driver,
-                    devices=[Device(name=f"node-{i}-dev{j}",
+                    node_name=name, driver=driver,
+                    devices=[Device(name=f"{name}-dev{j}",
                                     attributes={"model": "a100", "index": str(j)})
                              for j in range(per_node)]))
         elif opcode == "allocResourceClaims":

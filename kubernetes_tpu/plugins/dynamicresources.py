@@ -214,6 +214,7 @@ def allocate_pending_claims(clientset) -> int:
                     dc = clientset.device_classes.get(req.device_class)
                     if dc is not None:
                         sel.update(dc.selectors)
+                matcher = DynamicResources._matcher_for(req)  # compiled once
                 found = 0
                 for sl in slices:
                     for dev in sl.devices:
@@ -224,11 +225,8 @@ def allocate_pending_claims(clientset) -> int:
                             continue
                         if not all(dev.attributes.get(k) == v for k, v in sel.items()):
                             continue
-                        expr = getattr(req, "expression", "")
-                        if expr:
-                            from ..api.dra import compile_device_expression
-                            if not compile_device_expression(expr)(dev, sl.driver):
-                                continue
+                        if matcher is not None and not matcher(dev, sl.driver):
+                            continue
                         devices.append(AllocatedDevice(sl.driver, dev.name))
                         taken.add(key)
                         found += 1
