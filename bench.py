@@ -39,12 +39,12 @@ def build_cluster(n_nodes: int, zones: int = 50):
 
 def make_pods(n, name_prefix):
     from kubernetes_tpu.testing import make_pod
-    return [
-        make_pod().name(f"{name_prefix}-{i}")
-        .req({"cpu": "100m", "memory": "128Mi"}).labels({"app": name_prefix})
-        .obj()
-        for i in range(n)
-    ]
+    # One template prototype, N identity clones sharing spec + signature memo
+    # (the reference perf harness stamps pods from a podTemplate the same way).
+    proto = (make_pod().name("proto")
+             .req({"cpu": "100m", "memory": "128Mi"}).labels({"app": name_prefix})
+             .obj())
+    return [proto.clone_from_template(f"{name_prefix}-{i}") for i in range(n)]
 
 
 def main():

@@ -344,6 +344,32 @@ class Pod:
         new.__dict__.update(self.__dict__)
         return new
 
+    def clone_from_template(self, name: str) -> "Pod":
+        """Stamp a new pod from this template prototype: a fresh identity
+        (name/uid/resourceVersion) over SHARED spec objects, plus a shared
+        signature-memo holder so a workload of N template pods computes its
+        scheduling signature once, not N times (Framework.sign_pod).
+
+        Mirrors how the reference perf harness stamps pods from a
+        `podTemplate` (scheduler_perf.go createPodsOp → template copy with a
+        generated name). Invariant required of callers: spec objects (labels,
+        containers, tolerations, affinity, ...) are never mutated in place —
+        the same invariant Framework.sign_pod memoization relies on."""
+        shared = self.__dict__.get("_sig_shared")
+        if shared is None:
+            shared = self._sig_shared = {}
+            # Prime the derived-spec memos once so every clone inherits them
+            # instead of recomputing per instance (resource folding is ~5µs
+            # and runs twice per pod on the enqueue+assume path).
+            self.resource_request()
+            self.host_ports()
+        new = object.__new__(Pod)
+        new.__dict__.update(self.__dict__)
+        new.name = name
+        new.uid = _next_uid("pod")
+        new.resource_version = 0
+        return new
+
     def required_node_selector_matches(self, node: "Node") -> bool:
         """nodeSelector AND requiredDuringScheduling node affinity
         (component-helpers nodeaffinity GetRequiredNodeAffinity)."""

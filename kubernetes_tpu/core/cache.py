@@ -166,12 +166,14 @@ class Cache:
 
     # -- pods --------------------------------------------------------------
 
-    def assume_pod(self, pod: Pod) -> None:
+    def assume_pod(self, pod: Pod, pod_info: Optional[PodInfo] = None) -> None:
         """AssumePod (cache.go): optimistically place the pod on its node
-        before the bind API call completes."""
+        before the bind API call completes. `pod_info` lets callers reuse the
+        queue entity's precomputed PodInfo (QueuedPodInfo.pod_info) instead
+        of re-deriving it — this runs once per scheduled pod."""
         if pod.uid in self.pod_states:
             raise ValueError(f"pod {pod.uid} is already assumed/added")
-        self._add_pod_to_node(pod)
+        self._add_pod_to_node(pod, pod_info)
         self.assumed_pods.add(pod.uid)
         self.pod_states[pod.uid] = _PodState(pod)
 
@@ -238,7 +240,7 @@ class Cache:
                 self.assumed_pods.discard(uid)
                 del self.pod_states[uid]
 
-    def _add_pod_to_node(self, pod: Pod) -> None:
+    def _add_pod_to_node(self, pod: Pod, pod_info: Optional[PodInfo] = None) -> None:
         ni = self.nodes.get(pod.node_name)
         if ni is None:
             # Pod on unknown node: create a placeholder NodeInfo (reference
@@ -247,7 +249,9 @@ class Cache:
             self.nodes[pod.node_name] = ni
             self._imaginary.append(pod.node_name)
             self._order_dirty = True
-        ni.add_pod(PodInfo.of(pod))
+        if pod_info is None or pod_info.pod is not pod:
+            pod_info = PodInfo.of(pod)
+        ni.add_pod(pod_info)
         self._dirty.add(pod.node_name)
 
     def _remove_pod_from_node(self, pod: Pod) -> None:

@@ -9,6 +9,7 @@ of apiserver watch streams collapsed to function calls.
 from __future__ import annotations
 
 import copy
+import itertools
 from typing import Callable, Dict, List, Optional
 
 from ..api.storage import CSINode, PersistentVolume, PersistentVolumeClaim, StorageClass
@@ -34,7 +35,11 @@ class FakeClientset:
         self._namespace_handlers: List = []
         self._pod_group_handlers: List = []
         self._storage_handlers: List = []
-        self._rv = 0
+        # Monotonic resourceVersion. itertools.count is C-implemented and
+        # GIL-atomic: a concurrent client thread (perf harness creators, the
+        # threaded watch transport) can write while the scheduling loop
+        # binds, without ever minting duplicate versions.
+        self._rv_counter = itertools.count(1)
 
     # -- informer-ish registration ----------------------------------------
 
@@ -67,8 +72,7 @@ class FakeClientset:
     # -- writes ------------------------------------------------------------
 
     def create_node(self, node: Node) -> Node:
-        self._rv += 1
-        node.resource_version = self._rv
+        node.resource_version = next(self._rv_counter)
         self.nodes[node.name] = node
         for h in self._node_handlers:
             h("add", None, node)
@@ -76,8 +80,7 @@ class FakeClientset:
 
     def update_node(self, node: Node) -> Node:
         old = self.nodes.get(node.name)
-        self._rv += 1
-        node.resource_version = self._rv
+        node.resource_version = next(self._rv_counter)
         self.nodes[node.name] = node
         for h in self._node_handlers:
             h("update", old, node)
@@ -159,8 +162,7 @@ class FakeClientset:
         pvc.volume_name = provisioned.name
 
     def create_pod(self, pod: Pod) -> Pod:
-        self._rv += 1
-        pod.resource_version = self._rv
+        pod.resource_version = next(self._rv_counter)
         self.pods[pod.uid] = pod
         for h in self._pod_handlers:
             h("add", None, pod)
@@ -168,8 +170,7 @@ class FakeClientset:
 
     def update_pod(self, pod: Pod) -> Pod:
         old = self.pods.get(pod.uid)
-        self._rv += 1
-        pod.resource_version = self._rv
+        pod.resource_version = next(self._rv_counter)
         self.pods[pod.uid] = pod
         for h in self._pod_handlers:
             h("update", old, pod)
@@ -187,8 +188,7 @@ class FakeClientset:
             if p.deletion_ts is None:
                 import time as _t
                 p.deletion_ts = _t.time()
-                self._rv += 1
-                p.resource_version = self._rv
+                p.resource_version = next(self._rv_counter)
                 for h in self._pod_handlers:
                     h("update", p, p)
             return
@@ -215,8 +215,7 @@ class FakeClientset:
         old = stored
         new = copy.copy(stored)
         new.node_name = node_name
-        self._rv += 1
-        new.resource_version = self._rv
+        new.resource_version = next(self._rv_counter)
         self.pods[pod.uid] = new
         self.bindings[pod.uid] = node_name
         for h in self._pod_handlers:

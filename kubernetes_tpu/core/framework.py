@@ -82,6 +82,10 @@ class Status:
 
 OK = Status()
 
+# Distinguishes "memoized as unsignable (None)" from "not memoized" in the
+# template-shared signature holder (sign_pod).
+_SIG_MISS = object()
+
 
 # ---------------------------------------------------------------------------
 # CycleState (pkg/scheduler/framework/cycle_state.go)
@@ -558,12 +562,26 @@ class Framework:
     def sign_pod(self, pod: Pod) -> Optional[tuple]:
         """Pod signature for batch reuse (staging framework/signers.go /
         interface.go:774 SignPlugin). None => unsignable (never batched).
-        Memoized per (pod identity, resource_version): batch collection signs
-        every popped pod, and the spec can't change without a version bump."""
-        key = (id(self), pod.resource_version)
-        cached = getattr(pod, "_sig_cache", None)
-        if cached is not None and cached[0] == key:
-            return cached[1]
+
+        Memoized two ways:
+        - per pod object, keyed by (framework, node_name): pod SPEC objects
+          are immutable in place (updates replace the pod object through the
+          watch path), and node_name is the only signed field the scheduler
+          mutates in place (assume/unwind);
+        - per TEMPLATE, when the pod carries a `_sig_shared` holder
+          (Pod.clone_from_template): all clones share one memo, so a
+          workload of N identical pods signs once, not N times.
+        """
+        key = (id(self), pod.node_name)
+        shared = getattr(pod, "_sig_shared", None)
+        if shared is not None:
+            hit = shared.get(key, _SIG_MISS)
+            if hit is not _SIG_MISS:
+                return hit
+        else:
+            cached = getattr(pod, "_sig_cache", None)
+            if cached is not None and cached[0] == key:
+                return cached[1]
         sig = []
         out: Optional[tuple] = None
         for p in self.sign_plugins:
@@ -573,5 +591,8 @@ class Framework:
             sig.append((p.name, part))
         else:
             out = tuple(sig) if sig else None
-        pod._sig_cache = (key, out)
+        if shared is not None:
+            shared[key] = out
+        else:
+            pod._sig_cache = (key, out)
         return out

@@ -318,7 +318,7 @@ class PriorityQueue:
     def add(self, pod: Pod) -> None:
         """Add (scheduling_queue.go:858) — new pending pod."""
         qpi = self._new_qpi(pod)
-        if self.framework is not None:
+        if self.framework is not None and self.framework.pre_enqueue_plugins:
             st = self.framework.run_pre_enqueue_plugins(pod)
             if not st.is_success():
                 qpi.gated = True

@@ -279,7 +279,6 @@ class Scheduler:
         # are atomic under the GIL, so no lock is needed.
         from collections import deque
         self._event_inbox = deque()
-        self._loop_thread = threading.current_thread()
         self._wire_event_handlers()
 
     # -- event handlers (eventhandlers.go:624 addAllEventHandlers) ---------
@@ -306,8 +305,10 @@ class Scheduler:
         (client-go delta_fifo.go): cache/queue mutation stays single-threaded.
         Events raised on the scheduling thread dispatch inline, preserving the
         synchronous semantics tests rely on."""
+        loop_ident = threading.get_ident()  # get_ident beats current_thread
+
         def dispatch(*args):
-            if threading.current_thread() is self._loop_thread:
+            if threading.get_ident() == loop_ident:
                 handler(*args)
             else:
                 self._event_inbox.append((handler, args))
@@ -522,7 +523,7 @@ class Scheduler:
         # assume (schedule_one.go:1060): in-memory commit before binding
         assumed = pod
         assumed.node_name = result.suggested_host
-        self.cache.assume_pod(assumed)
+        self.cache.assume_pod(assumed, qpi.pod_info)
         st = fw.run_reserve_plugins_reserve(state, assumed, result.suggested_host)
         if not st.is_success():
             fw.run_reserve_plugins_unreserve(state, assumed, result.suggested_host)
@@ -942,15 +943,17 @@ class Scheduler:
         """Returns True iff the pod was bound (False: unwound + requeued)."""
         pod = qpi.pod
         node_name = result.suggested_host
-        st = fw.run_pre_bind_plugins(state, pod, node_name)
-        if not st.is_success():
-            self._unwind_binding(fw, state, qpi, node_name, st)
-            return False
+        if fw.pre_bind_plugins:
+            st = fw.run_pre_bind_plugins(state, pod, node_name)
+            if not st.is_success():
+                self._unwind_binding(fw, state, qpi, node_name, st)
+                return False
         # Extender bind delegation (schedule_one.go:1100 bind: an interested
         # extender with a bind verb binds instead of the bind plugins).
         bind_ext = next(
             (e for e in self.extenders
-             if e.supports_bind() and e.is_interested(pod)), None)
+             if e.supports_bind() and e.is_interested(pod)), None) \
+            if self.extenders else None
         if bind_ext is not None:
             err = bind_ext.bind(pod, node_name)
             st = Status() if err is None else Status.error(err)
@@ -963,8 +966,9 @@ class Scheduler:
         self.queue.nominator.delete_nominated_pod(pod)
         self.scheduled += 1
         self.recorder.eventf(
-            f"{pod.namespace}/{pod.name}", "Normal", "Scheduled",
-            f"Successfully assigned {pod.namespace}/{pod.name} to {node_name}")
+            pod.namespace + "/" + pod.name, "Normal", "Scheduled",
+            ("Successfully assigned %s/%s to %s",
+             (pod.namespace, pod.name, node_name)))
         fw.run_post_bind_plugins(state, pod, node_name)
         return True
 

@@ -31,6 +31,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..core.framework import OK as _OK_STATUS
 from ..core.framework import WAIT, Framework
 from ..core.queue import QueuedPodGroupInfo, QueuedPodInfo
 from ..core.scheduler import Scheduler, ScheduleResult
@@ -324,6 +325,17 @@ class TPUScheduler(Scheduler):
             while not invalidated and len(inflight) < self.pipeline_depth:
                 if batch is None:
                     batch = self._collect_session_batch(fw, sig) or None
+                    if batch is None and self._event_inbox:
+                        # A concurrent client (threaded watch feed) may have
+                        # parked pod-add events while this session ran: drain
+                        # them HERE so a creation burst doesn't end the
+                        # session early. Cluster-state events invalidate the
+                        # carry, exactly as they would between sessions.
+                        self.drain_event_inbox()
+                        if self.cluster_event_seq == start_seq:
+                            batch = self._collect_session_batch(fw, sig) or None
+                        else:
+                            invalidated = True
                     if batch is None:
                         break
                 results, carry = self._dispatch(state, plan, len(batch), carry)
@@ -500,16 +512,18 @@ class TPUScheduler(Scheduler):
         self.attempts += 1
         state = CycleState()
         pod.node_name = node_name
-        self.cache.assume_pod(pod)
-        st = fw.run_reserve_plugins_reserve(state, pod, node_name)
-        if not st.is_success():
-            fw.run_reserve_plugins_unreserve(state, pod, node_name)
-            self.cache.forget_pod(pod)
-            pod.node_name = ""
-            self.handle_scheduling_failure(fw, qpi, st, None)
-            self.queue.done(pod.uid)
-            return False
-        st = fw.run_permit_plugins(state, pod, node_name)
+        self.cache.assume_pod(pod, qpi.pod_info)
+        if fw.reserve_plugins:  # guard: this tail runs once per pod at >10k/s
+            st = fw.run_reserve_plugins_reserve(state, pod, node_name)
+            if not st.is_success():
+                fw.run_reserve_plugins_unreserve(state, pod, node_name)
+                self.cache.forget_pod(pod)
+                pod.node_name = ""
+                self.handle_scheduling_failure(fw, qpi, st, None)
+                self.queue.done(pod.uid)
+                return False
+        st = fw.run_permit_plugins(state, pod, node_name) if fw.permit_plugins \
+            else _OK_STATUS
         if st.is_rejected():
             fw.run_reserve_plugins_unreserve(state, pod, node_name)
             self.cache.forget_pod(pod)

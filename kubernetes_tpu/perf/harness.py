@@ -128,10 +128,15 @@ class _ThroughputCollector:
         self._n0 = 0
         self.active = False
 
+    WINDOW_COUNTERS = ("plan_build_s", "device_wait_s", "host_commit_s",
+                       "device_scheduled", "host_path_pods", "device_batches")
+
     def start(self) -> None:
         self.active = True
         self._t0 = self._last_t = time.perf_counter()
         self._n0 = self._last_n = self.sched.scheduled
+        self._win0 = {a: getattr(self.sched, a, 0) for a in self.WINDOW_COUNTERS}
+        self.in_window: Dict[str, float] = {}
 
     def tick(self) -> None:
         if not self.active:
@@ -146,6 +151,15 @@ class _ThroughputCollector:
         self.active = False
         elapsed = time.perf_counter() - self._t0
         total = self.sched.scheduled - self._n0
+        # In-window attribution: the share of the MEASURED window each
+        # pipeline stage took (the workload-cumulative counters in `detail`
+        # also cover setup/warm phases and cannot attribute the window).
+        self.in_window = {"window_s": round(elapsed, 3)}
+        for a in self.WINDOW_COUNTERS:
+            v = getattr(self.sched, a, None)
+            if v is not None:
+                d = v - self._win0.get(a, 0)
+                self.in_window[a] = round(d, 3) if isinstance(d, float) else d
         avg = total / elapsed if elapsed > 0 else 0.0
         s = sorted(self.samples) or [avg]
 
@@ -181,7 +195,31 @@ def _make_node_from_template(i: int, tpl: Dict[str, Any]):
     return node
 
 
+# Template → prototype pod. Building a pod from a template parses resource
+# quantities and assembles spec objects (~20µs); a createPods op stamps tens
+# of thousands of IDENTICAL pods inside the measured window, so the spec is
+# built once and each instance is a cheap identity clone sharing the spec and
+# the signature memo (Pod.clone_from_template). Keyed by template-dict
+# identity (the strong ref in the entry keeps the id stable); pvc templates
+# have per-pod volume names and always take the full build path.
+_POD_PROTO_CACHE: Dict[Tuple[int, str], Tuple[Dict[str, Any], Any]] = {}
+
+
 def _make_pod_from_template(name: str, tpl: Dict[str, Any], namespace: str = "default"):
+    if not tpl.get("pvc"):
+        key = (id(tpl), namespace)
+        ent = _POD_PROTO_CACHE.get(key)
+        if ent is not None and ent[0] is tpl:
+            return ent[1].clone_from_template(name)
+        if len(_POD_PROTO_CACHE) > 4096:  # bound gang-workload growth
+            _POD_PROTO_CACHE.clear()
+        proto = _build_pod_from_template("proto", tpl, namespace)
+        _POD_PROTO_CACHE[key] = (tpl, proto)
+        return proto.clone_from_template(name)
+    return _build_pod_from_template(name, tpl, namespace)
+
+
+def _build_pod_from_template(name: str, tpl: Dict[str, Any], namespace: str = "default"):
     req = {"cpu": tpl.get("cpu", "100m"), "memory": tpl.get("memory", "128Mi")}
     req.update(tpl.get("extended", {}))  # extended-resource requests
     b = make_pod().name(name).namespace(namespace).req(req)
@@ -239,6 +277,38 @@ def _make_pod_from_template(name: str, tpl: Dict[str, Any], namespace: str = "de
     if tpl.get("podGroup"):
         pod.pod_group = tpl["podGroup"]
     return pod
+
+
+class _ThreadedCreator:
+    """createPods with a concurrent client: the reference's createPodsOp
+    issues creates from the test client while the scheduler schedules
+    (scheduler_perf.go createPodsOp → client-go rate-limited creates); here a
+    creator thread writes through the clientset and the scheduler's
+    off-thread event inbox (Scheduler._threaded) replays the adds on the
+    scheduling loop — creation overlaps the measured window instead of
+    serializing in front of it."""
+
+    blocks_idle = True  # _drain must not exit while creates are in flight
+
+    def __init__(self, fn):
+        import threading
+        self._exc: Optional[BaseException] = None
+
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 - re-raised on main thread
+                self._exc = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def tick(self) -> bool:
+        if self._exc is not None:
+            # A failed create op must fail the workload (synchronous creates
+            # propagated); surface the creator-thread exception here.
+            raise self._exc
+        return self._thread.is_alive()
 
 
 class _RateDeleter:
@@ -317,6 +387,11 @@ def _drain(sched: Scheduler, collector: _ThroughputCollector,
             sched.queue.flush_backoff_completed()
             sched.flush_expired_waiters()
             if not sched.schedule_one():
+                if any(getattr(t, "blocks_idle", False) for t in tickers):
+                    # A creator thread is still writing: wait for its events
+                    # instead of declaring the queue drained.
+                    sched.drain_event_inbox() or time.sleep(0.0002)
+                    continue
                 break
         n += 1
 
@@ -428,11 +503,20 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
                     warm(_make_pod_from_template("warm-template", tpl,
                                                  namespace=namespace))
                 collector.start()
-            _create_pods(op, tpl, namespace, count)
+                # Measured creates run on a concurrent client thread (the
+                # reference's createPodsOp issues creates from the test
+                # client while the scheduler runs); setup creates stay
+                # synchronous for determinism.
+                tickers.append(_ThreadedCreator(
+                    lambda op=op, tpl=tpl, namespace=namespace, count=count:
+                    _create_pods(op, tpl, namespace, count)))
+            else:
+                _create_pods(op, tpl, namespace, count)
             if not op.get("skipWaitToCompletion"):
                 _drain(sched, collector, tickers)
             if collect:
                 result.metrics["SchedulingThroughput"] = collector.stop()
+                result.detail["in_window"] = collector.in_window
         elif opcode == "deletePods":
             namespace = op.get("namespace", "default")
             targets = created_pods.get(namespace, [])
@@ -476,6 +560,7 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
             collector.start()
         elif opcode == "stopCollectingMetrics":
             result.metrics["SchedulingThroughput"] = collector.stop()
+            result.detail["in_window"] = collector.in_window
         elif opcode == "createResourceSlices":
             # One slice per node with N devices (dra configs' resource-slice
             # prep; devices get a model attribute for selector exercises).
