@@ -171,6 +171,16 @@ class FakeClientset:
     def update_pod(self, pod: Pod) -> Pod:
         old = self.pods.get(pod.uid)
         pod.resource_version = next(self._rv_counter)
+        # An update may carry an in-place spec change on the SAME object
+        # (clients mutate-and-republish): drop every derived-spec memo,
+        # including the template-shared signature holder — the object's spec
+        # may have diverged from its template. This is the API-boundary
+        # analogue of the old resourceVersion-keyed memo invalidation.
+        d = pod.__dict__
+        d.pop("_sig_cache", None)
+        d.pop("_sig_shared", None)
+        d.pop("_req_cache", None)
+        d.pop("_hp_cache", None)
         self.pods[pod.uid] = pod
         for h in self._pod_handlers:
             h("update", old, pod)

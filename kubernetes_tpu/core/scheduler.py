@@ -635,14 +635,14 @@ class Scheduler:
         self.metrics.generated_placements.observe(len(placements))
 
         start_save = self.next_start_node_index
-        candidates: List[Tuple[Placement, Dict[str, str], PodGroupAssignments]] = []
+        candidates: List[Tuple[Placement, Dict[str, tuple], PodGroupAssignments]] = []
         for placement in placements:
             assignment = self._evaluate_placement(
                 fw, pg_state, group, members, placement, start_save)
             if assignment is not None:
                 pga = PodGroupAssignments(
                     placement,
-                    proposed=[(m.pod, assignment[m.pod.uid]) for m in members
+                    proposed=[(m.pod, assignment[m.pod.uid][0]) for m in members
                               if m.pod.uid in assignment],
                     nodes=[self.snapshot.get(n) for n in placement.node_names])
                 candidates.append((placement, assignment, pga))
@@ -657,24 +657,29 @@ class Scheduler:
             pg_state, group, [pga for _, _, pga in candidates])
         best_i = max(range(len(totals)), key=lambda i: (totals[i], -i))
         best_placement, assignment, _pga = candidates[best_i]
-        self.metrics.generated_placements.observe(len(placements))
 
         # Commit the winning placement's assignments: assume into the cache
         # and run each member's binding cycle; members the placement could
         # not fit are requeued individually (submitPodGroupAlgorithmResult).
+        # Each member keeps the CycleState from the WINNING simulation —
+        # stateful Reserve/PreBind plugins (VolumeBinding, DynamicResources)
+        # wrote their PreFilter/Filter data there
+        # (schedule_one_podgroup.go algorithmResult.GetCycleState →
+        # submitPodGroupAlgorithmResult).
         committed = 0
         attempted_uids = set()
         for m in members:
             attempted_uids.add(m.pod.uid)
-            node = assignment.get(m.pod.uid)
-            if node is None:
+            entry = assignment.get(m.pod.uid)
+            if entry is None:
                 self.handle_scheduling_failure(
                     fw, m, Status.unschedulable(
                         f"did not fit placement {best_placement.name!r}"), None)
                 continue
+            node, m_state = entry
             m.pod.node_name = node
-            self.cache.assume_pod(m.pod)
-            if self._commit_group_member(fw, m, CycleState(),
+            self.cache.assume_pod(m.pod, m.pod_info)
+            if self._commit_group_member(fw, m, m_state,
                                          ScheduleResult(suggested_host=node)):
                 committed += 1
         group_key = (group.namespace, group.name)
@@ -686,35 +691,39 @@ class Scheduler:
 
     def _evaluate_placement(self, fw: Framework, pg_state: CycleState,
                             group, members: List[QueuedPodInfo], placement,
-                            start_index: int) -> Optional[Dict[str, str]]:
+                            start_index: int) -> Optional[Dict[str, tuple]]:
         """Simulate the group against one candidate placement under a
-        snapshot placement session. Returns {pod uid: node} when the
-        PlacementFeasible gate passes, else None. The snapshot is ALWAYS
-        restored (placement and pod assumptions), even on plugin exceptions."""
+        snapshot placement session. Returns {pod uid: (node, CycleState)}
+        when the PlacementFeasible gate passes, else None — the per-member
+        CycleState carries stateful-plugin simulation data into the commit
+        (schedule_one_podgroup.go initPodSchedulingContext). The snapshot is
+        ALWAYS restored (placement and pod assumptions), even on plugin
+        exceptions."""
         from .framework import PlacementProgress
 
         self.snapshot.assume_placement(placement.node_names)
         self.next_start_node_index = start_index  # identical rotation per sim
-        placed: List[QueuedPodInfo] = []
+        placed: List[Tuple[QueuedPodInfo, CycleState]] = []
         failed = 0
         try:
             for m in members:
+                m_state = CycleState()
                 try:
-                    result = self.schedule_pod(fw, CycleState(), m.pod)
+                    result = self.schedule_pod(fw, m_state, m.pod)
                 except FitError:
                     failed += 1
                     continue
                 m.pod.node_name = result.suggested_host
                 self.snapshot.assume_pod(m.pod)
-                placed.append(m)
+                placed.append((m, m_state))
             progress = PlacementProgress(len(placed), failed, len(members))
             feasible = placed and fw.run_placement_feasible_plugins(
                 pg_state, group, progress).is_success()
-            assignment = {m.pod.uid: m.pod.node_name for m in placed}
+            assignment = {m.pod.uid: (m.pod.node_name, st) for m, st in placed}
         finally:
             # LIFO revert: the snapshot returns to the placement view, then
             # the full view (snapshot.go revertFns + ForgetPlacement).
-            for m in reversed(placed):
+            for m, _st in reversed(placed):
                 self.snapshot.forget_pod(m.pod)
                 m.pod.node_name = ""
             self.snapshot.forget_placement()

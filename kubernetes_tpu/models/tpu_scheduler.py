@@ -445,11 +445,13 @@ class TPUScheduler(Scheduler):
 
     def _fail_state_key(self, fw: Framework, pod) -> tuple:
         """Everything a scheduling outcome can depend on, versioned: the pod
-        spec (signature), external cluster changes, our own binds, and
-        nominations (sessions never run with nominated pods present, but the
-        key guards the invariant)."""
-        return (fw.sign_pod(pod), self.cluster_event_seq, self.scheduled,
-                self.queue.nominator.has_nominated_pods())
+        spec (signature), priority (no Sign plugin covers it, but PostFilter
+        preemption eligibility does — a higher-priority pod with an identical
+        signature may succeed where the memoized pod could not), external
+        cluster changes, our own binds, and nominations (sessions never run
+        with nominated pods present, but the key guards the invariant)."""
+        return (fw.sign_pod(pod), pod.priority, self.cluster_event_seq,
+                self.scheduled, self.queue.nominator.has_nominated_pods())
 
     def _fail_from_memo(self, fw: Framework, qpi: QueuedPodInfo) -> bool:
         """An identical pod was already host-diagnosed unschedulable against

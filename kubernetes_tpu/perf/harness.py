@@ -401,6 +401,11 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
     inner loop, scheduler_perf.go:282+)."""
     from ..models.tpu_scheduler import TPUScheduler
 
+    # Each workload builds a fresh scheduler/framework; proto pods (and their
+    # framework-id-keyed signature holders) must not outlive the frameworks
+    # they were signed against (CPython id() reuse would alias a stale memo).
+    _POD_PROTO_CACHE.clear()
+
     if sched is None:
         if any(op.get("topologyKey") for op in wl.ops
                if op.get("opcode") == "createPodGroups"):

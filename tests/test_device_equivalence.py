@@ -439,3 +439,32 @@ class TestInfeasibleDiagnosisEquivalence:
         cs_d, s_d, hi_d = build(TPUScheduler)
         assert hi_h.node_name and hi_d.node_name
         assert hi_h.node_name == hi_d.node_name
+
+    def test_fail_memo_does_not_park_higher_priority_pod(self):
+        """A memoized terminal failure must not serve a later pod whose
+        priority differs: PostFilter preemption eligibility depends on
+        priority (victims in [memo_prio, new_prio) become evictable), so the
+        higher-priority pod must run its own attempt — and preempt."""
+        from kubernetes_tpu.core import FakeClientset
+        cs = FakeClientset()
+        s = TPUScheduler(clientset=cs)
+        for i in range(2):
+            cs.create_node(make_node().name(f"n{i}").capacity(
+                {"cpu": 4, "memory": "16Gi", "pods": 110}).obj())
+        for i in range(2):
+            p = make_pod().name(f"mid-{i}").req({"cpu": "4"}).priority(10).obj()
+            p.node_name = f"n{i}"
+            cs.create_pod(p)
+        # Flood of same-priority hopeless pods primes the memo...
+        for i in range(5):
+            cs.create_pod(make_pod().name(f"same-{i}").req({"cpu": "4"})
+                          .priority(10).obj())
+        s.run_until_idle()
+        assert s.scheduled == 0
+        # ...then an identically-signed HIGHER-priority pod must not be
+        # parked from the memo: preemption can make room for it.
+        hi = make_pod().name("hi").req({"cpu": "4"}).priority(50).obj()
+        cs.create_pod(hi)
+        s.run_until_idle()
+        assert hi.nominated_node_name or hi.node_name, (
+            "higher-priority pod was parked by a stale fail memo")

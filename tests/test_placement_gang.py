@@ -138,3 +138,48 @@ class TestPlacementAlgorithm:
         assert all(p.node_name for p in pods)
         # default member-wise algorithm spreads across zones (LeastAllocated)
         assert len(_zones_of(cs, pods)) == 2
+
+
+class TestPlacementCommitState:
+    def test_commit_reuses_winning_simulation_cycle_state(self):
+        """The committed members must receive the CycleState from the WINNING
+        placement simulation — stateful Reserve/PreBind plugins (e.g.
+        VolumeBinding) read PreFilter data written during the simulation
+        (schedule_one_podgroup.go algorithmResult.GetCycleState →
+        submitPodGroupAlgorithmResult)."""
+        from kubernetes_tpu.core.framework import OK, CycleState
+        from kubernetes_tpu.core.registry import build_framework
+        from kubernetes_tpu.core.registry import GANG_PLACEMENT_PLUGINS
+
+        seen = {}
+
+        class StateProbe:
+            name = "StateProbe"
+
+            def pre_filter(self, state, pod, nodes):
+                state.write("probe/" + pod.name, "sim")
+                return None, OK
+
+            def reserve(self, state, pod, node_name):
+                seen[pod.name] = state.read("probe/" + pod.name)
+                return OK
+
+        def profiles(handle):
+            fw = build_framework(handle, plugins=GANG_PLACEMENT_PLUGINS)
+            probe = StateProbe()
+            fw.pre_filter_plugins.append(probe)
+            fw.reserve_plugins.append(probe)
+            return {"default-scheduler": fw}
+
+        cs = FakeClientset()
+        s = Scheduler(clientset=cs, profile_factory=profiles,
+                      deterministic_ties=True)
+        for i in range(6):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": 8, "memory": "32Gi", "pods": 110})
+                           .zone(f"z{i % 2}").obj())
+        pods = _gang(cs, "probe", 3)
+        s.run_until_idle()
+        assert all(p.node_name for p in pods)
+        # Every committed member's Reserve saw the simulation-written state.
+        assert seen == {p.name: "sim" for p in pods}, seen

@@ -346,3 +346,29 @@ class TestZoneInterleavedOrder:
         order = [ni.name for ni in sched.snapshot.node_info_list]
         assert order == ["b-0", "a-0"]
         assert sched.cache.node_tree.num_nodes == 2
+
+
+def test_update_pod_invalidates_signature_memo():
+    """Mutate-and-republish of the SAME pod object must re-sign: the memo
+    drops at the API boundary (clientset.update_pod), so a changed spec
+    (tolerations are signed) produces a different signature."""
+    from kubernetes_tpu.api.types import Toleration
+    from kubernetes_tpu.core import FakeClientset, Scheduler
+    from kubernetes_tpu.testing.wrappers import make_pod
+
+    cs = FakeClientset()
+    s = Scheduler(clientset=cs)
+    fw = s.profiles["default-scheduler"]
+    proto = make_pod().name("proto").req({"cpu": "1"}).obj()
+    pod = proto.clone_from_template("p0")
+    pod.scheduling_gates = ["hold"]  # keep it parked, not scheduled
+    cs.create_pod(pod)
+    sig_before = fw.sign_pod(pod)
+    # In-place spec change republished through the API.
+    pod.tolerations = [Toleration(key="dedicated", operator="Exists")]
+    pod.scheduling_gates = []
+    cs.update_pod(pod)
+    sig_after = fw.sign_pod(pod)
+    assert sig_before != sig_after, "stale signature served after update_pod"
+    # The template prototype's memo must be unaffected by the divergent clone.
+    assert fw.sign_pod(proto.clone_from_template("p1")) == sig_before
