@@ -62,13 +62,38 @@ class Snapshot:
 
     def assume_pod(self, pod: Pod) -> None:
         ni = self.node_info_map.get(pod.node_name)
-        if ni is not None:
-            ni.add_pod(PodInfo.of(pod))
+        if ni is None:
+            return
+        had_aff = bool(ni.pods_with_affinity)
+        had_anti = bool(ni.pods_with_required_anti_affinity)
+        ni.add_pod(PodInfo.of(pod))
+        # Keep the affinity sublists consistent mid-simulation: PreFilter
+        # consumers (InterPodAffinity sublist shortcut, ops/features.py)
+        # read them against the SAME snapshot object while gang simulations
+        # assume members in (snapshot.go AddPod keeps its lists in step).
+        if not had_aff and ni.pods_with_affinity:
+            self.have_pods_with_affinity_list.append(ni)
+            self._list_members.add(ni.name)
+        if not had_anti and ni.pods_with_required_anti_affinity:
+            self.have_pods_with_required_anti_affinity_list.append(ni)
+            self._list_members.add(ni.name)
 
     def forget_pod(self, pod: Pod) -> None:
         ni = self.node_info_map.get(pod.node_name)
-        if ni is not None:
-            ni.remove_pod(pod)
+        if ni is None:
+            return
+        had_aff = bool(ni.pods_with_affinity)
+        had_anti = bool(ni.pods_with_required_anti_affinity)
+        ni.remove_pod(pod)
+        if had_aff and not ni.pods_with_affinity:
+            self.have_pods_with_affinity_list = [
+                x for x in self.have_pods_with_affinity_list if x is not ni]
+        if had_anti and not ni.pods_with_required_anti_affinity:
+            self.have_pods_with_required_anti_affinity_list = [
+                x for x in self.have_pods_with_required_anti_affinity_list
+                if x is not ni]
+        if not ni.pods_with_affinity and not ni.pods_with_required_anti_affinity:
+            self._list_members.discard(ni.name)
 
     # -- placement mutation session (snapshot.go:276 StartMutations / :317
     # EndMutations / :708 AssumePlacement): restrict the visible node list to

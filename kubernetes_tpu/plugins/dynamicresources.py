@@ -25,6 +25,9 @@ ERR_NO_DEVICES = "node(s) didn't have enough free devices for the claims"
 
 class DynamicResources:
     name = "DynamicResources"
+    # Reserve/PreBind act only on CycleState written in PreFilter (no-ops on
+    # a fresh state) — device commit fast-path eligible.
+    state_driven_tail = True
     _KEY = "PreFilterDynamicResources"
 
     def __init__(self, handle=None):

@@ -76,6 +76,9 @@ class GangScheduling:
     reservations; the barrier rejects (unwinding all waiters) on timeout."""
 
     name = "GangScheduling"
+    # Permit acts only on gang members (pod.pod_group); plain pods pass —
+    # the device commit fast path checks pod_group itself.
+    gang_only = True
 
     def __init__(self, handle=None, timeout_seconds: float = 60.0, now=time.monotonic):
         self.handle = handle

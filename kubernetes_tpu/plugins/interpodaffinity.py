@@ -86,8 +86,19 @@ class InterPodAffinity:
 
         # 1. existing pods' required anti-affinity vs incoming pod — only
         #    nodes that host such pods need scanning (filtering.go uses the
-        #    HavePodsWithRequiredAntiAffinityList sublist).
-        for ni in nodes:
+        #    HavePodsWithRequiredAntiAffinityList sublist). When `nodes` IS
+        #    the snapshot's full list, use its maintained sublist instead of
+        #    an O(all nodes) scan per pod — at 15k nodes with zero
+        #    anti-affinity pods the scan alone dominated the daemonset
+        #    workload's cycle time.
+        anti_nodes = nodes
+        if self.handle is not None:
+            snap_fn = getattr(self.handle, "snapshot", None)
+            if snap_fn is not None:
+                snap = snap_fn()
+                if snap.node_info_list is nodes:
+                    anti_nodes = snap.have_pods_with_required_anti_affinity_list
+        for ni in anti_nodes:
             if not ni.pods_with_required_anti_affinity:
                 continue
             node = ni.node

@@ -47,6 +47,10 @@ class VolumeBinding:
     """volumebinding/volume_binding.go."""
 
     name = "VolumeBinding"
+    # Reserve/PreBind read only the CycleState written in PreFilter/Filter:
+    # with a fresh empty state they are no-ops, so the device commit fast
+    # path may skip them (models/tpu_scheduler.py _commit_fast_eligible).
+    state_driven_tail = True
     _KEY = "PreFilterVolumeBinding"
 
     def __init__(self, handle=None):

@@ -372,3 +372,33 @@ def test_update_pod_invalidates_signature_memo():
     assert sig_before != sig_after, "stale signature served after update_pod"
     # The template prototype's memo must be unaffected by the divergent clone.
     assert fw.sign_pod(proto.clone_from_template("p1")) == sig_before
+
+
+def test_gang_simulation_sees_assumed_anti_affinity():
+    """Mid-simulation assumed members must be visible to later members'
+    InterPodAffinity PreFilter (snapshot sublists stay consistent): a gang
+    whose second member would violate the first member's required
+    anti-affinity must NOT commit (regression: the sublist shortcut read a
+    stale have_pods_with_required_anti_affinity_list)."""
+    from kubernetes_tpu.api.types import PodGroup
+
+    cs = FakeClientset()
+    sched = Scheduler(clientset=cs, deterministic_ties=True)
+    for i in range(2):
+        cs.create_node(
+            make_node().name(f"n{i}")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": 110}).obj())
+    cs.create_pod_group(PodGroup(name="g", min_count=2))
+    a = (make_pod().name("a").labels({"app": "x"})
+         .pod_affinity("kubernetes.io/hostname", {"app": "x"}, anti=True)
+         .req({"cpu": "100m"}).obj())
+    a.pod_group = "g"
+    b = (make_pod().name("b").labels({"app": "x"})
+         .req({"cpu": "100m"}).obj())
+    b.pod_group = "g"
+    cs.create_pod(a)
+    cs.create_pod(b)
+    sched.run_until_idle()
+    bound = {cs.bindings.get(a.uid), cs.bindings.get(b.uid)}
+    # Both scheduled (2 nodes available) but never co-located.
+    assert None not in bound and len(bound) == 2, bound
