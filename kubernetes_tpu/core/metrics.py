@@ -9,6 +9,7 @@ format for a /metrics endpoint.
 from __future__ import annotations
 
 import time
+from bisect import bisect_left
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -64,6 +65,10 @@ class Gauge(Metric):
 
 
 class Histogram(Metric):
+    """Counts are stored PER-BUCKET (non-cumulative) so observe() is O(1)
+    via bisect — it runs several times per pod on a >10k pods/s path — and
+    converted to Prometheus cumulative form at expose/percentile time."""
+
     def __init__(self, name, help_text, label_names=(), buckets=DURATION_BUCKETS):
         super().__init__(name, help_text, tuple(label_names))
         self.buckets = tuple(buckets)
@@ -72,15 +77,22 @@ class Histogram(Metric):
         self._totals: Dict[Tuple[str, ...], int] = {}
 
     def observe(self, value: float, *labels: str) -> None:
-        key = tuple(labels)
-        # +1 slot: the +Inf bucket (cumulative == count, Prometheus contract)
-        counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
-        for i, b in enumerate(self.buckets):
-            if value <= b:
-                counts[i] += 1
-        counts[-1] += 1
+        key = labels
+        counts = self._counts.get(key)
+        if counts is None:
+            # +1 slot: the +Inf bucket
+            counts = self._counts.setdefault(key, [0] * (len(self.buckets) + 1))
+        counts[bisect_left(self.buckets, value)] += 1
         self._sums[key] = self._sums.get(key, 0.0) + value
         self._totals[key] = self._totals.get(key, 0) + 1
+
+    def _cumulative(self, key) -> List[int]:
+        out = []
+        c = 0
+        for v in self._counts.get(key, ()):
+            c += v
+            out.append(c)
+        return out
 
     def count(self, *labels: str) -> int:
         return self._totals.get(tuple(labels), 0)
@@ -97,8 +109,9 @@ class Histogram(Metric):
             return 0.0
         target = q * total
         cum_prev = 0
+        cums = self._cumulative(key)
         for i, b in enumerate(self.buckets):
-            cum = self._counts[key][i]
+            cum = cums[i]
             if cum >= target:
                 lo = self.buckets[i - 1] if i else 0.0
                 span = cum - cum_prev
@@ -110,11 +123,12 @@ class Histogram(Metric):
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         for key in sorted(self._totals):
+            cums = self._cumulative(key)
             for i, b in enumerate(self.buckets):
                 labels = _fmt_labels(self.label_names + ("le",), key + (str(b),))
-                out.append(f"{self.name}_bucket{labels} {self._counts[key][i]}")
+                out.append(f"{self.name}_bucket{labels} {cums[i]}")
             inf = _fmt_labels(self.label_names + ("le",), key + ("+Inf",))
-            out.append(f"{self.name}_bucket{inf} {self._counts[key][-1]}")
+            out.append(f"{self.name}_bucket{inf} {cums[-1]}")
             out.append(f"{self.name}_sum{_fmt_labels(self.label_names, key)} {self._sums[key]}")
             out.append(f"{self.name}_count{_fmt_labels(self.label_names, key)} {self._totals[key]}")
         return out

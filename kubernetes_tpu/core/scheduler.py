@@ -134,6 +134,7 @@ class Handle:
     def on_async_bind_error(self, pod, exc: Exception) -> None:
         """Async dispatcher bind failure: unwind the optimistic commit."""
         s = self._scheduler
+        s.state_unwinds += 1
         s.cache.forget_pod(pod)
         pod.node_name = ""
         s.scheduled = max(0, s.scheduled - 1)
@@ -275,6 +276,11 @@ class Scheduler:
         self.error_log: List[str] = []
         # Versions node-state-relevant cluster changes (see _on_pod_event).
         self.cluster_event_seq = 0
+        # Versions cache-state UNWINDS that happen outside a scheduling
+        # attempt (bind failure after Permit WAIT release, waiter expiry,
+        # async bind error): a device session/resume carry or fail memo
+        # computed before an unwind no longer reflects the cache.
+        self.state_unwinds = 0
         # Off-thread watch-event inbox (see _threaded): deque append/popleft
         # are atomic under the GIL, so no lock is needed.
         from collections import deque
@@ -635,17 +641,8 @@ class Scheduler:
         self.metrics.generated_placements.observe(len(placements))
 
         start_save = self.next_start_node_index
-        candidates: List[Tuple[Placement, Dict[str, tuple], PodGroupAssignments]] = []
-        for placement in placements:
-            assignment = self._evaluate_placement(
-                fw, pg_state, group, members, placement, start_save)
-            if assignment is not None:
-                pga = PodGroupAssignments(
-                    placement,
-                    proposed=[(m.pod, assignment[m.pod.uid][0]) for m in members
-                              if m.pod.uid in assignment],
-                    nodes=[self.snapshot.get(n) for n in placement.node_names])
-                candidates.append((placement, assignment, pga))
+        candidates = self._evaluate_placements(
+            fw, pg_state, group, members, placements, start_save)
         self.next_start_node_index = start_save
 
         if not candidates:
@@ -689,6 +686,29 @@ class Scheduler:
             "scheduled" if committed else "unschedulable")
         return True
 
+    def _evaluate_placements(self, fw: Framework, pg_state: CycleState,
+                             group, members: List[QueuedPodInfo],
+                             placements, start_index: int) -> List[tuple]:
+        """Evaluate every candidate placement; returns the feasible
+        candidates as (placement, assignment, PodGroupAssignments) tuples.
+        The host loop simulates placements one by one; TPUScheduler
+        overrides this with one stacked kernel evaluation of ALL candidates
+        (ops/kernel.py schedule_placements)."""
+        from .framework import PodGroupAssignments
+
+        candidates: List[tuple] = []
+        for placement in placements:
+            assignment = self._evaluate_placement(
+                fw, pg_state, group, members, placement, start_index)
+            if assignment is not None:
+                pga = PodGroupAssignments(
+                    placement,
+                    proposed=[(m.pod, assignment[m.pod.uid][0]) for m in members
+                              if m.pod.uid in assignment],
+                    nodes=[self.snapshot.get(n) for n in placement.node_names])
+                candidates.append((placement, assignment, pga))
+        return candidates
+
     def _evaluate_placement(self, fw: Framework, pg_state: CycleState,
                             group, members: List[QueuedPodInfo], placement,
                             start_index: int) -> Optional[Dict[str, tuple]]:
@@ -698,11 +718,20 @@ class Scheduler:
         CycleState carries stateful-plugin simulation data into the commit
         (schedule_one_podgroup.go initPodSchedulingContext). The snapshot is
         ALWAYS restored (placement and pod assumptions), even on plugin
-        exceptions."""
+        exceptions.
+
+        Simulation spec (shared with the device evaluator,
+        ops/kernel.py schedule_placements): each simulation evaluates its
+        WHOLE candidate — no adaptive truncation — from rotation origin 0.
+        Placements are domain-sized (a zone/rack), so full evaluation is the
+        point, and a fixed origin makes host and device placement
+        evaluation bit-identical."""
         from .framework import PlacementProgress
 
         self.snapshot.assume_placement(placement.node_names)
-        self.next_start_node_index = start_index  # identical rotation per sim
+        self.next_start_node_index = 0
+        pct_save = self.percentage_of_nodes_to_score
+        self.percentage_of_nodes_to_score = 100  # evaluate the full candidate
         placed: List[Tuple[QueuedPodInfo, CycleState]] = []
         failed = 0
         try:
@@ -727,6 +756,7 @@ class Scheduler:
                 self.snapshot.forget_pod(m.pod)
                 m.pod.node_name = ""
             self.snapshot.forget_placement()
+            self.percentage_of_nodes_to_score = pct_save
         return assignment if feasible else None
 
     def group_feasible(self, group, members: List[QueuedPodInfo]) -> bool:
@@ -985,6 +1015,7 @@ class Scheduler:
         """handleBindingCycleError (schedule_one.go:507): unreserve, forget,
         flush an AssignedPodDelete-equivalent event, requeue."""
         pod = qpi.pod
+        self.state_unwinds += 1
         fw.run_reserve_plugins_unreserve(state, pod, node_name)
         self.cache.forget_pod(pod)
         pod.node_name = ""
@@ -1010,6 +1041,7 @@ class Scheduler:
         if entry is None:
             return False
         fw, state, qpi, result, _ = entry
+        self.state_unwinds += 1
         fw.run_reserve_plugins_unreserve(state, qpi.pod, result.suggested_host)
         self.cache.forget_pod(qpi.pod)
         qpi.pod.node_name = ""

@@ -40,6 +40,11 @@ from ..ops.features import Unsupported, batch_supported, build_batch
 from ..ops.kernel import schedule_batch
 
 
+# Sentinel fallback_reason: the popped entity is a pod GROUP that can ride a
+# device gang session (schedule_one routes it to run_gang_device_session).
+_GANG_SESSION = "__gang_device_session__"
+
+
 class TPUScheduler(Scheduler):
     """Scheduler with the hot path on device. Falls back per-pod to the host
     path for uncovered features; host and device paths produce identical
@@ -79,6 +84,9 @@ class TPUScheduler(Scheduler):
         self.device_batches = 0
         self.device_scheduled = 0
         self.host_path_pods = 0
+        # Stacked placement evaluations that ran on device (one per group
+        # cycle whose candidate set was kernel-evaluated).
+        self.placement_device_evals = 0
         # Host/device time split (schedule_one.go:574-style step accounting,
         # re-shaped for the batch pipeline): plan_build_s = snapshot→features
         # host work, device_wait_s = time blocked on a device result fetch,
@@ -130,8 +138,9 @@ class TPUScheduler(Scheduler):
         if head is None:
             return None, [], None
         if isinstance(head, QueuedPodGroupInfo):
-            # Gang entities take the host group cycle (device gang batching
-            # is a later ring — SURVEY.md §7.7).
+            fw, sig = self._gang_device_eligible(head)
+            if fw is not None:
+                return fw, [head], _GANG_SESSION
             return self.framework_for_pod(head.pod), [head], "pod group entity"
         fw = self.framework_for_pod(head.pod)
         reason = self._batch_supported_memo(head.pod, fw)
@@ -155,6 +164,331 @@ class TPUScheduler(Scheduler):
                 self._holdover = nxt
                 break
         return fw, batch, None
+
+    # -- gang device sessions ----------------------------------------------
+    #
+    # A pod group scheduled by the DEFAULT algorithm (no topology constraint)
+    # is member-wise greedy placement with all-or-nothing commit
+    # (schedule_one_podgroup.go:556) — exactly the kernel's scan with a
+    # group-granular commit barrier. Groups of identical members ride device
+    # sessions like plain pods: whole groups pack into each dispatch, the
+    # carry chains across packs, and the host commits a retired pack's
+    # groups atomically (any member infeasible ⇒ that group reverts to the
+    # exact host cycle for diagnosis/PostFilter and the session invalidates).
+
+    def _gang_device_eligible(self, qgpi: QueuedPodGroupInfo):
+        """Returns (fw, sig) when the whole group can ride a device session:
+        default algorithm, identical batch-supported members, one signature."""
+        if not qgpi.members or len(qgpi.members) > self.max_batch:
+            return None, None
+        if not self.device_enabled or self.queue.nominator.has_nominated_pods():
+            return None, None
+        p0 = qgpi.members[0].pod
+        if p0.scheduler_name not in self.profiles:
+            return None, None
+        fw = self.framework_for_pod(p0)
+        if fw.placement_generate_plugins and getattr(
+                qgpi.group, "topology_keys", ()):
+            return None, None  # placement algorithm (separate path)
+        if self.extenders and any(
+                e.is_interested(m.pod) for e in self.extenders
+                for m in qgpi.members):
+            return None, None
+        sig = fw.sign_pod(p0)
+        if sig is None:
+            return None, None
+        for m in qgpi.members:
+            if (m.pod.scheduler_name != p0.scheduler_name
+                    or fw.sign_pod(m.pod) != sig
+                    or self._batch_supported_memo(m.pod, fw) is not None
+                    or self._device_unsupported_profile(fw, m.pod) is not None):
+                return None, None
+        return fw, sig
+
+    def _sorted_members(self, qgpi: QueuedPodGroupInfo) -> List[QueuedPodInfo]:
+        """Host group-cycle member order (schedule_pod_group)."""
+        return sorted(qgpi.members, key=lambda m: (-m.pod.priority, m.timestamp))
+
+    def run_gang_device_session(self, fw: Framework, first: QueuedPodGroupInfo) -> None:
+        sig = fw.sign_pod(first.members[0].pod)
+        carry = None
+        resume = self._resume
+        self._resume = None
+        if (resume is not None
+                and resume[0] == (id(fw), sig, self.cluster_event_seq,
+                                  self.attempts, self.state_unwinds)):
+            state, plan, carry, node_names = resume[1]
+        else:
+            _t0 = _time.perf_counter()
+            state, plan = self.build_plan(fw, first.members[0].pod, self.max_batch)
+            self.plan_build_s += _time.perf_counter() - _t0
+            node_names = [ni.name for ni in self.snapshot.node_info_list]
+        start_seq = self.cluster_event_seq
+        start_unwinds = self.state_unwinds
+        inflight: List[Tuple[List[QueuedPodGroupInfo], object]] = []
+        ok_rows: List[int] = []
+        dirty_rows: List[int] = []
+        invalidated = False
+        pack: Optional[List[QueuedPodGroupInfo]] = [first]
+
+        def collect_pack() -> List[QueuedPodGroupInfo]:
+            groups: List[QueuedPodGroupInfo] = []
+            total = 0
+            while True:
+                nxt = self._pop()
+                if nxt is None:
+                    break
+                if isinstance(nxt, QueuedPodGroupInfo):
+                    gfw, gsig = self._gang_device_eligible(nxt)
+                    if (gfw is fw and gsig == sig
+                            and total + len(nxt.members) <= self.max_batch):
+                        groups.append(nxt)
+                        total += len(nxt.members)
+                        continue
+                self._holdover = nxt
+                break
+            return groups
+
+        while True:
+            while not invalidated and len(inflight) < self.pipeline_depth:
+                if pack is None:
+                    pack = collect_pack() or None
+                    if pack is None:
+                        break
+                members = [m for g in pack for m in self._sorted_members(g)]
+                results, carry = self._dispatch(state, plan, len(members), carry)
+                try:
+                    results.copy_to_host_async()
+                except AttributeError:
+                    pass
+                self.device_batches += 1
+                self.metrics.batch_attempts.inc("dispatched")
+                self.metrics.batch_size.observe(len(members))
+                inflight.append((pack, results))
+                pack = None
+            if not inflight:
+                break
+            groups, results = inflight.pop(0)
+            _t0 = _time.perf_counter()
+            res = np.asarray(results)
+            _t1 = _time.perf_counter()
+            self.device_wait_s += _t1 - _t0
+            if (invalidated or self.cluster_event_seq != start_seq
+                    or self.state_unwinds != start_unwinds):
+                invalidated = True
+                for g in groups:
+                    for m in self._sorted_members(g):
+                        self.host_path_pods += 1
+                    self.process_one(g)
+                continue
+            i = 0
+            for g in groups:
+                ms = self._sorted_members(g)
+                rows = res[0, i:i + len(ms)]
+                self.next_start_node_index = int(res[1, i + len(ms) - 1])
+                i += len(ms)
+                if invalidated or (rows < 0).any():
+                    # Some member infeasible (or a prior group diverged):
+                    # every row this group DID take is charged dirty (the
+                    # carry placed them), and the exact host group cycle
+                    # owns the entity (diagnosis, PodGroupPostFilter).
+                    for r in rows:
+                        if r >= 0:
+                            dirty_rows.append(int(r))
+                    for _ in ms:
+                        self.host_path_pods += 1
+                    self.process_one(g)
+                    invalidated = True
+                    continue
+                if not self._commit_gang_group(fw, g, ms, rows, node_names,
+                                               ok_rows, dirty_rows):
+                    invalidated = True  # a member's host commit rejected a
+                    # placement the carry already applied
+                if (self.cluster_event_seq != start_seq
+                        or self.state_unwinds != start_unwinds):
+                    invalidated = True
+                    start_seq = self.cluster_event_seq
+                    start_unwinds = self.state_unwinds
+            self.host_commit_s += _time.perf_counter() - _t1
+
+        if pack:
+            for g in pack:
+                for _ in g.members:
+                    self.host_path_pods += 1
+                self.process_one(g)
+
+        self.cache.update_snapshot(self.snapshot)
+        if invalidated:
+            self.mirror.invalidate()
+        else:
+            self.mirror.adopt(self.snapshot.node_info_list, ok_rows,
+                              carry.req_r, carry.nonzero, carry.pod_count,
+                              dirty_rows=dirty_rows)
+            if carry is not None and not dirty_rows:
+                self._resume = (
+                    (id(fw), sig, self.cluster_event_seq, self.attempts,
+                     self.state_unwinds),
+                    (state, plan, carry, node_names))
+
+    def _commit_gang_group(self, fw: Framework, qgpi: QueuedPodGroupInfo,
+                           members: List[QueuedPodInfo], rows, node_names,
+                           ok_rows: List[int], dirty_rows: List[int]) -> bool:
+        """All members feasible on device: run the group commit exactly as
+        schedule_pod_group's tail (assume into cache, reserve → permit →
+        binding cycle per member, group bookkeeping). Returns False when any
+        member's host commit rejected its placement — the device carry has
+        that placement applied, so the caller must invalidate."""
+        from ..core.framework import CycleState
+
+        self.attempts += 1
+        committed = 0
+        attempted_uids = set()
+        for m, r in zip(members, rows):
+            attempted_uids.add(m.pod.uid)
+            node = node_names[int(r)]
+            m.pod.node_name = node
+            self.cache.assume_pod(m.pod, m.pod_info)
+            if self._commit_group_member(fw, m, CycleState(),
+                                         ScheduleResult(suggested_host=node)):
+                committed += 1
+                ok_rows.append(int(r))
+                self.device_scheduled += 1
+            else:
+                dirty_rows.append(int(r))
+        group_key = (qgpi.group.namespace, qgpi.group.name)
+        self.queue.clear_group_members(group_key, attempted_uids)
+        self.queue.done(qgpi.uid)
+        self.metrics.podgroup_schedule_attempts.inc(
+            "scheduled" if committed else "unschedulable")
+        return committed == len(members)
+
+    # -- placement-gang device evaluation ----------------------------------
+
+    @staticmethod
+    def _placement_plan_restriction_invariant(plan) -> bool:
+        """True when restricting the node universe cannot change any feature
+        table the plan precomputed over the FULL cluster: no topology-spread
+        or inter-pod-affinity count tables (their domains/counts would have
+        been computed over the restricted list by the host oracle), no
+        image-locality score (its spread discount divides by the restricted
+        node count). Static row-local terms (fit, balance, taints,
+        node-affinity preference) restrict exactly."""
+        f = plan.features
+        return (f.dns_axis.shape[0] == 0 and f.sa_axis.shape[0] == 0
+                and f.anti_axis.shape[0] == 0 and f.aff_axis.shape[0] == 0
+                and f.ipa_axis.shape[0] == 0 and not plan.has_ipa_base
+                and not bool(np.asarray(f.il_score).any()))
+
+    def _evaluate_placements(self, fw: Framework, pg_state, group, members,
+                             placements, start_index: int):
+        """Stacked device evaluation of ALL candidate placements in one
+        kernel call (ops/kernel.py schedule_placements) — the TPU form of
+        the per-placement simulation loop. Falls back to the host loop when
+        any member or the plan is outside the device ring."""
+        from ..core.framework import (CycleState, PlacementProgress,
+                                      PodGroupAssignments)
+
+        if not self.device_enabled or self.queue.nominator.has_nominated_pods():
+            return super()._evaluate_placements(
+                fw, pg_state, group, members, placements, start_index)
+        p0 = members[0].pod
+        sig = fw.sign_pod(p0)
+        if sig is None or any(
+                fw.sign_pod(m.pod) != sig
+                or self._batch_supported_memo(m.pod, fw) is not None
+                or self._device_unsupported_profile(fw, m.pod) is not None
+                for m in members):
+            return super()._evaluate_placements(
+                fw, pg_state, group, members, placements, start_index)
+        # Plan cache across group cycles: restriction-invariant, port-free
+        # plans depend only on NODE state + the pod spec — our own commits
+        # between cycles only move per-node aggregates, which flow through
+        # the mirror's dirty-row scatter, NOT the feature tables. A stream
+        # of identical gangs (the perf shape) then builds features once.
+        cache = getattr(self, "_placement_plan_cache", None)
+        ckey = (id(fw), sig, len(members), self.cluster_event_seq,
+                self.mirror.np_cap)
+        if cache is not None and cache[0] == ckey:
+            plan = cache[1]
+            self.cache.update_snapshot(self.snapshot)
+            self.mirror.sync(self.snapshot.node_info_list)
+            state = self.mirror.flush()
+            if self.mesh is not None:
+                from ..parallel import shard_node_state
+                state = shard_node_state(state, self.mesh)
+        else:
+            try:
+                state, plan = self.build_plan(fw, p0, len(members))
+            except Unsupported:
+                return super()._evaluate_placements(
+                    fw, pg_state, group, members, placements, start_index)
+            if not self._placement_plan_restriction_invariant(plan):
+                return super()._evaluate_placements(
+                    fw, pg_state, group, members, placements, start_index)
+            self._placement_plan_cache = (
+                (id(fw), sig, len(members), self.cluster_event_seq,
+                 self.mirror.np_cap),
+                plan) if not plan.port_selfblock else None
+
+        import jax.numpy as jnp
+        from ..ops.kernel import schedule_placements
+        index = self.snapshot._index
+        if len(index) != len(self.snapshot.node_info_list):
+            index = {ni.name: i
+                     for i, ni in enumerate(self.snapshot.node_info_list)}
+        npc = self.mirror.np_cap
+        # Pad the placement axis to a pow2 tier so XLA compiles once per
+        # (placement tier, batch tier), not once per candidate count.
+        p_pad = 1
+        while p_pad < len(placements):
+            p_pad *= 2
+        # Mask cache: candidate placements for one topology key are identical
+        # across a stream of identical groups (same domains, same rows).
+        mkey = (self.cluster_event_seq, p_pad, npc,
+                tuple(tuple(p.node_names) for p in placements))
+        mcache = getattr(self, "_placement_mask_cache", None)
+        if mcache is not None and mcache[0] == mkey:
+            masks_dev = mcache[1]
+        else:
+            masks = np.zeros((p_pad, npc), bool)
+            for pi, placement in enumerate(placements):
+                for name in placement.node_names:
+                    row = index.get(name)
+                    if row is not None:
+                        masks[pi, row] = True
+            masks_dev = jnp.asarray(masks)
+            self._placement_mask_cache = (mkey, masks_dev)
+        res = np.asarray(schedule_placements(
+            state, plan.features, plan.batch_pad, plan.fit_strategy,
+            plan.vmax, masks_dev,
+            n_active=np.int32(len(members)),
+            has_pns=plan.has_pns, has_na_pref=plan.has_na_pref,
+            port_selfblock=plan.port_selfblock))  # [P, 2, B]
+        self.placement_device_evals += 1
+
+        node_names = [ni.name for ni in self.snapshot.node_info_list]
+        candidates = []
+        for pi, placement in enumerate(placements):
+            rows = res[pi, 0, :len(members)]
+            placed = [(m, int(r)) for m, r in zip(members, rows) if r >= 0]
+            failed = len(members) - len(placed)
+            progress = PlacementProgress(len(placed), failed, len(members))
+            if not placed or not fw.run_placement_feasible_plugins(
+                    pg_state, group, progress).is_success():
+                continue
+            # Device-eligible members carry no stateful-plugin simulation
+            # data (no volumes/claims — batch_supported excludes them), so a
+            # fresh CycleState is exactly what the host simulation would
+            # have produced for them.
+            assignment = {m.pod.uid: (node_names[r], CycleState())
+                          for m, r in placed}
+            pga = PodGroupAssignments(
+                placement,
+                proposed=[(m.pod, assignment[m.pod.uid][0]) for m in members
+                          if m.pod.uid in assignment],
+                nodes=[self.snapshot.get(n) for n in placement.node_names])
+            candidates.append((placement, assignment, pga))
+        return candidates
 
     # -- device dispatch ---------------------------------------------------
 
@@ -262,6 +596,34 @@ class TPUScheduler(Scheduler):
             r2, _ = self._dispatch(state, fb, 0, c1)
             np.asarray(r2)
 
+    def warm_for_placements(self, pod, group_size: int,
+                            n_placements: int) -> None:
+        """Compile the stacked placement-evaluation kernel for the tiers a
+        topology-constrained gang workload will hit (inert n_active=0
+        dispatch), so XLA compilation lands outside the measured window —
+        the placement analogue of warm_for."""
+        import jax.numpy as jnp
+        from ..ops.kernel import schedule_placements
+        fw = self.framework_for_pod(pod)
+        if self._batch_supported_memo(pod, fw) is not None:
+            return
+        try:
+            state, plan = self.build_plan(fw, pod, group_size)
+        except Unsupported:
+            return
+        if not self._placement_plan_restriction_invariant(plan):
+            return
+        p_pad = 1
+        while p_pad < max(1, n_placements):
+            p_pad *= 2
+        masks = jnp.zeros((p_pad, self.mirror.np_cap), bool)
+        res = schedule_placements(
+            state, plan.features, plan.batch_pad, plan.fit_strategy,
+            plan.vmax, masks, n_active=np.int32(0),
+            has_pns=plan.has_pns, has_na_pref=plan.has_na_pref,
+            port_selfblock=plan.port_selfblock)
+        np.asarray(res)
+
     def _dispatch(self, state, plan, n_active: int, carry):
         """The ONLY schedule_batch call site. Every dispatch — warm or live —
         must be call-signature-identical (kwarg set included: static kwargs
@@ -344,7 +706,7 @@ class TPUScheduler(Scheduler):
         self._resume = None
         if (resume is not None
                 and resume[0] == (id(fw), sig, self.cluster_event_seq,
-                                  self.attempts)):
+                                  self.attempts, self.state_unwinds)):
             # Nothing happened since the last clean session of this exact
             # signature: the mirror is device-resident, the feature plan is
             # still exact, and the final carry reflects every placement —
@@ -356,6 +718,7 @@ class TPUScheduler(Scheduler):
             self.plan_build_s += _time.perf_counter() - _t0
             node_names = [ni.name for ni in self.snapshot.node_info_list]
         start_seq = self.cluster_event_seq
+        start_unwinds = self.state_unwinds
         inflight: List[Tuple[List[QueuedPodInfo], object]] = []
         ok_rows: List[int] = []
         dirty_rows: List[int] = []
@@ -408,9 +771,11 @@ class TPUScheduler(Scheduler):
                 invalidated = self._commit_batch(
                     b, res, fw, node_names, ok_rows, dirty_rows)
                 self.host_commit_s += _time.perf_counter() - _t1
-                if self.cluster_event_seq != start_seq:
+                if (self.cluster_event_seq != start_seq
+                        or self.state_unwinds != start_unwinds):
                     invalidated = True
                     start_seq = self.cluster_event_seq
+                    start_unwinds = self.state_unwinds
             else:
                 # A previous batch diverged: every later device choice is
                 # stale. Host-path the pods and charge their rows dirty.
@@ -439,7 +804,8 @@ class TPUScheduler(Scheduler):
                               dirty_rows=dirty_rows)
             if carry is not None and not dirty_rows:
                 self._resume = (
-                    (id(fw), sig, self.cluster_event_seq, self.attempts),
+                    (id(fw), sig, self.cluster_event_seq, self.attempts,
+                     self.state_unwinds),
                     (state, plan, carry, node_names))
 
     def _commit_batch(self, b, res, fw, node_names, ok_rows, dirty_rows) -> bool:
@@ -498,7 +864,8 @@ class TPUScheduler(Scheduler):
         cluster changes, our own binds, and nominations (sessions never run
         with nominated pods present, but the key guards the invariant)."""
         return (fw.sign_pod(pod), pod.priority, self.cluster_event_seq,
-                self.scheduled, self.queue.nominator.has_nominated_pods())
+                self.scheduled, self.state_unwinds,
+                self.queue.nominator.has_nominated_pods())
 
     def _fail_from_memo(self, fw: Framework, qpi: QueuedPodInfo) -> bool:
         """An identical pod was already host-diagnosed unschedulable against
@@ -664,6 +1031,14 @@ class TPUScheduler(Scheduler):
         fw, batch, fallback_reason = self._collect_batch()
         if not batch:
             return False
+        if fallback_reason is _GANG_SESSION:
+            try:
+                self.run_gang_device_session(fw, batch[0])
+            except Unsupported:
+                for qpi in batch:
+                    self.host_path_pods += len(getattr(qpi, "members", ()) or (1,))
+                    self.process_one(qpi)
+            return True
         if fallback_reason is None and len(batch) >= 1:
             pr = self._device_unsupported_profile(fw, batch[0].pod)
             if pr is not None:

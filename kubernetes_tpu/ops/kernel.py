@@ -242,7 +242,11 @@ def schedule_batch(
                       and not has_ipa_base and not has_na_pref)
     # No cross-window coupling at all: place a whole lap of pods per
     # iteration (the fast path for fit-only and hostname-anti-affinity pods).
-    static_scores = incremental_feas and scores_carried
+    # Small batches (gang-sized placement sims) stay on the scan path — its
+    # per-step body is ~6 fused ops vs the lap's [LAP_MAX, NP] window
+    # tensors, and a 4-member gang gets no lap parallelism anyway (with
+    # truncation inactive every window spans the whole rotation, L=1).
+    static_scores = incremental_feas and scores_carried and batch_pad > 64
 
     taint_ok, pns_cnt, sel_ok, name_ok, unsched_ok, exist_anti_ok = _static_masks(state, f)
 
@@ -516,6 +520,53 @@ def schedule_batch(
     # (NodeStateMirror.adopt) instead of re-uploading — the device-side
     # analogue of the incremental snapshot.
     return jnp.stack([chosen, starts]), ScanCarry(*final[:13])
+
+
+@partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax",
+                                   "has_pns", "has_na_pref",
+                                   "port_selfblock"))
+def schedule_placements(
+    state: DeviceNodeState,
+    f: BatchFeatures,
+    batch_pad: int,
+    fit_strategy: int,
+    vmax: int,
+    masks: jnp.ndarray,          # [P, NP] bool candidate-placement row masks
+    n_active: Optional[jnp.ndarray] = None,
+    has_pns: bool = True,
+    has_na_pref: bool = False,
+    port_selfblock: bool = False,
+) -> jnp.ndarray:
+    """Evaluate a pod group against P candidate placements IN PARALLEL — the
+    device form of podGroupSchedulingPlacementAlgorithm's per-placement
+    simulation loop (schedule_one_podgroup.go:971): each lane restricts the
+    node universe to one placement's rows and runs the full greedy member
+    assignment from the CURRENT cluster state (fresh carry — simulations
+    never contaminate the resident state). Returns the stacked [P, 2, B]
+    results; the host gates lanes with PlacementFeasible and scores the
+    survivors (findBestPodGroupPlacement :1173).
+
+    Placement simulations evaluate their whole candidate (no adaptive
+    truncation) from rotation origin 0 — the host oracle uses the identical
+    spec (core/scheduler.py _evaluate_placement), making host and device
+    placement evaluation bit-identical for restriction-invariant plans
+    (no topology-spread / inter-pod-affinity / image terms; see
+    models/tpu_scheduler.py _placement_plan_restriction_invariant)."""
+
+    def one(mask):
+        f2 = f._replace(
+            extra_ok=f.extra_ok & mask,
+            start_index=jnp.int32(0),
+            to_find=f.num_nodes,
+        )
+        results, _carry = schedule_batch.__wrapped__(
+            state, f2, batch_pad, fit_strategy, vmax,
+            n_active=n_active, carry_in=None,
+            has_pns=has_pns, has_ipa_base=False, anti_rowlocal=False,
+            has_na_pref=has_na_pref, port_selfblock=port_selfblock)
+        return results
+
+    return jax.vmap(one)(masks)
 
 
 # Max pods placed per lap iteration (bounds the segment tensors; L_full =
