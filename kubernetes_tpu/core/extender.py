@@ -42,6 +42,7 @@ class Extender:
     filter_verb: str = ""        # "" = extender doesn't filter
     prioritize_verb: str = ""
     bind_verb: str = ""
+    preempt_verb: str = ""       # "" = extender doesn't process preemption
     weight: int = 1
     node_cache_capable: bool = False     # send node names only
     ignorable: bool = False              # errors don't fail scheduling
@@ -65,6 +66,9 @@ class Extender:
 
     def supports_bind(self) -> bool:
         return bool(self.bind_verb)
+
+    def supports_preemption(self) -> bool:
+        return bool(self.preempt_verb)
 
     # -- verbs -------------------------------------------------------------
 
@@ -103,6 +107,43 @@ class Extender:
             out[item["host"]] = int(item["score"]) * self.weight
         return out
 
+    def process_preemption(
+        self, pod: Pod, node_name_to_victims: Dict[str, list]
+    ) -> Tuple[Dict[str, list], Optional[str]]:
+        """ProcessPreemption (extender.go:46-49 / :310): send the candidate
+        victim map; the extender returns the subset of nodes (possibly with
+        trimmed victim lists) it accepts for preemption. Response shape
+        mirrors extender/v1 ExtenderPreemptionResult (NodeNameToMetaVictims,
+        collapsed to victim-uid lists here). Unlisted nodes are dropped;
+        errors drop the extender's input unless `ignorable`."""
+        payload = {
+            "pod": {"name": pod.name, "namespace": pod.namespace, "uid": pod.uid},
+            "nodeNameToVictims": {
+                node: [pi.pod.uid for pi in victims]
+                for node, victims in node_name_to_victims.items()},
+        }
+        try:
+            resp = self.transport("preempt", payload)
+        except Exception as e:  # noqa: BLE001
+            if self.ignorable:
+                return node_name_to_victims, None
+            return {}, str(e)
+        if resp.get("error"):
+            if self.ignorable:
+                return node_name_to_victims, None
+            return {}, resp["error"]
+        accepted = resp.get("nodeNameToVictims")
+        if accepted is None:
+            return node_name_to_victims, None
+        out = {}
+        for node, uids in accepted.items():
+            victims = node_name_to_victims.get(node)
+            if victims is None:
+                continue
+            keep = set(uids)
+            out[node] = [pi for pi in victims if pi.pod.uid in keep]
+        return out, None
+
     def bind(self, pod: Pod, node_name: str) -> Optional[str]:
         try:
             resp = self.transport("bind", {
@@ -128,6 +169,26 @@ def run_extender_filters(
         for node, reason in failed.items():
             diagnosis.node_to_status[node] = Status.unschedulable(reason)
     return feasible, None
+
+
+def run_extender_preemption(
+    extenders: Sequence[Extender], pod: Pod,
+    node_name_to_victims: Dict[str, list],
+) -> Tuple[Dict[str, list], Optional[str]]:
+    """preemption.go callExtenders: chain ProcessPreemption through every
+    preempt-capable interested extender, narrowing the candidate map. A
+    non-ignorable transport error surfaces as (original_map_unused, error) —
+    the attempt must fail retryably, not park the pod unresolvable."""
+    for ext in extenders:
+        if not node_name_to_victims:
+            break
+        if not ext.supports_preemption() or not ext.is_interested(pod):
+            continue
+        node_name_to_victims, err = ext.process_preemption(
+            pod, node_name_to_victims)
+        if err is not None:
+            return {}, err
+    return node_name_to_victims, None
 
 
 def run_extender_prioritize(

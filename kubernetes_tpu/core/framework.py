@@ -278,6 +278,19 @@ class Framework:
         self.placement_feasible_plugins = self._having("placement_feasible")
         self.placement_score_plugins = self._having_weighted("score_placement")
         self.pod_group_post_filter_plugins = self._having("pod_group_post_filter")
+        # Per-plugin QueueingHintFn registrations (EventsToRegister →
+        # ClusterEventWithHint, framework/types.go:217): plugin name →
+        # {event: [hint fn or None]}. Plugins without events_to_register
+        # fall back to the queue's static event map.
+        self.queueing_hint_map: Dict[str, Dict[str, List[Any]]] = {}
+        for p, _w in self._plugins:
+            etr = getattr(p, "events_to_register", None)
+            if etr is None:
+                continue
+            m: Dict[str, List[Any]] = {}
+            for event, fn in etr():
+                m.setdefault(event, []).append(fn)
+            self.queueing_hint_map[p.name] = m
         # Optional dense batch evaluator (the TPU backend) — set by
         # kubernetes_tpu/models pipeline when the device profile is active.
         self.batch_evaluator = None
@@ -528,6 +541,30 @@ class Framework:
                 st.plugin = p.name
                 return st
         return OK
+
+    def run_pre_bind_pre_flight(self, state: CycleState, pod: Pod,
+                                node_name: str) -> Status:
+        """PreBindPreFlight (staging kube-scheduler framework
+        interface.go:688-694, runtime/framework.go:1875): ask each PreBind
+        plugin whether it intends to do any work for this pod. Plugins
+        answering Skip are recorded in state.skip_pre_bind_plugins; returns
+        Skip when EVERY PreBind plugin skips (the binding cycle may then
+        bypass the PreBind phase entirely — the async-binding enabler)."""
+        all_skip = True
+        for p in self.pre_bind_plugins:
+            flight = getattr(p, "pre_bind_pre_flight", None)
+            if flight is None:
+                all_skip = False
+                continue
+            st = flight(state, pod, node_name)
+            if st.is_skip():
+                state.skip_pre_bind_plugins.add(p.name)
+            elif not st.is_success():
+                st.plugin = p.name
+                return st
+            else:
+                all_skip = False
+        return Status.skip() if all_skip else OK
 
     def run_pre_bind_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         for p in self.pre_bind_plugins:

@@ -263,8 +263,10 @@ class PriorityQueue:
         now: Callable[[], float] = time.monotonic,
         pop_from_backoff_q: bool = True,
         gang_enabled: bool = True,
+        queueing_hints_enabled: bool = True,
     ):
         self.framework = framework
+        self.queueing_hints_enabled = queueing_hints_enabled
         self.now = now
         self.initial_backoff = initial_backoff
         self.max_backoff = max_backoff
@@ -490,20 +492,45 @@ class PriorityQueue:
             return
         self.unschedulable[uid] = qpi
 
-    def _events_relevant(self, qpi, events: List[str]) -> bool:
+    def _events_relevant(self, qpi, events: List) -> bool:
         """isPodWorthRequeuing (scheduling_queue.go:582): does any of the
         events plausibly resolve one of the plugins that rejected this
-        entity? Unknown rejection causes requeue on anything."""
+        entity? Per-plugin QueueingHintFn callbacks (EventsToRegister →
+        ClusterEventWithHint; framework/types.go:217) are evaluated over the
+        event's (old, new) objects when the plugin registered them; plugins
+        without callbacks fall back to the static event map; unknown
+        rejection causes requeue on anything. Events arrive as plain strings
+        or (event, old, new) tuples."""
         plugins = qpi.unschedulable_plugins
         if not plugins:
             return True
-        for event in events:
+        hint_map = (getattr(self.framework, "queueing_hint_map", None)
+                    if self.queueing_hints_enabled else None)
+        for ev in events:
+            event, old, new = ev if isinstance(ev, tuple) else (ev, None, None)
             if event in (EVENT_UNSCHEDULABLE_TIMEOUT, EVENT_FORCE_ACTIVATE):
                 return True
             for p in plugins:
-                hints = QUEUEING_HINTS.get(p)
-                if hints is None or event in hints:
-                    return True
+                registered = hint_map.get(p) if hint_map is not None else None
+                if registered is None:
+                    hints = QUEUEING_HINTS.get(p)
+                    if hints is None or event in hints:
+                        return True
+                    continue
+                fns = registered.get(event)
+                if fns is None:
+                    # Plugin registered its events and this isn't one of
+                    # them: the event cannot help this rejection.
+                    continue
+                pod = qpi.pod
+                for fn in fns:
+                    if fn is None:
+                        return True  # no hint fn: always Queue
+                    try:
+                        if fn(pod, old, new):
+                            return True
+                    except Exception:  # noqa: BLE001 - hint errors → Queue
+                        return True   # (the reference logs and queues)
         return False
 
     def _move_to_active_or_backoff(self, qpi) -> None:
@@ -523,13 +550,15 @@ class PriorityQueue:
             qpi.timestamp = self.now()
             self.active_q.push(qpi)
 
-    def move_all_to_active_or_backoff(self, event: str) -> None:
+    def move_all_to_active_or_backoff(self, event: str, old=None, new=None) -> None:
         """MoveAllToActiveOrBackoffQueue (scheduling_queue.go:1817), with
-        per-plugin QueueingHint filtering. Gated pods are skipped via the
-        map's non-gated index — cluster events must cost O(requeue-able
-        pods), not O(gated pods) (the SchedulingWhileGated perf contract:
-        10k parked gated pods while deletes fire during the window)."""
+        per-plugin QueueingHint filtering over the event's (old, new)
+        objects. Gated pods are skipped via the map's non-gated index —
+        cluster events must cost O(requeue-able pods), not O(gated pods)
+        (the SchedulingWhileGated perf contract: 10k parked gated pods while
+        deletes fire during the window)."""
         self.moved_count += 1
+        ev = (event, old, new)
         uids = (list(self.unschedulable.keys()) if event == EVENT_FORCE_ACTIVATE
                 else list(self.unschedulable.non_gated))
         for uid in uids:
@@ -538,12 +567,12 @@ class PriorityQueue:
                 continue
             if qpi.gated and event != EVENT_FORCE_ACTIVATE:
                 continue
-            if not self._events_relevant(qpi, [event]):
+            if not self._events_relevant(qpi, [ev]):
                 continue
             del self.unschedulable[uid]
             self._move_to_active_or_backoff(qpi)
         for events in self._in_flight.values():
-            events.append(event)
+            events.append(ev)
 
     def flush_backoff_completed(self) -> None:
         """backoffQ flush loop (scheduling_queue.go Run :503)."""

@@ -114,6 +114,10 @@ class Handle:
     def api_dispatcher(self):
         return self._scheduler.api_dispatcher
 
+    @property
+    def extenders(self):
+        return self._scheduler.extenders
+
     # waiting pods (Permit WAIT; framework.Handle IterateOverWaitingPods /
     # GetWaitingPod surface, collapsed to allow/reject by uid)
     def allow_waiting_pod(self, uid: str) -> bool:
@@ -188,6 +192,7 @@ class Scheduler:
         from .features import (
             GENERIC_WORKLOAD,
             SCHEDULER_POP_FROM_BACKOFF_Q,
+            SCHEDULER_QUEUEING_HINTS,
             FeatureGates,
         )
         from .metrics import SchedulerMetrics
@@ -231,6 +236,7 @@ class Scheduler:
             now=now,
             pop_from_backoff_q=self.gates.enabled(SCHEDULER_POP_FROM_BACKOFF_Q),
             gang_enabled=self.gates.enabled(GENERIC_WORKLOAD),
+            queueing_hints_enabled=self.gates.enabled(SCHEDULER_QUEUEING_HINTS),
         )
         # Extenders (extender.go; config extenders or injected objects).
         from .extender import Extender, http_transport
@@ -244,6 +250,7 @@ class Scheduler:
                     filter_verb=e.get("filterVerb", ""),
                     prioritize_verb=e.get("prioritizeVerb", ""),
                     bind_verb=e.get("bindVerb", ""),
+                    preempt_verb=e.get("preemptVerb", ""),
                     weight=e.get("weight", 1),
                     ignorable=e.get("ignorable", False),
                     managed_resources=tuple(e.get("managedResources", ())),
@@ -362,7 +369,8 @@ class Scheduler:
         if kind == "add":
             if new.node_name:
                 self.cache.add_pod(new)
-                self.queue.move_all_to_active_or_backoff(EVENT_ASSIGNED_POD_ADD)
+                self.queue.move_all_to_active_or_backoff(
+                    EVENT_ASSIGNED_POD_ADD, None, new)
             elif self._responsible_for_pod(new):
                 self.queue.add(new)
         elif kind == "update":
@@ -377,7 +385,8 @@ class Scheduler:
         elif kind == "delete":
             if new.node_name:
                 self.cache.remove_pod(new)
-                self.queue.move_all_to_active_or_backoff(EVENT_ASSIGNED_POD_DELETE)
+                self.queue.move_all_to_active_or_backoff(
+                    EVENT_ASSIGNED_POD_DELETE, new, None)
             else:
                 self.queue.delete(new)
 
@@ -385,10 +394,11 @@ class Scheduler:
         self.cluster_event_seq += 1
         if kind == "add":
             self.cache.add_node(new)
-            self.queue.move_all_to_active_or_backoff(EVENT_NODE_ADD)
+            self.queue.move_all_to_active_or_backoff(EVENT_NODE_ADD, None, new)
         elif kind == "update":
             self.cache.update_node(new)
-            self.queue.move_all_to_active_or_backoff(EVENT_NODE_UPDATE)
+            self.queue.move_all_to_active_or_backoff(
+                EVENT_NODE_UPDATE, old, new)
         elif kind == "delete":
             self.cache.remove_node(new.name)
 
@@ -509,8 +519,10 @@ class Scheduler:
         cycle and the device path's vectorized diagnosis."""
         pod = qpi.pod
         if fw.post_filter_plugins:
+            _t = time.perf_counter()
             result, post_st = fw.run_post_filter_plugins(
                 state, pod, fe.diagnosis.node_to_status)
+            self._observe_point("PostFilter", _t, post_st.is_success())
             nominated = getattr(result, "nominating_info", None) if result else None
             if post_st.is_success() and nominated:
                 pod.nominated_node_name = nominated
@@ -530,13 +542,16 @@ class Scheduler:
         assumed = pod
         assumed.node_name = result.suggested_host
         self.cache.assume_pod(assumed, qpi.pod_info)
+        _t = time.perf_counter()
         st = fw.run_reserve_plugins_reserve(state, assumed, result.suggested_host)
+        _t = self._observe_point("Reserve", _t, st.is_success())
         if not st.is_success():
             fw.run_reserve_plugins_unreserve(state, assumed, result.suggested_host)
             self.cache.forget_pod(assumed)
             assumed.node_name = ""
             raise RuntimeError(f"reserve failed: {st.message()}")
         st = fw.run_permit_plugins(state, assumed, result.suggested_host)
+        self._observe_point("Permit", _t, not st.is_rejected())
         if st.is_rejected():
             fw.run_reserve_plugins_unreserve(state, assumed, result.suggested_host)
             self.cache.forget_pod(assumed)
@@ -868,12 +883,23 @@ class Scheduler:
             feasible_nodes=len(feasible),
         )
 
+    def _observe_point(self, point: str, t0: float, ok: bool = True) -> float:
+        """framework_extension_point_duration_seconds observation; returns a
+        fresh perf_counter for chaining (one call per point per cycle —
+        Histogram.observe is O(1))."""
+        t1 = time.perf_counter()
+        self.metrics.framework_extension_point_duration.observe(
+            t1 - t0, point, "Success" if ok else "Error", "")
+        return t1
+
     def find_nodes_that_fit_pod(
         self, fw: Framework, state: CycleState, pod: Pod
     ) -> Tuple[List[NodeInfo], Diagnosis]:
         diagnosis = Diagnosis()
         all_nodes = self.snapshot.node_info_list
+        _t = time.perf_counter()
         pre_res, st = fw.run_pre_filter_plugins(state, pod, all_nodes)
+        _t = self._observe_point("PreFilter", _t, st.is_success())
         if not st.is_success():
             if st.is_rejected():
                 diagnosis.pre_filter_msg = st.message()
@@ -904,6 +930,7 @@ class Scheduler:
                 # list, schedule_one.go:630).
                 nodes = [ni for ni in all_nodes if ni.name in pre_res.node_names]
         feasible = self.find_nodes_that_pass_filters(fw, state, pod, diagnosis, nodes)
+        self._observe_point("Filter", _t)
         if feasible and self.extenders:
             from .extender import run_extender_filters
             feasible, err = run_extender_filters(self.extenders, pod, feasible, diagnosis)
@@ -945,10 +972,13 @@ class Scheduler:
     def prioritize_nodes(
         self, fw: Framework, state: CycleState, pod: Pod, nodes: Sequence[NodeInfo]
     ) -> List[NodeScore]:
+        _t = time.perf_counter()
         st = fw.run_pre_score_plugins(state, pod, nodes)
+        _t = self._observe_point("PreScore", _t, st.is_success())
         if not st.is_success():
             raise RuntimeError(f"prescore failed: {st.message()}")
         plugin_scores = fw.run_score_plugins(state, pod, nodes)
+        self._observe_point("Score", _t)
         total = [NodeScore(ni.name, 0) for ni in nodes]
         for scores in plugin_scores.values():
             for i, ns in enumerate(scores):
@@ -982,11 +1012,21 @@ class Scheduler:
         """Returns True iff the pod was bound (False: unwound + requeued)."""
         pod = qpi.pod
         node_name = result.suggested_host
+        _t = time.perf_counter()
         if fw.pre_bind_plugins:
-            st = fw.run_pre_bind_plugins(state, pod, node_name)
-            if not st.is_success():
+            # PreBindPreFlight (runtime/framework.go:1875): plugins that
+            # declare no work for this pod are skipped; all-skip bypasses
+            # the PreBind phase.
+            st = fw.run_pre_bind_pre_flight(state, pod, node_name)
+            if not st.is_success() and not st.is_skip():
                 self._unwind_binding(fw, state, qpi, node_name, st)
                 return False
+            if not st.is_skip():
+                st = fw.run_pre_bind_plugins(state, pod, node_name)
+                _t = self._observe_point("PreBind", _t, st.is_success())
+                if not st.is_success():
+                    self._unwind_binding(fw, state, qpi, node_name, st)
+                    return False
         # Extender bind delegation (schedule_one.go:1100 bind: an interested
         # extender with a bind verb binds instead of the bind plugins).
         bind_ext = next(
@@ -998,6 +1038,7 @@ class Scheduler:
             st = Status() if err is None else Status.error(err)
         else:
             st = fw.run_bind_plugins(state, pod, node_name)
+        self._observe_point("Bind", _t, st.is_success())
         if not st.is_success():
             self._unwind_binding(fw, state, qpi, node_name, st)
             return False
@@ -1019,7 +1060,8 @@ class Scheduler:
         fw.run_reserve_plugins_unreserve(state, pod, node_name)
         self.cache.forget_pod(pod)
         pod.node_name = ""
-        self.queue.move_all_to_active_or_backoff(EVENT_ASSIGNED_POD_DELETE)
+        self.queue.move_all_to_active_or_backoff(
+            EVENT_ASSIGNED_POD_DELETE, pod, None)
         self.handle_scheduling_failure(fw, qpi, st, None)
 
     # -- failure (schedule_one.go:1152 handleSchedulingFailure) ------------

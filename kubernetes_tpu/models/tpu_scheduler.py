@@ -45,6 +45,15 @@ from ..ops.kernel import schedule_batch
 _GANG_SESSION = "__gang_device_session__"
 
 
+def _pow2_pad(n: int) -> int:
+    """Placement-axis pow2 tier (shared by warm + live paths so the warm
+    compile always matches the live kernel shape)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 class TPUScheduler(Scheduler):
     """Scheduler with the hot path on device. Falls back per-pod to the host
     path for uncovered features; host and device paths produce identical
@@ -439,9 +448,7 @@ class TPUScheduler(Scheduler):
         npc = self.mirror.np_cap
         # Pad the placement axis to a pow2 tier so XLA compiles once per
         # (placement tier, batch tier), not once per candidate count.
-        p_pad = 1
-        while p_pad < len(placements):
-            p_pad *= 2
+        p_pad = _pow2_pad(len(placements))
         # Mask cache: candidate placements for one topology key are identical
         # across a stream of identical groups (same domains, same rows).
         mkey = (self.cluster_event_seq, p_pad, npc,
@@ -613,9 +620,7 @@ class TPUScheduler(Scheduler):
             return
         if not self._placement_plan_restriction_invariant(plan):
             return
-        p_pad = 1
-        while p_pad < max(1, n_placements):
-            p_pad *= 2
+        p_pad = _pow2_pad(max(1, n_placements))
         masks = jnp.zeros((p_pad, self.mirror.np_cap), bool)
         res = schedule_placements(
             state, plan.features, plan.batch_pad, plan.fit_strategy,

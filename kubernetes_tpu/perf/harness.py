@@ -632,6 +632,19 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
         v = getattr(sched, attr, None)
         if v is not None:
             result.detail[attr] = round(v, 3) if isinstance(v, float) else v
+    # Per-extension-point latency (scheduler_perf.go:866-871 collects the
+    # framework_extension_point_duration_seconds histogram per workload).
+    hist = sched.metrics.framework_extension_point_duration
+    points = {}
+    for key in list(hist._totals):
+        label = key[0] if key[1] == "Success" else f"{key[0]}/{key[1]}"
+        points[label] = {
+            "count": hist.count(*key),
+            "p50_ms": round(hist.percentile(0.50, *key) * 1e3, 3),
+            "p99_ms": round(hist.percentile(0.99, *key) * 1e3, 3),
+        }
+    if points:
+        result.detail["extension_points"] = points
     # in-flight invariant (scheduler_perf.go:878-880 checkEmptyInFlightEvents)
     assert not sched.queue._in_flight, "in-flight events remain after workload"
     return result

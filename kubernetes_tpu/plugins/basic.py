@@ -237,6 +237,19 @@ class TaintToleration:
     name = "TaintToleration"
     _KEY = "PreScoreTaintToleration"
 
+    def events_to_register(self):
+        """taint_toleration.go EventsToRegister: node add/update with a
+        toleration check (isSchedulableAfterNodeChange)."""
+        from ..core.queue import EVENT_NODE_ADD, EVENT_NODE_UPDATE
+        return [(EVENT_NODE_ADD, self._hint_node),
+                (EVENT_NODE_UPDATE, self._hint_node)]
+
+    @staticmethod
+    def _hint_node(pod: Pod, old, new) -> bool:
+        if new is None:
+            return True
+        return find_matching_untolerated_taint(new.taints, pod.tolerations) is None
+
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
         node = node_info.node
         if node is None:
@@ -283,6 +296,20 @@ class NodeAffinity:
     """
 
     name = "NodeAffinity"
+
+    def events_to_register(self):
+        """node_affinity.go EventsToRegister / isSchedulableAfterNodeChange:
+        a node event helps only if the new node matches the pod's required
+        selector/affinity."""
+        from ..core.queue import EVENT_NODE_ADD, EVENT_NODE_UPDATE
+        return [(EVENT_NODE_ADD, self._hint_node),
+                (EVENT_NODE_UPDATE, self._hint_node)]
+
+    @staticmethod
+    def _hint_node(pod: Pod, old, new) -> bool:
+        if new is None:
+            return True
+        return pod.required_node_selector_matches(new)
 
     def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Tuple[Optional[PreFilterResult], Status]:
         na = pod.affinity.node_affinity if pod.affinity else None

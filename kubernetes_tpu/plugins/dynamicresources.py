@@ -183,6 +183,14 @@ class DynamicResources:
             self.assumed.pop(claim.key, None)
             self.assumed_nodes.pop(claim.key, None)
 
+    def pre_bind_pre_flight(self, state: CycleState, pod: Pod,
+                            node_name: str) -> Status:
+        """PreBindPreFlight (dynamicresources.go PreBindPreFlight): Skip
+        when the pod references no resource claims."""
+        if not getattr(pod, "resource_claims", None):
+            return Status.skip()
+        return OK
+
     def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         s: Optional[DynamicResources._State] = state.read(self._KEY)
         if s is None:

@@ -75,6 +75,56 @@ class InterPodAffinity:
                 return fn(ns)
         return None
 
+    # -- QueueingHints (interpodaffinity EventsToRegister /
+    # isSchedulableAfterPodChange) ------------------------------------------
+
+    def events_to_register(self):
+        from ..core.queue import (EVENT_ASSIGNED_POD_ADD,
+                                  EVENT_ASSIGNED_POD_DELETE, EVENT_NODE_ADD,
+                                  EVENT_NODE_UPDATE, EVENT_POD_DELETE)
+        return [
+            (EVENT_ASSIGNED_POD_ADD, self._hint_pod),
+            (EVENT_ASSIGNED_POD_DELETE, self._hint_pod),
+            (EVENT_POD_DELETE, self._hint_pod),
+            (EVENT_NODE_ADD, None),     # topology domains may appear
+            (EVENT_NODE_UPDATE, None),  # (label changes) — always queue
+        ]
+
+    @staticmethod
+    def _hint_terms(pod: Pod):
+        """Per-pod memo of compiled required terms: hint fns run once per
+        parked pod per cluster event (O(events x pods)), and the compiled
+        terms are constant per pod spec."""
+        cached = pod.__dict__.get("_ipa_hint_terms")
+        if cached is None:
+            pi = PodInfo.of(pod)
+            cached = pod._ipa_hint_terms = (
+                compile_terms(pi.required_affinity_terms, pod),
+                compile_terms(pi.required_anti_affinity_terms, pod),
+            )
+        return cached
+
+    def _hint_pod(self, pod: Pod, old, new) -> bool:
+        """A pod add can satisfy a required affinity term; a pod delete can
+        clear an anti-affinity conflict (in either direction). Queue only
+        when the other pod matches one of this pod's required terms, or this
+        pod matches the other's anti terms (isSchedulableAfterPodChange)."""
+        other = new if new is not None else old
+        if other is None:
+            return True
+        aff_terms, anti_terms = self._hint_terms(pod)
+        for term in aff_terms:
+            if term.matches(other, self._ns_labels):
+                return True
+        for term in anti_terms:
+            if term.matches(other, self._ns_labels):
+                return True
+        o_aff, o_anti = self._hint_terms(other)
+        for term in o_anti:
+            if term.matches(pod, self._ns_labels):
+                return True
+        return False
+
     # -- PreFilter ---------------------------------------------------------
 
     def pre_filter(self, state: CycleState, pod: Pod, nodes: Sequence[NodeInfo]) -> Tuple[Optional[PreFilterResult], Status]:

@@ -148,6 +148,41 @@ class Fit:
         self.resources = tuple(resources)
         self.shape = tuple(shape)
 
+    # -- QueueingHints (fit.go EventsToRegister / isSchedulableAfterNodeChange
+    # / isSchedulableAfterPodEvent) -----------------------------------------
+
+    def events_to_register(self):
+        from ..core.queue import (EVENT_ASSIGNED_POD_DELETE, EVENT_NODE_ADD,
+                                  EVENT_NODE_UPDATE, EVENT_POD_DELETE)
+        return [
+            (EVENT_NODE_ADD, self._hint_node_change),
+            (EVENT_NODE_UPDATE, self._hint_node_change),
+            # Deletes always queue: every pod delete frees a pod slot, and
+            # a Fit rejection may be pod-count-bound regardless of the
+            # pending pod's resource requests (fits() "Too many pods") —
+            # a freed-resource overlap test would strand such pods until
+            # the unschedulable timeout.
+            (EVENT_ASSIGNED_POD_DELETE, None),
+            (EVENT_POD_DELETE, None),
+        ]
+
+    @staticmethod
+    def _hint_node_change(pod: Pod, old, new) -> bool:
+        """Queue only when the (new/updated) node could satisfy the
+        request outright (fit.go isSchedulableAfterNodeChange)."""
+        if new is None:
+            return True
+        req = pod.resource_request()
+        alloc = new.allocatable
+        if req.milli_cpu > alloc.milli_cpu or req.memory > alloc.memory:
+            return False
+        if req.ephemeral_storage > alloc.ephemeral_storage:
+            return False
+        for name, amount in req.scalar_resources.items():
+            if amount > alloc.scalar_resources.get(name, 0):
+                return False
+        return True
+
     # -- filter -----------------------------------------------------------
 
     def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Tuple[Optional[PreFilterResult], Status]:

@@ -150,6 +150,61 @@ class PodTopologySpread:
         self.handle = handle
         self.default_constraints = tuple(default_constraints)
 
+    # -- QueueingHints (pod_topology_spread.go EventsToRegister /
+    # isSchedulableAfterPodChange / isSchedulableAfterNodeChange) -----------
+
+    def events_to_register(self):
+        from ..core.queue import (EVENT_ASSIGNED_POD_ADD,
+                                  EVENT_ASSIGNED_POD_DELETE, EVENT_NODE_ADD,
+                                  EVENT_NODE_UPDATE, EVENT_POD_DELETE)
+        return [
+            (EVENT_ASSIGNED_POD_ADD, self._hint_pod),
+            (EVENT_ASSIGNED_POD_DELETE, self._hint_pod),
+            (EVENT_POD_DELETE, self._hint_pod),
+            (EVENT_NODE_ADD, self._hint_node),
+            (EVENT_NODE_UPDATE, self._hint_node),
+        ]
+
+    @staticmethod
+    def _hint_constraints(pod: Pod):
+        """Per-pod memo of compiled DoNotSchedule constraints (hint fns run
+        once per parked pod per cluster event)."""
+        cached = pod.__dict__.get("_pts_hint_constraints")
+        if cached is None:
+            cached = pod._pts_hint_constraints = _compile_constraints(
+                pod, DO_NOT_SCHEDULE)
+        return cached
+
+    def _hint_pod(self, pod: Pod, old, new) -> bool:
+        """A pod change matters only if the other pod matches a constraint
+        selector in this pod's namespace (isSchedulableAfterPodChange)."""
+        other = new if new is not None else old
+        if other is None:
+            return True
+        if other.namespace != pod.namespace:
+            return False
+        for c in self._hint_constraints(pod):
+            if c.selector.matches(other.labels):
+                return True
+        return False
+
+    def _hint_node(self, pod: Pod, old, new) -> bool:
+        """A node event matters if the node carries every constraint
+        topology key — or if an UPDATE changed/removed a topology label
+        (a vanishing min-count domain can raise the global min and clear
+        the skew rejection) (isSchedulableAfterNodeChange)."""
+        if new is None:
+            return True
+        constraints = self._hint_constraints(pod)
+        if old is not None and any(
+                old.labels.get(c.topology_key) != new.labels.get(c.topology_key)
+                for c in constraints):
+            return True
+        for c in constraints:
+            if c.topology_key not in new.labels:
+                return False
+        return True
+
     # -- eligibility -------------------------------------------------------
 
     @staticmethod

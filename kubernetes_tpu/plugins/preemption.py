@@ -296,6 +296,30 @@ class DefaultPreemption:
         if not candidates:
             return None, Status.unresolvable(
                 "preemption: 0/%d nodes are available" % max(1, snapshot.num_nodes()))
+        # Extender preempt verb (preemption.go callExtenders /
+        # extender.go:46-49 ProcessPreemption): preempt-capable extenders
+        # narrow the candidate victim map before selection.
+        extenders = getattr(self.handle, "extenders", None) or ()
+        if any(e.supports_preemption() for e in extenders):
+            from ..core.extender import run_extender_preemption
+            victim_map = {c.node_name: c.victims for c in candidates}
+            victim_map, err = run_extender_preemption(extenders, pod, victim_map)
+            if err is not None:
+                # Retryable failure (preemption.go callExtenders → AsStatus):
+                # the attempt errors; it must NOT park the pod unresolvable.
+                return None, Status.error(f"extender preemption: {err}")
+            candidates = [
+                # num_pdb_violations carries over only because no PDB API
+                # exists yet (always 0); with PDBs it must be recomputed
+                # from the trimmed victim list.
+                Candidate(node_name=c.node_name,
+                          victims=victim_map[c.node_name],
+                          num_pdb_violations=c.num_pdb_violations)
+                for c in candidates
+                if c.node_name in victim_map and victim_map[c.node_name]]
+            if not candidates:
+                return None, Status.unresolvable(
+                    "preemption: extenders rejected all candidates")
         best = self.evaluator.select_candidate(candidates)
         self.evaluator.prepare_candidate(best, pod)
         if metrics is not None:

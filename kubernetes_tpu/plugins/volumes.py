@@ -181,6 +181,14 @@ class VolumeBinding:
             if pv_name and self.assumed.get(pv_name) == pvc.key:
                 del self.assumed[pv_name]
 
+    def pre_bind_pre_flight(self, state: CycleState, pod: Pod,
+                            node_name: str) -> Status:
+        """PreBindPreFlight (volume_binding.go PreBindPreFlight): Skip when
+        the pod carries no PVC-backed volumes — PreBind would be a no-op."""
+        if not any(v.pvc_name for v in pod.volumes):
+            return Status.skip()
+        return OK
+
     def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         """BindPodVolumes (binder.go): write the PV↔PVC binds (and node
         selection for provisioning) through the API."""

@@ -117,3 +117,60 @@ class TestMetrics:
         s.run_until_idle()
         assert s.metrics.batch_attempts.value("dispatched") >= 1
         assert s.metrics.batch_size.count() >= 1
+
+
+def test_pre_bind_pre_flight_skips_and_runs():
+    """PreBindPreFlight (runtime/framework.go:1875): all-Skip bypasses the
+    PreBind phase; a declaring plugin still runs when it has work."""
+    from kubernetes_tpu.core.framework import CycleState, Framework, OK, Status
+
+    ran = []
+
+    class Flighty:
+        name = "Flighty"
+
+        def __init__(self, skip):
+            self._skip = skip
+
+        def pre_bind_pre_flight(self, state, pod, node):
+            return Status.skip() if self._skip else OK
+
+        def pre_bind(self, state, pod, node):
+            ran.append(self.name)
+            return OK
+
+    from kubernetes_tpu.testing.wrappers import make_pod
+    pod = make_pod().name("p").obj()
+
+    fw = Framework(plugins=[(Flighty(skip=True), 0)])
+    state = CycleState()
+    st = fw.run_pre_bind_pre_flight(state, pod, "n0")
+    assert st.is_skip()
+    assert "Flighty" in state.skip_pre_bind_plugins
+
+    fw2 = Framework(plugins=[(Flighty(skip=False), 0)])
+    state2 = CycleState()
+    st2 = fw2.run_pre_bind_pre_flight(state2, pod, "n0")
+    assert st2.is_success() and not st2.is_skip()
+    fw2.run_pre_bind_plugins(state2, pod, "n0")
+    assert ran == ["Flighty"]
+
+
+def test_extension_point_latency_recorded():
+    """framework_extension_point_duration_seconds fills per point during
+    host scheduling cycles (metrics.go:265-615 series; perf artifact
+    carries per-point percentiles)."""
+    from kubernetes_tpu.core.clientset import FakeClientset
+    from kubernetes_tpu.core.scheduler import Scheduler
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    cs = FakeClientset()
+    sched = Scheduler(clientset=cs)
+    cs.create_node(make_node().name("n0").capacity({"cpu": "4", "pods": 10}).obj())
+    cs.create_node(make_node().name("n1").capacity({"cpu": "4", "pods": 10}).obj())
+    cs.create_pod(make_pod().name("p").req({"cpu": "1"}).obj())
+    sched.run_until_idle()
+    hist = sched.metrics.framework_extension_point_duration
+    for point in ("PreFilter", "Filter", "PreScore", "Score", "Reserve",
+                  "Permit", "Bind"):
+        assert hist.count(point, "Success", "") >= 1, point

@@ -203,3 +203,41 @@ class TestDispatcherBarrierAndErrors:
         call.on_error(exc)
         assert seen and seen[0] is threading.main_thread()
         d.close()
+
+
+def test_extender_preempt_verb_narrows_candidates():
+    """ProcessPreemption (extender.go:46-49): a preempt-capable extender
+    restricts which nodes/victims preemption may use; the scheduler then
+    nominates only an accepted node."""
+    from kubernetes_tpu.core.clientset import FakeClientset
+
+    calls = {}
+
+    def transport(verb, payload):
+        if verb == "preempt":
+            calls["preempt"] = payload
+            # accept only node n1, all its victims
+            accepted = {n: v for n, v in payload["nodeNameToVictims"].items()
+                        if n == "n1"}
+            return {"nodeNameToVictims": accepted}
+        return {}
+
+    ext = Extender(name="pe", preempt_verb="preempt", transport=transport)
+    cs = FakeClientset()
+    sched = Scheduler(clientset=cs, deterministic_ties=True)
+    sched.extenders.append(ext)
+    for i in range(2):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": "2", "pods": 10}).obj())
+    victims = []
+    for i in range(2):
+        v = make_pod().name(f"victim-{i}").req({"cpu": "2"}).priority(0).obj()
+        cs.create_pod(v)
+        victims.append(v)
+    sched.run_until_idle()
+    assert all(cs.bindings.get(v.uid) for v in victims)
+    high = make_pod().name("high").req({"cpu": "2"}).priority(100).obj()
+    cs.create_pod(high)
+    sched.run_until_idle()
+    assert "preempt" in calls
+    assert high.nominated_node_name == "n1"

@@ -402,3 +402,59 @@ def test_gang_simulation_sees_assumed_anti_affinity():
     bound = {cs.bindings.get(a.uid), cs.bindings.get(b.uid)}
     # Both scheduled (2 nodes available) but never co-located.
     assert None not in bound and len(bound) == 2, bound
+
+
+def test_queueing_hint_fns_filter_requeues():
+    """QueueingHintFn callbacks (scheduling_queue.go:582 isPodWorthRequeuing):
+    a Node/Add that cannot help a NodeResourcesFit rejection does NOT requeue
+    the pod; one that can, does. Same for NodeAffinity and TaintToleration."""
+    cs = FakeClientset()
+    sched = Scheduler(clientset=cs)
+    cs.create_node(make_node().name("small").capacity({"cpu": "1", "pods": 10}).obj())
+
+    big = make_pod().name("big").req({"cpu": "8"}).obj()
+    cs.create_pod(big)
+    sched.run_until_idle()
+    assert cs.bindings.get(big.uid) is None
+    assert "big" not in [q.pod.name for q in sched.queue.active_q.items()]
+
+    # A too-small node: the Fit hint must SKIP (no requeue).
+    cs.create_node(make_node().name("small2").capacity({"cpu": "2", "pods": 10}).obj())
+    assert sched.queue.active_q.get(big.uid) is None
+    assert sched.queue.backoff_q.get(big.uid) is None
+    assert big.uid in sched.queue.unschedulable
+
+    # A big-enough node: the hint queues it, and it schedules.
+    cs.create_node(make_node().name("big-node").capacity({"cpu": "16", "pods": 10}).obj())
+    assert big.uid not in sched.queue.unschedulable
+    sched.queue.flush_backoff_completed()
+    sched.run_until_idle()
+    import time as _t
+    deadline = _t.monotonic() + 12
+    while cs.bindings.get(big.uid) is None and _t.monotonic() < deadline:
+        _t.sleep(0.1)
+        sched.queue.flush_backoff_completed()
+        sched.run_until_idle()
+    assert cs.bindings.get(big.uid) == "big-node"
+
+
+def test_queueing_hint_node_affinity_and_taints():
+    cs = FakeClientset()
+    sched = Scheduler(clientset=cs)
+    cs.create_node(make_node().name("n0").capacity({"cpu": "8", "pods": 10}).obj())
+    pod = (make_pod().name("picky").req({"cpu": "1"})
+           .node_selector({"tier": "gold"}).obj())
+    cs.create_pod(pod)
+    sched.run_until_idle()
+    assert pod.uid in sched.queue.unschedulable
+    assert sched.queue.unschedulable[pod.uid].unschedulable_plugins == {"NodeAffinity"}
+
+    # Node without the selector label: NodeAffinity hint skips.
+    cs.create_node(make_node().name("plain").capacity({"cpu": "8", "pods": 10}).obj())
+    assert pod.uid in sched.queue.unschedulable
+
+    # A tainted node WITH the label: NodeAffinity hint queues (taints are
+    # TaintToleration's concern, and it rejected nothing yet).
+    cs.create_node(make_node().name("gold").capacity({"cpu": "8", "pods": 10})
+                   .label("tier", "gold").obj())
+    assert pod.uid not in sched.queue.unschedulable
