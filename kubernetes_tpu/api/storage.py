@@ -65,6 +65,8 @@ class PersistentVolumeClaim:
     storage_class: str = ""
     volume_name: str = ""                # bound PV ("" = pending)
     labels: Dict[str, str] = field(default_factory=dict)
+    # bind-completed / selected-node markers (pv_controller interlock)
+    annotations: Dict[str, str] = field(default_factory=dict)
 
     def __post_init__(self):
         if not self.uid:

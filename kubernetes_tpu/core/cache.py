@@ -146,6 +146,10 @@ class Cache:
         self.assumed_pods: Set[str] = set()
         self.pod_states: Dict[str, _PodState] = {}
         self.namespaces: Dict[str, Namespace] = {}
+        # Cluster-wide PVC reference counts over cached+assumed pods (the
+        # device path's claim-sharing eligibility check reads this — a
+        # shared claim must not ride the kernel's counted-attach encoding).
+        self.pvc_refs: Dict[str, int] = {}
         self._dirty: Set[str] = set()
         self._removed_since_snapshot = False
 
@@ -277,9 +281,25 @@ class Cache:
         if pod_info is None or pod_info.pod is not pod:
             pod_info = PodInfo.of(pod)
         ni.add_pod(pod_info)
+        for v in pod.volumes:
+            if v.pvc_name:
+                key = f"{pod.namespace}/{v.pvc_name}"
+                self.pvc_refs[key] = self.pvc_refs.get(key, 0) + 1
         self._dirty.add(pod.node_name)
 
     def _remove_pod_from_node(self, pod: Pod) -> None:
+        # Symmetric with _add_pod_to_node's unconditional increment: the
+        # refcount must drop even when the pod's node has already left the
+        # cache (a leak would misclassify future users as 'shared pvc' and
+        # silently strip their device eligibility).
+        for v in pod.volumes:
+            if v.pvc_name:
+                key = f"{pod.namespace}/{v.pvc_name}"
+                n = self.pvc_refs.get(key, 0) - 1
+                if n <= 0:
+                    self.pvc_refs.pop(key, None)
+                else:
+                    self.pvc_refs[key] = n
         ni = self.nodes.get(pod.node_name)
         if ni is not None:
             ni.remove_pod(pod)

@@ -35,6 +35,7 @@ class FakeClientset:
         self._namespace_handlers: List = []
         self._pod_group_handlers: List = []
         self._storage_handlers: List = []
+        self._pv_controller = None
         # Monotonic resourceVersion. itertools.count is C-implemented and
         # GIL-atomic: a concurrent client thread (perf harness creators, the
         # threaded watch transport) can write while the scheduling loop
@@ -123,6 +124,9 @@ class FakeClientset:
 
     def create_csi_node(self, cn: CSINode) -> CSINode:
         self.csi_nodes[cn.node_name] = cn
+        # Version the CSINode SET (not just its size): replacing a node's
+        # driver_limits must invalidate limited-driver caches.
+        self.csi_nodes_rv = getattr(self, "csi_nodes_rv", 0) + 1
         self._fire_storage("csi_node", cn)
         return cn
 
@@ -141,14 +145,27 @@ class FakeClientset:
         self._fire_storage("device_class", dc)
         return dc
 
+    def attach_pv_controller(self, ctrl) -> None:
+        """Register the PV controller (core/pv_controller.py) so PreBind's
+        provisioning path rides the real control loop."""
+        self._pv_controller = ctrl
+
     def bind_volume(self, pvc: PersistentVolumeClaim, pv_name: str, node_name: str) -> None:
-        """VolumeBinding PreBind writes: bind the claim to a matching PV, or
-        simulate the external provisioner for WaitForFirstConsumer classes
-        (reference sets volume.kubernetes.io/selected-node and waits)."""
+        """VolumeBinding PreBind writes (binder.go BindPodVolumes): bind the
+        claim to the decided PV, or — for WaitForFirstConsumer provisioning —
+        write the volume.kubernetes.io/selected-node annotation and let the
+        PV controller provision (pv_controller.py). Without an attached
+        controller, provisioning is simulated inline (unit-test shape)."""
         if pv_name:
             pv = self.pvs[pv_name]
             pv.claim_ref = pvc.key
             pvc.volume_name = pv_name
+            pvc.annotations["pv.kubernetes.io/bind-completed"] = "true"
+            return
+        from ..core.pv_controller import SELECTED_NODE
+        pvc.annotations[SELECTED_NODE] = node_name
+        if self._pv_controller is not None:
+            self._pv_controller.provision(pvc, node_name)
             return
         from ..api.types import NodeSelector, NodeSelectorTerm
         from ..api.labels import IN, Requirement

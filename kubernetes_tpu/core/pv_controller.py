@@ -1,0 +1,126 @@
+"""PersistentVolume controller analogue: the control loop that binds claims
+to volumes OUTSIDE the scheduler.
+
+Re-expresses the kube-controller-manager persistentvolume controller surface
+the scheduler's VolumeBinding plugin interlocks with
+(pkg/controller/volume/persistentvolume/pv_controller.go semantics, reduced
+to the scheduler-relevant contract):
+
+- IMMEDIATE-mode unbound claims bind to the smallest matching available PV
+  as soon as both exist (syncUnboundClaim → findBestMatchForClaim); the
+  scheduler refuses pods whose immediate claims are still unbound
+  (volume_binding.go PreFilter ERR_UNBOUND_IMMEDIATE).
+- WAIT_FOR_FIRST_CONSUMER claims wait until the scheduler selects a node and
+  writes the `volume.kubernetes.io/selected-node` annotation (the PreBind
+  side of binder.go BindPodVolumes); the controller then provisions a PV
+  with node affinity for that node and binds it.
+
+The controller subscribes to the clientset's storage events, so newly
+created claims/volumes reconcile immediately — the informer-driven shape of
+the reference collapsed to synchronous callbacks (SURVEY.md §4.2 fake
+control-plane layer).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.storage import (
+    IMMEDIATE,
+    WAIT_FOR_FIRST_CONSUMER,
+    PersistentVolume,
+    PersistentVolumeClaim,
+)
+from ..api.types import NodeSelector, NodeSelectorTerm
+from ..api.labels import IN, Requirement
+
+BIND_COMPLETED = "pv.kubernetes.io/bind-completed"
+SELECTED_NODE = "volume.kubernetes.io/selected-node"
+
+
+class PVController:
+    """Bind/provision loop. Attach to a FakeClientset; every storage write
+    (and every explicit sync()) reconciles all unbound claims."""
+
+    def __init__(self, clientset):
+        self.cs = clientset
+        self.binds = 0
+        self.provisions = 0
+        clientset.attach_pv_controller(self)
+        clientset.on_storage_event(self._on_storage_event)
+
+    # -- reconcile ---------------------------------------------------------
+
+    def _on_storage_event(self, kind: str, obj) -> None:
+        if kind in ("pv", "pvc", "storage_class"):
+            self.sync()
+
+    def sync(self) -> int:
+        """One reconcile pass; returns the number of claims progressed."""
+        n = 0
+        for pvc in list(self.cs.pvcs.values()):
+            if pvc.volume_name:
+                continue
+            mode = self._binding_mode(pvc)
+            if mode == WAIT_FOR_FIRST_CONSUMER:
+                node = pvc.annotations.get(SELECTED_NODE, "")
+                if node:
+                    self.provision(pvc, node)
+                    n += 1
+                continue
+            pv = self._find_best_match(pvc)
+            if pv is not None:
+                self._bind(pvc, pv)
+                n += 1
+        return n
+
+    def _binding_mode(self, pvc: PersistentVolumeClaim) -> str:
+        sc = self.cs.storage_classes.get(pvc.storage_class)
+        return sc.volume_binding_mode if sc is not None else IMMEDIATE
+
+    def _find_best_match(self, pvc: PersistentVolumeClaim) -> Optional[PersistentVolume]:
+        """findBestMatchForClaim: smallest available PV satisfying
+        class/modes/capacity (node affinity is the scheduler's concern for
+        delayed claims; immediate claims bind regardless of topology, which
+        is exactly the historical immediate-mode pitfall the reference
+        preserves)."""
+        best = None
+        for pv in self.cs.pvs.values():
+            if pv.claim_ref:
+                continue
+            if pv.storage_class != pvc.storage_class:
+                continue
+            if not set(pvc.access_modes) <= set(pv.access_modes):
+                continue
+            if pv.capacity < pvc.request:
+                continue
+            if best is None or pv.capacity < best.capacity:
+                best = pv
+        return best
+
+    # -- bind / provision --------------------------------------------------
+
+    def _bind(self, pvc: PersistentVolumeClaim, pv: PersistentVolume) -> None:
+        pv.claim_ref = pvc.key
+        pvc.volume_name = pv.name
+        pvc.annotations[BIND_COMPLETED] = "true"
+        self.binds += 1
+
+    def provision(self, pvc: PersistentVolumeClaim, node_name: str) -> PersistentVolume:
+        """Dynamic provisioning for a WaitForFirstConsumer claim whose
+        consumer landed on `node_name`: create a PV pinned to that node
+        (the external-provisioner contract) and bind it."""
+        sc = self.cs.storage_classes.get(pvc.storage_class)
+        pv = PersistentVolume(
+            name=f"pvc-{pvc.uid}",
+            capacity=pvc.request,
+            access_modes=pvc.access_modes,
+            storage_class=pvc.storage_class,
+            csi_driver=(sc.provisioner if sc is not None else ""),
+            node_affinity=NodeSelector(terms=(NodeSelectorTerm(
+                match_fields=(Requirement("metadata.name", IN, (node_name,)),)),)),
+        )
+        self.cs.pvs[pv.name] = pv
+        self._bind(pvc, pv)
+        self.provisions += 1
+        return pv

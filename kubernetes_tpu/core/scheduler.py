@@ -376,8 +376,16 @@ class Scheduler:
         elif kind == "update":
             if new.node_name:
                 if old is not None and not old.node_name:
-                    # pending → bound transition (our own bind confirm)
+                    # pending → bound transition (our own bind confirm):
+                    # still an AssignedPodAdd for QUEUEING purposes — parked
+                    # pods whose affinity/spread terms this pod satisfies
+                    # must requeue (eventhandlers.go addPodToCache →
+                    # MoveAllToActiveOrBackoffQueue(AssignedPodAdd)). The
+                    # cluster_event_seq stays unbumped (the carry already
+                    # holds the placement via the assume).
                     self.cache.add_pod(new)
+                    self.queue.move_all_to_active_or_backoff(
+                        EVENT_ASSIGNED_POD_ADD, None, new)
                 else:
                     self.cache.update_pod(old, new)
             else:

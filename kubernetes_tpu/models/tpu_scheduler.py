@@ -121,6 +121,15 @@ class TPUScheduler(Scheduler):
         self._resume = None
         # Per-framework commit fast-path eligibility (see _commit).
         self._fast_tail: dict = {}
+        # Drivers with ANY CSINode attach limit (volume aux eligibility);
+        # recomputed when the CSINode set grows.
+        self._limited_drivers = frozenset()
+        self._limited_drivers_n = -1
+        # Claims referenced by pods already accepted into the CURRENT device
+        # session (committed or in flight): a second pod sharing one of them
+        # must not join — the kernel counts attach units per landing, the
+        # host per distinct claim (see ops/features.py volume_device_support).
+        self._session_claims: set = set()
 
     # -- batch accumulation ------------------------------------------------
 
@@ -162,6 +171,7 @@ class TPUScheduler(Scheduler):
         sig = fw.sign_pod(head.pod) if reason is None else None
         if sig is None:
             return fw, [head], reason or "unsignable pod"
+        self._session_claims = set(self._claims_of(head.pod))
         batch = [head]
         while len(batch) < self.max_batch:
             nxt = self._pop()
@@ -210,7 +220,11 @@ class TPUScheduler(Scheduler):
             if (m.pod.scheduler_name != p0.scheduler_name
                     or fw.sign_pod(m.pod) != sig
                     or self._batch_supported_memo(m.pod, fw) is not None
-                    or self._device_unsupported_profile(fw, m.pod) is not None):
+                    or self._device_unsupported_profile(fw, m.pod) is not None
+                    # PVC-claimed members stay on the host group cycle: the
+                    # gang session has no per-member claim-dedup seam, and
+                    # the kernel's counted attach math requires it.
+                    or any(v.pvc_name for v in m.pod.volumes)):
                 return None, None
         return fw, sig
 
@@ -406,6 +420,8 @@ class TPUScheduler(Scheduler):
                 fw.sign_pod(m.pod) != sig
                 or self._batch_supported_memo(m.pod, fw) is not None
                 or self._device_unsupported_profile(fw, m.pod) is not None
+                # claim-carrying members: host sims (no intra-sim claim dedup)
+                or any(v.pvc_name for v in m.pod.volumes)
                 for m in members):
             return super()._evaluate_placements(
                 fw, pg_state, group, members, placements, start_index)
@@ -437,7 +453,7 @@ class TPUScheduler(Scheduler):
             self._placement_plan_cache = (
                 (id(fw), sig, len(members), self.cluster_event_seq,
                  self.mirror.np_cap),
-                plan) if not plan.port_selfblock else None
+                plan) if not (plan.port_selfblock or plan.has_aux) else None
 
         import jax.numpy as jnp
         from ..ops.kernel import schedule_placements
@@ -470,7 +486,8 @@ class TPUScheduler(Scheduler):
             plan.vmax, masks_dev,
             n_active=np.int32(len(members)),
             has_pns=plan.has_pns, has_na_pref=plan.has_na_pref,
-            port_selfblock=plan.port_selfblock))  # [P, 2, B]
+            port_selfblock=plan.port_selfblock,
+            has_aux=plan.has_aux))  # [P, 2, B]
         self.placement_device_evals += 1
 
         node_names = [ni.name for ni in self.snapshot.node_info_list]
@@ -562,6 +579,8 @@ class TPUScheduler(Scheduler):
             ignore_preferred_terms_of_existing_pods=getattr(
                 ipa, "ignore_preferred_terms_of_existing_pods", False),
             fit_plugin=fw.plugin("NodeResourcesFit"),
+            clientset=self.clientset, pvc_refs=self.cache.pvc_refs,
+            limited_drivers=self.limited_drivers(),
         )
         state = self.mirror.flush()
         if self.mesh is not None:
@@ -626,7 +645,7 @@ class TPUScheduler(Scheduler):
             state, plan.features, plan.batch_pad, plan.fit_strategy,
             plan.vmax, masks, n_active=np.int32(0),
             has_pns=plan.has_pns, has_na_pref=plan.has_na_pref,
-            port_selfblock=plan.port_selfblock)
+            port_selfblock=plan.port_selfblock, has_aux=plan.has_aux)
         np.asarray(res)
 
     def _dispatch(self, state, plan, n_active: int, carry):
@@ -639,7 +658,7 @@ class TPUScheduler(Scheduler):
             plan.vmax, n_active=np.int32(n_active), carry_in=carry,
             has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base,
             anti_rowlocal=plan.anti_rowlocal, has_na_pref=plan.has_na_pref,
-            port_selfblock=plan.port_selfblock)
+            port_selfblock=plan.port_selfblock, has_aux=plan.has_aux)
 
     # -- device session ----------------------------------------------------
     #
@@ -653,6 +672,19 @@ class TPUScheduler(Scheduler):
     # oracle, or any external cluster event arrives
     # (Scheduler.cluster_event_seq).
 
+    def limited_drivers(self) -> frozenset:
+        rv = getattr(self.clientset, "csi_nodes_rv", 0)
+        if rv != self._limited_drivers_n:
+            self._limited_drivers = frozenset(
+                d for cn in self.clientset.csi_nodes.values()
+                for d in cn.driver_limits)
+            self._limited_drivers_n = rv
+        return self._limited_drivers
+
+    def _claims_of(self, pod) -> list:
+        return [f"{pod.namespace}/{v.pvc_name}"
+                for v in pod.volumes if v.pvc_name]
+
     def _batch_supported_memo(self, pod, fw: Framework):
         """batch_supported with the verdict memoized on the pod's shared
         template-signature holder (clone_from_template invariant: clones
@@ -662,32 +694,46 @@ class TPUScheduler(Scheduler):
         if pod.nominated_node_name:
             return "nominated node fast path"
         shared = pod.__dict__.get("_sig_shared")
-        if shared is None:
+        if shared is None or any(v.pvc_name for v in pod.volumes):
+            # PVC verdicts depend on live claim/PV state — never memoized.
             return batch_supported(
                 pod, self.snapshot,
                 fit_plugin=fw.plugin("NodeResourcesFit"),
-                ba_plugin=fw.plugin("NodeResourcesBalancedAllocation"))
+                ba_plugin=fw.plugin("NodeResourcesBalancedAllocation"),
+                clientset=self.clientset, pvc_refs=self.cache.pvc_refs,
+                limited_drivers=self.limited_drivers())
         key = ("_bsup", id(fw))
         if key in shared:
             return shared[key]
         reason = batch_supported(
             pod, self.snapshot,
             fit_plugin=fw.plugin("NodeResourcesFit"),
-            ba_plugin=fw.plugin("NodeResourcesBalancedAllocation"))
+            ba_plugin=fw.plugin("NodeResourcesBalancedAllocation"),
+            clientset=self.clientset, pvc_refs=self.cache.pvc_refs,
+            limited_drivers=self.limited_drivers())
         shared[key] = reason
         return reason
 
     def _session_compatible(self, head: QueuedPodInfo, fw: Framework, sig) -> bool:
         if isinstance(head, QueuedPodGroupInfo):
             return False
-        return (head.pod.scheduler_name in self.profiles
+        if not (head.pod.scheduler_name in self.profiles
                 and self.framework_for_pod(head.pod) is fw
                 and fw.sign_pod(head.pod) == sig
                 # Signatures only cover the Sign plugins; a member with a
-                # feature outside the kernel (PVC volumes, DRA claims) shares
-                # the head's signature but must NOT ride the device — it
-                # would silently skip that feature's filters.
-                and self._batch_supported_memo(head.pod, fw) is None)
+                # feature outside the kernel (unbound volumes, DRA claims)
+                # shares the head's signature but must NOT ride the device —
+                # it would silently skip that feature's filters.
+                and self._batch_supported_memo(head.pod, fw) is None):
+            return False
+        claims = self._claims_of(head.pod)
+        if claims:
+            # A claim already used by a pod accepted into this session must
+            # not be counted twice by the kernel's per-landing attach math.
+            if any(c in self._session_claims for c in claims):
+                return False
+            self._session_claims.update(claims)
+        return True
 
     def _collect_session_batch(self, fw: Framework, sig) -> List[QueuedPodInfo]:
         """Pop up to max_batch pods matching the session signature; an

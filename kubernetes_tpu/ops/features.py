@@ -130,6 +130,11 @@ class BatchFeatures(NamedTuple):
     # filter enablement from the profile's filter plugin set:
     # [NodeName, NodeUnschedulable, TaintToleration, NodeAffinity, NodeResourcesFit]
     enable: jnp.ndarray           # [5] i32
+    # Counted row-local auxiliary constraint (CSI attach limits,
+    # nodevolumelimits/csi.go): room left per node for the batch's limited
+    # driver; each landing consumes aux_inc units of its row's room.
+    aux_room: jnp.ndarray         # [NP] i32 (BIG = unconstrained)
+    aux_inc: jnp.ndarray          # i32 scalar (0 = no aux constraint)
     # sampling / loop
     num_nodes: jnp.ndarray        # i32
     start_index: jnp.ndarray      # i32
@@ -159,6 +164,9 @@ class BatchPlan:
     # blocks itself for the rest of the session (identical pods always
     # port-conflict with each other) — row-local, lap-path compatible.
     port_selfblock: bool = False
+    # Counted aux constraint live (CSI attach limits) — row-local,
+    # lap-path compatible.
+    has_aux: bool = False
 
 
 class Unsupported(Exception):
@@ -166,13 +174,73 @@ class Unsupported(Exception):
     must take the host path (SURVEY.md §7.4 'sequential fallback')."""
 
 
-def batch_supported(pod: Pod, snapshot, fit_plugin=None, ba_plugin=None) -> Optional[str]:
+ZONE_KEYS = ("topology.kubernetes.io/zone", "topology.kubernetes.io/region",
+             "failure-domain.beta.kubernetes.io/zone",
+             "failure-domain.beta.kubernetes.io/region")
+
+
+def volume_device_support(pod: Pod, clientset, pvc_refs=None,
+                          limited_drivers=frozenset()):
+    """Device eligibility for a pod's PVC-backed volumes. Returns
+    (reason, limited_driver, inc): reason is None when the volumes impose
+    either NO per-node constraint (bound PV, no node affinity, no zone
+    labels, not RWOP, unshared claim) or exactly one counted CSI
+    attach-limit constraint — which the kernel models as the aux counted
+    row-local resource (limited_driver/inc feed build_batch's aux vectors).
+
+    Parity argument: under these conditions the volume plugins' Filter
+    verdicts are all-pass except NodeVolumeLimits, whose distinct-claim
+    count over unshared fresh claims equals the kernel's per-landing count
+    (plugins/volumes.py NodeVolumeLimits.filter)."""
+    from ..api.storage import RWOP
+
+    names = [v.pvc_name for v in pod.volumes if v.pvc_name]
+    if not names:
+        return None, "", 0
+    if clientset is None:
+        return "pvc-backed volumes", "", 0
+    driver_incs: Dict[str, int] = {}
+    for name in names:
+        key = f"{pod.namespace}/{name}"
+        pvc = clientset.pvcs.get(key)
+        if pvc is None or not pvc.volume_name:
+            return "unbound pvc", "", 0
+        if RWOP in pvc.access_modes:
+            return "rwop pvc", "", 0
+        if pvc_refs is not None and pvc_refs.get(key, 0) > 0:
+            return "shared pvc", "", 0
+        pv = clientset.pvs.get(pvc.volume_name)
+        if pv is None:
+            return "missing pv", "", 0
+        if pv.node_affinity is not None:
+            return "pv node affinity", "", 0
+        if any(k in pv.labels for k in ZONE_KEYS):
+            return "pv zone labels", "", 0
+        driver = pv.csi_driver
+        if not driver:
+            sc = clientset.storage_classes.get(pvc.storage_class)
+            driver = sc.provisioner if sc is not None else ""
+        if driver and driver in limited_drivers:
+            driver_incs[driver] = driver_incs.get(driver, 0) + 1
+    if len(driver_incs) > 1:
+        return "multiple attach-limited drivers", "", 0
+    if driver_incs:
+        d, inc = next(iter(driver_incs.items()))
+        return None, d, inc
+    return None, "", 0
+
+
+def batch_supported(pod: Pod, snapshot, fit_plugin=None, ba_plugin=None,
+                    clientset=None, pvc_refs=None,
+                    limited_drivers=frozenset(),
+                    _volume_verdict=None) -> Optional[str]:
     """Returns a reason string when the pod needs the host path, else None.
 
     Host ports, node-affinity expressions (required AND preferred), image
-    locality, and NodeDeclaredFeatures are covered on device since round 3
-    via host-evaluated static per-node vectors (sel_match / extra_ok /
-    na_raw / il_score) — only genuinely stateful host machinery (volume
+    locality, NodeDeclaredFeatures, and bound-PVC volumes (incl. one
+    counted CSI attach limit) are covered on device via host-evaluated
+    static per-node vectors (sel_match / extra_ok / na_raw / il_score /
+    aux_room) — only genuinely stateful host machinery (unbound volume
     binding, DRA allocation, nominated-pod two-pass) still falls back."""
     if pod.nominated_node_name:
         return "nominated node fast path"
@@ -186,8 +254,12 @@ def batch_supported(pod: Pod, snapshot, fit_plugin=None, ba_plugin=None) -> Opti
         # universe is tiny, so the host cycle is already O(1) per pod.
         if any(t.match_fields for t in na.required.terms):
             return "node-affinity metadata.name narrowing"
-    if any(v.pvc_name for v in pod.volumes):
-        return "pvc-backed volumes"
+    reason, _d, _inc = (_volume_verdict if _volume_verdict is not None
+                        else volume_device_support(
+                            pod, clientset, pvc_refs=pvc_refs,
+                            limited_drivers=limited_drivers))
+    if reason is not None:
+        return reason
     if getattr(pod, "resource_claims", None):
         return "dynamic resource claims"
     if fit_plugin is not None and fit_plugin.scoring_strategy not in ("LeastAllocated", "MostAllocated"):
@@ -223,15 +295,24 @@ def build_batch(
     hard_pod_affinity_weight: int = 1,
     ignore_preferred_terms_of_existing_pods: bool = False,
     fit_plugin=None,
+    clientset=None,
+    pvc_refs=None,
+    limited_drivers=frozenset(),
 ) -> BatchPlan:
     """Build kernel inputs for a batch of `batch_size` pods identical to `pod`.
 
     `mirror` must already be synced to `snapshot`. Raises Unsupported for
     feature combinations the kernel does not cover.
     """
-    reason = batch_supported(pod, snapshot, fit_plugin=fit_plugin)
+    verdict = volume_device_support(
+        pod, clientset, pvc_refs=pvc_refs, limited_drivers=limited_drivers)
+    reason = batch_supported(pod, snapshot, fit_plugin=fit_plugin,
+                             clientset=clientset, pvc_refs=pvc_refs,
+                             limited_drivers=limited_drivers,
+                             _volume_verdict=verdict)
     if reason:
         raise Unsupported(reason)
+    _vr, aux_driver, aux_inc_n = verdict
 
     nodes: List[NodeInfo] = snapshot.node_info_list
     n = len(nodes)
@@ -616,6 +697,36 @@ def build_batch(
 
     to_find = num_feasible_nodes_to_find(n, percentage_of_nodes_to_score)
 
+    # ---- counted aux constraint: CSI attach room per node ----------------
+    AUX_BIG = (1 << 30)
+    aux_room = np.full(npc, AUX_BIG, i32)
+    if aux_driver and aux_inc_n:
+        driver_of: Dict[str, Optional[str]] = {}
+
+        def _claim_driver(key: str) -> Optional[str]:
+            d = driver_of.get(key)
+            if d is None and key not in driver_of:
+                pvc = clientset.pvcs.get(key)
+                d = None
+                if pvc is not None:
+                    pv = clientset.pvs.get(pvc.volume_name) if pvc.volume_name else None
+                    if pv is not None and pv.csi_driver:
+                        d = pv.csi_driver
+                    else:
+                        sc = clientset.storage_classes.get(pvc.storage_class)
+                        d = sc.provisioner if sc is not None else None
+                driver_of[key] = d
+            return driver_of.get(key)
+
+        for r_i, ni in enumerate(nodes):
+            cn = clientset.csi_nodes.get(ni.name)
+            limit = cn.driver_limits.get(aux_driver) if cn is not None else None
+            if limit is None:
+                continue
+            existing = sum(1 for key in ni.pvc_ref_counts
+                           if _claim_driver(key) == aux_driver)
+            aux_room[r_i] = max(0, limit - existing)
+
     feats = BatchFeatures(
         request=jnp.asarray(request),
         nz_request=jnp.asarray(nz_request),
@@ -649,6 +760,8 @@ def build_batch(
         fit_slots=jnp.asarray(fit_slots), fit_weights=jnp.asarray(fit_weights),
         weights=jnp.asarray(np.array(weights, i64)),
         enable=jnp.asarray(np.array([1 if b else 0 for b in filters_on], i32)),
+        aux_room=jnp.asarray(aux_room),
+        aux_inc=jnp.asarray(np.int32(aux_inc_n)),
         num_nodes=jnp.asarray(np.int32(n)),
         start_index=jnp.asarray(np.int32(start_index % max(1, n))),
         to_find=jnp.asarray(np.int32(to_find)),
@@ -663,6 +776,7 @@ def build_batch(
         anti_rowlocal=anti_rowlocal,
         has_na_pref=has_na_pref,
         port_selfblock=port_selfblock,
+        has_aux=bool(aux_driver and aux_inc_n),
     )
 
 

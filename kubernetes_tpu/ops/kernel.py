@@ -88,6 +88,7 @@ class ScanCarry(NamedTuple):
     ipa_delta: jnp.ndarray    # [KD, V] i64
     start: jnp.ndarray        # i32 rotation index
     blocked: jnp.ndarray      # [NP] bool rows self-blocked by a landing (ports)
+    aux_cnt: jnp.ndarray      # [NP] i32 aux units consumed by landings (CSI)
 
 
 def _tolerates(f: BatchFeatures, taint_key, taint_val, taint_eff):
@@ -189,7 +190,7 @@ def _resource_eval(f: BatchFeatures, fit_strategy: int,
 
 @partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax",
                                    "has_pns", "has_ipa_base", "anti_rowlocal",
-                                   "has_na_pref", "port_selfblock"),
+                                   "has_na_pref", "port_selfblock", "has_aux"),
          donate_argnames=("carry_in",))
 def schedule_batch(
     state: DeviceNodeState,
@@ -204,6 +205,7 @@ def schedule_batch(
     anti_rowlocal: bool = False,
     has_na_pref: bool = False,
     port_selfblock: bool = False,
+    has_aux: bool = False,
 ) -> Tuple[jnp.ndarray, ScanCarry]:
     """Greedy-assign up to `batch_pad` identical pods (`n_active` of them
     real; padded steps are inert so the returned carry stays exact).
@@ -288,14 +290,17 @@ def schedule_batch(
     n_act = jnp.int32(batch_pad) if n_active is None else n_active.astype(jnp.int32)
 
     def feasibility_proj(fit_ok, dns_counts, mnum, acnt, fcnt, aff_total,
-                         blocked):
+                         blocked, aux_cnt):
         """Per-node ok mask from the dynamic filters
         (findNodesThatPassFilters; PTS skew filtering.go:318-362, IPA
-        required filtering.go:368-426), reading the carried per-node
-        projections — no gathers on the critical path."""
+        required filtering.go:368-426, counted CSI attach room), reading
+        the carried per-node projections — no gathers on the critical
+        path."""
         ok = static_ok & fit_ok & (idx < num)
         if port_selfblock:
             ok &= ~blocked
+        if has_aux:
+            ok &= aux_cnt + f.aux_inc <= f.aux_room
         if C1:
             # All-int32 skew math (counts are pods-per-domain, far below 2^31;
             # int64 vector ops cost ~2x in the per-op-latency regime).
@@ -316,12 +321,13 @@ def schedule_batch(
     def step(carry, t):
         (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
          dns_counts, sa_counts, anti_counts, aff_counts, ipa_delta, start,
-         blocked, okd, F, total, mnum, scnt, acnt, fcnt, dproj, aff_total) = carry
+         blocked, aux_cnt, okd, F, total,
+         mnum, scnt, acnt, fcnt, dproj, aff_total) = carry
         active = t < n_act
 
         if not incremental_feas:
             okd = feasibility_proj(fit_ok, dns_counts, mnum, acnt, fcnt,
-                                   aff_total, blocked)
+                                   aff_total, blocked, aux_cnt)
             F = jnp.cumsum(okd.astype(jnp.int32))          # inclusive, row order
 
         # ---- sampling truncation + rotation (schedule_one.go:779-892) -----
@@ -444,6 +450,8 @@ def schedule_batch(
             dproj = dproj + upd[:, None] * (ipa_vid == ipa_vid[:, row][:, None])
         if port_selfblock:
             blocked = blocked.at[row].set(blocked[row] | any_kept)
+        if has_aux:
+            aux_cnt = aux_cnt.at[row].add(f.aux_inc * apply.astype(jnp.int32))
         if incremental_feas:
             # Feasibility flips only at the landed row: patch okd and shift
             # the prefix-sum tail by the delta (replaces the full cumsum).
@@ -452,6 +460,8 @@ def schedule_batch(
                 new_ok_row &= ~((anti_vid[:, row] > 0) & (acnt[:, row] > 0)).any()
             if port_selfblock:
                 new_ok_row &= ~blocked[row]
+            if has_aux:
+                new_ok_row &= aux_cnt[row] + f.aux_inc <= f.aux_room[row]
             delta = new_ok_row.astype(jnp.int32) - okd[row].astype(jnp.int32)
             okd = okd.at[row].set(new_ok_row)
             F = F + jnp.where(idx >= row, delta, 0)
@@ -463,7 +473,7 @@ def schedule_batch(
 
         new_carry = (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
                      dns_counts, sa_counts, anti_counts, aff_counts,
-                     ipa_delta, start, blocked, okd, F, total,
+                     ipa_delta, start, blocked, aux_cnt, okd, F, total,
                      mnum, scnt, acnt, fcnt, dproj, aff_total)
         return new_carry, (chosen, start)
 
@@ -476,14 +486,14 @@ def schedule_batch(
                          fit_ok0, fit_sc0, ba0,
                          f.dns_counts, f.sa_counts, f.anti_counts,
                          f.aff_counts, ipa_delta0, f.start_index,
-                         jnp.zeros(NP, bool))
+                         jnp.zeros(NP, bool), jnp.zeros(NP, jnp.int32))
     else:
         ext0 = carry_in
     if static_scores:
         return _lap_schedule(state, f, batch_pad, fit_strategy,
                              ext0, static_ok, n_act, idx, num,
                              w_tt, w_fit, w_ba, il_term, anti_vid,
-                             port_selfblock)
+                             port_selfblock, has_aux)
     # Per-node projections of the count tables (one gather per table per
     # CALL, kept elementwise-fresh by the scan) + okd/F seeds.
     i64v = jnp.int64
@@ -502,7 +512,7 @@ def schedule_batch(
         dproj0 = jnp.zeros((0, NP), jnp.int64)
     aff_total0 = (ext0.aff_counts * (f.aff_active[:, None] == 1)).sum()
     okd0 = feasibility_proj(ext0.fit_ok, ext0.dns_counts, mnum0, acnt0,
-                            fcnt0, aff_total0, ext0.blocked)
+                            fcnt0, aff_total0, ext0.blocked, ext0.aux_cnt)
     F0 = jnp.cumsum(okd0.astype(jnp.int32))
     if scores_carried:
         total0 = (w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * ext0.fit_sc
@@ -519,12 +529,12 @@ def schedule_batch(
     # chain the next batch (carry_in) and keep the mirror resident
     # (NodeStateMirror.adopt) instead of re-uploading — the device-side
     # analogue of the incremental snapshot.
-    return jnp.stack([chosen, starts]), ScanCarry(*final[:13])
+    return jnp.stack([chosen, starts]), ScanCarry(*final[:14])
 
 
 @partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax",
                                    "has_pns", "has_na_pref",
-                                   "port_selfblock"))
+                                   "port_selfblock", "has_aux"))
 def schedule_placements(
     state: DeviceNodeState,
     f: BatchFeatures,
@@ -536,6 +546,7 @@ def schedule_placements(
     has_pns: bool = True,
     has_na_pref: bool = False,
     port_selfblock: bool = False,
+    has_aux: bool = False,
 ) -> jnp.ndarray:
     """Evaluate a pod group against P candidate placements IN PARALLEL — the
     device form of podGroupSchedulingPlacementAlgorithm's per-placement
@@ -563,7 +574,8 @@ def schedule_placements(
             state, f2, batch_pad, fit_strategy, vmax,
             n_active=n_active, carry_in=None,
             has_pns=has_pns, has_ipa_base=False, anti_rowlocal=False,
-            has_na_pref=has_na_pref, port_selfblock=port_selfblock)
+            has_na_pref=has_na_pref, port_selfblock=port_selfblock,
+            has_aux=has_aux)
         return results
 
     return jax.vmap(one)(masks)
@@ -578,7 +590,7 @@ LAP_MAX = 32
 
 def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
                   static_ok, n_act, idx, num, w_tt, w_fit, w_ba, il_term,
-                  anti_vid, port_selfblock):
+                  anti_vid, port_selfblock, has_aux):
     """Lap-vectorized greedy assignment for the static-score case.
 
     Key fact: with adaptive sampling live (schedule_one.go:866-892), pod i
@@ -610,7 +622,8 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
         return c[0] < n_act
 
     def body(c):
-        (done, req_r, nonzero, pod_count, anti_counts, blocked, start, out) = c
+        (done, req_r, nonzero, pod_count, anti_counts, blocked, aux_cnt,
+         start, out) = c
         # Dense per-lap recompute (no scatters/gathers — TPU scatters
         # serialize per index, so one-hot masked vector ops win):
         fit_ok, fit_sc, ba = _resource_eval(
@@ -619,6 +632,8 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
         okd = static_ok & fit_ok & (idx < num)
         if port_selfblock:
             okd &= ~blocked
+        if has_aux:
+            okd &= aux_cnt + f.aux_inc <= f.aux_room
         if A1:
             acnt = jnp.take_along_axis(anti_counts, anti_vid.astype(jnp.int64), axis=1)
             okd &= ~((anti_vid > 0) & (acnt > 0)).any(axis=0)
@@ -661,6 +676,8 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
         pod_count = pod_count + cnt.astype(jnp.int32)
         if port_selfblock:
             blocked |= cnt
+        if has_aux:
+            aux_cnt = aux_cnt + f.aux_inc * cnt.astype(jnp.int32)
         if A1:
             # hostname-anti landings: +self at each landed row's own value
             # (duplicate vids cannot occur — the axis is singleton-per-node).
@@ -675,17 +692,18 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
         out = lax.dynamic_update_slice(out, block, (jnp.int32(0), done))
         start = start_w[jnp.maximum(L - 1, 0)]
         return (done + L, req_r, nonzero, pod_count, anti_counts, blocked,
-                start, out)
+                aux_cnt, start, out)
 
     out0 = jnp.full((2, B + LAP_MAX), -1, jnp.int32)
     c0 = (jnp.int32(0), ext0.req_r, ext0.nonzero, ext0.pod_count,
-          ext0.anti_counts, ext0.blocked, ext0.start, out0)
-    (done, req_r, nonzero, pod_count, anti_counts, blocked, start,
+          ext0.anti_counts, ext0.blocked, ext0.aux_cnt, ext0.start, out0)
+    (done, req_r, nonzero, pod_count, anti_counts, blocked, aux_cnt, start,
      out) = lax.while_loop(cond, body, c0)
     fit_ok, fit_sc, ba = _resource_eval(
         f, fit_strategy, state.alloc_r, state.alloc_pods,
         req_r, nonzero, pod_count)
     carry = ScanCarry(req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
                       ext0.dns_counts, ext0.sa_counts, anti_counts,
-                      ext0.aff_counts, ext0.ipa_delta, start, blocked)
+                      ext0.aff_counts, ext0.ipa_delta, start, blocked,
+                      aux_cnt)
     return out[:, :B], carry

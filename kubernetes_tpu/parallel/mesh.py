@@ -60,7 +60,7 @@ def _feature_specs() -> BatchFeatures:
     """Per-node feature arrays shard over "nodes"; the rest replicate."""
     specs = {name: P() for name in BatchFeatures._fields}
     for per_node in ("exist_anti", "ipa_base", "sel_match", "extra_ok",
-                     "il_score", "na_raw"):
+                     "il_score", "na_raw", "aux_room"):
         specs[per_node] = P("nodes")
     return BatchFeatures(**specs)
 

@@ -464,8 +464,32 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
     def _create_pods(op, tpl, namespace, count):
         nonlocal pod_seq
         claim_tpl = tpl.get("resourceClaimTemplate")
+        pv_tpl = op.get("persistentVolumeTemplate")
+        pvc_tpl = op.get("persistentVolumeClaimTemplate")
         batch = []
         for _ in range(count):
+            if pv_tpl is not None and pvc_tpl is not None:
+                # One pre-bound PV+PVC pair per pod (the reference's
+                # persistentVolumeTemplatePath/persistentVolumeClaimTemplatePath
+                # prep: pv-aws.yaml / pv-csi.yaml / pvc.yaml with
+                # pv.kubernetes.io/bind-completed).
+                from ..api.storage import PersistentVolume, PersistentVolumeClaim
+                from ..api.resource import parse_quantity
+                cap = int(parse_quantity(str(pv_tpl.get("capacity", "1Gi"))))
+                modes = tuple(pv_tpl.get("accessModes", ("ReadOnlyMany",)))
+                pv = PersistentVolume(
+                    name=f"pv-{pod_seq}", capacity=cap, access_modes=modes,
+                    csi_driver=pv_tpl.get("csi", ""),
+                    labels=dict(pv_tpl.get("labels", {})))
+                pvc = PersistentVolumeClaim(
+                    name=f"pvc-{pod_seq}", namespace=namespace, request=cap,
+                    access_modes=modes)
+                pv.claim_ref = pvc.key
+                pvc.volume_name = pv.name
+                pvc.annotations["pv.kubernetes.io/bind-completed"] = "true"
+                cs.create_pv(pv)
+                cs.create_pvc(pvc)
+                tpl = dict(tpl, pvc="pvc-%d" % pod_seq)
             p = _make_pod_from_template(f"pod-{pod_seq}", tpl, namespace=namespace)
             if claim_tpl:
                 # resourceClaimTemplate: one generated claim per pod
@@ -491,6 +515,7 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
         if opcode == "createNodes":
             count = _resolve_count(op, params)
             tpl = op.get("nodeTemplate", {})
+            csi_alloc = op.get("csiNodeAllocatable")  # {driver: count}
             if tpl.get("name"):
                 # Named template (node-with-name.yaml): names must be unique,
                 # so multi-count named ops get an index suffix.
@@ -505,6 +530,12 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
                     created_nodes.append(
                         cs.create_node(_make_node_from_template(node_seq + i, tpl)).name)
                 node_seq += count
+            if csi_alloc:
+                from ..api.storage import CSINode
+                for name in created_nodes[-count:]:
+                    cs.create_csi_node(CSINode(
+                        node_name=name,
+                        driver_limits={d: int(c) for d, c in csi_alloc.items()}))
         elif opcode == "createNamespaces":
             count = _resolve_count(op, params) if ("count" in op or "countParam" in op) else 1
             prefix = op.get("prefix", "ns")

@@ -171,3 +171,182 @@ class TestVolumeRestrictions:
         s.run_until_idle()
         bound = {p.name: p.node_name for p in s.clientset.pods.values() if p.node_name}
         assert bound.get("high") == "n0", f"high not scheduled via preemption: {bound}"
+
+
+def test_pv_controller_binds_immediate_claims():
+    """PV controller (core/pv_controller.py): IMMEDIATE-mode unbound claims
+    bind to the smallest matching available PV as soon as both exist, which
+    unblocks the scheduler's ERR_UNBOUND_IMMEDIATE rejection."""
+    from kubernetes_tpu.api.storage import (
+        PersistentVolume, PersistentVolumeClaim, StorageClass)
+    from kubernetes_tpu.core.clientset import FakeClientset
+    from kubernetes_tpu.core.pv_controller import BIND_COMPLETED, PVController
+    from kubernetes_tpu.core.scheduler import Scheduler
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+    from kubernetes_tpu.api.types import Volume
+
+    cs = FakeClientset()
+    ctrl = PVController(cs)
+    sched = Scheduler(clientset=cs)
+    cs.create_node(make_node().name("n0").capacity({"cpu": "8", "pods": 10}).obj())
+    cs.create_storage_class(StorageClass(name="std", volume_binding_mode="Immediate"))
+    # both PVs first, then the claim — controller picks the smaller match
+    cs.create_pv(PersistentVolume.of("big", "10Gi", storage_class="std"))
+    cs.create_pv(PersistentVolume.of("small", "2Gi", storage_class="std"))
+    pvc = PersistentVolumeClaim.of("data", "1Gi", storage_class="std")
+    cs.create_pvc(pvc)
+    assert pvc.volume_name == "small"
+    assert pvc.annotations.get(BIND_COMPLETED) == "true"
+    assert ctrl.binds == 1
+
+    pod = make_pod().name("p").req({"cpu": "1"}).obj()
+    pod.volumes.append(Volume(name="data", pvc_name="data"))
+    cs.create_pod(pod)
+    sched.run_until_idle()
+    assert cs.bindings.get(pod.uid) == "n0"
+
+
+def test_pv_controller_wffc_provisions_on_selected_node():
+    """WaitForFirstConsumer: the scheduler's PreBind writes selected-node;
+    the PV controller provisions a node-pinned PV and binds it
+    (binder.go BindPodVolumes + external-provisioner contract)."""
+    from kubernetes_tpu.api.storage import PersistentVolumeClaim, StorageClass
+    from kubernetes_tpu.core.clientset import FakeClientset
+    from kubernetes_tpu.core.pv_controller import SELECTED_NODE, PVController
+    from kubernetes_tpu.core.scheduler import Scheduler
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+    from kubernetes_tpu.api.types import Volume
+
+    cs = FakeClientset()
+    ctrl = PVController(cs)
+    sched = Scheduler(clientset=cs)
+    for i in range(3):
+        cs.create_node(make_node().name(f"n{i}").capacity({"cpu": "8", "pods": 10}).obj())
+    cs.create_storage_class(StorageClass(
+        name="wffc", volume_binding_mode="WaitForFirstConsumer",
+        provisioner="csi.example.com"))
+    pvc = PersistentVolumeClaim.of("data", "1Gi", storage_class="wffc")
+    cs.create_pvc(pvc)
+    pod = make_pod().name("p").req({"cpu": "1"}).obj()
+    pod.volumes.append(Volume(name="data", pvc_name="data"))
+    cs.create_pod(pod)
+    sched.run_until_idle()
+    node = cs.bindings.get(pod.uid)
+    assert node
+    assert ctrl.provisions == 1
+    assert pvc.volume_name.startswith("pvc-")
+    assert pvc.annotations[SELECTED_NODE] == node
+    pv = cs.pvs[pvc.volume_name]
+    assert pv.csi_driver == "csi.example.com"
+    # provisioned PV is pinned to the selected node
+    assert pv.node_affinity is not None
+    node_obj = cs.nodes[node]
+    assert pv.node_affinity.matches(node_obj)
+
+
+def _pv_cluster(cls, n_nodes=30, csi_limit=None):
+    from kubernetes_tpu.core.clientset import FakeClientset
+    from kubernetes_tpu.core.scheduler import Scheduler as _S
+    from kubernetes_tpu.models import TPUScheduler as _T
+    from kubernetes_tpu.api.storage import CSINode
+    from kubernetes_tpu.testing.wrappers import make_node
+
+    cs = FakeClientset()
+    kw = {"deterministic_ties": True} if cls is _S else {}
+    sched = cls(clientset=cs, **kw)
+    for i in range(n_nodes):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": "8", "memory": "16Gi", "pods": 110}).obj())
+        if csi_limit is not None:
+            cs.create_csi_node(CSINode(
+                node_name=f"n{i}", driver_limits={"csi.x": csi_limit}))
+    return cs, sched
+
+
+def _bound_pvc_pods(cs, n, driver=""):
+    from kubernetes_tpu.api.storage import PersistentVolume, PersistentVolumeClaim
+    from kubernetes_tpu.api.types import Volume
+    from kubernetes_tpu.testing.wrappers import make_pod
+
+    pods = []
+    for i in range(n):
+        pv = PersistentVolume.of(f"pv-{i}", "1Gi", access_modes=("ReadOnlyMany",),
+                                 csi_driver=driver)
+        pvc = PersistentVolumeClaim.of(f"pvc-{i}", "1Gi",
+                                       access_modes=("ReadOnlyMany",))
+        pv.claim_ref = pvc.key
+        pvc.volume_name = pv.name
+        cs.create_pv(pv)
+        cs.create_pvc(pvc)
+        p = make_pod().name(f"vp-{i}").req({"cpu": "100m", "memory": "64Mi"}).obj()
+        p.volumes.append(Volume(name="data", pvc_name=f"pvc-{i}"))
+        cs.create_pod(p)
+        pods.append(p)
+    return pods
+
+
+def test_bound_pvc_pods_ride_device_and_match_host():
+    """Bound claims with no node affinity / zone labels / limits impose no
+    per-node constraint: such pods ride the device path with assignments
+    identical to the host oracle."""
+    from kubernetes_tpu.core.scheduler import Scheduler
+    from kubernetes_tpu.models import TPUScheduler
+
+    cs_h, host = _pv_cluster(Scheduler)
+    ph = _bound_pvc_pods(cs_h, 60)
+    host.run_until_idle()
+    cs_d, dev = _pv_cluster(TPUScheduler)
+    pd = _bound_pvc_pods(cs_d, 60)
+    dev.run_until_idle()
+    hb = {p.name: cs_h.bindings.get(p.uid) for p in ph}
+    db = {p.name: cs_d.bindings.get(p.uid) for p in pd}
+    assert hb == db
+    assert dev.device_scheduled == 60
+    assert dev.host_path_pods == 0
+
+
+def test_csi_attach_limits_enforced_on_device():
+    """The kernel's counted aux constraint (CSI attach limits,
+    nodevolumelimits/csi.go): with limit 2 on 3 nodes, exactly 6 of 8 pods
+    schedule, identical to the host oracle."""
+    from kubernetes_tpu.core.scheduler import Scheduler
+    from kubernetes_tpu.models import TPUScheduler
+
+    cs_h, host = _pv_cluster(Scheduler, n_nodes=3, csi_limit=2)
+    ph = _bound_pvc_pods(cs_h, 8, driver="csi.x")
+    host.run_until_idle()
+    cs_d, dev = _pv_cluster(TPUScheduler, n_nodes=3, csi_limit=2)
+    pd = _bound_pvc_pods(cs_d, 8, driver="csi.x")
+    dev.run_until_idle()
+    hb = {p.name: cs_h.bindings.get(p.uid) for p in ph}
+    db = {p.name: cs_d.bindings.get(p.uid) for p in pd}
+    assert hb == db
+    assert sum(1 for v in db.values() if v) == 6
+    assert dev.device_scheduled >= 6
+
+
+def test_shared_claim_pods_fall_back_to_host():
+    """Two pods sharing one bound claim: the kernel's per-landing attach
+    math would double-count, so the second pod must take the host path (and
+    both schedule correctly)."""
+    from kubernetes_tpu.api.storage import PersistentVolume, PersistentVolumeClaim
+    from kubernetes_tpu.api.types import Volume
+    from kubernetes_tpu.models import TPUScheduler
+    from kubernetes_tpu.testing.wrappers import make_pod
+
+    cs, dev = _pv_cluster(TPUScheduler, n_nodes=4, csi_limit=5)
+    pv = PersistentVolume.of("shared-pv", "1Gi", access_modes=("ReadOnlyMany",),
+                             csi_driver="csi.x")
+    pvc = PersistentVolumeClaim.of("shared", "1Gi", access_modes=("ReadOnlyMany",))
+    pv.claim_ref = pvc.key
+    pvc.volume_name = pv.name
+    cs.create_pv(pv)
+    cs.create_pvc(pvc)
+    pods = []
+    for i in range(2):
+        p = make_pod().name(f"sh-{i}").req({"cpu": "100m"}).obj()
+        p.volumes.append(Volume(name="d", pvc_name="shared"))
+        cs.create_pod(p)
+        pods.append(p)
+    dev.run_until_idle()
+    assert all(cs.bindings.get(p.uid) for p in pods)
