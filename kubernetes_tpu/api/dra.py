@@ -22,6 +22,10 @@ class Device:
     name: str
     attributes: Dict[str, str] = field(default_factory=dict)
     capacity: Dict[str, str] = field(default_factory=dict)
+    # Node-allocatable resources this device CONSUMES when allocated
+    # (nodeallocatabledynamicresources.go: DRA allocations that draw from
+    # the node's cpu/memory budget), e.g. {"cpu": "2", "memory": "4Gi"}.
+    consumes: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -36,10 +40,16 @@ class ResourceSlice:
 @dataclass
 class DeviceClass:
     """DeviceClass: a named device category; `selectors` are attribute
-    equality requirements every matching device must satisfy."""
+    equality requirements every matching device must satisfy.
+    `extended_resource_name` maps a v1 extended resource (e.g.
+    example.com/gpu) onto this class: pods requesting it are satisfied via
+    DRA when no device plugin advertises it
+    (resource/v1 types.go:2427 ExtendedResourceName +
+    extendeddynamicresources.go)."""
 
     name: str
     selectors: Dict[str, str] = field(default_factory=dict)
+    extended_resource_name: str = ""
 
 
 @dataclass
@@ -141,8 +151,19 @@ def compile_device_expression(expr: str):
         __slots__ = ("attributes", "capacity", "driver", "name")
 
         def __init__(self, device, driver):
-            self.attributes = _CoercingMap(device.attributes)
-            self.capacity = _CoercingMap(getattr(device, "capacity", {}) or {})
+            # Coerced maps are memoized ON the device: attribute dicts are
+            # immutable spec, and the exception-driven coercion chain costs
+            # more than the whole match when it runs per evaluation.
+            attrs = device.__dict__.get("_coerced_attrs")
+            if attrs is None:
+                attrs = device._coerced_attrs = _CoercingMap.coerced(
+                    device.attributes)
+            cap = device.__dict__.get("_coerced_cap")
+            if cap is None:
+                cap = device._coerced_cap = _CoercingMap.coerced(
+                    getattr(device, "capacity", {}) or {})
+            self.attributes = attrs
+            self.capacity = cap
             self.driver = driver
             self.name = device.name
 
@@ -160,16 +181,38 @@ def compile_device_expression(expr: str):
 
 class _CoercingMap(dict):
     """Attribute/capacity map that compares numerically when both sides are
-    numeric (quantity semantics: "40" >= 32 must hold)."""
+    numeric, with full QUANTITY semantics for suffixed strings — the typed
+    CEL surface: device.capacity["memory"] >= 40 * 1024**3 holds for
+    "40Gi" (apimachinery resource.Quantity comparisons in the reference's
+    CEL environment)."""
 
-    def __getitem__(self, key):
-        v = dict.get(self, key)
+    @classmethod
+    def coerced(cls, raw: Dict[str, str]) -> "_CoercingMap":
+        """Pre-coerce every value ONCE (the maps are per-device spec)."""
+        out = cls()
+        for k, v in raw.items():
+            out[k] = cls._coerce(v)
+        return out
+
+    @staticmethod
+    def _coerce(v):
         if isinstance(v, str):
             try:
                 return int(v)
             except ValueError:
-                try:
-                    return float(v)
-                except ValueError:
-                    return v
+                pass
+            try:
+                return float(v)
+            except ValueError:
+                pass
+            try:
+                from .resource import parse_quantity
+                q = parse_quantity(v)
+                iq = int(q)
+                return iq if q == iq else float(q)
+            except Exception:
+                return v
         return v
+
+    def __getitem__(self, key):
+        return dict.get(self, key)

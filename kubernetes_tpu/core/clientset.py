@@ -132,13 +132,23 @@ class FakeClientset:
 
     def create_resource_slice(self, sl) -> object:
         self.resource_slices.setdefault(sl.node_name, []).append(sl)
+        if any(getattr(d, "consumes", None) for d in sl.devices):
+            # Node-allocatable-consuming devices: their allocation math is
+            # outside the device kernel's aux model (eligibility checks this).
+            self.has_consuming_devices = True
         self._fire_storage("resource_slice", sl)
         return sl
 
     def create_resource_claim(self, claim) -> object:
         self.resource_claims[claim.key] = claim
+        self.resource_claims_rv = getattr(self, "resource_claims_rv", 0) + 1
         self._fire_storage("resource_claim", claim)
         return claim
+
+    def bump_resource_claims_rv(self) -> None:
+        """Out-of-band claim mutations (controller-side allocation) must
+        invalidate in-use caches keyed on the claims revision."""
+        self.resource_claims_rv = getattr(self, "resource_claims_rv", 0) + 1
 
     def create_device_class(self, dc) -> object:
         self.device_classes[dc.name] = dc

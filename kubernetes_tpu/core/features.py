@@ -36,6 +36,8 @@ SCHEDULER_POP_FROM_BACKOFF_Q = "SchedulerPopFromBackoffQ"  # :1062
 NOMINATED_NODE_NAME_FOR_EXPECTATION = "NominatedNodeNameForExpectation"  # :812
 SCHEDULER_QUEUEING_HINTS = "SchedulerQueueingHints"
 NODE_DECLARED_FEATURES = "NodeDeclaredFeatures"
+DRA_EXTENDED_RESOURCE = "DRAExtendedResource"             # :240 fork
+DRA_NODE_ALLOCATABLE_RESOURCES = "DRANodeAllocatableResources"  # :261 fork
 DYNAMIC_RESOURCE_ALLOCATION = "DynamicResourceAllocation"
 MATCH_LABEL_KEYS_IN_POD_TOPOLOGY_SPREAD = "MatchLabelKeysInPodTopologySpread"
 # TPU-native framework gates.
@@ -52,6 +54,10 @@ DEFAULT_FEATURES: Dict[str, FeatureSpec] = {
     SCHEDULER_QUEUEING_HINTS: FeatureSpec(True, BETA),
     NODE_DECLARED_FEATURES: FeatureSpec(False, ALPHA),
     DYNAMIC_RESOURCE_ALLOCATION: FeatureSpec(False, ALPHA),
+    DRA_EXTENDED_RESOURCE: FeatureSpec(
+        False, ALPHA, depends_on=(DYNAMIC_RESOURCE_ALLOCATION,)),
+    DRA_NODE_ALLOCATABLE_RESOURCES: FeatureSpec(
+        False, ALPHA, depends_on=(DYNAMIC_RESOURCE_ALLOCATION,)),
     MATCH_LABEL_KEYS_IN_POD_TOPOLOGY_SPREAD: FeatureSpec(True, GA),
     TPU_BATCH_SCHEDULING: FeatureSpec(True, BETA),
     TPU_STATE_RESIDENCY: FeatureSpec(True, BETA, depends_on=(TPU_BATCH_SCHEDULING,)),

@@ -52,7 +52,7 @@ IN_TREE_REGISTRY: Dict[str, Callable] = {
     "TaintToleration": lambda h, **kw: TaintToleration(),
     "NodeAffinity": lambda h, **kw: NodeAffinity(),
     "NodePorts": lambda h, **kw: NodePorts(),
-    "NodeResourcesFit": lambda h, **kw: Fit(**kw),
+    "NodeResourcesFit": lambda h, **kw: Fit(handle=h, **kw),
     "PodTopologySpread": lambda h, **kw: PodTopologySpread(handle=h, **kw),
     "InterPodAffinity": lambda h, **kw: InterPodAffinity(handle=h, **kw),
     "NodeResourcesBalancedAllocation": lambda h, **kw: BalancedAllocation(**kw),

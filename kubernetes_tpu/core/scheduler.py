@@ -341,8 +341,16 @@ class Scheduler:
 
     def _on_storage_event(self, kind: str, obj) -> None:
         from .queue import EVENT_STORAGE_ADD
-        self.cluster_event_seq += 1
-        self.queue.move_all_to_active_or_backoff(EVENT_STORAGE_ADD)
+        # Device-session validity: only storage objects that change NODE
+        # capability can stale an in-flight carry (CSINode limits, device
+        # pools, PV topology, binding-mode classes). New claims/PVCs are
+        # pod-side state — they unblock WAITING pods (queue move below) but
+        # cannot invalidate decisions already made for eligible pods, and
+        # bumping the seq per created claim would tear down a session per
+        # measured pod (the claim-template workload creates one each).
+        if kind not in ("pvc", "resource_claim"):
+            self.cluster_event_seq += 1
+        self.queue.move_all_to_active_or_backoff(EVENT_STORAGE_ADD, None, obj)
 
     def _responsible_for_pod(self, pod: Pod) -> bool:
         """eventhandlers.go responsibleForPod: only queue pods whose

@@ -172,6 +172,10 @@ class TPUScheduler(Scheduler):
         if sig is None:
             return fw, [head], reason or "unsignable pod"
         self._session_claims = set(self._claims_of(head.pod))
+        self._session_claims.update(
+            f"dra:{head.pod.namespace}/{n}"
+            for n in getattr(head.pod, "resource_claims", ()) or ())
+        self._session_aux_shape = self._aux_shape(head.pod)
         batch = [head]
         while len(batch) < self.max_batch:
             nxt = self._pop()
@@ -221,10 +225,12 @@ class TPUScheduler(Scheduler):
                     or fw.sign_pod(m.pod) != sig
                     or self._batch_supported_memo(m.pod, fw) is not None
                     or self._device_unsupported_profile(fw, m.pod) is not None
-                    # PVC-claimed members stay on the host group cycle: the
-                    # gang session has no per-member claim-dedup seam, and
-                    # the kernel's counted attach math requires it.
-                    or any(v.pvc_name for v in m.pod.volumes)):
+                    # PVC/DRA-claimed members stay on the host group cycle:
+                    # the gang session has no per-member claim-dedup seam and
+                    # commits with a fresh CycleState (their stateful
+                    # Reserve/PreBind would silently no-op).
+                    or any(v.pvc_name for v in m.pod.volumes)
+                    or getattr(m.pod, "resource_claims", None)):
                 return None, None
         return fw, sig
 
@@ -552,6 +558,16 @@ class TPUScheduler(Scheduler):
         if pts is not None and getattr(pts, "default_constraints", ()) \
                 and not pod.topology_spread_constraints:
             return "plugin-level default spread constraints"
+        if fw.plugin("DynamicResources") is not None:
+            req = pod.resource_request()
+            if req.scalar_resources and any(
+                    dc.extended_resource_name in req.scalar_resources
+                    for dc in self.clientset.device_classes.values()
+                    if dc.extended_resource_name):
+                # Extended resources backed by DRA: the kernel's fit math
+                # would treat them as plain node scalars, but the plugin may
+                # satisfy them from ResourceSlices instead.
+                return "extended resources backed by DRA"
         return None
 
     def build_plan(self, fw: Framework, pod, batch_size: int):
@@ -581,6 +597,8 @@ class TPUScheduler(Scheduler):
             fit_plugin=fw.plugin("NodeResourcesFit"),
             clientset=self.clientset, pvc_refs=self.cache.pvc_refs,
             limited_drivers=self.limited_drivers(),
+            dra_enabled=self._dra_ctx(fw)[0],
+            dra_in_use=self._dra_ctx(fw)[1],
         )
         state = self.mirror.flush()
         if self.mesh is not None:
@@ -681,9 +699,40 @@ class TPUScheduler(Scheduler):
             self._limited_drivers_n = rv
         return self._limited_drivers
 
+    def _dra_ctx(self, fw: Framework):
+        """(dra_enabled, in_use) for eligibility/plan builds: claims are
+        scheduling-relevant only when the profile runs DynamicResources."""
+        dr = fw.plugin("DynamicResources")
+        if dr is None:
+            return False, None
+        return True, dr._in_use()
+
     def _claims_of(self, pod) -> list:
         return [f"{pod.namespace}/{v.pvc_name}"
                 for v in pod.volumes if v.pvc_name]
+
+    def _claim_shape(self, pod):
+        names = getattr(pod, "resource_claims", ()) or ()
+        if not names:
+            return None
+        claim = self.clientset.resource_claims.get(
+            f"{pod.namespace}/{names[0]}")
+        if claim is None or len(claim.requests) != 1:
+            return ("?",)
+        r = claim.requests[0]
+        return (r.device_class, r.count,
+                tuple(sorted(r.selectors.items())), r.expression)
+
+    def _aux_shape(self, pod):
+        """The counted-constraint shape a plan models for this pod: the
+        volume attach (driver, inc) AND the DRA claim shape. Every session
+        member must share it — a mixed batch would run the head's aux math
+        against members with different (or no) counted constraints."""
+        from ..ops.features import volume_device_support
+        _r, vol_d, vol_inc = volume_device_support(
+            pod, self.clientset, pvc_refs=self.cache.pvc_refs,
+            limited_drivers=self.limited_drivers())
+        return ((vol_d, vol_inc) if vol_d else None, self._claim_shape(pod))
 
     def _batch_supported_memo(self, pod, fw: Framework):
         """batch_supported with the verdict memoized on the pod's shared
@@ -694,14 +743,19 @@ class TPUScheduler(Scheduler):
         if pod.nominated_node_name:
             return "nominated node fast path"
         shared = pod.__dict__.get("_sig_shared")
-        if shared is None or any(v.pvc_name for v in pod.volumes):
-            # PVC verdicts depend on live claim/PV state — never memoized.
+        if (shared is None or any(v.pvc_name for v in pod.volumes)
+                or getattr(pod, "resource_claims", None)):
+            # PVC/claim verdicts depend on live claim/PV state — never
+            # memoized.
+            dra_enabled, dra_in_use = self._dra_ctx(fw)
             return batch_supported(
                 pod, self.snapshot,
                 fit_plugin=fw.plugin("NodeResourcesFit"),
                 ba_plugin=fw.plugin("NodeResourcesBalancedAllocation"),
                 clientset=self.clientset, pvc_refs=self.cache.pvc_refs,
-                limited_drivers=self.limited_drivers())
+                limited_drivers=self.limited_drivers(),
+                dra_enabled=dra_enabled, dra_in_use=dra_in_use,
+                session_claims=self._session_claims)
         key = ("_bsup", id(fw))
         if key in shared:
             return shared[key]
@@ -726,13 +780,22 @@ class TPUScheduler(Scheduler):
                 # it would silently skip that feature's filters.
                 and self._batch_supported_memo(head.pod, fw) is None):
             return False
+        if self._aux_shape(head.pod) != getattr(
+                self, "_session_aux_shape", None):
+            # The plan's aux decrement models ONE counted-constraint shape
+            # (volume attach or claim); a member with a different (or no)
+            # constraint must not share the batch.
+            return False
         claims = self._claims_of(head.pod)
-        if claims:
+        dra_claims = [f"dra:{head.pod.namespace}/{n}"
+                      for n in getattr(head.pod, "resource_claims", ()) or ()]
+        if claims or dra_claims:
             # A claim already used by a pod accepted into this session must
             # not be counted twice by the kernel's per-landing attach math.
-            if any(c in self._session_claims for c in claims):
+            if any(c in self._session_claims for c in claims + dra_claims):
                 return False
             self._session_claims.update(claims)
+            self._session_claims.update(dra_claims)
         return True
 
     def _collect_session_batch(self, fw: Framework, sig) -> List[QueuedPodInfo]:
@@ -1007,7 +1070,26 @@ class TPUScheduler(Scheduler):
 
         pod = qpi.pod
         self.attempts += 1
-        if (not pod.pod_group and not self.extenders
+        dra_state = None
+        if getattr(pod, "resource_claims", None):
+            dr = fw.plugin("DynamicResources")
+            if dr is not None:
+                # The kernel decided the NODE via the free-matching-device
+                # aux count; the host picks the actual devices by running
+                # the plugin's allocation on that one node (the full
+                # per-node Filter, restricted to the winner). A miss means
+                # the carry diverged from live device state.
+                dra_state = CycleState()
+                ni = self.snapshot.get(node_name)
+                _r, st = dr.pre_filter(dra_state, pod,
+                                       [ni] if ni is not None else [])
+                if st.is_success() and ni is not None:
+                    st = dr.filter(dra_state, pod, ni)
+                if ni is None or not st.is_success():
+                    self.host_path_pods += 1
+                    self.process_one(qpi)
+                    return False
+        if (dra_state is None and not pod.pod_group and not self.extenders
                 and self._commit_fast_eligible(fw)):
             # Lean tail: identical observable semantics to the full path
             # below for this plugin shape (the skipped plugin runs are
@@ -1035,7 +1117,7 @@ class TPUScheduler(Scheduler):
             self._unwind_binding(fw, CycleState(), qpi, node_name, st)
             self.queue.done(pod.uid)
             return False
-        state = CycleState()
+        state = dra_state if dra_state is not None else CycleState()
         pod.node_name = node_name
         self.cache.assume_pod(pod, qpi.pod_info)
         if fw.reserve_plugins:  # guard: this tail runs once per pod at >10k/s

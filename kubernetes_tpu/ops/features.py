@@ -230,9 +230,85 @@ def volume_device_support(pod: Pod, clientset, pvc_refs=None,
     return None, "", 0
 
 
+def dra_device_support(pod: Pod, clientset, dra_in_use=None,
+                       session_claims=None):
+    """Device eligibility for a pod's resource claims: returns
+    (reason, shape, inc). Eligible when the pod has exactly ONE unallocated,
+    unreserved, unshared claim with ONE request — the claim-template shape.
+    The kernel then models the node's FREE MATCHING DEVICE count as the
+    counted aux resource; the host commit picks the actual devices on the
+    chosen node only (plugins/dynamicresources.py filter, restricted to one
+    node). `shape` keys session compatibility: every member of a batch must
+    request identically or the per-landing decrement is wrong."""
+    names = list(getattr(pod, "resource_claims", ()) or ())
+    if not names:
+        return None, None, 0
+    if clientset is None or len(names) != 1:
+        return "dynamic resource claims", None, 0
+    key = f"{pod.namespace}/{names[0]}"
+    claim = clientset.resource_claims.get(key)
+    if claim is None:
+        return "resource claim not found", None, 0
+    if claim.allocated or claim.reserved_for:
+        return "allocated resource claim", None, 0
+    if getattr(clientset, "has_consuming_devices", False):
+        # Devices that consume node allocatable add a second constraint
+        # dimension the aux count cannot model (the plugin's
+        # _check_node_allocatable).
+        return "node-allocatable-consuming devices", None, 0
+    if session_claims is not None and f"dra:{key}" in session_claims:
+        return "claim shared within session", None, 0
+    if len(claim.requests) != 1:
+        return "multi-request claim", None, 0
+    r = claim.requests[0]
+    shape = (r.device_class, r.count, tuple(sorted(r.selectors.items())),
+             r.expression)
+    return None, shape, int(r.count)
+
+
+def count_free_matching_devices(clientset, node_name: str, shape,
+                                dra_in_use) -> int:
+    """Free devices on `node_name` matching the session's claim shape —
+    the aux_room source for DRA batches (mirror of
+    plugins/dynamicresources.py filter's per-device predicate)."""
+    from ..plugins.dynamicresources import DynamicResources
+
+    device_class, _count, sel_items, expression = shape
+    sel = dict(sel_items)
+    if device_class:
+        dc = clientset.device_classes.get(device_class)
+        if dc is not None:
+            sel.update(dc.selectors)
+    matcher = _compiled_expr(expression) if expression else None
+    n = 0
+    for sl in clientset.resource_slices.get(node_name, ()):
+        for dev in sl.devices:
+            if (node_name, sl.driver, dev.name) in dra_in_use:
+                continue
+            if not all(dev.attributes.get(k) == v for k, v in sel.items()):
+                continue
+            if matcher is not None and not matcher(dev, sl.driver):
+                continue
+            n += 1
+    return n
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=256)
+def _compiled_expr(expression: str):
+    """Compiled device-selector cache (expression strings are the whole
+    input to compilation; bounded so long-lived processes with many claim
+    shapes can't grow it without limit)."""
+    from ..api.dra import compile_device_expression
+    return compile_device_expression(expression)
+
+
 def batch_supported(pod: Pod, snapshot, fit_plugin=None, ba_plugin=None,
                     clientset=None, pvc_refs=None,
                     limited_drivers=frozenset(),
+                    dra_enabled=False, dra_in_use=None, session_claims=None,
                     _volume_verdict=None) -> Optional[str]:
     """Returns a reason string when the pod needs the host path, else None.
 
@@ -254,14 +330,25 @@ def batch_supported(pod: Pod, snapshot, fit_plugin=None, ba_plugin=None,
         # universe is tiny, so the host cycle is already O(1) per pod.
         if any(t.match_fields for t in na.required.terms):
             return "node-affinity metadata.name narrowing"
-    reason, _d, _inc = (_volume_verdict if _volume_verdict is not None
-                        else volume_device_support(
-                            pod, clientset, pvc_refs=pvc_refs,
-                            limited_drivers=limited_drivers))
+    reason, vol_d, vol_inc = (_volume_verdict if _volume_verdict is not None
+                              else volume_device_support(
+                                  pod, clientset, pvc_refs=pvc_refs,
+                                  limited_drivers=limited_drivers))
     if reason is not None:
         return reason
     if getattr(pod, "resource_claims", None):
-        return "dynamic resource claims"
+        if not dra_enabled:
+            # Profile has no DynamicResources plugin: claims are inert for
+            # scheduling (host semantics) — the pod batches as plain.
+            pass
+        else:
+            dreason, _shape, dinc = dra_device_support(
+                pod, clientset, dra_in_use=dra_in_use,
+                session_claims=session_claims)
+            if dreason is not None:
+                return dreason
+            if dinc and (vol_d and vol_inc):
+                return "volume and DRA counted constraints together"
     if fit_plugin is not None and fit_plugin.scoring_strategy not in ("LeastAllocated", "MostAllocated"):
         return "requestedToCapacityRatio strategy"
     if ba_plugin is not None and tuple(
@@ -298,6 +385,8 @@ def build_batch(
     clientset=None,
     pvc_refs=None,
     limited_drivers=frozenset(),
+    dra_enabled=False,
+    dra_in_use=None,
 ) -> BatchPlan:
     """Build kernel inputs for a batch of `batch_size` pods identical to `pod`.
 
@@ -309,10 +398,17 @@ def build_batch(
     reason = batch_supported(pod, snapshot, fit_plugin=fit_plugin,
                              clientset=clientset, pvc_refs=pvc_refs,
                              limited_drivers=limited_drivers,
+                             dra_enabled=dra_enabled, dra_in_use=dra_in_use,
                              _volume_verdict=verdict)
     if reason:
         raise Unsupported(reason)
     _vr, aux_driver, aux_inc_n = verdict
+    dra_shape = None
+    if dra_enabled and getattr(pod, "resource_claims", None):
+        _dr, dra_shape, dra_inc = dra_device_support(
+            pod, clientset, dra_in_use=dra_in_use)
+        if dra_shape is not None and dra_inc:
+            aux_driver, aux_inc_n = "", 0  # volume aux unused with DRA aux
 
     nodes: List[NodeInfo] = snapshot.node_info_list
     n = len(nodes)
@@ -697,9 +793,17 @@ def build_batch(
 
     to_find = num_feasible_nodes_to_find(n, percentage_of_nodes_to_score)
 
-    # ---- counted aux constraint: CSI attach room per node ----------------
+    # ---- counted aux constraint: CSI attach room / DRA free devices ------
     AUX_BIG = (1 << 30)
     aux_room = np.full(npc, AUX_BIG, i32)
+    has_aux_flag = False
+    if dra_shape is not None:
+        iu = dra_in_use if dra_in_use is not None else set()
+        for r_i, ni in enumerate(nodes):
+            aux_room[r_i] = count_free_matching_devices(
+                clientset, ni.name, dra_shape, iu)
+        aux_inc_n = dra_shape[1]
+        has_aux_flag = True
     if aux_driver and aux_inc_n:
         driver_of: Dict[str, Optional[str]] = {}
 
@@ -776,7 +880,7 @@ def build_batch(
         anti_rowlocal=anti_rowlocal,
         has_na_pref=has_na_pref,
         port_selfblock=port_selfblock,
-        has_aux=bool(aux_driver and aux_inc_n),
+        has_aux=has_aux_flag or bool(aux_driver and aux_inc_n),
     )
 
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..api.dra import AllocatedDevice, ResourceClaim
+from ..api.dra import AllocatedDevice, DeviceRequest, ResourceClaim
 from ..api.types import Pod
 from ..core.framework import OK, CycleState, PreFilterResult, Status
 from ..core.node_info import NodeInfo
@@ -30,12 +30,63 @@ class DynamicResources:
     state_driven_tail = True
     _KEY = "PreFilterDynamicResources"
 
+    # extendeddynamicresources.go specialClaimInMemName: the in-memory
+    # claim tracking extended-resource-backed allocations until PreBind
+    # creates the real object.
+    SPECIAL_CLAIM_NAME = "<extended-resources>"
+
     def __init__(self, handle=None):
         self.handle = handle
         # Assume cache (dra_manager.go / assumecache): device keys held by
         # in-flight reservations, per claim.
         self.assumed: Dict[str, List[AllocatedDevice]] = {}  # claim key -> devices
         self.assumed_nodes: Dict[str, str] = {}              # claim key -> node
+        # Revision-cached in-use device set: rebuilt when the clientset's
+        # claim revision moves, updated INCREMENTALLY by our own
+        # reserve/unreserve (the O(all claims) rebuild per cycle made the
+        # claim-template workload quadratic).
+        self._iu_cache: Optional[Set[Tuple[str, str, str]]] = None
+        self._iu_rv = -1
+
+    def _gate(self, name: str) -> bool:
+        gates = getattr(self.handle, "gates", None)
+        if gates is None:
+            return True
+        try:
+            return gates.enabled(name)
+        except ValueError:
+            return True
+
+    def _extended_claim_for(self, pod: Pod) -> Optional[ResourceClaim]:
+        """Extended Resources Backed by DRA (extendeddynamicresources.go
+        preFilterExtendedResources): a pod requesting an extended resource
+        mapped by some DeviceClass.extended_resource_name gets an IN-MEMORY
+        claim requesting count=quantity devices of that class; the real
+        object is created in PreBind."""
+        from ..core.features import DRA_EXTENDED_RESOURCE
+        if not self._gate(DRA_EXTENDED_RESOURCE):
+            return None
+        req = pod.resource_request()
+        if not req.scalar_resources:
+            return None
+        by_ext = {dc.extended_resource_name: dc
+                  for dc in self.handle.device_classes.values()
+                  if dc.extended_resource_name}
+        if not by_ext:
+            return None
+        requests = []
+        for rname, amount in req.scalar_resources.items():
+            dc = by_ext.get(rname)
+            if dc is not None and amount > 0:
+                requests.append(DeviceRequest(
+                    name=rname, device_class=dc.name, count=int(amount)))
+        if not requests:
+            return None
+        # Named for its pod from the start: the assume cache keys on
+        # claim.key, and a shared in-memory name would let two in-flight
+        # extended-resource pods clobber each other's reservations.
+        return ResourceClaim(name=f"{pod.name}-extended-resources",
+                             namespace=pod.namespace, requests=requests)
 
     # -- listers -----------------------------------------------------------
 
@@ -44,7 +95,13 @@ class DynamicResources:
                 for name in getattr(pod, "resource_claims", ())]
 
     def _in_use(self) -> Set[Tuple[str, str, str]]:
-        """(node, driver, device) triples already allocated or assumed."""
+        """(node, driver, device) triples already allocated or assumed.
+        Cached against the clientset's claim revision; our own
+        reserve/unreserve/pre_bind keep it consistent in between (their
+        net effect on the set is exactly the triples they add/remove)."""
+        rv = getattr(self.handle.clientset, "resource_claims_rv", 0)
+        if self._iu_cache is not None and self._iu_rv == rv:
+            return self._iu_cache
         used: Set[Tuple[str, str, str]] = set()
         for claim in self.handle.resource_claims.values():
             if claim.allocated:
@@ -54,6 +111,8 @@ class DynamicResources:
             node = self.assumed_nodes.get(key, "")
             for d in devices:
                 used.add((node, d.driver, d.device))
+        self._iu_cache = used
+        self._iu_rv = rv
         return used
 
     # -- PreFilter ---------------------------------------------------------
@@ -69,6 +128,10 @@ class DynamicResources:
         # Filter must not rescan every claim in the cluster (O(claims) per
         # node turned the 500-node DRA workload O(claims x nodes x pods)).
         in_use: Optional[Set[Tuple[str, str, str]]] = None
+        # Extended-resources-backed special claim (in-memory until PreBind);
+        # nodes where the device plugin satisfied everything keep an empty
+        # allocation list (extendeddynamicresources.go filterExtendedResources).
+        special: Optional[ResourceClaim] = None
 
         def clone(self) -> "DynamicResources._State":
             return DynamicResources._State(
@@ -76,13 +139,21 @@ class DynamicResources:
                 pinned_node=self.pinned_node,
                 node_allocations={k: list(v) for k, v in self.node_allocations.items()},
                 in_use=set(self.in_use) if self.in_use is not None else None,
+                special=self.special,
             )
 
     def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Tuple[Optional[PreFilterResult], Status]:
         names = getattr(pod, "resource_claims", ())
-        if not names:
+        special = self._extended_claim_for(pod) if not names else None
+        if not names and special is None:
             return None, Status.skip()
         s = self._State()
+        if special is not None:
+            s.claims.append(special)
+            s.special = special
+            s.in_use = self._in_use()
+            state.write(self._KEY, s)
+            return None, OK
         pinned: Optional[str] = None
         for name in names:
             claim = self.handle.resource_claims.get(f"{pod.namespace}/{name}")
@@ -93,8 +164,15 @@ class DynamicResources:
                 if pinned is not None and claim.allocated_node != pinned:
                     return None, Status.unresolvable(ERR_ALLOCATED_ELSEWHERE)
                 pinned = claim.allocated_node
-        s.in_use = self._in_use()
         state.write(self._KEY, s)
+        if pinned is not None and all(c.allocated for c in s.claims):
+            # Every claim pre-allocated: scheduling reduces to validating
+            # the pinned node — the O(all claims) in-use scan is dead
+            # weight (it only feeds fresh allocation attempts), and the
+            # ResourceClaimTemplate workload pays it once per pod.
+            s.pinned_node = pinned
+            return PreFilterResult({pinned}), OK
+        s.in_use = self._in_use()
         if pinned is not None:
             s.pinned_node = pinned
             return PreFilterResult({pinned}), OK
@@ -141,11 +219,21 @@ class DynamicResources:
                 continue
             devices: List[AllocatedDevice] = []
             for req in claim.requests:
+                count = req.count
+                if claim is s.special:
+                    # Extended resource: the node's device plugin satisfies
+                    # it outright when it advertises enough capacity; DRA
+                    # devices only back the remainder-less case
+                    # (filterExtendedResources: device-plugin vs DRA split).
+                    free = (node_info.allocatable.scalar_resources.get(req.name, 0)
+                            - node_info.requested.scalar_resources.get(req.name, 0))
+                    if free >= count:
+                        continue
                 sel = self._resolve_selectors(req)
                 found = 0
                 for sl in slices:
                     for dev in sl.devices:
-                        if found >= req.count:
+                        if found >= count:
                             break
                         key = (sl.driver, dev.name)
                         if key in taken or (node_name, sl.driver, dev.name) in in_use:
@@ -158,11 +246,67 @@ class DynamicResources:
                         devices.append(AllocatedDevice(sl.driver, dev.name))
                         taken.add(key)
                         found += 1
-                if found < req.count:
+                if found < count:
                     return Status.unschedulable(ERR_NO_DEVICES)
             allocations.append((claim, devices))
+        st = self._check_node_allocatable(pod, node_info, allocations, slices,
+                                          in_use)
+        if st is not None:
+            return st
         s.node_allocations[node_name] = allocations
         return OK
+
+    def _check_node_allocatable(self, pod: Pod, node_info: NodeInfo,
+                                allocations, slices,
+                                in_use=None) -> Optional[Status]:
+        """DRA allocations that consume node-allocatable resources
+        (nodeallocatabledynamicresources.go
+        calculateAndCheckNodeAllocatableResources): the pod's container
+        requests PLUS its chosen devices' declared consumption must fit the
+        node's remaining allocatable."""
+        from ..core.features import DRA_NODE_ALLOCATABLE_RESOURCES
+        if not self._gate(DRA_NODE_ALLOCATABLE_RESOURCES):
+            return None
+        dev_objs = {}
+        for sl in slices:
+            for dev in sl.devices:
+                if dev.consumes:
+                    dev_objs[(sl.driver, dev.name)] = dev
+        if not dev_objs:
+            return None
+        from ..api.resource import cpu_to_milli, to_int
+        extra_cpu = extra_mem = 0
+        for _claim, devices in allocations:
+            for ad in devices:
+                dev = dev_objs.get((ad.driver, ad.device))
+                if dev is None:
+                    continue
+                if "cpu" in dev.consumes:
+                    extra_cpu += cpu_to_milli(dev.consumes["cpu"])
+                if "memory" in dev.consumes:
+                    extra_mem += to_int(dev.consumes["memory"])
+        # Devices ALREADY allocated on this node consume allocatable that
+        # NodeInfo.requested doesn't know about (their pods' requests only
+        # cover containers) — charge them too
+        # (nodeallocatabledynamicresources.go counts existing allocations).
+        node_name = node_info.name
+        if in_use:
+            for (driver, name), dev in dev_objs.items():
+                if (node_name, driver, name) in in_use:
+                    if "cpu" in dev.consumes:
+                        extra_cpu += cpu_to_milli(dev.consumes["cpu"])
+                    if "memory" in dev.consumes:
+                        extra_mem += to_int(dev.consumes["memory"])
+        if not extra_cpu and not extra_mem:
+            return None
+        req = pod.resource_request()
+        alloc = node_info.allocatable
+        used = node_info.requested
+        if (req.milli_cpu + extra_cpu > alloc.milli_cpu - used.milli_cpu
+                or req.memory + extra_mem > alloc.memory - used.memory):
+            return Status.unschedulable(
+                "node(s) lack allocatable for DRA device consumption")
+        return None
 
     # -- Reserve / Unreserve / PreBind -------------------------------------
 
@@ -173,28 +317,50 @@ class DynamicResources:
         for claim, devices in s.node_allocations.get(node_name, ()):
             self.assumed[claim.key] = devices
             self.assumed_nodes[claim.key] = node_name
+            if self._iu_cache is not None:
+                for d in devices:
+                    self._iu_cache.add((node_name, d.driver, d.device))
         return OK
 
     def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
         s: Optional[DynamicResources._State] = state.read(self._KEY)
         if s is None:
             return
-        for claim, _ in s.node_allocations.get(node_name, ()):
+        for claim, devices in s.node_allocations.get(node_name, ()):
             self.assumed.pop(claim.key, None)
             self.assumed_nodes.pop(claim.key, None)
+            if self._iu_cache is not None:
+                for d in devices:
+                    self._iu_cache.discard((node_name, d.driver, d.device))
 
     def pre_bind_pre_flight(self, state: CycleState, pod: Pod,
                             node_name: str) -> Status:
         """PreBindPreFlight (dynamicresources.go PreBindPreFlight): Skip
-        when the pod references no resource claims."""
-        if not getattr(pod, "resource_claims", None):
-            return Status.skip()
-        return OK
+        when the pod references no resource claims AND no in-memory
+        extended-resources claim was built for it this cycle."""
+        if getattr(pod, "resource_claims", None):
+            return OK
+        s = state.read(self._KEY)
+        if s is not None and s.special is not None:
+            return OK
+        return Status.skip()
 
     def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         s: Optional[DynamicResources._State] = state.read(self._KEY)
         if s is None:
             return OK
+        if s.special is not None and any(
+                devices for claim, devices in s.node_allocations.get(node_name, ())
+                if claim is s.special):
+            # bindClaim (extendeddynamicresources.go): the in-memory claim
+            # becomes a real API object; the pod records the mapping in
+            # extended_resource_claim_status. When the node's device plugin
+            # satisfied every request, no claim is created at all.
+            self.handle.clientset.create_resource_claim(s.special)
+            pod.extended_resource_claim_status = {
+                "claim": s.special.key,
+                "requests": [r.name for r in s.special.requests],
+            }
         for claim, devices in s.node_allocations.get(node_name, ()):
             claim.allocated_node = node_name
             claim.allocations = list(devices)
@@ -258,4 +424,6 @@ def allocate_pending_claims(clientset) -> int:
                     used.add((node_name, d.driver, d.device))
                 n_alloc += 1
                 break
+    if n_alloc and hasattr(clientset, "bump_resource_claims_rv"):
+        clientset.bump_resource_claims_rv()
     return n_alloc

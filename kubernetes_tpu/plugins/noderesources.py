@@ -143,10 +143,49 @@ class Fit:
 
     def __init__(self, scoring_strategy: str = LEAST_ALLOCATED,
                  resources: Sequence[Dict] = DEFAULT_RESOURCES,
-                 shape: Sequence[Tuple[int, int]] = ((0, 10), (100, 0))):
+                 shape: Sequence[Tuple[int, int]] = ((0, 10), (100, 0)),
+                 handle=None):
         self.scoring_strategy = scoring_strategy
         self.resources = tuple(resources)
         self.shape = tuple(shape)
+        self.handle = handle
+        self._has_dra = False  # set_framework: profile runs DynamicResources
+
+    def set_framework(self, fw) -> None:
+        self._has_dra = fw.plugin("DynamicResources") is not None
+
+    def _effective_request(self, pod: Pod):
+        """fit.go + extendeddynamicresources.go: extended resources mapped
+        to a DeviceClass (DRAExtendedResource) are the DynamicResources
+        plugin's to satisfy — strip them from the fit request so nodes
+        without device-plugin capacity remain candidates."""
+        req = pod.resource_request()
+        handle = self.handle
+        if handle is None or not self._has_dra or not req.scalar_resources:
+            # Without the DynamicResources plugin in the profile, nothing
+            # would ever satisfy the stripped resource — keep it in the fit.
+            return req
+        gates = getattr(handle, "gates", None)
+        try:
+            if gates is None or not gates.enabled("DRAExtendedResource"):
+                return req
+        except ValueError:
+            return req
+        classes = getattr(handle, "device_classes", None)
+        if not classes:
+            return req
+        mapped = {dc.extended_resource_name for dc in classes.values()
+                  if dc.extended_resource_name}
+        strip = mapped & set(req.scalar_resources)
+        if not strip:
+            return req
+        eff = req.clone()
+        for name in strip:
+            # The device plugin may still satisfy part of it; zeroing here
+            # is exact because DynamicResources.filter re-checks the
+            # device-plugin-vs-DRA split per node.
+            eff.scalar_resources.pop(name, None)
+        return eff
 
     # -- QueueingHints (fit.go EventsToRegister / isSchedulableAfterNodeChange
     # / isSchedulableAfterPodEvent) -----------------------------------------
@@ -186,7 +225,7 @@ class Fit:
     # -- filter -----------------------------------------------------------
 
     def pre_filter(self, state: CycleState, pod: Pod, nodes) -> Tuple[Optional[PreFilterResult], Status]:
-        state.write(self._KEY, pod.resource_request())
+        state.write(self._KEY, self._effective_request(pod))
         return None, OK
 
     def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:

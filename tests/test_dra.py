@@ -171,3 +171,168 @@ class TestExpressionSelectors:
                     'device.__class__', 'x + 1'):
             with pytest.raises(ExpressionError):
                 compile_device_expression(bad)
+
+
+def _dra_sched_pair(**kw):
+    from kubernetes_tpu.core.clientset import FakeClientset
+    from kubernetes_tpu.core.config import SchedulerConfiguration
+    from kubernetes_tpu.core.registry import DEFAULT_PLUGINS, build_framework
+    from kubernetes_tpu.core.scheduler import Scheduler
+
+    cs = FakeClientset()
+    plugins = DEFAULT_PLUGINS + (("DynamicResources", 0),)
+    cfg = SchedulerConfiguration(feature_gates={
+        "DynamicResourceAllocation": True,
+        "DRAExtendedResource": True,
+        "DRANodeAllocatableResources": True,
+    })
+    sched = Scheduler(clientset=cs, deterministic_ties=True, config=cfg,
+                      profile_factory=lambda h: {
+                          "default-scheduler": build_framework(h, plugins=plugins)},
+                      **kw)
+    return cs, sched
+
+
+def test_extended_resources_backed_by_dra():
+    """extendeddynamicresources.go: a pod requesting example.com/gpu with a
+    mapping DeviceClass allocates DRA devices on a node with no device
+    plugin capacity; the special in-memory claim becomes a real object at
+    PreBind with the pod recorded in reservedFor."""
+    from kubernetes_tpu.api.dra import Device, DeviceClass, ResourceSlice
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    cs, sched = _dra_sched_pair()
+    cs.create_node(make_node().name("n0").capacity({"cpu": "8", "pods": 10}).obj())
+    cs.create_resource_slice(ResourceSlice(
+        node_name="n0", driver="gpu.example.com",
+        devices=[Device(name=f"gpu-{i}") for i in range(4)]))
+    cs.create_device_class(DeviceClass(
+        name="gpus", extended_resource_name="example.com/gpu"))
+    pod = make_pod().name("p").req({"cpu": "1", "example.com/gpu": 2}).obj()
+    cs.create_pod(pod)
+    sched.run_until_idle()
+    assert cs.bindings.get(pod.uid) == "n0"
+    claim = cs.resource_claims.get("default/p-extended-resources")
+    assert claim is not None
+    assert claim.allocated_node == "n0"
+    assert len(claim.allocations) == 2
+    assert pod.uid in claim.reserved_for
+    assert pod.extended_resource_claim_status["claim"] == claim.key
+
+
+def test_extended_resources_satisfied_by_device_plugin():
+    """When the node's device plugin already advertises the extended
+    resource, no DRA allocation happens (filterExtendedResources split)."""
+    from kubernetes_tpu.api.dra import DeviceClass
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    cs, sched = _dra_sched_pair()
+    cs.create_node(make_node().name("n0").capacity(
+        {"cpu": "8", "pods": 10, "example.com/gpu": 4}).obj())
+    cs.create_device_class(DeviceClass(
+        name="gpus", extended_resource_name="example.com/gpu"))
+    pod = make_pod().name("p").req({"cpu": "1", "example.com/gpu": 2}).obj()
+    cs.create_pod(pod)
+    sched.run_until_idle()
+    assert cs.bindings.get(pod.uid) == "n0"
+    assert cs.resource_claims.get("default/p-extended-resources") is None
+
+
+def test_dra_device_node_allocatable_consumption():
+    """nodeallocatabledynamicresources.go: an allocated device's declared
+    node-resource consumption counts against the node's allocatable."""
+    from kubernetes_tpu.api.dra import Device, DeviceRequest, ResourceClaim, ResourceSlice
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    cs, sched = _dra_sched_pair()
+    cs.create_node(make_node().name("n0").capacity({"cpu": "4", "pods": 10}).obj())
+    cs.create_resource_slice(ResourceSlice(
+        node_name="n0", driver="x.csi",
+        devices=[Device(name="d0", consumes={"cpu": "3"})]))
+    # pod requests 2 cpu; device consumes 3 more => 5 > 4 allocatable
+    cs.create_resource_claim(ResourceClaim(
+        name="c", requests=[DeviceRequest(name="r", count=1)]))
+    pod = make_pod().name("p").req({"cpu": "2"}).obj()
+    pod.resource_claims = ["c"]
+    cs.create_pod(pod)
+    sched.run_until_idle()
+    assert cs.bindings.get(pod.uid) is None
+
+    # a lighter pod fits alongside the device's consumption
+    cs.create_resource_claim(ResourceClaim(
+        name="c2", requests=[DeviceRequest(name="r", count=1)]))
+    pod2 = make_pod().name("p2").req({"cpu": "1"}).obj()
+    pod2.resource_claims = ["c2"]
+    cs.create_pod(pod2)
+    sched.run_until_idle()
+    assert cs.bindings.get(pod2.uid) == "n0"
+
+
+def test_typed_capacity_expression():
+    """Typed CEL capacity semantics: quantity strings compare numerically
+    (device.capacity["memory"] >= 40Gi-in-bytes for "80Gi")."""
+    from kubernetes_tpu.api.dra import Device, compile_device_expression
+
+    m = compile_device_expression(
+        'device.capacity["memory"] >= 42949672960')
+    assert m(Device(name="d", capacity={"memory": "80Gi"}), "drv")
+    assert not m(Device(name="d", capacity={"memory": "16Gi"}), "drv")
+
+
+def test_claim_template_pods_ride_device_and_match_host():
+    """Claim-template pods (one unallocated single-request claim each):
+    the kernel models free matching devices as the counted aux resource;
+    the host commit allocates on the chosen node — assignments AND device
+    exhaustion behavior identical to the host oracle."""
+    from kubernetes_tpu.api.dra import Device, DeviceRequest, ResourceClaim, ResourceSlice
+    from kubernetes_tpu.core.clientset import FakeClientset
+    from kubernetes_tpu.core.registry import DEFAULT_PLUGINS, build_framework
+    from kubernetes_tpu.core.scheduler import Scheduler
+    from kubernetes_tpu.models import TPUScheduler
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    def run(cls):
+        cs = FakeClientset()
+        plugins = DEFAULT_PLUGINS + (("DynamicResources", 0),)
+        kw = {"deterministic_ties": True} if cls is Scheduler else {}
+        sched = cls(clientset=cs, profile_factory=lambda h: {
+            "default-scheduler": build_framework(h, plugins=plugins)}, **kw)
+        for i in range(8):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": "32", "pods": 110}).obj())
+            cs.create_resource_slice(ResourceSlice(
+                node_name=f"n{i}", driver="gpu.x",
+                devices=[Device(name=f"n{i}-d{j}",
+                                attributes={"model": "a100" if j < 2 else "v100"})
+                         for j in range(4)]))
+        pods = []
+        # 20 pods x 1 matching device; only 16 matching devices exist
+        for i in range(20):
+            cs.create_resource_claim(ResourceClaim(
+                name=f"c{i}", requests=[DeviceRequest(
+                    name="r", count=1,
+                    expression='device.attributes["model"] == "a100"')]))
+            p = make_pod().name(f"p{i}").req({"cpu": "100m"}).obj()
+            p.resource_claims = [f"c{i}"]
+            cs.create_pod(p)
+            pods.append(p)
+        sched.run_until_idle()
+        return cs, sched, pods
+
+    cs_h, host, ph = run(Scheduler)
+    cs_d, dev, pd = run(TPUScheduler)
+    hb = {p.name: cs_h.bindings.get(p.uid) for p in ph}
+    db = {p.name: cs_d.bindings.get(p.uid) for p in pd}
+    assert hb == db
+    assert sum(1 for v in db.values() if v) == 16  # device pool exhausted
+    assert dev.device_scheduled >= 14
+    # committed claims carry real allocations on the bound node
+    for p in pd:
+        node = cs_d.bindings.get(p.uid)
+        claim = cs_d.resource_claims[f"default/{p.resource_claims[0]}"]
+        if node:
+            assert claim.allocated_node == node
+            assert len(claim.allocations) == 1
+            assert p.uid in claim.reserved_for
+        else:
+            assert not claim.allocated
