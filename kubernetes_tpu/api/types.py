@@ -444,7 +444,29 @@ class PodGroup:
     # topology-aware placement; topology_placement.go:120 getTopologyKey uses
     # only the first key today, and so do we).
     topology_keys: tuple = ()
+    # spec.parentCompositePodGroupName (scheduling/v1beta1): membership in a
+    # CompositePodGroup hierarchy — the whole TREE schedules all-or-nothing
+    # (workload_forest.go, schedule_one_podgroup.go composite paths).
+    parent_name: str = ""
 
     def __post_init__(self):
         if not self.uid:
             self.uid = _next_uid("pg")
+
+
+@dataclass
+class CompositePodGroup:
+    """scheduling/v1alpha3 CompositePodGroup: an interior node of the
+    workload forest — its children (PodGroups or further CompositePodGroups,
+    via their parent_name) schedule together as one atomic unit rooted at
+    the outermost composite (kube_features.go CompositePodGroup gate)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    parent_name: str = ""  # parent CompositePodGroup ("" = root)
+    priority: int = 0
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = _next_uid("cpg")

@@ -13,7 +13,7 @@ import itertools
 from typing import Callable, Dict, List, Optional
 
 from ..api.storage import CSINode, PersistentVolume, PersistentVolumeClaim, StorageClass
-from ..api.types import Namespace, Node, Pod, PodGroup
+from ..api.types import CompositePodGroup, Namespace, Node, Pod, PodGroup
 
 
 class FakeClientset:
@@ -22,6 +22,7 @@ class FakeClientset:
         self.nodes: Dict[str, Node] = {}
         self.namespaces: Dict[str, Namespace] = {"default": Namespace(name="default")}
         self.pod_groups: Dict[str, PodGroup] = {}  # "ns/name" -> group
+        self.composite_pod_groups: Dict[str, CompositePodGroup] = {}
         self.pvs: Dict[str, PersistentVolume] = {}
         self.pvcs: Dict[str, PersistentVolumeClaim] = {}  # "ns/name" -> pvc
         self.storage_classes: Dict[str, StorageClass] = {}
@@ -104,6 +105,14 @@ class FakeClientset:
         for h in self._pod_group_handlers:
             h(group)
         return group
+
+    def create_composite_pod_group(self, cpg: CompositePodGroup) -> CompositePodGroup:
+        """CompositePodGroup informer feed — delivered through the same
+        pod-group handler channel (handlers type-switch)."""
+        self.composite_pod_groups[f"{cpg.namespace}/{cpg.name}"] = cpg
+        for h in self._pod_group_handlers:
+            h(cpg)
+        return cpg
 
     # -- storage (PV controller surface the volume plugins consume) --------
 

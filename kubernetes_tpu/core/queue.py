@@ -121,6 +121,115 @@ class QueuedPodGroupInfo:
         return f"pg:{self.group.namespace}/{self.group.name}"
 
 
+@dataclass
+class QueuedCompositeGroupInfo:
+    """The queue entity for a whole CompositePodGroup TREE: the root
+    composite plus every leaf PodGroup's buffered members. Pops as ONE unit
+    and schedules all-or-nothing across levels
+    (workload_forest.go buildQueuedPodGroupInfo + schedule_one_podgroup.go
+    composite paths)."""
+
+    cpg: "object"  # api.types.CompositePodGroup (the root)
+    # [(PodGroup, [QueuedPodInfo, ...])] — one entry per leaf group
+    groups: List[Tuple["object", List[QueuedPodInfo]]] = field(default_factory=list)
+    timestamp: float = 0.0
+    attempts: int = 0
+    initial_attempt_timestamp: Optional[float] = None
+    unschedulable_plugins: Set[str] = field(default_factory=set)
+    pending_plugins: Set[str] = field(default_factory=set)
+    gated: bool = False
+    consecutive_backoff_exempt: bool = False
+
+    @property
+    def pod(self) -> Pod:
+        for _g, members in self.groups:
+            if members:
+                return members[0].pod
+        return Pod(name="(empty-composite)")
+
+    @property
+    def uid(self) -> str:
+        return f"cpg:{self.cpg.namespace}/{self.cpg.name}"
+
+
+class WorkloadForest:
+    """Consistent queue-side view of the PodGroup/CompositePodGroup
+    hierarchy (backend/queue/workload_forest.go): child→parent links are
+    recorded even before the parent object is observed, so late parents
+    retroactively own their children without a full rescan."""
+
+    def __init__(self, composite_enabled: bool = True):
+        self.composite_enabled = composite_enabled
+        self.pod_groups: Dict[Tuple[str, str], object] = {}
+        self.composites: Dict[Tuple[str, str], object] = {}
+        # parent cpg key -> {("pg"|"cpg", child key)}
+        self.children: Dict[Tuple[str, str], Set[Tuple[str, Tuple[str, str]]]] = {}
+
+    def add_pod_group(self, group) -> None:
+        key = (group.namespace, group.name)
+        self.pod_groups[key] = group
+        parent = getattr(group, "parent_name", "")
+        if parent and self.composite_enabled:
+            self.children.setdefault((group.namespace, parent), set()).add(
+                ("pg", key))
+
+    def add_composite(self, cpg) -> None:
+        key = (cpg.namespace, cpg.name)
+        self.composites[key] = cpg
+        if cpg.parent_name:
+            self.children.setdefault((cpg.namespace, cpg.parent_name), set()).add(
+                ("cpg", key))
+
+    def root_of_group(self, group):
+        """Walk parent links to the outermost observed composite. Returns
+        (kind, obj) — ("pg", group) when the group is its own root,
+        ("cpg", cpg) for a composite root — or (None, None) while an
+        ancestor in the chain is not yet observed (the tree must wait,
+        getRootLookupInfoForPod)."""
+        if not self.composite_enabled or not getattr(group, "parent_name", ""):
+            return "pg", group
+        ns = group.namespace
+        name = group.parent_name
+        cpg = None
+        seen = set()
+        while name:
+            if (ns, name) in seen:
+                return None, None  # cycle: never schedulable
+            seen.add((ns, name))
+            cpg = self.composites.get((ns, name))
+            if cpg is None:
+                return None, None  # parent not observed yet
+            name = cpg.parent_name
+        return "cpg", cpg
+
+    def leaf_groups(self, cpg) -> Optional[List[object]]:
+        """Every PodGroup in the subtree rooted at `cpg`, or None when a
+        composite child has no observed object or a composite has no leaves
+        (getLeafPodGroups)."""
+        out: List[object] = []
+        stack = [(cpg.namespace, cpg.name)]
+        visited = set()
+        while stack:
+            key = stack.pop()
+            if key in visited:
+                continue
+            visited.add(key)
+            kids = self.children.get(key)
+            if not kids:
+                return None  # interior node with no observed children
+            for kind, ckey in sorted(kids):
+                if kind == "pg":
+                    g = self.pod_groups.get(ckey)
+                    if g is None:
+                        return None
+                    out.append(g)
+                else:
+                    if ckey not in self.composites:
+                        return None
+                    stack.append(ckey)
+        return out or None
+
+
 class _Heap:
     """Stable heap with O(log n) update/delete by key (backend/heap/heap.go).
 
@@ -264,9 +373,12 @@ class PriorityQueue:
         pop_from_backoff_q: bool = True,
         gang_enabled: bool = True,
         queueing_hints_enabled: bool = True,
+        composite_enabled: bool = False,
     ):
         self.framework = framework
         self.queueing_hints_enabled = queueing_hints_enabled
+        self.composite_enabled = composite_enabled
+        self.forest = WorkloadForest(composite_enabled)
         self.now = now
         self.initial_backoff = initial_backoff
         self.max_backoff = max_backoff
@@ -335,10 +447,30 @@ class PriorityQueue:
     # -- gang scheduling ---------------------------------------------------
 
     def register_pod_group(self, group) -> None:
-        """PodGroup informer event: record the group and activate it if its
-        members already arrived (scheduling_queue.go pod-group invariants)."""
+        """PodGroup/CompositePodGroup informer event: record in the forest
+        and activate whatever ROOT became complete
+        (scheduling_queue.go pod-group invariants + workload_forest.go)."""
+        from ..api.types import CompositePodGroup
+        if isinstance(group, CompositePodGroup):
+            self.forest.add_composite(group)
+            if self.composite_enabled:
+                # A late parent can complete any subtree: re-check once per
+                # DISTINCT root (not per buffered group — each composite
+                # check walks the whole tree).
+                roots = {}
+                for key in list(self._group_members):
+                    g = self.pod_groups.get(key)
+                    if g is None:
+                        continue
+                    kind, root = self.forest.root_of_group(g)
+                    if kind == "cpg":
+                        roots[(root.namespace, root.name)] = root
+                for root in roots.values():
+                    self._maybe_activate_composite(root)
+            return
         key = (group.namespace, group.name)
         self.pod_groups[key] = group
+        self.forest.add_pod_group(group)
         self._maybe_activate_group(key)
 
     def _add_group_member(self, qpi: QueuedPodInfo) -> None:
@@ -361,17 +493,48 @@ class PriorityQueue:
         return ent
 
     def _maybe_activate_group(self, key) -> None:
-        """PodGroupPodsCount gate: the group becomes schedulable once
-        min_count members are pending (podgrouppodscount/)."""
+        """PodGroupPodsCount gate at ROOT granularity: a flat group becomes
+        schedulable once min_count members arrived; a group inside a
+        composite tree only when EVERY leaf group of the whole tree is
+        complete (podgrouppodscount/ + workload_forest.go)."""
         group = self.pod_groups.get(key)
+        if group is None:
+            return
+        kind, root = self.forest.root_of_group(group)
+        if kind == "cpg":
+            self._maybe_activate_composite(root)
+            return
+        if kind is None:
+            return  # an ancestor is unobserved: the tree waits
         members = self._group_members.get(key, [])
-        if group is None or len(members) < max(1, group.min_count):
+        if len(members) < max(1, group.min_count):
             return
         if self._group_entity(key) is not None or f"pg:{key[0]}/{key[1]}" in self._in_flight:
             return
         ent = QueuedPodGroupInfo(
             group=group, members=list(members), timestamp=self.now())
         self.active_q.push(ent)
+
+    def _maybe_activate_composite(self, cpg) -> None:
+        leaves = self.forest.leaf_groups(cpg)
+        if leaves is None:
+            return
+        groups = []
+        for g in leaves:
+            members = self._group_members.get((g.namespace, g.name), [])
+            if len(members) < max(1, g.min_count):
+                return  # an incomplete leaf holds the whole tree back
+            groups.append((g, list(members)))
+        uid = f"cpg:{cpg.namespace}/{cpg.name}"
+        ent = (self.active_q.get(uid) or self.backoff_q.get(uid)
+               or self.unschedulable.get(uid))
+        if ent is not None:
+            ent.groups = groups  # late joiner widens the queued tree
+            return
+        if uid in self._in_flight:
+            return
+        self.active_q.push(QueuedCompositeGroupInfo(
+            cpg=cpg, groups=groups, timestamp=self.now()))
 
     def remove_group_member(self, pod: Pod) -> None:
         key = (pod.namespace, pod.pod_group)
@@ -387,6 +550,18 @@ class PriorityQueue:
                 self.active_q.delete(ent.uid)
                 self.backoff_q.delete(ent.uid)
                 self.unschedulable.pop(ent.uid, None)
+        # A queued COMPOSITE entity holding this pod must not schedule it:
+        # drop the entity and re-activate from the (now filtered) buffers —
+        # it re-enqueues iff every leaf still meets min_count.
+        group = self.pod_groups.get(key)
+        if group is not None and self.composite_enabled:
+            kind, root = self.forest.root_of_group(group)
+            if kind == "cpg":
+                uid = f"cpg:{root.namespace}/{root.name}"
+                if (self.active_q.delete(uid) is not None
+                        or self.backoff_q.delete(uid) is not None
+                        or self.unschedulable.pop(uid, None) is not None):
+                    self._maybe_activate_composite(root)
 
     def clear_group_members(self, group_key: Tuple[str, str], uids) -> None:
         """Members successfully scheduled leave the buffer."""

@@ -54,6 +54,7 @@ from .queue import (
     EVENT_NODE_ADD,
     EVENT_NODE_UPDATE,
     PriorityQueue,
+    QueuedCompositeGroupInfo,
     QueuedPodGroupInfo,
     QueuedPodInfo,
 )
@@ -190,6 +191,7 @@ class Scheduler:
     ):
         from .config import SchedulerConfiguration  # local: avoid cycle
         from .features import (
+            COMPOSITE_POD_GROUP,
             GENERIC_WORKLOAD,
             SCHEDULER_POP_FROM_BACKOFF_Q,
             SCHEDULER_QUEUEING_HINTS,
@@ -237,6 +239,7 @@ class Scheduler:
             pop_from_backoff_q=self.gates.enabled(SCHEDULER_POP_FROM_BACKOFF_Q),
             gang_enabled=self.gates.enabled(GENERIC_WORKLOAD),
             queueing_hints_enabled=self.gates.enabled(SCHEDULER_QUEUEING_HINTS),
+            composite_enabled=self.gates.enabled(COMPOSITE_POD_GROUP),
         )
         # Extenders (extender.go; config extenders or injected objects).
         from .extender import Extender, http_transport
@@ -472,6 +475,9 @@ class Scheduler:
 
     def process_one(self, qpi) -> None:
         """One full scheduling+binding cycle for an already-popped entity."""
+        if isinstance(qpi, QueuedCompositeGroupInfo):
+            self.schedule_composite_group(qpi)
+            return
         if isinstance(qpi, QueuedPodGroupInfo):
             self.schedule_pod_group(qpi)
             return
@@ -650,6 +656,73 @@ class Scheduler:
         self.queue.done(qgpi.uid)
         self.metrics.podgroup_schedule_attempts.inc(
             "scheduled" if committed else "unschedulable")
+
+    def schedule_composite_group(self, qcgi: QueuedCompositeGroupInfo) -> None:
+        """The composite tree cycle (schedule_one_podgroup.go composite
+        paths + completeCompositePodGroupAlgorithmResult): every leaf
+        PodGroup of the root CompositePodGroup simulates member-wise against
+        the snapshot; ANY leaf failure rolls the WHOLE tree back (partial
+        results are discarded, :51) and parks the root; success commits
+        every member. Leaves schedule with the default member-wise
+        algorithm (placement-constrained leaves inside composites are out of
+        this reduced scope and fail the tree)."""
+        self.attempts += 1
+        self.cache.update_snapshot(self.snapshot)
+        placed: List[Tuple[QueuedPodInfo, CycleState, ScheduleResult]] = []
+        failure: Optional[FitError] = None
+        for group, members in qcgi.groups:
+            ms = sorted(members, key=lambda m: (-m.pod.priority, m.timestamp))
+            if not ms:
+                continue
+            fw = self.framework_for_pod(ms[0].pod)
+            if getattr(group, "topology_keys", ()):
+                qcgi.unschedulable_plugins.add("TopologyPlacementGenerator")
+                break
+            for m in ms:
+                state = CycleState()
+                try:
+                    result = self.schedule_pod(fw, state, m.pod)
+                except FitError as fe:
+                    failure = fe
+                    qcgi.unschedulable_plugins |= fe.diagnosis.unschedulable_plugins
+                    break
+                m.pod.node_name = result.suggested_host
+                self.snapshot.assume_pod(m.pod)
+                placed.append((m, state, result))
+            else:
+                continue
+            break
+        else:
+            if placed:
+                # Whole tree feasible: commit every member (each keeps ITS
+                # simulation CycleState, submitPodGroupAlgorithmResult).
+                committed = 0
+                attempted: Dict[Tuple[str, str], set] = {}
+                for m, state, result in placed:
+                    self.cache.assume_pod(m.pod)
+                    gkey = (m.pod.namespace, m.pod.pod_group)
+                    attempted.setdefault(gkey, set()).add(m.pod.uid)
+                    fw = self.framework_for_pod(m.pod)
+                    if self._commit_group_member(fw, m, state, result):
+                        committed += 1
+                for gkey, uids in attempted.items():
+                    self.queue.clear_group_members(gkey, uids)
+                self.queue.done(qcgi.uid)
+                self.metrics.podgroup_schedule_attempts.inc(
+                    "scheduled" if committed else "unschedulable")
+                return
+            failure = None  # empty tree: fall through to the failure tail
+
+        # LIFO rollback across the whole tree (revertFns :50-75 applied at
+        # composite scope: parents propagate failure to children).
+        for m, _st, _r in reversed(placed):
+            self.snapshot.forget_pod(m.pod)
+            m.pod.node_name = ""
+        self.failures += 1
+        qcgi.timestamp = self.now()
+        self.queue.add_unschedulable_if_not_present(qcgi)
+        self.queue.done(qcgi.uid)
+        self.metrics.podgroup_schedule_attempts.inc("unschedulable")
 
     def _schedule_group_with_placements(
         self, fw: Framework, qgpi: QueuedPodGroupInfo,

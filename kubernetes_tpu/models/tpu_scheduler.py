@@ -33,7 +33,8 @@ import numpy as np
 
 from ..core.framework import OK as _OK_STATUS
 from ..core.framework import WAIT, Framework
-from ..core.queue import QueuedPodGroupInfo, QueuedPodInfo
+from ..core.queue import (QueuedCompositeGroupInfo, QueuedPodGroupInfo,
+                          QueuedPodInfo)
 from ..core.scheduler import Scheduler, ScheduleResult
 from ..ops.device_state import NodeStateMirror, enable_persistent_compilation_cache
 from ..ops.features import Unsupported, batch_supported, build_batch
@@ -141,9 +142,12 @@ class TPUScheduler(Scheduler):
                 qpi = self.queue.pop()
             if qpi is None:
                 return None
-            if (not isinstance(qpi, QueuedPodGroupInfo)
+            if (not isinstance(qpi, (QueuedPodGroupInfo,
+                                     QueuedCompositeGroupInfo))
                     and qpi.pod.deletion_ts is not None):
                 # skipPodSchedule: deleting pods never dispatch to device.
+                # (Group/composite entities are never skipped whole — their
+                # .pod is just the first member.)
                 self.queue.done(qpi.pod.uid)
                 continue
             return qpi
@@ -155,6 +159,10 @@ class TPUScheduler(Scheduler):
         head = self._pop()
         if head is None:
             return None, [], None
+        if isinstance(head, QueuedCompositeGroupInfo):
+            # Composite trees take the host composite cycle (all-or-nothing
+            # across levels; core/scheduler.py schedule_composite_group).
+            return self.framework_for_pod(head.pod), [head], "composite group entity"
         if isinstance(head, QueuedPodGroupInfo):
             fw, sig = self._gang_device_eligible(head)
             if fw is not None:

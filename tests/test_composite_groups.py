@@ -1,0 +1,150 @@
+"""CompositePodGroup hierarchies + workload forest
+(backend/queue/workload_forest.go, schedule_one_podgroup.go composite paths,
+kube_features.go CompositePodGroup gate): the whole TREE pops as one queue
+entity once every leaf group is complete, and schedules all-or-nothing
+across levels — any leaf failure rolls the entire tree back."""
+
+from kubernetes_tpu.api.types import CompositePodGroup, PodGroup
+from kubernetes_tpu.core import FakeClientset, Scheduler
+from kubernetes_tpu.core.config import SchedulerConfiguration
+from kubernetes_tpu.models import TPUScheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _sched(cls=Scheduler, **kw):
+    cs = FakeClientset()
+    cfg = SchedulerConfiguration(feature_gates={"CompositePodGroup": True})
+    if cls is Scheduler:
+        kw.setdefault("deterministic_ties", True)
+    return cs, cls(clientset=cs, config=cfg, **kw)
+
+
+def _members(cs, group_name, n, cpu="500m"):
+    proto = make_pod().name("proto").req({"cpu": cpu}).obj()
+    out = []
+    for i in range(n):
+        p = proto.clone_from_template(f"{group_name}-m{i}")
+        p.pod_group = group_name
+        cs.create_pod(p)
+        out.append(p)
+    return out
+
+
+def test_tree_waits_for_every_leaf():
+    cs, sched = _sched()
+    for i in range(10):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": "8", "pods": 110}).obj())
+    cs.create_composite_pod_group(CompositePodGroup(name="root"))
+    cs.create_pod_group(PodGroup(name="a", min_count=2, parent_name="root"))
+    cs.create_pod_group(PodGroup(name="b", min_count=2, parent_name="root"))
+    pa = _members(cs, "a", 2)
+    sched.run_until_idle()
+    # leaf b incomplete: NOTHING schedules, even though a is ready
+    assert all(cs.bindings.get(p.uid) is None for p in pa)
+    pb = _members(cs, "b", 2)
+    sched.run_until_idle()
+    assert all(cs.bindings.get(p.uid) for p in pa + pb)
+
+
+def test_nested_composites_schedule_atomically():
+    cs, sched = _sched()
+    for i in range(10):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": "8", "pods": 110}).obj())
+    cs.create_composite_pod_group(CompositePodGroup(name="root"))
+    cs.create_composite_pod_group(CompositePodGroup(name="mid", parent_name="root"))
+    cs.create_pod_group(PodGroup(name="x", min_count=1, parent_name="mid"))
+    cs.create_pod_group(PodGroup(name="y", min_count=1, parent_name="root"))
+    px = _members(cs, "x", 1)
+    py = _members(cs, "y", 1)
+    sched.run_until_idle()
+    assert all(cs.bindings.get(p.uid) for p in px + py)
+
+
+def test_leaf_failure_rolls_back_whole_tree():
+    cs, sched = _sched()
+    for i in range(3):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": "4", "pods": 110}).obj())
+    cs.create_composite_pod_group(CompositePodGroup(name="root"))
+    cs.create_pod_group(PodGroup(name="ok", min_count=2, parent_name="root"))
+    cs.create_pod_group(PodGroup(name="big", min_count=1, parent_name="root"))
+    p_ok = _members(cs, "ok", 2, cpu="1")
+    p_big = _members(cs, "big", 1, cpu="64")  # fits nowhere
+    sched.run_until_idle()
+    # the feasible leaf must NOT have committed (all-or-nothing across levels)
+    assert all(cs.bindings.get(p.uid) is None for p in p_ok + p_big)
+    assert sched.failures >= 1
+    # freeing capacity lets the whole tree schedule
+    cs.create_node(make_node().name("huge")
+                   .capacity({"cpu": "128", "pods": 110}).obj())
+    import time
+    deadline = time.monotonic() + 15
+    while (time.monotonic() < deadline
+           and any(cs.bindings.get(p.uid) is None for p in p_ok + p_big)):
+        sched.queue.flush_backoff_completed()
+        sched.run_until_idle()
+        time.sleep(0.1)
+    assert all(cs.bindings.get(p.uid) for p in p_ok + p_big)
+
+
+def test_late_parent_completes_the_tree():
+    """Child→parent links are recorded before the parent is observed; the
+    tree activates when the late parent arrives (workload_forest.go
+    invariant)."""
+    cs, sched = _sched()
+    for i in range(6):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": "8", "pods": 110}).obj())
+    cs.create_pod_group(PodGroup(name="a", min_count=1, parent_name="root"))
+    pa = _members(cs, "a", 1)
+    sched.run_until_idle()
+    assert cs.bindings.get(pa[0].uid) is None  # root unobserved: tree waits
+    cs.create_composite_pod_group(CompositePodGroup(name="root"))
+    sched.run_until_idle()
+    assert cs.bindings.get(pa[0].uid)
+
+
+def test_composite_gate_off_schedules_flat():
+    """With the CompositePodGroup gate off, parent links are ignored and
+    groups schedule as flat gangs (kube_features.go:158 gating)."""
+    cs = FakeClientset()
+    sched = Scheduler(clientset=cs, deterministic_ties=True)
+    for i in range(4):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": "8", "pods": 110}).obj())
+    cs.create_pod_group(PodGroup(name="a", min_count=1, parent_name="root"))
+    pa = _members(cs, "a", 1)
+    sched.run_until_idle()
+    assert cs.bindings.get(pa[0].uid)
+
+
+def test_composite_on_tpu_scheduler():
+    cs, sched = _sched(TPUScheduler)
+    for i in range(8):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": "8", "pods": 110}).obj())
+    cs.create_composite_pod_group(CompositePodGroup(name="root"))
+    cs.create_pod_group(PodGroup(name="a", min_count=2, parent_name="root"))
+    cs.create_pod_group(PodGroup(name="b", min_count=2, parent_name="root"))
+    pa = _members(cs, "a", 2)
+    pb = _members(cs, "b", 2)
+    sched.run_until_idle()
+    assert all(cs.bindings.get(p.uid) for p in pa + pb)
+
+
+def test_deleted_member_is_not_scheduled_and_tree_recovers():
+    """A member deleted while its composite tree is queued must not be
+    committed; the tree re-activates from the filtered buffers."""
+    cs, sched = _sched()
+    for i in range(6):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": "8", "pods": 110}).obj())
+    cs.create_composite_pod_group(CompositePodGroup(name="root"))
+    cs.create_pod_group(PodGroup(name="a", min_count=2, parent_name="root"))
+    pa = _members(cs, "a", 3)  # one extra member
+    cs.delete_pod(pa[0])
+    sched.run_until_idle()
+    assert cs.bindings.get(pa[0].uid) is None
+    assert all(cs.bindings.get(p.uid) for p in pa[1:])
