@@ -31,7 +31,8 @@ class FeatureSpec:
 GENERIC_WORKLOAD = "GenericWorkload"                      # :441 gang scheduling
 COMPOSITE_POD_GROUP = "CompositePodGroup"                 # :158
 OPPORTUNISTIC_BATCHING = "OpportunisticBatching"          # :818 KEP-5598
-SCHEDULER_ASYNC_API_CALLS = "SchedulerAsyncAPICalls"      # :1048
+SCHEDULER_ASYNC_API_CALLS = "SchedulerAsyncAPICalls"
+SCHEDULER_ASYNC_PREEMPTION = "SchedulerAsyncPreemption"      # :1048
 SCHEDULER_POP_FROM_BACKOFF_Q = "SchedulerPopFromBackoffQ"  # :1062
 NOMINATED_NODE_NAME_FOR_EXPECTATION = "NominatedNodeNameForExpectation"  # :812
 SCHEDULER_QUEUEING_HINTS = "SchedulerQueueingHints"
@@ -49,10 +50,11 @@ DEFAULT_FEATURES: Dict[str, FeatureSpec] = {
     COMPOSITE_POD_GROUP: FeatureSpec(False, ALPHA, depends_on=(GENERIC_WORKLOAD,)),
     OPPORTUNISTIC_BATCHING: FeatureSpec(True, BETA),
     SCHEDULER_ASYNC_API_CALLS: FeatureSpec(True, BETA),
+    SCHEDULER_ASYNC_PREEMPTION: FeatureSpec(True, BETA),
     SCHEDULER_POP_FROM_BACKOFF_Q: FeatureSpec(True, BETA),
     NOMINATED_NODE_NAME_FOR_EXPECTATION: FeatureSpec(True, BETA),
     SCHEDULER_QUEUEING_HINTS: FeatureSpec(True, BETA),
-    NODE_DECLARED_FEATURES: FeatureSpec(False, ALPHA),
+    NODE_DECLARED_FEATURES: FeatureSpec(True, BETA),
     DYNAMIC_RESOURCE_ALLOCATION: FeatureSpec(False, ALPHA),
     DRA_EXTENDED_RESOURCE: FeatureSpec(
         False, ALPHA, depends_on=(DYNAMIC_RESOURCE_ALLOCATION,)),

@@ -65,6 +65,11 @@ QUEUEING_HINTS: Dict[str, Set[str]] = {
     "VolumeRestrictions": {EVENT_ASSIGNED_POD_DELETE, EVENT_POD_DELETE},
     "DynamicResources": {EVENT_NODE_ADD, EVENT_NODE_UPDATE, EVENT_STORAGE_ADD,
                          EVENT_ASSIGNED_POD_DELETE, EVENT_POD_DELETE},
+    # Composite trees with topology-constrained leaves are rejected by
+    # design on the composite path (schedule_composite_group) — no cluster
+    # event changes that, so nothing requeues them before the
+    # unschedulable-timeout flush.
+    "TopologyPlacementGenerator": set(),
 }
 
 
@@ -551,17 +556,26 @@ class PriorityQueue:
                 self.backoff_q.delete(ent.uid)
                 self.unschedulable.pop(ent.uid, None)
         # A queued COMPOSITE entity holding this pod must not schedule it:
-        # drop the entity and re-activate from the (now filtered) buffers —
-        # it re-enqueues iff every leaf still meets min_count.
+        # filter the member IN PLACE (preserving the entity's backoff and
+        # attempt state, like the flat-gang path above); the entity only
+        # drops when a leaf falls below min_count — buffers then re-activate
+        # it when enough members return.
         group = self.pod_groups.get(key)
         if group is not None and self.composite_enabled:
             kind, root = self.forest.root_of_group(group)
             if kind == "cpg":
                 uid = f"cpg:{root.namespace}/{root.name}"
-                if (self.active_q.delete(uid) is not None
-                        or self.backoff_q.delete(uid) is not None
-                        or self.unschedulable.pop(uid, None) is not None):
-                    self._maybe_activate_composite(root)
+                ent = (self.active_q.get(uid) or self.backoff_q.get(uid)
+                       or self.unschedulable.get(uid))
+                if ent is not None:
+                    ent.groups = [
+                        (g, [m for m in ms if m.pod.uid != pod.uid])
+                        for g, ms in ent.groups]
+                    if any(len(ms) < max(1, g.min_count)
+                           for g, ms in ent.groups):
+                        self.active_q.delete(uid)
+                        self.backoff_q.delete(uid)
+                        self.unschedulable.pop(uid, None)
 
     def clear_group_members(self, group_key: Tuple[str, str], uids) -> None:
         """Members successfully scheduled leave the buffer."""

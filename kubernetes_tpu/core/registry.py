@@ -116,8 +116,26 @@ def build_framework(
     return fw
 
 
+def _gated_extras(handle) -> Tuple[Tuple[str, int], ...]:
+    """Feature-gated default-profile additions (the reference wires gated
+    plugins into the default set at registry build time): NodeDeclaredFeatures
+    rides the NodeDeclaredFeatures gate (fork plugin, default on) — disabling
+    the gate removes the plugin, which is what the
+    NodeDeclaredFeaturesDisabled perf variants toggle."""
+    extras: Tuple[Tuple[str, int], ...] = ()
+    gates = getattr(handle, "gates", None)
+    if gates is not None:
+        try:
+            if gates.enabled("NodeDeclaredFeatures"):
+                extras += (("NodeDeclaredFeatures", 0),)
+        except ValueError:
+            pass
+    return extras
+
+
 def default_profiles(handle) -> Dict[str, Framework]:
-    return {"default-scheduler": build_framework(handle)}
+    return {"default-scheduler": build_framework(
+        handle, plugins=DEFAULT_PLUGINS + _gated_extras(handle))}
 
 
 # DEFAULT_PLUGINS + the gang/placement set (GenericWorkload-gated in the
@@ -132,7 +150,7 @@ GANG_PLACEMENT_PLUGINS: Tuple[Tuple[str, int], ...] = DEFAULT_PLUGINS + (
 
 def gang_placement_profiles(handle) -> Dict[str, Framework]:
     return {"default-scheduler": build_framework(
-        handle, plugins=GANG_PLACEMENT_PLUGINS)}
+        handle, plugins=GANG_PLACEMENT_PLUGINS + _gated_extras(handle))}
 
 
 def fit_only_profiles(handle) -> Dict[str, Framework]:

@@ -1,0 +1,118 @@
+"""Process-boundary watch seam: the clientset behind a thread transport.
+
+Re-expresses the part of the reference's architecture the in-process
+FakeClientset collapses away: the scheduler talks to an apiserver over a
+NETWORK — every write pays a round trip on whichever thread issued it, and
+watch events arrive asynchronously on the reflector's thread
+(client-go tools/cache/reflector.go:470 ListAndWatch,
+shared_informer.go:841 processLoop; integration substrate
+test/integration/framework/test_server.go:78).
+
+`RemoteClientset` wraps a FakeClientset (the "apiserver" store):
+
+- WRITES (create/update/delete/bind/patch) are serialized onto an
+  apiserver thread and block the CALLER for the configured RTT — exactly
+  client-go's synchronous REST semantics. The async API dispatcher's
+  thread mode absorbs this latency off the scheduling loop (the binding
+  cycle and preemption victim deletion keep scheduling while calls drain),
+  which is the machinery's whole purpose and was previously never
+  exercised against real latency.
+- EVENTS fan out from the apiserver thread — the scheduler's handlers see
+  cross-thread delivery and park them in the off-thread inbox
+  (core/scheduler.py _threaded, the DeltaFIFO seam), replayed on the
+  scheduling loop like a reflector feed.
+- READS (the lister dicts: pods/nodes/pvs/...) go straight to the store,
+  modeling the informer's local cache (client-go listers read local
+  indexed state, not the wire).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+from .clientset import FakeClientset
+
+_WRITE_METHODS = (
+    "create_node", "update_node", "delete_node",
+    "create_namespace", "create_pod_group", "create_composite_pod_group",
+    "create_pv", "create_pvc", "create_storage_class", "create_csi_node",
+    "create_resource_slice", "create_resource_claim", "create_device_class",
+    "bind_volume",
+    "create_pod", "update_pod", "delete_pod", "remove_pod_finalizers",
+    "bind", "patch_pod_status",
+)
+
+_READ_ATTRS = (
+    "pods", "nodes", "namespaces", "pod_groups", "composite_pod_groups",
+    "pvs", "pvcs", "storage_classes", "csi_nodes",
+    "resource_slices", "resource_claims", "device_classes", "bindings",
+)
+
+
+class RemoteClientset:
+    """FakeClientset proxy behind an apiserver thread with a configurable
+    round-trip time. Drop-in for the scheduler and the perf harness."""
+
+    def __init__(self, store: FakeClientset | None = None, rtt: float = 0.001):
+        self._store = store or FakeClientset()
+        self.rtt = rtt
+        self._requests: "queue.Queue" = queue.Queue()
+        self._server = threading.Thread(
+            target=self._serve, name="apiserver", daemon=True)
+        self._server.start()
+        self.calls = 0
+
+        for name in _WRITE_METHODS:
+            setattr(self, name, self._remote(getattr(self._store, name)))
+
+    # -- apiserver thread --------------------------------------------------
+
+    def _serve(self) -> None:
+        while True:
+            item = self._requests.get()
+            if item is None:
+                return
+            fn, args, kwargs, fut = item
+            # One-way latency before the store applies the write; the caller
+            # blocks on the future for the full round trip.
+            if self.rtt > 0:
+                time.sleep(self.rtt / 2)
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 - surfaces at caller
+                fut.set_exception(e)
+
+    def _remote(self, fn):
+        def call(*args, **kwargs):
+            fut: Future = Future()
+            self._requests.put((fn, args, kwargs, fut))
+            self.calls += 1
+            result = fut.result()
+            if self.rtt > 0:
+                time.sleep(self.rtt / 2)  # response leg
+            return result
+        return call
+
+    def close(self) -> None:
+        self._requests.put(None)
+
+    # -- informer-cache reads + handler registration -----------------------
+
+    def __getattr__(self, name):
+        # Reads and handler registration delegate to the store (events then
+        # FIRE on the apiserver thread — the cross-thread reflector feed).
+        if name in _READ_ATTRS or name.startswith("on_") or name in (
+                "attach_pv_controller", "bump_resource_claims_rv"):
+            return getattr(self._store, name)
+        raise AttributeError(name)
+
+    @property
+    def resource_claims_rv(self) -> int:
+        return getattr(self._store, "resource_claims_rv", 0)
+
+    @property
+    def csi_nodes_rv(self) -> int:
+        return getattr(self._store, "csi_nodes_rv", 0)

@@ -35,6 +35,11 @@ def main(argv=None) -> int:
                     help="TESTCASE or TESTCASE/WORKLOAD substring filter")
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--out", default="")
+    ap.add_argument("--runs", type=int, default=1,
+                    help="consecutive full-table runs; per-workload results "
+                         "report every run + the worst (the reference "
+                         "asserts floors per CI run, so one quiet pass is "
+                         "not evidence — VERDICT r3 weakness 3)")
     args = ap.parse_args(argv)
 
     wanted = [s for s in args.labels.split(",") if s]
@@ -51,38 +56,56 @@ def main(argv=None) -> int:
         "platform": os.environ.get("JAX_PLATFORMS", "default"),
         "started": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
+    meta["runs"] = args.runs
     below = 0
-    for wl in wls:
-        key = f"{wl.testcase}/{wl.name}"
-        t0 = time.perf_counter()
-        entry = {"workload": key,
-                 "threshold": wl.thresholds.get("SchedulingThroughput")}
-        try:
-            res = run_workload(wl)
-            tp = res.metrics.get("SchedulingThroughput", {})
-            avg = tp.get("Average", 0.0)
+    by_key = {}
+    for run_i in range(args.runs):
+        for wl in wls:
+            key = f"{wl.testcase}/{wl.name}"
+            t0 = time.perf_counter()
+            entry = by_key.get(key)
+            if entry is None:
+                entry = by_key[key] = {
+                    "workload": key,
+                    "threshold": wl.thresholds.get("SchedulingThroughput"),
+                    "runs": [],
+                }
+                results.append(entry)
             thr = entry["threshold"] or 0
-            entry.update({
-                "pods_per_second": round(avg, 1),
-                "vs_baseline": round(avg / thr, 2) if thr else None,
-                "meets_threshold": res.meets_thresholds(),
-                "percentiles": {k: round(v, 1) for k, v in tp.items()},
-                "scheduled": res.scheduled,
-                "failed_attempts": res.failed,
-                "wall_s": round(time.perf_counter() - t0, 1),
-                "detail": res.detail,
-            })
-            below += 0 if res.meets_thresholds() else 1
-        except Exception as e:  # noqa: BLE001
-            entry.update({"error": repr(e),
-                          "trace": traceback.format_exc(limit=4),
-                          "wall_s": round(time.perf_counter() - t0, 1)})
-            below += 1
-        results.append(entry)
-        print(json.dumps(entry), flush=True)
-        if args.out:
-            with open(args.out, "w") as f:
-                json.dump({"meta": meta, "results": results}, f, indent=1)
+            # Thresholds gate performance-labeled workloads only
+            # (scheduler_perf.go:282-368; harness.PerfResult.meets_thresholds)
+            asserted = "performance" in wl.labels
+            try:
+                res = run_workload(wl)
+                tp = res.metrics.get("SchedulingThroughput", {})
+                avg = tp.get("Average", 0.0)
+                entry["runs"].append(round(avg, 1))
+                if run_i == 0:
+                    entry.update({
+                        "percentiles": {k: round(v, 1) for k, v in tp.items()},
+                        "scheduled": res.scheduled,
+                        "failed_attempts": res.failed,
+                        "wall_s": round(time.perf_counter() - t0, 1),
+                        "detail": res.detail,
+                    })
+            except Exception as e:  # noqa: BLE001
+                entry["runs"].append(0.0)
+                entry.update({"error": repr(e),
+                              "trace": traceback.format_exc(limit=4)})
+            # the WORST run is the claim (floors assert per run)
+            worst = min(entry["runs"]) if entry["runs"] else 0.0
+            entry["pods_per_second"] = worst
+            entry["vs_baseline"] = round(worst / thr, 2) if thr else None
+            entry["meets_threshold"] = (
+                "error" not in entry
+                and (not asserted or not thr or worst >= thr))
+            print(json.dumps({"run": run_i + 1, "workload": key,
+                              "pods_per_second": entry["runs"][-1],
+                              "worst": worst}), flush=True)
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump({"meta": meta, "results": results}, f, indent=1)
+    below = sum(1 for r in results if not r.get("meets_threshold"))
     ok = sum(1 for r in results if r.get("meets_threshold"))
     print(f"# {ok}/{len(results)} workloads met their thresholds", flush=True)
     return 1 if below else 0

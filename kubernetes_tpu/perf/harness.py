@@ -52,6 +52,11 @@ class Workload:
     thresholds: Dict[str, float]
     ops: List[Dict[str, Any]]
     default_pod_template: Optional[Dict[str, Any]] = None
+    # Per-workload featureGates (misc/performance-config.yaml:65-81 variant
+    # style) and the simulated apiserver round-trip for the watch-seam
+    # transport (core/remote.py); 0 = in-process clientset.
+    feature_gates: Dict[str, bool] = field(default_factory=dict)
+    api_rtt_ms: float = 0.0
 
 
 @dataclass
@@ -94,6 +99,8 @@ def load_config(path: str, scale: float = 1.0) -> List[Workload]:
             thresholds = {
                 k: v * scale if scale != 1.0 else v
                 for k, v in wl.get("thresholds", {}).items()}
+            gates = dict(tc.get("featureGates", {}))
+            gates.update(wl.get("featureGates", {}))
             out.append(Workload(
                 name=wl["name"],
                 testcase=tc["name"],
@@ -102,6 +109,8 @@ def load_config(path: str, scale: float = 1.0) -> List[Workload]:
                 thresholds=thresholds,
                 ops=tc.get("workloadTemplate", []),
                 default_pod_template=tc.get("defaultPodTemplate"),
+                feature_gates=gates,
+                api_rtt_ms=float(wl.get("apiRttMs", tc.get("apiRttMs", 0.0))),
             ))
     return out
 
@@ -435,21 +444,34 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
     _POD_PROTO_CACHE.clear()
 
     if sched is None:
+        cfg = None
+        cs_arg = {}
+        if wl.feature_gates or wl.api_rtt_ms:
+            from ..core.config import SchedulerConfiguration
+            cfg = SchedulerConfiguration(
+                feature_gates=dict(wl.feature_gates),
+                async_dispatch_threads=wl.feature_gates.get(
+                    "SchedulerAsyncAPICalls", False))
+        if wl.api_rtt_ms:
+            from ..core.remote import RemoteClientset
+            cs_arg["clientset"] = RemoteClientset(rtt=wl.api_rtt_ms / 1000.0)
         if any(op.get("topologyKey") for op in wl.ops
                if op.get("opcode") == "createPodGroups"):
             # Topology-constrained gangs need the placement plugin set
             # (GenericWorkload-gated in the reference).
             from ..core.registry import gang_placement_profiles
-            sched = TPUScheduler(profile_factory=gang_placement_profiles)
+            sched = TPUScheduler(profile_factory=gang_placement_profiles,
+                                 config=cfg, **cs_arg)
         elif any(op.get("opcode") == "createResourceSlices" for op in wl.ops):
             # DRA workloads need the DynamicResources plugin
             # (DynamicResourceAllocation-gated in the reference).
             from ..core.registry import DEFAULT_PLUGINS, build_framework
             plugins = DEFAULT_PLUGINS + (("DynamicResources", 0),)
             sched = TPUScheduler(profile_factory=lambda h: {
-                "default-scheduler": build_framework(h, plugins=plugins)})
+                "default-scheduler": build_framework(h, plugins=plugins)},
+                config=cfg, **cs_arg)
         else:
-            sched = TPUScheduler()
+            sched = TPUScheduler(config=cfg, **cs_arg)
     cs = sched.clientset
     collector = _ThroughputCollector(sched)
     params = wl.params
@@ -515,6 +537,8 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
         if opcode == "createNodes":
             count = _resolve_count(op, params)
             tpl = op.get("nodeTemplate", {})
+            tpl = {k: (params[v[1:]] if isinstance(v, str) and v.startswith("$")
+                       else v) for k, v in tpl.items()}
             csi_alloc = op.get("csiNodeAllocatable")  # {driver: count}
             if tpl.get("name"):
                 # Named template (node-with-name.yaml): names must be unique,
@@ -678,4 +702,7 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
         result.detail["extension_points"] = points
     # in-flight invariant (scheduler_perf.go:878-880 checkEmptyInFlightEvents)
     assert not sched.queue._in_flight, "in-flight events remain after workload"
+    close = getattr(cs, "close", None)
+    if close is not None:
+        close()  # stop the per-workload apiserver thread (core/remote.py)
     return result

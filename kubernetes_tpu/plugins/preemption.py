@@ -192,13 +192,22 @@ class Evaluator:
         semantics)."""
         cs = self.handle.clientset
         dispatcher = getattr(self.handle, "api_dispatcher", None)
+        gates = getattr(self.handle, "gates", None)
+        async_ok = True
+        if gates is not None:
+            try:
+                async_ok = gates.enabled("SchedulerAsyncPreemption")
+            except ValueError:
+                pass
         for pi in cand.victims:
-            if dispatcher is not None:
+            if dispatcher is not None and async_ok:
                 from ..core.api_dispatcher import APICall, CALL_DELETE
                 dispatcher.add(APICall(
                     call_type=CALL_DELETE, object_uid=pi.pod.uid,
                     execute=lambda p=pi.pod: cs.delete_pod(p)))
             else:
+                # SchedulerAsyncPreemption off: victims delete synchronously
+                # inside the scheduling cycle (pre-gate behavior).
                 cs.delete_pod(pi.pod)
         # Lower-priority pods nominated to this node lose their nomination
         # (preemption.go prepareCandidate → ClearNominatedNodeName).

@@ -138,3 +138,48 @@ class TestExtraPlugins:
         s.clientset.update_pod(updated)
         s.run_until_idle()
         assert s.scheduled == 1
+
+
+def test_remote_clientset_equivalence_with_latency():
+    """The watch-seam transport (core/remote.py): scheduling against a
+    1ms-RTT apiserver thread with the async dispatcher produces the SAME
+    assignments as the in-process clientset, with watch events crossing
+    threads through the reflector inbox."""
+    from kubernetes_tpu.core import FakeClientset, Scheduler
+    from kubernetes_tpu.core.config import SchedulerConfiguration
+    from kubernetes_tpu.core.remote import RemoteClientset
+    from kubernetes_tpu.models import TPUScheduler
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    def load(cs):
+        for i in range(20):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": "8", "memory": "16Gi", "pods": 110})
+                           .zone(f"z{i % 4}").obj())
+        proto = make_pod().name("proto").req({"cpu": "500m"}).obj()
+        pods = [proto.clone_from_template(f"p{i}") for i in range(80)]
+        for p in pods:
+            cs.create_pod(p)
+        return pods
+
+    cs_h = FakeClientset()
+    host = Scheduler(clientset=cs_h, deterministic_ties=True)
+    ph = load(cs_h)
+    host.run_until_idle()
+
+    cs_r = RemoteClientset(rtt=0.001)
+    cfg = SchedulerConfiguration(async_dispatch_threads=True)
+    dev = TPUScheduler(clientset=cs_r, config=cfg)
+    pr = load(cs_r)
+    import time
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and dev.scheduled < 80:
+        dev.run_until_idle()
+        time.sleep(0.002)
+    dev.api_dispatcher.flush()
+    dev.run_until_idle()
+    hb = {p.name: cs_h.bindings.get(p.uid) for p in ph}
+    rb = {p.name: cs_r.bindings.get(p.uid) for p in pr}
+    assert hb == rb
+    assert cs_r.calls >= 180  # every write crossed the transport
+    cs_r.close()
