@@ -397,7 +397,14 @@ class PriorityQueue:
         self.backoff_q = _Heap(self._backoff_less)
         self.unschedulable: "_UnschedulableMap" = _UnschedulableMap()
         self.nominator = Nominator()
-        self._in_flight: Dict[str, List[str]] = {}  # uid -> events seen while in flight
+        # In-flight entities + the SHARED event log (scheduling_queue.go
+        # inFlightEvents): each entity records the log position at pop time;
+        # events append ONCE to the log instead of once per in-flight entity
+        # (device sessions keep ~2 batches of pods in flight, and every own
+        # bind fires an AssignedPodAdd — per-entity lists would be O(batch²)
+        # per batch). The log clears whenever nothing is in flight.
+        self._in_flight: Dict[str, int] = {}  # uid -> event-log index at pop
+        self._event_log: List = []
         self.moved_count = 0  # schedulingCycle analogue of moveRequestCycle
         # Gang scheduling (workload_forest.go / pod_group_member_pods.go):
         # member pods buffer until their group has min_count arrivals, then
@@ -653,12 +660,24 @@ class PriorityQueue:
         qpi.attempts += 1
         if qpi.initial_attempt_timestamp is None:
             qpi.initial_attempt_timestamp = self.now()
-        self._in_flight[qpi.uid] = []
+        self._in_flight[qpi.uid] = len(self._event_log)
         return qpi
 
     def done(self, uid: str) -> None:
         """Done (scheduling_queue.go:1326) — scheduling attempt finished."""
         self._in_flight.pop(uid, None)
+        if not self._in_flight:
+            self._event_log.clear()
+        elif len(self._event_log) > 4096:
+            # Pipelined scheduling can keep SOMETHING in flight for the whole
+            # run; trim the prefix no live entity can reference and rebase
+            # (the reference trims inFlightEvents as pods complete). Amortized
+            # by the length gate so the min() scan is rare.
+            mn = min(self._in_flight.values())
+            if mn > 0:
+                del self._event_log[:mn]
+                for k in self._in_flight:
+                    self._in_flight[k] -= mn
 
     def __len__(self) -> int:
         return len(self.active_q) + len(self.backoff_q) + len(self.unschedulable)
@@ -674,7 +693,8 @@ class PriorityQueue:
         unschedulable pool and go straight to backoff/active. Entities key by
         their queue uid (pod uid, or "pg:ns/name" for gangs)."""
         uid = qpi.uid
-        events = self._in_flight.get(uid, [])
+        start = self._in_flight.get(uid)
+        events = self._event_log[start:] if start is not None else []
         qpi.timestamp = self.now()
         if events and self._events_relevant(qpi, events):
             self._move_to_active_or_backoff(qpi)
@@ -760,8 +780,8 @@ class PriorityQueue:
                 continue
             del self.unschedulable[uid]
             self._move_to_active_or_backoff(qpi)
-        for events in self._in_flight.values():
-            events.append(ev)
+        if self._in_flight:
+            self._event_log.append(ev)
 
     def flush_backoff_completed(self) -> None:
         """backoffQ flush loop (scheduling_queue.go Run :503)."""
