@@ -150,6 +150,10 @@ class Cache:
         # device path's claim-sharing eligibility check reads this — a
         # shared claim must not ride the kernel's counted-attach encoding).
         self.pvc_refs: Dict[str, int] = {}
+        # Optional scheduled-group-pods index (core/podgroupstate.py), kept
+        # in lockstep with the cache's pod view (assumed + bound) — the
+        # scheduler-side truth placement generation pins domains against.
+        self.pod_group_state = None
         self._dirty: Set[str] = set()
         self._removed_since_snapshot = False
 
@@ -281,6 +285,8 @@ class Cache:
         if pod_info is None or pod_info.pod is not pod:
             pod_info = PodInfo.of(pod)
         ni.add_pod(pod_info)
+        if self.pod_group_state is not None:
+            self.pod_group_state.record_bound(pod)
         for v in pod.volumes:
             if v.pvc_name:
                 key = f"{pod.namespace}/{v.pvc_name}"
@@ -288,6 +294,8 @@ class Cache:
         self._dirty.add(pod.node_name)
 
     def _remove_pod_from_node(self, pod: Pod) -> None:
+        if self.pod_group_state is not None:
+            self.pod_group_state.remove(pod)
         # Symmetric with _add_pod_to_node's unconditional increment: the
         # refcount must drop even when the pod's node has already left the
         # cache (a leak would misclassify future users as 'shared pvc' and

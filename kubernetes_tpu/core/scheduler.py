@@ -119,6 +119,10 @@ class Handle:
     def extenders(self):
         return self._scheduler.extenders
 
+    @property
+    def pod_group_state(self):
+        return self._scheduler.pod_group_state
+
     # waiting pods (Permit WAIT; framework.Handle IterateOverWaitingPods /
     # GetWaitingPod surface, collapsed to allow/reject by uid)
     def allow_waiting_pod(self, uid: str) -> bool:
@@ -276,6 +280,14 @@ class Scheduler:
         self.waiting_pods: Dict[str, tuple] = {}
         self.permit_wait_timeout = 60.0
         self._next_wait_deadline = float("inf")
+        # Scheduled-group-pods store (backend/podgroupstate): group members
+        # the CACHE considers placed (assumed + bound), maintained by the
+        # cache's add/remove flow — placement generation pins a partially
+        # scheduled gang's domain against the scheduler-side truth, with no
+        # watch-feed lag under thread-mode async binds.
+        from .podgroupstate import PodGroupState
+        self.pod_group_state = PodGroupState()
+        self.cache.pod_group_state = self.pod_group_state
         # Event recorder + step tracing (schedule_one.go:1138, :574).
         from .tracing import EventRecorder
         self.recorder = EventRecorder()

@@ -183,3 +183,30 @@ class TestPlacementCommitState:
         assert all(p.node_name for p in pods)
         # Every committed member's Reserve saw the simulation-written state.
         assert seen == {p.name: "sim" for p in pods}, seen
+
+
+def test_pod_group_state_store_tracks_bound_members():
+    """The persistent scheduled-group-pods index (core/podgroupstate.py,
+    podgroupstate.go analogue) follows binds and deletes incrementally and
+    pins a partially-scheduled gang's domain without cluster scans."""
+    from kubernetes_tpu.api.types import PodGroup
+
+    cs, s = _sched()
+    for i in range(6):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": "8", "pods": 110})
+                       .zone(f"z{i % 2}").obj())
+    cs.create_pod_group(PodGroup(name="g", min_count=2, topology_keys=(ZONE,)))
+    pods = []
+    for i in range(2):
+        p = make_pod().name(f"m{i}").req({"cpu": "1"}).obj()
+        p.pod_group = "g"
+        cs.create_pod(p)
+        pods.append(p)
+    s.run_until_idle()
+    store = s.pod_group_state
+    assert store.count("default", "g") == 2
+    gen = store.generation
+    cs.delete_pod(pods[0])
+    assert store.count("default", "g") == 1
+    assert store.generation > gen
