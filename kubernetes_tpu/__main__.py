@@ -1,0 +1,106 @@
+"""The scheduler binary: the cmd/kube-scheduler analogue.
+
+    python -m kubernetes_tpu [--config sched.yaml] [--port 10259]
+                             [--cluster cluster.yaml] [--leader-elect]
+                             [--identity scheduler-0] [--once]
+
+Re-expresses cmd/kube-scheduler/app/server.go's wiring (Run :183): parse the
+KubeSchedulerConfiguration, build the (TPU-backed) scheduler, expose
+/healthz /readyz /metrics /debug/cache /debug/comparer, optionally campaign
+for leadership, and drive the scheduling loop.
+
+Without a real apiserver, `--cluster` bootstraps the clientset from a YAML
+manifest (nodes/pods/podGroups in the perf harness's template shapes), and
+the process keeps scheduling whatever arrives through the clientset until
+interrupted (`--once` exits after the queue drains — the smoke-test mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def _load_cluster(cs, path: str) -> None:
+    import yaml
+
+    from .perf.harness import _make_node_from_template, _make_pod_from_template
+    from .api.types import PodGroup
+
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    for i, tpl in enumerate(doc.get("nodes", ())):
+        count = int(tpl.pop("count", 1))
+        for j in range(count):
+            cs.create_node(_make_node_from_template(i * 100000 + j, tpl))
+    for g in doc.get("podGroups", ()):
+        cs.create_pod_group(PodGroup(
+            name=g["name"], min_count=int(g.get("minCount", 1)),
+            topology_keys=tuple(g.get("topologyKeys", ()))))
+    seq = 0
+    for tpl in doc.get("pods", ()):
+        count = int(tpl.pop("count", 1))
+        for _ in range(count):
+            cs.create_pod(_make_pod_from_template(f"pod-{seq}", tpl))
+            seq += 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes-tpu-scheduler")
+    ap.add_argument("--config", default="",
+                    help="KubeSchedulerConfiguration YAML (core/config.py)")
+    ap.add_argument("--cluster", default="",
+                    help="bootstrap manifest: nodes/pods/podGroups")
+    ap.add_argument("--port", type=int, default=10259,
+                    help="healthz/metrics port (0 = ephemeral)")
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--identity", default="scheduler-0")
+    ap.add_argument("--once", action="store_true",
+                    help="exit once the queue drains (smoke/test mode)")
+    args = ap.parse_args(argv)
+
+    from .core.config import SchedulerConfiguration
+    from .core.server import SchedulerServer
+    from .models import TPUScheduler
+
+    cfg = None
+    if args.config:
+        import yaml
+        with open(args.config) as f:
+            cfg = SchedulerConfiguration.from_dict(yaml.safe_load(f) or {})
+    sched = TPUScheduler(config=cfg)
+    if args.cluster:
+        _load_cluster(sched.clientset, args.cluster)
+
+    server = SchedulerServer(sched, identity=args.identity,
+                             leader_elect=args.leader_elect)
+    port = server.serve(args.port)
+    print(f"kubernetes-tpu-scheduler: serving on 127.0.0.1:{port} "
+          f"(profiles: {', '.join(sched.profiles)})", flush=True)
+
+    stop = {"flag": False}
+
+    def _sig(_s, _f):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+
+    try:
+        while not stop["flag"]:
+            progressed = server.run_cycles()
+            if args.once and not progressed and not sched.queue:
+                break
+            if not progressed:
+                time.sleep(0.02)
+    finally:
+        server.shutdown()
+    print(f"kubernetes-tpu-scheduler: scheduled={sched.scheduled} "
+          f"failures={sched.failures}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -183,3 +183,21 @@ def test_remote_clientset_equivalence_with_latency():
     assert hb == rb
     assert cs_r.calls >= 180  # every write crossed the transport
     cs_r.close()
+
+
+def test_scheduler_binary_once_mode(tmp_path):
+    """The cmd/kube-scheduler analogue (python -m kubernetes_tpu): bootstrap
+    a cluster manifest, serve endpoints, drain the queue, exit cleanly."""
+    import subprocess
+    import sys
+
+    manifest = tmp_path / "cluster.yaml"
+    manifest.write_text(
+        "nodes:\n- {count: 6, cpu: 8, memory: 32Gi, pods: 110, zones: 2}\n"
+        "pods:\n- {count: 12, cpu: 250m}\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu", "--cluster", str(manifest),
+         "--port", "0", "--once"],
+        capture_output=True, text=True, timeout=180, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "scheduled=12 failures=0" in out.stdout
