@@ -59,7 +59,16 @@ def main(argv=None) -> int:
     ap.add_argument("--identity", default="scheduler-0")
     ap.add_argument("--once", action="store_true",
                     help="exit once the queue drains (smoke/test mode)")
+    ap.add_argument("--platform", default="auto",
+                    choices=("auto", "cpu", "tpu"),
+                    help="JAX platform; 'cpu' forces the host backend via "
+                         "the config API BEFORE backend init (the axon TPU "
+                         "plugin ignores the JAX_PLATFORMS env var)")
     args = ap.parse_args(argv)
+
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     from .core.config import SchedulerConfiguration
     from .core.server import SchedulerServer
@@ -91,8 +100,12 @@ def main(argv=None) -> int:
     try:
         while not stop["flag"]:
             progressed = server.run_cycles()
-            if args.once and not progressed and not sched.queue:
-                break
+            if args.once and not progressed:
+                active, backoff, _unsched = sched.queue.pending_counts()
+                if active == 0 and backoff == 0:
+                    # Drained (parked-unschedulable pods don't block exit —
+                    # they are reported in the failure count below).
+                    break
             if not progressed:
                 time.sleep(0.02)
     finally:

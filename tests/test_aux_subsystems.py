@@ -188,6 +188,7 @@ def test_remote_clientset_equivalence_with_latency():
 def test_scheduler_binary_once_mode(tmp_path):
     """The cmd/kube-scheduler analogue (python -m kubernetes_tpu): bootstrap
     a cluster manifest, serve endpoints, drain the queue, exit cleanly."""
+    import os
     import subprocess
     import sys
 
@@ -195,9 +196,12 @@ def test_scheduler_binary_once_mode(tmp_path):
     manifest.write_text(
         "nodes:\n- {count: 6, cpu: 8, memory: 32Gi, pods: 110, zones: 2}\n"
         "pods:\n- {count: 12, cpu: 250m}\n")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU plugin in the child
     out = subprocess.run(
         [sys.executable, "-m", "kubernetes_tpu", "--cluster", str(manifest),
-         "--port", "0", "--once"],
-        capture_output=True, text=True, timeout=180, cwd="/root/repo")
+         "--port", "0", "--once", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=180, cwd=repo_root, env=env)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "scheduled=12 failures=0" in out.stdout
