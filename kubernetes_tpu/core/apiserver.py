@@ -1,0 +1,627 @@
+"""A minimal REST + watch apiserver over the FakeClientset store, and the
+HTTP client/reflector that lets a scheduler run against it across a REAL
+process boundary (no shared objects — JSON on the wire).
+
+Re-expresses the scheduler-relevant slice of the reference's L2/L3 stack:
+
+- apiserver REST surface (staging/src/k8s.io/apiserver collapsed to the
+  verbs the scheduler uses): create/delete pods and nodes, the binding and
+  status subresources, and a `?watch=true` chunked event stream per
+  resource. A watch opens with resourceVersion=0 semantics: the server
+  streams ADDED for every existing object, then a SYNC marker, then live
+  events — so nothing can fall between a separate LIST and the watch
+  registration.
+- client-go's reflector/informer seam (tools/cache/reflector.go:470
+  ListAndWatch → shared_informer.go:841 processLoop): HTTPClientset
+  consumes the stream on its own thread, maintains the informer's local
+  object cache, and fans events into the scheduler's registered handlers —
+  which the scheduler's off-thread inbox (core/scheduler.py _threaded)
+  replays on the scheduling loop. Handler registration replays the cache
+  under the dispatch lock, so attach-time replay cannot race live events.
+
+The JSON codec covers the full scheduling-relevant pod/node spec (requests,
+tolerations, selectors, node+pod affinity, topology spread, gates, host
+ports, PVC volumes, resource claims, nominations, deletion state); GVK /
+admission / etcd stay out of scope (SURVEY §7).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib import request as urlrequest
+
+from ..api.labels import LabelSelector, Requirement
+from ..api.resource import Resource
+from ..api.types import (
+    Affinity,
+    Container,
+    ContainerPort,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorTerm,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PreferredSchedulingTerm,
+    Toleration,
+    TopologySpreadConstraint,
+    Volume,
+    WeightedPodAffinityTerm,
+)
+from .clientset import FakeClientset
+
+# ---------------------------------------------------------------------------
+# JSON codec — full scheduling-relevant spec
+# ---------------------------------------------------------------------------
+
+
+def _req_to_wire(r: Requirement) -> dict:
+    return {"key": r.key, "op": r.operator, "values": list(r.values)}
+
+
+def _req_from_wire(d: dict) -> Requirement:
+    return Requirement(d["key"], d["op"], tuple(d.get("values", ())))
+
+
+def _sel_to_wire(s: Optional[LabelSelector]) -> Optional[dict]:
+    if s is None:
+        return None
+    return {"matchLabels": dict(s.match_labels),
+            "matchExpressions": [_req_to_wire(r) for r in s.match_expressions]}
+
+
+def _sel_from_wire(d: Optional[dict]) -> Optional[LabelSelector]:
+    if d is None:
+        return None
+    return LabelSelector.of(
+        d.get("matchLabels", {}),
+        [_req_from_wire(r) for r in d.get("matchExpressions", ())])
+
+
+def _nsel_to_wire(ns: Optional[NodeSelector]) -> Optional[list]:
+    if ns is None:
+        return None
+    return [{"matchExpressions": [_req_to_wire(r) for r in t.match_expressions],
+             "matchFields": [_req_to_wire(r) for r in t.match_fields]}
+            for t in ns.terms]
+
+
+def _nsel_from_wire(terms: Optional[list]) -> Optional[NodeSelector]:
+    if terms is None:
+        return None
+    return NodeSelector(terms=tuple(
+        NodeSelectorTerm(
+            match_expressions=tuple(_req_from_wire(r)
+                                    for r in t.get("matchExpressions", ())),
+            match_fields=tuple(_req_from_wire(r)
+                               for r in t.get("matchFields", ())))
+        for t in terms))
+
+
+def _pterm_to_wire(t: PodAffinityTerm) -> dict:
+    return {"labelSelector": _sel_to_wire(t.label_selector),
+            "namespaces": list(t.namespaces),
+            "topologyKey": t.topology_key,
+            "namespaceSelector": _sel_to_wire(t.namespace_selector)}
+
+
+def _pterm_from_wire(d: dict) -> PodAffinityTerm:
+    return PodAffinityTerm(
+        label_selector=_sel_from_wire(d.get("labelSelector")),
+        namespaces=tuple(d.get("namespaces", ())),
+        topology_key=d.get("topologyKey", ""),
+        namespace_selector=_sel_from_wire(d.get("namespaceSelector")))
+
+
+def _affinity_to_wire(a: Optional[Affinity]) -> Optional[dict]:
+    if a is None:
+        return None
+    out: dict = {}
+    if a.node_affinity is not None:
+        out["nodeAffinity"] = {
+            "required": _nsel_to_wire(a.node_affinity.required),
+            "preferred": [{"weight": p.weight,
+                           "term": _nsel_to_wire(NodeSelector((p.preference,)))[0]}
+                          for p in a.node_affinity.preferred],
+        }
+    for attr, key in (("pod_affinity", "podAffinity"),
+                      ("pod_anti_affinity", "podAntiAffinity")):
+        pa = getattr(a, attr)
+        if pa is not None:
+            out[key] = {
+                "required": [_pterm_to_wire(t) for t in pa.required],
+                "preferred": [{"weight": w.weight,
+                               "term": _pterm_to_wire(w.term)}
+                              for w in pa.preferred],
+            }
+    return out or None
+
+
+def _affinity_from_wire(d: Optional[dict]) -> Optional[Affinity]:
+    if not d:
+        return None
+    na = None
+    if "nodeAffinity" in d:
+        nd = d["nodeAffinity"]
+        na = NodeAffinity(
+            required=_nsel_from_wire(nd.get("required")),
+            preferred=tuple(
+                PreferredSchedulingTerm(
+                    weight=p["weight"],
+                    preference=_nsel_from_wire([p["term"]]).terms[0])
+                for p in nd.get("preferred", ())))
+
+    def _pa(key, cls):
+        if key not in d:
+            return None
+        pd = d[key]
+        return cls(
+            required=tuple(_pterm_from_wire(t) for t in pd.get("required", ())),
+            preferred=tuple(
+                WeightedPodAffinityTerm(weight=w["weight"],
+                                        term=_pterm_from_wire(w["term"]))
+                for w in pd.get("preferred", ())))
+
+    return Affinity(node_affinity=na,
+                    pod_affinity=_pa("podAffinity", PodAffinity),
+                    pod_anti_affinity=_pa("podAntiAffinity", PodAntiAffinity))
+
+
+def pod_to_wire(p: Pod) -> dict:
+    req = p.resource_request()
+    return {
+        "name": p.name, "namespace": p.namespace, "uid": p.uid,
+        "nodeName": p.node_name, "schedulerName": p.scheduler_name,
+        "nominatedNodeName": p.nominated_node_name,
+        "labels": dict(p.labels), "annotations": dict(p.annotations),
+        "priority": p.priority, "podGroup": p.pod_group,
+        "deletionTs": p.deletion_ts, "finalizers": list(p.finalizers),
+        "requests": {"cpu": req.milli_cpu, "memory": req.memory,
+                     "ephemeral": req.ephemeral_storage,
+                     "scalar": dict(req.scalar_resources)},
+        "hostPorts": [{"port": hp.host_port, "protocol": hp.protocol,
+                       "hostIP": hp.host_ip}
+                      for hp in p.host_ports()],
+        "tolerations": [
+            {"key": t.key, "operator": t.operator, "value": t.value,
+             "effect": t.effect} for t in p.tolerations],
+        "nodeSelector": dict(p.node_selector),
+        "affinity": _affinity_to_wire(p.affinity),
+        "topologySpread": [
+            {"maxSkew": c.max_skew, "topologyKey": c.topology_key,
+             "whenUnsatisfiable": c.when_unsatisfiable,
+             "labelSelector": _sel_to_wire(c.label_selector),
+             "minDomains": c.min_domains,
+             "nodeAffinityPolicy": c.node_affinity_policy,
+             "nodeTaintsPolicy": c.node_taints_policy}
+            for c in p.topology_spread_constraints],
+        "schedulingGates": list(p.scheduling_gates),
+        "volumes": [{"name": v.name, "pvc": v.pvc_name} for v in p.volumes],
+        "resourceClaims": list(getattr(p, "resource_claims", ()) or ()),
+    }
+
+
+def pod_from_wire(d: dict) -> Pod:
+    req = Resource(milli_cpu=int(d["requests"]["cpu"]),
+                   memory=int(d["requests"]["memory"]),
+                   ephemeral_storage=int(d["requests"].get("ephemeral", 0)),
+                   scalar_resources=dict(d["requests"].get("scalar", {})))
+    ports = tuple(ContainerPort(host_port=int(hp["port"]),
+                                protocol=hp.get("protocol", "TCP"),
+                                host_ip=hp.get("hostIP", ""))
+                  for hp in d.get("hostPorts", ()))
+    p = Pod(
+        name=d["name"], namespace=d.get("namespace", "default"),
+        uid=d["uid"], node_name=d.get("nodeName", ""),
+        scheduler_name=d.get("schedulerName", "default-scheduler"),
+        labels=dict(d.get("labels", {})),
+        annotations=dict(d.get("annotations", {})),
+        priority=int(d.get("priority", 0)),
+        containers=[Container(name="c0", requests=req, ports=ports)],
+        tolerations=[Toleration(key=t["key"], operator=t["operator"],
+                                value=t.get("value", ""),
+                                effect=t.get("effect", ""))
+                     for t in d.get("tolerations", ())],
+        node_selector=dict(d.get("nodeSelector", {})),
+        affinity=_affinity_from_wire(d.get("affinity")),
+        topology_spread_constraints=[
+            TopologySpreadConstraint(
+                max_skew=c["maxSkew"], topology_key=c["topologyKey"],
+                when_unsatisfiable=c["whenUnsatisfiable"],
+                label_selector=_sel_from_wire(c.get("labelSelector")),
+                min_domains=c.get("minDomains"),
+                node_affinity_policy=c.get("nodeAffinityPolicy", "Honor"),
+                node_taints_policy=c.get("nodeTaintsPolicy", "Ignore"))
+            for c in d.get("topologySpread", ())],
+        scheduling_gates=list(d.get("schedulingGates", ())),
+        volumes=[Volume(name=v["name"], pvc_name=v.get("pvc"))
+                 for v in d.get("volumes", ())],
+    )
+    p.nominated_node_name = d.get("nominatedNodeName", "")
+    p.deletion_ts = d.get("deletionTs")
+    p.finalizers = list(d.get("finalizers", ()))
+    p.pod_group = d.get("podGroup", "")
+    claims = d.get("resourceClaims", ())
+    if claims:
+        p.resource_claims = list(claims)
+    return p
+
+
+def node_to_wire(n: Node) -> dict:
+    return {
+        "name": n.name, "uid": n.uid, "labels": dict(n.labels),
+        "unschedulable": n.unschedulable,
+        "allocatable": {"cpu": n.allocatable.milli_cpu,
+                        "memory": n.allocatable.memory,
+                        "ephemeral": n.allocatable.ephemeral_storage,
+                        "pods": n.allocatable.allowed_pod_number,
+                        "scalar": dict(n.allocatable.scalar_resources)},
+        "taints": [{"key": t.key, "value": t.value, "effect": t.effect}
+                   for t in n.taints],
+        "declaredFeatures": dict(n.declared_features),
+    }
+
+
+def node_from_wire(d: dict) -> Node:
+    from ..api.types import Taint
+    alloc = Resource(milli_cpu=int(d["allocatable"]["cpu"]),
+                     memory=int(d["allocatable"]["memory"]),
+                     ephemeral_storage=int(d["allocatable"].get("ephemeral", 0)),
+                     allowed_pod_number=int(d["allocatable"]["pods"]),
+                     scalar_resources=dict(d["allocatable"].get("scalar", {})))
+    n = Node(
+        name=d["name"], uid=d["uid"], labels=dict(d.get("labels", {})),
+        unschedulable=bool(d.get("unschedulable", False)),
+        capacity=alloc.clone(), allocatable=alloc,
+        taints=[Taint(key=t["key"], value=t.get("value", ""),
+                      effect=t.get("effect", "NoSchedule"))
+                for t in d.get("taints", ())],
+    )
+    n.declared_features = dict(d.get("declaredFeatures", {}))
+    return n
+
+
+# ---------------------------------------------------------------------------
+# The apiserver
+# ---------------------------------------------------------------------------
+
+
+class APIServer:
+    """REST + watch over an owned FakeClientset store."""
+
+    def __init__(self, store: Optional[FakeClientset] = None):
+        self.store = store or FakeClientset()
+        self._watchers: Dict[str, List["queue.Queue"]] = {"pods": [], "nodes": []}
+        self._lock = threading.Lock()
+        self.store.on_pod_event(self._pod_event)
+        self.store.on_node_event(self._node_event)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # -- event fanout to watch streams -------------------------------------
+
+    def _broadcast(self, kind: str, event: dict) -> None:
+        data = (json.dumps(event) + "\n").encode()
+        with self._lock:
+            for q in self._watchers[kind]:
+                q.put(data)
+
+    def _pod_event(self, kind: str, old, new) -> None:
+        typ = {"add": "ADDED", "update": "MODIFIED", "delete": "DELETED"}[kind]
+        self._broadcast("pods", {"type": typ, "object": pod_to_wire(new)})
+
+    def _node_event(self, kind: str, old, new) -> None:
+        typ = {"add": "ADDED", "update": "MODIFIED", "delete": "DELETED"}[kind]
+        self._broadcast("nodes", {"type": typ, "object": node_to_wire(new)})
+
+    def _attach_watch(self, kind: str) -> "queue.Queue":
+        """Attach a watch with resourceVersion=0 semantics: under the
+        broadcast lock, seed the stream with ADDED for every existing object
+        plus a SYNC marker, THEN register for live events — no create can
+        fall between snapshot and registration."""
+        q: "queue.Queue" = queue.Queue()
+        with self._lock:
+            if kind == "pods":
+                objs = [pod_to_wire(p) for p in self.store.pods.values()]
+            else:
+                objs = [node_to_wire(n) for n in self.store.nodes.values()]
+            for o in objs:
+                q.put((json.dumps({"type": "ADDED", "object": o}) + "\n").encode())
+            q.put((json.dumps({"type": "SYNC"}) + "\n").encode())
+            self._watchers[kind].append(q)
+        return q
+
+    def _detach_watch(self, kind: str, q) -> None:
+        with self._lock:
+            if q in self._watchers[kind]:
+                self._watchers[kind].remove(q)
+
+    # -- http --------------------------------------------------------------
+
+    def serve(self, port: int = 0) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def _json(self, code: int, obj) -> None:
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                watch = "watch=true" in query
+                if path == "/api/v1/pods":
+                    if watch:
+                        return self._stream("pods")
+                    return self._json(200, [pod_to_wire(p) for p in
+                                            server.store.pods.values()])
+                if path == "/api/v1/nodes":
+                    if watch:
+                        return self._stream("nodes")
+                    return self._json(200, [node_to_wire(n) for n in
+                                            server.store.nodes.values()])
+                self._json(404, {"error": "not found"})
+
+            def _stream(self, kind: str) -> None:
+                # watch.Interface: hold the connection open, one JSON event
+                # per line (chunked); blocking queue — no idle polling.
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                q = server._attach_watch(kind)
+                try:
+                    while server._httpd is not None:
+                        try:
+                            data = q.get(timeout=0.5)
+                        except queue.Empty:
+                            continue
+                        self.wfile.write(
+                            f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    server._detach_watch(kind, q)
+
+            def do_POST(self):
+                if self.path == "/api/v1/pods":
+                    pod = pod_from_wire(self._body())
+                    server.store.create_pod(pod)
+                    return self._json(201, pod_to_wire(pod))
+                if self.path == "/api/v1/nodes":
+                    node = node_from_wire(self._body())
+                    server.store.create_node(node)
+                    return self._json(201, node_to_wire(node))
+                parts = self.path.split("/")
+                if (self.path.startswith("/api/v1/pods/")
+                        and self.path.endswith("/binding")):
+                    pod = server.store.pods.get(parts[4])
+                    if pod is None:
+                        return self._json(404, {"error": "pod not found"})
+                    server.store.bind(pod, self._body()["node"])
+                    return self._json(200, {"bound": True})
+                if (self.path.startswith("/api/v1/pods/")
+                        and self.path.endswith("/status")):
+                    pod = server.store.pods.get(parts[4])
+                    if pod is None:
+                        return self._json(404, {"error": "pod not found"})
+                    body = self._body()
+                    server.store.patch_pod_status(
+                        pod,
+                        nominated_node_name=body.get("nominatedNodeName", ""),
+                        phase=body.get("phase", ""))
+                    return self._json(200, {})
+                self._json(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                if self.path.startswith("/api/v1/pods/"):
+                    uid = self.path.split("/")[4]
+                    pod = server.store.pods.get(uid)
+                    if pod is not None:
+                        server.store.delete_pod(pod)
+                    return self._json(200, {})
+                self._json(404, {"error": "not found"})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return self._httpd.server_address[1]
+
+    def shutdown(self) -> None:
+        httpd = self._httpd
+        self._httpd = None
+        if httpd is not None:
+            httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# The client: REST writes + reflector-fed informer cache
+# ---------------------------------------------------------------------------
+
+
+class HTTPClientset:
+    """Clientset over the wire: writes are REST calls; reads serve from the
+    reflector-maintained local cache; handler registration taps the informer
+    fanout (events arrive on the reflector thread → the scheduler's inbox).
+
+    Only the pod/node surface crosses the wire (the verbs the scheduler
+    core exercises); the remaining listers return empty local dicts."""
+
+    def __init__(self, base_url: str, sync_timeout: float = 30.0):
+        self.base = base_url.rstrip("/")
+        self.pods: Dict[str, Pod] = {}
+        self.nodes: Dict[str, Node] = {}
+        self.bindings: Dict[str, str] = {}
+        # unused-surface listers (volume/DRA plugins see empty cluster state)
+        self.namespaces: Dict[str, object] = {}
+        self.pod_groups: Dict[str, object] = {}
+        self.composite_pod_groups: Dict[str, object] = {}
+        self.pvs: Dict[str, object] = {}
+        self.pvcs: Dict[str, object] = {}
+        self.storage_classes: Dict[str, object] = {}
+        self.csi_nodes: Dict[str, object] = {}
+        self.resource_slices: Dict[str, list] = {}
+        self.resource_claims: Dict[str, object] = {}
+        self.device_classes: Dict[str, object] = {}
+        self._pod_handlers: List = []
+        self._node_handlers: List = []
+        self._dispatch_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._responses: List = []
+        self._synced = {"pods": threading.Event(), "nodes": threading.Event()}
+        self._threads: List[threading.Thread] = []
+        for kind in ("pods", "nodes"):
+            t = threading.Thread(target=self._watch_loop, args=(kind,),
+                                 name=f"reflector-{kind}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        for kind in ("pods", "nodes"):
+            if not self._synced[kind].wait(sync_timeout):
+                raise TimeoutError(f"reflector {kind} never synced")
+
+    # -- REST --------------------------------------------------------------
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urlrequest.Request(self.base + path, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+        with urlrequest.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    def create_pod(self, pod: Pod) -> Pod:
+        self._call("POST", "/api/v1/pods", pod_to_wire(pod))
+        return pod
+
+    def create_node(self, node: Node) -> Node:
+        self._call("POST", "/api/v1/nodes", node_to_wire(node))
+        return node
+
+    def delete_pod(self, pod: Pod) -> None:
+        self._call("DELETE", f"/api/v1/pods/{pod.uid}")
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        self._call("POST", f"/api/v1/pods/{pod.uid}/binding",
+                   {"node": node_name})
+
+    def patch_pod_status(self, pod: Pod, nominated_node_name: str = "",
+                         phase: str = "") -> None:
+        self._call("POST", f"/api/v1/pods/{pod.uid}/status",
+                   {"nominatedNodeName": nominated_node_name, "phase": phase})
+        local = self.pods.get(pod.uid)
+        if local is not None and nominated_node_name:
+            local.nominated_node_name = nominated_node_name
+
+    def update_pod(self, pod: Pod) -> Pod:  # parity stub for the surface
+        return pod
+
+    # -- informer registration (scheduler event handlers) -------------------
+
+    def on_pod_event(self, handler) -> None:
+        # Replay-then-subscribe under the dispatch lock: live events cannot
+        # interleave with (or duplicate) the attach-time replay.
+        with self._dispatch_lock:
+            for p in list(self.pods.values()):
+                handler("add", None, p)
+            self._pod_handlers.append(handler)
+
+    def on_node_event(self, handler) -> None:
+        with self._dispatch_lock:
+            for n in list(self.nodes.values()):
+                handler("add", None, n)
+            self._node_handlers.append(handler)
+
+    def on_namespace_event(self, handler) -> None:
+        pass
+
+    def on_pod_group_event(self, handler) -> None:
+        pass
+
+    def on_storage_event(self, handler) -> None:
+        pass
+
+    def attach_pv_controller(self, ctrl) -> None:
+        pass
+
+    # -- reflector (ListAndWatch: the watch carries the initial list) -------
+
+    def _watch_loop(self, kind: str) -> None:
+        # Raw HTTPConnection so close() can shut the SOCKET down —
+        # HTTPResponse.close() on an endless chunked stream would block
+        # draining to EOF.
+        import http.client as _hc
+        host = self.base.split("//", 1)[1]
+        try:
+            conn = _hc.HTTPConnection(host, timeout=300)
+            conn.request("GET", f"/api/v1/{kind}?watch=true")
+            resp = conn.getresponse()
+            self._responses.append(conn)
+            while not self._stop.is_set():
+                line = resp.readline()
+                if not line:
+                    return
+                event = json.loads(line)
+                if event["type"] == "SYNC":
+                    self._synced[kind].set()
+                    continue
+                with self._dispatch_lock:
+                    self._dispatch(kind, event["type"], event["object"])
+        except Exception:  # noqa: BLE001 - stream torn down on close()
+            return
+        finally:
+            self._synced[kind].set()  # unblock a waiting constructor
+
+    def _dispatch(self, kind: str, typ: str, obj: dict) -> None:
+        action = {"ADDED": "add", "MODIFIED": "update", "DELETED": "delete"}[typ]
+        if kind == "pods":
+            pod = pod_from_wire(obj)
+            old = self.pods.get(pod.uid)
+            if action == "delete":
+                self.pods.pop(pod.uid, None)
+                self.bindings.pop(pod.uid, None)
+            else:
+                self.pods[pod.uid] = pod
+                if pod.node_name:
+                    self.bindings[pod.uid] = pod.node_name
+            for h in self._pod_handlers:
+                h(action, old, pod)
+        else:
+            node = node_from_wire(obj)
+            old = self.nodes.get(node.name)
+            if action == "delete":
+                self.nodes.pop(node.name, None)
+            else:
+                self.nodes[node.name] = node
+            for h in self._node_handlers:
+                h(action, old, node)
+
+    def close(self) -> None:
+        self._stop.set()
+        for conn in self._responses:
+            try:
+                import socket
+                if conn.sock is not None:
+                    conn.sock.shutdown(socket.SHUT_RDWR)
+                    conn.sock.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for t in self._threads:
+            t.join(timeout=2)
