@@ -53,6 +53,10 @@ def main(argv=None) -> int:
                     help="KubeSchedulerConfiguration YAML (core/config.py)")
     ap.add_argument("--cluster", default="",
                     help="bootstrap manifest: nodes/pods/podGroups")
+    ap.add_argument("--api-url", default="",
+                    help="schedule against a remote apiserver "
+                         "(core/apiserver.py REST+watch) instead of the "
+                         "in-process store")
     ap.add_argument("--port", type=int, default=10259,
                     help="healthz/metrics port (0 = ephemeral)")
     ap.add_argument("--leader-elect", action="store_true")
@@ -79,7 +83,11 @@ def main(argv=None) -> int:
         import yaml
         with open(args.config) as f:
             cfg = SchedulerConfiguration.from_dict(yaml.safe_load(f) or {})
-    sched = TPUScheduler(config=cfg)
+    cs_kw = {}
+    if args.api_url:
+        from .core.apiserver import HTTPClientset
+        cs_kw["clientset"] = HTTPClientset(args.api_url)
+    sched = TPUScheduler(config=cfg, **cs_kw)
     if args.cluster:
         _load_cluster(sched.clientset, args.cluster)
 
