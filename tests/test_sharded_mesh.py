@@ -61,3 +61,49 @@ def test_sharded_chained_sessions_match_host():
     dev_asg, dev = build(TPUScheduler)
     assert dev.mesh is not None and dev.device_batches >= 3
     assert host_asg == dev_asg
+
+
+def test_two_cells_schedule_independently():
+    """The "cells" mesh axis (parallel/mesh.py sharded_schedule_batch):
+    n_cells=2 vmaps the kernel over two INDEPENDENT scheduling cells
+    (separate clusters scheduled data-parallel, 4-way node sharding each);
+    every cell's assignments equal its own single-device run."""
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.ops.kernel import schedule_batch
+    from kubernetes_tpu.parallel import make_mesh
+    from kubernetes_tpu.parallel.mesh import sharded_schedule_batch
+
+    def cell_inputs(seed: int):
+        cs = FakeClientset()
+        s = TPUScheduler(clientset=cs, mesh=None)
+        for i in range(32):
+            cs.create_node(make_node().name(f"c{seed}-n{i}")
+                           .capacity({"cpu": 8 + (i + seed) % 4,
+                                      "memory": "32Gi", "pods": 110})
+                           .zone(f"z{i % 4}").obj())
+        pod = (make_pod().name(f"c{seed}-p").req({"cpu": "500m"})
+               .labels({"app": f"cell{seed}"}).obj())
+        fw = s.framework_for_pod(pod)
+        state, plan = s.build_plan(fw, pod, 8)
+        return state, plan
+
+    s0, p0 = cell_inputs(0)
+    s1, p1 = cell_inputs(1)
+    assert p0.batch_pad == p1.batch_pad and p0.vmax == p1.vmax
+
+    # single-device truth per cell
+    r0, _ = schedule_batch(s0, p0.features, p0.batch_pad, p0.fit_strategy,
+                           p0.vmax, n_active=np.int32(8))
+    r1, _ = schedule_batch(s1, p1.features, p1.batch_pad, p1.fit_strategy,
+                           p1.vmax, n_active=np.int32(8))
+
+    mesh = make_mesh(n_cells=2)
+    assert dict(mesh.shape) == {"cells": 2, "nodes": 4}
+    stack = lambda a, b: jax.tree_util.tree_map(  # noqa: E731
+        lambda x, y: jnp.stack([x, y]), a, b)
+    run = sharded_schedule_batch(mesh, p0.batch_pad, p0.fit_strategy, p0.vmax)
+    out, _carry = run(stack(s0, s1), stack(p0.features, p1.features))
+    out = np.asarray(out)
+    assert (out[0] == np.asarray(r0)).all()
+    assert (out[1] == np.asarray(r1)).all()
