@@ -248,11 +248,14 @@ class TPUScheduler(Scheduler):
 
     def run_gang_device_session(self, fw: Framework, first: QueuedPodGroupInfo) -> None:
         sig = fw.sign_pod(first.members[0].pod)
+        aux_shape = (None, None)  # gang-eligible members carry no claims
+        claims_rv = getattr(self.clientset, "resource_claims_rv", 0)
         carry = None
         resume = self._resume
         self._resume = None
         if (resume is not None
-                and resume[0] == (id(fw), sig, self.cluster_event_seq,
+                and resume[0] == (id(fw), sig, aux_shape, claims_rv,
+                                  self.cluster_event_seq,
                                   self.attempts, self.state_unwinds)):
             state, plan, carry, node_names = resume[1]
         else:
@@ -363,7 +366,9 @@ class TPUScheduler(Scheduler):
                               dirty_rows=dirty_rows)
             if carry is not None and not dirty_rows:
                 self._resume = (
-                    (id(fw), sig, self.cluster_event_seq, self.attempts,
+                    (id(fw), sig, aux_shape,
+                     getattr(self.clientset, "resource_claims_rv", 0),
+                     self.cluster_event_seq, self.attempts,
                      self.state_unwinds),
                     (state, plan, carry, node_names))
 
@@ -823,11 +828,18 @@ class TPUScheduler(Scheduler):
 
     def run_device_session(self, fw: Framework, first_batch: List[QueuedPodInfo]) -> None:
         sig = fw.sign_pod(first_batch[0].pod)
+        # Signatures cover only the Sign plugins — NOT volumes/claims, whose
+        # counted-constraint shape changes the PLAN (aux_room semantics). A
+        # resume must match the aux shape too, or a claim-template session
+        # could chain onto a volume session's attach-room plan (fuzz-caught).
+        aux_shape = self._aux_shape(first_batch[0].pod)
+        claims_rv = getattr(self.clientset, "resource_claims_rv", 0)
         carry = None
         resume = self._resume
         self._resume = None
         if (resume is not None
-                and resume[0] == (id(fw), sig, self.cluster_event_seq,
+                and resume[0] == (id(fw), sig, aux_shape, claims_rv,
+                                  self.cluster_event_seq,
                                   self.attempts, self.state_unwinds)):
             # Nothing happened since the last clean session of this exact
             # signature: the mirror is device-resident, the feature plan is
@@ -926,7 +938,9 @@ class TPUScheduler(Scheduler):
                               dirty_rows=dirty_rows)
             if carry is not None and not dirty_rows:
                 self._resume = (
-                    (id(fw), sig, self.cluster_event_seq, self.attempts,
+                    (id(fw), sig, aux_shape,
+                     getattr(self.clientset, "resource_claims_rv", 0),
+                     self.cluster_event_seq, self.attempts,
                      self.state_unwinds),
                     (state, plan, carry, node_names))
 

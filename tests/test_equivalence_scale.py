@@ -205,3 +205,80 @@ class TestInterleavingAndRecovery:
         cs_h = build(Scheduler)
         cs_d = build(TPUScheduler)
         assert _assignments(cs_h) == _assignments(cs_d)
+
+
+class TestAuxConstraintFuzz:
+    """Randomized equivalence over the counted-constraint (aux) paths new in
+    round 4: bound-PVC pods under random CSI attach limits and DRA
+    claim-template pods over random device pools, interleaved with plain
+    pods — assignments must equal the host oracle on every seed."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_csi_and_dra_aux_fuzz(self, seed):
+        from kubernetes_tpu.api.dra import Device, DeviceRequest, ResourceClaim, ResourceSlice
+        from kubernetes_tpu.api.storage import CSINode, PersistentVolume, PersistentVolumeClaim
+        from kubernetes_tpu.api.types import Volume
+        from kubernetes_tpu.core.registry import DEFAULT_PLUGINS, build_framework
+
+        rng = random.Random(1000 + seed)
+        n_nodes = rng.randint(6, 16)
+        limit = rng.randint(1, 3)
+        devs_per_node = rng.randint(1, 3)
+        n_vol = rng.randint(3, 3 * n_nodes)
+        n_dra = rng.randint(3, devs_per_node * n_nodes + 4)
+        n_plain = rng.randint(0, 10)
+
+        def build(cls):
+            cs = FakeClientset()
+            plugins = DEFAULT_PLUGINS + (("DynamicResources", 0),)
+            kw = {"deterministic_ties": True} if cls is Scheduler else {}
+            s = cls(clientset=cs, profile_factory=lambda h: {
+                "default-scheduler": build_framework(h, plugins=plugins)}, **kw)
+            for i in range(n_nodes):
+                cs.create_node(make_node().name(f"n{i}")
+                               .capacity({"cpu": 64, "memory": "256Gi",
+                                          "pods": 110}).obj())
+                cs.create_csi_node(CSINode(node_name=f"n{i}",
+                                           driver_limits={"csi.x": limit}))
+                cs.create_resource_slice(ResourceSlice(
+                    node_name=f"n{i}", driver="gpu.x",
+                    devices=[Device(name=f"n{i}-d{j}",
+                                    attributes={"model": "a100"})
+                             for j in range(devs_per_node)]))
+            pods = []
+            for i in range(n_vol):
+                pv = PersistentVolume.of(f"pv-{i}", "1Gi",
+                                         access_modes=("ReadOnlyMany",),
+                                         csi_driver="csi.x")
+                pvc = PersistentVolumeClaim.of(f"pvc-{i}", "1Gi",
+                                               access_modes=("ReadOnlyMany",))
+                pv.claim_ref = pvc.key
+                pvc.volume_name = pv.name
+                cs.create_pv(pv)
+                cs.create_pvc(pvc)
+                p = make_pod().name(f"vol-{i}").req({"cpu": "100m"}).obj()
+                p.volumes.append(Volume(name="d", pvc_name=f"pvc-{i}"))
+                pods.append(p)
+            for i in range(n_dra):
+                cs.create_resource_claim(ResourceClaim(
+                    name=f"c{i}", requests=[DeviceRequest(
+                        name="r", count=1,
+                        expression='device.attributes["model"] == "a100"')]))
+                p = make_pod().name(f"dra-{i}").req({"cpu": "100m"}).obj()
+                p.resource_claims = [f"c{i}"]
+                pods.append(p)
+            for i in range(n_plain):
+                pods.append(make_pod().name(f"plain-{i}")
+                            .req({"cpu": "200m"}).obj())
+            rng2 = random.Random(seed)
+            rng2.shuffle(pods)
+            for p in pods:
+                cs.create_pod(p)
+            s.run_until_idle()
+            return cs, s
+
+        cs_h, _ = build(Scheduler)
+        cs_d, dev = build(TPUScheduler)
+        h = _assignments(cs_h)
+        d = _assignments(cs_d)
+        assert h == d, {k: (h[k], d[k]) for k in h if h[k] != d.get(k)}
