@@ -282,3 +282,65 @@ class TestAuxConstraintFuzz:
         h = _assignments(cs_h)
         d = _assignments(cs_d)
         assert h == d, {k: (h[k], d[k]) for k in h if h[k] != d.get(k)}
+
+
+class TestGangFuzz:
+    """Randomized gangs: flat + topology-constrained groups of random sizes
+    interleaved with plain pods, device (gang sessions + stacked placement
+    evaluation) vs host oracle."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_mixed_gang_fuzz(self, seed):
+        from kubernetes_tpu.api.types import PodGroup
+        from kubernetes_tpu.core.registry import gang_placement_profiles
+
+        rng = random.Random(2000 + seed)
+        n_nodes = rng.randint(8, 24)
+        zones = rng.randint(2, 4)
+        n_flat = rng.randint(0, 4)
+        n_topo = rng.randint(0, 3)
+        n_plain = rng.randint(0, 8)
+
+        def build(cls):
+            cs = FakeClientset()
+            kw = {"deterministic_ties": True} if cls is Scheduler else {}
+            s = cls(clientset=cs, profile_factory=gang_placement_profiles, **kw)
+            for i in range(n_nodes):
+                cs.create_node(make_node().name(f"n{i}")
+                               .capacity({"cpu": rng_caps[i],
+                                          "memory": "64Gi", "pods": 110})
+                               .zone(f"z{i % zones}").obj())
+            pods = []
+            for g in range(n_flat):
+                size = flat_sizes[g]
+                cs.create_pod_group(PodGroup(name=f"fg{g}", min_count=size))
+                for j in range(size):
+                    p = make_pod().name(f"fg{g}-{j}").req({"cpu": "500m"}).obj()
+                    p.pod_group = f"fg{g}"
+                    pods.append(p)
+            for g in range(n_topo):
+                size = topo_sizes[g]
+                cs.create_pod_group(PodGroup(name=f"tg{g}", min_count=size,
+                                             topology_keys=(ZONE,)))
+                for j in range(size):
+                    p = make_pod().name(f"tg{g}-{j}").req({"cpu": "250m"}).obj()
+                    p.pod_group = f"tg{g}"
+                    pods.append(p)
+            for i in range(n_plain):
+                pods.append(make_pod().name(f"pl-{i}").req({"cpu": "200m"}).obj())
+            rng2 = random.Random(seed)
+            rng2.shuffle(pods)
+            for p in pods:
+                cs.create_pod(p)
+            s.run_until_idle()
+            return cs, s
+
+        rng_caps = [rng.choice([4, 8, 16]) for _ in range(n_nodes)]
+        flat_sizes = [rng.randint(2, 5) for _ in range(n_flat)]
+        topo_sizes = [rng.randint(2, 4) for _ in range(n_topo)]
+
+        cs_h, _ = build(Scheduler)
+        cs_d, _ = build(TPUScheduler)
+        h = _assignments(cs_h)
+        d = _assignments(cs_d)
+        assert h == d, {k: (h[k], d[k]) for k in h if h[k] != d.get(k)}
