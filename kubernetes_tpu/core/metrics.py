@@ -224,3 +224,51 @@ class _Timer:
 
     def elapsed(self) -> float:
         return time.perf_counter() - self.start
+
+
+class MetricAsyncRecorder:
+    """Buffered off-thread metric recording (pkg/scheduler/metrics/
+    metric_recorder.go MetricAsyncRecorder): hot paths append observations
+    to a bounded buffer and a flusher thread applies them to the histograms
+    on an interval — the scheduling loop never pays the registry's dict
+    work. observe() drops on overflow (the reference's channel send is
+    non-blocking too), counting drops for observability."""
+
+    def __init__(self, interval: float = 0.05, capacity: int = 4096):
+        import threading
+        from collections import deque
+
+        self._buf = deque(maxlen=capacity)
+        self._interval = interval
+        self.dropped = 0
+        self._stop = threading.Event()
+        self._flushed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="metric-recorder", daemon=True)
+        self._thread.start()
+
+    def observe(self, histogram: Histogram, value: float, *labels: str) -> None:
+        if len(self._buf) == self._buf.maxlen:
+            self.dropped += 1
+            return
+        self._buf.append((histogram, value, labels))
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.flush_now()
+        self.flush_now()
+
+    def flush_now(self) -> None:
+        buf = self._buf
+        while buf:
+            try:
+                histogram, value, labels = buf.popleft()
+            except IndexError:
+                break
+            histogram.observe(value, *labels)
+        self._flushed.set()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self.flush_now()

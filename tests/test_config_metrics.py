@@ -174,3 +174,28 @@ def test_extension_point_latency_recorded():
     for point in ("PreFilter", "Filter", "PreScore", "Score", "Reserve",
                   "Permit", "Bind"):
         assert hist.count(point, "Success", "") >= 1, point
+
+
+def test_metric_async_recorder_flushes_off_thread():
+    """metric_recorder.go analogue: observations buffer on the hot path and
+    land in the histogram via the flusher thread; overflow drops are
+    counted, close() drains."""
+    import time as _t
+
+    from kubernetes_tpu.core.metrics import Histogram, MetricAsyncRecorder
+
+    h = Histogram("test_hist", "t", ("label",))
+    rec = MetricAsyncRecorder(interval=0.01, capacity=8)
+    for i in range(6):
+        rec.observe(h, 0.001 * i, "x")
+    deadline = _t.monotonic() + 5
+    while _t.monotonic() < deadline and h.count("x") < 6:
+        _t.sleep(0.005)
+    assert h.count("x") == 6
+    # overflow drops (non-blocking send semantics)
+    rec._stop.set(); rec._thread.join(timeout=2)  # park the flusher
+    for i in range(20):
+        rec.observe(h, 0.1, "x")
+    assert rec.dropped == 12
+    rec.flush_now()
+    assert h.count("x") == 14
