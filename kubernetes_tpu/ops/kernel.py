@@ -42,6 +42,18 @@ tests/test_device_equivalence.py):
 - selection: max total score, ties broken by first position in rotation order
   (the host's deterministic-tie mode; the reference randomizes ties,
   schedule_one.go selectHost).
+
+Pallas note (evaluated, deliberately not used): a hand-written Pallas kernel
+could fuse the lap loop's iterations and pin the node tensors in VMEM
+(5k x 8 i64 ~ 320KB — fits), saving per-iteration dispatch + HBM traffic.
+It loses on two hard constraints: (1) the scheduler's score math is
+SPECIFIED in exact int64 arithmetic so host and device agree bit-for-bit
+(memory quantities alone exceed int32), and Pallas-TPU's int64 support is
+poor — rescaling to int32 domains would change integer-division results and
+break the equivalence contract; (2) the op mix is masked elementwise +
+small reductions with no matmul — the MXU is idle either way and XLA
+already fuses the VPU work, so the ceiling is per-op issue latency, which
+the lap/scan restructuring (few dependent stages) addresses directly.
 """
 
 from __future__ import annotations
