@@ -30,7 +30,6 @@ from __future__ import annotations
 import json
 import queue
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib import request as urlrequest

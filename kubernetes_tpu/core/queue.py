@@ -567,7 +567,7 @@ class PriorityQueue:
         # attempt state, like the flat-gang path above); the entity only
         # drops when a leaf falls below min_count — buffers then re-activate
         # it when enough members return.
-        group = self.pod_groups.get(key)
+        group = self.pod_groups.get(key)  # may be None when only buffered
         if group is not None and self.composite_enabled:
             kind, root = self.forest.root_of_group(group)
             if kind == "cpg":

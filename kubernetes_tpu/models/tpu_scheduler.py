@@ -49,10 +49,8 @@ _GANG_SESSION = "__gang_device_session__"
 def _pow2_pad(n: int) -> int:
     """Placement-axis pow2 tier (shared by warm + live paths so the warm
     compile always matches the live kernel shape)."""
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+    from ..ops.features import _pow2
+    return _pow2(max(1, n))
 
 
 class TPUScheduler(Scheduler):
@@ -590,6 +588,7 @@ class TPUScheduler(Scheduler):
         self.cache.update_snapshot(self.snapshot)
         self.mirror.sync(self.snapshot.node_info_list)
         ipa = fw.plugin("InterPodAffinity")
+        dra_enabled, dra_in_use = self._dra_ctx(fw)
         plan = build_batch(
             pod,
             batch_size=batch_size,
@@ -610,8 +609,8 @@ class TPUScheduler(Scheduler):
             fit_plugin=fw.plugin("NodeResourcesFit"),
             clientset=self.clientset, pvc_refs=self.cache.pvc_refs,
             limited_drivers=self.limited_drivers(),
-            dra_enabled=self._dra_ctx(fw)[0],
-            dra_in_use=self._dra_ctx(fw)[1],
+            dra_enabled=dra_enabled,
+            dra_in_use=dra_in_use,
         )
         state = self.mirror.flush()
         if self.mesh is not None:
