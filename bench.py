@@ -52,7 +52,10 @@ def build_cluster(n_nodes: int, zones: int = 50):
     from kubernetes_tpu.testing import make_node
 
     cs = FakeClientset()
-    sched = TPUScheduler(clientset=cs)
+    # BENCH_MAX_BATCH sweeps the session batch tier (dispatch count vs scan
+    # length tradeoff on real hardware); default = config.max_batch.
+    mb = int(os.environ.get("BENCH_MAX_BATCH", 0)) or None
+    sched = TPUScheduler(clientset=cs, max_batch=mb)
     for i in range(n_nodes):
         cs.create_node(
             make_node().name(f"node-{i}")
