@@ -586,10 +586,20 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
                 # Compile the kernel shapes outside the measured window
                 # (the reference's measured runs start against a warm
                 # scheduler process; XLA compilation is our cold-start).
-                warm = getattr(sched, "warm_for", None)
-                if warm is not None:
-                    warm(_make_pod_from_template("warm-template", tpl,
-                                                 namespace=namespace))
+                if tpl.get("resourceClaimTemplate") or op.get(
+                        "persistentVolumeTemplate"):
+                    # Claim/volume pods plan with the counted-aux kernel
+                    # variant (has_aux) — a template-only warm pod would
+                    # compile the WRONG tier. Schedule ONE real
+                    # measured-shaped pod (claim/PV included) before the
+                    # window opens instead.
+                    _create_pods(op, tpl, namespace, 1)
+                    _drain(sched, collector, tickers)
+                else:
+                    warm = getattr(sched, "warm_for", None)
+                    if warm is not None:
+                        warm(_make_pod_from_template("warm-template", tpl,
+                                                     namespace=namespace))
                 collector.start()
                 # Measured creates run on a concurrent client thread (the
                 # reference's createPodsOp issues creates from the test
