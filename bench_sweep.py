@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Hardware tuning sweep for the headline workload: runs bench-shaped
+measured windows across (max_batch, pipeline_depth) combinations on the
+CURRENT backend and prints one JSON line per point plus the best.
+
+    python bench_sweep.py                      # default grid
+    BENCH_NODES=5000 BENCH_PODS=10000 python bench_sweep.py
+    SWEEP_BATCHES=512,1024,2048 SWEEP_DEPTHS=2,3 python bench_sweep.py
+
+The dispatch-count vs scan-length tradeoff (and the RTT-hiding value of
+pipeline depth) is hardware-specific — on the tunneled TPU each result
+fetch pays tens of ms, on a local chip far less — so the right tier is
+measured, not guessed. Round 5: run this on the real chip and set
+config.max_batch / pipeline_depth from the winner.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench import _ensure_live_backend, build_cluster, make_pods  # noqa: E402
+
+
+def run_point(n_nodes, n_pods, max_batch, depth):
+    from kubernetes_tpu.core import FakeClientset
+    from kubernetes_tpu.models import TPUScheduler
+    from kubernetes_tpu.testing import make_node
+
+    cs = FakeClientset()
+    sched = TPUScheduler(clientset=cs, max_batch=max_batch)
+    sched.pipeline_depth = depth
+    for i in range(n_nodes):
+        cs.create_node(
+            make_node().name(f"node-{i}")
+            .capacity({"cpu": 32, "memory": "256Gi", "pods": 110})
+            .zone(f"zone-{i % 50}").obj())
+    sched.warm_for(make_pods(1, "warmshape")[0])
+    for p in make_pods(min(max_batch, 1024), "warm"):
+        cs.create_pod(p)
+    sched.run_until_idle()
+    before = sched.scheduled
+    for p in make_pods(n_pods, "bench"):
+        cs.create_pod(p)
+    t0 = time.perf_counter()
+    sched.run_until_idle()
+    elapsed = time.perf_counter() - t0
+    return (sched.scheduled - before) / elapsed if elapsed > 0 else 0.0
+
+
+def main():
+    n_nodes = int(os.environ.get("BENCH_NODES", 5000))
+    n_pods = int(os.environ.get("BENCH_PODS", 10000))
+    batches = [int(b) for b in os.environ.get(
+        "SWEEP_BATCHES", "512,1024,2048").split(",")]
+    depths = [int(d) for d in os.environ.get("SWEEP_DEPTHS", "2,3").split(",")]
+
+    platform = _ensure_live_backend()
+    best = None
+    for mb in batches:
+        for depth in depths:
+            rate = run_point(n_nodes, n_pods, mb, depth)
+            point = {"max_batch": mb, "pipeline_depth": depth,
+                     "pods_per_s": round(rate, 1), "platform": platform}
+            print(json.dumps(point), flush=True)
+            if best is None or rate > best["pods_per_s"]:
+                best = point
+    print(json.dumps({"best": best}))
+
+
+if __name__ == "__main__":
+    main()
