@@ -83,6 +83,13 @@ def main(argv=None) -> int:
         import yaml
         with open(args.config) as f:
             cfg = SchedulerConfiguration.from_dict(yaml.safe_load(f) or {})
+        errs = cfg.validate()
+        if errs:
+            # kube-scheduler refuses an invalid KubeSchedulerConfiguration
+            # (validation.go aggregate -> fatal at startup).
+            for e in errs:
+                print(f"invalid configuration: {e}", file=sys.stderr)
+            return 1
     cs_kw = {}
     if args.api_url:
         from .core.apiserver import HTTPClientset

@@ -96,3 +96,47 @@ class SchedulerConfiguration:
 
     def gates(self) -> FeatureGates:
         return FeatureGates(self.feature_gates)
+
+    def validate(self) -> List[str]:
+        """ValidateKubeSchedulerConfiguration
+        (apis/config/validation/validation.go:38): returns field errors
+        ("" = valid). The TPU fork drops parallelism/leader-election knobs
+        (the batch kernel replaces the goroutine pool; leases are internal),
+        so those reference checks have no analogue here."""
+        errs: List[str] = []
+        if not (0 <= self.percentage_of_nodes_to_score <= 100):
+            errs.append(
+                f"percentageOfNodesToScore: {self.percentage_of_nodes_to_score}"
+                " not in valid range [0-100]")
+        if self.pod_initial_backoff_seconds <= 0:
+            errs.append("podInitialBackoffSeconds: must be greater than 0")
+        if self.pod_max_backoff_seconds < self.pod_initial_backoff_seconds:
+            errs.append("podMaxBackoffSeconds: must be greater than or equal"
+                        " to podInitialBackoffSeconds")
+        if self.max_batch <= 0:
+            errs.append("maxBatch: should be an integer value greater than zero")
+        if not self.profiles:
+            errs.append("profiles: Required value")
+        seen: Dict[str, int] = {}
+        for i, p in enumerate(self.profiles):
+            if not p.scheduler_name:
+                errs.append(f"profiles[{i}].schedulerName: Required value")
+            if p.scheduler_name in seen:
+                errs.append(
+                    f"profiles[{i}].schedulerName: Duplicate value "
+                    f"{p.scheduler_name!r} (first at profiles[{seen[p.scheduler_name]}])")
+            else:
+                seen[p.scheduler_name] = i
+        for i, e in enumerate(self.extenders):
+            if not isinstance(e, Mapping):
+                continue  # pre-built Extender objects validate themselves
+            if not e.get("urlPrefix"):
+                errs.append(f"extenders[{i}].urlPrefix: Required value")
+            if not any(e.get(v) for v in
+                       ("filterVerb", "prioritizeVerb", "bindVerb",
+                        "preemptVerb")):
+                errs.append(f"extenders[{i}]: must configure at least one verb")
+            w = e.get("weight", 1)
+            if not isinstance(w, int) or w <= 0:
+                errs.append(f"extenders[{i}].weight: must be a positive integer")
+        return errs

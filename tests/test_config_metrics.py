@@ -199,3 +199,31 @@ def test_metric_async_recorder_flushes_off_thread():
     assert rec.dropped == 12
     rec.flush_now()
     assert h.count("x") == 14
+
+
+def test_scheduler_configuration_validation():
+    """ValidateKubeSchedulerConfiguration (validation.go:38): range checks,
+    profile uniqueness, extender verb/weight requirements."""
+    from kubernetes_tpu.core.config import ProfileConfig, SchedulerConfiguration
+
+    assert SchedulerConfiguration().validate() == []
+
+    bad = SchedulerConfiguration(
+        percentage_of_nodes_to_score=150,
+        pod_initial_backoff_seconds=0,
+        pod_max_backoff_seconds=-1,
+        max_batch=0,
+        profiles=[ProfileConfig(scheduler_name="a"),
+                  ProfileConfig(scheduler_name="a")],
+        extenders=[{"filterVerb": "filter"},         # no urlPrefix
+                   {"urlPrefix": "http://x", "weight": 0}])  # no verb, bad weight
+    errs = bad.validate()
+    joined = "\n".join(errs)
+    assert "percentageOfNodesToScore" in joined
+    assert "podInitialBackoffSeconds" in joined
+    assert "podMaxBackoffSeconds" in joined
+    assert "maxBatch" in joined
+    assert "Duplicate" in joined
+    assert "urlPrefix" in joined
+    assert "at least one verb" in joined
+    assert "positive integer" in joined
