@@ -151,19 +151,22 @@ def compile_device_expression(expr: str):
         __slots__ = ("attributes", "capacity", "driver", "name")
 
         def __init__(self, device, driver):
-            # Coerced maps are memoized ON the device: attribute dicts are
-            # immutable spec, and the exception-driven coercion chain costs
-            # more than the whole match when it runs per evaluation.
-            attrs = device.__dict__.get("_coerced_attrs")
-            if attrs is None:
-                attrs = device._coerced_attrs = _CoercingMap.coerced(
-                    device.attributes)
-            cap = device.__dict__.get("_coerced_cap")
-            if cap is None:
-                cap = device._coerced_cap = _CoercingMap.coerced(
-                    getattr(device, "capacity", {}) or {})
-            self.attributes = attrs
-            self.capacity = cap
+            # Coerced maps are memoized ON the device (the exception-driven
+            # coercion chain costs more than the whole match when it runs
+            # per evaluation), validated against the raw dicts' identities:
+            # a slice update that REPLACES the attribute/capacity maps (the
+            # supported mutation shape — spec maps are copy-on-write, never
+            # edited in place) invalidates the memo automatically.
+            raw_cap = getattr(device, "capacity", None)
+            memo = device.__dict__.get("_coerced_memo")
+            if (memo is None or memo[0] is not device.attributes
+                    or memo[1] is not raw_cap):
+                memo = device._coerced_memo = (
+                    device.attributes, raw_cap,
+                    _CoercingMap.coerced(device.attributes),
+                    _CoercingMap.coerced(raw_cap or {}))
+            self.attributes = memo[2]
+            self.capacity = memo[3]
             self.driver = driver
             self.name = device.name
 
@@ -198,21 +201,65 @@ class _CoercingMap(dict):
     def _coerce(v):
         if isinstance(v, str):
             try:
-                return int(v)
+                return _QtyInt(int(v))
             except ValueError:
                 pass
             try:
-                return float(v)
+                return _QtyFloat(float(v))
             except ValueError:
                 pass
             try:
                 from .resource import parse_quantity
                 q = parse_quantity(v)
                 iq = int(q)
-                return iq if q == iq else float(q)
+                return _QtyInt(iq) if q == iq else _QtyFloat(float(q))
             except Exception:
                 return v
         return v
 
     def __getitem__(self, key):
         return dict.get(self, key)
+
+
+class _QtyMixin:
+    """Coerced quantity values compare against BOTH numbers and suffixed
+    string literals: device.capacity["mem"] == "40Gi" and == 40*1024**3 both
+    hold (the reference's CEL environment compares typed quantities; plain
+    int coercion would make the string form silently False)."""
+
+    __slots__ = ()
+
+    def _other(self, other):
+        if isinstance(other, str):
+            return _CoercingMap._coerce(other)
+        return other
+
+    def __eq__(self, other):
+        other = self._other(other)
+        if isinstance(other, str):
+            return False
+        return super().__eq__(other)
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return eq if eq is NotImplemented else not eq
+
+    def __lt__(self, other):
+        return super().__lt__(self._other(other))
+
+    def __le__(self, other):
+        return super().__le__(self._other(other))
+
+    def __gt__(self, other):
+        return super().__gt__(self._other(other))
+
+    def __ge__(self, other):
+        return super().__ge__(self._other(other))
+
+
+class _QtyInt(_QtyMixin, int):
+    __hash__ = int.__hash__
+
+
+class _QtyFloat(_QtyMixin, float):
+    __hash__ = float.__hash__

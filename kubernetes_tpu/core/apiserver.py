@@ -381,18 +381,28 @@ class APIServer:
 
             def _stream(self, kind: str) -> None:
                 # watch.Interface: hold the connection open, one JSON event
-                # per line (chunked); blocking queue — no idle polling.
+                # per line (chunked); blocking queue — no idle polling. A
+                # BOOKMARK heartbeat goes out on idle (~10s) so a quiet
+                # cluster keeps the client's read timeout from killing the
+                # watch (the reference's watch bookmarks serve the same
+                # liveness role).
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 q = server._attach_watch(kind)
+                idle = 0.0
                 try:
                     while server._httpd is not None:
                         try:
                             data = q.get(timeout=0.5)
+                            idle = 0.0
                         except queue.Empty:
-                            continue
+                            idle += 0.5
+                            if idle < 10.0:
+                                continue
+                            idle = 0.0
+                            data = b'{"type": "BOOKMARK"}\n'
                         self.wfile.write(
                             f"{len(data):x}\r\n".encode() + data + b"\r\n")
                         self.wfile.flush()
@@ -400,6 +410,11 @@ class APIServer:
                     pass
                 finally:
                     server._detach_watch(kind, q)
+                    # End of stream (server shutdown): close the TCP
+                    # connection instead of waiting for another request on
+                    # it, so the client's reflector sees EOF immediately
+                    # and re-lists against the next server.
+                    self.close_connection = True
 
             def do_POST(self):
                 if self.path == "/api/v1/pods":
@@ -487,6 +502,8 @@ class HTTPClientset:
         self._stop = threading.Event()
         self._responses: List = []
         self._synced = {"pods": threading.Event(), "nodes": threading.Event()}
+        self._fatal: Dict[str, Exception] = {}
+        self.last_sync: Dict[str, float] = {}
         self._threads: List[threading.Thread] = []
         for kind in ("pods", "nodes"):
             t = threading.Thread(target=self._watch_loop, args=(kind,),
@@ -496,6 +513,10 @@ class HTTPClientset:
         for kind in ("pods", "nodes"):
             if not self._synced[kind].wait(sync_timeout):
                 raise TimeoutError(f"reflector {kind} never synced")
+            if kind in self._fatal:
+                raise ConnectionError(
+                    f"reflector {kind}: initial connection failed"
+                ) from self._fatal[kind]
 
     # -- REST --------------------------------------------------------------
 
@@ -563,36 +584,99 @@ class HTTPClientset:
     # -- reflector (ListAndWatch: the watch carries the initial list) -------
 
     def _watch_loop(self, kind: str) -> None:
+        """client-go reflector behavior (tools/cache/reflector.go:470): on
+        stream EOF/timeout, re-connect and re-list — the watch=true stream
+        replays ADDED for every live object then SYNC, so each reconnect IS
+        the re-list. Replayed objects the cache already holds dispatch as
+        updates; objects that vanished during the outage dispatch DELETED at
+        the SYNC barrier (the reflector's Replace semantics). Only a failure
+        of the FIRST connection is fatal (recorded in _fatal so the
+        constructor raises instead of returning a dead clientset)."""
         # Raw HTTPConnection so close() can shut the SOCKET down —
         # HTTPResponse.close() on an endless chunked stream would block
         # draining to EOF.
         import http.client as _hc
+        import time as _time
         host = self.base.split("//", 1)[1]
-        try:
-            conn = _hc.HTTPConnection(host, timeout=300)
-            conn.request("GET", f"/api/v1/{kind}?watch=true")
-            resp = conn.getresponse()
-            self._responses.append(conn)
-            while not self._stop.is_set():
-                line = resp.readline()
-                if not line:
-                    return
-                event = json.loads(line)
-                if event["type"] == "SYNC":
+        backoff = 0.05
+        while not self._stop.is_set():
+            try:
+                conn = _hc.HTTPConnection(host, timeout=60)
+                conn.request("GET", f"/api/v1/{kind}?watch=true")
+                resp = conn.getresponse()
+            except Exception as e:  # noqa: BLE001 - connect failure
+                if not self._synced[kind].is_set():
+                    # Initial connection failed: dead on arrival is an error,
+                    # not an empty cluster.
+                    self._fatal[kind] = e
                     self._synced[kind].set()
-                    continue
-                with self._dispatch_lock:
-                    self._dispatch(kind, event["type"], event["object"])
-        except Exception:  # noqa: BLE001 - stream torn down on close()
-            return
-        finally:
-            self._synced[kind].set()  # unblock a waiting constructor
+                    return
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 5.0)
+                continue
+            self._responses.append(conn)
+            backoff = 0.05
+            resync_seen: Optional[set] = set()  # keys replayed pre-SYNC
+            try:
+                while not self._stop.is_set():
+                    line = resp.readline()
+                    if not line:
+                        break  # EOF: server went away — re-list + re-watch
+                    event = json.loads(line)
+                    typ = event["type"]
+                    if typ == "BOOKMARK":
+                        continue  # server idle heartbeat
+                    if typ == "SYNC":
+                        with self._dispatch_lock:
+                            self._replace_barrier(kind, resync_seen)
+                        resync_seen = None
+                        self._synced[kind].set()
+                        self.last_sync[kind] = _time.monotonic()
+                        continue
+                    with self._dispatch_lock:
+                        if resync_seen is not None:
+                            resync_seen.add(self._wire_key(kind, event["object"]))
+                        self._dispatch(kind, typ, event["object"],
+                                       relisting=resync_seen is not None)
+            except Exception:  # noqa: BLE001 - stream torn down / timeout
+                pass
+            finally:
+                try:
+                    self._responses.remove(conn)
+                except ValueError:
+                    pass
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            if self._stop.wait(0.05):
+                return
 
-    def _dispatch(self, kind: str, typ: str, obj: dict) -> None:
+    @staticmethod
+    def _wire_key(kind: str, obj: dict) -> str:
+        return obj["uid"] if kind == "pods" else obj["name"]
+
+    def _replace_barrier(self, kind: str, seen: Optional[set]) -> None:
+        """End of a (re-)list window: local objects the server did NOT replay
+        no longer exist — dispatch their deletion (reflector Replace)."""
+        if seen is None:
+            return
+        if kind == "pods":
+            for uid in [u for u in self.pods if u not in seen]:
+                self._dispatch(kind, "DELETED", pod_to_wire(self.pods[uid]))
+        else:
+            for name in [n for n in self.nodes if n not in seen]:
+                self._dispatch(kind, "DELETED", node_to_wire(self.nodes[name]))
+
+    def _dispatch(self, kind: str, typ: str, obj: dict,
+                  relisting: bool = False) -> None:
         action = {"ADDED": "add", "MODIFIED": "update", "DELETED": "delete"}[typ]
         if kind == "pods":
             pod = pod_from_wire(obj)
             old = self.pods.get(pod.uid)
+            if relisting and action == "add" and old is not None:
+                action = "update"  # re-list replay of a known object
             if action == "delete":
                 self.pods.pop(pod.uid, None)
                 self.bindings.pop(pod.uid, None)
@@ -605,6 +689,8 @@ class HTTPClientset:
         else:
             node = node_from_wire(obj)
             old = self.nodes.get(node.name)
+            if relisting and action == "add" and old is not None:
+                action = "update"
             if action == "delete":
                 self.nodes.pop(node.name, None)
             else:
@@ -614,7 +700,8 @@ class HTTPClientset:
 
     def close(self) -> None:
         self._stop.set()
-        for conn in self._responses:
+        # Snapshot: reflector threads remove() dead connections concurrently.
+        for conn in list(self._responses):
             try:
                 import socket
                 if conn.sock is not None:

@@ -238,7 +238,13 @@ class MetricAsyncRecorder:
         import threading
         from collections import deque
 
-        self._buf = deque(maxlen=capacity)
+        # Unbounded deque + explicit capacity check: deque(maxlen) would
+        # silently evict the OLDEST observation when two racing observers
+        # both pass a len() check — an uncounted loss. With no maxlen the
+        # worst case of the (benign) check-then-append race is a few entries
+        # over capacity, all of which still flush.
+        self._buf = deque()
+        self._capacity = capacity
         self._interval = interval
         self.dropped = 0
         self._stop = threading.Event()
@@ -248,7 +254,7 @@ class MetricAsyncRecorder:
         self._thread.start()
 
     def observe(self, histogram: Histogram, value: float, *labels: str) -> None:
-        if len(self._buf) == self._buf.maxlen:
+        if len(self._buf) >= self._capacity:
             self.dropped += 1
             return
         self._buf.append((histogram, value, labels))

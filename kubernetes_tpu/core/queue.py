@@ -309,6 +309,10 @@ class Nominator:
     def __init__(self):
         self._node_to_pods: Dict[str, List[PodInfo]] = {}
         self._pod_to_node: Dict[str, str] = {}
+        # Bumped on every add/delete: device sessions and failure memos key
+        # on the nomination SET (a changed set changes two-pass filter
+        # outcomes), not just on whether any nomination exists.
+        self.version = 0
 
     def add_nominated_pod(self, pi: PodInfo, node_name: str) -> None:
         self.delete_nominated_pod(pi.pod)
@@ -316,6 +320,7 @@ class Nominator:
             return
         self._node_to_pods.setdefault(node_name, []).append(pi)
         self._pod_to_node[pi.pod.uid] = node_name
+        self.version += 1
 
     def delete_nominated_pod(self, pod: Pod) -> None:
         node = self._pod_to_node.pop(pod.uid, None)
@@ -325,6 +330,10 @@ class Nominator:
             ]
             if not self._node_to_pods[node]:
                 del self._node_to_pods[node]
+            self.version += 1
+
+    def all_nominated_pod_infos(self) -> List[PodInfo]:
+        return [pi for pis in self._node_to_pods.values() for pi in pis]
 
     def nominated_pods_for_node(self, node_name: str) -> List[PodInfo]:
         return self._node_to_pods.get(node_name, [])

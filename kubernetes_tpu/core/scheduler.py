@@ -723,7 +723,17 @@ class Scheduler:
                 self.metrics.podgroup_schedule_attempts.inc(
                     "scheduled" if committed else "unschedulable")
                 return
-            failure = None  # empty tree: fall through to the failure tail
+            # Empty tree (every leaf memberless): nothing was attempted, so
+            # parking it unschedulable with an EMPTY plugin set would make
+            # every cluster event "relevant" — a busy reactivate/re-park
+            # loop until members arrive. Drop the entity instead; the member
+            # buffers re-activate the tree when members show up. Member adds
+            # that arrived WHILE this entity was in flight were swallowed by
+            # the in-flight gate (_maybe_activate_composite), so re-check
+            # activation once the slot clears.
+            self.queue.done(qcgi.uid)
+            self.queue._maybe_activate_composite(qcgi.cpg)
+            return
 
         # LIFO rollback across the whole tree (revertFns :50-75 applied at
         # composite scope: parents propagate failure to children).

@@ -142,3 +142,52 @@ def test_wire_codec_preserves_scheduling_spec():
     assert len(set(hp_nodes)) == 2                      # host-port conflict
     client.close()
     api.shutdown()
+
+
+def test_reflector_relists_after_server_restart():
+    """client-go reflector semantics (reflector.go:470): when the watch
+    stream dies, the client re-connects and re-lists; objects that vanished
+    during the outage are dispatched DELETED at the SYNC barrier, new
+    objects ADDED — the informer cache converges on the restarted server's
+    truth instead of freezing forever (round-4 advisor finding)."""
+    api = APIServer()
+    port = api.serve(0)
+    api.store.create_node(make_node().name("n0")
+                          .capacity({"cpu": "4", "pods": 10}).obj())
+    ghost = make_pod().name("ghost").req({"cpu": "1"}).obj()
+    api.store.create_pod(ghost)
+    client = HTTPClientset(f"http://127.0.0.1:{port}")
+    assert ghost.uid in client.pods and "n0" in client.nodes
+
+    # Server restarts: the ghost pod is gone, a new node exists.
+    api.shutdown()
+    api2 = APIServer()
+    api2.store.create_node(make_node().name("n0")
+                           .capacity({"cpu": "4", "pods": 10}).obj())
+    api2.store.create_node(make_node().name("n1")
+                           .capacity({"cpu": "4", "pods": 10}).obj())
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            api2.serve(port)
+            break
+        except OSError:
+            time.sleep(0.1)  # TIME_WAIT on the old socket
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and (
+            ghost.uid in client.pods or "n1" not in client.nodes):
+        time.sleep(0.02)
+    assert ghost.uid not in client.pods   # Replace barrier delivered delete
+    assert "n1" in client.nodes           # re-list delivered the new node
+    assert "n0" in client.nodes
+    client.close()
+    api2.shutdown()
+
+
+def test_dead_initial_connection_raises():
+    """A clientset whose FIRST connection fails must raise, not return a
+    silently empty informer cache (round-4 advisor finding)."""
+    import pytest
+    with pytest.raises((ConnectionError, TimeoutError)):
+        HTTPClientset("http://127.0.0.1:1", sync_timeout=5.0)

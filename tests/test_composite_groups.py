@@ -148,3 +148,33 @@ def test_deleted_member_is_not_scheduled_and_tree_recovers():
     sched.run_until_idle()
     assert cs.bindings.get(pa[0].uid) is None
     assert all(cs.bindings.get(p.uid) for p in pa[1:])
+
+
+def test_empty_tree_is_dropped_not_parked():
+    """An all-leaves-memberless composite tree must be DROPPED, not parked
+    unschedulable: an empty unschedulable_plugins set makes every cluster
+    event relevant, producing a busy reactivate/re-park loop until members
+    arrive (round-4 advisor finding). The member buffers re-activate the
+    tree when members show up."""
+    from kubernetes_tpu.core.queue import QueuedCompositeGroupInfo
+
+    cs, sched = _sched()
+    for i in range(4):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": "8", "pods": 110}).obj())
+    cpg = CompositePodGroup(name="root")
+    cs.create_composite_pod_group(cpg)
+    ga = PodGroup(name="a", min_count=2, parent_name="root")
+    cs.create_pod_group(ga)
+    sched.run_until_idle()
+
+    qcgi = QueuedCompositeGroupInfo(cpg=cpg, groups=[(ga, [])])
+    sched.queue._in_flight[qcgi.uid] = 0
+    sched.schedule_composite_group(qcgi)
+    # not parked: no unschedulable entity, no in-flight leak
+    assert sched.queue.unschedulable.get(qcgi.uid) is None
+    assert qcgi.uid not in sched.queue._in_flight
+    # members arriving later still schedule the tree through the buffers
+    _members(cs, "a", 2)
+    sched.run_until_idle()
+    assert sum(1 for u in cs.bindings if cs.bindings[u]) >= 2

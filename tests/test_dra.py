@@ -336,3 +336,41 @@ def test_claim_template_pods_ride_device_and_match_host():
             assert p.uid in claim.reserved_for
         else:
             assert not claim.allocated
+
+
+def test_quantity_string_equality_in_expressions():
+    """Typed quantities compare against the ORIGINAL suffixed string form
+    too: coercion to numbers must not silently break
+    device.capacity["x"] == "40Gi" (round-4 advisor finding)."""
+    from kubernetes_tpu.api.dra import Device, compile_device_expression
+
+    d = Device(name="d", capacity={"memory": "40Gi"},
+               attributes={"model": "a100", "count": "8"})
+    assert compile_device_expression(
+        'device.capacity["memory"] == "40Gi"')(d, "drv")
+    assert compile_device_expression(
+        'device.capacity["memory"] == 42949672960')(d, "drv")
+    assert compile_device_expression(
+        'device.attributes["count"] == "8"')(d, "drv")
+    assert compile_device_expression(
+        'device.attributes["count"] >= "4"')(d, "drv")
+    assert not compile_device_expression(
+        'device.capacity["memory"] == "16Gi"')(d, "drv")
+    # non-numeric strings still compare as strings
+    assert compile_device_expression(
+        'device.attributes["model"] == "a100"')(d, "drv")
+
+
+def test_coerced_memo_invalidates_on_map_replacement():
+    """Replacing a device's attribute/capacity maps (the copy-on-write
+    mutation contract) must invalidate the memoized coerced views — stale
+    CEL values were the round-4 advisor finding."""
+    from kubernetes_tpu.api.dra import Device, compile_device_expression
+
+    d = Device(name="d", attributes={"model": "a100"})
+    m = compile_device_expression('device.attributes["model"] == "a100"')
+    assert m(d, "drv")
+    d.attributes = {"model": "h100"}  # slice update replaces the map
+    assert not m(d, "drv")
+    assert compile_device_expression(
+        'device.attributes["model"] == "h100"')(d, "drv")
