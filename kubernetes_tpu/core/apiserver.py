@@ -512,8 +512,10 @@ class HTTPClientset:
             self._threads.append(t)
         for kind in ("pods", "nodes"):
             if not self._synced[kind].wait(sync_timeout):
+                self.close()  # stop the reflector threads before raising
                 raise TimeoutError(f"reflector {kind} never synced")
             if kind in self._fatal:
+                self.close()
                 raise ConnectionError(
                     f"reflector {kind}: initial connection failed"
                 ) from self._fatal[kind]
@@ -616,7 +618,7 @@ class HTTPClientset:
                 backoff = min(backoff * 2, 5.0)
                 continue
             self._responses.append(conn)
-            backoff = 0.05
+            got_sync = False
             resync_seen: Optional[set] = set()  # keys replayed pre-SYNC
             try:
                 while not self._stop.is_set():
@@ -631,6 +633,8 @@ class HTTPClientset:
                         with self._dispatch_lock:
                             self._replace_barrier(kind, resync_seen)
                         resync_seen = None
+                        got_sync = True
+                        backoff = 0.05  # healthy stream: reset the backoff
                         self._synced[kind].set()
                         self.last_sync[kind] = _time.monotonic()
                         continue
@@ -650,8 +654,13 @@ class HTTPClientset:
                     conn.close()
                 except Exception:  # noqa: BLE001
                     pass
-            if self._stop.wait(0.05):
+            # A stream that died before delivering SYNC counts as a failure:
+            # back off exponentially (client-go ListAndWatch backoff) so a
+            # crash-looping server isn't hammered at ~20 reconnects/sec.
+            if self._stop.wait(backoff if not got_sync else 0.05):
                 return
+            if not got_sync:
+                backoff = min(backoff * 2, 5.0)
 
     @staticmethod
     def _wire_key(kind: str, obj: dict) -> str:
@@ -684,6 +693,11 @@ class HTTPClientset:
                 self.pods[pod.uid] = pod
                 if pod.node_name:
                     self.bindings[pod.uid] = pod.node_name
+                else:
+                    # Re-list replay (or status update) of an UNBOUND pod:
+                    # a stale binding from before a server restart must not
+                    # survive in the informer cache.
+                    self.bindings.pop(pod.uid, None)
             for h in self._pod_handlers:
                 h(action, old, pod)
         else:

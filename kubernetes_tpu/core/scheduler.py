@@ -140,6 +140,16 @@ class Handle:
         any NodeInfo mutations (victim removals) around this probe."""
         return self._scheduler.group_feasible(group, members)
 
+    def device_dry_run_preemption(self, fw, state, pod, node_to_status,
+                                  num_candidates: int, start: int):
+        """Batched DryRunPreemption when the scheduler has a device backend
+        (models/tpu_scheduler.py); None routes the Evaluator to the exact
+        host per-node simulation loop."""
+        fn = getattr(self._scheduler, "device_dry_run_preemption", None)
+        if fn is None:
+            return None
+        return fn(fw, state, pod, node_to_status, num_candidates, start)
+
     def on_async_bind_error(self, pod, exc: Exception) -> None:
         """Async dispatcher bind failure: unwind the optimistic commit."""
         s = self._scheduler

@@ -95,6 +95,8 @@ class TPUScheduler(Scheduler):
         # Stacked placement evaluations that ran on device (one per group
         # cycle whose candidate set was kernel-evaluated).
         self.placement_device_evals = 0
+        # DryRunPreemption kernel calls (one per device-evaluated PostFilter).
+        self.preemption_device_evals = 0
         # Host/device time split (schedule_one.go:574-style step accounting,
         # re-shaped for the batch pipeline): plan_build_s = snapshot→features
         # host work, device_wait_s = time blocked on a device result fetch,
@@ -168,8 +170,8 @@ class TPUScheduler(Scheduler):
             return self.framework_for_pod(head.pod), [head], "pod group entity"
         fw = self.framework_for_pod(head.pod)
         reason = self._batch_supported_memo(head.pod, fw)
-        if reason is None and self.queue.nominator.has_nominated_pods():
-            reason = "nominated pods present"
+        if reason is None:
+            reason = self._nominated_device_block(fw, head.pod)
         if reason is None and self.extenders:
             interested = [e for e in self.extenders if e.is_interested(head.pod)]
             if interested:
@@ -177,6 +179,13 @@ class TPUScheduler(Scheduler):
         sig = fw.sign_pod(head.pod) if reason is None else None
         if sig is None:
             return fw, [head], reason or "unsignable pod"
+        # The nominated lane's priority threshold is the head's priority
+        # (two-pass counts only >=-priority nominations,
+        # framework.go:1280-1284): a different-priority member would need a
+        # different lane, so it ends the session instead of joining it.
+        self._session_nom_priority = (
+            head.pod.priority
+            if self.queue.nominator.has_nominated_pods() else None)
         self._session_claims = set(self._claims_of(head.pod))
         self._session_claims.update(
             f"dra:{head.pod.namespace}/{n}"
@@ -254,7 +263,9 @@ class TPUScheduler(Scheduler):
         if (resume is not None
                 and resume[0] == (id(fw), sig, aux_shape, claims_rv,
                                   self.cluster_event_seq,
-                                  self.attempts, self.state_unwinds)):
+                                  self.attempts, self.state_unwinds)
+                and resume[2] == self._nom_resume_key(
+                    first.members[0].pod.priority)):
             state, plan, carry, node_names = resume[1]
         else:
             _t0 = _time.perf_counter()
@@ -368,7 +379,8 @@ class TPUScheduler(Scheduler):
                      getattr(self.clientset, "resource_claims_rv", 0),
                      self.cluster_event_seq, self.attempts,
                      self.state_unwinds),
-                    (state, plan, carry, node_names))
+                    (state, plan, carry, node_names),
+                    self._nom_resume_key(first.members[0].pod.priority))
 
     def _commit_gang_group(self, fw: Framework, qgpi: QueuedPodGroupInfo,
                            members: List[QueuedPodInfo], rows, node_names,
@@ -531,6 +543,66 @@ class TPUScheduler(Scheduler):
             candidates.append((placement, assignment, pga))
         return candidates
 
+    # -- device preemption dry run -----------------------------------------
+
+    def device_dry_run_preemption(self, fw: Framework, state, pod,
+                                  node_to_status, num_candidates: int,
+                                  start: int):
+        """Batched DryRunPreemption (ops/kernel.py dry_run_preemption): every
+        candidate node's minimal victim set in ONE kernel call, replacing the
+        host Evaluator's per-node simulation loop (preemption.go:425).
+        Returns rotation-ordered, capped [Candidate] — or None when the
+        preemptor (or cluster) needs the exact host dry run: topology-coupled
+        features change with victim removal (spread counts, affinity terms,
+        freed host ports, freed attach room), which the static-filter + fit
+        arithmetic kernel doesn't model. The SELECTED candidate is
+        host-verified by the caller (plugins/preemption.py post_filter)."""
+        if not self.device_enabled:
+            return None
+        if self._resources_only_block(pod) is not None:
+            return None
+        if self._device_unsupported_profile(fw, pod) is not None:
+            return None
+        self.cache.update_snapshot(self.snapshot)
+        nodes = self.snapshot.node_info_list
+        if any(ni.pods_with_required_anti_affinity for ni in nodes):
+            # Removing an anti-carrying victim could clear exist_anti, which
+            # the kernel treats as static.
+            return None
+        self.mirror.sync(nodes)
+        from ..ops.features import build_preemption_victims
+        built = build_preemption_victims(pod, self.snapshot, self.mirror)
+        if built is None:
+            return None
+        vic_req, vic_valid, potential = built
+        try:
+            dstate, plan = self.build_plan(fw, pod, 1)
+        except Unsupported:
+            return None
+        import jax.numpy as jnp
+        from ..core.framework import UNSCHEDULABLE_AND_UNRESOLVABLE
+        from ..ops.kernel import dry_run_preemption
+        from ..plugins.preemption import Candidate
+        res = np.asarray(dry_run_preemption(
+            dstate, plan.features, jnp.asarray(vic_req),
+            jnp.asarray(vic_valid), vic_valid.shape[1]))
+        self.preemption_device_evals += 1
+        feasible, vmask = res[:, 0], res[:, 1:]
+        n = len(nodes)
+        out = []
+        for i in range(n):
+            r = (start + i) % n
+            st = node_to_status.get(nodes[r].name)
+            if st is not None and st.code == UNSCHEDULABLE_AND_UNRESOLVABLE:
+                continue  # nodesWherePreemptionMightHelp
+            if not feasible[r]:
+                continue
+            victims = [pi for j, pi in enumerate(potential[r]) if vmask[r, j]]
+            out.append(Candidate(node_name=nodes[r].name, victims=victims))
+            if len(out) >= num_candidates:
+                break
+        return out
+
     # -- device dispatch ---------------------------------------------------
 
     def _profile_weights(self, fw: Framework) -> Tuple[int, int, int, int, int, int, int]:
@@ -554,6 +626,67 @@ class TPUScheduler(Scheduler):
             "NodeAffinity" in names,
             "NodeResourcesFit" in names,
         )
+
+    def _nominated_device_block(self, fw: Framework, pod) -> Optional[str]:
+        """Why `pod` cannot ride the device while nominations exist (None =
+        the nominated LANE covers it). The lane models pass-1 of the two-pass
+        filter (runtime/framework.go:1275,1300-1317) for RESOURCES only:
+        nominated pods' requests/counts tighten the fit filter on their
+        nominated rows. Features where a nominated pod interacts beyond
+        resources — topology domain counts, affinity terms, host ports,
+        counted volume/claim constraints — take the host path, as does a pod
+        whose own filters a nominated pod's spec could reject (a nominated
+        pod carrying required anti-affinity)."""
+        nom = self.queue.nominator
+        if not nom.has_nominated_pods():
+            return None
+        reason = self._resources_only_block(pod)
+        if reason is not None:
+            return f"nominated pods with {reason}"
+        for pi in nom.all_nominated_pod_infos():
+            if pi.required_anti_affinity_terms:
+                return "nominated pod carries required anti-affinity"
+        return None
+
+    @staticmethod
+    def _resources_only_block(pod) -> Optional[str]:
+        """Why `pod`'s filter outcome depends on more than per-node resource
+        arithmetic + static per-batch masks. Shared by the nominated lane and
+        the preemption dry-run kernel: both model OTHER pods' effects (a
+        nomination counted in, a victim removed) as pure request/count
+        deltas, which is only exact when the pod carries none of these."""
+        if pod.topology_spread_constraints:
+            return "spread constraints"
+        aff = pod.affinity
+        if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
+            return "pod affinity"
+        if pod.host_ports():
+            return "host ports"
+        if any(v.pvc_name for v in pod.volumes) or getattr(
+                pod, "resource_claims", None):
+            return "counted claims"
+        return None
+
+    def _nominated_lane(self, pod) -> Optional[list]:
+        """[(snapshot row, PodInfo)] for the lane: nominated pods with
+        priority >= the batch pod's, on rows present in the snapshot.
+        Call AFTER update_snapshot (rows index node_info_list)."""
+        nom = self.queue.nominator
+        if not nom.has_nominated_pods():
+            return None
+        index = self.snapshot._index
+        if len(index) != len(self.snapshot.node_info_list):
+            index = {ni.name: i
+                     for i, ni in enumerate(self.snapshot.node_info_list)}
+        out = []
+        for node_name, pis in nom._node_to_pods.items():
+            row = index.get(node_name)
+            if row is None:
+                continue
+            for pi in pis:
+                if pi.pod.priority >= pod.priority and pi.pod.uid != pod.uid:
+                    out.append((row, pi))
+        return out or None
 
     def _device_unsupported_profile(self, fw: Framework, pod) -> Optional[str]:
         """PTS/IPA are always enforced by the kernel when the pod carries the
@@ -611,6 +744,7 @@ class TPUScheduler(Scheduler):
             limited_drivers=self.limited_drivers(),
             dra_enabled=dra_enabled,
             dra_in_use=dra_in_use,
+            nominated=self._nominated_lane(pod),
         )
         state = self.mirror.flush()
         if self.mesh is not None:
@@ -619,7 +753,8 @@ class TPUScheduler(Scheduler):
             plan.features = shard_features(plan.features, self.mesh)
         return state, plan
 
-    def warm_for(self, pod, batch_sizes: Optional[List[int]] = None) -> None:
+    def warm_for(self, pod, batch_sizes: Optional[List[int]] = None,
+                 nominated: bool = False) -> None:
         """Compile the kernel shapes a workload of `pod`-shaped pods will hit,
         WITHOUT scheduling anything: dispatches with n_active=0 are fully
         inert (every scan step is padding). Benchmark harnesses call this so
@@ -650,6 +785,21 @@ class TPUScheduler(Scheduler):
             fb = dataclasses.replace(plan, anti_rowlocal=False)
             r1, c1 = self._dispatch(state, fb, 0, None)
             r2, _ = self._dispatch(state, fb, 0, c1)
+            np.asarray(r2)
+        if nominated and not plan.has_nom:
+            # Preemption workloads flip the nominated lane on mid-run (the
+            # first nomination would otherwise compile inside the measured
+            # window): warm the has_nom variant with an empty lane — shapes
+            # and statics are identical to the live nominated plan.
+            import dataclasses
+            import jax.numpy as jnp
+            nf = plan.features._replace(
+                nom_req=jnp.zeros((self.mirror.np_cap, self.mirror.r_slots),
+                                  jnp.int64),
+                nom_pods=jnp.zeros(self.mirror.np_cap, jnp.int32))
+            nv = dataclasses.replace(plan, features=nf, has_nom=True)
+            r1, c1 = self._dispatch(state, nv, 0, None)
+            r2, _ = self._dispatch(state, nv, 0, c1)
             np.asarray(r2)
 
     def warm_for_placements(self, pod, group_size: int,
@@ -688,7 +838,8 @@ class TPUScheduler(Scheduler):
             plan.vmax, n_active=np.int32(n_active), carry_in=carry,
             has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base,
             anti_rowlocal=plan.anti_rowlocal, has_na_pref=plan.has_na_pref,
-            port_selfblock=plan.port_selfblock, has_aux=plan.has_aux)
+            port_selfblock=plan.port_selfblock, has_aux=plan.has_aux,
+            has_nom=plan.has_nom)
 
     # -- device session ----------------------------------------------------
     #
@@ -701,6 +852,13 @@ class TPUScheduler(Scheduler):
     # queue yields something incompatible, a commit diverges from the host
     # oracle, or any external cluster event arrives
     # (Scheduler.cluster_event_seq).
+
+    def _nom_resume_key(self, priority: int):
+        """Nomination component of the session-resume key: the set version
+        plus — only when a lane is live — the priority threshold the plan
+        was built with (an empty nominator makes priority irrelevant)."""
+        nom = self.queue.nominator
+        return (nom.version, priority if nom.has_nominated_pods() else None)
 
     def limited_drivers(self) -> frozenset:
         rv = getattr(self.clientset, "csi_nodes_rv", 0)
@@ -786,6 +944,9 @@ class TPUScheduler(Scheduler):
     def _session_compatible(self, head: QueuedPodInfo, fw: Framework, sig) -> bool:
         if isinstance(head, QueuedPodGroupInfo):
             return False
+        if (getattr(self, "_session_nom_priority", None) is not None
+                and head.pod.priority != self._session_nom_priority):
+            return False  # nominated lane is priority-thresholded
         if not (head.pod.scheduler_name in self.profiles
                 and self.framework_for_pod(head.pod) is fw
                 and fw.sign_pod(head.pod) == sig
@@ -842,7 +1003,9 @@ class TPUScheduler(Scheduler):
         if (resume is not None
                 and resume[0] == (id(fw), sig, aux_shape, claims_rv,
                                   self.cluster_event_seq,
-                                  self.attempts, self.state_unwinds)):
+                                  self.attempts, self.state_unwinds)
+                and resume[2] == self._nom_resume_key(
+                    first_batch[0].pod.priority)):
             # Nothing happened since the last clean session of this exact
             # signature: the mirror is device-resident, the feature plan is
             # still exact, and the final carry reflects every placement —
@@ -855,6 +1018,7 @@ class TPUScheduler(Scheduler):
             node_names = [ni.name for ni in self.snapshot.node_info_list]
         start_seq = self.cluster_event_seq
         start_unwinds = self.state_unwinds
+        start_nom = self.queue.nominator.version
         inflight: List[Tuple[List[QueuedPodInfo], object]] = []
         ok_rows: List[int] = []
         dirty_rows: List[int] = []
@@ -908,10 +1072,12 @@ class TPUScheduler(Scheduler):
                     b, res, fw, node_names, ok_rows, dirty_rows)
                 self.host_commit_s += _time.perf_counter() - _t1
                 if (self.cluster_event_seq != start_seq
-                        or self.state_unwinds != start_unwinds):
+                        or self.state_unwinds != start_unwinds
+                        or self.queue.nominator.version != start_nom):
                     invalidated = True
                     start_seq = self.cluster_event_seq
                     start_unwinds = self.state_unwinds
+                    start_nom = self.queue.nominator.version
             else:
                 # A previous batch diverged: every later device choice is
                 # stale. Host-path the pods and charge their rows dirty.
@@ -944,7 +1110,8 @@ class TPUScheduler(Scheduler):
                      getattr(self.clientset, "resource_claims_rv", 0),
                      self.cluster_event_seq, self.attempts,
                      self.state_unwinds),
-                    (state, plan, carry, node_names))
+                    (state, plan, carry, node_names),
+                    self._nom_resume_key(first_batch[0].pod.priority))
 
     def _commit_batch(self, b, res, fw, node_names, ok_rows, dirty_rows) -> bool:
         """Host tail for one retired batch. Returns True when the session
@@ -999,11 +1166,12 @@ class TPUScheduler(Scheduler):
         spec (signature), priority (no Sign plugin covers it, but PostFilter
         preemption eligibility does — a higher-priority pod with an identical
         signature may succeed where the memoized pod could not), external
-        cluster changes, our own binds, and nominations (sessions never run
-        with nominated pods present, but the key guards the invariant)."""
+        cluster changes, our own binds, and the nomination SET (sessions may
+        run WITH a nominated lane; a changed set changes two-pass filter
+        outcomes, so the memo keys on Nominator.version)."""
         return (fw.sign_pod(pod), pod.priority, self.cluster_event_seq,
                 self.scheduled, self.state_unwinds,
-                self.queue.nominator.has_nominated_pods())
+                self.queue.nominator.version)
 
     def _fail_from_memo(self, fw: Framework, qpi: QueuedPodInfo) -> bool:
         """An identical pod was already host-diagnosed unschedulable against
@@ -1033,6 +1201,10 @@ class TPUScheduler(Scheduler):
         from ..core.framework import CycleState, FitError
         from ..ops.features import diagnose_unschedulable
 
+        if self.queue.nominator.has_nominated_pods():
+            # The vectorized diagnosis doesn't model the two-pass nominated
+            # filter; the exact host rerun owns the Diagnosis.
+            return False
         t0 = _t.perf_counter()
         self.cache.update_snapshot(self.snapshot)
         self.mirror.sync(self.snapshot.node_info_list)

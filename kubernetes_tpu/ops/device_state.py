@@ -307,6 +307,17 @@ class NodeStateMirror:
     def flush(self) -> DeviceNodeState:
         """Upload pending changes; returns the device pytree. Scatter when the
         dirty fraction is small, full device_put otherwise."""
+        if self._device is not None and not self._full_flush:
+            try:
+                deleted = self._device.req_r.is_deleted()
+            except AttributeError:
+                deleted = False
+            if deleted:
+                # The resident arrays came from a session carry (adopt) that
+                # was later DONATED back to the kernel (session resume).
+                # adopt kept the host staging in line, so a full upload from
+                # staging reproduces the exact device truth.
+                self._full_flush = True
         if self._device is None or self._full_flush:
             self._device = DeviceNodeState(
                 *[jnp.asarray(a) for a in self._arrays()], jnp.asarray(self.h_topo)

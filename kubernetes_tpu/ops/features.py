@@ -135,6 +135,14 @@ class BatchFeatures(NamedTuple):
     # driver; each landing consumes aux_inc units of its row's room.
     aux_room: jnp.ndarray         # [NP] i32 (BIG = unconstrained)
     aux_inc: jnp.ndarray          # i32 scalar (0 = no aux constraint)
+    # Nominated-pod lane (runtime/framework.go:1275 two-pass filter, pass 1):
+    # per-node request/count totals of preemption-nominated pods with
+    # priority >= the batch pod's — the FIT FILTER counts them as if running
+    # (pass 1 is strictly tighter than pass 2 for resources, so one pass
+    # suffices); scores ignore them, exactly like the host. Shape [0]/[0, R]
+    # when the plan has no nominated lane (has_nom=False).
+    nom_req: jnp.ndarray          # [NP or 0, R] i64
+    nom_pods: jnp.ndarray         # [NP or 0] i32
     # sampling / loop
     num_nodes: jnp.ndarray        # i32
     start_index: jnp.ndarray      # i32
@@ -167,6 +175,24 @@ class BatchPlan:
     # Counted aux constraint live (CSI attach limits) — row-local,
     # lap-path compatible.
     has_aux: bool = False
+    # Nominated-pod lane live: the fit filter subtracts nom_req/nom_pods
+    # (static per plan; any nomination add/delete invalidates the session
+    # via Nominator.version).
+    has_nom: bool = False
+    # Host-side per-node topology-spread columns (numpy, NOT shipped to the
+    # kernel): per-constraint per-node matching-pod counts + domain
+    # eligibility. schedule_placements rebuilds each candidate placement's
+    # RESTRICTED count tables from these (the host oracle computes its
+    # PreFilter state over the placement-restricted node list —
+    # core/cache.py assume_placement), lifting the old no-spread
+    # restriction invariant. None when the plan has no spread features.
+    dns_node_counts: Optional[object] = None   # np [C1, n] i32
+    dns_node_elig: Optional[object] = None     # np [C1, n] bool (key+policies)
+    dns_min_domains: Optional[object] = None   # list[Optional[int]] per C1 row
+    sa_node_counts: Optional[object] = None    # np [C2, n] i32
+    sa_node_live: Optional[object] = None      # np [n] bool (~sa_ignored)
+    sa_hostname_axis: Optional[object] = None  # list[bool] per C2 row
+    sa_max_skew: Optional[object] = None       # list[int] per C2 row
 
 
 class Unsupported(Exception):
@@ -387,11 +413,18 @@ def build_batch(
     limited_drivers=frozenset(),
     dra_enabled=False,
     dra_in_use=None,
+    nominated=None,
 ) -> BatchPlan:
     """Build kernel inputs for a batch of `batch_size` pods identical to `pod`.
 
     `mirror` must already be synced to `snapshot`. Raises Unsupported for
     feature combinations the kernel does not cover.
+
+    `nominated`: [(node_row, PodInfo)] of preemption-nominated pods with
+    priority >= the batch pod's, pre-filtered by the caller (the device
+    gate guarantees the batch pod carries no feature a nominated pod could
+    interact with beyond resources — models/tpu_scheduler.py
+    _nominated_device_block).
     """
     verdict = volume_device_support(
         pod, clientset, pvc_refs=pvc_refs, limited_drivers=limited_drivers)
@@ -572,6 +605,9 @@ def build_batch(
     dns_honor_taints = np.zeros(c1, i32)
     dns_counts = np.zeros((c1, vmax), i32)
     dns_dom = np.zeros((c1, vmax), bool)
+    dns_node_counts = np.zeros((len(dns), n), i32) if dns else None
+    dns_node_elig = np.zeros((len(dns), n), bool) if dns else None
+    dns_min_domains = [c.min_domains for c in dns] if dns else None
     for ci, c in enumerate(dns):
         ax = mirror.axes[c.topology_key]
         dns_axis[ci] = ax.index
@@ -593,7 +629,10 @@ def build_batch(
             vid = vids[r_i]
             dns_dom[ci, vid] = True
             n_domains.add(vid)
-            dns_counts[ci, vid] += _count_pods_matching(ni, c.selector, pod.namespace)
+            cnt = _count_pods_matching(ni, c.selector, pod.namespace)
+            dns_counts[ci, vid] += cnt
+            dns_node_counts[ci, r_i] = cnt
+            dns_node_elig[ci, r_i] = True
         forced = c.min_domains is not None and len(n_domains) < c.min_domains
         dns_forced0[ci] = 1 if (forced or not n_domains) else 0
 
@@ -604,6 +643,10 @@ def build_batch(
     sa_skew = np.ones(c2, i64)
     sa_self = np.zeros(c2, i32)
     sa_counts = np.zeros((c2, vmax), i32)
+    sa_node_counts = np.zeros((len(sa), n), i32) if sa else None
+    sa_node_live = None
+    sa_hostname_axis = [c.topology_key == LABEL_HOSTNAME for c in sa] if sa else None
+    sa_max_skew_l = [int(c.max_skew) for c in sa] if sa else None
     if sa:
         # scoring.go initPreScoreState: a node is ignored when it misses any
         # constraint's topology key or fails the pod's required node affinity.
@@ -611,6 +654,7 @@ def build_batch(
             (not all(c.topology_key in ni.node.labels for c in sa)) or not sel_match_host[r_i]
             for r_i, ni in enumerate(nodes)
         ]
+        sa_node_live = ~np.asarray(sa_ignored, bool)
         for ci, c in enumerate(sa):
             ax = mirror.axes[c.topology_key]
             sa_axis[ci] = ax.index
@@ -625,6 +669,7 @@ def build_batch(
                 vid = vids[r_i]
                 cnt = _count_pods_matching(ni, c.selector, pod.namespace)
                 sa_counts[ci, vid] += cnt
+                sa_node_counts[ci, r_i] = cnt
                 domains.add(vid)
                 size_hostname += 1
             if c.topology_key == LABEL_HOSTNAME:
@@ -793,6 +838,18 @@ def build_batch(
 
     to_find = num_feasible_nodes_to_find(n, percentage_of_nodes_to_score)
 
+    # ---- nominated-pod lane (two-pass filter pass 1, resources only) -----
+    has_nom = bool(nominated)
+    if has_nom:
+        nom_req = np.zeros((npc, mirror.r_slots), i64)
+        nom_pods = np.zeros(npc, i32)
+        for row, npi in nominated:
+            nom_req[row] += _resource_vec(mirror, npi.pod.resource_request())
+            nom_pods[row] += 1
+    else:
+        nom_req = np.zeros((0, mirror.r_slots), i64)
+        nom_pods = np.zeros(0, i32)
+
     # ---- counted aux constraint: CSI attach room / DRA free devices ------
     AUX_BIG = (1 << 30)
     aux_room = np.full(npc, AUX_BIG, i32)
@@ -866,6 +923,8 @@ def build_batch(
         enable=jnp.asarray(np.array([1 if b else 0 for b in filters_on], i32)),
         aux_room=jnp.asarray(aux_room),
         aux_inc=jnp.asarray(np.int32(aux_inc_n)),
+        nom_req=jnp.asarray(nom_req),
+        nom_pods=jnp.asarray(nom_pods),
         num_nodes=jnp.asarray(np.int32(n)),
         start_index=jnp.asarray(np.int32(start_index % max(1, n))),
         to_find=jnp.asarray(np.int32(to_find)),
@@ -881,6 +940,7 @@ def build_batch(
         has_na_pref=has_na_pref,
         port_selfblock=port_selfblock,
         has_aux=has_aux_flag or bool(aux_driver and aux_inc_n),
+        has_nom=has_nom,
     )
 
 
@@ -907,6 +967,46 @@ def _batch_tier(n: int) -> int:
     if n <= 64:
         return 64
     return _pow2(n, 512)
+
+
+PREEMPT_K_CAP = 256  # victims-per-node tier ceiling (recompile guard)
+
+
+def build_preemption_victims(pod: Pod, snapshot, mirror: NodeStateMirror):
+    """Victim tensors for the dry-run kernel: per node, every lower-priority
+    pod in MoreImportantPod reprieve order (higher priority first, then
+    earlier start — preemption.go:480-520 / the host Evaluator's sort).
+    Returns (vic_req [npc, K, R] i64, vic_valid [npc, K] bool,
+    potential [n] list-of-PodInfo in the same order) or None when some node
+    exceeds the K cap (host path owns it)."""
+    nodes = snapshot.node_info_list
+    prio = pod.priority
+    potential = []
+    kmax = 0
+    for ni in nodes:
+        pis = [pi for pi in ni.pods if pi.pod.priority < prio]
+        pis.sort(key=lambda pi: (-pi.pod.priority, pi.pod.creation_ts))
+        potential.append(pis)
+        if len(pis) > kmax:
+            kmax = len(pis)
+    if kmax == 0 or kmax > PREEMPT_K_CAP:
+        return None
+    k = _pow2(kmax, 8)
+    npc = mirror.np_cap
+    # Intern every victim scalar-resource slot BEFORE allocating (interning
+    # can grow r_slots; the caller's build_plan re-syncs the mirror after).
+    reqs = [[pi.pod.resource_request() for pi in pis] for pis in potential]
+    for rs in reqs:
+        for r in rs:
+            for name in r.scalar_resources:
+                mirror.scalar_slot(name)
+    vic_req = np.zeros((npc, k, mirror.r_slots), np.int64)
+    vic_valid = np.zeros((npc, k), bool)
+    for r_i, rs in enumerate(reqs):
+        for j, r in enumerate(rs):
+            vic_req[r_i, j] = _resource_vec(mirror, r)
+            vic_valid[r_i, j] = True
+    return vic_req, vic_valid, potential
 
 
 def diagnose_unschedulable(pod: Pod, mirror: NodeStateMirror, snapshot,

@@ -158,14 +158,22 @@ def _normalize_default_reverse(raw, mx):
 
 
 def _resource_eval(f: BatchFeatures, fit_strategy: int,
-                   alloc_r, alloc_pods, req_r, nonzero, pod_count):
+                   alloc_r, alloc_pods, req_r, nonzero, pod_count,
+                   nom_r=None, nom_p=None):
     """Fit filter (fit.go:710) + LeastAllocated/MostAllocated score +
     integer-quantized BalancedAllocation for any leading shape (all nodes
     pre-scan; a single updated row inside the scan — these values only change
     at the row a pod landed on, so the scan carries them instead of
-    recomputing [NP, R] work per step)."""
-    pods_ok = (pod_count + 1).astype(jnp.int64) <= alloc_pods
-    viol = ((f.request > 0) & (f.request > alloc_r - req_r)).any(axis=-1)
+    recomputing [NP, R] work per step).
+
+    `nom_r`/`nom_p` (the nominated-pod lane): pass-1 of the two-pass filter
+    (runtime/framework.go:1300-1317) counts nominated pods' requests/count
+    against the FILTER only — scores stay pass-2 (real pods), exactly as the
+    host computes them."""
+    eff_count = pod_count if nom_p is None else pod_count + nom_p
+    pods_ok = (eff_count + 1).astype(jnp.int64) <= alloc_pods
+    avail = alloc_r - req_r if nom_r is None else alloc_r - req_r - nom_r
+    viol = ((f.request > 0) & (f.request > avail)).any(axis=-1)
     fit_ok = (pods_ok & (~viol | (f.has_request == 0))) | (f.enable[4] == 0)
     used0 = nonzero[..., 0] + f.nz_request[0]
     used1 = nonzero[..., 1] + f.nz_request[1]
@@ -202,7 +210,8 @@ def _resource_eval(f: BatchFeatures, fit_strategy: int,
 
 @partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax",
                                    "has_pns", "has_ipa_base", "anti_rowlocal",
-                                   "has_na_pref", "port_selfblock", "has_aux"),
+                                   "has_na_pref", "port_selfblock", "has_aux",
+                                   "has_nom"),
          donate_argnames=("carry_in",))
 def schedule_batch(
     state: DeviceNodeState,
@@ -218,6 +227,7 @@ def schedule_batch(
     has_na_pref: bool = False,
     port_selfblock: bool = False,
     has_aux: bool = False,
+    has_nom: bool = False,
 ) -> Tuple[jnp.ndarray, ScanCarry]:
     """Greedy-assign up to `batch_pad` identical pods (`n_active` of them
     real; padded steps are inert so the returned carry stays exact).
@@ -434,7 +444,9 @@ def schedule_batch(
         # nothing was applied the inputs are unchanged, so this is identity).
         r_ok, r_fit, r_ba = _resource_eval(
             f, fit_strategy, state.alloc_r[row], state.alloc_pods[row],
-            req_r[row], nonzero[row], pod_count[row])
+            req_r[row], nonzero[row], pod_count[row],
+            nom_r=f.nom_req[row] if has_nom else None,
+            nom_p=f.nom_pods[row] if has_nom else None)
         fit_ok = fit_ok.at[row].set(r_ok)
         fit_sc = fit_sc.at[row].set(r_fit)
         ba = ba.at[row].set(r_ba)
@@ -492,7 +504,9 @@ def schedule_batch(
     if carry_in is None:
         fit_ok0, fit_sc0, ba0 = _resource_eval(
             f, fit_strategy, state.alloc_r, state.alloc_pods,
-            state.req_r, state.nonzero, state.pod_count)
+            state.req_r, state.nonzero, state.pod_count,
+            nom_r=f.nom_req if has_nom else None,
+            nom_p=f.nom_pods if has_nom else None)
         ipa_delta0 = jnp.zeros((KD, vmax), jnp.int64)
         ext0 = ScanCarry(state.req_r, state.nonzero, state.pod_count,
                          fit_ok0, fit_sc0, ba0,
@@ -505,7 +519,7 @@ def schedule_batch(
         return _lap_schedule(state, f, batch_pad, fit_strategy,
                              ext0, static_ok, n_act, idx, num,
                              w_tt, w_fit, w_ba, il_term, anti_vid,
-                             port_selfblock, has_aux)
+                             port_selfblock, has_aux, has_nom)
     # Per-node projections of the count tables (one gather per table per
     # CALL, kept elementwise-fresh by the scan) + okd/F seeds.
     i64v = jnp.int64
@@ -593,6 +607,68 @@ def schedule_placements(
     return jax.vmap(one)(masks)
 
 
+@partial(jax.jit, static_argnames=("k",))
+def dry_run_preemption(
+    state: DeviceNodeState,
+    f: BatchFeatures,
+    vic_req: jnp.ndarray,    # [NP, K, R] i64 victim requests, MoreImportantPod order
+    vic_valid: jnp.ndarray,  # [NP, K] bool
+    k: int,
+) -> jnp.ndarray:
+    """Batched DryRunPreemption (preemption.go:425 SelectVictimsOnNode for
+    every candidate node in ONE dense what-if — SURVEY §7.7's 'natural second
+    TPU kernel').
+
+    Per node: remove all lower-priority pods (columns of vic_req), check the
+    preemptor fits; then reprieve victims most-important-first (the host's
+    MoreImportantPod order, pre-sorted into the K axis), keeping each victim
+    whose re-addition still leaves the preemptor feasible. The preemptor's
+    non-resource filters are static per node (the device gate excludes
+    topology-coupled preemptors and clusters with anti-affinity pods), so
+    the per-victim feasibility check reduces to the fit arithmetic of
+    _resource_eval — bit-identical to the host oracle's filter verdicts.
+
+    Returns one stacked bool array [NP, 1+K] (a single device→host fetch):
+    column 0 = feasible (non-empty minimal victim set), columns 1..K = the
+    victim mask; scores/PDBs/selection stay host-side
+    (pickOneNodeForPreemption, preemption.go:286)."""
+    NP = state.valid.shape[0]
+    idx = jnp.arange(NP, dtype=jnp.int32)
+    num = jnp.maximum(f.num_nodes, 1)
+    taint_ok, _pns, sel_ok, name_ok, unsched_ok, exist_anti_ok = _static_masks(state, f)
+    static_ok = (state.valid & name_ok & unsched_ok & taint_ok & sel_ok
+                 & exist_anti_ok & f.extra_ok & (idx < num))
+
+    n_pot = vic_valid.sum(axis=1).astype(jnp.int32)          # [NP]
+    sum_vic = (vic_req * vic_valid[:, :, None]).sum(axis=1)  # [NP, R]
+    base_req = state.req_r - sum_vic
+    cnt0 = state.pod_count - n_pot
+
+    def fit(req_r, pod_cnt):
+        pods_ok = (pod_cnt + 1).astype(jnp.int64) <= state.alloc_pods
+        viol = ((f.request > 0) & (f.request > state.alloc_r - req_r)).any(axis=-1)
+        return (pods_ok & (~viol | (f.has_request == 0))) | (f.enable[4] == 0)
+
+    feasible0 = static_ok & fit(base_req, cnt0) & (n_pot > 0)
+
+    def step(carry, i):
+        kept_req, kept_cnt = carry
+        vr = vic_req[:, i]                                   # [NP, R]
+        valid = vic_valid[:, i]                              # [NP]
+        keep = valid & feasible0 & fit(base_req + kept_req + vr,
+                                       cnt0 + kept_cnt + 1)
+        kept_req = kept_req + vr * keep[:, None]
+        kept_cnt = kept_cnt + keep.astype(jnp.int32)
+        return (kept_req, kept_cnt), valid & feasible0 & ~keep
+
+    (_kr, _kc), victims_t = lax.scan(
+        step, (jnp.zeros_like(sum_vic), jnp.zeros(NP, jnp.int32)),
+        jnp.arange(k, dtype=jnp.int32))
+    victim_mask = jnp.moveaxis(victims_t, 0, 1)              # [NP, K]
+    feasible = feasible0 & victim_mask.any(axis=1)
+    return jnp.concatenate([feasible[:, None], victim_mask], axis=1)
+
+
 # Max pods placed per lap iteration (bounds the segment tensors; L_full =
 # total_feasible // to_find never exceeds ~20 for the reference's adaptive
 # percentage formula, schedule_one.go:866, but custom percentageOfNodesToScore
@@ -602,7 +678,7 @@ LAP_MAX = 32
 
 def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
                   static_ok, n_act, idx, num, w_tt, w_fit, w_ba, il_term,
-                  anti_vid, port_selfblock, has_aux):
+                  anti_vid, port_selfblock, has_aux, has_nom=False):
     """Lap-vectorized greedy assignment for the static-score case.
 
     Key fact: with adaptive sampling live (schedule_one.go:866-892), pod i
@@ -640,7 +716,9 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
         # serialize per index, so one-hot masked vector ops win):
         fit_ok, fit_sc, ba = _resource_eval(
             f, fit_strategy, state.alloc_r, state.alloc_pods,
-            req_r, nonzero, pod_count)
+            req_r, nonzero, pod_count,
+            nom_r=f.nom_req if has_nom else None,
+            nom_p=f.nom_pods if has_nom else None)
         okd = static_ok & fit_ok & (idx < num)
         if port_selfblock:
             okd &= ~blocked
@@ -711,9 +789,14 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
           ext0.anti_counts, ext0.blocked, ext0.aux_cnt, ext0.start, out0)
     (done, req_r, nonzero, pod_count, anti_counts, blocked, aux_cnt, start,
      out) = lax.while_loop(cond, body, c0)
+    # The carry's fit_ok seeds the next chained batch of the SAME plan, so
+    # it keeps the nominated lane (a changed nomination set never chains —
+    # Nominator.version invalidates the session).
     fit_ok, fit_sc, ba = _resource_eval(
         f, fit_strategy, state.alloc_r, state.alloc_pods,
-        req_r, nonzero, pod_count)
+        req_r, nonzero, pod_count,
+        nom_r=f.nom_req if has_nom else None,
+        nom_p=f.nom_pods if has_nom else None)
     carry = ScanCarry(req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
                       ext0.dns_counts, ext0.sa_counts, anti_counts,
                       ext0.aff_counts, ext0.ipa_delta, start, blocked,

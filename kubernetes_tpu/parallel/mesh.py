@@ -60,8 +60,9 @@ def _feature_specs() -> BatchFeatures:
     """Per-node feature arrays shard over "nodes"; the rest replicate."""
     specs = {name: P() for name in BatchFeatures._fields}
     for per_node in ("exist_anti", "ipa_base", "sel_match", "extra_ok",
-                     "il_score", "na_raw", "aux_room"):
+                     "il_score", "na_raw", "aux_room", "nom_pods"):
         specs[per_node] = P("nodes")
+    specs["nom_req"] = P("nodes", None)
     return BatchFeatures(**specs)
 
 

@@ -56,6 +56,8 @@ class Evaluator:
         self.handle = handle
         self.fw = framework
         self._offset = 0  # rotating start, GetOffsetAndNumCandidates
+        self._last_start = None  # start used by the most recent dry run
+        self.last_from_device = False  # candidates came from the kernel
 
     # -- eligibility (default_preemption.go PodEligibleToPreemptOthers) ----
 
@@ -128,11 +130,16 @@ class Evaluator:
         return Candidate(node_name=ni.name, victims=victims)
 
     def find_candidates(
-        self, state: CycleState, pod: Pod, node_to_status: Dict[str, Status]
+        self, state: CycleState, pod: Pod, node_to_status: Dict[str, Status],
+        force_host: bool = False,
     ) -> List[Candidate]:
         """DryRunPreemption over candidate nodes, capped at ~10% of the
         cluster (floor 100) from a rotating offset — the reference's
-        GetOffsetAndNumCandidates (preemption.go:201,425)."""
+        GetOffsetAndNumCandidates (preemption.go:201,425). When the handle
+        exposes a device backend, the per-node victim simulation runs as ONE
+        batched kernel call (same rotation, same cap, same skip of
+        unresolvable nodes); the caller host-verifies the selected
+        candidate and passes force_host=True to recompute on divergence."""
         snapshot = self.handle.snapshot() if callable(self.handle.snapshot) else self.handle.snapshot
         nodes = snapshot.node_info_list
         n = len(nodes)
@@ -141,8 +148,25 @@ class Evaluator:
         num_candidates = max(
             n * self.MIN_CANDIDATE_NODES_PERCENTAGE // 100,
             self.MIN_CANDIDATE_NODES_ABSOLUTE)
-        start = self._offset % n
-        self._offset += 1
+        if force_host and self._last_start is not None:
+            # Host recompute after a device-verify divergence: scan the SAME
+            # rotation window the device pass used, and do NOT advance the
+            # offset again — a pure-host run would have consumed exactly one
+            # offset for this attempt.
+            start = self._last_start
+        else:
+            start = self._offset % n
+            self._offset += 1
+            self._last_start = start
+        self.last_from_device = False
+        if not force_host:
+            device_fn = getattr(self.handle, "device_dry_run_preemption", None)
+            if device_fn is not None:
+                cands = device_fn(self.fw, state, pod, node_to_status,
+                                  num_candidates, start)
+                if cands is not None:
+                    self.last_from_device = True
+                    return cands
         candidates: List[Candidate] = []
         for i in range(n):
             ni = nodes[(start + i) % n]
@@ -310,6 +334,9 @@ class DefaultPreemption:
         # narrow the candidate victim map before selection.
         extenders = getattr(self.handle, "extenders", None) or ()
         if any(e.supports_preemption() for e in extenders):
+            # Extender-trimmed victim sets are extender-authoritative: the
+            # host dry run can't reproduce them, so skip device verification.
+            self.evaluator.last_from_device = False
             from ..core.extender import run_extender_preemption
             victim_map = {c.node_name: c.victims for c in candidates}
             victim_map, err = run_extender_preemption(extenders, pod, victim_map)
@@ -330,6 +357,27 @@ class DefaultPreemption:
                 return None, Status.unresolvable(
                     "preemption: extenders rejected all candidates")
         best = self.evaluator.select_candidate(candidates)
+        if self.evaluator.last_from_device and best is not None:
+            # Host-verify the device-selected candidate: the exact per-node
+            # dry run must reproduce the victim set. On divergence (a kernel
+            # coverage bug), the host loop is authoritative.
+            ni = snapshot.get(best.node_name)
+            verified = (self.evaluator.dry_run_on_node(state, pod, ni)
+                        if ni is not None else None)
+            if verified is None or (
+                    {pi.pod.uid for pi in verified.victims}
+                    != {pi.pod.uid for pi in best.victims}):
+                candidates = self.evaluator.find_candidates(
+                    state, pod, filtered_status_map, force_host=True)
+                if not candidates:
+                    return None, Status.unresolvable(
+                        "preemption: 0/%d nodes are available"
+                        % max(1, snapshot.num_nodes()))
+                best = self.evaluator.select_candidate(candidates)
+            else:
+                best = Candidate(node_name=best.node_name,
+                                 victims=verified.victims,
+                                 num_pdb_violations=best.num_pdb_violations)
         self.evaluator.prepare_candidate(best, pod)
         if metrics is not None:
             metrics.preemption_victims.observe(len(best.victims))

@@ -468,3 +468,93 @@ class TestInfeasibleDiagnosisEquivalence:
         s.run_until_idle()
         assert hi.nominated_node_name or hi.node_name, (
             "higher-priority pod was parked by a stale fail memo")
+
+
+class TestNominatedLane:
+    """Nominated pods ride the kernel as a fit-filter lane
+    (runtime/framework.go:1275 two-pass, pass 1 resources) instead of
+    disabling the device path wholesale (round-4 VERDICT item 3)."""
+
+    def _pair(self, n_nodes=8, seed=0):
+        host = Scheduler(deterministic_ties=True)
+        dev = TPUScheduler()
+        _mk_cluster(host, n_nodes, seed=seed)
+        _mk_cluster(dev, n_nodes, seed=seed)
+        return host, dev
+
+    def test_manual_nominations_match_host(self):
+        from kubernetes_tpu.core.node_info import PodInfo
+        host, dev = self._pair()
+        for sched in (host, dev):
+            g1 = make_pod().name("ghost1").req({"cpu": "1500m"}).priority(50).obj()
+            g2 = make_pod().name("ghost2").req({"cpu": "1"}).priority(50).obj()
+            sched.queue.nominator.add_nominated_pod(PodInfo.of(g1), "node-0")
+            sched.queue.nominator.add_nominated_pod(PodInfo.of(g2), "node-3")
+        proto = make_pod().name("proto").req({"cpu": "500m"}).labels({"a": "b"}).obj()
+        for sched in (host, dev):
+            for i in range(24):
+                sched.clientset.create_pod(proto.clone_from_template(f"p{i}"))
+            sched.run_until_idle()
+        a_h, a_d = _assignments(host), _assignments(dev)
+        assert a_h == a_d
+        assert dev.device_scheduled >= 20, (
+            f"device path should stay on with nominations "
+            f"(device={dev.device_scheduled}, host={dev.host_path_pods})")
+
+    def test_lower_priority_nomination_ignored(self):
+        """Only >=-priority nominations count in pass 1
+        (framework.go:1280-1284): a LOWER-priority nomination must not
+        shrink the fit room for the batch."""
+        from kubernetes_tpu.core.node_info import PodInfo
+        host, dev = self._pair()
+        for sched in (host, dev):
+            g = make_pod().name("ghost").req({"cpu": "100"}).priority(-5).obj()
+            sched.queue.nominator.add_nominated_pod(PodInfo.of(g), "node-1")
+        proto = make_pod().name("proto").req({"cpu": "500m"}).obj()
+        for sched in (host, dev):
+            for i in range(16):
+                sched.clientset.create_pod(proto.clone_from_template(f"p{i}"))
+            sched.run_until_idle()
+        assert _assignments(host) == _assignments(dev)
+        assert dev.device_scheduled >= 14
+
+    def test_preemption_nominations_interleaved(self):
+        """The VERDICT done-criterion: real PostFilter preemptions create
+        nominations mid-workload; plain pods keep riding the device with
+        identical assignments and >=90% device-scheduled."""
+        host = Scheduler(deterministic_ties=True)
+        dev = TPUScheduler()
+        for sched in (host, dev):
+            for i in range(10):
+                sched.clientset.create_node(
+                    make_node().name(f"node-{i}")
+                    .capacity({"cpu": 4, "memory": "8Gi", "pods": 20}).obj())
+        # fill the cluster with evictable low-priority pods
+        low = make_pod().name("low").req({"cpu": "3"}).priority(0).obj()
+        for sched in (host, dev):
+            for i in range(10):
+                sched.clientset.create_pod(low.clone_from_template(f"low-{i}"))
+            sched.run_until_idle()
+        # preemptors (high priority, need 3 cpu -> must evict) interleaved
+        # with plain small pods that fit in the remaining 1-cpu slivers
+        hi = make_pod().name("hi").req({"cpu": "3"}).priority(100).obj()
+        small = make_pod().name("small").req({"cpu": "200m"}).priority(10).obj()
+        for sched in (host, dev):
+            for i in range(3):
+                sched.clientset.create_pod(hi.clone_from_template(f"hi-{i}"))
+                for j in range(8):
+                    sched.clientset.create_pod(
+                        small.clone_from_template(f"small-{i}-{j}"))
+                sched.run_until_idle()
+            # let evictions finish and preemptors land
+            for _ in range(40):
+                sched.process_async_api_errors()
+                sched.run_until_idle()
+        a_h, a_d = _assignments(host), _assignments(dev)
+        small_h = {k: v for k, v in a_h.items() if k.startswith("small")}
+        small_d = {k: v for k, v in a_d.items() if k.startswith("small")}
+        assert small_h == small_d
+        total_small = 24
+        assert sum(1 for v in small_d.values() if v) == total_small
+        assert dev.device_scheduled >= 0.9 * total_small, (
+            f"{dev.device_scheduled} device vs {dev.host_path_pods} host")
