@@ -418,18 +418,73 @@ class TPUScheduler(Scheduler):
 
     @staticmethod
     def _placement_plan_restriction_invariant(plan) -> bool:
-        """True when restricting the node universe cannot change any feature
-        table the plan precomputed over the FULL cluster: no topology-spread
-        or inter-pod-affinity count tables (their domains/counts would have
-        been computed over the restricted list by the host oracle), no
-        image-locality score (its spread discount divides by the restricted
+        """True when the plan can be evaluated per-placement on device.
+        Topology-SPREAD tables are no longer a blocker: the host oracle
+        computes them over the restricted list (cache.py assume_placement),
+        and _placement_spread_overrides rebuilds each placement's restricted
+        tables from the plan's per-node columns. Still host-only:
+        inter-pod-affinity tables (term matches against restricted pod sets)
+        and image-locality (its spread discount divides by the restricted
         node count). Static row-local terms (fit, balance, taints,
         node-affinity preference) restrict exactly."""
         f = plan.features
-        return (f.dns_axis.shape[0] == 0 and f.sa_axis.shape[0] == 0
-                and f.anti_axis.shape[0] == 0 and f.aff_axis.shape[0] == 0
+        return (f.anti_axis.shape[0] == 0 and f.aff_axis.shape[0] == 0
                 and f.ipa_axis.shape[0] == 0 and not plan.has_ipa_base
                 and not bool(np.asarray(f.il_score).any()))
+
+    def _placement_spread_overrides(self, plan, placements, index):
+        """Per-placement restricted spread tables (the device analogue of
+        running calPreFilterState / initPreScoreState over
+        assume_placement's node list): scatter-add the plan's per-node
+        match-count columns over each placement's rows. Returns the
+        spread_overrides tuple for ops/kernel.py schedule_placements, or
+        None when the plan carries no spread features."""
+        import jax.numpy as jnp
+        f = plan.features
+        c1p, c2p = f.dns_axis.shape[0], f.sa_axis.shape[0]
+        if c1p == 0 and c2p == 0:
+            return None
+        import math
+        npc = self.mirror.np_cap
+        vmax = plan.vmax
+        p_pad = _pow2_pad(len(placements))
+        n = len(self.snapshot.node_info_list)
+        dns_axis = np.asarray(f.dns_axis)
+        sa_axis = np.asarray(f.sa_axis)
+        dns_counts = np.zeros((p_pad, c1p, vmax), np.int32)
+        dns_dom = np.zeros((p_pad, c1p, vmax), bool)
+        dns_forced0 = np.ones((p_pad, c1p), np.int32)  # pad rows: min 0
+        sa_counts = np.zeros((p_pad, c2p, vmax), np.int32)
+        sa_wq = np.zeros((p_pad, c2p), np.int64)
+        nc1 = 0 if plan.dns_node_counts is None else plan.dns_node_counts.shape[0]
+        nc2 = 0 if plan.sa_node_counts is None else plan.sa_node_counts.shape[0]
+        for pi, placement in enumerate(placements):
+            rows = np.array([r for name in placement.node_names
+                             if (r := index.get(name)) is not None and r < n],
+                            np.int64)
+            for ci in range(nc1):
+                vids = self.mirror.h_topo[dns_axis[ci], rows]
+                elig = plan.dns_node_elig[ci, rows]
+                ev = vids[elig]
+                np.add.at(dns_counts[pi, ci], ev,
+                          plan.dns_node_counts[ci, rows][elig])
+                dns_dom[pi, ci, ev] = True
+                nd = np.unique(ev).size
+                md = plan.dns_min_domains[ci]
+                dns_forced0[pi, ci] = 1 if (nd == 0 or (
+                    md is not None and nd < md)) else 0
+            for ci in range(nc2):
+                vids = self.mirror.h_topo[sa_axis[ci], rows]
+                live = plan.sa_node_live[rows]
+                lv = vids[live]
+                np.add.at(sa_counts[pi, ci], lv,
+                          plan.sa_node_counts[ci, rows][live])
+                size = (int(live.sum()) if plan.sa_hostname_axis[ci]
+                        else np.unique(lv).size)
+                sa_wq[pi, ci] = int(round(math.log(size + 2) * 1024))
+        return (jnp.asarray(dns_counts), jnp.asarray(dns_dom),
+                jnp.asarray(dns_forced0), jnp.asarray(sa_counts),
+                jnp.asarray(sa_wq))
 
     def _evaluate_placements(self, fw: Framework, pg_state, group, members,
                              placements, start_index: int):
@@ -479,10 +534,16 @@ class TPUScheduler(Scheduler):
             if not self._placement_plan_restriction_invariant(plan):
                 return super()._evaluate_placements(
                     fw, pg_state, group, members, placements, start_index)
+            # Spread-carrying plans are NOT cached across group cycles: the
+            # per-node match-count columns change with every commit of a
+            # matching pod, unlike the node-state aggregates that flow
+            # through the mirror's dirty rows.
             self._placement_plan_cache = (
                 (id(fw), sig, len(members), self.cluster_event_seq,
                  self.mirror.np_cap),
-                plan) if not (plan.port_selfblock or plan.has_aux) else None
+                plan) if not (plan.port_selfblock or plan.has_aux
+                              or plan.dns_node_counts is not None
+                              or plan.sa_node_counts is not None) else None
 
         import jax.numpy as jnp
         from ..ops.kernel import schedule_placements
@@ -516,7 +577,9 @@ class TPUScheduler(Scheduler):
             n_active=np.int32(len(members)),
             has_pns=plan.has_pns, has_na_pref=plan.has_na_pref,
             port_selfblock=plan.port_selfblock,
-            has_aux=plan.has_aux))  # [P, 2, B]
+            has_aux=plan.has_aux,
+            spread_overrides=self._placement_spread_overrides(
+                plan, placements, index)))  # [P, 2, B]
         self.placement_device_evals += 1
 
         node_names = [ni.name for ni in self.snapshot.node_info_list]
@@ -793,10 +856,19 @@ class TPUScheduler(Scheduler):
             # and statics are identical to the live nominated plan.
             import dataclasses
             import jax.numpy as jnp
-            nf = plan.features._replace(
-                nom_req=jnp.zeros((self.mirror.np_cap, self.mirror.r_slots),
-                                  jnp.int64),
-                nom_pods=jnp.zeros(self.mirror.np_cap, jnp.int32))
+            nom_req = jnp.zeros((self.mirror.np_cap, self.mirror.r_slots),
+                                jnp.int64)
+            nom_pods = jnp.zeros(self.mirror.np_cap, jnp.int32)
+            if self.mesh is not None:
+                # Match the live dispatch's committed shardings (jit keys on
+                # them): shard_features puts nom arrays on the node axis.
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                nom_req = jax.device_put(
+                    nom_req, NamedSharding(self.mesh, P("nodes", None)))
+                nom_pods = jax.device_put(
+                    nom_pods, NamedSharding(self.mesh, P("nodes")))
+            nf = plan.features._replace(nom_req=nom_req, nom_pods=nom_pods)
             nv = dataclasses.replace(plan, features=nf, has_nom=True)
             r1, c1 = self._dispatch(state, nv, 0, None)
             r2, _ = self._dispatch(state, nv, 0, c1)
@@ -821,11 +893,24 @@ class TPUScheduler(Scheduler):
             return
         p_pad = _pow2_pad(max(1, n_placements))
         masks = jnp.zeros((p_pad, self.mirror.np_cap), bool)
+        overrides = None
+        f = plan.features
+        if f.dns_axis.shape[0] or f.sa_axis.shape[0]:
+            # Warm the spread-override trace with empty tables of the live
+            # shapes (pad lanes are inert at n_active=0).
+            overrides = (
+                jnp.zeros((p_pad, f.dns_axis.shape[0], plan.vmax), jnp.int32),
+                jnp.zeros((p_pad, f.dns_axis.shape[0], plan.vmax), bool),
+                jnp.ones((p_pad, f.dns_axis.shape[0]), jnp.int32),
+                jnp.zeros((p_pad, f.sa_axis.shape[0], plan.vmax), jnp.int32),
+                jnp.zeros((p_pad, f.sa_axis.shape[0]), jnp.int64),
+            )
         res = schedule_placements(
             state, plan.features, plan.batch_pad, plan.fit_strategy,
             plan.vmax, masks, n_active=np.int32(0),
             has_pns=plan.has_pns, has_na_pref=plan.has_na_pref,
-            port_selfblock=plan.port_selfblock, has_aux=plan.has_aux)
+            port_selfblock=plan.port_selfblock, has_aux=plan.has_aux,
+            spread_overrides=overrides)
         np.asarray(res)
 
     def _dispatch(self, state, plan, n_active: int, carry):

@@ -452,6 +452,14 @@ def build_batch(
     req = pod.resource_request()
     for name in req.scalar_resources:
         mirror.scalar_slot(name)
+    # Nominated pods' scalar slots intern HERE, before the re-sync point —
+    # a grow later would orphan every feature vector already built at the
+    # old r_slots width.
+    nom_reqs = [(row, npi.pod.resource_request())
+                for row, npi in (nominated or ())]
+    for _row, r in nom_reqs:
+        for name in r.scalar_resources:
+            mirror.scalar_slot(name)
     if fit_plugin is not None:
         specs = fit_plugin.resources
         strategy = {"LeastAllocated": 0, "MostAllocated": 1}[fit_plugin.scoring_strategy]
@@ -839,12 +847,13 @@ def build_batch(
     to_find = num_feasible_nodes_to_find(n, percentage_of_nodes_to_score)
 
     # ---- nominated-pod lane (two-pass filter pass 1, resources only) -----
+    # (scalar slots were interned at the top of build_batch, before re-sync)
     has_nom = bool(nominated)
     if has_nom:
         nom_req = np.zeros((npc, mirror.r_slots), i64)
         nom_pods = np.zeros(npc, i32)
-        for row, npi in nominated:
-            nom_req[row] += _resource_vec(mirror, npi.pod.resource_request())
+        for row, r in nom_reqs:
+            nom_req[row] += _resource_vec(mirror, r)
             nom_pods[row] += 1
     else:
         nom_req = np.zeros((0, mirror.r_slots), i64)
@@ -941,6 +950,13 @@ def build_batch(
         port_selfblock=port_selfblock,
         has_aux=has_aux_flag or bool(aux_driver and aux_inc_n),
         has_nom=has_nom,
+        dns_node_counts=dns_node_counts,
+        dns_node_elig=dns_node_elig,
+        dns_min_domains=dns_min_domains,
+        sa_node_counts=sa_node_counts,
+        sa_node_live=sa_node_live,
+        sa_hostname_axis=sa_hostname_axis,
+        sa_max_skew=sa_max_skew_l,
     )
 
 

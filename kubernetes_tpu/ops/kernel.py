@@ -573,6 +573,7 @@ def schedule_placements(
     has_na_pref: bool = False,
     port_selfblock: bool = False,
     has_aux: bool = False,
+    spread_overrides: Optional[Tuple] = None,
 ) -> jnp.ndarray:
     """Evaluate a pod group against P candidate placements IN PARALLEL — the
     device form of podGroupSchedulingPlacementAlgorithm's per-placement
@@ -586,16 +587,18 @@ def schedule_placements(
     Placement simulations evaluate their whole candidate (no adaptive
     truncation) from rotation origin 0 — the host oracle uses the identical
     spec (core/scheduler.py _evaluate_placement), making host and device
-    placement evaluation bit-identical for restriction-invariant plans
-    (no topology-spread / inter-pod-affinity / image terms; see
-    models/tpu_scheduler.py _placement_plan_restriction_invariant)."""
+    placement evaluation bit-identical for eligible plans (no
+    inter-pod-affinity / image terms; see models/tpu_scheduler.py
+    _placement_plan_restriction_invariant).
 
-    def one(mask):
-        f2 = f._replace(
-            extra_ok=f.extra_ok & mask,
-            start_index=jnp.int32(0),
-            to_find=f.num_nodes,
-        )
+    `spread_overrides` lifts the no-topology-spread restriction: the host
+    oracle computes its PreFilter spread state over the placement-RESTRICTED
+    node list (cache.py assume_placement), so each lane gets its own
+    restricted count tables — a (dns_counts [P,C1,V], dns_dom [P,C1,V],
+    dns_forced0 [P,C1], sa_counts [P,C2,V], sa_wq [P,C2]) tuple built by
+    models/tpu_scheduler.py _placement_spread_overrides."""
+
+    def run_lane(f2):
         results, _carry = schedule_batch.__wrapped__(
             state, f2, batch_pad, fit_strategy, vmax,
             n_active=n_active, carry_in=None,
@@ -604,7 +607,26 @@ def schedule_placements(
             has_aux=has_aux)
         return results
 
-    return jax.vmap(one)(masks)
+    if spread_overrides is None:
+        def one(mask):
+            return run_lane(f._replace(
+                extra_ok=f.extra_ok & mask,
+                start_index=jnp.int32(0),
+                to_find=f.num_nodes,
+            ))
+
+        return jax.vmap(one)(masks)
+
+    def one_sp(mask, dns_counts, dns_dom, dns_forced0, sa_counts, sa_wq):
+        return run_lane(f._replace(
+            extra_ok=f.extra_ok & mask,
+            start_index=jnp.int32(0),
+            to_find=f.num_nodes,
+            dns_counts=dns_counts, dns_dom=dns_dom, dns_forced0=dns_forced0,
+            sa_counts=sa_counts, sa_wq=sa_wq,
+        ))
+
+    return jax.vmap(one_sp)(masks, *spread_overrides)
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -645,9 +667,13 @@ def dry_run_preemption(
     cnt0 = state.pod_count - n_pot
 
     def fit(req_r, pod_cnt):
-        pods_ok = (pod_cnt + 1).astype(jnp.int64) <= state.alloc_pods
-        viol = ((f.request > 0) & (f.request > state.alloc_r - req_r)).any(axis=-1)
-        return (pods_ok & (~viol | (f.has_request == 0))) | (f.enable[4] == 0)
+        # The scheduling kernel's exact fit filter; scores are dead code
+        # under jit (XLA eliminates them). No nominated lane: the host dry
+        # run ignores nominations too (run_filter_plugins, not two-pass).
+        ok, _sc, _ba = _resource_eval(
+            f, 0, state.alloc_r, state.alloc_pods, req_r,
+            jnp.zeros_like(req_r[..., :2]), pod_cnt)
+        return ok
 
     feasible0 = static_ok & fit(base_req, cnt0) & (n_pot > 0)
 

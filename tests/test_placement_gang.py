@@ -210,3 +210,108 @@ def test_pod_group_state_store_tracks_bound_members():
     cs.delete_pod(pods[0])
     assert store.count("default", "g") == 1
     assert store.generation > gen
+
+
+class TestDevicePlacementSpread:
+    """Placement gangs whose MEMBERS carry topology-spread constraints ride
+    the stacked device evaluation (round-4 VERDICT item 4): the restricted
+    spread tables are rebuilt per placement (spread_overrides), matching the
+    host oracle's assume_placement-restricted PreFilter state."""
+
+    HOSTNAME = "kubernetes.io/hostname"
+
+    def _cluster(self, cs, zones=3, per_zone=4, cpu=8):
+        for i in range(zones * per_zone):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": cpu, "memory": "32Gi",
+                                      "pods": 110})
+                           .zone(f"z{i % zones}").obj())
+
+    def _spread_gang(self, cs, name, size, max_skew=1, key=None):
+        cs.create_pod_group(PodGroup(
+            name=name, min_count=size, topology_keys=(ZONE,)))
+        pods = []
+        for i in range(size):
+            p = (make_pod().name(f"{name}-{i}").req({"cpu": "1"})
+                 .labels({"gang": name})
+                 .spread_constraint(max_skew, key or self.HOSTNAME,
+                                    "DoNotSchedule", {"gang": name})
+                 .obj())
+            p.pod_group = name
+            cs.create_pod(p)
+            pods.append(p)
+        return pods
+
+    def _pair(self, fn):
+        from kubernetes_tpu.models.tpu_scheduler import TPUScheduler
+        out = []
+        for cls in (Scheduler, TPUScheduler):
+            cs = FakeClientset()
+            kw = {"deterministic_ties": True} if cls is Scheduler else {}
+            s = cls(clientset=cs, profile_factory=gang_placement_profiles,
+                    **kw)
+            fn(cs, s)
+            s.run_until_idle()
+            out.append((cs, s))
+        return out
+
+    def test_hostname_spread_members_match_host(self):
+        """maxSkew=1 over hostname forces one member per node INSIDE the
+        chosen zone — the placement-restricted domain set."""
+        def fn(cs, s):
+            self._cluster(cs)
+            self._spread_gang(cs, "train", 4)
+
+        (cs_h, host), (cs_d, dev) = self._pair(fn)
+        h = {p.name: p.node_name for p in cs_h.pods.values()}
+        d = {p.name: p.node_name for p in cs_d.pods.values()}
+        assert h == d, {k: (h[k], d.get(k)) for k in h if h[k] != d.get(k)}
+        assert all(h.values())
+        # spread satisfied: 4 distinct nodes, one zone
+        assert len(set(h.values())) == 4
+        assert len(_zones_of(cs_h, list(cs_h.pods.values()))) == 1
+        assert dev.placement_device_evals > 0, "device placement path off"
+
+    def test_skew_infeasible_domain_rejected(self):
+        """A zone with too few nodes for the skew constraint must lose to a
+        bigger zone — the restricted domain count decides feasibility."""
+        def fn(cs, s):
+            # z0: 2 nodes, z1: 4 nodes; gang of 4 with hostname skew 1 only
+            # fits in z1.
+            for i in range(2):
+                cs.create_node(make_node().name(f"s{i}")
+                               .capacity({"cpu": 8, "pods": 110})
+                               .zone("z0").obj())
+            for i in range(4):
+                cs.create_node(make_node().name(f"b{i}")
+                               .capacity({"cpu": 8, "pods": 110})
+                               .zone("z1").obj())
+            self._spread_gang(cs, "train", 4)
+
+        (cs_h, host), (cs_d, dev) = self._pair(fn)
+        h = {p.name: p.node_name for p in cs_h.pods.values()}
+        d = {p.name: p.node_name for p in cs_d.pods.values()}
+        assert h == d
+        assert all(v.startswith("b") for v in h.values()), h
+        assert dev.placement_device_evals > 0
+
+    def test_fuzz_spread_gangs(self):
+        import random
+        for seed in range(4):
+            def fn(cs, s, seed=seed):
+                rng = random.Random(seed)
+                zones = rng.choice([2, 3, 4])
+                per = rng.choice([3, 4, 5])
+                self._cluster(cs, zones=zones, per_zone=per,
+                              cpu=rng.choice([4, 8]))
+                for g in range(3):
+                    self._spread_gang(
+                        cs, f"g{g}", rng.choice([2, 3]),
+                        max_skew=rng.choice([1, 2]),
+                        key=rng.choice([self.HOSTNAME, ZONE]))
+
+            (cs_h, host), (cs_d, dev) = self._pair(fn)
+            h = {p.name: p.node_name for p in cs_h.pods.values()}
+            d = {p.name: p.node_name for p in cs_d.pods.values()}
+            assert h == d, (seed, {k: (h[k], d.get(k))
+                                   for k in h if h[k] != d.get(k)})
