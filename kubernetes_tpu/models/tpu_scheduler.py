@@ -214,9 +214,17 @@ class TPUScheduler(Scheduler):
     # groups atomically (any member infeasible ⇒ that group reverts to the
     # exact host cycle for diagnosis/PostFilter and the session invalidates).
 
-    def _gang_device_eligible(self, qgpi: QueuedPodGroupInfo):
+    def _gang_device_eligible(self, qgpi: QueuedPodGroupInfo,
+                              session_claims=None, session_aux_shape=None):
         """Returns (fw, sig) when the whole group can ride a device session:
-        default algorithm, identical batch-supported members, one signature."""
+        default algorithm, identical batch-supported members, one signature.
+        PVC-carrying members are eligible when every member shares ONE
+        counted-constraint shape (the plan's aux math models one driver/inc)
+        and the members' claims are pairwise distinct and unseen by the
+        session (the kernel counts attach units per LANDING; a shared claim
+        would double-count what the host counts once per distinct claim).
+        DRA resource claims stay on the host group cycle: their commit needs
+        a per-member device allocation that can fail mid-group."""
         if not qgpi.members or len(qgpi.members) > self.max_batch:
             return None, None
         if not self.device_enabled or self.queue.nominator.has_nominated_pods():
@@ -235,18 +243,24 @@ class TPUScheduler(Scheduler):
         sig = fw.sign_pod(p0)
         if sig is None:
             return None, None
+        aux_shape = self._aux_shape(p0)
+        if session_aux_shape is not None and aux_shape != session_aux_shape:
+            return None, None  # the live session's plan models one aux shape
+        group_claims: set = set()
         for m in qgpi.members:
             if (m.pod.scheduler_name != p0.scheduler_name
                     or fw.sign_pod(m.pod) != sig
                     or self._batch_supported_memo(m.pod, fw) is not None
                     or self._device_unsupported_profile(fw, m.pod) is not None
-                    # PVC/DRA-claimed members stay on the host group cycle:
-                    # the gang session has no per-member claim-dedup seam and
-                    # commits with a fresh CycleState (their stateful
-                    # Reserve/PreBind would silently no-op).
-                    or any(v.pvc_name for v in m.pod.volumes)
                     or getattr(m.pod, "resource_claims", None)):
                 return None, None
+            if self._aux_shape(m.pod) != aux_shape:
+                return None, None
+            for c in self._claims_of(m.pod):
+                if c in group_claims or (session_claims is not None
+                                         and c in session_claims):
+                    return None, None  # shared claim: host counts it once
+                group_claims.add(c)
         return fw, sig
 
     def _sorted_members(self, qgpi: QueuedPodGroupInfo) -> List[QueuedPodInfo]:
@@ -255,7 +269,13 @@ class TPUScheduler(Scheduler):
 
     def run_gang_device_session(self, fw: Framework, first: QueuedPodGroupInfo) -> None:
         sig = fw.sign_pod(first.members[0].pod)
-        aux_shape = (None, None)  # gang-eligible members carry no claims
+        aux_shape = self._aux_shape(first.members[0].pod)
+        # Claims already accepted into this session (all members' PVCs):
+        # collect_pack rejects groups re-using any of them — the kernel's
+        # per-landing attach count assumes distinct claims, like the host's
+        # distinct-claim NodeVolumeLimits count.
+        self._session_claims = {
+            c for m in first.members for c in self._claims_of(m.pod)}
         claims_rv = getattr(self.clientset, "resource_claims_rv", 0)
         carry = None
         resume = self._resume
@@ -288,11 +308,16 @@ class TPUScheduler(Scheduler):
                 if nxt is None:
                     break
                 if isinstance(nxt, QueuedPodGroupInfo):
-                    gfw, gsig = self._gang_device_eligible(nxt)
+                    gfw, gsig = self._gang_device_eligible(
+                        nxt, session_claims=self._session_claims,
+                        session_aux_shape=aux_shape)
                     if (gfw is fw and gsig == sig
                             and total + len(nxt.members) <= self.max_batch):
                         groups.append(nxt)
                         total += len(nxt.members)
+                        self._session_claims.update(
+                            c for m in nxt.members
+                            for c in self._claims_of(m.pod))
                         continue
                 self._holdover = nxt
                 break
@@ -445,7 +470,6 @@ class TPUScheduler(Scheduler):
         if c1p == 0 and c2p == 0:
             return None
         import math
-        npc = self.mirror.np_cap
         vmax = plan.vmax
         p_pad = _pow2_pad(len(placements))
         n = len(self.snapshot.node_info_list)
@@ -592,10 +616,12 @@ class TPUScheduler(Scheduler):
             if not placed or not fw.run_placement_feasible_plugins(
                     pg_state, group, progress).is_success():
                 continue
-            # Device-eligible members carry no stateful-plugin simulation
-            # data (no volumes/claims — batch_supported excludes them), so a
-            # fresh CycleState is exactly what the host simulation would
-            # have produced for them.
+            # Placement-eligible members carry no stateful-plugin simulation
+            # data — the explicit volume/claim gate above keeps PVC members
+            # off this path (batch_supported itself ACCEPTS bound-PVC pods;
+            # do not remove that gate without establishing fresh-CycleState
+            # parity for the placement commit) — so a fresh CycleState is
+            # exactly what the host simulation would have produced for them.
             assignment = {m.pod.uid: (node_names[r], CycleState())
                           for m, r in placed}
             pga = PodGroupAssignments(
