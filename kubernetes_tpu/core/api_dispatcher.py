@@ -44,9 +44,10 @@ class APICall:
 
 
 class APIDispatcher:
-    def __init__(self, mode: str = "inline"):
+    def __init__(self, mode: str = "inline", metrics=None):
         assert mode in ("inline", "thread")
         self.mode = mode
+        self.metrics = metrics  # SchedulerMetrics (async_api_call_* series)
         self._pending: Dict[Tuple[str, str], APICall] = {}
         self._order: List[Tuple[str, str]] = []
         self._lock = threading.Lock()
@@ -91,11 +92,23 @@ class APIDispatcher:
             self._cv.notify_all()
 
     def _execute(self, call: APICall, defer_errors: bool = False) -> None:
+        import time as _time
+        _t0 = _time.perf_counter()
         try:
             call.execute()
             self.executed += 1
+            if self.metrics is not None:
+                self.metrics.async_api_call_execution_total.inc(
+                    call.call_type, "success")
+                self.metrics.async_api_call_execution_duration.observe(
+                    _time.perf_counter() - _t0, call.call_type, "success")
         except Exception as e:  # noqa: BLE001
             self.errors.append(f"{call.call_type}/{call.object_uid}: {e!r}")
+            if self.metrics is not None:
+                self.metrics.async_api_call_execution_total.inc(
+                    call.call_type, "error")
+                self.metrics.async_api_call_execution_duration.observe(
+                    _time.perf_counter() - _t0, call.call_type, "error")
             if call.on_error is None:
                 return
             if defer_errors:

@@ -208,11 +208,129 @@ class SchedulerMetrics:
             "(metrics.RecordGeneratedPlacements).",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128)))
         self.goroutines = r(Gauge(
-            "scheduler_device_dispatches_active",
-            "In-flight device dispatches (Parallelizer-goroutines analogue).",
-            ()))
+            "scheduler_goroutines",
+            "In-flight concurrent work by kind (metrics.go Goroutines); the "
+            "TPU build's analogue counts in-flight device dispatches.",
+            ("work",)))
         self.cache_size = r(Gauge(
-            "scheduler_scheduler_cache_size", "Cache object counts.", ("type",)))
+            "scheduler_cache_size", "Cache object counts.", ("type",)))
+        # ---- full reference-series parity (metrics.go:265-615) ------------
+        self.pod_scheduling_attempts = r(Histogram(
+            "scheduler_pod_scheduling_attempts",
+            "Number of attempts to successfully schedule a pod.",
+            buckets=(1, 2, 4, 8, 16)))
+        self.scheduling_algorithm_duration = r(Histogram(
+            "scheduler_scheduling_algorithm_duration_seconds",
+            "Scheduling algorithm latency (filter+score, no binding)."))
+        self.event_handling_duration = r(Histogram(
+            "scheduler_event_handling_duration_seconds",
+            "Event handling latency by event kind.", ("event",)))
+        self.inflight_events = r(Gauge(
+            "scheduler_inflight_events",
+            "Entries in the in-flight event log.", (), fn=None))
+        self.queued_entities = r(Gauge(
+            "scheduler_queued_entities",
+            "Queued entities by kind (pod/podgroup/composite).", ("kind",)))
+        self.unschedulable_pods = r(Gauge(
+            "scheduler_unschedulable_pods",
+            "Pods in the unschedulable store, by plugin that rejected them.",
+            ("plugin",)))
+        self.queue_incoming_entities = r(Counter(
+            "scheduler_queue_incoming_entities_total",
+            "Group/composite entities added to queues by event.",
+            ("queue", "event")))
+        self.permit_wait_duration = r(Histogram(
+            "scheduler_permit_wait_duration_seconds",
+            "Time pods spend waiting on Permit.", ("result",)))
+        self.queueing_hint_execution_duration = r(Histogram(
+            "scheduler_queueing_hint_execution_duration_seconds",
+            "QueueingHintFn execution latency.", ("plugin", "event")))
+        self.plugin_evaluation_total = r(Counter(
+            "scheduler_plugin_evaluation_total",
+            "Plugin evaluations by plugin/extension point/profile.",
+            ("plugin", "extension_point", "profile")))
+        # async API dispatcher (backend/api_dispatcher metrics)
+        self.async_api_call_execution_total = r(Counter(
+            "scheduler_async_api_call_execution_total",
+            "Async API calls executed, by call type and result.",
+            ("call_type", "result")))
+        self.async_api_call_execution_duration = r(Histogram(
+            "scheduler_async_api_call_execution_duration_seconds",
+            "Async API call execution latency.", ("call_type", "result")))
+        self.pending_async_api_calls = r(Gauge(
+            "scheduler_pending_async_api_calls",
+            "Queued async API calls not yet executed.", ()))
+        # opportunistic batching (runtime/batch.go series), generalized to
+        # device sessions: a "flush" is a session invalidation.
+        self.batch_cache_flushed = r(Counter(
+            "scheduler_batch_cache_flushed_total",
+            "Batch/session state flushes (session invalidations), by reason.",
+            ("reason",)))
+        self.pod_scheduled_after_flush = r(Counter(
+            "scheduler_pod_scheduled_after_flush_total",
+            "Pods scheduled in the first batch after a flush.", ()))
+        self.get_node_hint_duration = r(Histogram(
+            "scheduler_get_node_hint_duration_seconds",
+            "Batch reuse lookup latency (session-resume check)."))
+        # placement / pod-group series
+        self.generated_placements_total = r(Counter(
+            "scheduler_generated_placements_total",
+            "Candidate placements generated.", ()))
+        self.placement_evaluations = r(Counter(
+            "scheduler_placement_evaluations_total",
+            "Candidate placement evaluations, by backend.", ("backend",)))
+        self.placement_evaluation_duration = r(Histogram(
+            "scheduler_placement_evaluation_duration_seconds",
+            "Latency of evaluating ALL candidate placements for a group."))
+        self.podgroup_scheduling_algorithm_duration = r(Histogram(
+            "scheduler_podgroup_scheduling_algorithm_duration_seconds",
+            "Pod-group scheduling algorithm latency."))
+        self.podgroup_scheduling_attempt_duration = r(Histogram(
+            "scheduler_podgroup_scheduling_attempt_duration_seconds",
+            "Pod-group scheduling attempt latency incl. commit.",
+            ("result",)))
+        self.store_schedule_results_duration = r(Histogram(
+            "scheduler_store_schedule_results_duration_seconds",
+            "Latency of persisting scheduling results to the pod-group "
+            "state store."))
+        # preemption depth series
+        self.preemption_evaluation_duration = r(Histogram(
+            "scheduler_preemption_evaluation_duration_seconds",
+            "Preemption candidate evaluation (dry run) latency."))
+        self.preemption_execution_duration = r(Histogram(
+            "scheduler_preemption_execution_duration_seconds",
+            "Preemption execution (victim preparation) latency."))
+        self.preemption_goroutines_duration = r(Histogram(
+            "scheduler_preemption_goroutines_duration_seconds",
+            "Async victim-deletion work latency (executor.go analogue)."))
+        self.preemption_goroutines_execution_total = r(Counter(
+            "scheduler_preemption_goroutines_execution_total",
+            "Async victim-deletion executions, by result.", ("result",)))
+        self.preemption_pdb_violations = r(Counter(
+            "scheduler_preemption_pdb_violations_total",
+            "Victims selected despite PDB violation (no PDB API yet: "
+            "registered for parity, always 0).", ()))
+        self.preemption_workload_disruptions = r(Counter(
+            "scheduler_preemption_workload_disruptions",
+            "Workloads disrupted by pod-group preemption.", ()))
+        self.workload_preemption_attempts = r(Counter(
+            "scheduler_workload_preemption_attempts_total",
+            "Pod-group (workload) preemption attempts, by result.",
+            ("result",)))
+        self.workload_preemption_victims = r(Histogram(
+            "scheduler_workload_preemption_victims",
+            "Victims per pod-group preemption.",
+            buckets=(1, 2, 4, 8, 16, 32, 64)))
+        # DRA binding conditions (dra_bindingconditions_*): the binding-
+        # conditions protocol is not implemented (allocation is synchronous
+        # in-cycle), registered for name parity and future wiring.
+        self.dra_bindingconditions_allocations = r(Counter(
+            "scheduler_dra_bindingconditions_allocations_total",
+            "DRA allocations carrying binding conditions (not implemented: "
+            "allocation is synchronous; always 0).", ("result",)))
+        self.dra_bindingconditions_wait_duration = r(Histogram(
+            "scheduler_dra_bindingconditions_wait_duration_seconds",
+            "Wait for DRA binding conditions (not implemented; empty)."))
 
     def expose(self) -> str:
         return self.registry.expose()

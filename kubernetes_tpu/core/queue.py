@@ -390,6 +390,7 @@ class PriorityQueue:
         composite_enabled: bool = False,
     ):
         self.framework = framework
+        self.metrics = None  # optional SchedulerMetrics (hint latency series)
         self.queueing_hints_enabled = queueing_hints_enabled
         self.composite_enabled = composite_enabled
         self.forest = WorkloadForest(composite_enabled)
@@ -535,6 +536,8 @@ class PriorityQueue:
         ent = QueuedPodGroupInfo(
             group=group, members=list(members), timestamp=self.now())
         self.active_q.push(ent)
+        if self.metrics is not None:
+            self.metrics.queue_incoming_entities.inc("active", "GroupComplete")
 
     def _maybe_activate_composite(self, cpg) -> None:
         leaves = self.forest.leaf_groups(cpg)
@@ -556,6 +559,8 @@ class PriorityQueue:
             return
         self.active_q.push(QueuedCompositeGroupInfo(
             cpg=cpg, groups=groups, timestamp=self.now()))
+        if self.metrics is not None:
+            self.metrics.queue_incoming_entities.inc("active", "TreeComplete")
 
     def remove_group_member(self, pod: Pod) -> None:
         key = (pod.namespace, pod.pod_group)
@@ -744,11 +749,17 @@ class PriorityQueue:
                 for fn in fns:
                     if fn is None:
                         return True  # no hint fn: always Queue
+                    _m = self.metrics
+                    _t0 = time.perf_counter() if _m is not None else 0.0
                     try:
-                        if fn(pod, old, new):
-                            return True
+                        queue_it = bool(fn(pod, old, new))
                     except Exception:  # noqa: BLE001 - hint errors → Queue
-                        return True   # (the reference logs and queues)
+                        queue_it = True  # (the reference logs and queues)
+                    if _m is not None:
+                        _m.queueing_hint_execution_duration.observe(
+                            time.perf_counter() - _t0, p, event)
+                    if queue_it:
+                        return True
         return False
 
     def _move_to_active_or_backoff(self, qpi) -> None:

@@ -255,6 +255,7 @@ class Scheduler:
             queueing_hints_enabled=self.gates.enabled(SCHEDULER_QUEUEING_HINTS),
             composite_enabled=self.gates.enabled(COMPOSITE_POD_GROUP),
         )
+        self.queue.metrics = self.metrics  # queueing-hint latency series
         # Extenders (extender.go; config extenders or injected objects).
         from .extender import Extender, http_transport
         self.extenders: List[Extender] = []
@@ -281,7 +282,14 @@ class Scheduler:
         if self.gates.enabled(SCHEDULER_ASYNC_API_CALLS) and getattr(
                 self.config, "async_dispatch_threads", False):
             mode = "thread"
-        self.api_dispatcher = APIDispatcher(mode=mode)
+        self.api_dispatcher = APIDispatcher(mode=mode, metrics=self.metrics)
+        # Callback gauges (free until exposed): queue/dispatcher depth series.
+        self.metrics.inflight_events._fn = lambda: {
+            (): float(len(self.queue._event_log))}
+        self.metrics.pending_async_api_calls._fn = lambda: {
+            (): float(self.api_dispatcher.pending_count())}
+        self.metrics.queued_entities._fn = self._queued_entity_counts
+        self.metrics.unschedulable_pods._fn = self._unschedulable_by_plugin
         # Waiting pods (Permit WAIT; framework.go waitingPods registry).
         # _next_wait_deadline makes expiry TIMER-DRIVEN: schedule_one checks
         # it every cycle (O(1)), so a parked pod times out even while the
@@ -322,11 +330,27 @@ class Scheduler:
     # -- event handlers (eventhandlers.go:624 addAllEventHandlers) ---------
 
     def _wire_event_handlers(self) -> None:
-        self.clientset.on_pod_event(self._threaded(self._on_pod_event))
-        self.clientset.on_node_event(self._threaded(self._on_node_event))
+        self.clientset.on_pod_event(self._threaded(
+            self._timed_event("pod", self._on_pod_event)))
+        self.clientset.on_node_event(self._threaded(
+            self._timed_event("node", self._on_node_event)))
         self.clientset.on_namespace_event(self._threaded(self._bump(self.cache.add_namespace)))
         self.clientset.on_pod_group_event(self._threaded(self._bump(self.queue.register_pod_group)))
-        self.clientset.on_storage_event(self._threaded(self._on_storage_event))
+        self.clientset.on_storage_event(self._threaded(
+            self._timed_event("storage", self._on_storage_event)))
+
+    def _timed_event(self, name: str, handler):
+        """event_handling_duration_seconds per handler invocation
+        (eventhandlers.go handler latency series)."""
+        hist = self.metrics.event_handling_duration
+
+        def h(*args):
+            t0 = time.perf_counter()
+            try:
+                handler(*args)
+            finally:
+                hist.observe(time.perf_counter() - t0, name)
+        return h
 
     def _bump(self, handler):
         """Wrap a handler so it versions cluster_event_seq (namespace labels
@@ -501,7 +525,14 @@ class Scheduler:
             self.schedule_composite_group(qpi)
             return
         if isinstance(qpi, QueuedPodGroupInfo):
+            _t_pg = time.perf_counter()
+            _before = self.metrics.podgroup_schedule_attempts.value("scheduled")
             self.schedule_pod_group(qpi)
+            dt = time.perf_counter() - _t_pg
+            self.metrics.podgroup_scheduling_algorithm_duration.observe(dt)
+            self.metrics.podgroup_scheduling_attempt_duration.observe(
+                dt, "scheduled" if self.metrics.podgroup_schedule_attempts.value(
+                    "scheduled") > _before else "unschedulable")
             return
         pod = qpi.pod
         if pod.deletion_ts is not None:
@@ -554,6 +585,8 @@ class Scheduler:
         if bound and qpi.initial_attempt_timestamp is not None:
             self.metrics.pod_scheduling_sli_duration.observe(
                 self.now() - qpi.initial_attempt_timestamp, str(qpi.attempts))
+        if bound:
+            self.metrics.pod_scheduling_attempts.observe(max(1, qpi.attempts))
 
     def handle_fit_error(self, fw: Framework, state: CycleState,
                          qpi: QueuedPodInfo, fe: FitError, t0: float) -> None:
@@ -581,7 +614,10 @@ class Scheduler:
     def scheduling_cycle(self, fw: Framework, state: CycleState, qpi: QueuedPodInfo) -> ScheduleResult:
         pod = qpi.pod
         self.cache.update_snapshot(self.snapshot)
+        _t_alg = time.perf_counter()
         result = self.schedule_pod(fw, state, pod)
+        self.metrics.scheduling_algorithm_duration.observe(
+            time.perf_counter() - _t_alg)
         # assume (schedule_one.go:1060): in-memory commit before binding
         assumed = pod
         assumed.node_name = result.suggested_host
@@ -673,9 +709,12 @@ class Scheduler:
             self.cache.assume_pod(m.pod)
             if self._commit_group_member(fw, m, state, result):
                 committed += 1
+        _t_store = time.perf_counter()
         group_key = (qgpi.group.namespace, qgpi.group.name)
         self.queue.clear_group_members(group_key, attempted_uids)
         self.queue.done(qgpi.uid)
+        self.metrics.store_schedule_results_duration.observe(
+            time.perf_counter() - _t_store)
         self.metrics.podgroup_schedule_attempts.inc(
             "scheduled" if committed else "unschedulable")
 
@@ -775,6 +814,7 @@ class Scheduler:
             self._fail_pod_group(fw, qgpi, members, None)
             return False
         self.metrics.generated_placements.observe(len(placements))
+        self.metrics.generated_placements_total.inc(value=len(placements))
 
         start_save = self.next_start_node_index
         candidates = self._evaluate_placements(
@@ -832,6 +872,8 @@ class Scheduler:
         (ops/kernel.py schedule_placements)."""
         from .framework import PodGroupAssignments
 
+        _t_pe = time.perf_counter()
+        self.metrics.placement_evaluations.inc("host", value=len(placements))
         candidates: List[tuple] = []
         for placement in placements:
             assignment = self._evaluate_placement(
@@ -843,6 +885,8 @@ class Scheduler:
                               if m.pod.uid in assignment],
                     nodes=[self.snapshot.get(n) for n in placement.node_names])
                 candidates.append((placement, assignment, pga))
+        self.metrics.placement_evaluation_duration.observe(
+            time.perf_counter() - _t_pe)
         return candidates
 
     def _evaluate_placement(self, fw: Framework, pg_state: CycleState,
@@ -1052,6 +1096,15 @@ class Scheduler:
                 nodes = [ni for ni in all_nodes if ni.name in pre_res.node_names]
         feasible = self.find_nodes_that_pass_filters(fw, state, pod, diagnosis, nodes)
         self._observe_point("Filter", _t)
+        # PluginEvaluationTotal at cycle granularity (one evaluation of each
+        # enabled plugin per scheduling cycle; the reference's per-node inc
+        # would cost a dict write per node per plugin on the hot loop).
+        pet = self.metrics.plugin_evaluation_total
+        for p in fw.pre_filter_plugins:
+            pet.inc(p.name, "PreFilter", fw.profile_name)
+        for p in fw.filter_plugins:
+            if p.name not in state.skip_filter_plugins:
+                pet.inc(p.name, "Filter", fw.profile_name)
         if feasible and self.extenders:
             from .extender import run_extender_filters
             feasible, err = run_extender_filters(self.extenders, pod, feasible, diagnosis)
@@ -1100,6 +1153,9 @@ class Scheduler:
             raise RuntimeError(f"prescore failed: {st.message()}")
         plugin_scores = fw.run_score_plugins(state, pod, nodes)
         self._observe_point("Score", _t)
+        for p, _w in fw.score_plugins:
+            self.metrics.plugin_evaluation_total.inc(
+                p.name, "Score", fw.profile_name)
         total = [NodeScore(ni.name, 0) for ni in nodes]
         for scores in plugin_scores.values():
             for i, ns in enumerate(scores):
@@ -1195,7 +1251,9 @@ class Scheduler:
         entry = self.waiting_pods.pop(uid, None)
         if entry is None:
             return False
-        fw, state, qpi, result, _ = entry
+        fw, state, qpi, result, deadline = entry
+        self.metrics.permit_wait_duration.observe(
+            self.now() - (deadline - self.permit_wait_timeout), "allowed")
         self.run_binding_cycle(fw, state, qpi, result)
         return True
 
@@ -1203,7 +1261,9 @@ class Scheduler:
         entry = self.waiting_pods.pop(uid, None)
         if entry is None:
             return False
-        fw, state, qpi, result, _ = entry
+        fw, state, qpi, result, deadline = entry
+        self.metrics.permit_wait_duration.observe(
+            self.now() - (deadline - self.permit_wait_timeout), "rejected")
         self.state_unwinds += 1
         fw.run_reserve_plugins_unreserve(state, qpi.pod, result.suggested_host)
         self.cache.forget_pod(qpi.pod)
@@ -1229,6 +1289,50 @@ class Scheduler:
             self.reject_waiting_pod(uid, "permit wait timed out")
         self._rearm_wait_deadline()
         return len(expired)
+
+    def _queued_entity_counts(self) -> Dict[tuple, float]:
+        """queued_entities gauge callback: queued entities by kind."""
+        from .queue import QueuedCompositeGroupInfo, QueuedPodGroupInfo
+        counts = {"pod": 0, "podgroup": 0, "composite": 0}
+        try:
+            return self._queued_entity_counts_unsafe(counts)
+        except RuntimeError:
+            # /metrics is scraped from the HTTP thread while the scheduling
+            # loop mutates the queues; a torn iteration yields a stale scrape
+            # rather than a 500.
+            return {(k,): float(v) for k, v in counts.items()}
+
+    def _queued_entity_counts_unsafe(self, counts) -> Dict[tuple, float]:
+        from .queue import QueuedCompositeGroupInfo, QueuedPodGroupInfo
+        for q in (self.queue.active_q, self.queue.backoff_q):
+            for ent in q.items():
+                if isinstance(ent, QueuedCompositeGroupInfo):
+                    counts["composite"] += 1
+                elif isinstance(ent, QueuedPodGroupInfo):
+                    counts["podgroup"] += 1
+                else:
+                    counts["pod"] += 1
+        for ent in self.queue.unschedulable.values():
+            if isinstance(ent, QueuedCompositeGroupInfo):
+                counts["composite"] += 1
+            elif isinstance(ent, QueuedPodGroupInfo):
+                counts["podgroup"] += 1
+            else:
+                counts["pod"] += 1
+        return {(k,): float(v) for k, v in counts.items()}
+
+    def _unschedulable_by_plugin(self) -> Dict[tuple, float]:
+        """unschedulable_pods gauge callback: parked pods by rejecting
+        plugin (metrics.go UnschedulablePods)."""
+        counts: Dict[str, int] = {}
+        try:
+            for ent in list(self.queue.unschedulable.values()):
+                plugins = ent.unschedulable_plugins or {""}
+                for p in plugins:
+                    counts[p] = counts.get(p, 0) + 1
+        except RuntimeError:
+            pass  # concurrent scrape during queue mutation: stale is fine
+        return {(k,): float(v) for k, v in counts.items()}
 
     def update_pending_metrics(self) -> None:
         """Refresh the pending_pods gauges (metrics.go pending_pods)."""

@@ -66,6 +66,9 @@ class SchedulerServer:
                 elif self.path == "/metrics":
                     self._respond(200, server.scheduler.expose_metrics(),
                                   "text/plain; version=0.0.4")
+                elif self.path == "/metrics/resources":
+                    self._respond(200, server.expose_resource_metrics(),
+                                  "text/plain; version=0.0.4")
                 elif self.path == "/debug/cache":
                     self._respond(200, server.debugger.dump())
                 elif self.path == "/debug/comparer":
@@ -86,6 +89,35 @@ class SchedulerServer:
         t.start()
         self.mark_ready()
         return self._httpd.server_address[1]
+
+    def expose_resource_metrics(self) -> str:
+        """/metrics/resources (app/server.go:376-379 →
+        pkg/scheduler/metrics/resources): per-pod resource requests as
+        kube_pod_resource_request series, by namespace/pod/node/phase."""
+        cs = self.scheduler.clientset
+        lines = [
+            "# HELP kube_pod_resource_request Resources requested by "
+            "workloads on the cluster, broken down by pod.",
+            "# TYPE kube_pod_resource_request gauge",
+        ]
+        bindings = getattr(cs, "bindings", {})
+        for pod in cs.pods.values():
+            req = pod.resource_request()
+            node = bindings.get(pod.uid) or pod.node_name
+            phase = "Running" if node else "Pending"
+            for res_name, val in (("cpu", req.milli_cpu / 1000.0),
+                                  ("memory", float(req.memory))):
+                if val:
+                    lines.append(
+                        f'kube_pod_resource_request{{namespace="{pod.namespace}",'
+                        f'pod="{pod.name}",node="{node}",'
+                        f'resource="{res_name}",phase="{phase}"}} {val}')
+            for name, amount in req.scalar_resources.items():
+                lines.append(
+                    f'kube_pod_resource_request{{namespace="{pod.namespace}",'
+                    f'pod="{pod.name}",node="{node}",'
+                    f'resource="{name}",phase="{phase}"}} {float(amount)}')
+        return "\n".join(lines) + "\n"
 
     def shutdown(self) -> None:
         if self._httpd is not None:

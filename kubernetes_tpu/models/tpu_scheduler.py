@@ -339,10 +339,14 @@ class TPUScheduler(Scheduler):
                 self.metrics.batch_attempts.inc("dispatched")
                 self.metrics.batch_size.observe(len(members))
                 inflight.append((pack, results))
+                self.metrics.goroutines.set(float(len(inflight)),
+                                            "device_dispatch")
                 pack = None
             if not inflight:
                 break
             groups, results = inflight.pop(0)
+            self.metrics.goroutines.set(float(len(inflight)),
+                                        "device_dispatch")
             _t0 = _time.perf_counter()
             res = np.asarray(results)
             _t1 = _time.perf_counter()
@@ -384,6 +388,11 @@ class TPUScheduler(Scheduler):
                     start_seq = self.cluster_event_seq
                     start_unwinds = self.state_unwinds
             self.host_commit_s += _time.perf_counter() - _t1
+            if getattr(self, "_after_flush", False):
+                # First retired pack after a flush (pod_scheduled_after_flush
+                # consumption for gang sessions).
+                self.metrics.pod_scheduled_after_flush.inc(value=len(ok_rows))
+                self._after_flush = False
 
         if pack:
             for g in pack:
@@ -394,6 +403,8 @@ class TPUScheduler(Scheduler):
         self.cache.update_snapshot(self.snapshot)
         if invalidated:
             self.mirror.invalidate()
+            self.metrics.batch_cache_flushed.inc("gang_session_invalidated")
+            self._after_flush = True
         else:
             self.mirror.adopt(self.snapshot.node_info_list, ok_rows,
                               carry.req_r, carry.nonzero, carry.pod_count,
@@ -432,9 +443,12 @@ class TPUScheduler(Scheduler):
                 self.device_scheduled += 1
             else:
                 dirty_rows.append(int(r))
+        _t_store = _time.perf_counter()
         group_key = (qgpi.group.namespace, qgpi.group.name)
         self.queue.clear_group_members(group_key, attempted_uids)
         self.queue.done(qgpi.uid)
+        self.metrics.store_schedule_results_duration.observe(
+            _time.perf_counter() - _t_store)
         self.metrics.podgroup_schedule_attempts.inc(
             "scheduled" if committed else "unschedulable")
         return committed == len(members)
@@ -595,6 +609,7 @@ class TPUScheduler(Scheduler):
                         masks[pi, row] = True
             masks_dev = jnp.asarray(masks)
             self._placement_mask_cache = (mkey, masks_dev)
+        _t_pe = _time.perf_counter()
         res = np.asarray(schedule_placements(
             state, plan.features, plan.batch_pad, plan.fit_strategy,
             plan.vmax, masks_dev,
@@ -605,6 +620,10 @@ class TPUScheduler(Scheduler):
             spread_overrides=self._placement_spread_overrides(
                 plan, placements, index)))  # [P, 2, B]
         self.placement_device_evals += 1
+        self.metrics.placement_evaluations.inc(
+            "device", value=len(placements))
+        self.metrics.placement_evaluation_duration.observe(
+            _time.perf_counter() - _t_pe)
 
         node_names = [ni.name for ni in self.snapshot.node_info_list]
         candidates = []
@@ -1111,12 +1130,18 @@ class TPUScheduler(Scheduler):
         carry = None
         resume = self._resume
         self._resume = None
-        if (resume is not None
-                and resume[0] == (id(fw), sig, aux_shape, claims_rv,
-                                  self.cluster_event_seq,
-                                  self.attempts, self.state_unwinds)
-                and resume[2] == self._nom_resume_key(
-                    first_batch[0].pod.priority)):
+        _t_hint = _time.perf_counter()
+        hit = (resume is not None
+               and resume[0] == (id(fw), sig, aux_shape, claims_rv,
+                                 self.cluster_event_seq,
+                                 self.attempts, self.state_unwinds)
+               and resume[2] == self._nom_resume_key(
+                   first_batch[0].pod.priority))
+        # get_node_hint_duration (runtime/batch.go GetNodeHint analogue):
+        # the batch-reuse lookup is the session-resume key check.
+        self.metrics.get_node_hint_duration.observe(
+            _time.perf_counter() - _t_hint)
+        if hit:
             # Nothing happened since the last clean session of this exact
             # signature: the mirror is device-resident, the feature plan is
             # still exact, and the final carry reflects every placement —
@@ -1168,12 +1193,16 @@ class TPUScheduler(Scheduler):
                 self.metrics.batch_attempts.inc("dispatched")
                 self.metrics.batch_size.observe(len(batch))
                 inflight.append((batch, results))
+                self.metrics.goroutines.set(float(len(inflight)),
+                                            "device_dispatch")
                 batch = None
             if not inflight:
                 break
             # Retire the oldest batch: block on its results (the device is
             # already computing the NEXT batch), then run the host tail.
             b, results = inflight.pop(0)
+            self.metrics.goroutines.set(float(len(inflight)),
+                                        "device_dispatch")
             _t0 = _time.perf_counter()
             res = np.asarray(results)  # one device→host fetch
             _t1 = _time.perf_counter()
@@ -1182,6 +1211,12 @@ class TPUScheduler(Scheduler):
                 invalidated = self._commit_batch(
                     b, res, fw, node_names, ok_rows, dirty_rows)
                 self.host_commit_s += _time.perf_counter() - _t1
+                if getattr(self, "_after_flush", False):
+                    # First retired batch after a flush: its pods scheduled
+                    # from a fresh (non-chained) evaluation.
+                    self.metrics.pod_scheduled_after_flush.inc(
+                        value=len(ok_rows))
+                    self._after_flush = False
                 if (self.cluster_event_seq != start_seq
                         or self.state_unwinds != start_unwinds
                         or self.queue.nominator.version != start_nom):
@@ -1209,6 +1244,8 @@ class TPUScheduler(Scheduler):
             # The carry charged host-diverged placements; staging is the
             # authority again — force a full re-encode + upload.
             self.mirror.invalidate()
+            self.metrics.batch_cache_flushed.inc("session_invalidated")
+            self._after_flush = True
         else:
             # Keep the device state resident: the final carry reflects every
             # successful placement, so the next flush uploads nothing.

@@ -223,16 +223,34 @@ class Evaluator:
                 async_ok = gates.enabled("SchedulerAsyncPreemption")
             except ValueError:
                 pass
+        metrics = getattr(self.handle, "metrics", None)
+
+        def _delete(p):
+            # preemption_goroutines_* (executor.go:171 prepareCandidateAsync
+            # analogue): each victim deletion is one unit of async work.
+            import time as _time
+            _t0 = _time.perf_counter()
+            try:
+                cs.delete_pod(p)
+            except Exception:
+                if metrics is not None:
+                    metrics.preemption_goroutines_execution_total.inc("error")
+                raise
+            if metrics is not None:
+                metrics.preemption_goroutines_execution_total.inc("success")
+                metrics.preemption_goroutines_duration.observe(
+                    _time.perf_counter() - _t0)
+
         for pi in cand.victims:
             if dispatcher is not None and async_ok:
                 from ..core.api_dispatcher import APICall, CALL_DELETE
                 dispatcher.add(APICall(
                     call_type=CALL_DELETE, object_uid=pi.pod.uid,
-                    execute=lambda p=pi.pod: cs.delete_pod(p)))
+                    execute=lambda p=pi.pod: _delete(p)))
             else:
                 # SchedulerAsyncPreemption off: victims delete synchronously
                 # inside the scheduling cycle (pre-gate behavior).
-                cs.delete_pod(pi.pod)
+                _delete(pi.pod)
         # Lower-priority pods nominated to this node lose their nomination
         # (preemption.go prepareCandidate → ClearNominatedNodeName).
         nominator = getattr(self.handle, "nominator", None)
@@ -325,7 +343,12 @@ class DefaultPreemption:
         metrics = getattr(self.handle, "metrics", None)
         if metrics is not None:
             metrics.preemption_attempts.inc()
+        import time as _time
+        _t_eval = _time.perf_counter()
         candidates = self.evaluator.find_candidates(state, pod, filtered_status_map)
+        if metrics is not None:
+            metrics.preemption_evaluation_duration.observe(
+                _time.perf_counter() - _t_eval)
         if not candidates:
             return None, Status.unresolvable(
                 "preemption: 0/%d nodes are available" % max(1, snapshot.num_nodes()))
@@ -378,7 +401,14 @@ class DefaultPreemption:
                 best = Candidate(node_name=best.node_name,
                                  victims=verified.victims,
                                  num_pdb_violations=best.num_pdb_violations)
+        _t_exec = _time.perf_counter()
         self.evaluator.prepare_candidate(best, pod)
+        if metrics is not None:
+            metrics.preemption_execution_duration.observe(
+                _time.perf_counter() - _t_exec)
+            if best.num_pdb_violations:
+                metrics.preemption_pdb_violations.inc(
+                    value=best.num_pdb_violations)
         if metrics is not None:
             metrics.preemption_victims.observe(len(best.victims))
         # Success: the scheduler records the nomination and requeues
@@ -394,14 +424,23 @@ class DefaultPreemption:
         if simulate is None or not members:
             return None, Status.unschedulable("pod-group preemption unavailable")
         ev = PodGroupEvaluator(self.handle)
+        metrics = getattr(self.handle, "metrics", None)
         victims, st = ev.preempt(group, members, lambda: simulate(group, members))
         if not st.is_success() or not victims:
+            if metrics is not None:
+                metrics.workload_preemption_attempts.inc("no_victims")
             return None, st if not st.is_success() else Status.unschedulable(
                 "pod-group preemption found no victim set")
-        metrics = getattr(self.handle, "metrics", None)
         if metrics is not None:
             metrics.preemption_attempts.inc()
             metrics.preemption_victims.observe(len(victims))
+            metrics.workload_preemption_attempts.inc("preempted")
+            metrics.workload_preemption_victims.observe(len(victims))
+            disrupted = {(pi.pod.namespace, pi.pod.pod_group)
+                         for pi in victims if pi.pod.pod_group}
+            if disrupted:
+                metrics.preemption_workload_disruptions.inc(
+                    value=len(disrupted))
         cs = self.handle.clientset
         dispatcher = getattr(self.handle, "api_dispatcher", None)
         for pi in victims:
