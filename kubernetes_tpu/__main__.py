@@ -125,8 +125,14 @@ def main(argv=None) -> int:
                 time.sleep(0.02)
     finally:
         server.shutdown()
-    print(f"kubernetes-tpu-scheduler: scheduled={sched.scheduled} "
-          f"failures={sched.failures}", flush=True)
+    try:
+        print(f"kubernetes-tpu-scheduler: scheduled={sched.scheduled} "
+              f"failures={sched.failures}", flush=True)
+    except BrokenPipeError:
+        # Parent closed our stdout: drop the buffered bytes too, or the
+        # interpreter's exit-time flush re-raises outside this guard.
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
     return 0
 
 

@@ -425,6 +425,10 @@ class APIServer:
                     node = node_from_wire(self._body())
                     server.store.create_node(node)
                     return self._json(201, node_to_wire(node))
+                if (self.path.startswith("/api/v1/nodes/")
+                        and self.path.endswith("/status")):
+                    # parity stub (kubelet heartbeat shape); no-op
+                    return self._json(200, {})
                 parts = self.path.split("/")
                 if (self.path.startswith("/api/v1/pods/")
                         and self.path.endswith("/binding")):
@@ -446,12 +450,31 @@ class APIServer:
                     return self._json(200, {})
                 self._json(404, {"error": "not found"})
 
+            def do_PUT(self):
+                if (self.path.startswith("/api/v1/nodes/")
+                        and self.path.endswith("/status")):
+                    return self._json(200, {})  # heartbeat parity stub
+                # Node update (relabel / retaint / capacity change): the
+                # store fans a MODIFIED event to every watch stream, so
+                # churn workloads run over the wire (eventhandlers.go
+                # updateNodeInCache; round-4 VERDICT item 5).
+                if self.path.startswith("/api/v1/nodes/"):
+                    node = node_from_wire(self._body())
+                    if node.name != self.path.split("/")[4]:
+                        return self._json(400, {"error": "name mismatch"})
+                    server.store.update_node(node)
+                    return self._json(200, node_to_wire(node))
+                self._json(404, {"error": "not found"})
+
             def do_DELETE(self):
                 if self.path.startswith("/api/v1/pods/"):
                     uid = self.path.split("/")[4]
                     pod = server.store.pods.get(uid)
                     if pod is not None:
                         server.store.delete_pod(pod)
+                    return self._json(200, {})
+                if self.path.startswith("/api/v1/nodes/"):
+                    server.store.delete_node(self.path.split("/")[4])
                     return self._json(200, {})
                 self._json(404, {"error": "not found"})
 
@@ -536,6 +559,13 @@ class HTTPClientset:
     def create_node(self, node: Node) -> Node:
         self._call("POST", "/api/v1/nodes", node_to_wire(node))
         return node
+
+    def update_node(self, node: Node) -> Node:
+        self._call("PUT", f"/api/v1/nodes/{node.name}", node_to_wire(node))
+        return node
+
+    def delete_node(self, name: str) -> None:
+        self._call("DELETE", f"/api/v1/nodes/{name}")
 
     def delete_pod(self, pod: Pod) -> None:
         self._call("DELETE", f"/api/v1/pods/{pod.uid}")
@@ -716,12 +746,44 @@ class HTTPClientset:
         self._stop.set()
         # Snapshot: reflector threads remove() dead connections concurrently.
         for conn in list(self._responses):
-            try:
-                import socket
-                if conn.sock is not None:
-                    conn.sock.shutdown(socket.SHUT_RDWR)
-                    conn.sock.close()
-            except Exception:  # noqa: BLE001
-                pass
+            _shutdown_conn(conn)
         for t in self._threads:
             t.join(timeout=2)
+
+
+def _shutdown_conn(conn) -> None:
+    try:
+        import socket
+        if conn.sock is not None:
+            conn.sock.shutdown(socket.SHUT_RDWR)
+            conn.sock.close()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def main(argv=None) -> int:
+    """Standalone apiserver process (`python -m kubernetes_tpu.core.apiserver
+    --port N`): serves the REST+watch surface on a real socket until
+    SIGTERM/SIGINT — the other half of the two-OS-process integration seam
+    (ref test/integration/framework/test_server.go:78 StartTestServer)."""
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(prog="kubernetes-tpu-apiserver")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    api = APIServer()
+    port = api.serve(args.port)
+    print(f"kubernetes-tpu-apiserver: serving on 127.0.0.1:{port}",
+          flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    api.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
