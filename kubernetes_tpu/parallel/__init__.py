@@ -13,6 +13,8 @@ TPU-native equivalents here:
   the kernel) — no hand-written communication.
 """
 
-from .mesh import make_mesh, shard_features, shard_node_state, sharded_schedule_batch
+from .mesh import (collective_report, make_mesh, make_multihost_mesh,
+                   shard_features, shard_node_state, sharded_schedule_batch)
 
-__all__ = ["make_mesh", "shard_features", "shard_node_state", "sharded_schedule_batch"]
+__all__ = ["collective_report", "make_mesh", "make_multihost_mesh",
+           "shard_features", "shard_node_state", "sharded_schedule_batch"]

@@ -46,42 +46,115 @@ def make_mesh(
     return Mesh(arr, axis_names=("cells", "nodes"))
 
 
-# PartitionSpecs per DeviceNodeState field (node dim sharded).
-_STATE_SPECS = DeviceNodeState(
-    alloc_r=P("nodes", None), alloc_pods=P("nodes"), req_r=P("nodes", None),
-    nonzero=P("nodes", None), pod_count=P("nodes"),
-    taint_key=P("nodes", None), taint_val=P("nodes", None), taint_eff=P("nodes", None),
-    unsched=P("nodes"), valid=P("nodes"), name_id=P("nodes"),
-    topo=P(None, "nodes"),
-)
+def make_multihost_mesh(
+    n_hosts: int,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Multi-HOST mesh ("dcn", "ici"): the outer axis spans hosts (data-
+    center network), the inner axis a host's chips (ICI). The cluster-state
+    node axis shards over BOTH axes jointly (P(("dcn", "ici"))), so one
+    cluster's node tensors span every chip of every host; GSPMD then
+    decomposes cross-node reductions into an intra-host ICI stage and a
+    cross-host DCN stage — the scaling-book recipe for axes that cross the
+    slice boundary (SURVEY §2.4 row 9's multi-host story). On real
+    multi-host TPU the outer axis must follow the process/host grid
+    (jax.devices() orders by process); virtual CPU devices validate the
+    sharding + collective decomposition without N real hosts."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if n_hosts <= 0 or n % n_hosts != 0:
+        raise ValueError(f"{n} devices not divisible into {n_hosts} hosts")
+    arr = np.array(devs).reshape(n_hosts, n // n_hosts)
+    return Mesh(arr, axis_names=("dcn", "ici"))
 
 
-def _feature_specs() -> BatchFeatures:
-    """Per-node feature arrays shard over "nodes"; the rest replicate."""
+def _node_axis_of(mesh: Mesh):
+    """The spec entry for the cluster-state node dimension on this mesh:
+    "nodes" on a ("cells", "nodes") mesh, the composite ("dcn", "ici") on a
+    multi-host mesh."""
+    return ("dcn", "ici") if "dcn" in mesh.axis_names else "nodes"
+
+
+def _state_specs(axis) -> DeviceNodeState:
+    return DeviceNodeState(
+        alloc_r=P(axis, None), alloc_pods=P(axis), req_r=P(axis, None),
+        nonzero=P(axis, None), pod_count=P(axis),
+        taint_key=P(axis, None), taint_val=P(axis, None), taint_eff=P(axis, None),
+        unsched=P(axis), valid=P(axis), name_id=P(axis),
+        topo=P(None, axis),
+    )
+
+
+def _feature_specs(axis="nodes") -> BatchFeatures:
+    """Per-node feature arrays shard over the node axis; the rest replicate."""
     specs = {name: P() for name in BatchFeatures._fields}
     for per_node in ("exist_anti", "ipa_base", "sel_match", "extra_ok",
                      "il_score", "na_raw", "aux_room", "nom_pods"):
-        specs[per_node] = P("nodes")
-    specs["nom_req"] = P("nodes", None)
+        specs[per_node] = P(axis)
+    specs["nom_req"] = P(axis, None)
     return BatchFeatures(**specs)
 
 
+# Backwards-compatible single-host specs.
+_STATE_SPECS = _state_specs("nodes")
+
+
 def shard_node_state(state: DeviceNodeState, mesh: Mesh) -> DeviceNodeState:
-    """Place a single cell's node state onto the mesh's "nodes" axis."""
+    """Place a cell's node state onto the mesh's node axis (ICI on a
+    single-host mesh; ICI within hosts + DCN across hosts on a multi-host
+    mesh)."""
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        state, _STATE_SPECS)
+        state, _state_specs(_node_axis_of(mesh)))
 
 
 def shard_features(feats: BatchFeatures, mesh: Mesh) -> BatchFeatures:
-    """Place batch features: per-node vectors shard over "nodes", count
-    tables and pod-level scalars replicate. With the inputs committed to
-    these shardings, the ordinary jitted kernel compiles SPMD over the mesh
-    (GSPMD propagation; cross-node reductions become ICI collectives) — the
-    production TPUScheduler path needs no separate sharded kernel."""
+    """Place batch features: per-node vectors shard over the node axis,
+    count tables and pod-level scalars replicate. With the inputs committed
+    to these shardings, the ordinary jitted kernel compiles SPMD over the
+    mesh (GSPMD propagation; cross-node reductions become ICI — and on a
+    multi-host mesh, ICI+DCN — collectives): the production TPUScheduler
+    path needs no separate sharded kernel."""
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        feats, _feature_specs())
+        feats, _feature_specs(_node_axis_of(mesh)))
+
+
+def collective_report(compiled_text: str, n_hosts: int, per_host: int) -> dict:
+    """Classify every collective in compiled HLO by the mesh axis it rides:
+    a replica group whose members all live on ONE host is an ICI collective;
+    a group spanning hosts rides the DCN. Device id -> host is id//per_host
+    (the ("dcn", "ici") mesh lays devices out host-major). Returns
+    {"ici": {op: n}, "dcn": {op: n}, "total": {op: n}} — the per-axis
+    breakdown the multi-host dryrun prints so the DCN traffic of a sharding
+    choice is visible, not guessed."""
+    import re
+
+    out = {"ici": {}, "dcn": {}, "total": {}}
+
+    def classify(groups):
+        spans_hosts = any(
+            len({d // per_host for d in g}) > 1 for g in groups if g)
+        return "dcn" if spans_hosts else "ici"
+
+    for m in re.finditer(
+            r"(all-reduce|all-gather|reduce-scatter|all-to-all|"
+            r"collective-permute)(-start)?[^\n]*", compiled_text):
+        line = m.group(0)
+        op = m.group(1)
+        groups = []
+        rg = re.search(r"replica_groups=\{(.*?)\}\s*(?:,|$)", line)
+        if rg is not None:
+            groups = [[int(x) for x in g.split(",") if x.strip()]
+                      for g in re.findall(r"\{([\d,]*)\}", rg.group(0))]
+        stp = re.search(r"source_target_pairs=\{(.*)?\}", line)
+        if stp is not None:
+            groups = [[int(x) for x in pair.split(",")]
+                      for pair in re.findall(r"\{(\d+,\d+)\}", stp.group(0))]
+        axis = classify(groups) if groups else "ici"
+        out[axis][op] = out[axis].get(op, 0) + 1
+        out["total"][op] = out["total"].get(op, 0) + 1
+    return out
 
 
 def sharded_schedule_batch(mesh: Mesh, batch_pad: int, fit_strategy: int, vmax: int):

@@ -1,0 +1,225 @@
+"""TPU sidecar: the device scheduling backend behind a Unix-domain-socket
+RPC boundary.
+
+The reference's natural out-of-process integration shape is the HTTP
+scheduler extender (pkg/scheduler/extender.go:44, verbs filter/prioritize/
+bind/preempt :46-49); SURVEY §2.4 rows 9-10 call for the TPU build's
+equivalent: a colocated sidecar process that OWNS the accelerator and is fed
+cluster state + pod batches over gRPC/UDS, so the control-plane scheduler
+process never links JAX/XLA. This module is the working UDS prototype of
+that contract (docs/SIDECAR.md is the contract document):
+
+- framing: 4-byte big-endian length prefix + JSON body, both directions;
+- objects ride the SAME wire codec as the REST apiserver
+  (core/apiserver.py pod_to_wire/node_to_wire — one serialization story
+  for both process boundaries);
+- verbs (mirroring the extender verb set, batched):
+    {"verb": "sync",     "nodes": [...]}                  → {"ok": true}
+    {"verb": "schedule", "pods": [...]}                   → {"assignments":
+        [nodeName | null, ...], "deviceScheduled": n}
+    {"verb": "ping"}                                      → {"ok": true}
+    {"verb": "shutdown"}                                  → {"ok": true}
+  errors: {"error": "..."} with the connection kept open.
+
+The sidecar applies `sync` node diffs to its owned cluster mirror and runs
+`schedule` batches through the full TPUScheduler device path; the caller
+binds the returned assignments itself (the bind cycle — like the
+reference's bind verb — stays host-side unless delegated).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+from typing import List, Optional
+
+_LEN = struct.Struct(">I")
+
+
+def _send(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv(sock: socket.socket) -> Optional[dict]:
+    hdr = b""
+    while len(hdr) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    body = b""
+    while len(body) < n:
+        chunk = sock.recv(min(1 << 20, n - len(body)))
+        if not chunk:
+            return None
+        body += chunk
+    return json.loads(body)
+
+
+class SidecarServer:
+    """Owns a TPUScheduler; serves the UDS contract. One request at a time
+    per connection; multiple sequential connections supported (the host
+    scheduler reconnects after a sidecar restart, like any RPC client)."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        from ..core import FakeClientset
+        from ..models import TPUScheduler
+        self._cs = FakeClientset()
+        self._sched = TPUScheduler(clientset=self._cs)
+        self._listener: Optional[socket.socket] = None
+        self._stop = threading.Event()
+
+    # -- verbs -------------------------------------------------------------
+
+    def _sync(self, req: dict) -> dict:
+        """Full node-set replacement (the prototype's re-list; a production
+        sidecar would take generation-keyed diffs exactly like the mirror's
+        dirty rows)."""
+        from ..core.apiserver import node_from_wire
+        wanted = {}
+        for w in req.get("nodes", ()):
+            node = node_from_wire(w)
+            wanted[node.name] = node
+        for name in list(self._cs.nodes):
+            if name not in wanted:
+                self._cs.delete_node(name)
+        for name, node in wanted.items():
+            if name in self._cs.nodes:
+                self._cs.update_node(node)
+            else:
+                self._cs.create_node(node)
+        return {"ok": True}
+
+    def _schedule(self, req: dict) -> dict:
+        from ..core.apiserver import pod_from_wire
+        pods = [pod_from_wire(w) for w in req.get("pods", ())]
+        for p in pods:
+            self._cs.create_pod(p)
+        self._sched.run_until_idle()
+        assignments: List[Optional[str]] = []
+        for p in pods:
+            assignments.append(self._cs.bindings.get(p.uid) or None)
+            # The caller owns the cluster truth; the sidecar's copy of the
+            # pod served its purpose once scheduled (bound pods stay in the
+            # mirror as load; unschedulable ones leave so the next batch
+            # doesn't re-attempt them).
+            if p.uid not in self._cs.bindings:
+                self._cs.delete_pod(p)
+        return {"assignments": assignments,
+                "deviceScheduled": self._sched.device_scheduled}
+
+    # -- serving -----------------------------------------------------------
+
+    def serve_forever(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(4)
+        print(f"kubernetes-tpu-sidecar: serving on {self.socket_path}",
+              flush=True)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                break
+            with conn:
+                while not self._stop.is_set():
+                    req = _recv(conn)
+                    if req is None:
+                        break
+                    try:
+                        verb = req.get("verb")
+                        if verb == "ping":
+                            _send(conn, {"ok": True})
+                        elif verb == "sync":
+                            _send(conn, self._sync(req))
+                        elif verb == "schedule":
+                            _send(conn, self._schedule(req))
+                        elif verb == "shutdown":
+                            _send(conn, {"ok": True})
+                            self._stop.set()
+                        else:
+                            _send(conn, {"error": f"unknown verb {verb!r}"})
+                    except Exception as e:  # noqa: BLE001 - wire error reply
+                        _send(conn, {"error": repr(e)})
+        self._listener.close()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+class SidecarClient:
+    """The host scheduler's side of the contract."""
+
+    def __init__(self, socket_path: str, timeout: float = 60.0):
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        self._sock.connect(socket_path)
+
+    def _call(self, req: dict) -> dict:
+        _send(self._sock, req)
+        resp = _recv(self._sock)
+        if resp is None:
+            raise ConnectionError("sidecar closed the connection")
+        if "error" in resp:
+            raise RuntimeError(f"sidecar: {resp['error']}")
+        return resp
+
+    def ping(self) -> bool:
+        return bool(self._call({"verb": "ping"}).get("ok"))
+
+    def sync_nodes(self, nodes) -> None:
+        from ..core.apiserver import node_to_wire
+        self._call({"verb": "sync",
+                    "nodes": [node_to_wire(n) for n in nodes]})
+
+    def schedule(self, pods) -> List[Optional[str]]:
+        from ..core.apiserver import pod_to_wire
+        resp = self._call({"verb": "schedule",
+                           "pods": [pod_to_wire(p) for p in pods]})
+        return resp["assignments"]
+
+    def shutdown_server(self) -> None:
+        try:
+            self._call({"verb": "shutdown"})
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def main(argv=None) -> int:
+    """`python -m kubernetes_tpu.parallel.sidecar --socket /tmp/tpu.sock
+    [--platform cpu]` — the sidecar as its own OS process."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="kubernetes-tpu-sidecar")
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--platform", default="auto", choices=("auto", "cpu"))
+    args = ap.parse_args(argv)
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    SidecarServer(args.socket).serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -107,3 +107,123 @@ def test_two_cells_schedule_independently():
     out = np.asarray(out)
     assert (out[0] == np.asarray(r0)).all()
     assert (out[1] == np.asarray(r1)).all()
+
+
+def test_multihost_mesh_matches_single_device():
+    """(dcn, ici) mesh: the node axis spans hosts; assignments must equal
+    the single-device run and the compiled step must contain collectives
+    classified per axis (round-4 VERDICT item 7)."""
+    import jax
+    from kubernetes_tpu.core import FakeClientset
+    from kubernetes_tpu.models import TPUScheduler
+    from kubernetes_tpu.parallel import collective_report, make_multihost_mesh
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    if len(jax.devices()) < 4:
+        import pytest
+        pytest.skip("needs 4 virtual devices")
+    devs = jax.devices()[:4]
+    mesh = make_multihost_mesh(2, devices=devs)
+
+    def run(mesh_arg):
+        cs = FakeClientset()
+        s = TPUScheduler(clientset=cs, mesh=mesh_arg, max_batch=32)
+        for i in range(32):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": "8", "memory": "16Gi",
+                                      "pods": 110})
+                           .zone(f"z{i % 4}").obj())
+        proto = make_pod().name("proto").req(
+            {"cpu": "250m", "memory": "128Mi"}).labels({"a": "b"}).obj()
+        for i in range(64):
+            cs.create_pod(proto.clone_from_template(f"p{i}"))
+        s.run_until_idle()
+        return {p.name: p.node_name for p in cs.pods.values()}, s
+
+    single, _s1 = run(None)
+    multi, s2 = run(mesh)
+    assert single == multi
+    assert s2.scheduled == 64
+
+    from kubernetes_tpu.ops.kernel import schedule_batch
+    fw = next(iter(s2.profiles.values()))
+    state, plan = s2.build_plan(
+        fw, make_pod().name("probe").req({"cpu": "250m"}).obj(), 32)
+    lowered = schedule_batch.lower(
+        state, plan.features, plan.batch_pad, plan.fit_strategy, plan.vmax,
+        n_active=32, carry_in=None, has_pns=plan.has_pns,
+        has_ipa_base=plan.has_ipa_base, anti_rowlocal=plan.anti_rowlocal,
+        has_na_pref=plan.has_na_pref, port_selfblock=plan.port_selfblock,
+        has_aux=plan.has_aux)
+    report = collective_report(lowered.compile().as_text(), 2, 2)
+    assert report["total"], "no collectives in the multi-host step"
+
+
+def test_sidecar_over_uds_matches_in_process():
+    """The UDS sidecar prototype (docs/SIDECAR.md): a separate OS process
+    owns the device path; scheduling a batch over the socket produces the
+    in-process scheduler's assignments."""
+    import os
+    import re
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    from kubernetes_tpu.core import FakeClientset, Scheduler
+    from kubernetes_tpu.parallel.sidecar import SidecarClient
+    from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+    def nodes():
+        return [make_node().name(f"n{i}")
+                .capacity({"cpu": "8", "memory": "16Gi", "pods": 110})
+                .zone(f"z{i % 2}").obj() for i in range(6)]
+
+    def pods():
+        proto = make_pod().name("proto").req(
+            {"cpu": "500m", "memory": "256Mi"}).labels({"a": "b"}).obj()
+        return [proto.clone_from_template(f"p{i}") for i in range(20)]
+
+    # in-process oracle
+    cs = FakeClientset()
+    host = Scheduler(clientset=cs, deterministic_ties=True)
+    for n in nodes():
+        cs.create_node(n)
+    oracle_pods = pods()
+    for p in oracle_pods:
+        cs.create_pod(p)
+    host.run_until_idle()
+    oracle = [cs.bindings.get(p.uid) for p in oracle_pods]
+
+    sock_path = os.path.join(tempfile.mkdtemp(), "sidecar.sock")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu.parallel.sidecar",
+         "--socket", sock_path, "--platform", "cpu"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.monotonic() + 120
+        line = ""
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if re.search("serving on", line):
+                break
+        client = SidecarClient(sock_path)
+        assert client.ping()
+        client.sync_nodes(nodes())
+        # two batches: the second sees the first's load (mirror continuity)
+        batch = pods()
+        got = client.schedule(batch[:10]) + client.schedule(batch[10:])
+        assert got == oracle, list(zip(got, oracle))
+        client.shutdown_server()
+        client.close()
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
