@@ -93,7 +93,14 @@ def main(argv=None) -> int:
     cs_kw = {}
     if args.api_url:
         from .core.apiserver import HTTPClientset
-        cs_kw["clientset"] = HTTPClientset(args.api_url)
+        from .core.clientset import RetryingClientset
+        # Production shape: every write verb retries transient apiserver
+        # failures with backoff before surfacing an error to the scheduler
+        # (core/backoff.py; docs/RESILIENCE.md). Calls routed through the
+        # async API dispatcher retry at that layer TOO — the layers compose
+        # (worst case attempts multiply, bounded by both small budgets);
+        # the wrapper here is what covers the dispatcher-less sync writes.
+        cs_kw["clientset"] = RetryingClientset(HTTPClientset(args.api_url))
     sched = TPUScheduler(config=cfg, **cs_kw)
     if args.cluster:
         _load_cluster(sched.clientset, args.cluster)

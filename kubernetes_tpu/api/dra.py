@@ -225,7 +225,14 @@ class _QtyMixin:
     """Coerced quantity values compare against BOTH numbers and suffixed
     string literals: device.capacity["mem"] == "40Gi" and == 40*1024**3 both
     hold (the reference's CEL environment compares typed quantities; plain
-    int coercion would make the string form silently False)."""
+    int coercion would make the string form silently False).
+
+    HASH/EQ ASYMMETRY (ADVICE r5): _QtyInt(8) == "8" but hash(_QtyInt(8))
+    != hash("8") — the int/float __hash__ is kept deliberately so numeric
+    lookups work. Consequence: coerced quantity values must NEVER be used
+    as set members or dict keys alongside their raw string forms; two
+    "equal" members would occupy different hash buckets. Today they are
+    only ever compared (CEL selector evaluation), never keyed."""
 
     __slots__ = ()
 

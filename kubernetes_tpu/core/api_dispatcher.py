@@ -44,10 +44,19 @@ class APICall:
 
 
 class APIDispatcher:
-    def __init__(self, mode: str = "inline", metrics=None):
+    def __init__(self, mode: str = "inline", metrics=None, retry=None):
         assert mode in ("inline", "thread")
         self.mode = mode
         self.metrics = metrics  # SchedulerMetrics (async_api_call_* series)
+        # Transient-failure retry budget per call (client-go request retry):
+        # a bind that hits a connection reset / 5xx replays with backoff
+        # BEFORE landing in the error inbox — drain_errors only sees calls
+        # that stayed broken through the whole budget. Inline mode shares
+        # the config; its sleeps run on the scheduling thread, so the
+        # defaults are small (RetryConfig caps well under a watch timeout).
+        from .backoff import RetryConfig
+        self._retry_cfg = retry or RetryConfig()
+        self.retried = 0  # replays across all calls (tests/metrics)
         self._pending: Dict[Tuple[str, str], APICall] = {}
         self._order: List[Tuple[str, str]] = []
         self._lock = threading.Lock()
@@ -94,28 +103,44 @@ class APIDispatcher:
     def _execute(self, call: APICall, defer_errors: bool = False) -> None:
         import time as _time
         _t0 = _time.perf_counter()
-        try:
-            call.execute()
-            self.executed += 1
-            if self.metrics is not None:
-                self.metrics.async_api_call_execution_total.inc(
-                    call.call_type, "success")
-                self.metrics.async_api_call_execution_duration.observe(
-                    _time.perf_counter() - _t0, call.call_type, "success")
-        except Exception as e:  # noqa: BLE001
-            self.errors.append(f"{call.call_type}/{call.object_uid}: {e!r}")
-            if self.metrics is not None:
-                self.metrics.async_api_call_execution_total.inc(
-                    call.call_type, "error")
-                self.metrics.async_api_call_execution_duration.observe(
-                    _time.perf_counter() - _t0, call.call_type, "error")
-            if call.on_error is None:
+        delays = self._retry_cfg.delays()
+        while True:
+            try:
+                call.execute()
+                self.executed += 1
+                if self.metrics is not None:
+                    self.metrics.async_api_call_execution_total.inc(
+                        call.call_type, "success")
+                    self.metrics.async_api_call_execution_duration.observe(
+                        _time.perf_counter() - _t0, call.call_type, "success")
                 return
-            if defer_errors:
-                with self._cv:
-                    self._error_inbox.append((call, e))
-            else:
-                call.on_error(e)
+            except Exception as e:  # noqa: BLE001
+                if self._retry_cfg.retriable(e):
+                    try:
+                        delay = next(delays)
+                    except StopIteration:
+                        pass  # budget exhausted: fall through to the inbox
+                    else:
+                        self.retried += 1
+                        if self.metrics is not None:
+                            self.metrics.async_api_call_retries.inc(
+                                call.call_type)
+                        _time.sleep(delay)
+                        continue
+                self.errors.append(f"{call.call_type}/{call.object_uid}: {e!r}")
+                if self.metrics is not None:
+                    self.metrics.async_api_call_execution_total.inc(
+                        call.call_type, "error")
+                    self.metrics.async_api_call_execution_duration.observe(
+                        _time.perf_counter() - _t0, call.call_type, "error")
+                if call.on_error is None:
+                    return
+                if defer_errors:
+                    with self._cv:
+                        self._error_inbox.append((call, e))
+                else:
+                    call.on_error(e)
+                return
 
     # -- worker ------------------------------------------------------------
 

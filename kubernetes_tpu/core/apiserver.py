@@ -293,12 +293,33 @@ def node_from_wire(d: dict) -> Node:
 
 
 class APIServer:
-    """REST + watch over an owned FakeClientset store."""
+    """REST + watch over an owned FakeClientset store.
 
-    def __init__(self, store: Optional[FakeClientset] = None):
+    Watch streams support resourceVersion resume (the reference's
+    watch-cache window): every event is stamped with a per-kind monotonic
+    `rv` and retained in a bounded backlog. A client reconnecting with
+    `?watch=true&resourceVersion=N` gets a RESUME marker plus a replay of
+    every event it missed — no full re-list — when the window still covers
+    N; otherwise (compaction, the 410 Gone analogue) it gets the usual full
+    ADDED replay + SYNC and performs reflector Replace semantics."""
+
+    def __init__(self, store: Optional[FakeClientset] = None,
+                 backlog: int = 8192):
         self.store = store or FakeClientset()
         self._watchers: Dict[str, List["queue.Queue"]] = {"pods": [], "nodes": []}
         self._lock = threading.Lock()
+        from collections import deque
+        import uuid
+        self._seq: Dict[str, int] = {"pods": 0, "nodes": 0}
+        self._backlog: Dict[str, "deque"] = {
+            "pods": deque(maxlen=backlog), "nodes": deque(maxlen=backlog)}
+        # Boot epoch: rv counters restart at 0 with a fresh server, so a
+        # client's rv from a PREVIOUS server instance must never resume
+        # against this one's unrelated event history — resume requires the
+        # epoch to match, otherwise the full re-list (Replace) runs.
+        self.epoch = uuid.uuid4().hex[:12]
+        self.resumed_watches = 0   # incremental reconnects served
+        self.relisted_watches = 0  # full-list attaches served
         self.store.on_pod_event(self._pod_event)
         self.store.on_node_event(self._node_event)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -306,8 +327,11 @@ class APIServer:
     # -- event fanout to watch streams -------------------------------------
 
     def _broadcast(self, kind: str, event: dict) -> None:
-        data = (json.dumps(event) + "\n").encode()
         with self._lock:
+            self._seq[kind] += 1
+            event["rv"] = self._seq[kind]
+            data = (json.dumps(event) + "\n").encode()
+            self._backlog[kind].append((self._seq[kind], data))
             for q in self._watchers[kind]:
                 q.put(data)
 
@@ -319,20 +343,43 @@ class APIServer:
         typ = {"add": "ADDED", "update": "MODIFIED", "delete": "DELETED"}[kind]
         self._broadcast("nodes", {"type": typ, "object": node_to_wire(new)})
 
-    def _attach_watch(self, kind: str) -> "queue.Queue":
-        """Attach a watch with resourceVersion=0 semantics: under the
-        broadcast lock, seed the stream with ADDED for every existing object
-        plus a SYNC marker, THEN register for live events — no create can
-        fall between snapshot and registration."""
+    def _attach_watch(self, kind: str, since: Optional[int] = None,
+                      epoch: Optional[str] = None) -> "queue.Queue":
+        """Attach a watch under the broadcast lock, THEN register for live
+        events — no create can fall between snapshot and registration.
+
+        since=None (or outside the backlog window, or an epoch from another
+        server instance): resourceVersion=0 semantics — ADDED for every
+        existing object, then a SYNC marker carrying the current rv +
+        epoch. since=N inside the window with a matching epoch: a RESUME
+        marker, then a replay of exactly the events with rv > N."""
         q: "queue.Queue" = queue.Queue()
         with self._lock:
-            if kind == "pods":
-                objs = [pod_to_wire(p) for p in self.store.pods.values()]
+            backlog = self._backlog[kind]
+            seq = self._seq[kind]
+            # Resumable iff the rv names THIS server's history (epoch) and
+            # NOTHING after `since` was compacted away. Anything else —
+            # unknown epoch (server restarted, counters reset), a future
+            # rv, a pruned window — full-re-lists, never silently resumes.
+            if (since is not None and epoch == self.epoch and since <= seq
+                    and (since == seq
+                         or (backlog and backlog[0][0] <= since + 1))):
+                q.put((json.dumps({"type": "RESUME", "rv": seq,
+                                   "epoch": self.epoch}) + "\n").encode())
+                for s, data in backlog:
+                    if s > since:
+                        q.put(data)
+                self.resumed_watches += 1
             else:
-                objs = [node_to_wire(n) for n in self.store.nodes.values()]
-            for o in objs:
-                q.put((json.dumps({"type": "ADDED", "object": o}) + "\n").encode())
-            q.put((json.dumps({"type": "SYNC"}) + "\n").encode())
+                if kind == "pods":
+                    objs = [pod_to_wire(p) for p in self.store.pods.values()]
+                else:
+                    objs = [node_to_wire(n) for n in self.store.nodes.values()]
+                for o in objs:
+                    q.put((json.dumps({"type": "ADDED", "object": o}) + "\n").encode())
+                q.put((json.dumps({"type": "SYNC", "rv": seq,
+                                   "epoch": self.epoch}) + "\n").encode())
+                self.relisted_watches += 1
             self._watchers[kind].append(q)
         return q
 
@@ -367,19 +414,29 @@ class APIServer:
             def do_GET(self):
                 path, _, query = self.path.partition("?")
                 watch = "watch=true" in query
+                since, epoch = None, None
+                for part in query.split("&"):
+                    if part.startswith("resourceVersion="):
+                        try:
+                            since = int(part.split("=", 1)[1])
+                        except ValueError:
+                            pass
+                    elif part.startswith("epoch="):
+                        epoch = part.split("=", 1)[1]
                 if path == "/api/v1/pods":
                     if watch:
-                        return self._stream("pods")
+                        return self._stream("pods", since, epoch)
                     return self._json(200, [pod_to_wire(p) for p in
                                             server.store.pods.values()])
                 if path == "/api/v1/nodes":
                     if watch:
-                        return self._stream("nodes")
+                        return self._stream("nodes", since, epoch)
                     return self._json(200, [node_to_wire(n) for n in
                                             server.store.nodes.values()])
                 self._json(404, {"error": "not found"})
 
-            def _stream(self, kind: str) -> None:
+            def _stream(self, kind: str, since: Optional[int] = None,
+                        epoch: Optional[str] = None) -> None:
                 # watch.Interface: hold the connection open, one JSON event
                 # per line (chunked); blocking queue — no idle polling. A
                 # BOOKMARK heartbeat goes out on idle (~10s) so a quiet
@@ -390,7 +447,7 @@ class APIServer:
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
-                q = server._attach_watch(kind)
+                q = server._attach_watch(kind, since, epoch)
                 idle = 0.0
                 try:
                     while server._httpd is not None:
@@ -419,10 +476,18 @@ class APIServer:
             def do_POST(self):
                 if self.path == "/api/v1/pods":
                     pod = pod_from_wire(self._body())
+                    # AlreadyExists (409, like the reference registry):
+                    # duplicate creates — e.g. a client retrying a write
+                    # whose reply was lost — must not re-fire ADDED events
+                    # or reset a pod the scheduler already bound.
+                    if pod.uid in server.store.pods:
+                        return self._json(409, {"error": "AlreadyExists"})
                     server.store.create_pod(pod)
                     return self._json(201, pod_to_wire(pod))
                 if self.path == "/api/v1/nodes":
                     node = node_from_wire(self._body())
+                    if node.name in server.store.nodes:
+                        return self._json(409, {"error": "AlreadyExists"})
                     server.store.create_node(node)
                     return self._json(201, node_to_wire(node))
                 if (self.path.startswith("/api/v1/nodes/")
@@ -527,6 +592,16 @@ class HTTPClientset:
         self._synced = {"pods": threading.Event(), "nodes": threading.Event()}
         self._fatal: Dict[str, Exception] = {}
         self.last_sync: Dict[str, float] = {}
+        # resourceVersion resume (reflector.go lastSyncResourceVersion):
+        # the rv of the last event (or SYNC snapshot) each stream consumed;
+        # reconnects ask the server to replay from here instead of
+        # re-listing. relists/resumes count how each reconnect was served.
+        self._last_rv: Dict[str, Optional[int]] = {"pods": None, "nodes": None}
+        # Server boot epoch (from SYNC/RESUME): sent with the rv so a
+        # restarted server (fresh counters) re-lists instead of resuming.
+        self._epoch: Dict[str, Optional[str]] = {"pods": None, "nodes": None}
+        self.relists: Dict[str, int] = {"pods": 0, "nodes": 0}
+        self.resumes: Dict[str, int] = {"pods": 0, "nodes": 0}
         self._threads: List[threading.Thread] = []
         for kind in ("pods", "nodes"):
             t = threading.Thread(target=self._watch_loop, args=(kind,),
@@ -617,13 +692,15 @@ class HTTPClientset:
 
     def _watch_loop(self, kind: str) -> None:
         """client-go reflector behavior (tools/cache/reflector.go:470): on
-        stream EOF/timeout, re-connect and re-list — the watch=true stream
-        replays ADDED for every live object then SYNC, so each reconnect IS
-        the re-list. Replayed objects the cache already holds dispatch as
-        updates; objects that vanished during the outage dispatch DELETED at
-        the SYNC barrier (the reflector's Replace semantics). Only a failure
-        of the FIRST connection is fatal (recorded in _fatal so the
-        constructor raises instead of returning a dead clientset)."""
+        stream EOF/timeout, re-connect with the last-seen resourceVersion.
+        Inside the server's backlog window the stream opens with RESUME and
+        replays exactly the missed events — the local cache converges
+        without a re-list. Outside the window (or on first connect) the
+        stream replays ADDED for every live object then SYNC, and objects
+        that vanished during the outage dispatch DELETED at the SYNC
+        barrier (the reflector's Replace semantics). Only a failure of the
+        FIRST connection is fatal (recorded in _fatal so the constructor
+        raises instead of returning a dead clientset)."""
         # Raw HTTPConnection so close() can shut the SOCKET down —
         # HTTPResponse.close() on an endless chunked stream would block
         # draining to EOF.
@@ -634,7 +711,12 @@ class HTTPClientset:
         while not self._stop.is_set():
             try:
                 conn = _hc.HTTPConnection(host, timeout=60)
-                conn.request("GET", f"/api/v1/{kind}?watch=true")
+                path = f"/api/v1/{kind}?watch=true"
+                if (self._last_rv[kind] is not None
+                        and self._epoch[kind] is not None):
+                    path += (f"&resourceVersion={self._last_rv[kind]}"
+                             f"&epoch={self._epoch[kind]}")
+                conn.request("GET", path)
                 resp = conn.getresponse()
             except Exception as e:  # noqa: BLE001 - connect failure
                 if not self._synced[kind].is_set():
@@ -659,12 +741,30 @@ class HTTPClientset:
                     typ = event["type"]
                     if typ == "BOOKMARK":
                         continue  # server idle heartbeat
+                    if typ == "RESUME":
+                        # Incremental reconnect: the server will replay the
+                        # missed tail — the local cache stays authoritative,
+                        # so no Replace barrier runs.
+                        resync_seen = None
+                        got_sync = True
+                        backoff = 0.05
+                        self.resumes[kind] += 1
+                        if event.get("epoch") is not None:
+                            self._epoch[kind] = event["epoch"]
+                        self._synced[kind].set()
+                        self.last_sync[kind] = _time.monotonic()
+                        continue
                     if typ == "SYNC":
                         with self._dispatch_lock:
                             self._replace_barrier(kind, resync_seen)
                         resync_seen = None
                         got_sync = True
                         backoff = 0.05  # healthy stream: reset the backoff
+                        self.relists[kind] += 1
+                        if event.get("rv") is not None:
+                            self._last_rv[kind] = event["rv"]
+                        if event.get("epoch") is not None:
+                            self._epoch[kind] = event["epoch"]
                         self._synced[kind].set()
                         self.last_sync[kind] = _time.monotonic()
                         continue
@@ -673,6 +773,8 @@ class HTTPClientset:
                             resync_seen.add(self._wire_key(kind, event["object"]))
                         self._dispatch(kind, typ, event["object"],
                                        relisting=resync_seen is not None)
+                        if event.get("rv") is not None:
+                            self._last_rv[kind] = event["rv"]
             except Exception:  # noqa: BLE001 - stream torn down / timeout
                 pass
             finally:

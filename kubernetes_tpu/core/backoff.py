@@ -1,0 +1,172 @@
+"""Retry/backoff machinery + the device-path circuit breaker.
+
+Re-expresses the slice of client-go's wait/backoff stack the scheduler
+actually leans on (k8s.io/apimachinery/pkg/util/wait Backoff{Duration,
+Factor, Jitter, Steps} and client-go rest/request.go retry-on-transient):
+exponential backoff with deterministic seeded jitter, a retriable-error
+taxonomy shared by every boundary (REST writes, async API dispatcher,
+sidecar RPC), and a consecutive-failure circuit breaker that pins the
+device scheduling path to the host Evaluator for a cool-down after
+repeated kernel failures (docs/RESILIENCE.md).
+
+Determinism: jitter comes from a `random.Random(seed)` owned by the
+RetryConfig, never the global RNG — chaos tests (tests/test_faults.py)
+replay identical delay sequences from identical seeds.
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Optional
+
+
+class TransientAPIError(Exception):
+    """A retriable control-plane failure: the request may succeed if
+    replayed (apiserver 5xx / timeout / reset mid-write). Fault injection
+    (testing/faults.py) raises exactly this; real transports map their
+    transient failures onto it or onto the stdlib types is_retriable
+    recognizes."""
+
+
+# OS-level errno values that signal a transient transport failure.
+_TRANSIENT_ERRNOS = frozenset({
+    errno.ECONNRESET, errno.ECONNREFUSED, errno.ECONNABORTED,
+    errno.EPIPE, errno.ETIMEDOUT, errno.EAGAIN,
+})
+
+
+def is_retriable(exc: BaseException) -> bool:
+    """The shared retriable-error taxonomy (client-go's IsConnectionReset /
+    retryable-status-code checks collapsed to one predicate). Semantic
+    errors (KeyError pod-not-found, ValueError, programming bugs) are NOT
+    retriable — replaying them can only repeat the failure."""
+    import http.client as _hc
+    if isinstance(exc, TransientAPIError):
+        return True
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        # ConnectionResetError/BrokenPipeError/ConnectionRefusedError and
+        # socket.timeout are subclasses.
+        return True
+    # urllib.error.HTTPError: retry server-side (5xx) failures only.
+    code = getattr(exc, "code", None)
+    if isinstance(code, int):
+        return code >= 500
+    # urllib.error.URLError wraps the transport failure in .reason.
+    reason = getattr(exc, "reason", None)
+    if isinstance(reason, BaseException) and reason is not exc:
+        return is_retriable(reason)
+    if isinstance(exc, _hc.HTTPException):
+        # RemoteDisconnected / BadStatusLine / IncompleteRead: the
+        # connection died mid-exchange — a replay gets a fresh connection.
+        return True
+    if isinstance(exc, OSError):
+        return exc.errno in _TRANSIENT_ERRNOS
+    return False
+
+
+@dataclass
+class RetryConfig:
+    """wait.Backoff analogue. `max_attempts` counts total tries (1 = no
+    retry). `jitter` is a +/- fraction of each delay; the seeded RNG makes
+    the whole delay sequence reproducible."""
+
+    initial_backoff: float = 0.01
+    max_backoff: float = 1.0
+    multiplier: float = 2.0
+    jitter: float = 0.1
+    max_attempts: int = 4
+    seed: Optional[int] = 0
+    retriable: Callable[[BaseException], bool] = field(default=is_retriable)
+
+    def delays(self) -> Iterator[float]:
+        """The (max_attempts - 1) sleep durations between tries."""
+        rng = random.Random(self.seed)
+        d = self.initial_backoff
+        for _ in range(max(0, self.max_attempts - 1)):
+            j = 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+            yield max(0.0, min(self.max_backoff, d) * j)
+            d *= self.multiplier
+
+
+def retry_call(fn: Callable, config: Optional[RetryConfig] = None,
+               on_retry: Optional[Callable[[int, BaseException], None]] = None,
+               sleep: Callable[[float], None] = time.sleep):
+    """Run `fn()`; on a retriable failure, back off and replay, up to
+    config.max_attempts total tries. Non-retriable exceptions (and the
+    final retriable one) propagate. `on_retry(attempt_no, exc)` fires
+    before each sleep — callers hang metrics/logging off it."""
+    cfg = config or RetryConfig()
+    attempt = 0
+    delays = cfg.delays()
+    while True:
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 - predicate decides
+            attempt += 1
+            if not cfg.retriable(e):
+                raise
+            try:
+                delay = next(delays)
+            except StopIteration:
+                raise e from None
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(delay)
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker for the device scheduling path.
+
+    closed    — device path allowed; failures count.
+    open      — after `failure_threshold` consecutive failures: device path
+                pinned off for `cooldown` seconds (host Evaluator owns every
+                cycle — the crash-proof degradation mode).
+    half-open — cooldown elapsed: ONE probe is allowed; success closes the
+                breaker, failure re-opens it for another cooldown.
+
+    `clock` is injectable so chaos tests step time deterministically.
+    """
+
+    def __init__(self, failure_threshold: int = 3, cooldown: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = max(1, failure_threshold)
+        self.cooldown = cooldown
+        self.clock = clock
+        self.consecutive_failures = 0
+        self.opened_at: Optional[float] = None
+        self.open_count = 0  # times the breaker tripped (metrics)
+
+    @property
+    def state(self) -> str:
+        if self.opened_at is None:
+            return "closed"
+        if self.clock() - self.opened_at >= self.cooldown:
+            return "half-open"
+        return "open"
+
+    def allows(self) -> bool:
+        """May the device path run this cycle? (closed or half-open probe)"""
+        return self.state != "open"
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self.opened_at = None
+
+    def record_failure(self) -> bool:
+        """Count one failure; returns True when this failure OPENED (or
+        re-opened) the breaker."""
+        if self.state == "half-open":
+            # Failed probe: restart the cool-down.
+            self.opened_at = self.clock()
+            self.open_count += 1
+            return True
+        self.consecutive_failures += 1
+        if (self.opened_at is None
+                and self.consecutive_failures >= self.failure_threshold):
+            self.opened_at = self.clock()
+            self.open_count += 1
+            return True
+        return False

@@ -275,3 +275,65 @@ class FakeClientset:
             stored.nominated_node_name = nominated_node_name
         if phase:
             stored.phase = phase
+
+
+class RetryingClientset:
+    """Write-path retry decorator over any clientset (client-go's
+    rest/request.go retry + wait.Backoff, collapsed to the verbs the
+    scheduler writes). Transient failures — connection resets, timeouts,
+    5xx, injected ``TransientAPIError`` — are replayed with exponential
+    backoff + seeded jitter; semantic errors (pod not found, validation)
+    propagate on the first try. Reads, listers, and informer registration
+    delegate untouched, so the wrapper is drop-in wherever a clientset is
+    (``TPUScheduler(clientset=RetryingClientset(HTTPClientset(url)))``).
+
+    ``retries_total`` counts replayed calls; ``give_ups`` counts calls
+    that exhausted the budget (the final exception propagates — the async
+    dispatcher's error inbox / drain_errors owns what happens next)."""
+
+    _WRITE_VERBS = frozenset({
+        "create_pod", "update_pod", "delete_pod", "bind", "patch_pod_status",
+        "create_node", "update_node", "delete_node",
+        "create_namespace", "create_pod_group", "create_composite_pod_group",
+        "create_pv", "create_pvc", "create_storage_class", "create_csi_node",
+        "create_resource_slice", "create_resource_claim",
+        "create_device_class", "bind_volume", "remove_pod_finalizers",
+    })
+
+    def __init__(self, inner, retry=None):
+        from .backoff import RetryConfig, retry_call
+        self._inner = inner
+        self._retry_cfg = retry or RetryConfig()
+        self._retry_call = retry_call
+        self.retries_total = 0
+        self.give_ups = 0
+
+    def _on_retry(self, _attempt: int, _exc: BaseException) -> None:
+        self.retries_total += 1
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in RetryingClientset._WRITE_VERBS and callable(attr):
+            def retried(*args, _attr=attr, **kwargs):
+                state = {"retried": False}
+
+                def on_retry(attempt, exc):
+                    state["retried"] = True
+                    self._on_retry(attempt, exc)
+
+                try:
+                    return self._retry_call(
+                        lambda: _attr(*args, **kwargs),
+                        config=self._retry_cfg, on_retry=on_retry)
+                except BaseException as e:
+                    if state["retried"] and getattr(e, "code", None) == 409:
+                        # AlreadyExists on a REPLAY: the earlier attempt
+                        # landed before its reply was lost — the write is
+                        # durable, which is what the caller wanted. A 409 on
+                        # the FIRST try is a genuine conflict and raises.
+                        return None
+                    if self._retry_cfg.retriable(e):
+                        self.give_ups += 1  # budget exhausted, still failing
+                    raise
+            return retried
+        return attr

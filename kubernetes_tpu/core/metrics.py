@@ -260,6 +260,21 @@ class SchedulerMetrics:
         self.pending_async_api_calls = r(Gauge(
             "scheduler_pending_async_api_calls",
             "Queued async API calls not yet executed.", ()))
+        self.async_api_call_retries = r(Counter(
+            "scheduler_async_api_call_retries_total",
+            "Transient-failure replays of async API calls (backoff retries "
+            "that happened BEFORE a call landed in the error inbox).",
+            ("call_type",)))
+        # resilience layer (core/backoff.py; docs/RESILIENCE.md)
+        self.device_path_fallback = r(Counter(
+            "scheduler_device_path_fallback_total",
+            "Scheduling work rerouted from the device kernel path to the "
+            "host Evaluator, by reason (exception class, 'unsupported', or "
+            "'breaker_open').", ("reason",)))
+        self.device_breaker_state = r(Gauge(
+            "scheduler_device_path_breaker_open",
+            "1 while the device-path circuit breaker is open (host path "
+            "pinned for the cool-down), else 0.", ()))
         # opportunistic batching (runtime/batch.go series), generalized to
         # device sessions: a "flush" is a session invalidation.
         self.batch_cache_flushed = r(Counter(

@@ -103,7 +103,10 @@ class SchedulerServer:
         bindings = getattr(cs, "bindings", {})
         for pod in cs.pods.values():
             req = pod.resource_request()
-            node = bindings.get(pod.uid) or pod.node_name
+            # Pending pods get an EMPTY node label (the reference's
+            # kube_pod_resource_request convention) — `or ""` keeps a None
+            # node_name from rendering as the literal string "None".
+            node = bindings.get(pod.uid) or pod.node_name or ""
             phase = "Running" if node else "Pending"
             for res_name, val in (("cpu", req.milli_cpu / 1000.0),
                                   ("memory", float(req.memory))):

@@ -26,10 +26,13 @@ generalized from one-pod hint reuse to true multi-pod kernel batches.
 
 from __future__ import annotations
 
+import logging
 import time as _time
 from typing import List, Optional, Tuple
 
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 from ..core.framework import OK as _OK_STATUS
 from ..core.framework import WAIT, Framework
@@ -131,6 +134,20 @@ class TPUScheduler(Scheduler):
         # must not join — the kernel counts attach units per landing, the
         # host per distinct claim (see ops/features.py volume_device_support).
         self._session_claims: set = set()
+        # Device-path circuit breaker (core/backoff.py; docs/RESILIENCE.md):
+        # any unexpected exception from the device path is caught ONCE, the
+        # work reruns on the host Evaluator, and after N consecutive
+        # failures the breaker pins the host path for a cool-down. The host
+        # path produces identical assignments (the repo's core equivalence
+        # invariant), so degradation is graceful, never a crashed cycle.
+        from ..core.backoff import CircuitBreaker
+        self.device_breaker = CircuitBreaker(
+            failure_threshold=getattr(
+                self.config, "device_breaker_threshold", 3),
+            cooldown=getattr(self.config, "device_breaker_cooldown", 5.0))
+        # Chaos seam (testing/faults.py DeviceFaults): called at every
+        # device kernel boundary crossing; may raise.
+        self._fault_hook = None
 
     # -- batch accumulation ------------------------------------------------
 
@@ -268,6 +285,25 @@ class TPUScheduler(Scheduler):
         return sorted(qgpi.members, key=lambda m: (-m.pod.priority, m.timestamp))
 
     def run_gang_device_session(self, fw: Framework, first: QueuedPodGroupInfo) -> None:
+        """Crash-proof wrapper — see run_device_session: stranded packs
+        rerun on the host group cycle on an unexpected device failure."""
+        pending: List[List[QueuedPodGroupInfo]] = []
+        try:
+            self._run_gang_device_session(fw, first, pending)
+        except Unsupported:
+            raise
+        except Exception as e:  # noqa: BLE001 - device→host fallback
+            self._note_device_failure(e, "gang_device_session")
+            for pk in pending:
+                for g in pk:
+                    self._recover_qpi(g)
+
+    def _run_gang_device_session(self, fw: Framework,
+                                 first: QueuedPodGroupInfo,
+                                 pending: List[List[QueuedPodGroupInfo]]) -> None:
+        pack: Optional[List[QueuedPodGroupInfo]] = [first]
+        pending.append(pack)  # crash-recovery registry (wrapper);
+        # registered BEFORE build_plan so a plan-build crash recovers too.
         sig = fw.sign_pod(first.members[0].pod)
         aux_shape = self._aux_shape(first.members[0].pod)
         # Claims already accepted into this session (all members' PVCs):
@@ -298,7 +334,6 @@ class TPUScheduler(Scheduler):
         ok_rows: List[int] = []
         dirty_rows: List[int] = []
         invalidated = False
-        pack: Optional[List[QueuedPodGroupInfo]] = [first]
 
         def collect_pack() -> List[QueuedPodGroupInfo]:
             groups: List[QueuedPodGroupInfo] = []
@@ -329,6 +364,7 @@ class TPUScheduler(Scheduler):
                     pack = collect_pack() or None
                     if pack is None:
                         break
+                    pending.append(pack)
                 members = [m for g in pack for m in self._sorted_members(g)]
                 results, carry = self._dispatch(state, plan, len(members), carry)
                 try:
@@ -358,6 +394,8 @@ class TPUScheduler(Scheduler):
                     for m in self._sorted_members(g):
                         self.host_path_pods += 1
                     self.process_one(g)
+                if groups in pending:
+                    pending.remove(groups)
                 continue
             i = 0
             for g in groups:
@@ -393,12 +431,16 @@ class TPUScheduler(Scheduler):
                 # consumption for gang sessions).
                 self.metrics.pod_scheduled_after_flush.inc(value=len(ok_rows))
                 self._after_flush = False
+            if groups in pending:
+                pending.remove(groups)  # fully handled: out of crash recovery
 
         if pack:
             for g in pack:
                 for _ in g.members:
                     self.host_path_pods += 1
                 self.process_one(g)
+            if pack in pending:
+                pending.remove(pack)
 
         self.cache.update_snapshot(self.snapshot)
         if invalidated:
@@ -417,6 +459,7 @@ class TPUScheduler(Scheduler):
                      self.state_unwinds),
                     (state, plan, carry, node_names),
                     self._nom_resume_key(first.members[0].pod.priority))
+        self._note_device_success()
 
     def _commit_gang_group(self, fw: Framework, qgpi: QueuedPodGroupInfo,
                            members: List[QueuedPodInfo], rows, node_names,
@@ -651,6 +694,69 @@ class TPUScheduler(Scheduler):
             candidates.append((placement, assignment, pga))
         return candidates
 
+    # -- resilience: device→host fallback + circuit breaker ----------------
+
+    def _note_device_failure(self, exc: BaseException, where: str) -> None:
+        """One unexpected device-path exception: log it, count it, charge
+        the breaker, and discard every piece of device-resident state the
+        failure may have poisoned (mirror, resume carry, plan caches). The
+        caller reroutes the affected work to the host Evaluator."""
+        reason = type(exc).__name__
+        _log.error("device path failed in %s (%s: %s) — falling back to the "
+                   "host path", where, reason, exc, exc_info=True)
+        self.metrics.device_path_fallback.inc(reason)
+        opened = self.device_breaker.record_failure()
+        if opened:
+            _log.error(
+                "device-path circuit breaker OPEN after %d consecutive "
+                "failures; host path pinned for %.1fs",
+                self.device_breaker.consecutive_failures,
+                self.device_breaker.cooldown)
+        self.metrics.device_breaker_state.set(
+            0.0 if self.device_breaker.allows() else 1.0)
+        self.mirror.invalidate()
+        self._resume = None
+        self._placement_plan_cache = None
+        self._placement_mask_cache = None
+        self._fail_memo.clear()
+        self.metrics.batch_cache_flushed.inc("device_path_failure")
+        self._after_flush = True
+
+    def _note_device_success(self) -> None:
+        self.device_breaker.record_success()
+        self.metrics.device_breaker_state.set(0.0)
+
+    def _recover_qpi(self, qpi) -> None:
+        """Host-path one entity stranded by a mid-session device failure.
+        Pods the session already committed (bound or assumed onto a node)
+        are done — re-running them would double-place; everything else gets
+        the exact host cycle."""
+        members = getattr(qpi, "members", None)
+        bindings = getattr(self.clientset, "bindings", None) or {}
+        if members is None:
+            pod = qpi.pod
+            if pod.node_name or pod.uid in bindings:
+                self.queue.done(pod.uid)
+                return
+            self.host_path_pods += 1
+            self.process_one(qpi)
+        else:
+            remaining = [m for m in members
+                         if not (m.pod.node_name or m.pod.uid in bindings)]
+            if not remaining:
+                # _commit_gang_group finished this group before the crash
+                # (it already cleared members + queue bookkeeping): re-running
+                # the group cycle would double-place every member.
+                return
+            if len(remaining) < len(members):
+                # Crash mid-gang-commit: some members are already bound.
+                # Rerun the group cycle over the UNBOUND tail only — the
+                # bound members are real cluster load now, and re-placing
+                # them would double-count.
+                qpi.members = remaining
+            self.host_path_pods += len(remaining)
+            self.process_one(qpi)
+
     # -- device preemption dry run -----------------------------------------
 
     def device_dry_run_preemption(self, fw: Framework, state, pod,
@@ -665,12 +771,28 @@ class TPUScheduler(Scheduler):
         freed host ports, freed attach room), which the static-filter + fit
         arithmetic kernel doesn't model. The SELECTED candidate is
         host-verified by the caller (plugins/preemption.py post_filter)."""
-        if not self.device_enabled:
+        if not self.device_enabled or not self.device_breaker.allows():
             return None
         if self._resources_only_block(pod) is not None:
             return None
         if self._device_unsupported_profile(fw, pod) is not None:
             return None
+        try:
+            return self._device_dry_run_preemption(
+                fw, pod, node_to_status, num_candidates, start)
+        except Unsupported:
+            return None
+        except Exception as e:  # noqa: BLE001 - crash-proof fallback
+            # The failure class ADVICE r5 found (victim tensors at one
+            # r_slots width, the plan at another) lands here if a new
+            # variant ever appears: one count, one breaker charge, and the
+            # host Evaluator reruns the dry run exactly — never a crashed
+            # PostFilter cycle.
+            self._note_device_failure(e, "preemption_dry_run")
+            return None
+
+    def _device_dry_run_preemption(self, fw: Framework, pod, node_to_status,
+                                   num_candidates: int, start: int):
         self.cache.update_snapshot(self.snapshot)
         nodes = self.snapshot.node_info_list
         if any(ni.pods_with_required_anti_affinity for ni in nodes):
@@ -683,10 +805,21 @@ class TPUScheduler(Scheduler):
         if built is None:
             return None
         vic_req, vic_valid, potential = built
-        try:
-            dstate, plan = self.build_plan(fw, pod, 1)
-        except Unsupported:
-            return None
+        dstate, plan = self.build_plan(fw, pod, 1)
+        if vic_req.shape[2] != self.mirror.r_slots:
+            # build_plan interned the preemptor's never-seen scalar slots
+            # AFTER the victim tensors were built, growing the mirror's
+            # resource tier (ADVICE r5 medium). The grown slots name
+            # resources no victim carries, so zero-padding vic_req to the
+            # plan's width is exact — without it the kernel's
+            # `state.req_r - sum_vic` raises a shape error.
+            grown = np.zeros(
+                (vic_req.shape[0], vic_req.shape[1], self.mirror.r_slots),
+                np.int64)
+            grown[:, :, :vic_req.shape[2]] = vic_req
+            vic_req = grown
+        if self._fault_hook is not None:
+            self._fault_hook("preempt")
         import jax.numpy as jnp
         from ..core.framework import UNSCHEDULABLE_AND_UNRESOLVABLE
         from ..ops.kernel import dry_run_preemption
@@ -695,6 +828,7 @@ class TPUScheduler(Scheduler):
             dstate, plan.features, jnp.asarray(vic_req),
             jnp.asarray(vic_valid), vic_valid.shape[1]))
         self.preemption_device_evals += 1
+        self._note_device_success()
         feasible, vmask = res[:, 0], res[:, 1:]
         n = len(nodes)
         out = []
@@ -963,6 +1097,8 @@ class TPUScheduler(Scheduler):
         must be call-signature-identical (kwarg set included: static kwargs
         are part of jit's cache-key pytree structure), or the warmed trace
         misses and a ~1min XLA compile lands inside the measured window."""
+        if self._fault_hook is not None:
+            self._fault_hook("dispatch")
         return schedule_batch(
             state, plan.features, plan.batch_pad, plan.fit_strategy,
             plan.vmax, n_active=np.int32(n_active), carry_in=carry,
@@ -1120,6 +1256,28 @@ class TPUScheduler(Scheduler):
         return batch
 
     def run_device_session(self, fw: Framework, first_batch: List[QueuedPodInfo]) -> None:
+        """Crash-proof wrapper: an unexpected device failure mid-session
+        (kernel shape error, dispatch fault, poisoned carry) must not strand
+        the entities the session popped — every batch not yet fully
+        committed reruns on the host path, the mirror invalidates, and the
+        breaker is charged. Unsupported keeps its existing contract
+        (schedule_one host-paths first_batch)."""
+        pending: List[List[QueuedPodInfo]] = []
+        try:
+            self._run_device_session(fw, first_batch, pending)
+        except Unsupported:
+            raise
+        except Exception as e:  # noqa: BLE001 - device→host fallback
+            self._note_device_failure(e, "device_session")
+            for b in pending:
+                for qpi in b:
+                    self._recover_qpi(qpi)
+
+    def _run_device_session(self, fw: Framework,
+                            first_batch: List[QueuedPodInfo],
+                            pending: List[List[QueuedPodInfo]]) -> None:
+        pending.append(first_batch)  # crash-recovery registry (wrapper);
+        # registered BEFORE build_plan so a plan-build crash recovers too.
         sig = fw.sign_pod(first_batch[0].pod)
         # Signatures cover only the Sign plugins — NOT volumes/claims, whose
         # counted-constraint shape changes the PLAN (aux_room semantics). A
@@ -1180,6 +1338,7 @@ class TPUScheduler(Scheduler):
                             invalidated = True
                     if batch is None:
                         break
+                    pending.append(batch)
                 results, carry = self._dispatch(state, plan, len(batch), carry)
                 # Start the device→host copy NOW: on a tunneled TPU the
                 # result fetch pays a full pipeline-flush RTT (~10s of ms);
@@ -1233,11 +1392,15 @@ class TPUScheduler(Scheduler):
                         dirty_rows.append(row)
                     self.host_path_pods += 1
                     self.process_one(qpi)
+            if b in pending:
+                pending.remove(b)  # fully handled: out of crash recovery
 
         if batch:  # popped but never dispatched (invalidated mid-refill)
             for qpi in batch:
                 self.host_path_pods += 1
                 self.process_one(qpi)
+            if batch in pending:
+                pending.remove(batch)
 
         self.cache.update_snapshot(self.snapshot)
         if invalidated:
@@ -1260,6 +1423,9 @@ class TPUScheduler(Scheduler):
                      self.state_unwinds),
                     (state, plan, carry, node_names),
                     self._nom_resume_key(first_batch[0].pod.priority))
+        # The session ran to completion (invalidation included — that is a
+        # NORMAL end, not a device failure): a half-open breaker closes.
+        self._note_device_success()
 
     def _commit_batch(self, b, res, fw, node_names, ok_rows, dirty_rows) -> bool:
         """Host tail for one retired batch. Returns True when the session
@@ -1504,6 +1670,18 @@ class TPUScheduler(Scheduler):
     def schedule_one(self) -> bool:
         if not self.device_enabled:
             return super().schedule_one()  # TPUBatchScheduling gate off
+        if not self.device_breaker.allows():
+            # Breaker open: the host Evaluator owns every cycle until the
+            # cool-down elapses (then ONE probe session runs half-open).
+            # The device path's holdover slot (an entity popped by a session
+            # refill but never dispatched) MUST drain here — the host
+            # schedule_one only pops the queue and would strand it forever.
+            if self._holdover is not None:
+                qpi, self._holdover = self._holdover, None
+                self.host_path_pods += len(getattr(qpi, "members", ()) or (1,))
+                self.process_one(qpi)
+                return True
+            return super().schedule_one()
         self.process_async_api_errors()
         fw, batch, fallback_reason = self._collect_batch()
         if not batch:
@@ -1512,6 +1690,7 @@ class TPUScheduler(Scheduler):
             try:
                 self.run_gang_device_session(fw, batch[0])
             except Unsupported:
+                self.metrics.device_path_fallback.inc("unsupported")
                 for qpi in batch:
                     self.host_path_pods += len(getattr(qpi, "members", ()) or (1,))
                     self.process_one(qpi)
@@ -1528,6 +1707,7 @@ class TPUScheduler(Scheduler):
         try:
             self.run_device_session(fw, batch)
         except Unsupported:
+            self.metrics.device_path_fallback.inc("unsupported")
             for qpi in batch:
                 self.host_path_pods += 1
                 self.process_one(qpi)

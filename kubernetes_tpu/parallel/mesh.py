@@ -143,10 +143,20 @@ def collective_report(compiled_text: str, n_hosts: int, per_host: int) -> dict:
         line = m.group(0)
         op = m.group(1)
         groups = []
-        rg = re.search(r"replica_groups=\{(.*?)\}\s*(?:,|$)", line)
+        # Match the FULL braced list: a non-greedy `\{(.*?)\}` would stop at
+        # the first '}' of nested groups like {{0,1},{2,3}} and classify
+        # only the first replica group — a collective whose later groups
+        # span hosts would be misreported as ICI (ADVICE r5).
+        rg = re.search(
+            r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*|[^{}]*)\}", line)
         if rg is not None:
-            groups = [[int(x) for x in g.split(",") if x.strip()]
-                      for g in re.findall(r"\{([\d,]*)\}", rg.group(0))]
+            inner = rg.group(1)
+            if "{" in inner:
+                groups = [[int(x) for x in g.split(",") if x.strip()]
+                          for g in re.findall(r"\{([\d,]*)\}", inner)]
+            elif inner.strip():
+                # flat form: replica_groups={0,1,2,3} — one group
+                groups = [[int(x) for x in inner.split(",") if x.strip()]]
         stp = re.search(r"source_target_pairs=\{(.*)?\}", line)
         if stp is not None:
             groups = [[int(x) for x in pair.split(",")]

@@ -34,6 +34,7 @@ import os
 import socket
 import struct
 import threading
+import time
 from typing import List, Optional
 
 _LEN = struct.Struct(">I")
@@ -66,22 +67,30 @@ class SidecarServer:
     per connection; multiple sequential connections supported (the host
     scheduler reconnects after a sidecar restart, like any RPC client)."""
 
-    def __init__(self, socket_path: str):
+    def __init__(self, socket_path: str, max_batch: Optional[int] = None,
+                 mesh="auto"):
         self.socket_path = socket_path
         from ..core import FakeClientset
         from ..models import TPUScheduler
         self._cs = FakeClientset()
-        self._sched = TPUScheduler(clientset=self._cs)
+        self._sched = TPUScheduler(clientset=self._cs, max_batch=max_batch,
+                                   mesh=mesh)
         self._listener: Optional[socket.socket] = None
         self._stop = threading.Event()
+        self._conns: set = set()  # live client connections (kill())
+        self.served_connections = 0  # accepted connections (tests)
 
     # -- verbs -------------------------------------------------------------
 
     def _sync(self, req: dict) -> dict:
         """Full node-set replacement (the prototype's re-list; a production
         sidecar would take generation-keyed diffs exactly like the mirror's
-        dirty rows)."""
-        from ..core.apiserver import node_from_wire
+        dirty rows). An optional "pods" list carries BOUND pods: after a
+        sidecar restart the fresh mirror has no memory of earlier
+        placements, so the client's reconnect resync replays them as load
+        (the reconstructible-from-host-snapshot contract, docs/SIDECAR.md
+        + docs/RESILIENCE.md)."""
+        from ..core.apiserver import node_from_wire, pod_from_wire
         wanted = {}
         for w in req.get("nodes", ()):
             node = node_from_wire(w)
@@ -94,13 +103,34 @@ class SidecarServer:
                 self._cs.update_node(node)
             else:
                 self._cs.create_node(node)
+        for w in req.get("pods", ()):
+            if w.get("uid") in self._cs.pods:
+                continue  # live server, replayed sync: already tracked
+            pod = pod_from_wire(w)
+            if pod.node_name:  # bound pods only: they are node LOAD
+                self._cs.create_pod(pod)
+                self._cs.bindings[pod.uid] = pod.node_name
+        if "nextStartNodeIndex" in req and not self._cs.bindings:
+            # Round-robin rotation point: part of the reconstructible
+            # scheduling state — without it a restarted sidecar restarts
+            # its rotation at 0 and diverges from a fault-free run. Applied
+            # only while this instance has scheduled NOTHING: on a live
+            # server a reconnect resync carries the client's STALE value
+            # (from the last reply it actually read), and rolling a live
+            # rotation back would diverge exactly the way starting at 0
+            # would. A live server's own counter is always the truth.
+            self._sched.next_start_node_index = int(req["nextStartNodeIndex"])
         return {"ok": True}
 
     def _schedule(self, req: dict) -> dict:
         from ..core.apiserver import pod_from_wire
         pods = [pod_from_wire(w) for w in req.get("pods", ())]
         for p in pods:
-            self._cs.create_pod(p)
+            # Replay-idempotent (a reconnect replays the request whose reply
+            # was lost): a pod this mirror already bound keeps its binding
+            # instead of being re-created as pending and double-counted.
+            if p.uid not in self._cs.bindings:
+                self._cs.create_pod(p)
         self._sched.run_until_idle()
         assignments: List[Optional[str]] = []
         for p in pods:
@@ -112,7 +142,8 @@ class SidecarServer:
             if p.uid not in self._cs.bindings:
                 self._cs.delete_pod(p)
         return {"assignments": assignments,
-                "deviceScheduled": self._sched.device_scheduled}
+                "deviceScheduled": self._sched.device_scheduled,
+                "nextStartNodeIndex": self._sched.next_start_node_index}
 
     # -- serving -----------------------------------------------------------
 
@@ -129,27 +160,43 @@ class SidecarServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 break
-            with conn:
-                while not self._stop.is_set():
-                    req = _recv(conn)
-                    if req is None:
-                        break
-                    try:
-                        verb = req.get("verb")
-                        if verb == "ping":
-                            _send(conn, {"ok": True})
-                        elif verb == "sync":
-                            _send(conn, self._sync(req))
-                        elif verb == "schedule":
-                            _send(conn, self._schedule(req))
-                        elif verb == "shutdown":
-                            _send(conn, {"ok": True})
-                            self._stop.set()
-                        else:
-                            _send(conn, {"error": f"unknown verb {verb!r}"})
-                    except Exception as e:  # noqa: BLE001 - wire error reply
-                        _send(conn, {"error": repr(e)})
+            self._conns.add(conn)
+            self.served_connections += 1
+            try:
+                with conn:
+                    self._serve_connection(conn)
+            except OSError:
+                # Client died mid-exchange (reset, broken pipe): this
+                # connection is gone; the server survives and accepts the
+                # client's reconnect — a sidecar must never crash because
+                # its caller did.
+                pass
+            finally:
+                self._conns.discard(conn)
         self._listener.close()
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        while not self._stop.is_set():
+            req = _recv(conn)
+            if req is None:
+                break
+            try:
+                verb = req.get("verb")
+                if verb == "ping":
+                    _send(conn, {"ok": True})
+                elif verb == "sync":
+                    _send(conn, self._sync(req))
+                elif verb == "schedule":
+                    _send(conn, self._schedule(req))
+                elif verb == "shutdown":
+                    _send(conn, {"ok": True})
+                    self._stop.set()
+                else:
+                    _send(conn, {"error": f"unknown verb {verb!r}"})
+            except OSError:
+                raise  # transport dead: drop the connection, not the server
+            except Exception as e:  # noqa: BLE001 - wire error reply
+                _send(conn, {"error": repr(e)})
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -159,41 +206,143 @@ class SidecarServer:
             except OSError:
                 pass
 
+    def kill(self) -> None:
+        """Abrupt death (chaos: SIGKILL analogue): tear down the listener
+        AND every live connection mid-exchange, no goodbye. Clients see a
+        reset; a replacement server may then bind the same socket path."""
+        self._stop.set()
+        for s in list(self._conns) + ([self._listener] if self._listener else []):
+            try:
+                s.close()
+            except OSError:
+                pass
+
 
 class SidecarClient:
-    """The host scheduler's side of the contract."""
+    """The host scheduler's side of the contract.
 
-    def __init__(self, socket_path: str, timeout: float = 60.0):
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.settimeout(timeout)
-        self._sock.connect(socket_path)
+    Crash-proof: a dead connection (sidecar killed/restarted, reset
+    mid-reply) reconnects with backoff and REPLAYS the failed request. The
+    sidecar's mirror is reconstructible-from-host-snapshot (docs/SIDECAR.md
+    state ownership), so the client re-sends its last `sync` payload on
+    every reconnect before the replay — a freshly restarted sidecar sees
+    the node set first, exactly like the first connection did. A `schedule`
+    whose reply was lost replays whole; the batch re-schedules against the
+    re-synced mirror (level-triggered, like a re-attempted in-process
+    cycle)."""
 
-    def _call(self, req: dict) -> dict:
-        _send(self._sock, req)
-        resp = _recv(self._sock)
+    def __init__(self, socket_path: str, timeout: float = 60.0, retry=None):
+        from ..core.backoff import RetryConfig
+        self._path = socket_path
+        self._timeout = timeout
+        self._retry_cfg = retry or RetryConfig(
+            initial_backoff=0.05, max_backoff=2.0, max_attempts=8)
+        self._last_sync: Optional[dict] = None
+        # Every placement this client has bound since its last sync, by uid
+        # (pod wire + nodeName): the reconnect resync replays these so a
+        # RESTARTED sidecar rebuilds its load picture, not just its nodes.
+        self._bound_pods: dict = {}
+        self._next_start: Optional[int] = None  # rotation point (resync)
+        self.reconnects = 0
+        self._sock = self._connect()
+
+    def _connect(self) -> socket.socket:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self._timeout)
+        sock.connect(self._path)
+        return sock
+
+    def _roundtrip(self, sock: socket.socket, req: dict) -> dict:
+        _send(sock, req)
+        resp = _recv(sock)
         if resp is None:
             raise ConnectionError("sidecar closed the connection")
+        return resp
+
+    def _call(self, req: dict) -> dict:
+        try:
+            resp = self._roundtrip(self._sock, req)
+        except (ConnectionError, OSError):
+            resp = self._reconnect_and_replay(req)
         if "error" in resp:
             raise RuntimeError(f"sidecar: {resp['error']}")
         return resp
+
+    def _reconnect_and_replay(self, req: dict) -> dict:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        last_exc: Optional[BaseException] = None
+        if req.get("verb") == "sync":
+            # The dying request IS a sync: enrich the replay itself with the
+            # bound-pod load + rotation point, so a server restarted
+            # mid-sync still rebuilds the full mirror state (a bare node
+            # list would leave it loadless at rotation 0).
+            req = dict(req)
+            if self._bound_pods:
+                req.setdefault("pods", list(self._bound_pods.values()))
+            if self._next_start is not None:
+                req.setdefault("nextStartNodeIndex", self._next_start)
+        for delay in self._retry_cfg.delays():
+            time.sleep(delay)
+            try:
+                sock = self._connect()
+                # Re-establish the mirror before replaying (idempotent if
+                # the server never died; required if it restarted empty):
+                # the node set from the last sync plus every placement this
+                # client has bound since.
+                if self._last_sync is not None and req.get("verb") != "sync":
+                    resync = dict(self._last_sync)
+                    if self._bound_pods:
+                        resync["pods"] = list(self._bound_pods.values())
+                    if self._next_start is not None:
+                        resync["nextStartNodeIndex"] = self._next_start
+                    self._roundtrip(sock, resync)
+                resp = self._roundtrip(sock, req)
+            except (ConnectionError, OSError) as e:
+                last_exc = e
+                continue
+            self._sock = sock
+            self.reconnects += 1
+            return resp
+        raise ConnectionError(
+            f"sidecar unreachable at {self._path} after "
+            f"{self._retry_cfg.max_attempts - 1} reconnect attempts"
+        ) from last_exc
 
     def ping(self) -> bool:
         return bool(self._call({"verb": "ping"}).get("ok"))
 
     def sync_nodes(self, nodes) -> None:
         from ..core.apiserver import node_to_wire
-        self._call({"verb": "sync",
-                    "nodes": [node_to_wire(n) for n in nodes]})
+        req = {"verb": "sync", "nodes": [node_to_wire(n) for n in nodes]}
+        self._last_sync = req
+        # _bound_pods is NOT cleared: a later restart-resync must replay
+        # every placement this client ever bound, not just the ones since
+        # the last node sync (the server keeps them; a fresh server needs
+        # them all).
+        self._call(req)
 
     def schedule(self, pods) -> List[Optional[str]]:
         from ..core.apiserver import pod_to_wire
-        resp = self._call({"verb": "schedule",
-                           "pods": [pod_to_wire(p) for p in pods]})
-        return resp["assignments"]
+        wires = [pod_to_wire(p) for p in pods]
+        resp = self._call({"verb": "schedule", "pods": wires})
+        assignments = resp["assignments"]
+        for w, node in zip(wires, assignments):
+            if node:
+                bound = dict(w)
+                bound["nodeName"] = node
+                self._bound_pods[w["uid"]] = bound
+        if resp.get("nextStartNodeIndex") is not None:
+            self._next_start = int(resp["nextStartNodeIndex"])
+        return assignments
 
     def shutdown_server(self) -> None:
+        # Graceful-stop best effort: no reconnect dance for a server we are
+        # telling to exit.
         try:
-            self._call({"verb": "shutdown"})
+            self._roundtrip(self._sock, {"verb": "shutdown"})
         except (ConnectionError, OSError):
             pass
 

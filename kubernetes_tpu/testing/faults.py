@@ -1,0 +1,206 @@
+"""Fault injection for the control-plane and device boundaries.
+
+The chaos layer the resilience subsystem (docs/RESILIENCE.md) is tested
+against. Three seams, matching the process boundaries the production
+deployment has:
+
+- ``FlakyClientset`` — wraps any clientset and makes WRITE verbs raise
+  retriable :class:`~..core.backoff.TransientAPIError` (5xx/timeout
+  analogue) on a deterministic seeded schedule. Reads and informer
+  registration pass through untouched. Pair with ``RetryingClientset``
+  (core/clientset.py) to prove write-path retries.
+
+- ``ChaosTCPProxy`` — a byte-pump TCP proxy in front of the REST+watch
+  apiserver (core/apiserver.py). ``drop_connections()`` resets every live
+  connection mid-stream (the dropped-watch / connection-reset fault);
+  ``delay`` slows responses. The reflector's resourceVersion re-list runs
+  against exactly this.
+
+- ``DeviceFaults`` — installed as ``TPUScheduler._fault_hook``; raises a
+  configured exception on the Nth device kernel boundary crossing
+  (``dispatch`` / ``preempt``), driving the device→host fallback and the
+  circuit breaker.
+
+Sidecar process kill rides ``SidecarServer.kill()`` (parallel/sidecar.py):
+an abrupt listener+connection teardown, distinct from graceful shutdown.
+
+Everything is deterministically seeded: a chaos test that fails replays
+byte-for-byte from its seed.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+from typing import Dict, Iterable, Optional
+
+from ..core.backoff import TransientAPIError
+
+# Clientset write verbs the chaos layer may afflict (the API-mutation
+# surface the scheduler exercises).
+WRITE_VERBS = (
+    "create_pod", "update_pod", "delete_pod", "bind", "patch_pod_status",
+    "create_node", "update_node", "delete_node",
+)
+
+
+class FlakyClientset:
+    """Deterministic write-fault decorator over any clientset.
+
+    ``fail_first`` maps verb -> how many leading calls of that verb raise;
+    ``failure_rate`` additionally fails each write call with the given
+    seeded probability. Injected failures raise BEFORE the inner verb runs
+    (the write never lands — a replay is required, like a request that
+    died on the wire). ``injected`` counts faults by verb for assertions.
+    """
+
+    def __init__(self, inner, seed: int = 0, failure_rate: float = 0.0,
+                 fail_first: Optional[Dict[str, int]] = None,
+                 exc_factory=TransientAPIError):
+        self._inner = inner
+        self._rng = random.Random(seed)
+        self._rate = failure_rate
+        self._fail_first = dict(fail_first or {})
+        self._exc_factory = exc_factory
+        self.injected: Dict[str, int] = {}
+
+    def _maybe_fail(self, verb: str) -> None:
+        remaining = self._fail_first.get(verb, 0)
+        if remaining > 0:
+            self._fail_first[verb] = remaining - 1
+        elif not (self._rate and self._rng.random() < self._rate):
+            return
+        self.injected[verb] = self.injected.get(verb, 0) + 1
+        raise self._exc_factory(f"injected fault on {verb}")
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in WRITE_VERBS:
+            def flaky(*args, _attr=attr, _verb=name, **kwargs):
+                self._maybe_fail(_verb)
+                return _attr(*args, **kwargs)
+            return flaky
+        return attr
+
+
+class ChaosTCPProxy:
+    """TCP byte pump with a kill switch, for resetting watch streams and
+    in-flight requests between a client and the apiserver."""
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 delay: float = 0.0):
+        self.upstream = (upstream_host, upstream_port)
+        self.delay = delay
+        self._conns: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.drops = 0  # connections reset by drop_connections()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="chaos-proxy-accept", daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                server = socket.create_connection(self.upstream, timeout=10)
+            except OSError:
+                client.close()
+                continue
+            with self._lock:
+                self._conns.add(client)
+                self._conns.add(server)
+            for src, dst in ((client, server), (server, client)):
+                threading.Thread(target=self._pump, args=(src, dst),
+                                 daemon=True).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                if self.delay:
+                    self._stop.wait(self.delay)
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                with self._lock:
+                    self._conns.discard(s)
+
+    def drop_connections(self) -> int:
+        """Reset every live proxied connection NOW (watch streams included).
+        New connections keep working — this is a network blip, not an
+        outage. Returns how many sockets were torn down."""
+        with self._lock:
+            victims = list(self._conns)
+            self._conns.clear()
+        for s in victims:
+            try:
+                # linger(on, 0): close sends RST, not FIN — a real reset.
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             struct.pack("ii", 1, 0))
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.drops += len(victims)
+        return len(victims)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.drop_connections()
+
+
+class DeviceFaults:
+    """Fault hook for TPUScheduler's device kernel boundaries.
+
+    Install as ``scheduler._fault_hook``. Raises ``exc_factory()`` when the
+    running count of crossings for a site ('dispatch' | 'preempt') lands in
+    that site's configured set. Counts are 1-based and per-site, so a plan
+    like ``DeviceFaults(dispatch={3}, preempt={1})`` is fully
+    deterministic regardless of interleaving."""
+
+    def __init__(self, dispatch: Iterable[int] = (),
+                 preempt: Iterable[int] = (),
+                 exc_factory=lambda: RuntimeError("injected device fault")):
+        self._plan = {"dispatch": set(dispatch), "preempt": set(preempt)}
+        self._exc_factory = exc_factory
+        self.calls: Dict[str, int] = {"dispatch": 0, "preempt": 0}
+        self.injected: Dict[str, int] = {"dispatch": 0, "preempt": 0}
+
+    def __call__(self, site: str) -> None:
+        self.calls[site] = self.calls.get(site, 0) + 1
+        if self.calls[site] in self._plan.get(site, ()):
+            self.injected[site] = self.injected.get(site, 0) + 1
+            raise self._exc_factory()

@@ -18,3 +18,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    # Deterministic-seed fault-injection tests (tests/test_faults.py) run in
+    # tier-1 under `chaos`; long kill/restart stress rides `slow` and is
+    # excluded by the tier-1 `-m 'not slow'` selection.
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection tests (tier-1)")
+    config.addinivalue_line(
+        "markers", "slow: long-running stress tests (excluded from tier-1)")

@@ -291,9 +291,13 @@ def test_metric_name_parity_with_reference():
     missing = expected - registered
     assert not missing, f"missing reference series: {sorted(missing)}"
     extra = registered - expected
-    # Our additions beyond the reference set (device-path series).
+    # Our additions beyond the reference set (device-path + resilience
+    # series; docs/RESILIENCE.md).
     assert extra <= {"scheduler_batch_size",
-                     "scheduler_podgroup_generated_placements"}, extra
+                     "scheduler_podgroup_generated_placements",
+                     "scheduler_async_api_call_retries_total",
+                     "scheduler_device_path_fallback_total",
+                     "scheduler_device_path_breaker_open"}, extra
 
 
 def test_new_series_populate_during_scheduling():

@@ -1,0 +1,681 @@
+"""Chaos suite: fault injection against every resilience boundary
+(docs/RESILIENCE.md).
+
+Deterministic-seed tests carry the `chaos` marker and run in tier-1; the
+long kill/restart stress is `slow` (excluded by `-m 'not slow'`).
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.core import FakeClientset, Scheduler
+from kubernetes_tpu.core.api_dispatcher import (APICall, APIDispatcher,
+                                                CALL_BINDING)
+from kubernetes_tpu.core.backoff import (CircuitBreaker, RetryConfig,
+                                         TransientAPIError, is_retriable,
+                                         retry_call)
+from kubernetes_tpu.core.clientset import RetryingClientset
+from kubernetes_tpu.testing.faults import (ChaosTCPProxy, DeviceFaults,
+                                           FlakyClientset)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+FAST_RETRY = RetryConfig(initial_backoff=0.001, max_backoff=0.01,
+                         max_attempts=4, seed=0)
+
+
+def _nodes(n, cpu=16):
+    return [make_node().name(f"n{i}")
+            .capacity({"cpu": cpu, "memory": "64Gi", "pods": 110})
+            .zone(f"z{i % 4}").obj() for i in range(n)]
+
+
+def _pods(n, cpu="100m"):
+    proto = (make_pod().name("proto").req({"cpu": cpu, "memory": "64Mi"})
+             .labels({"app": "chaos"}).obj())
+    return [proto.clone_from_template(f"p{i}") for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# backoff.py units
+# ---------------------------------------------------------------------------
+
+
+class TestBackoff:
+    def test_delays_deterministic_and_bounded(self):
+        cfg = RetryConfig(initial_backoff=0.1, max_backoff=0.5,
+                          multiplier=2.0, jitter=0.2, max_attempts=6, seed=7)
+        a, b = list(cfg.delays()), list(cfg.delays())
+        assert a == b  # same seed, same sequence
+        assert len(a) == 5
+        assert all(d <= 0.5 * 1.2 + 1e-9 for d in a)
+        assert a[0] < a[-1]  # grows toward the cap
+
+    def test_is_retriable_taxonomy(self):
+        import http.client
+        from urllib.error import HTTPError, URLError
+        assert is_retriable(TransientAPIError("x"))
+        assert is_retriable(ConnectionResetError())
+        assert is_retriable(TimeoutError())
+        assert is_retriable(socket.timeout())
+        assert is_retriable(HTTPError("u", 503, "boom", {}, None))
+        assert not is_retriable(HTTPError("u", 404, "nope", {}, None))
+        assert is_retriable(URLError(ConnectionResetError()))
+        assert is_retriable(http.client.RemoteDisconnected())
+        assert not is_retriable(KeyError("pod not found"))
+        assert not is_retriable(ValueError("bad spec"))
+
+    def test_retry_call_replays_then_succeeds(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise TransientAPIError("blip")
+            return "ok"
+
+        assert retry_call(flaky, FAST_RETRY, sleep=lambda d: None) == "ok"
+        assert calls["n"] == 3
+
+    def test_retry_call_nonretriable_raises_immediately(self):
+        calls = {"n": 0}
+
+        def broken():
+            calls["n"] += 1
+            raise KeyError("missing")
+
+        with pytest.raises(KeyError):
+            retry_call(broken, FAST_RETRY, sleep=lambda d: None)
+        assert calls["n"] == 1
+
+    def test_retry_call_budget_exhausted(self):
+        with pytest.raises(TransientAPIError):
+            retry_call(lambda: (_ for _ in ()).throw(TransientAPIError("x")),
+                       FAST_RETRY, sleep=lambda d: None)
+
+    def test_circuit_breaker_lifecycle(self):
+        t = {"now": 0.0}
+        br = CircuitBreaker(failure_threshold=3, cooldown=5.0,
+                            clock=lambda: t["now"])
+        assert br.allows() and br.state == "closed"
+        assert not br.record_failure()
+        assert not br.record_failure()
+        assert br.record_failure()  # third consecutive: opens
+        assert br.state == "open" and not br.allows()
+        t["now"] = 5.1
+        assert br.state == "half-open" and br.allows()  # one probe
+        assert br.record_failure()  # failed probe: re-opens
+        assert not br.allows()
+        t["now"] = 10.3
+        assert br.allows()
+        br.record_success()  # clean probe: closes
+        assert br.state == "closed" and br.open_count == 2
+        br.record_failure()
+        br.record_success()  # success resets the consecutive count
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# clientset write retries
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestClientsetRetries:
+    def test_write_retries_transparent(self):
+        inner = FakeClientset()
+        flaky = FlakyClientset(inner, fail_first={"create_pod": 2, "bind": 1})
+        rcs = RetryingClientset(flaky, retry=FAST_RETRY)
+        pod = _pods(1)[0]
+        rcs.create_node(_nodes(1)[0])
+        rcs.create_pod(pod)  # 2 injected faults, then lands
+        assert pod.uid in inner.pods
+        rcs.bind(pod, "n0")
+        assert inner.bindings[pod.uid] == "n0"
+        assert rcs.retries_total == 3
+        assert flaky.injected == {"create_pod": 2, "bind": 1}
+        assert rcs.give_ups == 0
+
+    def test_semantic_error_not_retried(self):
+        inner = FakeClientset()
+        rcs = RetryingClientset(FlakyClientset(inner), retry=FAST_RETRY)
+        with pytest.raises(KeyError):
+            rcs.bind(_pods(1)[0], "n0")  # pod never created: not transient
+        assert rcs.retries_total == 0
+
+    def test_budget_exhaustion_propagates(self):
+        inner = FakeClientset()
+        flaky = FlakyClientset(inner, fail_first={"create_pod": 99})
+        rcs = RetryingClientset(flaky, retry=FAST_RETRY)
+        with pytest.raises(TransientAPIError):
+            rcs.create_pod(_pods(1)[0])
+        assert rcs.give_ups == 1
+        assert rcs.retries_total == FAST_RETRY.max_attempts - 1
+
+    def test_reads_and_registration_delegate(self):
+        inner = FakeClientset()
+        rcs = RetryingClientset(FlakyClientset(inner), retry=FAST_RETRY)
+        seen = []
+        rcs.on_pod_event(lambda kind, old, new: seen.append(kind))
+        rcs.create_pod(_pods(1)[0])
+        assert seen == ["add"]
+        assert rcs.pods is inner.pods
+
+
+# ---------------------------------------------------------------------------
+# async API dispatcher retries
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestDispatcherRetries:
+    def _flaky_call(self, fails, log):
+        state = {"left": fails}
+
+        def execute():
+            if state["left"] > 0:
+                state["left"] -= 1
+                raise TransientAPIError("write timeout")
+            log.append("done")
+
+        return execute
+
+    def test_inline_mode_retries_before_error(self):
+        d = APIDispatcher(mode="inline", retry=FAST_RETRY)
+        log = []
+        d.add(APICall(CALL_BINDING, "u1", self._flaky_call(2, log)))
+        assert log == ["done"]
+        assert d.retried == 2 and d.executed == 1 and not d.errors
+
+    def test_thread_mode_retries_then_inbox_on_exhaustion(self):
+        d = APIDispatcher(mode="thread", retry=FAST_RETRY)
+        try:
+            log = []
+            d.add(APICall(CALL_BINDING, "ok", self._flaky_call(3, log)))
+            d.flush()
+            assert log == ["done"] and not d.has_errors()
+            failed = []
+            d.add(APICall(CALL_BINDING, "doomed", self._flaky_call(99, []),
+                          on_error=lambda e: failed.append(e)))
+            d.flush()
+            deadline = time.monotonic() + 5
+            while not d.has_errors() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            drained = d.drain_errors()
+            assert len(drained) == 1  # only the budget-exhausted call
+            assert isinstance(drained[0][1], TransientAPIError)
+        finally:
+            d.close()
+
+    def test_semantic_error_skips_retry(self):
+        d = APIDispatcher(mode="inline", retry=FAST_RETRY)
+        errs = []
+        d.add(APICall(CALL_BINDING, "u9",
+                      lambda: (_ for _ in ()).throw(KeyError("pod gone")),
+                      on_error=lambda e: errs.append(e)))
+        assert d.retried == 0 and len(errs) == 1
+
+
+# ---------------------------------------------------------------------------
+# sidecar: disconnects, kill + restart, request replay
+# ---------------------------------------------------------------------------
+
+
+def _start_sidecar(path, max_batch=64):
+    from kubernetes_tpu.parallel.sidecar import SidecarServer
+    # mesh=None: the single-device kernel path — this environment's XLA
+    # miscompiles the SPMD partitioning of the scan (pre-existing; the
+    # breaker contains it), and chaos tests need a WORKING device path.
+    server = SidecarServer(path, max_batch=max_batch, mesh=None)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            probe.connect(path)
+            probe.close()
+            return server, t
+        except OSError:
+            time.sleep(0.02)
+    raise TimeoutError("sidecar never came up")
+
+
+@pytest.mark.chaos
+def test_sidecar_survives_client_disconnects(tmp_path):
+    from kubernetes_tpu.parallel.sidecar import SidecarClient
+    path = str(tmp_path / "tpu.sock")
+    server, _ = _start_sidecar(path)
+    try:
+        # A client that sends a truncated frame and vanishes...
+        rude = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        rude.connect(path)
+        rude.sendall(b"\x00\x00\x00\x10partial")
+        rude.close()
+        # ...and one that resets mid-exchange...
+        rude2 = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        rude2.connect(path)
+        rude2.sendall(b"\x00\x00")
+        rude2.close()
+        # ...must not take the server down.
+        client = SidecarClient(path, timeout=10)
+        assert client.ping()
+        client.close()
+        assert server.served_connections >= 3
+    finally:
+        server.shutdown()
+
+
+def _oracle_assignments(nodes_fn, pods_fn):
+    cs = FakeClientset()
+    host = Scheduler(clientset=cs, deterministic_ties=True)
+    for n in nodes_fn():
+        cs.create_node(n)
+    for p in pods_fn():
+        cs.create_pod(p)
+    host.run_until_idle()
+    return {cs.pods[u].name: n for u, n in cs.bindings.items()}
+
+
+def _run_sidecar_batches(tmp_path, n_nodes, n_pods, batch, kill_at=()):
+    """Feed pods through the sidecar in batches, killing + restarting the
+    server process-analogue before the batch indices in `kill_at`. Returns
+    (assignments, client)."""
+    from kubernetes_tpu.parallel.sidecar import SidecarClient
+    path = str(tmp_path / "tpu.sock")
+    server, _ = _start_sidecar(path)
+    client = SidecarClient(
+        path, timeout=60,
+        retry=RetryConfig(initial_backoff=0.05, max_backoff=0.5,
+                          max_attempts=10, seed=3))
+    got = {}
+    try:
+        client.sync_nodes(_nodes(n_nodes))
+        pods = _pods(n_pods)
+        for bi in range(0, n_pods, batch):
+            if bi // batch in kill_at:
+                server.kill()  # SIGKILL analogue: no goodbye
+                server, _ = _start_sidecar(path)
+            chunk = pods[bi:bi + batch]
+            assignments = client.schedule(chunk)
+            for p, a in zip(chunk, assignments):
+                got[p.name] = a
+    finally:
+        client.shutdown_server()
+        client.close()
+        server.shutdown()
+    return got, client
+
+
+@pytest.mark.chaos
+def test_sidecar_kill_restart_replay(tmp_path):
+    """One sidecar kill+restart mid-run (100 nodes / 1000 pods): the client
+    reconnects, resyncs nodes + bound load + rotation, replays the lost
+    request, and the full assignment map still matches a fault-free
+    in-process oracle."""
+    got, client = _run_sidecar_batches(
+        tmp_path, n_nodes=100, n_pods=1000, batch=100, kill_at={3})
+    oracle = _oracle_assignments(lambda: _nodes(100), lambda: _pods(1000))
+    assert client.reconnects >= 1
+    unassigned = [k for k, v in got.items() if not v]
+    assert not unassigned, f"{len(unassigned)} pods unassigned"
+    diffs = {k: (oracle.get(k), got[k]) for k in got if got[k] != oracle.get(k)}
+    assert not diffs, f"{len(diffs)} divergences, e.g. {list(diffs.items())[:5]}"
+
+
+@pytest.mark.slow
+def test_sidecar_repeated_kill_stress(tmp_path):
+    """Long-running kill/restart stress: three kills across a 1000-pod run."""
+    got, client = _run_sidecar_batches(
+        tmp_path, n_nodes=100, n_pods=1000, batch=50, kill_at={4, 9, 14})
+    oracle = _oracle_assignments(lambda: _nodes(100), lambda: _pods(1000))
+    assert client.reconnects >= 3
+    assert {k: v for k, v in got.items() if v} == oracle
+
+
+# ---------------------------------------------------------------------------
+# device-path circuit breaker + the ADVICE r5 shape-error regression
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestDeviceBreaker:
+    def test_preemption_never_interned_scalar_regression(self):
+        """ADVICE r5 medium: a preemptor carrying a scalar resource the
+        mirror never interned grows r_slots inside build_plan AFTER the
+        victim tensors were built; the dry run must zero-pad and run, not
+        crash the PostFilter cycle with a shape error."""
+        from kubernetes_tpu.models import TPUScheduler
+        cs = FakeClientset()
+        sched = TPUScheduler(clientset=cs, max_batch=16, mesh=None)
+        # Four node-level scalar resources fill the mirror's default
+        # scalar tier exactly (s_cap=4): the NEXT interned scalar _grow()s.
+        for i in range(4):
+            cs.create_node(
+                make_node().name(f"n{i}")
+                .capacity({"cpu": 4, "memory": "8Gi", "pods": 110,
+                           "r0.example.com/a": 8, "r1.example.com/b": 8,
+                           "r2.example.com/c": 8, "r3.example.com/d": 8})
+                .obj())
+        for p in _pods(4, cpu="3"):  # victims: one 3-cpu pod per node
+            cs.create_pod(p)
+        sched.run_until_idle()
+        assert len(cs.bindings) == 4
+        r_slots_before = sched.mirror.r_slots
+        pre = (make_pod().name("preemptor").priority(10)
+               .req({"cpu": "2", "memory": "64Mi",
+                     "ghost.example.com/widget": 1}).obj())
+        fw = sched.framework_for_pod(pre)
+        # Pre-fix this raised a shape error out of the kernel call.
+        out = sched.device_dry_run_preemption(fw, None, pre, {}, 10, 0)
+        assert sched.mirror.r_slots > r_slots_before  # the tier DID grow
+        assert out is not None and out == []  # ghost resource: no candidate
+        assert sched.preemption_device_evals == 1
+        assert sched.device_breaker.state == "closed"
+        # The fix handles it exactly — no fallback was needed.
+        assert sched.metrics.device_path_fallback.value("RuntimeError") == 0
+
+    def test_preemption_kernel_crash_falls_back_to_host(self):
+        """The breaker backstop for the same class of failure: an injected
+        kernel fault makes the dry run return None (host Evaluator owns the
+        PostFilter), never a crash."""
+        from kubernetes_tpu.models import TPUScheduler
+        cs = FakeClientset()
+        sched = TPUScheduler(clientset=cs, max_batch=16, mesh=None)
+        for n in _nodes(4, cpu=4):
+            cs.create_node(n)
+        for p in _pods(4, cpu="3"):
+            cs.create_pod(p)
+        sched.run_until_idle()
+        faults = DeviceFaults(preempt={1})
+        sched._fault_hook = faults
+        pre = (make_pod().name("pre").priority(10)
+               .req({"cpu": "2", "memory": "64Mi"}).obj())
+        fw = sched.framework_for_pod(pre)
+        out = sched.device_dry_run_preemption(fw, None, pre, {}, 10, 0)
+        assert out is None  # host path owns the dry run
+        assert faults.injected["preempt"] == 1
+        assert sched.metrics.device_path_fallback.value("RuntimeError") == 1
+        assert sched.device_breaker.consecutive_failures == 1
+        # Next call (fault cleared) succeeds and closes the count.
+        sched._fault_hook = None
+        out2 = sched.device_dry_run_preemption(fw, None, pre, {}, 10, 0)
+        assert out2 is not None and len(out2) > 0
+        assert sched.device_breaker.consecutive_failures == 0
+
+    def test_session_crash_recovers_and_breaker_opens(self):
+        """Every dispatch fails → sessions crash → stranded pods rerun on
+        the host path, the breaker opens and pins the host path, and after
+        the cool-down a clean probe closes it. All pods bind throughout."""
+        from kubernetes_tpu.models import TPUScheduler
+        cs = FakeClientset()
+        sched = TPUScheduler(clientset=cs, max_batch=16, mesh=None)
+        t = {"now": 0.0}
+        sched.device_breaker = CircuitBreaker(
+            failure_threshold=2, cooldown=5.0, clock=lambda: t["now"])
+        for n in _nodes(8):
+            cs.create_node(n)
+        faults = DeviceFaults(dispatch=set(range(1, 100)))
+        sched._fault_hook = faults
+        for p in _pods(40):
+            cs.create_pod(p)
+        sched.run_until_idle()
+        assert len(cs.bindings) == 40  # zero stranded pods, zero crashes
+        assert sched.device_breaker.open_count >= 1
+        assert not sched.device_breaker.allows()  # open: host path pinned
+        assert sched.metrics.device_breaker_state.value() == 1.0
+        fallbacks = sched.metrics.device_path_fallback.value("RuntimeError")
+        assert fallbacks >= 2
+        calls_while_open = faults.calls["dispatch"]
+        for p in _pods(20):
+            p.uid += "-b"  # fresh uids for a second wave
+            cs.create_pod(p)
+        sched.run_until_idle()
+        assert len(cs.bindings) == 60
+        assert faults.calls["dispatch"] == calls_while_open  # breaker held
+        # Cool-down elapses; a clean probe session closes the breaker.
+        t["now"] = 6.0
+        sched._fault_hook = None
+        for p in _pods(20):
+            p.uid += "-c"
+            cs.create_pod(p)
+        sched.run_until_idle()
+        assert len(cs.bindings) == 80
+        assert sched.device_breaker.state == "closed"
+        assert sched.metrics.device_breaker_state.value() == 0.0
+        assert sched.device_scheduled > 0  # the device path came back
+
+
+# ---------------------------------------------------------------------------
+# watch re-list / resume over the wire
+# ---------------------------------------------------------------------------
+
+
+def _call_http(base, method, path, body=None):
+    import json
+    from urllib import request as urlrequest
+
+    def once():
+        from urllib.error import HTTPError
+        data = json.dumps(body).encode() if body is not None else None
+        req = urlrequest.Request(base + path, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+        try:
+            with urlrequest.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+        except HTTPError as e:
+            if e.code == 409:
+                # AlreadyExists: an earlier attempt landed but its reply was
+                # lost — the write is durable, which is all a retry wants.
+                return {"conflict": True}
+            raise
+
+    # The test driver is an API client like any other: transient transport
+    # failures against the loaded ThreadingHTTPServer (broken pipe under
+    # thread churn) retry exactly as production clients do.
+    return retry_call(once, RetryConfig(initial_backoff=0.05,
+                                        max_backoff=0.5, max_attempts=6,
+                                        seed=5))
+
+
+class _Driver:
+    """Run a scheduler loop on a thread, recording any crash."""
+
+    def __init__(self, sched):
+        self.sched = sched
+        self.errors = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                if not self.sched.run_until_idle():
+                    time.sleep(0.01)
+            except Exception as e:  # noqa: BLE001 - the assertion target
+                self.errors.append(e)
+                return
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+
+@pytest.mark.chaos
+def test_watch_drop_relist_convergence_mid_churn():
+    """Kill every scheduler↔apiserver connection mid-MixedChurn: the
+    reflector reconnects with its last resourceVersion, replays the missed
+    events (RESUME), and assignments still match the in-process oracle."""
+    from kubernetes_tpu.core.apiserver import (APIServer, HTTPClientset,
+                                               node_to_wire, pod_to_wire)
+    api = APIServer()
+    port = api.serve(0)
+    proxy = ChaosTCPProxy("127.0.0.1", port)
+    direct = f"http://127.0.0.1:{port}"
+    http_cs = HTTPClientset(proxy.url)
+    rcs = RetryingClientset(http_cs, retry=RetryConfig(
+        initial_backoff=0.005, max_backoff=0.1, max_attempts=6, seed=11))
+    sched = Scheduler(clientset=rcs, deterministic_ties=True)
+    driver = _Driver(sched)
+    try:
+        nodes = _nodes(20)
+        for n in nodes:
+            _call_http(direct, "POST", "/api/v1/nodes", node_to_wire(n))
+        deadline = time.monotonic() + 30
+        while len(http_cs.nodes) < 20 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(http_cs.nodes) == 20
+        pods = _pods(300)
+        for i, p in enumerate(pods):
+            _call_http(direct, "POST", "/api/v1/pods", pod_to_wire(p))
+            if i % 15 == 5:
+                # churn irrelevant to scheduling outcomes (labels no plugin
+                # reads) — pure watch traffic for the re-list to replay
+                n = nodes[i % len(nodes)]
+                w = node_to_wire(n)
+                w["labels"]["churn"] = str(i)
+                _call_http(direct, "PUT", f"/api/v1/nodes/{n.name}", w)
+            if i == 150:
+                proxy.drop_connections()  # watch streams die mid-churn
+                for j in range(8):  # events the dead streams will miss
+                    n = nodes[j]
+                    w = node_to_wire(n)
+                    w["labels"]["churn"] = f"offline-{j}"
+                    _call_http(direct, "PUT", f"/api/v1/nodes/{n.name}", w)
+        deadline = time.monotonic() + 120
+        while len(api.store.bindings) < 300 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert not driver.errors, f"scheduler crashed: {driver.errors!r}"
+        bound = {api.store.pods[u].name: nn
+                 for u, nn in api.store.bindings.items()}
+        assert len(bound) == 300, f"only {len(bound)}/300 bound"
+        oracle = _oracle_assignments(lambda: _nodes(20), lambda: _pods(300))
+        diffs = {k: (oracle[k], bound.get(k)) for k in oracle
+                 if oracle[k] != bound.get(k)}
+        assert not diffs, f"{len(diffs)} divergences: {list(diffs.items())[:5]}"
+        assert http_cs.resumes["pods"] + http_cs.resumes["nodes"] >= 1, \
+            "reconnect never took the resourceVersion resume path"
+    finally:
+        driver.stop()
+        http_cs.close()
+        proxy.close()
+        api.shutdown()
+
+
+@pytest.mark.chaos
+def test_chaos_end_to_end_100n_1000p():
+    """The acceptance run: 100 nodes / 1000 pods over a real socket with
+    (a) transient apiserver write failures, (b) a dropped watch stream
+    mid-churn, and (c) injected device-path faults that trip and then
+    clear the circuit breaker — assignments identical to a fault-free
+    in-process oracle, zero scheduler crashes, breaker fired + recovered."""
+    from kubernetes_tpu.core.apiserver import (APIServer, HTTPClientset,
+                                               node_to_wire, pod_to_wire)
+    from kubernetes_tpu.models import TPUScheduler
+    api = APIServer()
+    port = api.serve(0)
+    proxy = ChaosTCPProxy("127.0.0.1", port)
+    direct = f"http://127.0.0.1:{port}"
+    http_cs = HTTPClientset(proxy.url)
+    flaky = FlakyClientset(http_cs, seed=42, failure_rate=0.03)
+    rcs = RetryingClientset(flaky, retry=RetryConfig(
+        initial_backoff=0.005, max_backoff=0.05, max_attempts=5, seed=1))
+    sched = TPUScheduler(clientset=rcs, max_batch=64, mesh=None)
+    sched.device_breaker = CircuitBreaker(failure_threshold=3, cooldown=1.0)
+    faults = DeviceFaults(dispatch={3, 4, 5})  # three consecutive crashes
+    sched._fault_hook = faults
+    driver = _Driver(sched)
+    try:
+        nodes = _nodes(100)
+        for n in nodes:
+            _call_http(direct, "POST", "/api/v1/nodes", node_to_wire(n))
+        deadline = time.monotonic() + 60
+        while len(http_cs.nodes) < 100 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(http_cs.nodes) == 100
+        pods = _pods(1000)
+        for i, p in enumerate(pods):
+            _call_http(direct, "POST", "/api/v1/pods", pod_to_wire(p))
+            if i % 25 == 10:  # outcome-irrelevant label churn
+                n = nodes[i % len(nodes)]
+                w = node_to_wire(n)
+                w["labels"]["churn"] = str(i)
+                _call_http(direct, "PUT", f"/api/v1/nodes/{n.name}", w)
+            if i == 400:
+                proxy.drop_connections()  # one dropped watch stream
+                for j in range(10):
+                    n = nodes[j]
+                    w = node_to_wire(n)
+                    w["labels"]["churn"] = f"offline-{j}"
+                    _call_http(direct, "PUT", f"/api/v1/nodes/{n.name}", w)
+        deadline = time.monotonic() + 300
+        while len(api.store.bindings) < 1000 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        # zero scheduler crashes
+        assert not driver.errors, f"scheduler crashed: {driver.errors!r}"
+        bound = {api.store.pods[u].name: nn
+                 for u, nn in api.store.bindings.items()}
+        assert len(bound) == 1000, f"only {len(bound)}/1000 bound"
+        # assignments identical to the fault-free oracle
+        oracle = _oracle_assignments(lambda: _nodes(100), lambda: _pods(1000))
+        diffs = {k: (oracle[k], bound.get(k)) for k in oracle
+                 if oracle[k] != bound.get(k)}
+        assert not diffs, f"{len(diffs)} divergences: {list(diffs.items())[:5]}"
+        # the write faults really fired and were retried away
+        assert sum(flaky.injected.values()) > 0
+        assert rcs.retries_total > 0 and rcs.give_ups == 0
+        # the watch drop really resumed
+        assert http_cs.resumes["pods"] + http_cs.resumes["nodes"] >= 1
+        # the breaker fired and recovered
+        assert faults.injected["dispatch"] == 3
+        assert sched.metrics.device_path_fallback.value("RuntimeError") >= 3
+        assert sched.device_breaker.open_count >= 1
+        assert sched.device_breaker.allows()  # recovered (closed/half-open)
+        assert sched.device_batches >= 1  # the device path did real work
+    finally:
+        driver.stop()
+        http_cs.close()
+        proxy.close()
+        api.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions (ADVICE r5 low items)
+# ---------------------------------------------------------------------------
+
+
+def test_collective_report_nested_replica_groups():
+    """Non-greedy regex regression: only the FIRST of nested replica groups
+    used to be classified — a later host-spanning group was misreported as
+    ICI."""
+    from kubernetes_tpu.parallel.mesh import collective_report
+    hlo = ("%ar = f32[8]{0} all-reduce(%x), replica_groups={{0,1},{3,4}}, "
+           "to_apply=%add\n"
+           "%ag = f32[8]{0} all-gather(%y), replica_groups={0,1,2,3}, "
+           "dimensions={0}\n")
+    rep = collective_report(hlo, n_hosts=2, per_host=4)
+    # {0,1} is host-local but {3,4} spans hosts 0 and 1 → DCN.
+    assert rep["dcn"].get("all-reduce", 0) == 1
+    # flat {0,1,2,3} stays within host 0 → ICI.
+    assert rep["ici"].get("all-gather", 0) == 1
+
+
+def test_resource_metrics_pending_pod_empty_node_label():
+    """`/metrics/resources` renders pending pods with node="" (reference
+    convention), never the literal string "None"."""
+    from kubernetes_tpu.core.server import SchedulerServer
+    cs = FakeClientset()
+    sched = Scheduler(clientset=cs, deterministic_ties=True)
+    pod = _pods(1)[0]
+    pod.node_name = None  # the shape that used to render node="None"
+    cs.create_pod(pod)
+    server = SchedulerServer(sched)
+    out = server.expose_resource_metrics()
+    assert 'node=""' in out
+    assert 'node="None"' not in out
+    assert 'phase="Pending"' in out
