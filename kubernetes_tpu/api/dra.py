@@ -128,6 +128,61 @@ class ExpressionError(ValueError):
     """Invalid or disallowed device selector expression."""
 
 
+class _ConstCoercer(_ast.NodeTransformer):
+    """Coerce quantity-shaped string literals ONCE at compile time (the
+    reference's CEL environment types quantity constants the same way):
+    `"40Gi"` in a comparison against `device.attributes[...]` /
+    `device.capacity[...]` becomes the coerced numeric bound to an injected
+    name, so runtime comparisons are plain int/float ops against the (also
+    coerced) map values — the coerced value classes need no cross-type
+    string equality, keeping their __eq__ consistent with their int/float
+    __hash__ (ADVICE r5; regression in tests/test_dra.py
+    test_quantity_hash_eq_consistency).
+
+    Scope: ONLY direct comparator operands (and their tuple/list members,
+    for `in`) of a Compare that involves one of the two quantity maps.
+    Subscript KEYS (`device.attributes["8"]` looks up the string key) and
+    comparisons against the plain-string fields (`device.name == "0"`)
+    keep their literal strings. Known edge: a CHAINED comparison mixing a
+    string field and a quantity map (`device.name == "8" ==
+    device.attributes["c"]`) treats its string literals as quantities —
+    CEL has no comparison chaining, so the quantity reading wins. Runs
+    AFTER validation, so injected names cannot collide with user
+    identifiers (only `device` is legal)."""
+
+    def __init__(self):
+        self.bindings = {}
+
+    @staticmethod
+    def _qty_map_operand(n) -> bool:
+        # device.attributes[...] / device.capacity[...] — the maps whose
+        # VALUES are quantity-coerced (_CoercingMap).
+        return (isinstance(n, _ast.Subscript)
+                and isinstance(n.value, _ast.Attribute)
+                and n.value.attr in ("attributes", "capacity"))
+
+    def _coerce_const(self, node):
+        if isinstance(node, _ast.Constant) and isinstance(node.value, str):
+            coerced = _CoercingMap._coerce(node.value)
+            if not isinstance(coerced, str):
+                name = f"_qty{len(self.bindings)}"
+                self.bindings[name] = coerced
+                return _ast.copy_location(
+                    _ast.Name(id=name, ctx=_ast.Load()), node)
+        elif isinstance(node, (_ast.Tuple, _ast.List)):
+            node.elts = [self._coerce_const(e) for e in node.elts]
+        return node
+
+    def visit_Compare(self, node):
+        self.generic_visit(node)  # nested compares inside operands first
+        operands = [node.left] + list(node.comparators)
+        if any(self._qty_map_operand(o) for o in operands):
+            node.left = self._coerce_const(node.left)
+            node.comparators = [self._coerce_const(c)
+                                for c in node.comparators]
+        return node
+
+
 def compile_device_expression(expr: str):
     """Validate + compile a device selector expression. Returns a callable
     (device, driver) -> bool. Raises ExpressionError on disallowed syntax."""
@@ -145,6 +200,9 @@ def compile_device_expression(expr: str):
             if node.attr.startswith("__") or node.attr not in (
                     "attributes", "capacity", "driver", "name"):
                 raise ExpressionError(f"unknown device field {node.attr!r}")
+    coercer = _ConstCoercer()
+    tree = _ast.fix_missing_locations(coercer.visit(tree))
+    qty_consts = coercer.bindings
     code = compile(tree, "<device-selector>", "eval")
 
     class _DeviceView:
@@ -172,8 +230,10 @@ def compile_device_expression(expr: str):
 
     def matcher(device, driver="") -> bool:
         try:
-            return bool(eval(code, {"__builtins__": {}},  # noqa: S307 - AST-whitelisted
-                             {"device": _DeviceView(device, driver)}))
+            env = {"device": _DeviceView(device, driver)}
+            if qty_consts:
+                env.update(qty_consts)
+            return bool(eval(code, {"__builtins__": {}}, env))  # noqa: S307 - AST-whitelisted
         except Exception:
             # CEL runtime errors make the device non-matching (the reference
             # treats evaluation errors as "does not satisfy selector").
@@ -222,17 +282,16 @@ class _CoercingMap(dict):
 
 
 class _QtyMixin:
-    """Coerced quantity values compare against BOTH numbers and suffixed
-    string literals: device.capacity["mem"] == "40Gi" and == 40*1024**3 both
-    hold (the reference's CEL environment compares typed quantities; plain
-    int coercion would make the string form silently False).
-
-    HASH/EQ ASYMMETRY (ADVICE r5): _QtyInt(8) == "8" but hash(_QtyInt(8))
-    != hash("8") — the int/float __hash__ is kept deliberately so numeric
-    lookups work. Consequence: coerced quantity values must NEVER be used
-    as set members or dict keys alongside their raw string forms; two
-    "equal" members would occupy different hash buckets. Today they are
-    only ever compared (CEL selector evaluation), never keyed."""
+    """Coerced quantity values: EQUALITY is strictly numeric (inherited
+    int/float __eq__/__hash__ — equal objects hash equal, so coerced values
+    are safe set members / dict keys next to any other form; the ADVICE-r5
+    hash/eq asymmetry is gone). The CEL surface still holds —
+    device.capacity["mem"] == "40Gi" and == 40*1024**3 are both True —
+    because expression string LITERALS are coerced once at compile time
+    (_ConstCoercer) and the map values once per device (_CoercingMap), so
+    both sides of every runtime comparison are already numeric. ORDERING
+    operands keep the string coercion (`qty >= "32Gi"` for direct API
+    users); ordering carries no hash contract."""
 
     __slots__ = ()
 
@@ -240,16 +299,6 @@ class _QtyMixin:
         if isinstance(other, str):
             return _CoercingMap._coerce(other)
         return other
-
-    def __eq__(self, other):
-        other = self._other(other)
-        if isinstance(other, str):
-            return False
-        return super().__eq__(other)
-
-    def __ne__(self, other):
-        eq = self.__eq__(other)
-        return eq if eq is NotImplemented else not eq
 
     def __lt__(self, other):
         return super().__lt__(self._other(other))
@@ -265,8 +314,8 @@ class _QtyMixin:
 
 
 class _QtyInt(_QtyMixin, int):
-    __hash__ = int.__hash__
+    pass
 
 
 class _QtyFloat(_QtyMixin, float):
-    __hash__ = float.__hash__
+    pass

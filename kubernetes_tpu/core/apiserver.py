@@ -22,7 +22,12 @@ Re-expresses the scheduler-relevant slice of the reference's L2/L3 stack:
 The JSON codec covers the full scheduling-relevant pod/node spec (requests,
 tolerations, selectors, node+pod affinity, topology spread, gates, host
 ports, PVC volumes, resource claims, nominations, deletion state); GVK /
-admission / etcd stay out of scope (SURVEY §7).
+admission stay out of scope (SURVEY §7). The etcd seam is re-expressed by
+an optional durable store (`data_dir`, core/wal.py): every committed write
+appends a WAL record, snapshots compact the log, and a restarted server
+replays snapshot+WAL — recovering objects, rv counters, the boot epoch, and
+the watch backlog, so clients resume (`RESUME`) instead of re-listing
+across a ``kill -9``.
 """
 
 from __future__ import annotations
@@ -301,13 +306,28 @@ class APIServer:
     `?watch=true&resourceVersion=N` gets a RESUME marker plus a replay of
     every event it missed — no full re-list — when the window still covers
     N; otherwise (compaction, the 410 Gone analogue) it gets the usual full
-    ADDED replay + SYNC and performs reflector Replace semantics."""
+    ADDED replay + SYNC and performs reflector Replace semantics.
+
+    With ``data_dir`` set, the server is durable (core/wal.py): writes are
+    WAL-logged before fanout, periodically compacted into a snapshot, and a
+    restart recovers state + rv counters + epoch + backlog — the etcd3
+    store seam (etcd3/store.go:284) collapsed to one process."""
 
     def __init__(self, store: Optional[FakeClientset] = None,
-                 backlog: int = 8192):
+                 backlog: int = 8192, data_dir: Optional[str] = None,
+                 fsync: bool = False, snapshot_every: int = 2048):
         self.store = store or FakeClientset()
         self._watchers: Dict[str, List["queue.Queue"]] = {"pods": [], "nodes": []}
         self._lock = threading.Lock()
+        # Serializes MUTATING verbs end-to-end (check + store write + WAL):
+        # the store itself is unlocked dicts, and ThreadingHTTPServer runs
+        # one thread per request — without this, two concurrent binding
+        # POSTs could both pass the already-bound check (double bind), two
+        # same-uid creates could both pass the 409 check, and a compaction
+        # could snapshot a store another thread is mid-mutation. One writer
+        # at a time is also the etcd model the reference stands on. Watch
+        # streams and GETs stay unserialized.
+        self._write_lock = threading.Lock()
         from collections import deque
         import uuid
         self._seq: Dict[str, int] = {"pods": 0, "nodes": 0}
@@ -316,13 +336,113 @@ class APIServer:
         # Boot epoch: rv counters restart at 0 with a fresh server, so a
         # client's rv from a PREVIOUS server instance must never resume
         # against this one's unrelated event history — resume requires the
-        # epoch to match, otherwise the full re-list (Replace) runs.
+        # epoch to match, otherwise the full re-list (Replace) runs. With a
+        # durable store (data_dir) the counters RESUME instead of restarting,
+        # so recovery re-announces the PERSISTED epoch and clients ride the
+        # RESUME path straight across a process death.
         self.epoch = uuid.uuid4().hex[:12]
         self.resumed_watches = 0   # incremental reconnects served
         self.relisted_watches = 0  # full-list attaches served
+        self.bind_conflicts = 0    # rebind-to-a-different-node rejections
+        self.compaction_failures = 0
+        # Durability (core/wal.py): WAL + snapshot compaction + recovery.
+        self.persistence = None
+        self.recovered_objects = 0
+        if data_dir is not None:
+            from .wal import DurableStore
+            self.persistence = DurableStore(
+                data_dir, fsync=fsync, snapshot_every=snapshot_every)
+            self._recover()
         self.store.on_pod_event(self._pod_event)
         self.store.on_node_event(self._node_event)
         self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # -- durability (WAL + snapshot; core/wal.py) ---------------------------
+
+    def _recover(self) -> None:
+        """Replay snapshot+WAL into the owned store and resume the watch
+        plane where the dead process left off: per-kind rv counters, the
+        persisted epoch, and an event backlog rebuilt from the WAL tail so
+        reflectors reconnecting with their last rv get RESUME, not Replace."""
+        import itertools
+
+        snap, records = self.persistence.load()
+        if self.persistence.epoch is not None:
+            self.epoch = self.persistence.epoch
+        else:
+            self.persistence.init_epoch(self.epoch)
+        if snap is not None:
+            self._seq.update(snap.get("seq", {}))
+            for w in snap.get("pods", ()):
+                self._apply_recovered("pods", "ADDED", w)
+            for w in snap.get("nodes", ()):
+                self._apply_recovered("nodes", "ADDED", w)
+        for rec in records:
+            kind = rec.get("kind")
+            if kind not in ("pods", "nodes"):
+                continue
+            self._apply_recovered(kind, rec.get("type", ""), rec.get("object"))
+            rv = rec.get("rv")
+            if rv is not None and rv > self._seq[kind]:
+                self._seq[kind] = rv
+            # Rebuild the watch backlog exactly as _broadcast framed it (the
+            # deque's maxlen keeps only the freshest `backlog` events).
+            if rv is not None:
+                event = {k: v for k, v in rec.items() if k != "kind"}
+                self._backlog[kind].append(
+                    (rv, (json.dumps(event) + "\n").encode()))
+        # Object resource_versions were not persisted; fast-forward the
+        # store's counter past everything ever minted so recovered and new
+        # objects never share a version.
+        self.store._rv_counter = itertools.count(
+            self._seq["pods"] + self._seq["nodes"] + 1)
+        self.recovered_objects = len(self.store.pods) + len(self.store.nodes)
+
+    def _apply_recovered(self, kind: str, typ: str, wire: Optional[dict]) -> None:
+        """Apply one recovered object directly to the store dicts — no
+        handler fanout (there are no watchers yet) and idempotent upserts
+        (a compaction snapshot may slightly lead the WAL it truncated)."""
+        if wire is None:
+            return
+        if kind == "pods":
+            pod = pod_from_wire(wire)
+            if typ == "DELETED":
+                self.store.pods.pop(pod.uid, None)
+                self.store.bindings.pop(pod.uid, None)
+            else:
+                self.store.pods[pod.uid] = pod
+                if pod.node_name:
+                    self.store.bindings[pod.uid] = pod.node_name
+                else:
+                    self.store.bindings.pop(pod.uid, None)
+        else:
+            node = node_from_wire(wire)
+            if typ == "DELETED":
+                self.store.nodes.pop(node.name, None)
+            else:
+                self.store.nodes[node.name] = node
+
+    def _wal_status(self, pod) -> None:
+        """Persist a non-evented status patch (nominatedNodeName): an
+        rv-less `STATUS` record — recovery upserts the object but the watch
+        backlog never sees it (parity with its non-evented live fanout)."""
+        if self.persistence is None:
+            return
+        with self._lock:
+            self.persistence.append(
+                {"kind": "pods", "type": "STATUS", "object": pod_to_wire(pod)})
+
+    def _snapshot_state(self) -> dict:
+        """Full-state compaction snapshot. The calling thread holds BOTH the
+        write lock (its own verb — no other store mutation can be in
+        flight) and the broadcast lock (no event can interleave); bindings
+        ride on nodeName."""
+        return {
+            "epoch": self.epoch,
+            "seq": dict(self._seq),
+            "pods": [pod_to_wire(p) for p in list(self.store.pods.values())],
+            "nodes": [node_to_wire(n) for n in list(self.store.nodes.values())],
+        }
 
     # -- event fanout to watch streams -------------------------------------
 
@@ -330,6 +450,24 @@ class APIServer:
         with self._lock:
             self._seq[kind] += 1
             event["rv"] = self._seq[kind]
+            if self.persistence is not None:
+                # WAL append BEFORE fanout: an event a watcher saw is always
+                # recoverable. The record is the event itself plus the kind,
+                # so recovery rebuilds both the store and the watch backlog
+                # from one stream.
+                self.persistence.append({"kind": kind, **event})
+                if self.persistence.should_compact():
+                    try:
+                        # Safe to read the store here: the writing thread
+                        # holds _write_lock, so no other mutation is in
+                        # flight. write_snapshot is atomic (tmp+replace)
+                        # and only resets the WAL after the replace — a
+                        # failed compaction leaves snapshot+WAL coherent,
+                        # so it must never abort the broadcast (that would
+                        # punch a hole in the fanout/backlog at this rv).
+                        self.persistence.write_snapshot(self._snapshot_state())
+                    except Exception:  # noqa: BLE001
+                        self.compaction_failures += 1
             data = (json.dumps(event) + "\n").encode()
             self._backlog[kind].append((self._seq[kind], data))
             for q in self._watchers[kind]:
@@ -399,9 +537,14 @@ class APIServer:
             def log_message(self, *a):
                 pass
 
-            def _body(self) -> dict:
+            def _read_body(self) -> dict:
+                # Socket I/O — must run OUTSIDE the write lock (a stalled
+                # sender would otherwise wedge the whole write plane).
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n) or b"{}")
+
+            def _body(self) -> dict:
+                return self._body_cache
 
             def _json(self, code: int, obj) -> None:
                 data = json.dumps(obj).encode()
@@ -474,6 +617,11 @@ class APIServer:
                     self.close_connection = True
 
             def do_POST(self):
+                self._body_cache = self._read_body()
+                with server._write_lock:
+                    return self._do_post()
+
+            def _do_post(self):
                 if self.path == "/api/v1/pods":
                     pod = pod_from_wire(self._body())
                     # AlreadyExists (409, like the reference registry):
@@ -500,7 +648,19 @@ class APIServer:
                     pod = server.store.pods.get(parts[4])
                     if pod is None:
                         return self._json(404, {"error": "pod not found"})
-                    server.store.bind(pod, self._body()["node"])
+                    node = self._body()["node"]
+                    if pod.node_name:
+                        # Already bound: a same-node POST is a retry replay
+                        # of a bind whose reply was lost (pre-crash write,
+                        # recovered from the WAL) — idempotent success, no
+                        # re-fired event. A different node is a genuine
+                        # conflict (409, registry AlreadyExists analogue):
+                        # a pod must never be bound twice.
+                        if pod.node_name == node:
+                            return self._json(200, {"bound": True})
+                        server.bind_conflicts += 1
+                        return self._json(409, {"error": "AlreadyBound"})
+                    server.store.bind(pod, node)
                     return self._json(200, {"bound": True})
                 if (self.path.startswith("/api/v1/pods/")
                         and self.path.endswith("/status")):
@@ -512,10 +672,20 @@ class APIServer:
                         pod,
                         nominated_node_name=body.get("nominatedNodeName", ""),
                         phase=body.get("phase", ""))
+                    # Status patches fan out no watch event (store parity),
+                    # but their scheduling-relevant slice (nominations) must
+                    # still survive a restart: WAL an rv-less STATUS record
+                    # — replayed as an upsert, never entering the backlog.
+                    server._wal_status(pod)
                     return self._json(200, {})
                 self._json(404, {"error": "not found"})
 
             def do_PUT(self):
+                self._body_cache = self._read_body()
+                with server._write_lock:
+                    return self._do_put()
+
+            def _do_put(self):
                 if (self.path.startswith("/api/v1/nodes/")
                         and self.path.endswith("/status")):
                     return self._json(200, {})  # heartbeat parity stub
@@ -532,6 +702,10 @@ class APIServer:
                 self._json(404, {"error": "not found"})
 
             def do_DELETE(self):
+                with server._write_lock:
+                    return self._do_delete()
+
+            def _do_delete(self):
                 if self.path.startswith("/api/v1/pods/"):
                     uid = self.path.split("/")[4]
                     pod = server.store.pods.get(uid)
@@ -553,6 +727,8 @@ class APIServer:
         self._httpd = None
         if httpd is not None:
             httpd.shutdown()
+        if self.persistence is not None:
+            self.persistence.close()
 
 
 # ---------------------------------------------------------------------------
@@ -873,11 +1049,29 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(prog="kubernetes-tpu-apiserver")
     ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--data-dir", default="",
+                    help="durable store directory (WAL + snapshot, "
+                         "core/wal.py); empty = in-memory only")
+    ap.add_argument("--fsync", action="store_true",
+                    help="fsync every WAL record (survives power loss, not "
+                         "just process death)")
+    ap.add_argument("--snapshot-every", type=int, default=2048,
+                    help="compact the WAL into a snapshot every N records")
     args = ap.parse_args(argv)
-    api = APIServer()
+    api = APIServer(data_dir=args.data_dir or None, fsync=args.fsync,
+                    snapshot_every=args.snapshot_every)
     port = api.serve(args.port)
+    # "serving on" stays the FIRST line: spawn harnesses select()+readline()
+    # on it, and a buffered readline would swallow any earlier line together
+    # with this one (leaving select blocked on a drained pipe).
     print(f"kubernetes-tpu-apiserver: serving on 127.0.0.1:{port}",
           flush=True)
+    if api.persistence is not None:
+        p = api.persistence
+        print(f"kubernetes-tpu-apiserver: recovered {api.recovered_objects} "
+              f"objects (wal={p.replayed_records} torn="
+              f"{p.torn_records_discarded}) epoch={api.epoch} "
+              f"rv={dict(api._seq)}", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())

@@ -321,6 +321,10 @@ class Scheduler:
         # async bind error): a device session/resume carry or fail memo
         # computed before an unwind no longer reflects the cache.
         self.state_unwinds = 0
+        # Placements the watch feed revoked (a re-list/resume after an
+        # apiserver restart reported a cache-placed pod as UNBOUND): the
+        # assumed-vs-recovered-truth reconciliation below unwound them.
+        self.reconcile_unwinds = 0
         # Off-thread watch-event inbox (see _threaded): deque append/popleft
         # are atomic under the GIL, so no lock is needed.
         from collections import deque
@@ -446,7 +450,30 @@ class Scheduler:
                 else:
                     self.cache.update_pod(old, new)
             else:
-                self.queue.update(old, new)
+                st = self.cache.pod_states.get(new.uid)
+                if st is not None and st.binding_finished:
+                    # Post-restart reconciliation: the API says this pod is
+                    # UNBOUND while the cache holds a placement whose bind
+                    # COMPLETED (binding_finished) — the control plane lost
+                    # the committed bind (apiserver restarted from a store
+                    # that predates it; the re-list/resume replay is the
+                    # diff against recovered truth). Unwind the phantom
+                    # placement and reschedule; the retry/bind layers will
+                    # re-commit it. A placement whose bind is still IN
+                    # FLIGHT is deliberately not touched: a stale re-list
+                    # can race a healthy bind, and exhaustion of that
+                    # bind's retries already unwinds via the bind-error
+                    # paths.
+                    self.reconcile_unwinds += 1
+                    self.state_unwinds += 1
+                    self.cache.remove_pod(st.pod)
+                    self.queue.move_all_to_active_or_backoff(
+                        EVENT_ASSIGNED_POD_DELETE, st.pod, None)
+                    if self._responsible_for_pod(new):
+                        new.node_name = ""
+                        self.queue.add(new)
+                else:
+                    self.queue.update(old, new)
         elif kind == "delete":
             if new.node_name:
                 self.cache.remove_pod(new)
@@ -538,6 +565,13 @@ class Scheduler:
         if pod.deletion_ts is not None:
             # skipPodSchedule (schedule_one.go:93): the pod is being deleted;
             # don't attempt it — the delete event will clear it from the queue.
+            self.queue.done(pod.uid)
+            return
+        if pod.uid in self.cache.pod_states:
+            # skipPodSchedule: the cache already holds a placement for this
+            # pod (a reconcile unwind raced the bind-confirm event — the
+            # re-queued copy predates the confirmation). Scheduling it again
+            # would double-place it.
             self.queue.done(pod.uid)
             return
         from .tracing import StepTrace

@@ -1,0 +1,165 @@
+"""Durable apiserver store: write-ahead log + snapshot compaction.
+
+Re-expresses the persistence seam the reference gets from etcd
+(etcd3/store.go:284 — every apiserver write goes through the etcd3 store;
+watch resumption is served from a resourceVersion-indexed history that
+survives apiserver restarts): each committed write appends ONE JSON record
+to an append-only log, a periodic snapshot compacts the log, and a restart
+replays snapshot+WAL to recover pods/nodes/bindings AND the watch plane's
+per-kind rv counters plus the boot **epoch** — so a reflector reconnecting
+with its last resourceVersion is served the PR-1 ``RESUME`` path (replay of
+exactly the missed events) instead of a full re-list, across a ``kill -9``
+of the apiserver process.
+
+Layout of ``data_dir``:
+
+- ``meta.json``    — ``{"epoch": ...}`` written once at first boot; the
+  epoch a recovered server re-announces on SYNC/RESUME markers, which is
+  what lets clients resume (PR 1's epoch guard rejects resumes against a
+  server whose counters restarted; a recovered server's counters do NOT
+  restart, so the SAME epoch is re-used deliberately).
+- ``snapshot.json`` — the latest compaction: full object state + the rv
+  counters at the moment of the snapshot. Written atomically
+  (tmp + ``os.replace``); the WAL is reset right after.
+- ``wal.log``       — one JSON record per committed write SINCE the
+  snapshot: ``{"kind": "pods"|"nodes", "type": "ADDED"|..., "object":
+  {...wire...}, "rv": N}`` — byte-identical in content to the watch event
+  the write broadcast, so recovery can rebuild the watch backlog from the
+  WAL tail and serve incremental resumes across the restart.
+
+Crash contract: records are written ``json\\n``-framed with a flush per
+record (``fsync=True`` additionally fsyncs — survives power loss, not just
+process death). A ``kill -9`` can leave at most one torn (partial/invalid)
+final record; replay detects it, discards it, truncates the log back to the
+last good frame, and counts it in ``torn_records_discarded`` — the write it
+belonged to never got a reply, so the client's retry layer replays it
+against the recovered server (the binding subresource is idempotent for
+same-node replays).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+
+class DurableStore:
+    """File mechanics for the apiserver's durability layer (core/apiserver.py
+    owns the wire codec and store application; this class owns bytes)."""
+
+    META = "meta.json"
+    SNAP = "snapshot.json"
+    WAL = "wal.log"
+
+    def __init__(self, data_dir: str, fsync: bool = False,
+                 snapshot_every: int = 2048):
+        self.data_dir = data_dir
+        self.fsync = fsync
+        self.snapshot_every = snapshot_every
+        os.makedirs(data_dir, exist_ok=True)
+        self._wal_path = os.path.join(data_dir, self.WAL)
+        self._wal_fh = None
+        self._since_snapshot = 0
+        # observability (surfaced by the apiserver's recovery log line)
+        self.replayed_records = 0
+        self.torn_records_discarded = 0
+        self.compactions = 0
+        self.epoch: Optional[str] = self._read_json(self.META, {}).get("epoch")
+
+    # -- small file helpers -------------------------------------------------
+
+    def _read_json(self, name: str, default):
+        try:
+            with open(os.path.join(self.data_dir, name)) as fh:
+                return json.load(fh)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return default
+
+    def _write_json_atomic(self, name: str, obj) -> None:
+        path = os.path.join(self.data_dir, name)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(obj, fh)
+            fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+    # -- boot ---------------------------------------------------------------
+
+    def init_epoch(self, epoch: str) -> None:
+        """First boot of this data_dir: persist the freshly minted epoch so
+        every future recovery re-announces it."""
+        self.epoch = epoch
+        self._write_json_atomic(self.META, {"epoch": epoch})
+
+    def load(self) -> Tuple[Optional[dict], List[dict]]:
+        """Read (snapshot, wal_records) for recovery. Discards a torn final
+        WAL record (truncating the file back to the last good frame) and
+        opens the WAL for append."""
+        snap = self._read_json(self.SNAP, None)
+        records: List[dict] = []
+        good_offset = 0
+        try:
+            with open(self._wal_path, "rb") as fh:
+                buf = fh.read()
+        except FileNotFoundError:
+            buf = b""
+        pos = 0
+        while pos < len(buf):
+            nl = buf.find(b"\n", pos)
+            if nl < 0:
+                # no terminating newline: the final frame is torn
+                self.torn_records_discarded += 1
+                break
+            line = buf[pos:nl]
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                # invalid JSON inside a terminated frame: a write torn mid-
+                # record that a later write's newline closed — everything
+                # from here on is untrusted.
+                self.torn_records_discarded += 1
+                break
+            records.append(rec)
+            pos = nl + 1
+            good_offset = pos
+        if good_offset < len(buf):
+            with open(self._wal_path, "r+b") as fh:
+                fh.truncate(good_offset)
+        self.replayed_records = len(records)
+        self._wal_fh = open(self._wal_path, "ab")
+        self._since_snapshot = len(records)
+        return snap, records
+
+    # -- the write path -----------------------------------------------------
+
+    def append(self, record: dict) -> None:
+        """Append one committed write. Caller serializes (the apiserver's
+        broadcast lock); a flush per record bounds loss to one torn frame."""
+        if self._wal_fh is None:
+            self._wal_fh = open(self._wal_path, "ab")
+        self._wal_fh.write(json.dumps(record).encode() + b"\n")
+        self._wal_fh.flush()
+        if self.fsync:
+            os.fsync(self._wal_fh.fileno())
+        self._since_snapshot += 1
+
+    def should_compact(self) -> bool:
+        return self._since_snapshot >= self.snapshot_every
+
+    def write_snapshot(self, snap: dict) -> None:
+        """Compaction: atomically persist the full state, then reset the WAL
+        (its records are now folded into the snapshot)."""
+        self._write_json_atomic(self.SNAP, snap)
+        if self._wal_fh is not None:
+            self._wal_fh.close()
+        self._wal_fh = open(self._wal_path, "wb")
+        self._since_snapshot = 0
+        self.compactions += 1
+
+    def close(self) -> None:
+        if self._wal_fh is not None:
+            self._wal_fh.close()
+            self._wal_fh = None

@@ -161,8 +161,11 @@ class TPUScheduler(Scheduler):
                 return None
             if (not isinstance(qpi, (QueuedPodGroupInfo,
                                      QueuedCompositeGroupInfo))
-                    and qpi.pod.deletion_ts is not None):
-                # skipPodSchedule: deleting pods never dispatch to device.
+                    and (qpi.pod.deletion_ts is not None
+                         or qpi.pod.uid in self.cache.pod_states)):
+                # skipPodSchedule: deleting pods never dispatch to device,
+                # and neither do pods the cache already placed (a reconcile
+                # unwind raced the bind confirm — see core process_one).
                 # (Group/composite entities are never skipped whole — their
                 # .pod is just the first member.)
                 self.queue.done(qpi.pod.uid)

@@ -340,11 +340,11 @@ def schedule_batch(
             ok &= term_ok.all(axis=0) | bootstrap
         return ok
 
-    def step(carry, t):
+    def step(carry, _):
         (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
          dns_counts, sa_counts, anti_counts, aff_counts, ipa_delta, start,
          blocked, aux_cnt, okd, F, total,
-         mnum, scnt, acnt, fcnt, dproj, aff_total) = carry
+         mnum, scnt, acnt, fcnt, dproj, aff_total, t, out) = carry
         active = t < n_act
 
         if not incremental_feas:
@@ -450,27 +450,37 @@ def schedule_batch(
         fit_ok = fit_ok.at[row].set(r_ok)
         fit_sc = fit_sc.at[row].set(r_fit)
         ba = ba.at[row].set(r_ba)
+        # All scatter/gather index operands stay int32 (matching `row` and
+        # the vid tables): with x64 enabled a bare arange defaults to int64,
+        # and mixed s64/s32 index tuples miscompile under GSPMD on this
+        # environment's XLA (compare(s64, s32) after spmd-partitioning —
+        # ROADMAP open item, fixed by this uniform-dtype normalization).
         if C1:
-            upd = (f.dns_self * dns_elig[jnp.arange(C1), row].astype(jnp.int32)
+            c1i = jnp.arange(C1, dtype=jnp.int32)
+            upd = (f.dns_self * dns_elig[c1i, row].astype(jnp.int32)
                    * apply.astype(jnp.int32))
-            dns_counts = dns_counts.at[jnp.arange(C1), dns_vid[:, row]].add(upd)
+            dns_counts = dns_counts.at[c1i, dns_vid[:, row]].add(upd)
             mnum = mnum + upd[:, None] * (dns_vid == dns_vid[:, row][:, None])
         if C2:
             upd = (f.sa_self * jnp.where(sa_ignored[row], 0, 1) * apply.astype(jnp.int32))
-            sa_counts = sa_counts.at[jnp.arange(C2), sa_vid[:, row]].add(upd)
+            sa_counts = sa_counts.at[jnp.arange(C2, dtype=jnp.int32),
+                                     sa_vid[:, row]].add(upd)
             scnt = scnt + upd[:, None] * (sa_vid == sa_vid[:, row][:, None])
         if A1:
             upd = f.anti_self * (anti_vid[:, row] > 0).astype(jnp.int32) * apply.astype(jnp.int32)
-            anti_counts = anti_counts.at[jnp.arange(A1), anti_vid[:, row]].add(upd)
+            anti_counts = anti_counts.at[jnp.arange(A1, dtype=jnp.int32),
+                                         anti_vid[:, row]].add(upd)
             acnt = acnt + upd[:, None] * (anti_vid == anti_vid[:, row][:, None])
         if A2:
             upd = f.aff_self * (aff_vid[:, row] > 0).astype(jnp.int32) * apply.astype(jnp.int32)
-            aff_counts = aff_counts.at[jnp.arange(A2), aff_vid[:, row]].add(upd)
+            aff_counts = aff_counts.at[jnp.arange(A2, dtype=jnp.int32),
+                                       aff_vid[:, row]].add(upd)
             fcnt = fcnt + upd[:, None] * (aff_vid == aff_vid[:, row][:, None])
             aff_total = aff_total + upd.sum()
         if KD:
             upd = f.ipa_wland * (ipa_vid[:, row] > 0) * apply
-            ipa_delta = ipa_delta.at[jnp.arange(KD), ipa_vid[:, row]].add(upd)
+            ipa_delta = ipa_delta.at[jnp.arange(KD, dtype=jnp.int32),
+                                     ipa_vid[:, row]].add(upd)
             dproj = dproj + upd[:, None] * (ipa_vid == ipa_vid[:, row][:, None])
         if port_selfblock:
             blocked = blocked.at[row].set(blocked[row] | any_kept)
@@ -494,12 +504,23 @@ def schedule_batch(
                 w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * r_fit + w_ba * r_ba
                 + il_term[row])
         start = jnp.where(active, (start + evaluated) % num, start).astype(jnp.int32)
+        # Results accumulate in the CARRY via a one-hot masked write (the
+        # int32 step counter `t` also rides the carry): lax.scan's own
+        # ys-stacking would index its dynamic_update_slice with the internal
+        # s64 loop counter (x64 mode), which this environment's XLA
+        # miscompiles under GSPMD — compare(s64, s32) after
+        # spmd-partitioning, the ROADMAP open item. The elementwise write
+        # keeps the carry uniformly int32-indexed and is also exact under
+        # vmap (the cells axis), where a batched-index update slice is not.
+        out = jnp.where(jnp.arange(batch_pad, dtype=jnp.int32)[None, :] == t,
+                        jnp.stack([chosen, start])[:, None], out)
 
         new_carry = (req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
                      dns_counts, sa_counts, anti_counts, aff_counts,
                      ipa_delta, start, blocked, aux_cnt, okd, F, total,
-                     mnum, scnt, acnt, fcnt, dproj, aff_total)
-        return new_carry, (chosen, start)
+                     mnum, scnt, acnt, fcnt, dproj, aff_total,
+                     t + jnp.int32(1), out)
+        return new_carry, None
 
     if carry_in is None:
         fit_ok0, fit_sc0, ba0 = _resource_eval(
@@ -521,18 +542,19 @@ def schedule_batch(
                              w_tt, w_fit, w_ba, il_term, anti_vid,
                              port_selfblock, has_aux, has_nom)
     # Per-node projections of the count tables (one gather per table per
-    # CALL, kept elementwise-fresh by the scan) + okd/F seeds.
-    i64v = jnp.int64
-    mnum0 = (jnp.take_along_axis(ext0.dns_counts, dns_vid.astype(i64v), axis=1)
+    # CALL, kept elementwise-fresh by the scan) + okd/F seeds. Index dtype
+    # is uniformly int32 — see the scatter-dtype note in `step`.
+    i32v = jnp.int32
+    mnum0 = (jnp.take_along_axis(ext0.dns_counts, dns_vid.astype(i32v), axis=1)
              if C1 else jnp.zeros((0, NP), jnp.int32))
-    scnt0 = (jnp.take_along_axis(ext0.sa_counts, sa_vid.astype(i64v), axis=1)
+    scnt0 = (jnp.take_along_axis(ext0.sa_counts, sa_vid.astype(i32v), axis=1)
              if C2 else jnp.zeros((0, NP), jnp.int32))
-    acnt0 = (jnp.take_along_axis(ext0.anti_counts, anti_vid.astype(i64v), axis=1)
+    acnt0 = (jnp.take_along_axis(ext0.anti_counts, anti_vid.astype(i32v), axis=1)
              if A1 else jnp.zeros((0, NP), jnp.int32))
-    fcnt0 = (jnp.take_along_axis(ext0.aff_counts, aff_vid.astype(i64v), axis=1)
+    fcnt0 = (jnp.take_along_axis(ext0.aff_counts, aff_vid.astype(i32v), axis=1)
              if A2 else jnp.zeros((0, NP), jnp.int32))
     if KD:
-        d0 = jnp.take_along_axis(ext0.ipa_delta, ipa_vid.astype(i64v), axis=1)
+        d0 = jnp.take_along_axis(ext0.ipa_delta, ipa_vid.astype(i32v), axis=1)
         dproj0 = d0 * jnp.where(ipa_vid > 0, 1, 0)
     else:
         dproj0 = jnp.zeros((0, NP), jnp.int64)
@@ -545,17 +567,18 @@ def schedule_batch(
                   + w_ba * ext0.ba + il_term)
     else:
         total0 = jnp.zeros(NP, jnp.int64)
+    out0 = jnp.full((2, batch_pad), -1, jnp.int32)
     carry0 = tuple(ext0) + (okd0, F0, total0,
-                            mnum0, scnt0, acnt0, fcnt0, dproj0, aff_total0)
-    final, (chosen, starts) = lax.scan(
-        step, carry0, jnp.arange(batch_pad, dtype=jnp.int32))
+                            mnum0, scnt0, acnt0, fcnt0, dproj0, aff_total0,
+                            jnp.int32(0), out0)
+    final, _ = lax.scan(step, carry0, None, length=batch_pad)
     # chosen+starts stacked into ONE array: the host fetches results with a
     # single device→host transfer (each fetch pays a full RTT on tunneled
     # TPUs). The final ScanCarry rides back (device-resident) so the host can
     # chain the next batch (carry_in) and keep the mirror resident
     # (NodeStateMirror.adopt) instead of re-uploading — the device-side
     # analogue of the incremental snapshot.
-    return jnp.stack([chosen, starts]), ScanCarry(*final[:14])
+    return final[-1], ScanCarry(*final[:14])
 
 
 @partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax",
@@ -751,7 +774,7 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
         if has_aux:
             okd &= aux_cnt + f.aux_inc <= f.aux_room
         if A1:
-            acnt = jnp.take_along_axis(anti_counts, anti_vid.astype(jnp.int64), axis=1)
+            acnt = jnp.take_along_axis(anti_counts, anti_vid.astype(jnp.int32), axis=1)
             okd &= ~((anti_vid > 0) & (acnt > 0)).any(axis=0)
         F = jnp.cumsum(okd.astype(jnp.int32))
         total = (w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * fit_sc
@@ -801,7 +824,8 @@ def _lap_schedule(state, f, batch_pad, fit_strategy, ext0,
             upd = (f.anti_self[:, None] * (anti_vid[:, rr] > 0).astype(jnp.int32)
                    * has_w[None, :].astype(jnp.int32))        # [A1, LAP_MAX]
             anti_counts = anti_counts.at[
-                jnp.arange(A1)[:, None], anti_vid[:, rr]].add(upd)
+                jnp.arange(A1, dtype=jnp.int32)[:, None],
+                anti_vid[:, rr]].add(upd)
         # ---- emit results (positions >= n_act are sliced off by the host) -
         chosen_w = jnp.where(has_w, row_w, -1)
         block = jnp.stack([chosen_w, start_w.astype(jnp.int32)])  # [2, LAP_MAX]

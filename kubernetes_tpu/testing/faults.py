@@ -21,6 +21,12 @@ deployment has:
   (``dispatch`` / ``preempt``), driving the device→host fallback and the
   circuit breaker.
 
+- ``ApiServerProcess`` — a real-OS-process apiserver under chaos control:
+  spawn with a durable data dir (WAL+snapshot, core/wal.py), ``kill9()``
+  (SIGKILL — no goodbye, no flush), ``restart()`` in place on the SAME
+  port + data dir. The crash-restart fault the durability layer and the
+  scheduler's post-restart reconciliation are tested against.
+
 Sidecar process kill rides ``SidecarServer.kill()`` (parallel/sidecar.py):
 an abrupt listener+connection teardown, distinct from graceful shutdown.
 
@@ -30,10 +36,16 @@ byte-for-byte from its seed.
 
 from __future__ import annotations
 
+import os
 import random
+import re
+import signal
 import socket
 import struct
+import subprocess
+import sys
 import threading
+import time
 from typing import Dict, Iterable, Optional
 
 from ..core.backoff import TransientAPIError
@@ -180,6 +192,104 @@ class ChaosTCPProxy:
         except OSError:
             pass
         self.drop_connections()
+
+
+def spawn_ready(cmd, pattern, cwd=None, env=None, timeout=120.0):
+    """Spawn a subprocess and block until a stdout line matches `pattern`
+    (stderr is folded into stdout). select-before-readline: a
+    silent-but-alive child trips the deadline instead of hanging the
+    harness; a dead child raises immediately. Returns (proc, match).
+
+    NOTE for callers printing a ready line: it must be the FIRST line the
+    child emits — readline buffers everything already in the pipe, so a
+    line printed BEFORE the ready line that arrives in the same chunk
+    would leave select() waiting on a drained fd."""
+    import select
+
+    proc = subprocess.Popen(cmd, cwd=cwd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    deadline = time.monotonic() + timeout
+    line = ""
+    while time.monotonic() < deadline:
+        ready, _, _ = select.select([proc.stdout], [], [],
+                                    max(0.0, deadline - time.monotonic()))
+        if not ready:
+            break
+        line = proc.stdout.readline()
+        if not line and proc.poll() is not None:
+            raise RuntimeError(f"{cmd[:3]} exited rc={proc.returncode}")
+        m = re.search(pattern, line)
+        if m:
+            return proc, m
+    proc.kill()
+    raise TimeoutError(f"{cmd[:3]} never printed {pattern!r}: last={line!r}")
+
+
+class ApiServerProcess:
+    """Standalone apiserver (`python -m kubernetes_tpu.core.apiserver`) as a
+    killable OS process: the control-plane analogue of SidecarServer.kill().
+
+    ``kill9()`` delivers SIGKILL mid-flight; ``restart()`` relaunches on the
+    SAME port with the SAME ``--data-dir`` so the new process recovers from
+    WAL+snapshot and watch clients reconnect to an identical address — the
+    crash-restart fault the durable store is specified against."""
+
+    _READY = re.compile(r"serving on 127\.0\.0\.1:(\d+)")
+
+    def __init__(self, data_dir: str, port: int = 0, fsync: bool = False,
+                 snapshot_every: int = 2048, startup_timeout: float = 60.0):
+        self.data_dir = data_dir
+        self.port = port
+        self.fsync = fsync
+        self.snapshot_every = snapshot_every
+        self.startup_timeout = startup_timeout
+        self.kills = 0
+        self.restarts = 0
+        self.proc: Optional[subprocess.Popen] = None
+        self._spawn()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def _spawn(self) -> None:
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo_root
+        cmd = [sys.executable, "-m", "kubernetes_tpu.core.apiserver",
+               "--port", str(self.port), "--data-dir", self.data_dir,
+               "--snapshot-every", str(self.snapshot_every)]
+        if self.fsync:
+            cmd.append("--fsync")
+        self.proc, m = spawn_ready(cmd, self._READY, cwd=repo_root, env=env,
+                                   timeout=self.startup_timeout)
+        # Pin the OS-assigned port: restarts re-bind the same one.
+        self.port = int(m.group(1))
+
+    def kill9(self) -> None:
+        """SIGKILL — the process dies mid-write, no flush, no shutdown."""
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=30)
+        self.kills += 1
+
+    def restart(self) -> None:
+        """Relaunch in place (same port, same data dir); blocks until the
+        recovered server is serving."""
+        assert self.proc.poll() is not None, "kill9()/stop() first"
+        self._spawn()
+        self.restarts += 1
+
+    def stop(self) -> None:
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
 
 
 class DeviceFaults:

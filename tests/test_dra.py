@@ -361,6 +361,55 @@ def test_quantity_string_equality_in_expressions():
         'device.attributes["model"] == "a100"')(d, "drv")
 
 
+def test_quantity_hash_eq_consistency():
+    """ADVICE r5 regression: coerced quantity values must satisfy the
+    hash/eq contract (a == b ⇒ hash(a) == hash(b)) for EVERY pairing of
+    coerced, raw-string, and plain-numeric forms — so mixing them in one
+    set or dict is well-defined. Cross-type string equality was dropped
+    (expression string literals coerce at compile time instead,
+    _ConstCoercer; see test_quantity_string_equality_in_expressions)."""
+    from kubernetes_tpu.api.dra import _CoercingMap
+
+    q8 = _CoercingMap._coerce("8")
+    q25 = _CoercingMap._coerce("2.5")
+    qgi = _CoercingMap._coerce("40Gi")
+    forms = [q8, "8", 8, q25, 2.5, "2.5", qgi, 40 * 1024 ** 3, "40Gi"]
+    for a in forms:
+        for b in forms:
+            if a == b:
+                assert hash(a) == hash(b), (a, b)
+    # one set/dict holding BOTH forms: coerced collapses with the number,
+    # the raw string stays a distinct, reachable member
+    s = {q8, "8", 8}
+    assert len(s) == 2 and 8 in s and "8" in s
+    d = {q8: "qty", "8": "raw"}
+    assert len(d) == 2 and d[8] == "qty" and d["8"] == "raw"
+    # ordering against suffixed strings still coerces (no hash contract)
+    assert qgi >= "32Gi" and q8 < "16"
+
+
+def test_const_coercion_scoped_to_quantity_map_comparisons():
+    """The compile-time coercion must ONLY touch comparator operands of the
+    two quantity maps: subscript KEYS stay literal strings (the map is
+    string-keyed), plain-string fields compare as strings, and `in`
+    membership against a quantity map coerces tuple members."""
+    from kubernetes_tpu.api.dra import Device, compile_device_expression
+
+    d = Device(name="0", attributes={"8": "yes", "count": "8",
+                                     "model": "a100"})
+    # quantity-shaped SUBSCRIPT KEY: looked up as the string "8"
+    assert compile_device_expression(
+        'device.attributes["8"] == "yes"')(d, "drv")
+    # quantity-shaped literal vs a PLAIN-STRING field: string semantics
+    assert compile_device_expression('device.name == "0"')(d, "drv")
+    assert not compile_device_expression('device.name == "1"')(d, "drv")
+    # membership against a quantity map coerces the tuple members
+    assert compile_device_expression(
+        'device.attributes["count"] in ("4", "8")')(d, "drv")
+    assert compile_device_expression(
+        'device.attributes["model"] in ("a100", "h100")')(d, "drv")
+
+
 def test_coerced_memo_invalidates_on_map_replacement():
     """Replacing a device's attribute/capacity maps (the copy-on-write
     mutation contract) must invalidate the memoized coerced views — stale

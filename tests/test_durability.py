@@ -1,0 +1,322 @@
+"""Durable control plane (core/wal.py + core/apiserver.py data_dir): WAL
+append/replay, snapshot compaction, torn-record handling, epoch + rv
+persistence, watch resume across a server restart, bind replay idempotency,
+and the scheduler's assumed-vs-recovered-truth reconciliation."""
+
+import json
+import os
+import time
+
+import pytest
+
+from kubernetes_tpu.core import FakeClientset, Scheduler
+from kubernetes_tpu.core.apiserver import (APIServer, HTTPClientset,
+                                           node_from_wire, node_to_wire,
+                                           pod_from_wire, pod_to_wire)
+from kubernetes_tpu.core.backoff import RetryConfig
+from kubernetes_tpu.core.clientset import RetryingClientset
+from kubernetes_tpu.core.wal import DurableStore
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _nodes(n, cpu=8):
+    return [make_node().name(f"n{i}")
+            .capacity({"cpu": cpu, "memory": "32Gi", "pods": 110})
+            .zone(f"z{i % 2}").obj() for i in range(n)]
+
+
+def _pods(n):
+    proto = (make_pod().name("proto").req({"cpu": "500m", "memory": "128Mi"})
+             .labels({"app": "wal"}).obj())
+    return [proto.clone_from_template(f"p{i}") for i in range(n)]
+
+
+def _serve_on(api, port, timeout=20.0):
+    """Bind a (re)started server to a specific port, riding out TIME_WAIT."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return api.serve(port)
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.1)
+
+
+# ---------------------------------------------------------------------------
+# WAL + snapshot mechanics (core/wal.py units)
+# ---------------------------------------------------------------------------
+
+
+class TestDurableStore:
+    def test_append_replay_roundtrip(self, tmp_path):
+        d = str(tmp_path / "s")
+        ds = DurableStore(d)
+        ds.init_epoch("abc123")
+        recs = [{"kind": "pods", "type": "ADDED", "rv": i, "object": {"i": i}}
+                for i in range(1, 6)]
+        assert ds.load() == (None, [])
+        for r in recs:
+            ds.append(r)
+        ds.close()
+        ds2 = DurableStore(d)
+        assert ds2.epoch == "abc123"
+        snap, replayed = ds2.load()
+        assert snap is None and replayed == recs
+        assert ds2.torn_records_discarded == 0
+        ds2.close()
+
+    def test_snapshot_compaction_resets_wal(self, tmp_path):
+        d = str(tmp_path / "s")
+        ds = DurableStore(d, snapshot_every=3)
+        ds.load()
+        for i in range(1, 4):
+            ds.append({"kind": "nodes", "type": "ADDED", "rv": i,
+                       "object": {}})
+        assert ds.should_compact()
+        ds.write_snapshot({"seq": {"nodes": 3}, "marker": "compacted"})
+        assert not ds.should_compact() and ds.compactions == 1
+        ds.append({"kind": "nodes", "type": "ADDED", "rv": 4, "object": {}})
+        ds.close()
+        ds2 = DurableStore(d)
+        snap, recs = ds2.load()
+        assert snap["marker"] == "compacted"
+        assert [r["rv"] for r in recs] == [4]  # WAL holds only the tail
+        ds2.close()
+
+    def test_torn_final_record_discarded_and_truncated(self, tmp_path):
+        d = str(tmp_path / "s")
+        ds = DurableStore(d)
+        ds.load()
+        ds.append({"kind": "pods", "type": "ADDED", "rv": 1, "object": {}})
+        ds.append({"kind": "pods", "type": "ADDED", "rv": 2, "object": {}})
+        ds.close()
+        wal = os.path.join(d, DurableStore.WAL)
+        with open(wal, "ab") as fh:
+            fh.write(b'{"kind": "pods", "type": "ADD')  # kill -9 mid-write
+        ds2 = DurableStore(d)
+        _, recs = ds2.load()
+        assert [r["rv"] for r in recs] == [1, 2]
+        assert ds2.torn_records_discarded == 1
+        # the torn frame was truncated away: appends resume a clean log
+        ds2.append({"kind": "pods", "type": "ADDED", "rv": 3, "object": {}})
+        ds2.close()
+        ds3 = DurableStore(d)
+        _, recs = ds3.load()
+        assert [r["rv"] for r in recs] == [1, 2, 3]
+        assert ds3.torn_records_discarded == 0
+        ds3.close()
+
+
+# ---------------------------------------------------------------------------
+# apiserver recovery (snapshot+WAL replay, rv/epoch resume)
+# ---------------------------------------------------------------------------
+
+
+def test_apiserver_recovers_store_rv_and_epoch(tmp_path):
+    d = str(tmp_path / "state")
+    api = APIServer(data_dir=d, snapshot_every=7)  # exercises compaction too
+    for n in _nodes(3):
+        api.store.create_node(n)
+    pods = _pods(6)
+    for p in pods:
+        api.store.create_pod(p)
+    api.store.bind(pods[0], "n0")
+    api.store.bind(pods[1], "n1")
+    api.store.delete_pod(pods[5])
+    epoch, seq = api.epoch, dict(api._seq)
+    api.shutdown()
+
+    api2 = APIServer(data_dir=d)
+    assert api2.epoch == epoch              # persisted boot epoch re-announced
+    assert dict(api2._seq) == seq           # rv counters resume, not restart
+    assert set(api2.store.nodes) == {"n0", "n1", "n2"}
+    assert len(api2.store.pods) == 5        # the deleted pod stayed deleted
+    assert api2.store.bindings == {pods[0].uid: "n0", pods[1].uid: "n1"}
+    assert api2.persistence.compactions == 0  # fresh instance, fresh counter
+    # recovered backlog serves incremental resumes: a new write mints the
+    # NEXT rv, never a duplicate
+    before = api2._seq["pods"]
+    api2.store.create_pod(_pods(1)[0].clone_from_template("fresh"))
+    assert api2._seq["pods"] == before + 1
+    api2.shutdown()
+
+
+def test_watch_resume_across_restart_same_epoch(tmp_path):
+    """A reflector that survives the server's death reconnects with its last
+    rv + the PERSISTED epoch and is served RESUME — no Replace re-list."""
+    d = str(tmp_path / "state")
+    api = APIServer(data_dir=d)
+    port = api.serve(0)
+    client = HTTPClientset(f"http://127.0.0.1:{port}")
+    try:
+        for n in _nodes(2):
+            client.create_node(n)
+        for p in _pods(4):
+            client.create_pod(p)
+        deadline = time.monotonic() + 10
+        while len(client.pods) < 4 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(client.pods) == 4
+        relists = dict(client.relists)
+        api.shutdown()  # process death analogue: streams EOF, state on disk
+
+        api2 = APIServer(data_dir=d)
+        _serve_on(api2, port)
+        try:
+            pod = _pods(1)[0].clone_from_template("after-restart")
+            client.create_pod(pod)
+            deadline = time.monotonic() + 20
+            while (pod.uid not in client.pods
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert pod.uid in client.pods  # live events flow again
+            assert client.resumes["pods"] >= 1
+            assert client.resumes["nodes"] >= 1
+            assert dict(client.relists) == relists  # RESUME, never Replace
+            assert api2.resumed_watches >= 2
+        finally:
+            api2.shutdown()
+    finally:
+        client.close()
+
+
+def test_bind_replay_idempotent_conflict_409(tmp_path):
+    """A retried bind whose first reply was lost lands as an idempotent
+    same-node 200; a bind to a DIFFERENT node is a 409 conflict (a pod must
+    never be bound twice)."""
+    from urllib import request as urlrequest
+    from urllib.error import HTTPError
+
+    api = APIServer()
+    port = api.serve(0)
+    base = f"http://127.0.0.1:{port}"
+
+    def call(method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urlrequest.Request(base + path, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+        with urlrequest.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    try:
+        call("POST", "/api/v1/nodes", node_to_wire(_nodes(1)[0]))
+        pod = _pods(1)[0]
+        call("POST", "/api/v1/pods", pod_to_wire(pod))
+        assert call("POST", f"/api/v1/pods/{pod.uid}/binding",
+                    {"node": "n0"}) == {"bound": True}
+        seq_after_bind = api._seq["pods"]
+        # replay (lost reply): idempotent, no re-fired MODIFIED event
+        assert call("POST", f"/api/v1/pods/{pod.uid}/binding",
+                    {"node": "n0"}) == {"bound": True}
+        assert api._seq["pods"] == seq_after_bind
+        with pytest.raises(HTTPError) as ei:
+            call("POST", f"/api/v1/pods/{pod.uid}/binding", {"node": "other"})
+        assert ei.value.code == 409
+        assert api.bind_conflicts == 1
+        assert api.store.bindings[pod.uid] == "n0"
+    finally:
+        api.shutdown()
+
+
+def test_nomination_status_patch_survives_restart(tmp_path):
+    """Status patches fan out no watch event, but the scheduling-relevant
+    slice (nominatedNodeName) is WAL'd as an rv-less STATUS record: a
+    restart recovers it, and the record never enters the watch backlog."""
+    from urllib import request as urlrequest
+
+    def call(base, method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urlrequest.Request(base + path, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+        with urlrequest.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    d = str(tmp_path / "state")
+    api = APIServer(data_dir=d)
+    port = api.serve(0)
+    base = f"http://127.0.0.1:{port}"
+    pod = _pods(1)[0]
+    try:
+        call(base, "POST", "/api/v1/nodes", node_to_wire(_nodes(1)[0]))
+        call(base, "POST", "/api/v1/pods", pod_to_wire(pod))
+        call(base, "POST", f"/api/v1/pods/{pod.uid}/status",
+             {"nominatedNodeName": "n0"})
+        assert api.store.pods[pod.uid].nominated_node_name == "n0"
+    finally:
+        api.shutdown()
+
+    api2 = APIServer(data_dir=d)
+    assert api2.store.pods[pod.uid].nominated_node_name == "n0"
+    # rv-less STATUS records replay into the store but never the backlog
+    assert all(rv is not None for rv, _ in api2._backlog["pods"])
+    api2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# scheduler post-restart reconciliation (assumed-vs-recovered truth)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_unwinds_lost_binds_against_recovered_truth():
+    """An apiserver that comes back WITHOUT the scheduler's bindings (the
+    lost-bind recovery shape: restart from a stale store): the reflector's
+    re-list reports the pods unbound, the scheduler diffs that against its
+    cache (assumed + bound placements), unwinds the phantoms, and rebinds
+    everything against the recovered truth."""
+    api = APIServer()
+    port = api.serve(0)
+    node_wires = [node_to_wire(n) for n in _nodes(4)]
+    pod_wires = [pod_to_wire(p) for p in _pods(6)]
+    for w in node_wires:
+        api.store.create_node(node_from_wire(w))
+    for w in pod_wires:
+        api.store.create_pod(pod_from_wire(w))
+    client = HTTPClientset(f"http://127.0.0.1:{port}")
+    sched = Scheduler(
+        clientset=RetryingClientset(client, retry=RetryConfig(
+            initial_backoff=0.02, max_backoff=0.2, max_attempts=8, seed=3)),
+        deterministic_ties=True)
+    api2 = None
+    try:
+        deadline = time.monotonic() + 30
+        while len(api.store.bindings) < 6 and time.monotonic() < deadline:
+            sched.run_until_idle()
+            time.sleep(0.01)
+        assert len(api.store.bindings) == 6
+        first_truth = dict(api.store.bindings)
+        api.shutdown()
+
+        # Amnesiac restart: same objects, NO bindings.
+        api2 = APIServer()
+        for w in node_wires:
+            api2.store.create_node(node_from_wire(w))
+        for w in pod_wires:
+            api2.store.create_pod(pod_from_wire(w))
+        _serve_on(api2, port)
+
+        deadline = time.monotonic() + 60
+        while len(api2.store.bindings) < 6 and time.monotonic() < deadline:
+            sched.run_until_idle()
+            time.sleep(0.01)
+        assert sched.reconcile_unwinds >= 6      # every phantom was unwound
+        assert len(api2.store.bindings) == 6     # ...and re-committed
+        # every pod rebound exactly once, onto real nodes (exact placements
+        # may legitimately rotate: the reschedule continues the rotation
+        # index where the first run left it)
+        assert set(api2.store.bindings) == set(first_truth)
+        assert all(n in api2.store.nodes for n in api2.store.bindings.values())
+        # the balanced workload still spreads one pod short of everywhere
+        assert len(set(api2.store.bindings.values())) == 4
+        # the cache converges on the recovered truth (no stale phantoms) —
+        # drain the in-flight bind-confirm events first
+        deadline = time.monotonic() + 15
+        while sched.cache.assumed_pods and time.monotonic() < deadline:
+            sched.run_until_idle()
+            time.sleep(0.01)
+        assert len(sched.cache.assumed_pods) == 0
+    finally:
+        client.close()
+        for a in (api, api2):
+            if a is not None:
+                a.shutdown()

@@ -2,7 +2,13 @@
 8-device virtual CPU mesh in conftest), TPUScheduler automatically shards
 the node axis over a ("cells", "nodes") mesh and the kernel compiles SPMD —
 every test in test_device_equivalence.py therefore runs sharded≡host. These
-tests pin the activation so it cannot silently regress to single-device."""
+tests pin the activation so it cannot silently regress to single-device.
+
+The two SPMD-asserting tests (chained sessions, multihost mesh) are live
+again: the environment's GSPMD s64/s32 miscompile was fixed at the source
+(uniform-int32 scan index/carry in ops/kernel.py — see ROADMAP), so a
+breaker-driven fallback to the host path here is a REGRESSION, not an
+environment fact."""
 
 import jax
 import numpy as np
