@@ -125,9 +125,14 @@ def main():
     # `scheduled` by exactly the warmup pods, which read as double-counting).
     warm_sched = sched.scheduled
     warm_failures = sched.failures
-    warm_dev_sched = sched.device_scheduled
-    warm_dev_batches = sched.device_batches
-    warm_host_pods = sched.host_path_pods
+    # Window-diff every attributable counter (the same step-accounting split
+    # the perf table reports — plan_build/device_wait/host_commit — plus the
+    # plan-rebuild kinds), so the headline bench can attribute its own
+    # number instead of printing an unexplained pods/s. One canonical list,
+    # shared with the perf harness.
+    from kubernetes_tpu.perf.harness import _ThroughputCollector
+    WINDOW = _ThroughputCollector.WINDOW_COUNTERS
+    win0 = {a: getattr(sched, a, 0) for a in WINDOW}
 
     for p in make_pods(n_pods, "bench"):
         cs.create_pod(p)
@@ -137,20 +142,21 @@ def main():
 
     scheduled = sched.scheduled - warm_sched
     pods_per_sec = scheduled / elapsed if elapsed > 0 else 0.0
+    detail = {
+        "scheduled": scheduled,
+        "failures": sched.failures - warm_failures,
+        "elapsed_s": round(elapsed, 2),
+        "platform": platform_note + "/" + os.environ.get("JAX_PLATFORMS", "default"),
+    }
+    for a in WINDOW:
+        d = getattr(sched, a, 0) - win0[a]
+        detail[a] = round(d, 3) if isinstance(d, float) else d
     result = {
         "metric": f"pods scheduled/sec ({n_nodes} nodes, {n_pods} pods, device batch path)",
         "value": round(pods_per_sec, 1),
         "unit": "pods/s",
         "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
-        "detail": {
-            "scheduled": scheduled,
-            "failures": sched.failures - warm_failures,
-            "elapsed_s": round(elapsed, 2),
-            "device_batches": sched.device_batches - warm_dev_batches,
-            "device_scheduled": sched.device_scheduled - warm_dev_sched,
-            "host_path_pods": sched.host_path_pods - warm_host_pods,
-            "platform": platform_note + "/" + os.environ.get("JAX_PLATFORMS", "default"),
-        },
+        "detail": detail,
     }
     print(json.dumps(result))
 

@@ -6,12 +6,18 @@ CURRENT backend and prints one JSON line per point plus the best.
     python bench_sweep.py                      # default grid
     BENCH_NODES=5000 BENCH_PODS=10000 python bench_sweep.py
     SWEEP_BATCHES=512,1024,2048 SWEEP_DEPTHS=2,3 python bench_sweep.py
+    python bench_sweep.py --bottleneck PERF_r03.json   # classify, don't run
 
 The dispatch-count vs scan-length tradeoff (and the RTT-hiding value of
 pipeline depth) is hardware-specific — on the tunneled TPU each result
 fetch pays tens of ms, on a local chip far less — so the right tier is
 measured, not guessed. Round 5: run this on the real chip and set
 config.max_batch / pipeline_depth from the winner.
+
+`--bottleneck PERF_*.json` reads a perf-table result file and prints each
+workload's dominant-cost classification (plan-build-bound / device-wait-
+bound / host-commit-bound / host-path-bound), so a round's VERDICT can rank
+optimization targets without hand-reading the table.
 """
 
 import json
@@ -21,10 +27,59 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from bench import _ensure_live_backend, build_cluster, make_pods  # noqa: E402
+
+def bottleneck(path: str) -> int:
+    """Classify every workload in a PERF_*.json by dominant cost. The
+    step-accounting split (plan_build_s / device_wait_s / host_commit_s,
+    models/tpu_scheduler.py) covers the device pipeline; pods that never
+    reached it classify as host-path-bound; workloads with no split data
+    and no host pods are unattributed."""
+    with open(path) as f:
+        data = json.load(f)
+    out = []
+    for r in data.get("results", []):
+        det = r.get("detail", {}) or {}
+        host_pods = det.get("host_path_pods", 0) or 0
+        dev_pods = det.get("device_scheduled", 0) or 0
+        split = {
+            "plan-build-bound": det.get("plan_build_s", 0.0) or 0.0,
+            "device-wait-bound": det.get("device_wait_s", 0.0) or 0.0,
+            "host-commit-bound": det.get("host_commit_s", 0.0) or 0.0,
+        }
+        total = sum(split.values())
+        if host_pods > dev_pods:
+            kind, share = "host-path-bound", None
+        elif total <= 0:
+            kind, share = "unattributed", None
+        else:
+            kind = max(split, key=split.get)
+            share = round(split[kind] / total, 2)
+        entry = {
+            "workload": r.get("workload"),
+            "bottleneck": kind,
+            "pods_per_second": r.get("pods_per_second"),
+            "split_s": {k.split("-")[0]: round(v, 2)
+                        for k, v in split.items()},
+        }
+        if share is not None:
+            entry["dominant_share"] = share
+        if host_pods:
+            entry["host_path_pods"] = host_pods
+        for k in ("plan_rebuilds_full", "plan_rebuilds_delta",
+                  "plan_rebuilds_resume"):
+            if det.get(k) is not None:
+                entry[k] = det[k]
+        out.append(entry)
+        print(json.dumps(entry), flush=True)
+    by_kind = {}
+    for e in out:
+        by_kind[e["bottleneck"]] = by_kind.get(e["bottleneck"], 0) + 1
+    print(json.dumps({"summary": by_kind}))
+    return 0
 
 
 def run_point(n_nodes, n_pods, max_batch, depth):
+    from bench import make_pods
     from kubernetes_tpu.core import FakeClientset
     from kubernetes_tpu.models import TPUScheduler
     from kubernetes_tpu.testing import make_node
@@ -57,6 +112,7 @@ def main():
         "SWEEP_BATCHES", "512,1024,2048").split(",")]
     depths = [int(d) for d in os.environ.get("SWEEP_DEPTHS", "2,3").split(",")]
 
+    from bench import _ensure_live_backend
     platform = _ensure_live_backend()
     best = None
     for mb in batches:
@@ -71,4 +127,15 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--bottleneck" in sys.argv:
+        i = sys.argv.index("--bottleneck")
+        if i + 1 >= len(sys.argv):
+            print("usage: bench_sweep.py --bottleneck PERF_rNN.json",
+                  file=sys.stderr)
+            sys.exit(2)
+        try:
+            sys.exit(bottleneck(sys.argv[i + 1]))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_sweep.py --bottleneck: {e}", file=sys.stderr)
+            sys.exit(2)
     main()

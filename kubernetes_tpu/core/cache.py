@@ -15,11 +15,132 @@ equivalent observable behavior, simpler host code.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional, Set
+from collections import deque
+from typing import Callable, Dict, List, NamedTuple, Optional, Set
 
 from ..api.types import Namespace, Node, Pod
 from .node_info import NodeInfo, PodInfo, next_generation
 from .node_tree import NodeTree
+
+
+# ---------------------------------------------------------------------------
+# Typed cluster-event journal
+# ---------------------------------------------------------------------------
+#
+# The old consumer contract was ONE integer (`Scheduler.cluster_event_seq`):
+# a device session could only ask "did anything change since seq S" and tear
+# its plan+carry down on any yes. The journal keeps the integer (it is still
+# the version every cache consumer keys on) but records WHAT each bump was —
+# (kind, node/namespace key, patch-relevant pod facts) — so a session can ask
+# "what changed since S" and delta-patch the exact rows an event dirtied
+# instead of rebuilding snapshot→features from scratch (the incremental-
+# resume generalization of cache.go:206's generation walk; KEP-5598's
+# opportunistic batching has the same never-restart-per-event shape).
+
+# Queue-only change: scheduling-gate lift, pending-pod update/delete,
+# pod-group registration. Dirties NOTHING node-side — a live session's
+# state, plan and carry all stay exact.
+EV_QUEUE = "queue"
+# Namespace created / labels changed. Only affinity namespaceSelector
+# matching reads namespace labels, so this is benign for plans with no
+# inter-pod-affinity machinery anywhere in play.
+EV_NAMESPACE = "namespace"
+# Pod appeared on / left / changed on a node (key = node name). Dirties that
+# node's resource aggregates (req_r/nonzero/pod_count rows); dirties
+# pod-derived feature tables too unless the pod is `plain` (see
+# pod_event_flags) and the plan carries none.
+EV_POD_ADD = "pod_add"
+EV_POD_REMOVE = "pod_remove"
+EV_POD_UPDATE = "pod_update"
+# Node object replaced in place with labels/images/declared-features intact
+# (key = node name): dirties that row's taint/allocatable/unschedulable
+# tensors only. Label or image changes are NOT this kind — they dirty
+# host-evaluated per-node feature vectors (sel_match/il_score/na_raw) and
+# topology vids, which the delta path does not patch.
+EV_NODE_UPDATE = "node_update"
+# Node added/removed: row order changes — never delta-patchable.
+EV_STRUCTURAL = "structural"
+# Everything else (storage objects, reconcile unwinds): full rebuild.
+EV_OTHER = "other"
+
+
+class ClusterEvent(NamedTuple):
+    seq: int
+    kind: str
+    key: str = ""          # node name (pod/node kinds) or namespace name
+    # Pod-side facts captured at record time (patch eligibility is decided
+    # later, against a specific plan):
+    pod_plain: bool = False   # no affinity/spread terms, no PVC/DRA claims
+    pod_ports: bool = False   # requests host ports
+    # True when the event can only ENLARGE feasibility (pod removed, taint
+    # lifted, capacity grown): results already computed on device against the
+    # pre-event state remain feasible, so in-flight batches may still commit
+    # while the patch waits for the pipeline to drain. Those commits keep
+    # their pre-event SCORES — a deliberate relaxation that only applies to
+    # events arriving asynchronously mid-session (the threaded inbox seam),
+    # where no interleaving against in-flight evaluations is defined and
+    # committing them is a legal linearization (the event lands just after).
+    # Deterministic (inline) event streams only ever patch at empty-pipeline
+    # boundaries, so the bit-identical-to-host-oracle invariant the
+    # equivalence suites enforce is unaffected.
+    shrink: bool = False
+
+
+def pod_event_flags(pod: Pod) -> tuple:
+    """(pod_plain, pod_ports) for a journal record. `plain` means the pod
+    cannot dirty any pod-derived feature table: no affinity/anti-affinity
+    terms (required or preferred), no topology-spread constraints, no
+    PVC-backed volumes (per-node attach counts), no DRA claims."""
+    aff = pod.affinity
+    plain = not (
+        pod.topology_spread_constraints
+        or (aff is not None and (aff.pod_affinity or aff.pod_anti_affinity))
+        or any(v.pvc_name for v in pod.volumes)
+        or getattr(pod, "resource_claims", None)
+    )
+    return plain, bool(pod.host_ports())
+
+
+class EventJournal:
+    """Bounded journal of node-state-relevant cluster events.
+
+    `seq` is the authoritative cluster-event version (the scheduler mirrors
+    it as `cluster_event_seq`). `since(S)` answers "what changed after S" —
+    or None when S has fallen off the retention window, which consumers must
+    treat as "anything may have changed" (full rebuild)."""
+
+    __slots__ = ("cap", "seq", "_events")
+
+    def __init__(self, capacity: int = 4096):
+        self.cap = capacity
+        self.seq = 0
+        self._events: deque = deque()
+
+    def record(self, kind: str, key: str = "", pod_plain: bool = False,
+               pod_ports: bool = False, shrink: bool = False) -> int:
+        self.seq += 1
+        self._events.append(ClusterEvent(
+            self.seq, kind, key, pod_plain, pod_ports, shrink))
+        if len(self._events) > self.cap:
+            self._events.popleft()
+        return self.seq
+
+    def since(self, seq: int) -> Optional[List[ClusterEvent]]:
+        """Events with .seq > seq in order, [] when nothing happened, or
+        None when the window was truncated (events older than retention).
+        Walks from the RIGHT so the per-invalidation-check cost is
+        O(new events), not O(retained window)."""
+        if seq >= self.seq:
+            return []
+        if not self._events or self._events[0].seq > seq + 1:
+            return None
+        out: List[ClusterEvent] = []
+        for e in reversed(self._events):
+            if e.seq <= seq:
+                break
+            out.append(e)
+        out.reverse()
+        return out
 
 
 class Snapshot:
@@ -150,6 +271,12 @@ class Cache:
         # device path's claim-sharing eligibility check reads this — a
         # shared claim must not ride the kernel's counted-attach encoding).
         self.pvc_refs: Dict[str, int] = {}
+        # Count of cached+assumed pods carrying ANY inter-pod (anti-)affinity
+        # term. Zero means pod labels and namespaces are scheduling-inert for
+        # affinity-free incoming pods — the live-truth gate behind the
+        # namespace-erased session signature (models/tpu_scheduler.py
+        # _neutral_sig) and the namespace-event delta classification.
+        self.affinity_pod_refs = 0
         # Optional scheduled-group-pods index (core/podgroupstate.py), kept
         # in lockstep with the cache's pod view (assumed + bound) — the
         # scheduler-side truth placement generation pins domains against.
@@ -291,6 +418,9 @@ class Cache:
             if v.pvc_name:
                 key = f"{pod.namespace}/{v.pvc_name}"
                 self.pvc_refs[key] = self.pvc_refs.get(key, 0) + 1
+        aff = pod.affinity
+        if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
+            self.affinity_pod_refs += 1
         self._dirty.add(pod.node_name)
 
     def _remove_pod_from_node(self, pod: Pod) -> None:
@@ -308,6 +438,9 @@ class Cache:
                     self.pvc_refs.pop(key, None)
                 else:
                     self.pvc_refs[key] = n
+        aff = pod.affinity
+        if aff is not None and (aff.pod_affinity or aff.pod_anti_affinity):
+            self.affinity_pod_refs = max(0, self.affinity_pod_refs - 1)
         ni = self.nodes.get(pod.node_name)
         if ni is not None:
             ni.remove_pod(pod)

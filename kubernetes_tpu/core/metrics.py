@@ -284,6 +284,16 @@ class SchedulerMetrics:
         self.pod_scheduled_after_flush = r(Counter(
             "scheduler_pod_scheduled_after_flush_total",
             "Pods scheduled in the first batch after a flush.", ()))
+        # incremental session resume (event-journal delta rebuilds)
+        self.plan_rebuild_total = r(Counter(
+            "scheduler_plan_rebuild_total",
+            "Device-session plan acquisitions, by kind: 'full' = complete "
+            "snapshot→features rebuild, 'resume' = untouched cache hit, "
+            "'delta' = journal-driven row patch of a live plan+carry.",
+            ("kind",)))
+        self.plan_rebuild_dirty_rows = r(Counter(
+            "scheduler_plan_rebuild_dirty_rows_total",
+            "Node rows re-encoded + scattered by delta plan patches.", ()))
         self.get_node_hint_duration = r(Histogram(
             "scheduler_get_node_hint_duration_seconds",
             "Batch reuse lookup latency (session-resume check)."))

@@ -33,7 +33,20 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..api.types import Pod
-from .cache import Cache, Snapshot
+from .cache import (
+    EV_NAMESPACE,
+    EV_NODE_UPDATE,
+    EV_OTHER,
+    EV_POD_ADD,
+    EV_POD_REMOVE,
+    EV_POD_UPDATE,
+    EV_QUEUE,
+    EV_STRUCTURAL,
+    Cache,
+    EventJournal,
+    Snapshot,
+    pod_event_flags,
+)
 from .clientset import FakeClientset
 from .framework import (
     MAX_NODE_SCORE,
@@ -315,6 +328,10 @@ class Scheduler:
         self.failures = 0
         self.error_log: List[str] = []
         # Versions node-state-relevant cluster changes (see _on_pod_event).
+        # The typed journal records WHAT each bump was, so device sessions
+        # can delta-patch instead of tearing down (cache.py EventJournal);
+        # cluster_event_seq mirrors journal.seq for all existing consumers.
+        self.journal = EventJournal()
         self.cluster_event_seq = 0
         # Versions cache-state UNWINDS that happen outside a scheduling
         # attempt (bind failure after Permit WAIT release, waiter expiry,
@@ -338,8 +355,11 @@ class Scheduler:
             self._timed_event("pod", self._on_pod_event)))
         self.clientset.on_node_event(self._threaded(
             self._timed_event("node", self._on_node_event)))
-        self.clientset.on_namespace_event(self._threaded(self._bump(self.cache.add_namespace)))
-        self.clientset.on_pod_group_event(self._threaded(self._bump(self.queue.register_pod_group)))
+        self.clientset.on_namespace_event(self._threaded(self._bump(
+            self.cache.add_namespace, EV_NAMESPACE,
+            keyfn=lambda ns: ns.name)))
+        self.clientset.on_pod_group_event(self._threaded(self._bump(
+            self.queue.register_pod_group, EV_QUEUE)))
         self.clientset.on_storage_event(self._threaded(
             self._timed_event("storage", self._on_storage_event)))
 
@@ -356,11 +376,19 @@ class Scheduler:
                 hist.observe(time.perf_counter() - t0, name)
         return h
 
-    def _bump(self, handler):
-        """Wrap a handler so it versions cluster_event_seq (namespace labels
-        and pod-group registrations affect scheduling outcomes)."""
+    def _record_event(self, kind: str, key: str = "", pod_plain: bool = False,
+                      pod_ports: bool = False, shrink: bool = False) -> None:
+        """Journal one typed event and advance cluster_event_seq."""
+        self.cluster_event_seq = self.journal.record(
+            kind, key, pod_plain=pod_plain, pod_ports=pod_ports,
+            shrink=shrink)
+
+    def _bump(self, handler, kind: str, keyfn=None):
+        """Wrap a handler so it versions cluster_event_seq with a typed
+        record (namespace labels and pod-group registrations affect
+        scheduling outcomes)."""
         def h(*args):
-            self.cluster_event_seq += 1
+            self._record_event(kind, keyfn(*args) if keyfn else "")
             handler(*args)
         return h
 
@@ -402,7 +430,7 @@ class Scheduler:
         # bumping the seq per created claim would tear down a session per
         # measured pod (the claim-template workload creates one each).
         if kind not in ("pvc", "resource_claim"):
-            self.cluster_event_seq += 1
+            self._record_event(EV_OTHER, kind)
         self.queue.move_all_to_active_or_backoff(EVENT_STORAGE_ADD, None, obj)
 
     def _responsible_for_pod(self, pod: Pod) -> bool:
@@ -413,9 +441,10 @@ class Scheduler:
     def _on_pod_event(self, kind: str, old: Optional[Pod], new: Pod) -> None:
         # cluster_event_seq versions node-state-relevant cluster changes so a
         # device batch session (models/tpu_scheduler.py) knows whether the
-        # on-device carry still reflects the cluster. Benign for the carry:
-        # pending-pod adds (queue-only) and our own bind confirms (the carry
-        # already holds that placement via the assume).
+        # on-device carry still reflects the cluster; the typed journal
+        # record lets it patch instead of tearing down. Benign for the
+        # carry (no record): pending-pod adds (queue-only) and our own bind
+        # confirms (the carry already holds that placement via the assume).
         if kind == "add" and not new.node_name:
             pass
         elif (kind == "update" and new.node_name
@@ -426,7 +455,7 @@ class Scheduler:
             # the assumed set can).
             pass
         else:
-            self.cluster_event_seq += 1
+            self._record_pod_event(kind, old, new)
         if kind == "add":
             if new.node_name:
                 self.cache.add_pod(new)
@@ -482,8 +511,82 @@ class Scheduler:
             else:
                 self.queue.delete(new)
 
+    def _record_pod_event(self, kind: str, old: Optional[Pod], new: Pod) -> None:
+        """Journal classification for a non-benign watch pod event."""
+        plain, ports = pod_event_flags(new)
+        if old is not None and old is not new:
+            oplain, oports = pod_event_flags(old)
+            plain, ports = plain and oplain, ports or oports
+        if kind == "add":
+            self._record_event(EV_POD_ADD, new.node_name,
+                               pod_plain=plain, pod_ports=ports)
+        elif kind == "update":
+            if new.node_name:
+                old_node = old.node_name if old is not None else ""
+                if not old_node:
+                    # Externally assigned (someone else's bind): load appears
+                    # on the node exactly like an assigned-pod add.
+                    self._record_event(EV_POD_ADD, new.node_name,
+                                       pod_plain=plain, pod_ports=ports)
+                elif old_node == new.node_name:
+                    self._record_event(EV_POD_UPDATE, new.node_name,
+                                       pod_plain=plain, pod_ports=ports)
+                else:  # moved between nodes: old row shrinks, new row grows
+                    self._record_event(EV_POD_REMOVE, old_node,
+                                       pod_plain=plain, pod_ports=ports,
+                                       shrink=True)
+                    self._record_event(EV_POD_ADD, new.node_name,
+                                       pod_plain=plain, pod_ports=ports)
+            else:
+                st = self.cache.pod_states.get(new.uid)
+                if st is not None and st.binding_finished:
+                    # Lost-bind reconciliation unwind (below): cache state
+                    # moves outside any single node row's aggregates.
+                    self._record_event(EV_OTHER, new.uid)
+                else:
+                    # Pending-pod spec update — the scheduling-gate lift
+                    # path. Queue-only: no node state moves.
+                    self._record_event(EV_QUEUE, new.uid)
+        elif kind == "delete":
+            if new.node_name:
+                self._record_event(EV_POD_REMOVE, new.node_name,
+                                   pod_plain=plain, pod_ports=ports,
+                                   shrink=True)
+            else:
+                self._record_event(EV_QUEUE, new.uid)
+        else:
+            self._record_event(EV_OTHER, new.uid)
+
+    @staticmethod
+    def _node_shrink_only(old, new) -> bool:
+        """True when `new` can only ENLARGE feasibility vs `old`: no taint
+        added, allocatable not reduced, unschedulable not switched on —
+        device results computed against `old` stay feasible under `new`."""
+        if new.unschedulable and not old.unschedulable:
+            return False
+        o_t = {(t.key, t.value, t.effect) for t in old.taints}
+        if any((t.key, t.value, t.effect) not in o_t for t in new.taints):
+            return False
+        oa, na = old.allocatable, new.allocatable
+        if (na.milli_cpu < oa.milli_cpu or na.memory < oa.memory
+                or na.ephemeral_storage < oa.ephemeral_storage
+                or na.allowed_pod_number < oa.allowed_pod_number):
+            return False
+        return all(na.scalar_resources.get(k, 0) >= v
+                   for k, v in oa.scalar_resources.items())
+
     def _on_node_event(self, kind: str, old, new) -> None:
-        self.cluster_event_seq += 1
+        if kind == "update" and old is not None and old.name == new.name \
+                and old.labels == new.labels and old.images == new.images \
+                and old.declared_features == new.declared_features:
+            # Taint/allocatable/unschedulable-only change: one row's
+            # non-feature tensors — delta-patchable by a live session.
+            self._record_event(EV_NODE_UPDATE, new.name,
+                               shrink=self._node_shrink_only(old, new))
+        elif kind == "update":
+            self._record_event(EV_OTHER, new.name)
+        else:
+            self._record_event(EV_STRUCTURAL, new.name)
         if kind == "add":
             self.cache.add_node(new)
             self.queue.move_all_to_active_or_backoff(EVENT_NODE_ADD, None, new)

@@ -49,6 +49,21 @@ from ..ops.kernel import schedule_batch
 _GANG_SESSION = "__gang_device_session__"
 
 
+class _SessionDelta:
+    """A live session's journal-patchable view: the device state + carry the
+    delta patches rewrite, the seq watermark already consumed, and whether a
+    shrink patch is parked waiting for the pipeline to drain. One protocol
+    (TPUScheduler._note_session_events) mutates it for both session kinds."""
+
+    __slots__ = ("state", "carry", "start_seq", "patch_pending")
+
+    def __init__(self, state, carry, start_seq):
+        self.state = state
+        self.carry = carry
+        self.start_seq = start_seq
+        self.patch_pending = False
+
+
 def _pow2_pad(n: int) -> int:
     """Placement-axis pow2 tier (shared by warm + live paths so the warm
     compile always matches the live kernel shape)."""
@@ -95,6 +110,13 @@ class TPUScheduler(Scheduler):
         self.device_batches = 0
         self.device_scheduled = 0
         self.host_path_pods = 0
+        # Plan acquisition attribution (scheduler_plan_rebuild_total):
+        # full = snapshot→features rebuild, resume = untouched cache hit,
+        # delta = journal-driven row patch of a live plan+carry.
+        self.plan_rebuilds_full = 0
+        self.plan_rebuilds_delta = 0
+        self.plan_rebuilds_resume = 0
+        self.delta_dirty_rows = 0
         # Stacked placement evaluations that ran on device (one per group
         # cycle whose candidate set was kernel-evaluated).
         self.placement_device_evals = 0
@@ -123,6 +145,10 @@ class TPUScheduler(Scheduler):
         # chains on — the cross-session generalization of the in-session
         # chained carry (plan_build was ~1s of the r03 measured window).
         self._resume = None
+        # Live session's namespace-erased signature (None = exact-sig only)
+        # and the node-name→row map behind journal delta patches.
+        self._session_neutral_sig = None
+        self._session_row_of = None
         # Per-framework commit fast-path eligibility (see _commit).
         self._fast_tail: dict = {}
         # Drivers with ANY CSINode attach limit (volume aux eligibility);
@@ -211,6 +237,7 @@ class TPUScheduler(Scheduler):
             f"dra:{head.pod.namespace}/{n}"
             for n in getattr(head.pod, "resource_claims", ()) or ())
         self._session_aux_shape = self._aux_shape(head.pod)
+        self._session_neutral_sig = self._neutral_sig(fw, head.pod, sig)
         batch = [head]
         while len(batch) < self.max_batch:
             nxt = self._pop()
@@ -316,22 +343,12 @@ class TPUScheduler(Scheduler):
         self._session_claims = {
             c for m in first.members for c in self._claims_of(m.pod)}
         claims_rv = getattr(self.clientset, "resource_claims_rv", 0)
-        carry = None
-        resume = self._resume
-        self._resume = None
-        if (resume is not None
-                and resume[0] == (id(fw), sig, aux_shape, claims_rv,
-                                  self.cluster_event_seq,
-                                  self.attempts, self.state_unwinds)
-                and resume[2] == self._nom_resume_key(
-                    first.members[0].pod.priority)):
-            state, plan, carry, node_names = resume[1]
-        else:
-            _t0 = _time.perf_counter()
-            state, plan = self.build_plan(fw, first.members[0].pod, self.max_batch)
-            self.plan_build_s += _time.perf_counter() - _t0
-            node_names = [ni.name for ni in self.snapshot.node_info_list]
-        start_seq = self.cluster_event_seq
+        # Gang resumes stay exact-signature (nsig=None): the neutral erasure
+        # targets plain-pod namespace sweeps, not group entities.
+        state, plan, carry, node_names, _rkind = self._resume_or_rebuild(
+            fw, first.members[0].pod, sig, None, aux_shape, claims_rv)
+        sd = _SessionDelta(state, carry, self.cluster_event_seq)
+        del state, carry
         start_unwinds = self.state_unwinds
         inflight: List[Tuple[List[QueuedPodGroupInfo], object]] = []
         ok_rows: List[int] = []
@@ -363,13 +380,21 @@ class TPUScheduler(Scheduler):
 
         while True:
             while not invalidated and len(inflight) < self.pipeline_depth:
+                if sd.patch_pending:
+                    if inflight:
+                        break  # retire dispatched packs before patching
+                    if not self._note_session_events(sd, plan, node_names,
+                                                     busy=False):
+                        invalidated = True
+                        break
                 if pack is None:
                     pack = collect_pack() or None
                     if pack is None:
                         break
                     pending.append(pack)
                 members = [m for g in pack for m in self._sorted_members(g)]
-                results, carry = self._dispatch(state, plan, len(members), carry)
+                results, sd.carry = self._dispatch(
+                    sd.state, plan, len(members), sd.carry)
                 try:
                     results.copy_to_host_async()
                 except AttributeError:
@@ -390,8 +415,9 @@ class TPUScheduler(Scheduler):
             res = np.asarray(results)
             _t1 = _time.perf_counter()
             self.device_wait_s += _t1 - _t0
-            if (invalidated or self.cluster_event_seq != start_seq
-                    or self.state_unwinds != start_unwinds):
+            if (invalidated or self.state_unwinds != start_unwinds
+                    or not self._note_session_events(sd, plan, node_names,
+                                                     busy=True)):
                 invalidated = True
                 for g in groups:
                     for m in self._sorted_members(g):
@@ -423,10 +449,11 @@ class TPUScheduler(Scheduler):
                                                ok_rows, dirty_rows):
                     invalidated = True  # a member's host commit rejected a
                     # placement the carry already applied
-                if (self.cluster_event_seq != start_seq
-                        or self.state_unwinds != start_unwinds):
+                if (self.state_unwinds != start_unwinds
+                        or not self._note_session_events(sd, plan, node_names,
+                                                         busy=True)):
                     invalidated = True
-                    start_seq = self.cluster_event_seq
+                    sd.start_seq = self.cluster_event_seq
                     start_unwinds = self.state_unwinds
             self.host_commit_s += _time.perf_counter() - _t1
             if getattr(self, "_after_flush", False):
@@ -452,16 +479,12 @@ class TPUScheduler(Scheduler):
             self._after_flush = True
         else:
             self.mirror.adopt(self.snapshot.node_info_list, ok_rows,
-                              carry.req_r, carry.nonzero, carry.pod_count,
-                              dirty_rows=dirty_rows)
-            if carry is not None and not dirty_rows:
-                self._resume = (
-                    (id(fw), sig, aux_shape,
-                     getattr(self.clientset, "resource_claims_rv", 0),
-                     self.cluster_event_seq, self.attempts,
-                     self.state_unwinds),
-                    (state, plan, carry, node_names),
-                    self._nom_resume_key(first.members[0].pod.priority))
+                              sd.carry.req_r, sd.carry.nonzero,
+                              sd.carry.pod_count, dirty_rows=dirty_rows)
+            if sd.carry is not None and not dirty_rows:
+                self._save_resume(fw, first.members[0].pod, sig, aux_shape,
+                                  sd.state, plan, sd.carry, node_names,
+                                  neutral_ok=False)
         self._note_device_success()
 
     def _commit_gang_group(self, fw: Framework, qgpi: QueuedPodGroupInfo,
@@ -1129,6 +1152,252 @@ class TPUScheduler(Scheduler):
         nom = self.queue.nominator
         return (nom.version, priority if nom.has_nominated_pods() else None)
 
+    # -- incremental session resume (typed event journal) -------------------
+    #
+    # The resume cache used to be all-or-nothing: ANY cluster event bumped
+    # cluster_event_seq, missed the key, and forced a full snapshot→features
+    # teardown (plan_build dominated the WhileGated/DeletedPodsWithFinalizers
+    # perf rows). The journal (core/cache.py EventJournal) records what each
+    # bump WAS, so a session can classify the intervening events against its
+    # plan and patch exactly the rows they dirtied — mirror staging, resident
+    # device state, and the live carry — then keep (or resume) the session
+    # with the pipeline full. Unclassifiable events keep today's behavior:
+    # full rebuild / invalidation.
+
+    def _count_rebuild(self, kind: str) -> None:
+        if kind == "full":
+            self.plan_rebuilds_full += 1
+        elif kind == "delta":
+            self.plan_rebuilds_delta += 1
+        else:
+            self.plan_rebuilds_resume += 1
+        self.metrics.plan_rebuild_total.inc(kind)
+
+    def _neutral_sig(self, fw: Framework, pod, sig):
+        """Namespace/label-erased session signature, or None when ineligible.
+
+        The IPA and PTS Sign plugins fold (labels, namespace) into every
+        pod's signature because affinity terms and spread selectors read
+        them — which splits e.g. per-namespace pod sets (the *WithNSSelector
+        init phase) into one session per namespace even though every pod
+        builds the IDENTICAL plan. When the pod carries no affinity/spread
+        machinery, no volumes or claims (namespaced PVC keys), and NO pod in
+        the cluster carries affinity terms (cache.affinity_pod_refs — live
+        truth, unlike the possibly-stale snapshot sublists), labels and
+        namespace are scheduling-inert: erase them so pods differing only
+        there share one session, one plan, and one chained carry.
+
+        The erased tuple is pure spec (memoized on the template-shared
+        signature holder, so a namespace sweep of N clones erases once);
+        only the cluster-side affinity gate is live state."""
+        if sig is None or self.cache.affinity_pod_refs:
+            return None
+        shared = pod.__dict__.get("_sig_shared")
+        # node_name rides the key exactly as sign_pod's own memo does (it is
+        # the one signed field mutated in place).
+        key = ("_nsig", id(fw), pod.node_name)
+        if shared is not None and key in shared:
+            return shared[key]
+        aff = pod.affinity
+        if (pod.topology_spread_constraints or pod.volumes
+                or getattr(pod, "resource_claims", None)
+                or (aff is not None
+                    and (aff.pod_affinity or aff.pod_anti_affinity))):
+            out = None
+        else:
+            out = tuple(
+                (name, part[2:] if name in ("InterPodAffinity",
+                                            "PodTopologySpread") else part)
+                for name, part in sig)
+        if shared is not None:
+            shared[key] = out
+        return out
+
+    def _classify_delta(self, events, plan):
+        """Map journal events to the feature blocks they dirty under `plan`.
+        Returns (level, dirty node names): 'benign' (nothing node-side
+        moved), 'safe' (row patches whose events only enlarge feasibility —
+        in-flight device results stay committable), 'strict' (row patches
+        that may shrink feasibility: only applicable with an empty
+        pipeline) — or None when any event needs the full rebuild."""
+        from ..core.cache import (EV_NAMESPACE, EV_NODE_UPDATE, EV_POD_ADD,
+                                  EV_POD_REMOVE, EV_POD_UPDATE, EV_QUEUE)
+        level = 0
+        names = set()
+        for ev in events:
+            if ev.kind == EV_QUEUE:
+                continue
+            if ev.kind == EV_NAMESPACE:
+                # Namespace labels feed ONLY affinity namespaceSelector
+                # matching: inert while no term exists on either side.
+                if plan.pod_local and self.cache.affinity_pod_refs == 0:
+                    continue
+                return None
+            if ev.kind in (EV_POD_ADD, EV_POD_REMOVE, EV_POD_UPDATE):
+                # plan.pod_local: a pod on node n can only dirty row n's
+                # resource aggregates (no count table could have counted
+                # it); ev.pod_plain: the pod brings no terms that could
+                # flip exist_anti/ipa_base from their compiled-empty state.
+                if not (plan.pod_local and ev.pod_plain):
+                    return None
+                if ev.pod_ports and plan.port_selfblock:
+                    return None  # used_ports moved under a port-aware plan
+            elif ev.kind == EV_NODE_UPDATE:
+                if not plan.pod_local:
+                    return None  # honor-policy spread tables read taints
+            else:
+                return None
+            names.add(ev.key)
+            level = max(level, 1 if ev.shrink else 2)
+        return ("benign", "safe", "strict")[level], names
+
+    def _note_session_events(self, sd, plan, node_names, busy: bool) -> bool:
+        """The ONE journal-consumption protocol both session kinds run at
+        their invalidation checks. `sd` is the session's mutable delta view
+        (_SessionDelta); updated in place. Returns True when the session
+        stays valid — benign advance, patch applied, or patch deferred
+        until the pipeline drains — False when it must invalidate. `busy` =
+        dispatched-but-uncommitted device results exist."""
+        if self.cluster_event_seq == sd.start_seq and not sd.patch_pending:
+            return True
+        events = self.journal.since(sd.start_seq)
+        if events is None:
+            return False
+        cls = self._classify_delta(events, plan)
+        if cls is None:
+            return False
+        level, names = cls
+        if not names:
+            sd.start_seq = self.cluster_event_seq
+            sd.patch_pending = False
+            return True
+        if busy:
+            if level == "strict":
+                return False  # in-flight results may no longer fit
+            sd.patch_pending = True  # shrink-only: commit in-flight as-is,
+            return True              # patch once the pipeline drains
+        patched = self._apply_delta_patch(
+            plan, node_names, names, sd.state, sd.carry)
+        if patched is None:
+            return False
+        sd.state, sd.carry = patched
+        sd.start_seq = self.cluster_event_seq
+        sd.patch_pending = False
+        self._count_rebuild("delta")
+        return True
+
+    def _apply_delta_patch(self, plan, node_names, names, state, carry):
+        """Patch the journal's dirty rows into mirror staging, the resident
+        device state, and the session carry. Returns (state, carry) or None
+        when the patch can't apply — the caller's full-rebuild fallback
+        recovers from every None."""
+        if not names:
+            return state, carry
+        if self.mesh is not None:
+            return None  # sharded states take the full (sharded) path
+        row_of = getattr(self, "_session_row_of", None)
+        if row_of is None or row_of[0] is not node_names:
+            row_of = (node_names, {n: i for i, n in enumerate(node_names)})
+            self._session_row_of = row_of
+        updates = []
+        for nm in names:
+            row = row_of[1].get(nm)
+            ni = self.cache.nodes.get(nm)
+            if row is None or ni is None or ni.node is None:
+                return None  # row set changed shape: structural after all
+            updates.append((row, ni))
+        new_state = self.mirror.patch_rows(updates)
+        if new_state is None:
+            return None
+        rows = sorted({r for r, _ in updates})
+        if not plan.has_pns:
+            from ..ops.codebook import EFFECT_PREFER_NO_SCHEDULE
+            if (self.mirror.h_taint_eff[rows]
+                    == EFFECT_PREFER_NO_SCHEDULE).any():
+                # The plan compiled the no-PreferNoSchedule fast path;
+                # staging is already patched, so the full rebuild (which
+                # recomputes has_pns) resumes from truth.
+                return None
+        if carry is not None:
+            import jax.numpy as jnp
+            from ..ops.features import _pow2
+            from ..ops.kernel import patch_carry_rows
+            tier = _pow2(len(rows), 1)
+            prows = rows + [rows[-1]] * (tier - len(rows))
+            carry = patch_carry_rows(
+                new_state, plan.features, carry,
+                jnp.asarray(np.asarray(prows, np.int32)),
+                jnp.asarray(self.mirror.h_req_r[prows]),
+                jnp.asarray(self.mirror.h_nonzero[prows]),
+                jnp.asarray(self.mirror.h_pod_count[prows]),
+                fit_strategy=plan.fit_strategy, has_nom=plan.has_nom)
+        self.delta_dirty_rows += len(rows)
+        self.metrics.plan_rebuild_dirty_rows.inc(value=len(rows))
+        return new_state, carry
+
+    def _resume_or_rebuild(self, fw: Framework, head_pod, sig, nsig,
+                           aux_shape, claims_rv):
+        """Session-start plan acquisition: exact/neutral resume, journal
+        delta patch, or full rebuild. Returns (state, plan, carry,
+        node_names, kind)."""
+        carry = None
+        resume, self._resume = self._resume, None
+        kind = "full"
+        state = plan = node_names = None
+        _t_hint = _time.perf_counter()
+        if resume is not None:
+            rkey, rseq, payload, rnom = resume
+            sig_ok = (rkey[1] == sig) if rkey[0] == "exact" else (
+                nsig is not None and rkey[1] == nsig)
+            if (sig_ok
+                    and rkey[2:] == (id(fw), aux_shape, claims_rv,
+                                     self.attempts, self.state_unwinds)
+                    and rnom == self._nom_resume_key(head_pod.priority)):
+                state, plan, carry, node_names = payload
+                if rseq == self.cluster_event_seq:
+                    kind = "resume"
+                else:
+                    events = self.journal.since(rseq)
+                    cls = (self._classify_delta(events, plan)
+                           if events is not None else None)
+                    if cls is not None:
+                        # No pipeline is in flight at session start: every
+                        # level (benign/safe/strict) may patch here.
+                        patched = self._apply_delta_patch(
+                            plan, node_names, cls[1], state, carry)
+                        if patched is not None:
+                            state, carry = patched
+                            kind = "delta"
+                if kind == "full":
+                    carry = None
+        # get_node_hint_duration (runtime/batch.go GetNodeHint analogue):
+        # the batch-reuse lookup is the session-resume key check.
+        self.metrics.get_node_hint_duration.observe(
+            _time.perf_counter() - _t_hint)
+        if kind == "full":
+            _t0 = _time.perf_counter()
+            state, plan = self.build_plan(fw, head_pod, self.max_batch)
+            self.plan_build_s += _time.perf_counter() - _t0
+            node_names = [ni.name for ni in self.snapshot.node_info_list]
+        self._count_rebuild(kind)
+        return state, plan, carry, node_names, kind
+
+    def _save_resume(self, fw: Framework, head_pod, sig, aux_shape,
+                     state, plan, carry, node_names,
+                     neutral_ok: bool = True) -> None:
+        """Capture a clean session's end state for the next resume check.
+        Saved under the neutral (namespace-erased) signature when eligible,
+        so a stream of label/namespace-only-different sessions chains."""
+        nsig = self._neutral_sig(fw, head_pod, sig) if neutral_ok else None
+        mode = ("neutral", nsig) if nsig is not None else ("exact", sig)
+        self._resume = (
+            mode + (id(fw), aux_shape,
+                    getattr(self.clientset, "resource_claims_rv", 0),
+                    self.attempts, self.state_unwinds),
+            self.cluster_event_seq,
+            (state, plan, carry, node_names),
+            self._nom_resume_key(head_pod.priority))
+
     def limited_drivers(self) -> frozenset:
         rv = getattr(self.clientset, "csi_nodes_rv", 0)
         if rv != self._limited_drivers_n:
@@ -1217,8 +1486,20 @@ class TPUScheduler(Scheduler):
                 and head.pod.priority != self._session_nom_priority):
             return False  # nominated lane is priority-thresholded
         if not (head.pod.scheduler_name in self.profiles
-                and self.framework_for_pod(head.pod) is fw
-                and fw.sign_pod(head.pod) == sig
+                and self.framework_for_pod(head.pod) is fw):
+            return False
+        psig = fw.sign_pod(head.pod)
+        sig_ok = psig == sig
+        if not sig_ok and psig is not None \
+                and self._session_neutral_sig is not None:
+            # Label/namespace-only signature difference: join the session
+            # when the pod's namespace-erased signature matches and the
+            # cluster still has no affinity-carrying pods (_neutral_sig
+            # re-checks the live gate) — per-namespace pod sweeps then ride
+            # ONE session instead of one per namespace.
+            sig_ok = self._neutral_sig(fw, head.pod, psig) \
+                == self._session_neutral_sig
+        if not (sig_ok
                 # Signatures only cover the Sign plugins; a member with a
                 # feature outside the kernel (unbound volumes, DRA claims)
                 # shares the head's signature but must NOT ride the device —
@@ -1282,38 +1563,18 @@ class TPUScheduler(Scheduler):
         pending.append(first_batch)  # crash-recovery registry (wrapper);
         # registered BEFORE build_plan so a plan-build crash recovers too.
         sig = fw.sign_pod(first_batch[0].pod)
+        nsig = self._neutral_sig(fw, first_batch[0].pod, sig)
+        self._session_neutral_sig = nsig
         # Signatures cover only the Sign plugins — NOT volumes/claims, whose
         # counted-constraint shape changes the PLAN (aux_room semantics). A
         # resume must match the aux shape too, or a claim-template session
         # could chain onto a volume session's attach-room plan (fuzz-caught).
         aux_shape = self._aux_shape(first_batch[0].pod)
         claims_rv = getattr(self.clientset, "resource_claims_rv", 0)
-        carry = None
-        resume = self._resume
-        self._resume = None
-        _t_hint = _time.perf_counter()
-        hit = (resume is not None
-               and resume[0] == (id(fw), sig, aux_shape, claims_rv,
-                                 self.cluster_event_seq,
-                                 self.attempts, self.state_unwinds)
-               and resume[2] == self._nom_resume_key(
-                   first_batch[0].pod.priority))
-        # get_node_hint_duration (runtime/batch.go GetNodeHint analogue):
-        # the batch-reuse lookup is the session-resume key check.
-        self.metrics.get_node_hint_duration.observe(
-            _time.perf_counter() - _t_hint)
-        if hit:
-            # Nothing happened since the last clean session of this exact
-            # signature: the mirror is device-resident, the feature plan is
-            # still exact, and the final carry reflects every placement —
-            # skip the rebuild and chain straight on.
-            state, plan, carry, node_names = resume[1]
-        else:
-            _t0 = _time.perf_counter()
-            state, plan = self.build_plan(fw, first_batch[0].pod, self.max_batch)
-            self.plan_build_s += _time.perf_counter() - _t0
-            node_names = [ni.name for ni in self.snapshot.node_info_list]
-        start_seq = self.cluster_event_seq
+        state, plan, carry, node_names, _rkind = self._resume_or_rebuild(
+            fw, first_batch[0].pod, sig, nsig, aux_shape, claims_rv)
+        sd = _SessionDelta(state, carry, self.cluster_event_seq)
+        del state, carry
         start_unwinds = self.state_unwinds
         start_nom = self.queue.nominator.version
         inflight: List[Tuple[List[QueuedPodInfo], object]] = []
@@ -1326,23 +1587,35 @@ class TPUScheduler(Scheduler):
             # Refill the dispatch pipeline (depth-bounded): dispatch is
             # async — these calls enqueue device work and return immediately.
             while not invalidated and len(inflight) < self.pipeline_depth:
+                if sd.patch_pending:
+                    if inflight:
+                        break  # retire dispatched work before patching
+                    if not self._note_session_events(sd, plan, node_names,
+                                                     busy=False):
+                        invalidated = True
+                        break
                 if batch is None:
                     batch = self._collect_session_batch(fw, sig) or None
                     if batch is None and self._event_inbox:
                         # A concurrent client (threaded watch feed) may have
                         # parked pod-add events while this session ran: drain
                         # them HERE so a creation burst doesn't end the
-                        # session early. Cluster-state events invalidate the
-                        # carry, exactly as they would between sessions.
+                        # session early. Cluster-state events patch the live
+                        # plan+carry when the journal classifies them, and
+                        # invalidate exactly as before when it can't.
                         self.drain_event_inbox()
-                        if self.cluster_event_seq == start_seq:
-                            batch = self._collect_session_batch(fw, sig) or None
-                        else:
+                        if not self._note_session_events(
+                                sd, plan, node_names, busy=bool(inflight)):
                             invalidated = True
+                        elif sd.patch_pending:
+                            continue  # patch (or drain) before collecting
+                        else:
+                            batch = self._collect_session_batch(fw, sig) or None
                     if batch is None:
                         break
                     pending.append(batch)
-                results, carry = self._dispatch(state, plan, len(batch), carry)
+                results, sd.carry = self._dispatch(
+                    sd.state, plan, len(batch), sd.carry)
                 # Start the device→host copy NOW: on a tunneled TPU the
                 # result fetch pays a full pipeline-flush RTT (~10s of ms);
                 # issuing it at dispatch time overlaps that latency with the
@@ -1379,11 +1652,13 @@ class TPUScheduler(Scheduler):
                     self.metrics.pod_scheduled_after_flush.inc(
                         value=len(ok_rows))
                     self._after_flush = False
-                if (self.cluster_event_seq != start_seq
-                        or self.state_unwinds != start_unwinds
-                        or self.queue.nominator.version != start_nom):
+                if not invalidated and (
+                        self.state_unwinds != start_unwinds
+                        or self.queue.nominator.version != start_nom
+                        or not self._note_session_events(
+                            sd, plan, node_names, busy=bool(inflight))):
                     invalidated = True
-                    start_seq = self.cluster_event_seq
+                    sd.start_seq = self.cluster_event_seq
                     start_unwinds = self.state_unwinds
                     start_nom = self.queue.nominator.version
             else:
@@ -1416,16 +1691,11 @@ class TPUScheduler(Scheduler):
             # Keep the device state resident: the final carry reflects every
             # successful placement, so the next flush uploads nothing.
             self.mirror.adopt(self.snapshot.node_info_list, ok_rows,
-                              carry.req_r, carry.nonzero, carry.pod_count,
-                              dirty_rows=dirty_rows)
-            if carry is not None and not dirty_rows:
-                self._resume = (
-                    (id(fw), sig, aux_shape,
-                     getattr(self.clientset, "resource_claims_rv", 0),
-                     self.cluster_event_seq, self.attempts,
-                     self.state_unwinds),
-                    (state, plan, carry, node_names),
-                    self._nom_resume_key(first_batch[0].pod.priority))
+                              sd.carry.req_r, sd.carry.nonzero,
+                              sd.carry.pod_count, dirty_rows=dirty_rows)
+            if sd.carry is not None and not dirty_rows:
+                self._save_resume(fw, first_batch[0].pod, sig, aux_shape,
+                                  sd.state, plan, sd.carry, node_names)
         # The session ran to completion (invalidation included — that is a
         # NORMAL end, not a device failure): a half-open breaker closes.
         self._note_device_success()

@@ -304,6 +304,19 @@ class NodeStateMirror:
             self.h_taint_eff, self.h_unsched, self.h_valid, self.h_name_id,
         )
 
+    def _scatter_dirty(self, dirty) -> DeviceNodeState:
+        """Scatter the given staging rows into the resident device state.
+        Pads to a pow2 tier by repeating the last index (scatter-set with
+        duplicate indices writes the same value), so the jitted scatter
+        compiles once per tier, not once per dirty-count."""
+        tier = _pow2(len(dirty), 1)
+        dirty = dirty + [dirty[-1]] * (tier - len(dirty))
+        idx = jnp.asarray(dirty, jnp.int32)
+        rows = DeviceNodeState(
+            *[jnp.asarray(a[dirty]) for a in self._arrays()],
+            jnp.asarray(self.h_topo[:, dirty]))
+        return _scatter_rows(self._device, idx, rows)
+
     def flush(self) -> DeviceNodeState:
         """Upload pending changes; returns the device pytree. Scatter when the
         dirty fraction is small, full device_put otherwise."""
@@ -328,21 +341,43 @@ class NodeStateMirror:
                     *[jnp.asarray(a) for a in self._arrays()], jnp.asarray(self.h_topo)
                 )
             else:
-                dirty = sorted(self._dirty)
-                # Pad to a pow2 tier by repeating the last index (scatter-set
-                # with duplicate indices writes the same value), so the jitted
-                # scatter compiles once per tier, not once per dirty-count.
-                tier = _pow2(len(dirty), 1)
-                dirty = dirty + [dirty[-1]] * (tier - len(dirty))
-                idx = jnp.asarray(dirty, jnp.int32)
-                rows = DeviceNodeState(
-                    *[jnp.asarray(a[dirty]) for a in self._arrays()],
-                    jnp.asarray(self.h_topo[:, dirty]))
-                self._device = _scatter_rows(self._device, idx, rows)
+                self._device = self._scatter_dirty(sorted(self._dirty))
         self._dirty.clear()
         self._full_flush = False
         return self._device
 
+
+    def patch_rows(self, updates) -> Optional[DeviceNodeState]:
+        """Event-delta row flush: re-encode the given (row, NodeInfo) pairs
+        from the LIVE cache NodeInfos and scatter them into the resident
+        device state WITHOUT a snapshot refresh — the journal-driven
+        analogue of sync+flush for a session that stays on device. Returns
+        the patched DeviceNodeState, or None when a row patch can't apply
+        (no resident device copy / full upload pending, a capacity tier grew
+        mid-encode, row out of range or name mismatch) — callers fall back
+        to the full rebuild path, which recovers from every one of those."""
+        if self._device is None or self._full_flush:
+            return None
+        # Validate EVERY row before encoding ANY: a late-row guard failure
+        # after earlier rows hit staging would leave those rows encoded with
+        # current generations but never scattered — the fallback's sync
+        # would then skip them and the device copy would stay stale forever.
+        # (_Regrown mid-encode is safe: _grow resets staging + generations
+        # and pends a full upload.)
+        for row, ni in updates:
+            if (row >= self.np_cap or row >= len(self._row_names)
+                    or ni.name != self._row_names[row]):
+                return None
+        try:
+            for row, ni in updates:
+                self._encode_row(row, ni)
+                self._row_gen[row] = ni.generation
+        except _Regrown:
+            return None  # staging reset: next flush rebuilds everything
+        dirty = sorted({row for row, _ in updates})
+        self._device = self._scatter_dirty(dirty)
+        self._dirty.difference_update(dirty)
+        return self._device
 
     def invalidate(self) -> None:
         """Force a full staging re-encode + full upload on the next

@@ -179,6 +179,12 @@ class BatchPlan:
     # (static per plan; any nomination add/delete invalidates the session
     # via Nominator.version).
     has_nom: bool = False
+    # No pod-derived feature coupling anywhere in the plan: no spread or
+    # (anti-)affinity count tables, no landing score deltas, no existing-pod
+    # anti-affinity hits. A pod arriving on / leaving node n then dirties
+    # ONLY row n's resource aggregates — the precondition for the event-
+    # journal delta patch (models/tpu_scheduler.py _classify_delta).
+    pod_local: bool = False
     # Host-side per-node topology-spread columns (numpy, NOT shipped to the
     # kernel): per-constraint per-node matching-pod counts + domain
     # eligibility. schedule_placements rebuilds each candidate placement's
@@ -945,6 +951,9 @@ def build_batch(
         vmax=vmax,
         has_pns=bool((mirror.h_taint_eff[:n] == EFFECT_PREFER_NO_SCHEDULE).any()),
         has_ipa_base=bool((ipa_base != 0).any()),
+        pod_local=bool(c1 == 0 and c2 == 0 and a1 == 0 and a2 == 0
+                       and kd == 0 and not (ipa_base != 0).any()
+                       and not (exist_anti != 0).any()),
         anti_rowlocal=anti_rowlocal,
         has_na_pref=has_na_pref,
         port_selfblock=port_selfblock,

@@ -581,6 +581,39 @@ def schedule_batch(
     return final[-1], ScanCarry(*final[:14])
 
 
+@partial(jax.jit, static_argnames=("fit_strategy", "has_nom"))
+def patch_carry_rows(
+    state: DeviceNodeState,
+    f: BatchFeatures,
+    carry: ScanCarry,
+    idx: jnp.ndarray,        # [K] i32 rows to patch (pow2-padded, dups OK)
+    req_rows: jnp.ndarray,   # [K, R] i64 post-event requested aggregates
+    nz_rows: jnp.ndarray,    # [K, 2] i64
+    cnt_rows: jnp.ndarray,   # [K] i32
+    fit_strategy: int = 0,
+    has_nom: bool = False,
+) -> ScanCarry:
+    """Event-delta patch of a live session carry: install the post-event
+    per-node aggregates for the journal's dirty rows and re-evaluate ONLY
+    those rows' resource-derived values — the carry-side analogue of the
+    mirror's dirty-row scatter. Valid only for pod-local plans (no count
+    tables to touch); taint/allocatable changes ride the separately patched
+    `state`, whose rows this reads. Duplicate padded indices write identical
+    values, so the pow2 index tier is exact."""
+    ok, sc, ba = _resource_eval(
+        f, fit_strategy, state.alloc_r[idx], state.alloc_pods[idx],
+        req_rows, nz_rows, cnt_rows,
+        nom_r=f.nom_req[idx] if has_nom else None,
+        nom_p=f.nom_pods[idx] if has_nom else None)
+    return carry._replace(
+        req_r=carry.req_r.at[idx].set(req_rows),
+        nonzero=carry.nonzero.at[idx].set(nz_rows),
+        pod_count=carry.pod_count.at[idx].set(cnt_rows),
+        fit_ok=carry.fit_ok.at[idx].set(ok),
+        fit_sc=carry.fit_sc.at[idx].set(sc),
+        ba=carry.ba.at[idx].set(ba))
+
+
 @partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax",
                                    "has_pns", "has_na_pref",
                                    "port_selfblock", "has_aux"))

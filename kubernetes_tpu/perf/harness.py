@@ -138,7 +138,9 @@ class _ThroughputCollector:
         self.active = False
 
     WINDOW_COUNTERS = ("plan_build_s", "device_wait_s", "host_commit_s",
-                       "device_scheduled", "host_path_pods", "device_batches")
+                       "device_scheduled", "host_path_pods", "device_batches",
+                       "plan_rebuilds_full", "plan_rebuilds_delta",
+                       "plan_rebuilds_resume", "delta_dirty_rows")
 
     def start(self) -> None:
         self.active = True
@@ -691,9 +693,8 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
     result.elapsed = time.perf_counter() - t0
     result.scheduled = sched.scheduled
     result.failed = sched.failures
-    for attr in ("device_batches", "device_scheduled", "host_path_pods",
-                 "placement_device_evals",
-                 "plan_build_s", "device_wait_s", "host_commit_s"):
+    for attr in _ThroughputCollector.WINDOW_COUNTERS + (
+            "placement_device_evals",):
         v = getattr(sched, attr, None)
         if v is not None:
             result.detail[attr] = round(v, 3) if isinstance(v, float) else v

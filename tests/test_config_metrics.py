@@ -297,7 +297,9 @@ def test_metric_name_parity_with_reference():
                      "scheduler_podgroup_generated_placements",
                      "scheduler_async_api_call_retries_total",
                      "scheduler_device_path_fallback_total",
-                     "scheduler_device_path_breaker_open"}, extra
+                     "scheduler_device_path_breaker_open",
+                     "scheduler_plan_rebuild_total",
+                     "scheduler_plan_rebuild_dirty_rows_total"}, extra
 
 
 def test_new_series_populate_during_scheduling():

@@ -1,0 +1,391 @@
+"""Incremental session resume: typed event journal + delta plan rebuild.
+
+The resume cache used to be all-or-nothing — ANY cluster event bumped
+cluster_event_seq and forced a full snapshot→features teardown. The journal
+(core/cache.py EventJournal) records what each bump was, so device sessions
+classify intervening events and patch exactly the rows they dirtied
+(models/tpu_scheduler.py _classify_delta/_apply_delta_patch) while keeping
+the chained carry. These tests enforce the repo's core invariant on that
+path: delta-patched sessions must produce assignments BIT-IDENTICAL to the
+host oracle — and must demonstrably take the delta path (not the full-
+rebuild fallback), including continuation across gate-lift and taint
+events, with the fallback still engaging on unclassified events.
+"""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.core.scheduler import Scheduler
+from kubernetes_tpu.models.tpu_scheduler import TPUScheduler
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _node(name, taint=None, cpu=8):
+    b = (make_node().name(name)
+         .capacity({"cpu": cpu, "memory": "32Gi", "pods": 110})
+         .zone(f"zone-{len(name) % 3}"))
+    if taint:
+        b = b.taint(*taint)
+    return b.obj()
+
+
+def _pod(name, ns="default", cpu="200m", gates=(), labels=None,
+         tolerate=None):
+    b = make_pod().name(name).namespace(ns).req({"cpu": cpu,
+                                                 "memory": "128Mi"})
+    for g in gates:
+        b = b.scheduling_gate(g)
+    if labels:
+        b = b.labels(dict(labels))
+    if tolerate:
+        b = b.toleration(tolerate, "", "Exists", "NoSchedule")
+    return b.obj()
+
+
+def _pair(n_nodes=24, max_batch=64, taints=None):
+    """(host oracle, device scheduler) over identical clusters. mesh=None:
+    row patches target the single-device resident state — under a sharded
+    mesh the delta path deliberately declines (scattering with fresh host
+    arrays would break the committed input shardings) and falls back to
+    the full rebuild, which these tests are not about."""
+    host = Scheduler(deterministic_ties=True)
+    dev = TPUScheduler(max_batch=max_batch, mesh=None)
+    taints = taints or {}
+    for s in (host, dev):
+        for i in range(n_nodes):
+            s.clientset.create_node(_node(f"node-{i}",
+                                          taint=taints.get(i)))
+    return host, dev
+
+
+def _assignments(s):
+    return {f"{p.namespace}/{p.name}": p.node_name
+            for p in s.clientset.pods.values()}
+
+
+def _both(host, dev, fn):
+    """Apply one scripted step to both sides, then drain both."""
+    fn(host)
+    fn(dev)
+    host.run_until_idle()
+    dev.run_until_idle()
+
+
+def _assert_identical(host, dev):
+    a_h, a_d = _assignments(host), _assignments(dev)
+    diffs = {k: (a_h[k], a_d.get(k)) for k in a_h if a_h[k] != a_d.get(k)}
+    assert not diffs, f"host/device divergence after delta churn: {diffs}"
+
+
+def _sessions(dev):
+    """Every device session acquires its plan exactly once, under exactly
+    one kind — the rebuild counters partition the session count."""
+    return (dev.plan_rebuilds_full + dev.plan_rebuilds_delta
+            + dev.plan_rebuilds_resume)
+
+
+class TestDeltaResumeBetweenSessions:
+    def test_bound_pod_delete_takes_delta_path(self):
+        """WhileGated/DeletedPodsWithFinalizers shape: bound pods deleted
+        between sessions must NOT force full plan rebuilds — the journal
+        classifies pod_remove as a shrink row patch."""
+        host, dev = _pair()
+        victims = [_pod(f"victim-{i}") for i in range(10)]
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"victim-{i}")) for i in range(10)])
+        del victims
+        assert dev.plan_rebuilds_full == 1
+        for r in range(4):
+            def step(s, r=r):
+                # delete one bound victim, then feed a new wave
+                vs = [p for p in s.clientset.pods.values()
+                      if p.name.startswith("victim-") and p.node_name]
+                if vs:
+                    s.clientset.delete_pod(
+                        min(vs, key=lambda p: p.name))
+                for i in range(6):
+                    s.clientset.create_pod(_pod(f"wave{r}-{i}"))
+            _both(host, dev, step)
+        _assert_identical(host, dev)
+        assert dev.plan_rebuilds_full == 1, (
+            "bound-pod deletes forced full rebuilds despite the journal")
+        assert dev.plan_rebuilds_delta >= 4
+        assert dev.delta_dirty_rows >= 4
+        assert dev.host_path_pods == 0
+
+    def test_gate_lift_is_benign_for_resume(self):
+        """A scheduling-gate lift is queue-only: the saved plan+carry resume
+        via the delta path with ZERO dirty rows."""
+        host, dev = _pair()
+        _both(host, dev, lambda s: s.clientset.create_pod(
+            _pod("gated", gates=("hold",))))
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"before-{i}")) for i in range(8)])
+        full0, rows0 = dev.plan_rebuilds_full, dev.delta_dirty_rows
+
+        def lift(s):
+            p = next(p for p in s.clientset.pods.values()
+                     if p.name == "gated")
+            p.scheduling_gates = []
+            s.clientset.update_pod(p)
+        _both(host, dev, lift)
+        _assert_identical(host, dev)
+        assert _assignments(dev)["default/gated"], "gated pod not scheduled"
+        assert dev.plan_rebuilds_full == full0, (
+            "gate lift tore the plan down")
+        assert dev.plan_rebuilds_delta >= 1
+        assert dev.delta_dirty_rows == rows0, "gate lift dirtied node rows"
+
+    def test_taint_lift_and_taint_add_take_delta_path(self):
+        """Taint-only node updates (labels untouched) row-patch the resident
+        taint tensors: removal (shrink) and addition (strict, applied at the
+        empty-pipeline session boundary) both keep the carry."""
+        host, dev = _pair(taints={0: ("dedicated", "infra", "NoSchedule")})
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"a-{i}")) for i in range(8)])
+        assert dev.plan_rebuilds_full == 1
+
+        def lift_taint(s):
+            s.clientset.update_node(_node("node-0"))  # fresh object, no taint
+        _both(host, dev, lift_taint)
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"b-{i}")) for i in range(8)])
+
+        def add_taint(s):
+            s.clientset.update_node(
+                _node("node-3", taint=("dedicated", "infra", "NoSchedule")))
+        _both(host, dev, add_taint)
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"c-{i}")) for i in range(8)])
+
+        _assert_identical(host, dev)
+        assert dev.plan_rebuilds_full == 1, (
+            "taint-only node updates forced full rebuilds")
+        assert dev.plan_rebuilds_delta >= 2
+        assert dev.host_path_pods == 0
+        # the untainted node is actually usable again (patch took effect)
+        assert any(n == "node-0" for n in _assignments(dev).values())
+
+    def test_unclassified_event_falls_back_to_full_rebuild(self):
+        """Structural events (node add) are not delta-patchable: the session
+        must fall back to the full snapshot→features rebuild — and still
+        match the oracle."""
+        host, dev = _pair(n_nodes=12)
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"a-{i}")) for i in range(6)])
+        full0 = dev.plan_rebuilds_full
+        _both(host, dev, lambda s: s.clientset.create_node(_node("node-99")))
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"b-{i}")) for i in range(6)])
+        _assert_identical(host, dev)
+        assert dev.plan_rebuilds_full > full0, (
+            "structural event did not fall back to the full rebuild")
+
+
+class TestMidSessionContinuation:
+    """Events arriving THROUGH the inbox while a session is live (the
+    threaded watch seam) must continue the session — carry intact, no
+    teardown — when the journal classifies them."""
+
+    def _park(self, dev, fn):
+        """Park a clientset mutation as an off-thread watch delivery: the
+        session's refill drains the inbox and runs it on the loop thread."""
+        dev._event_inbox.append((lambda: fn(dev), ()))
+
+    def test_session_continues_across_parked_gate_lift(self):
+        host, dev = _pair()
+        gated = {}
+        def mk_gated(s):
+            p = _pod("gated", gates=("hold",))
+            gated[id(s)] = p
+            s.clientset.create_pod(p)
+        _both(host, dev, mk_gated)
+        for s in (host, dev):
+            for i in range(12):
+                s.clientset.create_pod(_pod(f"w1-{i}"))
+
+        def lift(s):
+            p = gated[id(s)]
+            p.scheduling_gates = []
+            s.clientset.update_pod(p)
+        self._park(dev, lift)
+        dev.run_until_idle()
+        lift(host)
+        host.run_until_idle()
+        _assert_identical(host, dev)
+        assert _assignments(dev)["default/gated"]
+        # ONE session: one full build, gate lift consumed mid-session
+        # (benign advance — no extra plan acquisition of any kind).
+        assert dev.plan_rebuilds_full == 1
+        assert _sessions(dev) == 1, "gate lift ended the live session"
+
+    def test_session_continues_across_parked_pod_delete(self):
+        host, dev = _pair()
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"seed-{i}")) for i in range(6)])
+        assert dev.plan_rebuilds_full == 1
+        for s in (host, dev):
+            for i in range(12):
+                s.clientset.create_pod(_pod(f"w1-{i}"))
+
+        def kill_seed(s):
+            p = next(p for p in s.clientset.pods.values()
+                     if p.name == "seed-0")
+            s.clientset.delete_pod(p)
+            for i in range(12):
+                s.clientset.create_pod(_pod(f"w2-{i}"))
+        self._park(dev, kill_seed)
+        dev.run_until_idle()
+        kill_seed(host)
+        host.run_until_idle()
+        _assert_identical(host, dev)
+        assert dev.plan_rebuilds_full == 1, (
+            "mid-session bound-pod delete tore the session down")
+        assert dev.plan_rebuilds_delta >= 1
+        assert dev.host_path_pods == 0
+
+
+class TestNeutralSignatureBatching:
+    def test_cross_namespace_pods_share_one_session(self):
+        """Pods identical except labels+namespace (the *WithNSSelector init
+        shape) must ride ONE session/plan when nothing in the cluster
+        carries affinity terms — not one full rebuild per namespace."""
+        host, dev = _pair()
+
+        def create(s):
+            for n in range(5):
+                for i in range(8):
+                    s.clientset.create_pod(
+                        _pod(f"p-{i}", ns=f"ns-{n}",
+                             labels={"team": f"t{n}"}))
+        _both(host, dev, create)
+        _assert_identical(host, dev)
+        assert dev.device_scheduled == 40
+        assert dev.plan_rebuilds_full == 1, (
+            "per-namespace signatures fragmented the session")
+        assert dev.device_batches == 1
+
+    def test_neutral_batching_disabled_when_affinity_pods_exist(self):
+        """One affinity-carrying pod in the cluster makes labels/namespace
+        scheduling-relevant: neutral batching must switch off (correctness
+        over speed) and assignments must still match the oracle."""
+        host, dev = _pair()
+
+        def create(s):
+            s.clientset.create_pod(
+                make_pod().name("anchor").req({"cpu": "100m"})
+                .label("color", "red")
+                .pod_affinity("kubernetes.io/hostname", {"color": "red"},
+                              anti=True).obj())
+            for n in range(3):
+                for i in range(4):
+                    s.clientset.create_pod(_pod(f"p-{i}", ns=f"ns-{n}"))
+        _both(host, dev, create)
+        _assert_identical(host, dev)
+        assert dev.plan_rebuilds_full >= 3, (
+            "neutral batching engaged with affinity pods live")
+
+
+class TestChurnFuzz:
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_mixed_churn_oracle_equivalence(self, seed):
+        """MixedChurn-style randomized event stream: gate lifts, bound-pod
+        deletes, taint flips, namespace sweeps, and (rarely) node adds,
+        interleaved with scheduling. Assignments must be bit-identical to
+        the host oracle, the delta path must demonstrably engage, and the
+        full-rebuild fallback must engage on the structural events."""
+        rng = random.Random(seed)
+        host, dev = _pair(n_nodes=16)
+        gated = []
+        seq = 0
+
+        def create_wave(s, wave, ns, k, gate):
+            # Fuzz pods tolerate the churn taint: the taint flips still
+            # exercise the EV_NODE_UPDATE row-patch path, but never strand
+            # pods as unschedulable (whose retry attempts would perturb the
+            # resume key every cycle and mask the delta path).
+            for i in range(k):
+                s.clientset.create_pod(
+                    _pod(f"f{wave}-{i}", ns=ns, tolerate="dedicated",
+                         gates=("hold",) if gate else ()))
+
+        for _ in range(14):
+            op = rng.random()
+            if op < 0.35:
+                k, ns = rng.randint(2, 6), rng.choice(
+                    ["default", "ns-a", "ns-b"])
+                g = rng.random() < 0.25
+                if g:
+                    gated.append(f"f{seq}-")
+                _both(host, dev, lambda s, w=seq, k=k, ns=ns, g=g:
+                      create_wave(s, w, ns, k, g))
+                seq += 1
+            elif op < 0.55:
+                def kill(s):
+                    bound = sorted((p for p in s.clientset.pods.values()
+                                    if p.node_name and not p.pod_group),
+                                   key=lambda p: (p.namespace, p.name))
+                    if bound:
+                        s.clientset.delete_pod(bound[0])
+                _both(host, dev, kill)
+            elif op < 0.70 and gated:
+                prefix = gated.pop(0)
+                def lift(s, prefix=prefix):
+                    for p in list(s.clientset.pods.values()):
+                        if p.name.startswith(prefix) and p.scheduling_gates:
+                            p.scheduling_gates = []
+                            s.clientset.update_pod(p)
+                _both(host, dev, lift)
+            elif op < 0.93:
+                i = rng.randint(0, 15)
+                tainted = rng.random() < 0.5
+                def flip(s, i=i, tainted=tainted):
+                    s.clientset.update_node(_node(
+                        f"node-{i}",
+                        taint=("dedicated", "x", "NoSchedule")
+                        if tainted else None))
+                _both(host, dev, flip)
+            else:
+                name = f"extra-{seq}"
+                seq += 1
+                _both(host, dev,
+                      lambda s, name=name: s.clientset.create_node(
+                          _node(name)))
+        # drain any still-gated stragglers so the comparison is total
+        def lift_all(s):
+            for p in list(s.clientset.pods.values()):
+                if p.scheduling_gates:
+                    p.scheduling_gates = []
+                    s.clientset.update_pod(p)
+        _both(host, dev, lift_all)
+        # Deterministic delta tail (a random stream can legitimately put a
+        # structural event before every session — correct, but then the
+        # delta path never samples): one clean wave to establish the resume
+        # carry, then a shrink event + wave that must ride it.
+        _both(host, dev, lambda s: create_wave(s, "tail0", "default", 4,
+                                               False))
+        delta0 = dev.plan_rebuilds_delta
+
+        def shrink_step(s):
+            bound = sorted((p for p in s.clientset.pods.values()
+                            if p.node_name),
+                           key=lambda p: (p.namespace, p.name))
+            s.clientset.delete_pod(bound[0])
+            create_wave(s, "tail1", "default", 4, False)
+        _both(host, dev, shrink_step)
+        assert dev.plan_rebuilds_delta > delta0, (
+            "shrink event after a clean session did not take the delta path")
+        # ... and a structural event must take the full-rebuild fallback.
+        full0 = dev.plan_rebuilds_full
+
+        def structural_step(s):
+            s.clientset.create_node(_node("tail-node"))
+            create_wave(s, "tail2", "default", 4, False)
+        _both(host, dev, structural_step)
+        _assert_identical(host, dev)
+        assert dev.failures == host.failures == 0
+        assert dev.plan_rebuilds_full > full0, (
+            "structural event did not fall back to the full rebuild")
+        assert dev.device_scheduled > 0
+        assert dev.host_path_pods == 0
