@@ -61,6 +61,14 @@ def main(argv=None) -> int:
                     help="healthz/metrics port (0 = ephemeral)")
     ap.add_argument("--leader-elect", action="store_true")
     ap.add_argument("--identity", default="scheduler-0")
+    ap.add_argument("--shard-index", type=int, default=-1,
+                    help="join the shard plane as shard i of --shard-count "
+                         "(requires --api-url; kubernetes_tpu/shard/)")
+    ap.add_argument("--shard-count", type=int, default=0,
+                    help="total shard slots in the plane")
+    ap.add_argument("--shard-lease-duration", type=float, default=3.0,
+                    help="shard lease duration in seconds (failover takes "
+                         "at most one lease period + one renew interval)")
     ap.add_argument("--once", action="store_true",
                     help="exit once the queue drains (smoke/test mode)")
     ap.add_argument("--platform", default="auto",
@@ -90,6 +98,19 @@ def main(argv=None) -> int:
             for e in errs:
                 print(f"invalid configuration: {e}", file=sys.stderr)
             return 1
+    if args.shard_index >= 0 and cfg is None:
+        # Shard-plane processes bind over real HTTP. Async dispatch (the
+        # SchedulerAsyncAPICalls thread mode) overlaps every bind's RTT
+        # with the commit loop instead of stalling it per pod — the single
+        # worker preserves write order, and a late 409 unwinds through
+        # on_async_bind_error into the conflict requeue path. A tighter
+        # GIL switch interval keeps the worker's socket wakeups from being
+        # convoy-delayed behind the reflector thread (which is busy
+        # decoding every peer shard's events): at the default 5ms, worker
+        # throughput alone can cap binds near 200/s.
+        import sys as _sys
+        _sys.setswitchinterval(0.001)
+        cfg = SchedulerConfiguration(async_dispatch_threads=True)
     cs_kw = {}
     if args.api_url:
         from .core.apiserver import HTTPClientset
@@ -104,6 +125,19 @@ def main(argv=None) -> int:
     sched = TPUScheduler(config=cfg, **cs_kw)
     if args.cluster:
         _load_cluster(sched.clientset, args.cluster)
+
+    member = None
+    if args.shard_index >= 0:
+        if not args.api_url or args.shard_count <= args.shard_index:
+            print("--shard-index requires --api-url and a larger "
+                  "--shard-count", file=sys.stderr)
+            return 1
+        from .shard import ShardMember
+        member = ShardMember(sched, args.shard_index, args.shard_count,
+                             lease_duration=args.shard_lease_duration,
+                             identity=f"{args.identity}-shard-{args.shard_index}")
+        member.start_renewer()  # lease acquired before announcing ready;
+        member.tick()           # background renewals survive long drains
 
     server = SchedulerServer(sched, identity=args.identity,
                              leader_elect=args.leader_elect)
@@ -121,6 +155,10 @@ def main(argv=None) -> int:
 
     try:
         while not stop["flag"]:
+            # Sharded runs also refresh ownership per CYCLE via the
+            # scheduler's loop_hook; this outer tick covers idle stretches.
+            if member is not None:
+                member.tick()
             progressed = server.run_cycles()
             if args.once and not progressed:
                 active, backoff, _unsched = sched.queue.pending_counts()

@@ -37,6 +37,16 @@ class APICall:
     object_uid: str
     execute: Callable[[], None]
     on_error: Optional[Callable[[Exception], None]] = None
+    # Bulk seam (DefaultBinder): a run of consecutive queued calls sharing
+    # the SAME bulk_execute callable drains as one batch on the thread
+    # worker — one API round-trip (and one worker GIL wakeup) per batch
+    # instead of per call. bind_args carries the call's (pod, node_name)
+    # for the batch executor. bulk_execute(calls) returns one
+    # Optional[Exception] per call, or raises for a whole-batch transport
+    # failure (retried under the same budget as single calls — safe because
+    # the binding subresource answers same-node replays idempotently).
+    bind_args: Optional[tuple] = None
+    bulk_execute: Optional[Callable[[List["APICall"]], list]] = None
 
     @property
     def relevance(self) -> int:
@@ -144,6 +154,11 @@ class APIDispatcher:
 
     # -- worker ------------------------------------------------------------
 
+    # Batch cap: bounds the server-side write-lock hold per bulk request
+    # (~0.3ms/bind), so one shard's burst never stalls peers' binds or
+    # lease renews for more than a few tens of ms.
+    BULK_MAX = 128
+
     def _run(self) -> None:
         while not self._stop:
             with self._cv:
@@ -156,13 +171,83 @@ class APIDispatcher:
                 if call is None:
                     self._cv.wait(timeout=0.05)
                     continue
+                batch = [call]
+                # Drain the run of batchable calls queued behind it (stop at
+                # the first call with a different executor: cross-type FIFO
+                # order is preserved — a queued status patch still lands
+                # after the binds enqueued before it).
+                while (call.bulk_execute is not None and self._order
+                        and len(batch) < APIDispatcher.BULK_MAX):
+                    nxt = self._pending.get(self._order[0])
+                    if nxt is None:
+                        self._order.pop(0)  # merged-away slot
+                        continue
+                    # == not `is`: bound methods are materialized fresh on
+                    # every attribute access, so identity never matches —
+                    # method equality compares (__self__, __func__).
+                    if nxt.bulk_execute != call.bulk_execute:
+                        break
+                    self._order.pop(0)
+                    self._pending.pop((nxt.call_type, nxt.object_uid), None)
+                    batch.append(nxt)
                 self._in_flight += 1
             try:
-                self._execute(call, defer_errors=True)
+                if len(batch) > 1:
+                    self._execute_bulk(batch)
+                else:
+                    self._execute(call, defer_errors=True)
             finally:
                 with self._cv:
                     self._in_flight -= 1
                     self._cv.notify_all()
+
+    def _execute_bulk(self, calls: List[APICall]) -> None:
+        """One batch through bulk_execute, with the same transient-retry
+        budget as _execute; per-item failures land in the error inbox for
+        the scheduling loop to drain (never run on this thread)."""
+        import time as _time
+        _t0 = _time.perf_counter()
+        delays = self._retry_cfg.delays()
+        while True:
+            try:
+                results = calls[0].bulk_execute(calls)
+                break
+            except Exception as e:  # noqa: BLE001 - whole-batch transport
+                if self._retry_cfg.retriable(e):
+                    try:
+                        delay = next(delays)
+                    except StopIteration:
+                        pass  # budget exhausted: every call fails below
+                    else:
+                        self.retried += 1
+                        if self.metrics is not None:
+                            self.metrics.async_api_call_retries.inc(
+                                calls[0].call_type)
+                        _time.sleep(delay)
+                        continue
+                results = [e] * len(calls)
+                break
+        dur = _time.perf_counter() - _t0
+        if len(results) < len(calls):  # defensive: short executor response
+            results = list(results) + [RuntimeError("short bulk response")] \
+                * (len(calls) - len(results))
+        deferred = []
+        for call, err in zip(calls, results):
+            outcome = "success" if err is None else "error"
+            if self.metrics is not None:
+                self.metrics.async_api_call_execution_total.inc(
+                    call.call_type, outcome)
+                self.metrics.async_api_call_execution_duration.observe(
+                    dur / len(calls), call.call_type, outcome)
+            if err is None:
+                self.executed += 1
+                continue
+            self.errors.append(f"{call.call_type}/{call.object_uid}: {err!r}")
+            if call.on_error is not None:
+                deferred.append((call, err))
+        if deferred:
+            with self._cv:
+                self._error_inbox.extend(deferred)
 
     def has_errors(self) -> bool:
         """Cheap emptiness probe (list read is atomic under the GIL)."""
@@ -194,3 +279,11 @@ class APIDispatcher:
     def pending_count(self) -> int:
         with self._lock:
             return len(self._order)
+
+    def idle(self) -> bool:
+        """Nothing queued and nothing mid-execution (inline mode executes at
+        enqueue, so it is always idle)."""
+        if self.mode == "inline":
+            return True
+        with self._lock:
+            return not self._order and self._in_flight == 0

@@ -32,9 +32,11 @@ across a ``kill -9``.
 
 from __future__ import annotations
 
+import copy
 import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib import request as urlrequest
@@ -60,6 +62,14 @@ from ..api.types import (
     WeightedPodAffinityTerm,
 )
 from .clientset import FakeClientset
+
+
+def _lease_clock() -> float:
+    """Lease clock: one process-local monotonic source. Expiry is always
+    computed server-side against this clock, so shard clients never compare
+    wall clocks across processes."""
+    return time.monotonic()
+
 
 # ---------------------------------------------------------------------------
 # JSON codec — full scheduling-relevant spec
@@ -319,6 +329,17 @@ class APIServer:
         self.store = store or FakeClientset()
         self._watchers: Dict[str, List["queue.Queue"]] = {"pods": [], "nodes": []}
         self._lock = threading.Lock()
+        # Shard-plane coordination (shard/leases.py): named lease records,
+        # renewed through PUT /api/v1/leases/<name> with holder-CAS semantics
+        # and SERVER-side clocks (expiry is computed here, so shard processes
+        # never compare wall clocks). Ride the WAL like STATUS records.
+        self.leases: Dict[str, dict] = {}
+        # Omega-style optimistic commit validation: per-node committed usage,
+        # maintained incrementally so the binding subresource can reject an
+        # overcommitting bind in O(1) (409 OutOfCapacity → the losing
+        # scheduler requeues through its backoffQ and re-plans against the
+        # watch-fed truth).
+        self._usage: Dict[str, dict] = {}
         # Serializes MUTATING verbs end-to-end (check + store write + WAL):
         # the store itself is unlocked dicts, and ThreadingHTTPServer runs
         # one thread per request — without this, two concurrent binding
@@ -344,6 +365,9 @@ class APIServer:
         self.resumed_watches = 0   # incremental reconnects served
         self.relisted_watches = 0  # full-list attaches served
         self.bind_conflicts = 0    # rebind-to-a-different-node rejections
+        self.capacity_conflicts = 0  # overcommitting binds rejected (Omega)
+        self.lease_conflicts = 0     # held-lease PUTs rejected (CAS losers)
+        self.lease_transitions = 0   # holder changes (acquire + failover)
         self.compaction_failures = 0
         # Durability (core/wal.py): WAL + snapshot compaction + recovery.
         self.persistence = None
@@ -356,6 +380,13 @@ class APIServer:
         self.store.on_pod_event(self._pod_event)
         self.store.on_node_event(self._node_event)
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # Accepted connections (REST keep-alive + watch streams), so
+        # shutdown() can tear them down: pooled clients (KeepAliveClient)
+        # park idle connections whose handler threads would otherwise keep
+        # this DEAD server's store reachable — and keep the process's port
+        # reference alive across a restart-in-place. set add/discard are
+        # GIL-atomic; handler setup/finish are the only writers.
+        self._conns: set = set()
 
     # -- durability (WAL + snapshot; core/wal.py) ---------------------------
 
@@ -377,8 +408,28 @@ class APIServer:
                 self._apply_recovered("pods", "ADDED", w)
             for w in snap.get("nodes", ()):
                 self._apply_recovered("nodes", "ADDED", w)
+            for w in snap.get("leases", ()):
+                if w.get("name"):
+                    self.leases[w["name"]] = {
+                        "holder": w.get("holder", ""),
+                        "duration": float(w.get("duration", 15.0)),
+                        "renew": _lease_clock(),
+                        "transitions": int(w.get("transitions", 0))}
         for rec in records:
             kind = rec.get("kind")
+            if kind == "leases":
+                # Lease holders survive the restart but their clocks do not
+                # (renew stamps are this process's monotonic clock): restore
+                # renewed-at-recovery, so a live holder keeps its lease and a
+                # dead one expires exactly one lease period after recovery.
+                w = rec.get("object") or {}
+                if w.get("name"):
+                    self.leases[w["name"]] = {
+                        "holder": w.get("holder", ""),
+                        "duration": float(w.get("duration", 15.0)),
+                        "renew": _lease_clock(),
+                        "transitions": int(w.get("transitions", 0))}
+                continue
             if kind not in ("pods", "nodes"):
                 continue
             self._apply_recovered(kind, rec.get("type", ""), rec.get("object"))
@@ -397,6 +448,12 @@ class APIServer:
         self.store._rv_counter = itertools.count(
             self._seq["pods"] + self._seq["nodes"] + 1)
         self.recovered_objects = len(self.store.pods) + len(self.store.nodes)
+        # Rebuild the Omega commit-validation usage table from the recovered
+        # bound pods — incremental maintenance resumes from here.
+        self._usage.clear()
+        for pod in self.store.pods.values():
+            if pod.node_name:
+                self._usage_apply(pod.node_name, pod, +1)
 
     def _apply_recovered(self, kind: str, typ: str, wire: Optional[dict]) -> None:
         """Apply one recovered object directly to the store dicts — no
@@ -405,6 +462,16 @@ class APIServer:
         if wire is None:
             return
         if kind == "pods":
+            if typ == "BOUND":
+                # Slim bind record: patch the already-recovered pod in place
+                # (its ADDED/snapshot record precedes it in the log; a pod
+                # deleted later is corrected by the following DELETED).
+                pod = self.store.pods.get(wire.get("uid", ""))
+                if pod is not None:
+                    pod.node_name = wire.get("nodeName", "")
+                    if pod.node_name:
+                        self.store.bindings[pod.uid] = pod.node_name
+                return
             pod = pod_from_wire(wire)
             if typ == "DELETED":
                 self.store.pods.pop(pod.uid, None)
@@ -442,7 +509,156 @@ class APIServer:
             "seq": dict(self._seq),
             "pods": [pod_to_wire(p) for p in list(self.store.pods.values())],
             "nodes": [node_to_wire(n) for n in list(self.store.nodes.values())],
+            "leases": [dict(rec, name=name, renew=None)
+                       for name, rec in list(self.leases.items())],
         }
+
+    # -- Omega commit validation (per-node committed usage) -----------------
+
+    def _usage_apply(self, node_name: str, pod, sign: int) -> None:
+        """Incrementally maintain the committed-usage aggregate the binding
+        subresource validates against. Caller holds the write lock (or is
+        single-threaded recovery)."""
+        req = pod.resource_request()
+        u = self._usage.setdefault(
+            node_name, {"cpu": 0, "mem": 0, "eph": 0, "pods": 0, "scalar": {}})
+        u["cpu"] += sign * req.milli_cpu
+        u["mem"] += sign * req.memory
+        u["eph"] += sign * req.ephemeral_storage
+        u["pods"] += sign
+        for k, v in req.scalar_resources.items():
+            u["scalar"][k] = u["scalar"].get(k, 0) + sign * v
+
+    def _bind_overcommits(self, node_name: str, pod) -> bool:
+        """Would committing `pod` onto `node_name` exceed the node's
+        allocatable? The shared-state transaction check (Omega §3): every
+        scheduler plans optimistically against its own watch-fed view; the
+        single store is where conflicting plans meet, and the loser gets a
+        409 instead of an overcommitted node. A bind to a node the store
+        does not know is left to the scheduler's own validation."""
+        node = self.store.nodes.get(node_name)
+        if node is None:
+            return False
+        u = self._usage.get(
+            node_name, {"cpu": 0, "mem": 0, "eph": 0, "pods": 0, "scalar": {}})
+        req = pod.resource_request()
+        alloc = node.allocatable
+        if (u["cpu"] + req.milli_cpu > alloc.milli_cpu
+                or u["mem"] + req.memory > alloc.memory
+                or u["eph"] + req.ephemeral_storage > alloc.ephemeral_storage
+                or u["pods"] + 1 > alloc.allowed_pod_number):
+            return True
+        return any(u["scalar"].get(k, 0) + v > alloc.scalar_resources.get(k, 0)
+                   for k, v in req.scalar_resources.items())
+
+    def _bind_one(self, uid: str, node: str):
+        """One bind attempt (caller holds the write lock) → (code, payload).
+        Shared by the single binding subresource and the bulk endpoint."""
+        pod = self.store.pods.get(uid)
+        if pod is None:
+            return 404, {"error": "pod not found"}
+        if pod.node_name:
+            # Already bound: a same-node POST is a retry replay of a bind
+            # whose reply was lost (pre-crash write, recovered from the
+            # WAL) — idempotent success, no re-fired event. A different
+            # node is a genuine conflict (409, registry AlreadyExists
+            # analogue): a pod must never be bound twice.
+            if pod.node_name == node:
+                return 200, {"bound": True}
+            self.bind_conflicts += 1
+            return 409, {"error": "AlreadyBound"}
+        if self._bind_overcommits(node, pod):
+            # Optimistic-concurrency loser (Omega transaction validation):
+            # another scheduler's commits filled this node first. 409 →
+            # conflict-driven requeue.
+            self.capacity_conflicts += 1
+            return 409, {"error": "OutOfCapacity"}
+        self.store.bind(pod, node)
+        self._usage_apply(node, pod, +1)
+        return 200, {"bound": True}
+
+    # -- shard leases (PUT-CAS + server-side expiry) ------------------------
+
+    def _lease_wire(self, name: str, rec: dict, now: float) -> dict:
+        age = now - rec["renew"]
+        return {"name": name, "holder": rec["holder"],
+                "leaseDurationSeconds": rec["duration"],
+                "ageSeconds": round(age, 3),
+                "transitions": rec["transitions"],
+                "expired": (not rec["holder"]) or age >= rec["duration"]}
+
+    def list_leases(self) -> List[dict]:
+        now = _lease_clock()
+        with self._lock:
+            return [self._lease_wire(n, r, now)
+                    for n, r in sorted(self.leases.items())]
+
+    def upsert_lease(self, name: str, holder: str,
+                     duration: float) -> Optional[dict]:
+        """Acquire-or-renew under CAS semantics: a held, unexpired lease
+        only renews for its CURRENT holder; anyone else gets None (HTTP
+        409) — the resourcelock's update-if-expired collapsed to one verb.
+        The record rides the WAL so a `kill -9`'d apiserver recovers the
+        holder table (with clocks restarted, see _recover)."""
+        now = _lease_clock()
+        with self._write_lock:
+            rec = self.leases.get(name)
+            if (rec is not None and rec["holder"] and rec["holder"] != holder
+                    and now - rec["renew"] < rec["duration"]):
+                self.lease_conflicts += 1
+                return None
+            if rec is None:
+                rec = {"holder": "", "duration": float(duration),
+                       "renew": now, "transitions": 0}
+                self.leases[name] = rec
+            if rec["holder"] != holder:
+                rec["transitions"] += 1
+                self.lease_transitions += 1
+            rec["holder"] = holder
+            rec["duration"] = float(duration)
+            rec["renew"] = now
+            if self.persistence is not None:
+                with self._lock:
+                    self.persistence.append({
+                        "kind": "leases", "type": "LEASE",
+                        "object": {"name": name, "holder": holder,
+                                   "duration": rec["duration"],
+                                   "transitions": rec["transitions"]}})
+                    if self.persistence.should_compact():
+                        # Renewals are the steady-state WAL traffic of an
+                        # idle sharded plane (N shards × 3 appends per lease
+                        # period, forever); without compacting here — the
+                        # broadcast path never runs on a quiet cluster —
+                        # the WAL and its replay time grow without bound.
+                        # Same locking posture as _broadcast: this thread
+                        # holds the write lock, so the store snapshot is
+                        # stable, and a failed compaction must not fail the
+                        # renewal.
+                        try:
+                            self.persistence.write_snapshot(
+                                self._snapshot_state())
+                        except Exception:  # noqa: BLE001
+                            self.compaction_failures += 1
+            return self._lease_wire(name, rec, now)
+
+    def expose_metrics(self) -> str:
+        """Control-plane counters (conflict/lease/watch planes) in the
+        Prometheus text format — scraped by the shard chaos/bench harnesses
+        so failover and conflict behavior is observable from outside."""
+        out = []
+        for name, v in (
+                ("apiserver_bind_conflicts_total", self.bind_conflicts),
+                ("apiserver_capacity_conflicts_total",
+                 self.capacity_conflicts),
+                ("apiserver_lease_conflicts_total", self.lease_conflicts),
+                ("apiserver_lease_transitions_total", self.lease_transitions),
+                ("apiserver_resumed_watches_total", self.resumed_watches),
+                ("apiserver_relisted_watches_total", self.relisted_watches),
+                ("apiserver_compaction_failures_total",
+                 self.compaction_failures)):
+            out.append(f"# TYPE {name} counter")
+            out.append(f"{name} {v}")
+        return "\n".join(out) + "\n"
 
     # -- event fanout to watch streams -------------------------------------
 
@@ -475,6 +691,17 @@ class APIServer:
 
     def _pod_event(self, kind: str, old, new) -> None:
         typ = {"add": "ADDED", "update": "MODIFIED", "delete": "DELETED"}[kind]
+        if (kind == "update" and old is not None
+                and new.node_name and not old.node_name):
+            # Bind commit — the hottest event class on a sharded plane, and
+            # the only server-side writer of nodeName (the pod's spec is
+            # otherwise the one the watcher already caches from ADDED). A
+            # slim BOUND event carries just {uid, nodeName}: N shards each
+            # decode every peer's binds, so the full-pod wire encode +
+            # pod_from_wire rebuild per bind per watcher is pure scaling tax.
+            self._broadcast("pods", {"type": "BOUND", "object": {
+                "uid": new.uid, "nodeName": new.node_name}})
+            return
         self._broadcast("pods", {"type": typ, "object": pod_to_wire(new)})
 
     def _node_event(self, kind: str, old, new) -> None:
@@ -533,9 +760,24 @@ class APIServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # The handler writes responses as several small send()s (status
+            # line, headers, body) and clients send headers/body the same
+            # way: with Nagle on, each small segment waits on the peer's
+            # delayed ACK — measured ~3.8ms/request on LOOPBACK (≈260
+            # writes/s ceiling on an idle server). TCP_NODELAY on both
+            # sides (see KeepAliveClient) lifts the write plane ~4x.
+            disable_nagle_algorithm = True
 
             def log_message(self, *a):
                 pass
+
+            def setup(self):
+                super().setup()
+                server._conns.add(self.connection)
+
+            def finish(self):
+                server._conns.discard(self.connection)
+                super().finish()
 
             def _read_body(self) -> dict:
                 # Socket I/O — must run OUTSIDE the write lock (a stalled
@@ -569,6 +811,17 @@ class APIServer:
                 if path == "/api/v1/pods":
                     if watch:
                         return self._stream("pods", since, epoch)
+                    if "summary=true" in query:
+                        # Progress-poll surface: counting is ~3 orders of
+                        # magnitude cheaper than wire-encoding the full
+                        # list, and pollers (bench/chaos harnesses) only
+                        # need the counts — at 10k pods a full-list poll
+                        # every 0.5s costs the control plane more CPU than
+                        # the binds themselves.
+                        pods = list(server.store.pods.values())
+                        return self._json(200, {
+                            "total": len(pods),
+                            "bound": sum(1 for p in pods if p.node_name)})
                     return self._json(200, [pod_to_wire(p) for p in
                                             server.store.pods.values()])
                 if path == "/api/v1/nodes":
@@ -576,6 +829,17 @@ class APIServer:
                         return self._stream("nodes", since, epoch)
                     return self._json(200, [node_to_wire(n) for n in
                                             server.store.nodes.values()])
+                if path == "/api/v1/leases":
+                    return self._json(200, server.list_leases())
+                if path == "/metrics":
+                    data = server.expose_metrics().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 self._json(404, {"error": "not found"})
 
             def _stream(self, kind: str, since: Optional[int] = None,
@@ -623,7 +887,29 @@ class APIServer:
 
             def _do_post(self):
                 if self.path == "/api/v1/pods":
-                    pod = pod_from_wire(self._body())
+                    body = self._body()
+                    if isinstance(body, list):
+                        # Bulk create: one request, one lock acquisition,
+                        # one HTTP turnaround for a whole creation burst.
+                        # Per-object creates cost ~1.5ms of control-plane
+                        # turnaround each under load — at 10k pods that is
+                        # ~45s of a 60s sharded bench spent just ARRIVING.
+                        # Wire semantics match looped single creates: one
+                        # ADDED event per pod (watchers see no difference),
+                        # duplicates skipped and reported, never re-fired.
+                        dup = 0
+                        for w in body:
+                            pod = pod_from_wire(w)
+                            if pod.uid in server.store.pods:
+                                dup += 1
+                                continue
+                            server.store.create_pod(pod)
+                            if pod.node_name:
+                                server._usage_apply(pod.node_name, pod, +1)
+                        return self._json(
+                            201, {"created": len(body) - dup,
+                                  "alreadyExists": dup})
+                    pod = pod_from_wire(body)
                     # AlreadyExists (409, like the reference registry):
                     # duplicate creates — e.g. a client retrying a write
                     # whose reply was lost — must not re-fire ADDED events
@@ -631,9 +917,23 @@ class APIServer:
                     if pod.uid in server.store.pods:
                         return self._json(409, {"error": "AlreadyExists"})
                     server.store.create_pod(pod)
+                    if pod.node_name:  # created pre-bound: commit its usage
+                        server._usage_apply(pod.node_name, pod, +1)
                     return self._json(201, pod_to_wire(pod))
                 if self.path == "/api/v1/nodes":
-                    node = node_from_wire(self._body())
+                    body = self._body()
+                    if isinstance(body, list):
+                        dup = 0
+                        for w in body:
+                            node = node_from_wire(w)
+                            if node.name in server.store.nodes:
+                                dup += 1
+                                continue
+                            server.store.create_node(node)
+                        return self._json(
+                            201, {"created": len(body) - dup,
+                                  "alreadyExists": dup})
+                    node = node_from_wire(body)
                     if node.name in server.store.nodes:
                         return self._json(409, {"error": "AlreadyExists"})
                     server.store.create_node(node)
@@ -642,26 +942,23 @@ class APIServer:
                         and self.path.endswith("/status")):
                     # parity stub (kubelet heartbeat shape); no-op
                     return self._json(200, {})
+                if self.path == "/api/v1/bindings":
+                    # Bulk binding commits: one request, one write-lock
+                    # acquisition for a whole drained dispatcher queue
+                    # (api_dispatcher bulk path). Per-item verdicts ride a
+                    # 200 envelope — one pod's conflict must not fail its
+                    # batch-mates' commits.
+                    out = [dict(payload, code=code) for code, payload in
+                           (server._bind_one(item.get("uid", ""),
+                                             item.get("node", ""))
+                            for item in self._body())]
+                    return self._json(200, out)
                 parts = self.path.split("/")
                 if (self.path.startswith("/api/v1/pods/")
                         and self.path.endswith("/binding")):
-                    pod = server.store.pods.get(parts[4])
-                    if pod is None:
-                        return self._json(404, {"error": "pod not found"})
-                    node = self._body()["node"]
-                    if pod.node_name:
-                        # Already bound: a same-node POST is a retry replay
-                        # of a bind whose reply was lost (pre-crash write,
-                        # recovered from the WAL) — idempotent success, no
-                        # re-fired event. A different node is a genuine
-                        # conflict (409, registry AlreadyExists analogue):
-                        # a pod must never be bound twice.
-                        if pod.node_name == node:
-                            return self._json(200, {"bound": True})
-                        server.bind_conflicts += 1
-                        return self._json(409, {"error": "AlreadyBound"})
-                    server.store.bind(pod, node)
-                    return self._json(200, {"bound": True})
+                    code, payload = server._bind_one(
+                        parts[4], self._body()["node"])
+                    return self._json(code, payload)
                 if (self.path.startswith("/api/v1/pods/")
                         and self.path.endswith("/status")):
                     pod = server.store.pods.get(parts[4])
@@ -682,6 +979,17 @@ class APIServer:
 
             def do_PUT(self):
                 self._body_cache = self._read_body()
+                if self.path.startswith("/api/v1/leases/"):
+                    # upsert_lease serializes under the write lock itself
+                    # (it is also an in-process API); don't wrap it twice.
+                    body = self._body()
+                    got = server.upsert_lease(
+                        self.path.split("/")[4],
+                        body.get("holder", ""),
+                        float(body.get("leaseDurationSeconds", 15.0)))
+                    if got is None:
+                        return self._json(409, {"error": "LeaseHeld"})
+                    return self._json(200, got)
                 with server._write_lock:
                     return self._do_put()
 
@@ -710,7 +1018,13 @@ class APIServer:
                     uid = self.path.split("/")[4]
                     pod = server.store.pods.get(uid)
                     if pod is not None:
+                        bound_to = pod.node_name
                         server.store.delete_pod(pod)
+                        if bound_to and uid not in server.store.pods:
+                            # Finalizer-parked deletes keep the pod (and its
+                            # committed usage); only a completed delete
+                            # releases the node's share.
+                            server._usage_apply(bound_to, pod, -1)
                     return self._json(200, {})
                 if self.path.startswith("/api/v1/nodes/"):
                     server.store.delete_node(self.path.split("/")[4])
@@ -727,6 +1041,19 @@ class APIServer:
         self._httpd = None
         if httpd is not None:
             httpd.shutdown()
+            # Tear down accepted connections (parked keep-alive REST conns +
+            # watch streams) so their handler threads exit and pooled
+            # clients see EOF — a lingering thread would keep serving this
+            # dead server's store. Then release the LISTENING socket:
+            # restart-in-place must be able to rebind the port immediately
+            # (ThreadingHTTPServer.shutdown() alone never closes it).
+            for sock in list(self._conns):
+                try:
+                    import socket as _sock
+                    sock.shutdown(_sock.SHUT_RDWR)
+                except Exception:  # noqa: BLE001 - already closing
+                    pass
+            httpd.server_close()
         if self.persistence is not None:
             self.persistence.close()
 
@@ -734,6 +1061,110 @@ class APIServer:
 # ---------------------------------------------------------------------------
 # The client: REST writes + reflector-fed informer cache
 # ---------------------------------------------------------------------------
+
+
+class KeepAliveClient:
+    """Thread-local persistent HTTP/1.1 connections to one server.
+
+    The apiserver handler already speaks HTTP/1.1 keep-alive; what burned
+    CPU was the CLIENT side opening a fresh TCP connection per call (urllib
+    does not pool), which also costs the ThreadingHTTPServer one thread
+    spawn per request. At bind rates (>100/s per scheduler, every bind a
+    POST) the setup tax dominated the write path — the profiled 1-shard
+    bench spent 68s of a 78s run inside the serial host-commit loop, most
+    of it connection overhead. One pooled connection per calling thread
+    keeps the server thread persistent too.
+
+    Transport-failure policy: the pooled connection is dropped, then
+    - GET/PUT (idempotent on this surface — list/summary reads, node
+      updates, lease renews) transparently retry ONCE on a fresh
+      connection;
+    - POST/DELETE retry once too, but ONLY when a REUSED connection died
+      before yielding any response byte (RemoteDisconnected/reset/EPIPE —
+      the keep-alive staleness signature: the server restarted or closed
+      the parked conn, and a closed server socket RSTs late data, so the
+      request was almost certainly never processed). Every verb on this
+      surface tolerates the rare did-process replay: creates answer 409
+      AlreadyExists (a caller-visible wart only when the response to a
+      processed create was lost mid-crash), same-node bind replays answer
+      200, deletes/status are idempotent. All other POST/DELETE failures
+      surface a URLError to the caller's retry policy (RetryingClientset
+      owns replay-409 forgiveness for ITS replays).
+    """
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        from urllib.parse import urlsplit
+        sp = urlsplit(base_url.rstrip("/"))
+        self._host = sp.hostname
+        self._port = sp.port or 80
+        self._base = base_url.rstrip("/")
+        self._timeout = timeout
+        self._local = threading.local()
+
+    def call(self, method: str, path: str, body: Optional[dict] = None,
+             timeout: Optional[float] = None):
+        import http.client as _hc
+        import io
+        from urllib import error as urlerror
+
+        data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        t = timeout if timeout is not None else self._timeout
+        may_replay = method in ("GET", "PUT")
+        for attempt in (0, 1):
+            conn = getattr(self._local, "conn", None)
+            fresh = conn is None
+            if fresh:
+                conn = _hc.HTTPConnection(self._host, self._port, timeout=t)
+                self._local.conn = conn
+                try:  # headers+body go out as separate small segments;
+                    # without NODELAY, Nagle holds the second on the
+                    # peer's delayed ACK (~ms per request, even loopback)
+                    import socket as _sock
+                    conn.connect()
+                    conn.sock.setsockopt(_sock.IPPROTO_TCP,
+                                         _sock.TCP_NODELAY, 1)
+                except Exception:  # noqa: BLE001 - connect errors surface
+                    pass           # identically from request() below
+            elif conn.timeout != t:
+                conn.timeout = t
+                if conn.sock is not None:
+                    conn.sock.settimeout(t)
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                status, reason, hdrs = resp.status, resp.reason, resp.msg
+                if resp.will_close:
+                    self._local.conn = None
+                    conn.close()
+            except Exception as e:  # noqa: BLE001 - transport failure
+                self._local.conn = None
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                # A REUSED connection torn down before yielding any response
+                # byte is the keep-alive staleness signature (server
+                # restarted or idle-closed the parked conn; a closed server
+                # socket RSTs late data, so the request was almost certainly
+                # never processed). Replay it once on a fresh connection for
+                # every verb: this API surface tolerates the rare
+                # did-process case too (creates answer 409 AlreadyExists,
+                # same-node bind replays answer 200, deletes/status are
+                # idempotent).
+                stale = not fresh and isinstance(
+                    e, (_hc.RemoteDisconnected, ConnectionResetError,
+                        BrokenPipeError))
+                if (may_replay or stale) and not fresh and attempt == 0:
+                    continue  # stale keep-alive connection: one fresh try
+                if isinstance(e, urlerror.URLError):
+                    raise
+                raise urlerror.URLError(e) from e
+            if status >= 400:
+                raise urlerror.HTTPError(f"{self._base}{path}", status,
+                                         reason, hdrs, io.BytesIO(payload))
+            return json.loads(payload) if payload else None
 
 
 class HTTPClientset:
@@ -744,8 +1175,15 @@ class HTTPClientset:
     Only the pod/node surface crosses the wire (the verbs the scheduler
     core exercises); the remaining listers return empty local dicts."""
 
+    # Binds terminate at the apiserver's binding subresource, whose Omega
+    # commit validation rejects overcommits with 409 — the property
+    # shard.ShardMember's optimistic session patching relies on. The
+    # FakeClientset binds unconditionally and must not claim it.
+    validates_bind_capacity = True
+
     def __init__(self, base_url: str, sync_timeout: float = 30.0):
         self.base = base_url.rstrip("/")
+        self._ka = KeepAliveClient(self.base)
         self.pods: Dict[str, Pod] = {}
         self.nodes: Dict[str, Node] = {}
         self.bindings: Dict[str, str] = {}
@@ -797,11 +1235,10 @@ class HTTPClientset:
     # -- REST --------------------------------------------------------------
 
     def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
-        data = json.dumps(body).encode() if body is not None else None
-        req = urlrequest.Request(self.base + path, data=data, method=method,
-                                 headers={"Content-Type": "application/json"})
-        with urlrequest.urlopen(req, timeout=10) as resp:
-            return json.loads(resp.read())
+        # Pooled keep-alive connections (one per calling thread): the bind
+        # path POSTs once per scheduled pod, and per-call connection setup
+        # was the dominant cost of the serial host-commit loop.
+        return self._ka.call(method, path, body)
 
     def create_pod(self, pod: Pod) -> Pod:
         self._call("POST", "/api/v1/pods", pod_to_wire(pod))
@@ -825,6 +1262,27 @@ class HTTPClientset:
         self._call("POST", f"/api/v1/pods/{pod.uid}/binding",
                    {"node": node_name})
 
+    def bind_many(self, pairs) -> list:
+        """Bulk binding commits (POST /api/v1/bindings): one request for a
+        drained dispatcher bind queue. Per-item verdicts come back in a 200
+        envelope; each non-200 maps to the HTTPError the single-bind path
+        would have raised (the conflict-requeue seam keys on .code == 409
+        and the reason string naming AlreadyBound/OutOfCapacity)."""
+        import io
+        from urllib.error import HTTPError
+        res = self._call("POST", "/api/v1/bindings",
+                         [{"uid": p.uid, "node": node} for p, node in pairs])
+        out = []
+        for i, (p, _node) in enumerate(pairs):
+            item = res[i] if res is not None and i < len(res) else {
+                "code": 500, "error": "short bulk-bind response"}
+            code = item.get("code", 200)
+            out.append(None if code < 400 else HTTPError(
+                f"{self.base}/api/v1/bindings", code,
+                item.get("error", ""), None,
+                io.BytesIO(json.dumps(item).encode())))
+        return out
+
     def patch_pod_status(self, pod: Pod, nominated_node_name: str = "",
                          phase: str = "") -> None:
         self._call("POST", f"/api/v1/pods/{pod.uid}/status",
@@ -835,6 +1293,25 @@ class HTTPClientset:
 
     def update_pod(self, pod: Pod) -> Pod:  # parity stub for the surface
         return pod
+
+    # -- shard leases (shard/leases.py coordination surface) ----------------
+
+    def list_leases(self) -> List[dict]:
+        return self._call("GET", "/api/v1/leases")
+
+    def upsert_lease(self, name: str, holder: str,
+                     duration: float) -> Optional[dict]:
+        """Acquire-or-renew; None when the lease is held by someone else
+        (HTTP 409) — the CAS loss a ShardMember treats as 'not mine'."""
+        from urllib.error import HTTPError
+        try:
+            return self._call("PUT", f"/api/v1/leases/{name}",
+                              {"holder": holder,
+                               "leaseDurationSeconds": duration})
+        except HTTPError as e:
+            if e.code == 409:
+                return None
+            raise
 
     # -- informer registration (scheduler event handlers) -------------------
 
@@ -988,6 +1465,25 @@ class HTTPClientset:
 
     def _dispatch(self, kind: str, typ: str, obj: dict,
                   relisting: bool = False) -> None:
+        if typ == "BOUND":
+            # Slim bind event: the full pod is already cached (its ADDED
+            # preceded it on this ordered stream) — patch nodeName on a copy
+            # instead of rebuilding the pod from a full wire dict. The copy
+            # keeps old/new distinct for handlers AND shares the spec-derived
+            # memos (signature caches) with the cached object.
+            old = self.pods.get(obj["uid"])
+            if old is None:
+                return  # pod unseen on this stream; the next re-list corrects
+            pod = copy.copy(old)
+            pod.node_name = obj.get("nodeName", "")
+            self.pods[pod.uid] = pod
+            if pod.node_name:
+                self.bindings[pod.uid] = pod.node_name
+            else:
+                self.bindings.pop(pod.uid, None)
+            for h in self._pod_handlers:
+                h("update", old, pod)
+            return
         action = {"ADDED": "add", "MODIFIED": "update", "DELETED": "delete"}[typ]
         if kind == "pods":
             pod = pod_from_wire(obj)
@@ -1058,6 +1554,15 @@ def main(argv=None) -> int:
     ap.add_argument("--snapshot-every", type=int, default=2048,
                     help="compact the WAL into a snapshot every N records")
     args = ap.parse_args(argv)
+    # The server is thread-per-connection with ~a dozen live threads under
+    # a sharded cluster (creators, watch streams, shard write conns). At
+    # CPython's default 5ms switch interval a request handler that needs a
+    # few GIL slices waits out multiple quanta — measured as ~4ms/request
+    # turnaround with the CPU nearly idle (~240 creates/s arrival ceiling).
+    # A 1ms interval trades a little context-switch overhead for ~5x lower
+    # write-plane latency.
+    import sys as _sys
+    _sys.setswitchinterval(0.001)
     api = APIServer(data_dir=args.data_dir or None, fsync=args.fsync,
                     snapshot_every=args.snapshot_every)
     port = api.serve(args.port)

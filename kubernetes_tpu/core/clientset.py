@@ -42,6 +42,12 @@ class FakeClientset:
         # threaded watch transport) can write while the scheduling loop
         # binds, without ever minting duplicate versions.
         self._rv_counter = itertools.count(1)
+        # Shard leases (shard/leases.py): the in-process analogue of the
+        # apiserver's /api/v1/leases surface. `lease_now` is injectable so
+        # lease-expiry tests need no real sleeps.
+        self.leases: Dict[str, dict] = {}
+        import time as _time
+        self.lease_now: Callable[[], float] = _time.monotonic
 
     # -- informer-ish registration ----------------------------------------
 
@@ -276,6 +282,42 @@ class FakeClientset:
         if phase:
             stored.phase = phase
 
+    # -- shard leases (apiserver /api/v1/leases parity) ---------------------
+
+    def _lease_wire(self, name: str, rec: dict, now: float) -> dict:
+        age = now - rec["renew"]
+        return {"name": name, "holder": rec["holder"],
+                "leaseDurationSeconds": rec["duration"],
+                "ageSeconds": round(age, 3),
+                "transitions": rec["transitions"],
+                "expired": (not rec["holder"]) or age >= rec["duration"]}
+
+    def list_leases(self) -> List[dict]:
+        now = self.lease_now()
+        return [self._lease_wire(n, r, now)
+                for n, r in sorted(self.leases.items())]
+
+    def upsert_lease(self, name: str, holder: str,
+                     duration: float) -> Optional[dict]:
+        """Acquire-or-renew under CAS semantics (same contract as the
+        apiserver's PUT /api/v1/leases/<name>): a held, unexpired lease only
+        renews for its current holder; anyone else gets None."""
+        now = self.lease_now()
+        rec = self.leases.get(name)
+        if (rec is not None and rec["holder"] and rec["holder"] != holder
+                and now - rec["renew"] < rec["duration"]):
+            return None
+        if rec is None:
+            rec = {"holder": "", "duration": float(duration),
+                   "renew": now, "transitions": 0}
+            self.leases[name] = rec
+        if rec["holder"] != holder:
+            rec["transitions"] += 1
+        rec["holder"] = holder
+        rec["duration"] = float(duration)
+        rec["renew"] = now
+        return self._lease_wire(name, rec, now)
+
 
 class RetryingClientset:
     """Write-path retry decorator over any clientset (client-go's
@@ -314,7 +356,7 @@ class RetryingClientset:
     def __getattr__(self, name):
         attr = getattr(self._inner, name)
         if name in RetryingClientset._WRITE_VERBS and callable(attr):
-            def retried(*args, _attr=attr, **kwargs):
+            def retried(*args, _attr=attr, _verb=name, **kwargs):
                 state = {"retried": False}
 
                 def on_retry(attempt, exc):
@@ -326,11 +368,16 @@ class RetryingClientset:
                         lambda: _attr(*args, **kwargs),
                         config=self._retry_cfg, on_retry=on_retry)
                 except BaseException as e:
-                    if state["retried"] and getattr(e, "code", None) == 409:
-                        # AlreadyExists on a REPLAY: the earlier attempt
-                        # landed before its reply was lost — the write is
-                        # durable, which is what the caller wanted. A 409 on
-                        # the FIRST try is a genuine conflict and raises.
+                    if (state["retried"] and getattr(e, "code", None) == 409
+                            and _verb.startswith("create_")):
+                        # AlreadyExists on a create REPLAY: the earlier
+                        # attempt landed before its reply was lost — the
+                        # write is durable, which is what the caller wanted.
+                        # A 409 on the FIRST try is a genuine conflict and
+                        # raises. bind is deliberately excluded: the server
+                        # answers a same-node bind replay 200, so a bind 409
+                        # is ALWAYS a real conflict (another scheduler won
+                        # the pod) and must reach the conflict-requeue path.
                         return None
                     if self._retry_cfg.retriable(e):
                         self.give_ups += 1  # budget exhausted, still failing

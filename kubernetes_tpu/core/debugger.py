@@ -47,7 +47,26 @@ class CacheDebugger:
 
     def dump(self) -> str:
         s = self.scheduler
-        lines = ["Dump of cached NodeInfo:"]
+        lines = []
+        member = getattr(s, "shard_member", None)
+        if member is not None:
+            # Shard plane: ownership, lease ages, and conflict/requeue
+            # counts — enough to tell a wedged shard (stale own lease, zero
+            # requeues) from a conflict-storming one from one dump.
+            lines.append(
+                f"Shard member {member.identity}: "
+                f"owned={sorted(member.owned)} of {member.count} shards, "
+                f"renewals={member.renewals} adoptions={member.adoptions}")
+            for lease in member.lease_view():
+                lines.append(
+                    f"  lease {lease['name']}: holder={lease['holder'] or '-'}"
+                    f" age={lease['ageSeconds']:.2f}s"
+                    f"/{lease['leaseDurationSeconds']:.2f}s"
+                    f"{' EXPIRED' if lease['expired'] else ''}")
+            lines.append(
+                f"  bind_conflicts={getattr(s, 'bind_conflicts', 0)} "
+                f"conflict_requeues={getattr(s, 'conflict_requeues', 0)}")
+        lines.append("Dump of cached NodeInfo:")
         for name, ni in s.cache.nodes.items():
             lines.append(
                 f"  {name}: pods={len(ni.pods)} "

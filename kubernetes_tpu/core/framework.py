@@ -47,6 +47,15 @@ class Status:
     code: int = SUCCESS
     reasons: tuple = ()
     plugin: str = ""
+    # Optimistic-binding conflict (HTTP 409 from the binding subresource:
+    # AlreadyBound / OutOfCapacity): another scheduler's commit won the
+    # shared state. Not an error and not unschedulable — the scheduler
+    # requeues through the backoffQ and re-plans against the watch feed.
+    conflict: bool = False
+
+    @classmethod
+    def bind_conflict(cls, *reasons: str, plugin: str = "") -> "Status":
+        return cls(ERROR, tuple(reasons), plugin, conflict=True)
 
     @classmethod
     def unschedulable(cls, *reasons: str, plugin: str = "") -> "Status":
@@ -586,8 +595,9 @@ class Framework:
             if st.is_success():
                 return st
             # copy before stamping: plugins may return the shared OK/Status
-            # singletons, which must never be mutated.
-            return Status(st.code, st.reasons, p.name)
+            # singletons, which must never be mutated. `conflict` must ride
+            # along — it routes the unwind to the backoffQ requeue.
+            return Status(st.code, st.reasons, p.name, conflict=st.conflict)
         return Status.error("all bind plugins skipped")
 
     def run_post_bind_plugins(self, state: CycleState, pod: Pod, node_name: str) -> None:

@@ -297,6 +297,25 @@ class SchedulerMetrics:
         self.get_node_hint_duration = r(Histogram(
             "scheduler_get_node_hint_duration_seconds",
             "Batch reuse lookup latency (session-resume check)."))
+        # shard plane (kubernetes_tpu/shard/): optimistic multi-scheduler
+        self.bind_conflict_total = r(Counter(
+            "scheduler_bind_conflict_total",
+            "Optimistic-binding conflicts (409 from the binding "
+            "subresource), by reason: 'already_bound' = another scheduler "
+            "bound the pod first, 'capacity' = the commit would overcommit "
+            "the node (Omega transaction validation), 'conflict' = "
+            "unclassified 409.", ("reason",)))
+        self.shard_owned_shards = r(Gauge(
+            "scheduler_shard_owned_shards",
+            "Shard ranges this scheduler currently owns (1 = its own; more "
+            "after adopting an expired peer's range)."))
+        self.shard_lease_renewals = r(Counter(
+            "scheduler_shard_lease_renewals_total",
+            "Successful shard-lease renewals through the apiserver.", ()))
+        self.shard_adoptions = r(Counter(
+            "scheduler_shard_adoptions_total",
+            "Expired peer shard ranges adopted (lease-expiry failover).",
+            ()))
         # placement / pod-group series
         self.generated_placements_total = r(Counter(
             "scheduler_generated_placements_total",

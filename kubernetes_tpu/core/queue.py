@@ -771,6 +771,38 @@ class PriorityQueue:
         else:
             self.active_q.push(qpi)
 
+    def requeue_conflict(self, qpi) -> None:
+        """Optimistic-binding conflict (409 from the binding subresource):
+        the entity goes straight to the backoffQ — never the unschedulable
+        pool, because no cluster event is needed to make it schedulable
+        again; it only needs to wait out the backoff so the winning commit
+        arrives through the watch feed (Omega's conflict-then-retry)."""
+        qpi.timestamp = self.now()
+        if qpi.gated:
+            self.unschedulable[qpi.uid] = qpi
+            return
+        if qpi.pod.pod_group and self.gang_enabled:
+            # A gang member's conflict re-enters through the group buffer,
+            # exactly like add(): a bare backoffQ singleton would later pop
+            # and schedule SOLO, outside the gang's all-or-nothing. (Reached
+            # from failover-overlap 409s — the partitioner pins gangs whole,
+            # so only transient dual ownership can race a gang's binds.)
+            self._add_group_member(qpi)
+            return
+        self.backoff_q.push(qpi)
+        if self.metrics is not None:
+            self.metrics.queue_incoming_entities.inc("backoff", "BindConflict")
+
+    def has_entity(self, uid: str) -> bool:
+        """Is this pod/entity anywhere in the queue's custody (active,
+        backoff, unschedulable, in flight, or buffered as a gang member)?
+        Shard adoption sweeps use this to avoid double-admitting."""
+        if (uid in self.active_q or uid in self.backoff_q
+                or uid in self.unschedulable or uid in self._in_flight):
+            return True
+        return any(m.pod.uid == uid for ms in self._group_members.values()
+                   for m in ms)
+
     def activate(self, pod: Pod) -> None:
         """Activate (scheduling_queue.go:955) — force to activeQ."""
         uid = pod.uid

@@ -164,14 +164,38 @@ class Handle:
         return fn(fw, state, pod, node_to_status, num_candidates, start)
 
     def on_async_bind_error(self, pod, exc: Exception) -> None:
-        """Async dispatcher bind failure: unwind the optimistic commit."""
+        """Async dispatcher bind failure: unwind the optimistic commit. A
+        409 is an optimistic-binding conflict (another scheduler won the
+        pod/node): counted, not logged as an error — the re-added pod is
+        skipped once the winner's commit lands through the watch feed."""
         s = self._scheduler
         s.state_unwinds += 1
         s.cache.forget_pod(pod)
         pod.node_name = ""
         s.scheduled = max(0, s.scheduled - 1)
         s.failures += 1
-        s.error_log.append(f"async bind {pod.namespace}/{pod.name}: {exc!r}")
+        if getattr(exc, "code", None) == 409:
+            # Classify from the 409 BODY ({"error": AlreadyBound|
+            # OutOfCapacity}): the HTTPError's str() carries only the HTTP
+            # status phrase ("Conflict"), which would land every single
+            # (non-bulk) async conflict in the unclassified reason bucket.
+            msg = str(exc)
+            try:
+                import json as _json
+                msg = _json.loads(exc.read()).get("error", "") or msg
+            except Exception:  # noqa: BLE001 - keep the phrase fallback
+                pass
+            s._note_bind_conflict(msg)
+            s.conflict_requeues += 1
+            # Same routing as the sync path's _unwind_binding: straight to
+            # the backoffQ. Plain queue.add would put the loser on the
+            # activeQ, where it re-pops and re-binds against a cache that
+            # has not yet seen the winner's BOUND event — a 409 hot loop at
+            # full cycle speed until the watch feed catches up.
+            s.queue.requeue_conflict(s.queue._new_qpi(pod))
+            return
+        s.error_log.append(
+            f"async bind {pod.namespace}/{pod.name}: {exc!r}")
         s.queue.add(pod)
 
     # storage listers (volume plugins)
@@ -342,6 +366,27 @@ class Scheduler:
         # apiserver restart reported a cache-placed pod as UNBOUND): the
         # assumed-vs-recovered-truth reconciliation below unwound them.
         self.reconcile_unwinds = 0
+        # Shard plane (kubernetes_tpu/shard/): optional admission predicate —
+        # when set, only pods it accepts enter THIS scheduler's queue (the
+        # shard-scoped admission seam; the cache still mirrors the whole
+        # cluster so every shard plans against full node state). Optimistic
+        # binding: a 409 from the binding subresource is counted here and
+        # requeued through the backoffQ (see _unwind_binding).
+        self.pod_admission: Optional[Callable[[Pod], bool]] = None
+        self.shard_member = None  # set by shard.ShardMember (debugger dump)
+        # Per-cycle hook (run_until_idle): the shard member's ownership
+        # refresh runs here so queue-mutating failover stays on the
+        # scheduling thread even through long drains.
+        self.loop_hook: Optional[Callable[[], object]] = None
+        self.bind_conflicts = 0
+        self.conflict_requeues = 0
+        # True when every bind terminates at the apiserver's binding
+        # subresource, whose Omega-style transaction validation rejects an
+        # overcommitting commit with a 409 (set by shard.ShardMember). Lets
+        # device sessions treat a peer shard's bind feed optimistically:
+        # commit in-flight results as-is and let the store arbitrate,
+        # instead of invalidating the session pessimistically.
+        self.bind_capacity_validated = False
         # Off-thread watch-event inbox (see _threaded): deque append/popleft
         # are atomic under the GIL, so no lock is needed.
         from collections import deque
@@ -438,6 +483,10 @@ class Scheduler:
         schedulerName names one of our profiles."""
         return pod.scheduler_name in self.profiles
 
+    def _admits(self, pod: Pod) -> bool:
+        """Shard-scoped admission: with no shard plane every pod is ours."""
+        return self.pod_admission is None or self.pod_admission(pod)
+
     def _on_pod_event(self, kind: str, old: Optional[Pod], new: Pod) -> None:
         # cluster_event_seq versions node-state-relevant cluster changes so a
         # device batch session (models/tpu_scheduler.py) knows whether the
@@ -461,7 +510,7 @@ class Scheduler:
                 self.cache.add_pod(new)
                 self.queue.move_all_to_active_or_backoff(
                     EVENT_ASSIGNED_POD_ADD, None, new)
-            elif self._responsible_for_pod(new):
+            elif self._responsible_for_pod(new) and self._admits(new):
                 self.queue.add(new)
         elif kind == "update":
             if new.node_name:
@@ -498,11 +547,16 @@ class Scheduler:
                     self.cache.remove_pod(st.pod)
                     self.queue.move_all_to_active_or_backoff(
                         EVENT_ASSIGNED_POD_DELETE, st.pod, None)
-                    if self._responsible_for_pod(new):
+                    if self._responsible_for_pod(new) and self._admits(new):
                         new.node_name = ""
                         self.queue.add(new)
                 else:
-                    self.queue.update(old, new)
+                    if self._admits(new) or self.queue.has_entity(new.uid):
+                        # Non-admitted pending pods stay out of the queue;
+                        # an already-queued one (ownership shrank after
+                        # adoption handback) still takes spec updates — the
+                        # optimistic 409 path resolves any overlap.
+                        self.queue.update(old, new)
         elif kind == "delete":
             if new.node_name:
                 self.cache.remove_pod(new)
@@ -611,16 +665,28 @@ class Scheduler:
         """Drive schedule_one until the queue drains (test/bench harness)."""
         n = 0
         while n < max_cycles:
+            if self.loop_hook is not None:
+                self.loop_hook()
             if not self.schedule_one():
                 self.queue.flush_backoff_completed()
                 self.flush_expired_waiters()
                 # Drain async bind failures on THIS thread (the inbox keeps
                 # cache/queue mutation off the dispatcher worker), then
-                # re-check: an unwound pod goes back onto the queue.
-                self.api_dispatcher.flush()
+                # re-check: an unwound pod goes back onto the queue. The
+                # flush is a SHORT slice, not a full barrier — with binds in
+                # flight, a blocking flush would starve the event inbox
+                # (newly created pods can't enter the queue while the loop
+                # is parked), which capped sharded throughput at the bind
+                # drain rate. Only a fully idle dispatcher ends the loop, so
+                # the contract is unchanged: on return, the queue is drained
+                # AND every accepted write has landed or reported.
+                self.api_dispatcher.flush(timeout=0.05)
                 self.process_async_api_errors()
                 if not self.schedule_one():
-                    break
+                    if self.api_dispatcher.idle():
+                        break
+                    n += 1  # count the wait slice: max_cycles stays a bound
+                    continue  # writes still in flight: stay responsive
             n += 1
         return n
 
@@ -1368,7 +1434,12 @@ class Scheduler:
 
     def _unwind_binding(self, fw, state, qpi: QueuedPodInfo, node_name: str, st: Status) -> None:
         """handleBindingCycleError (schedule_one.go:507): unreserve, forget,
-        flush an AssignedPodDelete-equivalent event, requeue."""
+        flush an AssignedPodDelete-equivalent event, requeue. A tagged bind
+        CONFLICT (409: another scheduler won the shared state) skips the
+        unschedulable pool and goes straight to the backoffQ — by the time
+        the backoff elapses the watch feed has delivered the winning commit
+        and the retry either skips the pod (already placed) or re-plans
+        against the updated node state."""
         pod = qpi.pod
         self.state_unwinds += 1
         fw.run_reserve_plugins_unreserve(state, pod, node_name)
@@ -1376,7 +1447,19 @@ class Scheduler:
         pod.node_name = ""
         self.queue.move_all_to_active_or_backoff(
             EVENT_ASSIGNED_POD_DELETE, pod, None)
+        if getattr(st, "conflict", False):
+            self._note_bind_conflict(st.message())
+            self.conflict_requeues += 1
+            self.queue.requeue_conflict(qpi)
+            return
         self.handle_scheduling_failure(fw, qpi, st, None)
+
+    def _note_bind_conflict(self, message: str) -> None:
+        reason = ("capacity" if "OutOfCapacity" in message
+                  else "already_bound" if "AlreadyBound" in message
+                  else "conflict")
+        self.bind_conflicts += 1
+        self.metrics.bind_conflict_total.inc(reason)
 
     # -- failure (schedule_one.go:1152 handleSchedulingFailure) ------------
 
@@ -1483,7 +1566,33 @@ class Scheduler:
     def expose_metrics(self) -> str:
         """/metrics (app/server.go:376)."""
         self.update_pending_metrics()
-        return self.metrics.expose()
+        out = self.metrics.expose()
+        # Step-accounting counters (plan/device/host split, device-vs-host
+        # path mix, conflict/unwind tallies): in-process harnesses read
+        # these attributes directly, but a shard-plane scheduler is only
+        # reachable over HTTP — the split must ride /metrics for a sharded
+        # run to be diagnosable from outside (docs/SHARDING.md
+        # observability; bench.py --shards detail).
+        extra = []
+        for name, val in (
+                ("scheduler_plan_build_seconds_total",
+                 getattr(self, "plan_build_s", 0.0)),
+                ("scheduler_device_wait_seconds_total",
+                 getattr(self, "device_wait_s", 0.0)),
+                ("scheduler_host_commit_seconds_total",
+                 getattr(self, "host_commit_s", 0.0)),
+                ("scheduler_host_path_pods_total",
+                 getattr(self, "host_path_pods", 0)),
+                ("scheduler_device_scheduled_pods_total",
+                 getattr(self, "device_scheduled", 0)),
+                ("scheduler_device_batches_total",
+                 getattr(self, "device_batches", 0)),
+                ("scheduler_state_unwinds_total", self.state_unwinds),
+                ("scheduler_conflict_requeues_total", self.conflict_requeues),
+                ("scheduler_attempts_total", self.attempts)):
+            extra.append(f"# TYPE {name} counter")
+            extra.append(f"{name} {float(val)}")
+        return out + "\n".join(extra) + "\n"
 
     def handle_scheduling_failure(
         self, fw: Framework, qpi: QueuedPodInfo, status: Status, diagnosis: Optional[Diagnosis]

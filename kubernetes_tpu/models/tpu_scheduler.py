@@ -55,13 +55,22 @@ class _SessionDelta:
     shrink patch is parked waiting for the pipeline to drain. One protocol
     (TPUScheduler._note_session_events) mutates it for both session kinds."""
 
-    __slots__ = ("state", "carry", "start_seq", "patch_pending")
+    __slots__ = ("state", "carry", "start_seq", "patch_pending",
+                 "busy_patch_rows")
 
     def __init__(self, state, carry, start_seq):
         self.state = state
         self.carry = carry
         self.start_seq = start_seq
         self.patch_pending = False
+        # Rows patched while the pipeline was BUSY (shard-plane foreign-bind
+        # feed): an in-flight batch may have placed onto one of them after
+        # dispatch, and that placement's aggregate is not in mirror staging
+        # yet — so the patch can understate the row until the batch retires.
+        # The session end charges these rows dirty, and adopt() re-encodes
+        # them from post-commit staging truth; in between, the binding
+        # subresource's capacity re-validation bounds the damage to a 409.
+        self.busy_patch_rows: list = []
 
 
 def _pow2_pad(n: int) -> int:
@@ -473,6 +482,7 @@ class TPUScheduler(Scheduler):
                 pending.remove(pack)
 
         self.cache.update_snapshot(self.snapshot)
+        dirty_rows.extend(sd.busy_patch_rows)  # re-encode busy-patched rows
         if invalidated:
             self.mirror.invalidate()
             self.metrics.batch_cache_flushed.inc("gang_session_invalidated")
@@ -1215,15 +1225,20 @@ class TPUScheduler(Scheduler):
 
     def _classify_delta(self, events, plan):
         """Map journal events to the feature blocks they dirty under `plan`.
-        Returns (level, dirty node names): 'benign' (nothing node-side
-        moved), 'safe' (row patches whose events only enlarge feasibility —
-        in-flight device results stay committable), 'strict' (row patches
-        that may shrink feasibility: only applicable with an empty
-        pipeline) — or None when any event needs the full rebuild."""
+        Returns (level, dirty node names, node_only, pod_only): 'benign'
+        (nothing node-side moved), 'safe' (row patches whose events only
+        enlarge feasibility — in-flight device results stay committable),
+        'strict' (row patches that may shrink feasibility: applicable with
+        an empty pipeline, or while busy when pod_only and the bind path
+        re-validates capacity) — or None when any event needs the full
+        rebuild. node_only/pod_only say whether every dirtying event was a
+        taint/alloc node update resp. a plain-pod row event."""
         from ..core.cache import (EV_NAMESPACE, EV_NODE_UPDATE, EV_POD_ADD,
                                   EV_POD_REMOVE, EV_POD_UPDATE, EV_QUEUE)
         level = 0
         names = set()
+        node_only = True  # every dirtying event is a taint/alloc node update
+        pod_only = True   # every dirtying event is a plain-pod row event
         for ev in events:
             if ev.kind == EV_QUEUE:
                 continue
@@ -1242,14 +1257,16 @@ class TPUScheduler(Scheduler):
                     return None
                 if ev.pod_ports and plan.port_selfblock:
                     return None  # used_ports moved under a port-aware plan
+                node_only = False
             elif ev.kind == EV_NODE_UPDATE:
                 if not plan.pod_local:
                     return None  # honor-policy spread tables read taints
+                pod_only = False
             else:
                 return None
             names.add(ev.key)
             level = max(level, 1 if ev.shrink else 2)
-        return ("benign", "safe", "strict")[level], names
+        return ("benign", "safe", "strict")[level], names, node_only, pod_only
 
     def _note_session_events(self, sd, plan, node_names, busy: bool) -> bool:
         """The ONE journal-consumption protocol both session kinds run at
@@ -1266,18 +1283,50 @@ class TPUScheduler(Scheduler):
         cls = self._classify_delta(events, plan)
         if cls is None:
             return False
-        level, names = cls
+        level, names, node_only, pod_only = cls
         if not names:
             sd.start_seq = self.cluster_event_seq
             sd.patch_pending = False
             return True
         if busy:
-            if level == "strict":
+            if level == "strict" and not (
+                    pod_only and self.bind_capacity_validated):
                 return False  # in-flight results may no longer fit
-            sd.patch_pending = True  # shrink-only: commit in-flight as-is,
-            return True              # patch once the pipeline drains
+            if pod_only and self.bind_capacity_validated:
+                # Strict POD rows under a capacity-validating bind path (the
+                # shard plane): a foreign scheduler's bind may have consumed
+                # room an in-flight result counts on, but the binding
+                # subresource re-validates committed usage per node, so the
+                # worst case is a 409 → conflict requeue — never an
+                # overcommitted node. Patch the carry/state NOW, with the
+                # pipeline still full: draining first (the conservative
+                # deferral below) serializes every shard against its peers'
+                # bind bursts — the ping-pong that held a 2-shard plane
+                # under a 1-shard one. The patched rows are charged dirty
+                # (_SessionDelta.busy_patch_rows) so session-end adoption
+                # re-encodes them from post-commit truth.
+                patched = self._apply_delta_patch(
+                    plan, node_names, names, sd.state, sd.carry,
+                    node_only=node_only)
+                if patched is not None:
+                    sd.state, sd.carry = patched
+                    row_of = self._session_row_of[1]
+                    sd.busy_patch_rows.extend(
+                        row_of[nm] for nm in names if nm in row_of)
+                    sd.start_seq = self.cluster_event_seq
+                    sd.patch_pending = False
+                    self._count_rebuild("delta")
+                    return True
+            # Deferral: commit in-flight as-is, patch once the pipeline
+            # drains — shrink-only ('safe') events only enlarged
+            # feasibility, and a failed busy patch falls back here. Strict
+            # NODE events (taint/alloc shrink) still invalidate above:
+            # nothing re-validates taints at bind time.
+            sd.patch_pending = True
+            return True
         patched = self._apply_delta_patch(
-            plan, node_names, names, sd.state, sd.carry)
+            plan, node_names, names, sd.state, sd.carry,
+            node_only=node_only)
         if patched is None:
             return False
         sd.state, sd.carry = patched
@@ -1286,15 +1335,24 @@ class TPUScheduler(Scheduler):
         self._count_rebuild("delta")
         return True
 
-    def _apply_delta_patch(self, plan, node_names, names, state, carry):
+    def _apply_delta_patch(self, plan, node_names, names, state, carry,
+                           node_only: bool = False):
         """Patch the journal's dirty rows into mirror staging, the resident
         device state, and the session carry. Returns (state, carry) or None
         when the patch can't apply — the caller's full-rebuild fallback
-        recovers from every None."""
+        recovers from every None.
+
+        Mesh sessions patch too, for taint/alloc NODE updates only (the
+        ROADMAP's scoped re-enable): the row scatter and the carry re-eval
+        run through jits pinned to the session's committed shardings
+        (mesh_state_shardings / patch_carry_rows_pinned), so the patched
+        pytrees keep the exact placement the session kernel's traces key on.
+        Pod events still decline under a mesh — their aggregates also ride
+        the adopt/donate seam, which has no sharded variant yet."""
         if not names:
             return state, carry
-        if self.mesh is not None:
-            return None  # sharded states take the full (sharded) path
+        if self.mesh is not None and not node_only:
+            return None  # pod-event patches: full (sharded) rebuild path
         row_of = getattr(self, "_session_row_of", None)
         if row_of is None or row_of[0] is not node_names:
             row_of = (node_names, {n: i for i, n in enumerate(node_names)})
@@ -1306,7 +1364,13 @@ class TPUScheduler(Scheduler):
             if row is None or ni is None or ni.node is None:
                 return None  # row set changed shape: structural after all
             updates.append((row, ni))
-        new_state = self.mirror.patch_rows(updates)
+        if self.mesh is not None:
+            from ..parallel import mesh_state_shardings
+            new_state = self.mirror.patch_rows(
+                updates, sharded_state=state,
+                out_shardings=mesh_state_shardings(self.mesh))
+        else:
+            new_state = self.mirror.patch_rows(updates)
         if new_state is None:
             return None
         rows = sorted({r for r, _ in updates})
@@ -1320,11 +1384,13 @@ class TPUScheduler(Scheduler):
                 return None
         if carry is not None:
             import jax.numpy as jnp
-            from ..ops.features import _pow2
-            from ..ops.kernel import patch_carry_rows
-            tier = _pow2(len(rows), 1)
+            from ..ops.device_state import patch_tier
+            from ..ops.kernel import patch_carry_rows, patch_carry_rows_pinned
+            tier = patch_tier(len(rows))
             prows = rows + [rows[-1]] * (tier - len(rows))
-            carry = patch_carry_rows(
+            patch_fn = (patch_carry_rows_pinned if self.mesh is not None
+                        else patch_carry_rows)
+            carry = patch_fn(
                 new_state, plan.features, carry,
                 jnp.asarray(np.asarray(prows, np.int32)),
                 jnp.asarray(self.mirror.h_req_r[prows]),
@@ -1364,7 +1430,8 @@ class TPUScheduler(Scheduler):
                         # No pipeline is in flight at session start: every
                         # level (benign/safe/strict) may patch here.
                         patched = self._apply_delta_patch(
-                            plan, node_names, cls[1], state, carry)
+                            plan, node_names, cls[1], state, carry,
+                            node_only=cls[2])
                         if patched is not None:
                             state, carry = patched
                             kind = "delta"
@@ -1681,6 +1748,7 @@ class TPUScheduler(Scheduler):
                 pending.remove(batch)
 
         self.cache.update_snapshot(self.snapshot)
+        dirty_rows.extend(sd.busy_patch_rows)  # re-encode busy-patched rows
         if invalidated:
             # The carry charged host-diverged placements; staging is the
             # authority again — force a full re-encode + upload.

@@ -80,6 +80,22 @@ class DeviceNodeState(NamedTuple):
     topo: jnp.ndarray         # [K, NP] i32 per-axis topology value ids (0 = absent)
 
 
+def patch_tier(n: int) -> int:
+    """Dirty-row scatter/patch tiers: {32, 256, pow2 from 2048}. Each
+    distinct padded length is a separate XLA compile of the patch jits
+    (row scatter + carry re-eval), and event-driven patch waves — peer
+    shards' bind bursts above all — arrive in near-arbitrary sizes, so
+    pow2 tiers from 1 put ~10 compiles inside a sharded run's measured
+    window. Padding repeats a real index; duplicate scatter indices write
+    identical values, so a coarse tier is exact (just a few wasted rows
+    of device work)."""
+    if n <= 32:
+        return 32
+    if n <= 256:
+        return 256
+    return _pow2(n, 2048)
+
+
 def _pow2(n: int, floor: int) -> int:
     c = floor
     while c < n:
@@ -111,13 +127,32 @@ class TopoAxis:
         return self.values.lookup(val if val != "" else self._EMPTY_TOKEN)
 
 
-@jax.jit
-def _scatter_rows(state: DeviceNodeState, idx, rows: DeviceNodeState) -> DeviceNodeState:
+def _scatter_rows_impl(state: DeviceNodeState, idx, rows: DeviceNodeState) -> DeviceNodeState:
     """Dirty-row scatter as ONE compiled executable (13 per-array scatters
     fused; a separate jit per array would compile 13 executables per tier)."""
     updated = [arr.at[idx].set(r) for arr, r in zip(state[:-1], rows[:-1])]
     topo = state.topo.at[:, idx].set(rows.topo)
     return DeviceNodeState(*updated, topo)
+
+
+_scatter_rows = jax.jit(_scatter_rows_impl)
+
+# Mesh variant: one jitted scatter per out_shardings pytree (one per mesh —
+# parallel/mesh.py mesh_state_shardings caches the pytree, NamedSharding
+# hashes, so the pytree itself is the cache key).
+_SHARDED_SCATTER_CACHE: dict = {}
+
+
+def _sharded_scatter(out_shardings):
+    """_scatter_rows with explicit out_shardings: a mesh session's state is
+    committed to shard_node_state's placement and the session kernel's jit
+    keys on those input shardings — an unconstrained scatter would hand
+    back GSPMD-chosen placements and retrace the kernel on next dispatch."""
+    fn = _SHARDED_SCATTER_CACHE.get(out_shardings)
+    if fn is None:
+        fn = jax.jit(_scatter_rows_impl, out_shardings=out_shardings)
+        _SHARDED_SCATTER_CACHE[out_shardings] = fn
+    return fn
 
 
 class NodeStateMirror:
@@ -304,17 +339,22 @@ class NodeStateMirror:
             self.h_taint_eff, self.h_unsched, self.h_valid, self.h_name_id,
         )
 
-    def _scatter_dirty(self, dirty) -> DeviceNodeState:
-        """Scatter the given staging rows into the resident device state.
-        Pads to a pow2 tier by repeating the last index (scatter-set with
-        duplicate indices writes the same value), so the jitted scatter
+    def _dirty_payload(self, dirty):
+        """(idx, rows) scatter operands for the given staging rows. Pads to
+        a coarse tier (patch_tier) by repeating the last index (scatter-set
+        with duplicate indices writes the same value), so the jitted scatter
         compiles once per tier, not once per dirty-count."""
-        tier = _pow2(len(dirty), 1)
+        tier = patch_tier(len(dirty))
         dirty = dirty + [dirty[-1]] * (tier - len(dirty))
         idx = jnp.asarray(dirty, jnp.int32)
         rows = DeviceNodeState(
             *[jnp.asarray(a[dirty]) for a in self._arrays()],
             jnp.asarray(self.h_topo[:, dirty]))
+        return idx, rows
+
+    def _scatter_dirty(self, dirty) -> DeviceNodeState:
+        """Scatter the given staging rows into the resident device state."""
+        idx, rows = self._dirty_payload(dirty)
         return _scatter_rows(self._device, idx, rows)
 
     def flush(self) -> DeviceNodeState:
@@ -347,7 +387,8 @@ class NodeStateMirror:
         return self._device
 
 
-    def patch_rows(self, updates) -> Optional[DeviceNodeState]:
+    def patch_rows(self, updates, sharded_state=None,
+                   out_shardings=None) -> Optional[DeviceNodeState]:
         """Event-delta row flush: re-encode the given (row, NodeInfo) pairs
         from the LIVE cache NodeInfos and scatter them into the resident
         device state WITHOUT a snapshot refresh — the journal-driven
@@ -355,7 +396,14 @@ class NodeStateMirror:
         the patched DeviceNodeState, or None when a row patch can't apply
         (no resident device copy / full upload pending, a capacity tier grew
         mid-encode, row out of range or name mismatch) — callers fall back
-        to the full rebuild path, which recovers from every one of those."""
+        to the full rebuild path, which recovers from every one of those.
+
+        Mesh sessions pass `sharded_state` (their mesh-committed state) plus
+        `out_shardings` (parallel/mesh.py mesh_state_shardings): the same
+        dirty rows then also scatter into the sharded copy through a jit
+        pinned to those shardings, and THAT is what's returned — the
+        mirror's own resident (single-device) copy stays patched in line
+        either way, so later unsharded flushes remain incremental."""
         if self._device is None or self._full_flush:
             return None
         # Validate EVERY row before encoding ANY: a late-row guard failure
@@ -375,8 +423,11 @@ class NodeStateMirror:
         except _Regrown:
             return None  # staging reset: next flush rebuilds everything
         dirty = sorted({row for row, _ in updates})
-        self._device = self._scatter_dirty(dirty)
+        idx, rows = self._dirty_payload(dirty)
+        self._device = _scatter_rows(self._device, idx, rows)
         self._dirty.difference_update(dirty)
+        if sharded_state is not None:
+            return _sharded_scatter(out_shardings)(sharded_state, idx, rows)
         return self._device
 
     def invalidate(self) -> None:

@@ -614,6 +614,40 @@ def patch_carry_rows(
         ba=carry.ba.at[idx].set(ba))
 
 
+# One jit per (carry sharding set, statics): a mesh session's carry shardings
+# are stable for the session's lifetime, so this stays a handful of entries.
+_CARRY_PATCH_PINNED_CACHE: dict = {}
+
+
+def patch_carry_rows_pinned(
+    state: DeviceNodeState,
+    f: BatchFeatures,
+    carry: ScanCarry,
+    idx: jnp.ndarray,
+    req_rows: jnp.ndarray,
+    nz_rows: jnp.ndarray,
+    cnt_rows: jnp.ndarray,
+    fit_strategy: int = 0,
+    has_nom: bool = False,
+) -> ScanCarry:
+    """patch_carry_rows with out_shardings pinned to the live carry's OWN
+    committed shardings. A mesh session's chained-carry kernel trace keys on
+    the carry's placement (GSPMD chose it on the first dispatch); the patch
+    must hand back the identical placement or the next dispatch retraces —
+    the exact failure mode that kept mesh sessions on the full-rebuild path
+    (ROADMAP: delta resume under a sharded mesh)."""
+    out = ScanCarry(*[x.sharding for x in carry])
+    key = (out, fit_strategy, has_nom)
+    fn = _CARRY_PATCH_PINNED_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(
+            partial(patch_carry_rows.__wrapped__,
+                    fit_strategy=fit_strategy, has_nom=has_nom),
+            out_shardings=out)
+        _CARRY_PATCH_PINNED_CACHE[key] = fn
+    return fn(state, f, carry, idx, req_rows, nz_rows, cnt_rows)
+
+
 @partial(jax.jit, static_argnames=("batch_pad", "fit_strategy", "vmax",
                                    "has_pns", "has_na_pref",
                                    "port_selfblock", "has_aux"))

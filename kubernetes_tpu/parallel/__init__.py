@@ -14,7 +14,9 @@ TPU-native equivalents here:
 """
 
 from .mesh import (collective_report, make_mesh, make_multihost_mesh,
-                   shard_features, shard_node_state, sharded_schedule_batch)
+                   mesh_state_shardings, shard_features, shard_node_state,
+                   sharded_schedule_batch)
 
 __all__ = ["collective_report", "make_mesh", "make_multihost_mesh",
-           "shard_features", "shard_node_state", "sharded_schedule_batch"]
+           "mesh_state_shardings", "shard_features", "shard_node_state",
+           "sharded_schedule_batch"]

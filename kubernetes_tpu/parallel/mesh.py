@@ -99,6 +99,26 @@ def _feature_specs(axis="nodes") -> BatchFeatures:
 _STATE_SPECS = _state_specs("nodes")
 
 
+_MESH_STATE_SHARDINGS_CACHE: dict = {}
+
+
+def mesh_state_shardings(mesh: Mesh) -> DeviceNodeState:
+    """The NamedShardings shard_node_state commits the state to, as one
+    cached pytree — handed to the delta row patch (ops/device_state.py
+    patch_rows / ops/kernel.py patch_carry_rows_pinned) as explicit
+    `out_shardings`, so a patched state stays committed to the session
+    kernel's input shardings and the next dispatch does not retrace.
+    Cached per mesh: the pytree doubles as the jit-cache key over there."""
+    got = _MESH_STATE_SHARDINGS_CACHE.get(mesh)
+    if got is None:
+        got = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s),
+            _state_specs(_node_axis_of(mesh)),
+            is_leaf=lambda x: isinstance(x, P))
+        _MESH_STATE_SHARDINGS_CACHE[mesh] = got
+    return got
+
+
 def shard_node_state(state: DeviceNodeState, mesh: Mesh) -> DeviceNodeState:
     """Place a cell's node state onto the mesh's node axis (ICI on a
     single-host mesh; ICI within hosts + DCN across hosts on a multi-host

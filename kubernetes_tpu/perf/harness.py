@@ -94,7 +94,11 @@ def load_config(path: str, scale: float = 1.0) -> List[Workload]:
         for wl in tc.get("workloads", ()):
             params = dict(wl.get("params", {}))
             if scale != 1.0:
-                params = {k: max(1, int(v * scale)) if isinstance(v, int) else v
+                # `shards` is topology, not load — scaling it would silently
+                # turn a sharded workload into a single-scheduler one.
+                params = {k: (v if k == "shards"
+                              else max(1, int(v * scale)))
+                          if isinstance(v, int) else v
                           for k, v in params.items()}
             thresholds = {
                 k: v * scale if scale != 1.0 else v
@@ -435,10 +439,61 @@ def _warm_group_shapes(sched, cs, wl: Workload, start_op) -> None:
             warm(pod)
 
 
+def run_sharded_workload(wl: Workload,
+                         n_shards: Optional[int] = None) -> PerfResult:
+    """Run a createNodes/createPods workload through the MULTI-PROCESS shard
+    plane (shard/harness.py): one apiserver process, N scheduler processes,
+    everything over HTTP. The measured window is first-measured-create →
+    all-bound, so the reported pods/s composes shard throughput the way the
+    acceptance criterion counts it (1-shard vs N-shard, same transport)."""
+    from ..shard.harness import run_sharded_cluster
+
+    n_nodes = n_pods = 0
+    node_tpl: Dict[str, Any] = {}
+    pod_tpl: Dict[str, Any] = dict(wl.default_pod_template or {})
+    for op in wl.ops:
+        if op["opcode"] == "createNodes":
+            n_nodes += _resolve_count(op, wl.params)
+            node_tpl = op.get("nodeTemplate", {})
+        elif op["opcode"] == "createPods":
+            n_pods += _resolve_count(op, wl.params)
+            pod_tpl = dict(op.get("podTemplate") or pod_tpl)
+        else:
+            raise ValueError(
+                f"sharded workloads support createNodes/createPods only, "
+                f"got {op['opcode']!r}")
+    shards = int(n_shards or wl.params.get("shards", 2))
+    out = run_sharded_cluster(
+        shards, n_nodes, n_pods,
+        lease_duration=float(wl.params.get("leaseDuration", 3.0)),
+        warm_pods=int(wl.params.get("warmPods", min(256, max(1, n_pods // 8)))),
+        zones=int(node_tpl.get("zones", 50)),
+        node_capacity={"cpu": node_tpl.get("cpu", 32),
+                       "memory": node_tpl.get("memory", "256Gi"),
+                       "pods": node_tpl.get("pods", 110)},
+        pod_request={"cpu": pod_tpl.get("cpu", "100m"),
+                     "memory": pod_tpl.get("memory", "128Mi")})
+    result = PerfResult(workload=wl, scheduled=out["bound"],
+                        failed=0 if out["all_bound"] else 1,
+                        elapsed=out["elapsed_s"])
+    rate = out["pods_per_sec"]
+    result.metrics["SchedulingThroughput"] = {
+        "Average": rate, "Perc50": rate, "Perc90": rate, "Perc95": rate,
+        "Perc99": rate}
+    result.detail = dict(out)
+    return result
+
+
 def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
     """Execute one workload's opcode list (the RunBenchmarkPerfScheduling
     inner loop, scheduler_perf.go:282+)."""
     from ..models.tpu_scheduler import TPUScheduler
+
+    if wl.params.get("shards") and sched is None:
+        # Sharded workloads (ShardedSchedulingBasic) run the multi-process
+        # shard plane — one apiserver + N scheduler processes — rather than
+        # an in-process scheduler loop.
+        return run_sharded_workload(wl)
 
     # Each workload builds a fresh scheduler/framework; proto pods (and their
     # framework-id-keyed signature holders) must not outlive the frameworks

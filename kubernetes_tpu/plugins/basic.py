@@ -161,6 +161,18 @@ class DefaultBinder:
                 try:
                     self.handle.clientset.bind(pod, node_name)
                 except Exception as e:  # noqa: BLE001
+                    if getattr(e, "code", None) == 409:
+                        # Optimistic-binding loss (AlreadyBound /
+                        # OutOfCapacity): another scheduler committed first.
+                        # Tagged so the binding cycle requeues through the
+                        # backoffQ instead of parking the pod as an error.
+                        reason = ""
+                        try:  # the 409 body names which conflict it was
+                            import json as _json
+                            reason = _json.loads(e.read()).get("error", "")
+                        except Exception:  # noqa: BLE001
+                            pass
+                        return Status.bind_conflict(reason or str(e))
                     if dispatcher is not None:
                         from ..core.api_dispatcher import CALL_BINDING
                         dispatcher.errors.append(f"{CALL_BINDING}/{pod.uid}: {e!r}")
@@ -173,11 +185,36 @@ class DefaultBinder:
             dispatcher.add(APICall(
                 call_type=CALL_BINDING, object_uid=pod.uid,
                 execute=lambda: self.handle.clientset.bind(pod, node_name),
+                bind_args=(pod, node_name),
+                # Stable bound method: the dispatcher batches consecutive
+                # binding calls whose bulk_execute is the SAME callable.
+                bulk_execute=self._bulk_bind,
                 on_error=(lambda e, _p=pod: on_error(_p, e))
                 if on_error is not None else None))
         except Exception as e:  # noqa: BLE001
             return Status.error(str(e))
         return OK
+
+    def _bulk_bind(self, calls) -> list:
+        """Commit a run of queued binding calls as ONE bulk request
+        (dispatcher thread worker → clientset.bind_many). Per-bind POSTs
+        cap the async worker far below the server's bind capacity: each
+        round-trip costs a GIL wakeup in a process whose reflector/
+        scheduler threads are busy, so amortizing N binds per wakeup is
+        worth ~an order of magnitude in drain rate. Falls back to per-call
+        binds for clientsets without a bulk verb (FakeClientset)."""
+        cs = self.handle.clientset
+        bind_many = getattr(cs, "bind_many", None)
+        if bind_many is not None:
+            return bind_many([c.bind_args for c in calls])
+        out = []
+        for c in calls:
+            try:
+                cs.bind(*c.bind_args)
+                out.append(None)
+            except Exception as e:  # noqa: BLE001
+                out.append(e)
+        return out
 
 
 class ImageLocality:

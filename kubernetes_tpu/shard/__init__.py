@@ -1,0 +1,27 @@
+"""Scheduler shard plane: optimistic multi-scheduler scale-out.
+
+N scheduler instances run against ONE apiserver, Omega-style (Schwarzkopf
+et al., EuroSys'13): every shard plans against the FULL watch-fed cluster
+state, admission into each shard's queue is partitioned deterministically
+(`partition.py` — PodGroups pinned whole so gang all-or-nothing never spans
+shards), and conflicting commits meet at the binding subresource, where the
+loser's 409 becomes a conflict-driven requeue through the existing backoffQ
+(core/scheduler.py _unwind_binding). Shard liveness rides durable lease
+records renewed through the apiserver (`leases.py`; they ride the WAL, so
+a `kill -9`'d control plane recovers the holder table); an expired shard's
+pod range is adopted by its ring successor (`member.py`) and the PR-2
+reconciliation unwinds anything the dead shard left half-finished.
+
+See docs/SHARDING.md for the protocol and its invariants.
+"""
+
+from .harness import run_sharded_cluster
+from .leases import LEASE_PREFIX, ShardMap, lease_name
+from .member import ShardMember
+from .partition import shard_key, shard_of_key, shard_of_pod
+from .plane import ShardPlane
+
+__all__ = [
+    "LEASE_PREFIX", "ShardMap", "ShardMember", "ShardPlane", "lease_name",
+    "run_sharded_cluster", "shard_key", "shard_of_key", "shard_of_pod",
+]

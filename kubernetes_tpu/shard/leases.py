@@ -1,0 +1,110 @@
+"""ShardMap: the durable lease table behind shard ownership.
+
+One lease record per shard slot (``shard-<i>``), created and renewed
+through the apiserver's ``/api/v1/leases`` surface (core/apiserver.py):
+PUT is acquire-or-renew with holder-CAS semantics, expiry is computed
+SERVER-side against the server's own monotonic clock (shards never compare
+clocks), and every upsert rides the WAL so the holder table survives a
+control-plane ``kill -9``.
+
+Ownership is **possession-by-observation**, in the optimistic spirit of
+the rest of the plane: each member renews only its OWN slot's lease, and
+every refresh recomputes which EXPIRED slots this member is the ring
+successor of. No adoption write exists to race over — if two members
+briefly disagree during a refresh-skew window, both admit the range and
+the binding subresource's 409 resolves every double-schedule. When a dead
+shard returns (same slot, fresh process), its first renewal makes the slot
+alive again and the adopter's next refresh drops the range automatically —
+failback without a handoff protocol.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+LEASE_PREFIX = "shard-"
+
+
+def lease_name(index: int) -> str:
+    return f"{LEASE_PREFIX}{index}"
+
+
+def _slot_of(name: str) -> Optional[int]:
+    if not name.startswith(LEASE_PREFIX):
+        return None
+    try:
+        return int(name[len(LEASE_PREFIX):])
+    except ValueError:
+        return None
+
+
+class ShardMap:
+    """A member's view of the shard lease table + the deterministic
+    ownership rule every member computes identically from it."""
+
+    def __init__(self, clientset, index: int, count: int,
+                 lease_duration: float = 3.0, identity: str = "",
+                 now: Callable[[], float] = time.monotonic):
+        self.cs = clientset
+        self.index = index
+        self.count = count
+        self.lease_duration = lease_duration
+        self.identity = identity or f"scheduler-{lease_name(index)}"
+        self.now = now
+        # Startup grace: a slot with NO lease record yet may just be a peer
+        # that hasn't started; it becomes adoptable only after one full
+        # lease period from OUR start (a crashed peer that did start leaves
+        # an expired record, which is adoptable immediately on expiry).
+        self._vacant_adoptable_at = now() + lease_duration
+        self.last_view: List[dict] = []
+
+    def renew_own(self) -> bool:
+        """Acquire-or-renew this member's own slot; False = CAS loss
+        (another identity holds the slot — a misconfigured twin or a
+        superseding replacement; the member must stop admitting)."""
+        return self.cs.upsert_lease(
+            lease_name(self.index), self.identity, self.lease_duration
+        ) is not None
+
+    def refresh(self) -> List[dict]:
+        self.last_view = [l for l in self.cs.list_leases()
+                          if _slot_of(l["name"]) is not None]
+        return self.last_view
+
+    def compute_owned(self, own_ok: bool) -> Set[int]:
+        """The slots this member owns under the ring-successor rule:
+        its own slot (when its lease holds), plus every expired/vacant slot
+        whose first alive successor (scanning j+1, j+2, … mod count) is this
+        member. Every member computes this from the same server-evaluated
+        lease table, so disagreement is bounded by refresh skew — and any
+        overlap is resolved by bind 409s, not by a coordination protocol."""
+        alive: Set[int] = set()
+        seen: Set[int] = set()
+        for lease in self.last_view:
+            slot = _slot_of(lease["name"])
+            if slot is None or slot >= self.count:
+                continue
+            seen.add(slot)
+            if not lease["expired"]:
+                alive.add(slot)
+        if own_ok:
+            alive.add(self.index)
+        else:
+            alive.discard(self.index)
+        owned: Set[int] = {self.index} if own_ok else set()
+        if not own_ok:
+            return owned
+        vacant_ok = self.now() >= self._vacant_adoptable_at
+        for j in range(self.count):
+            if j in alive or j == self.index:
+                continue
+            if j not in seen and not vacant_ok:
+                continue  # never-started peer, still inside startup grace
+            for k in range(1, self.count + 1):
+                succ = (j + k) % self.count
+                if succ in alive:
+                    if succ == self.index:
+                        owned.add(j)
+                    break
+        return owned

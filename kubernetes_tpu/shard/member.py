@@ -1,0 +1,191 @@
+"""ShardMember: wires one scheduler instance into the shard plane.
+
+Installs the shard-scoped admission predicate (core/scheduler.py
+``pod_admission``), keeps the member's lease alive, and recomputes
+ownership (adopting expired peers' ranges) on the scheduling thread.
+
+Liveness and ownership are deliberately split:
+
+- **Renewal** runs on a small background thread (`start_renewer`): it only
+  PUTs the lease and refreshes the read-only lease view, so it stays alive
+  while the scheduling thread is pinned inside a long drain or an XLA
+  compile — a busy shard must never look dead.
+- **Ownership** (adoption + the pending-pod sweep) mutates the queue, so it
+  runs only from ``tick()`` on the scheduling thread — wired as the
+  scheduler's per-cycle ``loop_hook``, rate-limited internally.
+
+Without a renewer thread, ``tick()`` does both (the in-process/unit shape,
+where clocks are injectable and nothing sleeps).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Set
+
+from .leases import ShardMap
+from .partition import shard_of_pod
+
+
+class ShardMember:
+    def __init__(self, scheduler, index: int, count: int,
+                 lease_duration: float = 3.0,
+                 renew_interval: Optional[float] = None,
+                 identity: str = "",
+                 now: Callable[[], float] = time.monotonic):
+        self.scheduler = scheduler
+        self.index = index
+        self.count = count
+        self.map = ShardMap(scheduler.clientset, index, count,
+                            lease_duration=lease_duration,
+                            identity=identity, now=now)
+        self.identity = self.map.identity
+        self.lease_duration = lease_duration
+        # Renew well inside the lease period: 3 renew chances per duration.
+        self.renew_interval = (renew_interval if renew_interval is not None
+                               else lease_duration / 3.0)
+        self.now = now
+        self._next_tick = 0.0  # first tick runs immediately
+        self._own_ok = False
+        self._renewer: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.owned: Set[int] = {index}
+        self.renewals = 0
+        self.adoptions = 0
+        self.handbacks = 0
+        scheduler.pod_admission = self.admits
+        scheduler.shard_member = self
+        scheduler.loop_hook = self.tick
+        # Shard binds terminate at the binding subresource, which validates
+        # committed per-node usage (409 OutOfCapacity): device sessions may
+        # ride through peer shards' bind events optimistically — but ONLY
+        # when the clientset really has that backstop (HTTPClientset sets
+        # validates_bind_capacity; a FakeClientset member — the unit-test
+        # shape — binds unconditionally, so optimistic in-flight commits
+        # there could silently overcommit a node).
+        scheduler.bind_capacity_validated = bool(getattr(
+            scheduler.clientset, "validates_bind_capacity", False))
+        scheduler.metrics.shard_owned_shards.set(1.0)
+        # Pods that entered the queue BEFORE the admission predicate existed
+        # (informer replay at clientset registration) leave now; their owner
+        # admits them on its own feed.
+        self._purge_unowned()
+
+    # -- admission (the queue-side partition) -------------------------------
+
+    def admits(self, pod) -> bool:
+        return shard_of_pod(pod, self.count) in self.owned
+
+    def _purge_unowned(self) -> int:
+        """Drop queued entities outside this shard's range (gangs leave
+        whole — the partitioner pins them by group key, so one member's
+        verdict is the group's)."""
+        from ..core.queue import QueuedPodGroupInfo, QueuedPodInfo
+
+        q = self.scheduler.queue
+        removed = 0
+        for ent in (list(q.active_q.items()) + list(q.backoff_q.items())
+                    + list(q.unschedulable.values())):
+            if isinstance(ent, QueuedPodInfo) and not self.admits(ent.pod):
+                q.delete(ent.pod)
+                removed += 1
+            elif (isinstance(ent, QueuedPodGroupInfo) and ent.members
+                    and not self.admits(ent.members[0].pod)):
+                for m in list(ent.members):
+                    q.delete(m.pod)
+                removed += 1
+        for members in list(q._group_members.values()):
+            for m in list(members):
+                if not self.admits(m.pod):
+                    q.delete(m.pod)
+                    removed += 1
+        return removed
+
+    # -- liveness (renew) ---------------------------------------------------
+
+    def _renew_once(self) -> None:
+        """One renew + view refresh. HTTP only — safe off-thread; the lease
+        view lands by reference assignment (GIL-atomic), ownership is
+        recomputed from it on the scheduling thread."""
+        try:
+            self._own_ok = self.map.renew_own()
+            if self._own_ok:
+                self.renewals += 1
+                self.scheduler.metrics.shard_lease_renewals.inc()
+            self.map.refresh()
+        except Exception:  # noqa: BLE001 - transient API failure: the lease
+            pass           # simply ages; the next renew attempt catches up
+
+    def start_renewer(self) -> None:
+        """Background renewals: the shard stays visibly alive while the
+        scheduling thread is pinned (long drains, XLA compiles)."""
+        if self._renewer is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.renew_interval):
+                self._renew_once()
+
+        self._renew_once()  # synchronous first acquire (ready-gate)
+        self._renewer = threading.Thread(
+            target=loop, name=f"shard-renew-{self.index}", daemon=True)
+        self._renewer.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._renewer is not None:
+            self._renewer.join(timeout=5)
+            self._renewer = None
+
+    # -- ownership + failover (scheduling thread only) ----------------------
+
+    def tick(self) -> bool:
+        """Rate-limited ownership refresh; wired as the scheduler's
+        per-cycle loop_hook. Renews inline when no renewer thread runs."""
+        now = self.now()
+        if now < self._next_tick:
+            return False
+        self._next_tick = now + self.renew_interval
+        if self._renewer is None:
+            self._renew_once()
+        new_owned = self.map.compute_owned(self._own_ok)
+        grown = new_owned - self.owned
+        shrunk = self.owned - new_owned
+        self.owned = new_owned
+        self.scheduler.metrics.shard_owned_shards.set(float(len(new_owned)))
+        if shrunk:
+            # A dead peer came back (its renewal made the slot alive again):
+            # possession-by-observation hands the range back with no
+            # protocol. Pods of that range already in OUR queue finish
+            # normally; overlap resolves through bind 409s.
+            self.handbacks += len(shrunk)
+        if grown:
+            self.adoptions += len(grown)
+            self.scheduler.metrics.shard_adoptions.inc(value=len(grown))
+            self.sweep_pending()
+        return True
+
+    def sweep_pending(self) -> int:
+        """Adoption sweep: enqueue every pending pod the informer cache
+        holds that the new ownership admits and the queue/cache doesn't
+        already track. This is how a dead shard's range drains — its
+        ASSUMED-but-unbound pods died with its cache (nothing to unwind
+        anywhere else), its BOUND pods are in the store, and everything
+        still pending re-enters here."""
+        s = self.scheduler
+        added = 0
+        for pod in list(s.clientset.pods.values()):
+            if pod.node_name or pod.deletion_ts is not None:
+                continue
+            if not s._responsible_for_pod(pod) or not self.admits(pod):
+                continue
+            if pod.uid in s.cache.pod_states or s.queue.has_entity(pod.uid):
+                continue
+            s.queue.add(pod)
+            added += 1
+        return added
+
+    def lease_view(self) -> List[dict]:
+        """The last-refreshed lease table (debugger dump)."""
+        return list(self.map.last_view)

@@ -1,0 +1,37 @@
+"""Deterministic pod → shard partitioner.
+
+Every scheduler process must compute the SAME answer with no coordination,
+across interpreter runs (Python's builtin ``hash`` is salted per process —
+useless here): crc32 of a stable key, mod the shard count.
+
+PodGroup members are pinned WHOLE to one shard by keying on the group, not
+the pod: gang scheduling is all-or-nothing within one scheduler's cycle
+(schedule_pod_group), so a gang split across shards could deadlock half-
+placed. Composite trees follow the same rule through their leaf groups'
+shared namespace/group keys only when they name the same group; composite
+scheduling across groups remains a single-shard concern — the partitioner
+routes by the pod's own group, and a composite whose leaves hash apart is
+simply owned by whichever shards own its leaves (each schedules only the
+leaves it admits; min-count gating keeps half-trees parked).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+
+def shard_key(pod) -> str:
+    """The stable partition key: the gang's identity when the pod belongs
+    to one (pin the whole group to one shard), else the pod uid."""
+    group = getattr(pod, "pod_group", "")
+    if group:
+        return f"pg:{pod.namespace}/{group}"
+    return pod.uid
+
+
+def shard_of_key(key: str, count: int) -> int:
+    return zlib.crc32(key.encode()) % max(1, count)
+
+
+def shard_of_pod(pod, count: int) -> int:
+    return shard_of_key(shard_key(pod), count)

@@ -1,0 +1,111 @@
+"""ShardPlane: N in-process shard schedulers against one apiserver.
+
+Each shard is a full scheduler stack — its OWN HTTPClientset (reflector
+threads, informer cache, decoded object copies), queue, cache, and device
+sessions — driven by its own thread, so cross-shard interleaving is real
+(watch-feed lag between shards is what makes optimistic conflicts
+possible). The chaos/conflict tests and in-process experiments build this;
+production-shaped scale-out runs one shard per OS process instead
+(``python -m kubernetes_tpu --shard-index i --shard-count n``, see
+shard/harness.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from .member import ShardMember
+
+
+class _ShardHandle:
+    def __init__(self, index: int, scheduler, clientset, member):
+        self.index = index
+        self.scheduler = scheduler
+        self.clientset = clientset  # the raw HTTPClientset (close() target)
+        self.member = member
+        self.errors: List[BaseException] = []
+        self.alive = True
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _run(self) -> None:
+        s = self.scheduler
+        while not self._stop.is_set():
+            try:
+                if self.member is not None:
+                    self.member.tick()
+                if not s.run_until_idle(max_cycles=256):
+                    time.sleep(0.005)
+            except Exception as e:  # noqa: BLE001 - the assertion target
+                self.errors.append(e)
+                return
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"shard-{self.index}", daemon=True)
+        self._thread.start()
+
+    def kill(self) -> None:
+        """Simulated SIGKILL: stop driving and tear the reflectors down —
+        the lease stops renewing, the queue/cache state dies unobserved."""
+        self.alive = False
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self.member is not None:
+            self.member.stop()  # the lease now ages toward expiry
+        close = getattr(self.clientset, "close", None)
+        if close is not None:
+            close()
+
+
+class ShardPlane:
+    def __init__(self, api_url: str, n_shards: int,
+                 lease_duration: float = 2.0,
+                 scheduler_factory: Optional[Callable] = None,
+                 with_members: bool = True):
+        """`scheduler_factory(clientset)` builds one shard's scheduler
+        (default: TPUScheduler, single-device, modest batch). With
+        ``with_members=False`` no admission partition is installed — every
+        shard admits every pod, the deliberate worst case the bind-conflict
+        storm test runs."""
+        from ..core.apiserver import HTTPClientset
+        from ..core.clientset import RetryingClientset
+
+        if scheduler_factory is None:
+            def scheduler_factory(cs):
+                from ..models import TPUScheduler
+                return TPUScheduler(clientset=cs, mesh=None, max_batch=64)
+        self.shards: List[_ShardHandle] = []
+        for i in range(n_shards):
+            http_cs = HTTPClientset(api_url)
+            sched = scheduler_factory(RetryingClientset(http_cs))
+            member = None
+            if with_members:
+                member = ShardMember(sched, i, n_shards,
+                                     lease_duration=lease_duration)
+                member.start_renewer()  # alive through in-thread compiles
+            self.shards.append(_ShardHandle(i, sched, http_cs, member))
+
+    def start(self) -> None:
+        for sh in self.shards:
+            sh.start()
+
+    def kill(self, index: int) -> None:
+        self.shards[index].kill()
+
+    def alive_shards(self) -> List[_ShardHandle]:
+        return [sh for sh in self.shards if sh.alive]
+
+    def errors(self) -> List[BaseException]:
+        return [e for sh in self.shards for e in sh.errors]
+
+    def total(self, attr: str) -> float:
+        return sum(getattr(sh.scheduler, attr, 0) for sh in self.shards)
+
+    def close(self) -> None:
+        for sh in self.shards:
+            if sh.alive:
+                sh.kill()

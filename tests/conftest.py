@@ -17,6 +17,19 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the equivalence/fuzz suites compile many
+# distinct kernel static-combos (each ~0.5–5 s of backend_compile on a small
+# CPU box), and every pytest process — plus every SUBPROCESS the chaos and
+# shard-plane tests spawn — used to pay them all again. The cache is keyed
+# on HLO+flags+compiler version, so hits are exact; a cold cache only costs
+# the first run. Spawned schedulers inherit the env var (jax reads it at
+# import when set) via testing/faults.spawn_ready's environment.
+_JAX_CACHE = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.expanduser("~"), ".cache", "kubernetes-tpu-xla"))
+jax.config.update("jax_compilation_cache_dir", _JAX_CACHE)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 

@@ -292,14 +292,18 @@ def test_metric_name_parity_with_reference():
     assert not missing, f"missing reference series: {sorted(missing)}"
     extra = registered - expected
     # Our additions beyond the reference set (device-path + resilience
-    # series; docs/RESILIENCE.md).
+    # series, docs/RESILIENCE.md; shard-plane series, docs/SHARDING.md).
     assert extra <= {"scheduler_batch_size",
                      "scheduler_podgroup_generated_placements",
                      "scheduler_async_api_call_retries_total",
                      "scheduler_device_path_fallback_total",
                      "scheduler_device_path_breaker_open",
                      "scheduler_plan_rebuild_total",
-                     "scheduler_plan_rebuild_dirty_rows_total"}, extra
+                     "scheduler_plan_rebuild_dirty_rows_total",
+                     "scheduler_bind_conflict_total",
+                     "scheduler_shard_owned_shards",
+                     "scheduler_shard_lease_renewals_total",
+                     "scheduler_shard_adoptions_total"}, extra
 
 
 def test_new_series_populate_during_scheduling():

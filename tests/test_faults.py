@@ -733,6 +733,62 @@ def test_apiserver_kill9_restart_mixed_churn(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# shard-kill failover (PR-5 shard plane acceptance; docs/SHARDING.md)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_shard_kill_adoption_mixed_churn():
+    """SIGKILL one of 3 shard scheduler PROCESSES mid-MixedChurn: its lease
+    ages past expiry unrenewed, the ring successor adopts the dead range
+    (sweeping the informer backlog the dead shard never drained), and the
+    run still binds every pod exactly once — zero lost, zero duplicated.
+    Failover needs no handoff protocol: adoption is recomputed from the
+    server-evaluated lease table, and any transient overlap resolves
+    through the binding subresource's 409s."""
+    from kubernetes_tpu.shard.harness import _call, run_sharded_cluster
+
+    LEASE = 2.0
+    state = {"killed_at": 0.0, "nodes": None, "churn": 0}
+
+    def cb(bound, cluster):
+        if state["nodes"] is None:
+            state["nodes"] = _call(cluster.base, "GET", "/api/v1/nodes")
+        if not cluster.killed:
+            # Kill at the FIRST progress poll: bulk binding commits drain a
+            # 240-pod backlog within ~2 polls, so any bound-count trigger
+            # fires after the victim already finished its range and the
+            # failover would have nothing to adopt. At poll one the pods
+            # are created but shard 1's range is still (mostly) pending —
+            # the range MUST drain through lease expiry + adoption.
+            cluster.kill(1)  # SIGKILL: no goodbye, lease left to expire
+            state["killed_at"] = time.monotonic()
+        # outcome-irrelevant label churn on every poll: live watch traffic
+        # the survivors keep classifying while the failover runs
+        state["churn"] += 1
+        w = dict(state["nodes"][state["churn"] % len(state["nodes"])])
+        w["labels"] = dict(w.get("labels") or {}, churn=str(state["churn"]))
+        _call(cluster.base, "PUT", f"/api/v1/nodes/{w['name']}", w)
+
+    out = run_sharded_cluster(
+        3, 40, 240, lease_duration=LEASE, warm_pods=24,
+        progress_cb=cb, timeout=420.0)
+    assert out["killed_shards"] == [1]
+    # zero lost bindings: the dead shard's range drained through adoption
+    assert out["all_bound"], f"lost bindings: {out}"
+    # zero duplicates: one store object per pod, one node each
+    assert out["distinct_bound_pods"] == 240 + 24
+    # the failover demonstrably ran: a survivor adopted ≥1 expired range
+    # and the two survivors ended up owning all 3 slots between them
+    survivors = out["shard_metrics"]
+    assert sum(m.get("scheduler_shard_adoptions_total", 0)
+               for m in survivors) >= 1, survivors
+    assert sum(m.get("scheduler_shard_owned_shards", 0)
+               for m in survivors) >= 3, survivors
+    assert state["killed_at"] > 0  # the kill actually fired mid-run
+
+
+# ---------------------------------------------------------------------------
 # satellite regressions (ADVICE r5 low items)
 # ---------------------------------------------------------------------------
 

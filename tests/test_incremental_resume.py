@@ -43,14 +43,14 @@ def _pod(name, ns="default", cpu="200m", gates=(), labels=None,
     return b.obj()
 
 
-def _pair(n_nodes=24, max_batch=64, taints=None):
+def _pair(n_nodes=24, max_batch=64, taints=None, mesh=None):
     """(host oracle, device scheduler) over identical clusters. mesh=None:
-    row patches target the single-device resident state — under a sharded
-    mesh the delta path deliberately declines (scattering with fresh host
-    arrays would break the committed input shardings) and falls back to
-    the full rebuild, which these tests are not about."""
+    row patches target the single-device resident state. Under a sharded
+    mesh, taint/alloc NODE updates patch through shardings-pinned jits
+    (TestDeltaResumeUnderMesh) while pod events still decline to the full
+    rebuild (their aggregates also ride the adopt seam)."""
     host = Scheduler(deterministic_ties=True)
-    dev = TPUScheduler(max_batch=max_batch, mesh=None)
+    dev = TPUScheduler(max_batch=max_batch, mesh=mesh)
     taints = taints or {}
     for s in (host, dev):
         for i in range(n_nodes):
@@ -181,6 +181,64 @@ class TestDeltaResumeBetweenSessions:
         _assert_identical(host, dev)
         assert dev.plan_rebuilds_full > full0, (
             "structural event did not fall back to the full rebuild")
+
+
+class TestDeltaResumeUnderMesh:
+    """ROADMAP re-enable (scoped): under a sharded mesh, taint/alloc NODE
+    updates delta-patch the session through jits pinned to the committed
+    shardings (parallel/mesh.py mesh_state_shardings out_shardings on the
+    row scatter, ops/kernel.py patch_carry_rows_pinned on the carry), so
+    multi-chip sessions stop full-rebuilding on every taint churn. Pod
+    events still decline (their aggregates also ride the adopt seam)."""
+
+    def test_taint_updates_take_delta_path_under_mesh(self):
+        from kubernetes_tpu.parallel import make_mesh
+        host, dev = _pair(taints={0: ("dedicated", "infra", "NoSchedule")},
+                          mesh=make_mesh(n_cells=1))
+        assert dev.mesh is not None
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"a-{i}")) for i in range(8)])
+        assert dev.plan_rebuilds_full == 1
+
+        def lift_taint(s):
+            s.clientset.update_node(_node("node-0"))  # fresh object, no taint
+        _both(host, dev, lift_taint)
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"b-{i}")) for i in range(8)])
+
+        def add_taint(s):
+            s.clientset.update_node(
+                _node("node-3", taint=("dedicated", "infra", "NoSchedule")))
+        _both(host, dev, add_taint)
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"c-{i}")) for i in range(8)])
+
+        _assert_identical(host, dev)
+        assert dev.plan_rebuilds_full == 1, (
+            "taint-only node updates forced full rebuilds under the mesh")
+        assert dev.plan_rebuilds_delta >= 2
+        assert dev.host_path_pods == 0
+        assert any(n == "node-0" for n in _assignments(dev).values())
+
+    def test_pod_events_still_decline_under_mesh(self):
+        """A bound-pod delete (pod_remove, delta-patchable single-device)
+        must still take the full-rebuild path under a mesh — and match."""
+        from kubernetes_tpu.parallel import make_mesh
+        host, dev = _pair(mesh=make_mesh(n_cells=1))
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"a-{i}")) for i in range(8)])
+        full0 = dev.plan_rebuilds_full
+
+        def delete_one(s):
+            vs = [p for p in s.clientset.pods.values() if p.node_name]
+            s.clientset.delete_pod(min(vs, key=lambda p: p.name))
+        _both(host, dev, delete_one)
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"b-{i}")) for i in range(8)])
+        _assert_identical(host, dev)
+        assert dev.plan_rebuilds_full > full0, (
+            "pod-event patch applied under a mesh (adopt seam has no "
+            "sharded variant — this must decline)")
 
 
 class TestMidSessionContinuation:

@@ -17,8 +17,19 @@ from __future__ import annotations
 import pathlib
 import re
 
-OPS_DIR = (pathlib.Path(__file__).resolve().parent.parent
-           / "kubernetes_tpu" / "ops")
+_PKG = pathlib.Path(__file__).resolve().parent.parent / "kubernetes_tpu"
+OPS_DIR = _PKG / "ops"
+
+
+def _scanned_files():
+    """Every source whose jnp index producers can reach a device kernel:
+    all of ops/, plus models/tpu_scheduler.py — its session orchestration
+    builds scatter/gather operands too (victim tensors, placement masks,
+    delta-patch row vectors), so the s64/s32 GSPMD miscompile class can
+    regress from there just as well as from ops/."""
+    return sorted(OPS_DIR.glob("*.py")) + [
+        _PKG / "models" / "tpu_scheduler.py"]
+
 
 # (file name, 1-based line of the producer) -> reason. Quantity math that
 # genuinely needs int64 (resource units exceed int32) belongs here, never
@@ -57,7 +68,7 @@ def test_ops_jnp_arange_pins_dtype():
     """Every jnp.arange in ops/ must pass an explicit dtype (bare arange
     defaults to int64 under x64 and these values feed index operands)."""
     bad = []
-    for path in sorted(OPS_DIR.glob("*.py")):
+    for path in _scanned_files():
         src = path.read_text()
         for m in re.finditer(r"jnp\.arange\(", src):
             line = src.count("\n", 0, m.start()) + 1
@@ -77,7 +88,7 @@ def test_ops_argmax_style_producers_cast_int32():
     in the same statement (their int64 default rides into index tuples)."""
     bad = []
     producers = r"jnp\.(argmax|argmin|argsort|nonzero|searchsorted)\("
-    for path in sorted(OPS_DIR.glob("*.py")):
+    for path in _scanned_files():
         src = path.read_text()
         for m in re.finditer(producers, src):
             line = src.count("\n", 0, m.start()) + 1
@@ -98,7 +109,7 @@ def test_ops_scatter_index_asarray_pins_dtype():
     bad = []
     pat = re.compile(r"jnp\.asarray\((?:sorted\()?(?:dirty|rows_idx|prows|"
                      r"dirty_rows|idx)\b[^)]*\)")
-    for path in sorted(OPS_DIR.glob("*.py")):
+    for path in _scanned_files():
         src = path.read_text()
         for m in re.finditer(pat, src):
             line = src.count("\n", 0, m.start()) + 1

@@ -1,0 +1,465 @@
+"""Scheduler shard plane (kubernetes_tpu/shard/): deterministic partition,
+lease CAS + server-side expiry, ring-successor adoption/failback, the
+conflict-driven requeue through the backoffQ, and the 2-shard optimistic
+bind-conflict storm over a real apiserver (Omega-style shared-state
+transactions: the binding subresource 409s the loser, nobody overcommits,
+no pod is dropped). Protocol + invariants: docs/SHARDING.md."""
+
+import json
+import time
+
+from kubernetes_tpu.core import FakeClientset, Scheduler
+from kubernetes_tpu.core.apiserver import APIServer, HTTPClientset
+from kubernetes_tpu.shard import (ShardMap, ShardMember, ShardPlane,
+                                  lease_name, shard_key, shard_of_key,
+                                  shard_of_pod)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _node(name, cpu="8", pods=110):
+    return (make_node().name(name)
+            .capacity({"cpu": cpu, "memory": "32Gi", "pods": pods})
+            .zone(f"z{len(name) % 3}").obj())
+
+
+def _pod(name, cpu="200m", group=""):
+    p = make_pod().name(name).req({"cpu": cpu, "memory": "128Mi"}).obj()
+    if group:
+        p.pod_group = group
+    return p
+
+
+class _FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# partition
+# ---------------------------------------------------------------------------
+
+class TestPartition:
+    def test_deterministic_and_in_range(self):
+        for count in (1, 2, 3, 7):
+            for i in range(50):
+                s = shard_of_key(f"uid-{i}", count)
+                assert 0 <= s < count
+                assert s == shard_of_key(f"uid-{i}", count)  # stable
+
+    def test_spreads_across_shards(self):
+        hits = {shard_of_key(f"uid-{i}", 3) for i in range(64)}
+        assert hits == {0, 1, 2}
+
+    def test_gangs_pin_whole_to_one_shard(self):
+        """PodGroup members key on the group, not the pod uid: gang
+        all-or-nothing must never span shards."""
+        members = [_pod(f"g-{i}", group="train") for i in range(8)]
+        keys = {shard_key(p) for p in members}
+        assert len(keys) == 1
+        shards = {shard_of_pod(p, 3) for p in members}
+        assert len(shards) == 1
+        # non-gang pods key on their own uid
+        a, b = _pod("solo-a"), _pod("solo-b")
+        assert shard_key(a) == a.uid and shard_key(b) == b.uid
+
+
+# ---------------------------------------------------------------------------
+# leases: CAS + expiry (in-process surface; HTTP parity below)
+# ---------------------------------------------------------------------------
+
+class TestLeaseCAS:
+    def test_acquire_renew_conflict_expire_takeover(self):
+        cs = FakeClientset()
+        clock = _FakeClock()
+        cs.lease_now = clock
+        assert cs.upsert_lease("shard-0", "alice", 3.0) is not None
+        assert cs.upsert_lease("shard-0", "alice", 3.0) is not None  # renew
+        assert cs.upsert_lease("shard-0", "bob", 3.0) is None  # CAS loss
+        clock.advance(3.5)  # held lease expires
+        got = cs.upsert_lease("shard-0", "bob", 3.0)
+        assert got is not None and got["holder"] == "bob"
+        assert got["transitions"] == 2  # acquire + takeover
+        view = cs.list_leases()
+        assert view[0]["holder"] == "bob" and not view[0]["expired"]
+
+    def test_http_surface_parity(self):
+        """PUT /api/v1/leases/<name> + GET /api/v1/leases mirror the
+        in-process contract: 409 for a held lease, server-side expiry."""
+        api = APIServer()
+        port = api.serve(0)
+        cs = HTTPClientset(f"http://127.0.0.1:{port}")
+        assert cs.upsert_lease("shard-0", "alice", 30.0) is not None
+        assert cs.upsert_lease("shard-0", "bob", 30.0) is None  # HTTP 409
+        leases = cs.list_leases()
+        assert [l["name"] for l in leases] == ["shard-0"]
+        assert leases[0]["holder"] == "alice"
+        assert api.lease_conflicts == 1
+
+    def test_lease_rides_the_wal(self, tmp_path):
+        """An upserted lease survives an apiserver restart from the same
+        data dir: the holder table recovers, its clock restarted (a live
+        holder renews within one period; a dead one expires on schedule)."""
+        d = str(tmp_path / "wal")
+        api = APIServer(data_dir=d)
+        api.upsert_lease("shard-1", "alice", 15.0)
+        api2 = APIServer(data_dir=d)  # recovery replays snapshot + WAL
+        view = {l["name"]: l for l in api2.list_leases()}
+        assert view["shard-1"]["holder"] == "alice"
+        assert not view["shard-1"]["expired"]
+        assert view["shard-1"]["transitions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# ring-successor ownership (ShardMap.compute_owned)
+# ---------------------------------------------------------------------------
+
+class TestRingOwnership:
+    def _map(self, cs, clock, index, count=3, duration=3.0):
+        m = ShardMap(cs, index, count, lease_duration=duration,
+                     identity=f"m{index}", now=clock)
+        return m
+
+    def test_all_alive_owns_only_own_slot(self):
+        cs, clock = FakeClientset(), _FakeClock()
+        cs.lease_now = clock
+        for i in range(3):
+            cs.upsert_lease(lease_name(i), f"m{i}", 3.0)
+        m1 = self._map(cs, clock, 1)
+        assert m1.renew_own()
+        m1.refresh()
+        assert m1.compute_owned(True) == {1}
+
+    def test_expired_slot_adopted_by_ring_successor_only(self):
+        cs, clock = FakeClientset(), _FakeClock()
+        cs.lease_now = clock
+        for i in range(3):
+            cs.upsert_lease(lease_name(i), f"m{i}", 3.0)
+        clock.advance(2.0)
+        # slots 0 and 2 renew; slot 1's holder died
+        cs.upsert_lease(lease_name(0), "m0", 3.0)
+        cs.upsert_lease(lease_name(2), "m2", 3.0)
+        clock.advance(1.5)  # slot 1 now expired (age 3.5 > 3.0)
+        m0, m2 = self._map(cs, clock, 0), self._map(cs, clock, 2)
+        for m in (m0, m2):
+            assert m.renew_own()
+            m.refresh()
+        # ring successor of 1 is 2 — and ONLY 2
+        assert m2.compute_owned(True) == {2, 1}
+        assert m0.compute_owned(True) == {0}
+
+    def test_failback_on_peer_return(self):
+        cs, clock = FakeClientset(), _FakeClock()
+        cs.lease_now = clock
+        for i in range(2):
+            cs.upsert_lease(lease_name(i), f"m{i}", 3.0)
+        clock.advance(4.0)  # both expired; m1 returns, m0 does not
+        m1 = self._map(cs, clock, 1, count=2)
+        assert m1.renew_own()
+        m1.refresh()
+        assert m1.compute_owned(True) == {1, 0}
+        # dead shard 0 comes back: its renewal makes the slot alive again
+        cs.upsert_lease(lease_name(0), "m0-reborn", 3.0)
+        m1.refresh()
+        assert m1.compute_owned(True) == {1}
+
+    def test_vacant_slot_waits_out_startup_grace(self):
+        """A slot with NO lease record may be a peer that hasn't started:
+        adoptable only after one full lease period from OUR start. A
+        crashed peer that DID start leaves an expired record — adoptable
+        immediately on expiry."""
+        cs, clock = FakeClientset(), _FakeClock()
+        cs.lease_now = clock
+        m0 = self._map(cs, clock, 0, count=2)
+        assert m0.renew_own()
+        m0.refresh()
+        assert m0.compute_owned(True) == {0}  # slot 1 vacant, inside grace
+        clock.advance(3.5)
+        assert m0.renew_own()
+        m0.refresh()
+        assert m0.compute_owned(True) == {0, 1}  # grace elapsed
+
+    def test_own_cas_loss_owns_nothing(self):
+        """A member whose own slot is held by another identity must stop
+        admitting entirely (a superseding replacement took the slot)."""
+        cs, clock = FakeClientset(), _FakeClock()
+        cs.lease_now = clock
+        cs.upsert_lease(lease_name(0), "usurper", 30.0)
+        m0 = self._map(cs, clock, 0, count=2)
+        assert not m0.renew_own()
+        m0.refresh()
+        assert m0.compute_owned(False) == set()
+
+
+# ---------------------------------------------------------------------------
+# ShardMember: admission, adoption sweep, handback (fake clock, no threads)
+# ---------------------------------------------------------------------------
+
+class TestShardMember:
+    def _build(self, count=2, duration=3.0):
+        clock = _FakeClock()
+        cs = FakeClientset()
+        cs.lease_now = clock
+        sched = Scheduler(clientset=cs, deterministic_ties=True)
+        for i in range(8):
+            cs.create_node(_node(f"node-{i}"))
+        member = ShardMember(sched, 0, count, lease_duration=duration,
+                             now=clock)
+        return clock, cs, sched, member
+
+    def test_admission_partitions_the_queue(self):
+        clock, cs, sched, member = self._build()
+        member.tick()
+        pods = [_pod(f"p-{i}") for i in range(24)]
+        mine = [p for p in pods if shard_of_pod(p, 2) == 0]
+        theirs = [p for p in pods if shard_of_pod(p, 2) != 0]
+        assert mine and theirs  # both sides populated
+        for p in pods:
+            cs.create_pod(p)
+        sched.run_until_idle()
+        bound = {p.name for p in cs.pods.values() if p.node_name}
+        assert bound == {p.name for p in mine}
+        assert sched.queue.pending_counts() == (0, 0, 0)  # theirs never entered
+
+    def test_lease_expiry_adoption_sweeps_pending_pods(self):
+        clock, cs, sched, member = self._build()
+        cs.upsert_lease(lease_name(1), "peer", 3.0)  # peer starts...
+        member.tick()
+        pods = [_pod(f"p-{i}") for i in range(24)]
+        for p in pods:
+            cs.create_pod(p)
+        sched.run_until_idle()
+        pending = [p for p in cs.pods.values() if not p.node_name]
+        assert pending  # shard 1's pods wait for their owner
+        clock.advance(4.0)  # ...and dies: lease expires unrenewed
+        assert member.tick()
+        assert member.owned == {0, 1}
+        assert member.adoptions == 1
+        sched.run_until_idle()
+        assert all(p.node_name for p in cs.pods.values())
+        assert sched.metrics.shard_owned_shards.value() == 2.0
+
+    def test_peer_return_hands_range_back(self):
+        clock, cs, sched, member = self._build()
+        cs.upsert_lease(lease_name(1), "peer", 3.0)
+        member.tick()
+        clock.advance(4.0)
+        member.tick()
+        assert member.owned == {0, 1}
+        cs.upsert_lease(lease_name(1), "peer-reborn", 3.0)  # failback
+        clock.advance(member.renew_interval)
+        member.tick()
+        assert member.owned == {0}
+        assert member.handbacks == 1
+
+    def test_purge_unowned_on_join(self):
+        """Pods queued BEFORE the member installed its admission predicate
+        (informer replay) leave the queue at construction."""
+        clock = _FakeClock()
+        cs = FakeClientset()
+        cs.lease_now = clock
+        sched = Scheduler(clientset=cs, deterministic_ties=True)
+        for i in range(4):
+            cs.create_node(_node(f"node-{i}"))
+        pods = [_pod(f"p-{i}") for i in range(16)]
+        for p in pods:
+            cs.create_pod(p)  # all 16 enter the queue: no partition yet
+        member = ShardMember(sched, 0, 2, lease_duration=3.0, now=clock)
+        member.tick()
+        sched.run_until_idle()
+        bound = {p.name for p in cs.pods.values() if p.node_name}
+        assert bound == {p.name for p in pods if shard_of_pod(p, 2) == 0}
+
+
+# ---------------------------------------------------------------------------
+# conflict-driven requeue (deterministic unit seam)
+# ---------------------------------------------------------------------------
+
+class _Conflict409(Exception):
+    code = 409
+
+    def __init__(self, reason):
+        super().__init__(json.dumps({"error": reason}))
+        self._body = json.dumps({"error": reason}).encode()
+
+    def read(self):
+        return self._body
+
+
+class _ConflictOnce:
+    """Clientset decorator: the FIRST bind raises a 409 (another scheduler
+    won the shared state); later binds pass through."""
+
+    def __init__(self, inner, reason="AlreadyBound"):
+        self._inner = inner
+        self._reason = reason
+        self.fired = False
+
+    def bind(self, pod, node_name):
+        if not self.fired:
+            self.fired = True
+            raise _Conflict409(self._reason)
+        return self._inner.bind(pod, node_name)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TestConflictRequeue:
+    def test_bind_409_lands_in_backoffq_and_retries(self):
+        cs = FakeClientset()
+        sched = Scheduler(clientset=_ConflictOnce(cs),
+                          deterministic_ties=True)
+        for i in range(4):
+            cs.create_node(_node(f"node-{i}"))
+        cs.create_pod(_pod("racer"))
+        assert sched.schedule_one()  # first attempt: 409 at bind
+        assert sched.bind_conflicts == 1
+        assert sched.conflict_requeues == 1
+        # straight to the backoffQ — never the unschedulable pool, never an
+        # error-parked failure
+        active, backoff, unsched = sched.queue.pending_counts()
+        assert (active + backoff, unsched) == (1, 0)
+        assert not sched.error_log
+        sched.run_until_idle()  # backoff elapses, retry binds for real
+        assert [p.node_name for p in cs.pods.values()] != [""]
+        assert sched.scheduled == 1
+
+    def test_conflict_metric_classified_by_reason(self):
+        for reason, label in (("AlreadyBound", "already_bound"),
+                              ("OutOfCapacity", "capacity")):
+            cs = FakeClientset()
+            sched = Scheduler(clientset=_ConflictOnce(cs, reason),
+                              deterministic_ties=True)
+            cs.create_node(_node("node-0"))
+            cs.create_pod(_pod("racer"))
+            sched.run_until_idle()
+            assert sched.metrics.bind_conflict_total.value(label) == 1
+
+
+# ---------------------------------------------------------------------------
+# apiserver Omega commit validation (capacity 409)
+# ---------------------------------------------------------------------------
+
+class TestCapacityValidation:
+    def test_overcommitting_bind_409s(self):
+        api = APIServer()
+        port = api.serve(0)
+        cs = HTTPClientset(f"http://127.0.0.1:{port}")
+        cs.create_node(_node("tight", cpu="1"))  # fits five 200m pods
+        pods = [_pod(f"p-{i}") for i in range(6)]
+        for p in pods:
+            cs.create_pod(p)
+        bound = 0
+        conflicts = 0
+        for p in pods:
+            try:
+                cs.bind(p, "tight")
+                bound += 1
+            except Exception as e:  # noqa: BLE001
+                assert getattr(e, "code", None) == 409
+                conflicts += 1
+        assert bound == 5 and conflicts == 1
+        assert api.capacity_conflicts == 1
+        # releasing one pod frees its share for the loser (server-side
+        # store is the truth — local pod copies never mutate over HTTP)
+        victim = next(p for p in api.store.pods.values() if p.node_name)
+        loser = next(p for p in api.store.pods.values() if not p.node_name)
+        cs.delete_pod(victim)
+        cs.bind(loser, "tight")
+        assert api.store.pods[loser.uid].node_name == "tight"
+
+    def test_same_node_bind_replay_is_idempotent(self):
+        """A replayed same-node bind answers 200 (PR 2 contract) and must
+        NOT double-count usage — or replays would eat capacity."""
+        api = APIServer()
+        port = api.serve(0)
+        cs = HTTPClientset(f"http://127.0.0.1:{port}")
+        cs.create_node(_node("tight", cpu="1", pods=5))
+        p = _pod("replayed")
+        cs.create_pod(p)
+        for _ in range(4):
+            cs.bind(p, "tight")  # 1 real + 3 replays
+        assert api._usage["tight"]["pods"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 2-shard bind-conflict storm (no partition: the deliberate worst case)
+# ---------------------------------------------------------------------------
+
+def test_two_shards_racing_identical_pods_storm():
+    """Two full scheduler stacks, NO admission partition, one apiserver:
+    both race the same backlog. Invariants under maximal conflict: every
+    pod bound exactly once, every 409 became a backoffQ requeue (no pod
+    parked as an error), and no node overcommitted."""
+    api = APIServer()
+    port = api.serve(0)
+    url = f"http://127.0.0.1:{port}"
+    seed = HTTPClientset(url)
+    for i in range(12):
+        seed.create_node(_node(f"node-{i}", cpu="8", pods=8))
+    n_pods = 60
+    built = []
+
+    def factory(cs):
+        s = Scheduler(clientset=cs, deterministic_ties=True)
+        # Divergent node-rotation origins: two schedulers with IDENTICAL
+        # views and tie-breaking pick identical nodes, and a double-bind to
+        # the same node is the idempotent replay (200, no conflict). Real
+        # multi-scheduler deployments diverge (list order, rotation, timing)
+        # — model that honestly so the commits genuinely collide.
+        s.next_start_node_index = len(built) * 6
+        built.append(s)
+        return s
+
+    plane = ShardPlane(url, 2, with_members=False,
+                       scheduler_factory=factory)
+    try:
+        plane.start()
+        # Lockstep start: both reflectors must hold the node set BEFORE the
+        # backlog lands, or the first-up shard drains it alone and the race
+        # never happens.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(len(sh.scheduler.cache.nodes) == 12
+                   for sh in plane.shards):
+                break
+            time.sleep(0.02)
+        # waves re-synchronize the race: both shards pop each wave's head
+        # at the same time, so 409s keep happening throughout the run
+        for wave in range(6):
+            for i in range(n_pods // 6):
+                seed.create_pod(_pod(f"racer-{wave * 10 + i}", cpu="500m"))
+            time.sleep(0.05)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if sum(1 for p in api.store.pods.values() if p.node_name) >= n_pods:
+                break
+            time.sleep(0.1)
+        assert not plane.errors(), plane.errors()
+        bound = {p.uid: p.node_name
+                 for p in api.store.pods.values() if p.node_name}
+        assert len(bound) == n_pods, (
+            f"pods dropped under conflict: {len(bound)}/{n_pods}")
+        # both schedulers racing one backlog must actually conflict
+        total_conflicts = api.bind_conflicts + api.capacity_conflicts
+        assert total_conflicts > 0
+        assert plane.total("bind_conflicts") == total_conflicts
+        # every sync-path 409 requeued through the backoffQ, none errored
+        assert plane.total("conflict_requeues") == plane.total("bind_conflicts")
+        for sh in plane.shards:
+            assert not sh.scheduler.error_log, sh.scheduler.error_log
+        # host-oracle overcommit check: per-node committed usage fits
+        for node in api.store.nodes.values():
+            placed = [p for p in api.store.pods.values()
+                      if p.node_name == node.name]
+            assert len(placed) <= node.allocatable.allowed_pod_number
+            assert (sum(p.resource_request().milli_cpu for p in placed)
+                    <= node.allocatable.milli_cpu), node.name
+    finally:
+        plane.close()
