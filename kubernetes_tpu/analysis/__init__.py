@@ -1,0 +1,18 @@
+"""Project invariant analyzer (docs/ANALYSIS.md).
+
+AST-based static analysis for the invariants earlier PRs paid for in
+incidents: index-dtype pinning (s64/s32 GSPMD miscompiles), apiserver/WAL
+lock discipline, jit purity, thread hygiene, metrics discipline. Run it
+with ``python -m kubernetes_tpu.analysis`` (nonzero exit on findings) or
+through the tier-1 wrapper ``tests/test_static_analysis.py``.
+"""
+
+from .allowlist import ALLOWLIST, Allow, validate_allowlist
+from .base import (Checker, Finding, ModuleSource, Report, all_checkers,
+                   analyze, check_source, checker_by_id, register)
+
+__all__ = [
+    "ALLOWLIST", "Allow", "Checker", "Finding", "ModuleSource", "Report",
+    "all_checkers", "analyze", "check_source", "checker_by_id", "register",
+    "validate_allowlist",
+]

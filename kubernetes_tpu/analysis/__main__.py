@@ -1,0 +1,57 @@
+"""CLI: ``python -m kubernetes_tpu.analysis [--json] [--root DIR]
+[--checker ID ...]``.
+
+Scans the package tree (or ``--root``) with every registered checker and
+exits nonzero on any finding OR any stale allowlist entry — so it gates CI
+exactly like the tier-1 wrapper test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from .base import PKG_ROOT, all_checkers, analyze, checker_by_id
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m kubernetes_tpu.analysis")
+    ap.add_argument("--root", default=None,
+                    help="directory tree to scan (default: the installed "
+                         "kubernetes_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--checker", action="append", default=None,
+                    metavar="ID", help="run only the named checker(s)")
+    ap.add_argument("--list-checkers", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for c in all_checkers():
+            print(f"{c.id}: {c.description}")
+        return 0
+
+    checkers = ([checker_by_id(cid) for cid in args.checker]
+                if args.checker else None)
+    root = pathlib.Path(args.root).resolve() if args.root else PKG_ROOT
+    report = analyze(root=root, checkers=checkers)
+
+    if args.as_json:
+        print(json.dumps(report.to_json(), indent=2))
+    else:
+        for f in report.findings:
+            print(str(f))
+        for a in report.unused_allows:
+            print(f"stale allowlist entry: {a.checker}:{a.path}:{a.line} "
+                  f"({a.reason}) — nothing left to suppress, delete it")
+        n = len(report.findings)
+        print(f"{report.files_scanned} files scanned, {n} finding(s), "
+              f"{len(report.suppressed)} suppressed, "
+              f"{len(report.unused_allows)} stale allowlist entr(y/ies)")
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+"""Per-finding allowlist. Policy (docs/ANALYSIS.md):
+
+- an entry names ONE finding — (checker, file, line) — and carries a
+  mandatory one-line reason; entries without a reason fail validation;
+- an entry that no longer suppresses anything is stale and fails the run
+  (the engine reports it in ``unused_allowlist``), so line drift or a fix
+  forces the entry to be updated or deleted, never silently carried;
+- real violations get FIXED, not allowlisted: an entry is only for code
+  that is deliberately, provably exempt from the invariant (e.g. genuine
+  int64 quantity math whose result never indexes a scatter/gather).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class Allow:
+    checker: str  # checker id the entry suppresses
+    path: str     # package-relative path (suffix match, so "ops/kernel.py")
+    line: int     # 1-based line of the finding
+    reason: str   # mandatory: why this site is exempt
+
+    def matches(self, finding) -> bool:
+        return (finding.checker == self.checker
+                and finding.line == self.line
+                and (finding.path == self.path
+                     or finding.path.endswith("/" + self.path)))
+
+
+# The tree currently runs clean: every violation the checkers surfaced was
+# fixed in place (see docs/ANALYSIS.md per-checker incident notes), so no
+# entries are needed. Keep it that way — additions require a reason.
+ALLOWLIST: Tuple[Allow, ...] = ()
+
+
+def validate_allowlist(entries) -> None:
+    for a in entries:
+        if not isinstance(a, Allow):
+            raise TypeError(f"allowlist entry {a!r} is not an Allow")
+        if not a.reason or not a.reason.strip():
+            raise ValueError(
+                f"allowlist entry for {a.checker}:{a.path}:{a.line} has no "
+                "reason — every suppression must say why")

@@ -1,0 +1,253 @@
+"""Static-analysis core: findings, checker registry, engine, allowlist.
+
+The analyzers exist because five PRs of scale-out work accumulated
+invariants that are cheap to violate and expensive to debug (see
+docs/ANALYSIS.md for the incident behind each checker). Every checker is
+AST-based — no string-literal-naive paren matching — and runs over the
+whole ``kubernetes_tpu`` package unless it narrows its own scope.
+
+Contract:
+
+- a checker emits :class:`Finding`s; the engine subtracts allowlisted ones
+  (``allowlist.py`` — every entry carries a mandatory reason) and reports
+  the rest;
+- a stale allowlist entry (nothing left to suppress) is itself a failure:
+  the tree moved, the entry must go;
+- ``python -m kubernetes_tpu.analysis`` exits nonzero on any finding, so
+  the tier-1 wrapper (tests/test_static_analysis.py) gates every PR.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+PKG_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One invariant violation at one site."""
+
+    checker: str   # checker id, e.g. "index-dtype"
+    rule: str      # sub-rule id, e.g. "arange-dtype"
+    path: str      # package-relative posix path (or "<fixture>")
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}/{self.rule}] {self.message}"
+
+
+class ModuleSource:
+    """One parsed source file handed to every checker."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path              # package-relative posix path
+        self.name = path.rsplit("/", 1)[-1]
+        self.source = source
+        self._tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        if self._tree is None and self.parse_error is None:
+            try:
+                self._tree = ast.parse(self.source, filename=self.path)
+            except SyntaxError as e:
+                self.parse_error = e
+        return self._tree
+
+
+class Checker:
+    """Base class: subclasses set ``id``/``description`` and implement
+    ``check``. ``applies_to`` narrows the file scope for the tree scan;
+    ``check_source`` (module-level helper) bypasses it for fixtures."""
+
+    id: str = ""
+    description: str = ""
+
+    def applies_to(self, relpath: str) -> bool:
+        return True
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Type[Checker]] = {}
+
+
+def register(cls: Type[Checker]) -> Type[Checker]:
+    if not cls.id:
+        raise ValueError(f"checker {cls.__name__} has no id")
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate checker id {cls.id!r}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_checkers() -> List[Checker]:
+    # Import the checker modules for their registration side effect.
+    from . import (index_dtype, jit_purity, lock_discipline,  # noqa: F401
+                   metrics_discipline, thread_hygiene)
+    return [cls() for _, cls in sorted(_REGISTRY.items())]
+
+
+def checker_by_id(checker_id: str) -> Checker:
+    all_checkers()  # ensure registration ran
+    return _REGISTRY[checker_id]()
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Tuple[Finding, "object"]] = field(default_factory=list)
+    unused_allows: List["object"] = field(default_factory=list)
+    files_scanned: int = 0
+
+    @property
+    def clean(self) -> bool:
+        # A stale allowlist entry is a failure too: the violation it named
+        # no longer exists, so the entry must be deleted, not carried.
+        return not self.findings and not self.unused_allows
+
+    def to_json(self) -> dict:
+        return {
+            "clean": self.clean,
+            "files_scanned": self.files_scanned,
+            "findings": [
+                {"checker": f.checker, "rule": f.rule, "path": f.path,
+                 "line": f.line, "message": f.message}
+                for f in self.findings],
+            "suppressed": [
+                {"checker": f.checker, "path": f.path, "line": f.line,
+                 "reason": a.reason}
+                for f, a in self.suppressed],
+            "unused_allowlist": [
+                {"checker": a.checker, "path": a.path, "line": a.line,
+                 "reason": a.reason}
+                for a in self.unused_allows],
+        }
+
+
+def iter_sources(root: pathlib.Path) -> List[ModuleSource]:
+    mods = []
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        mods.append(ModuleSource(rel, path.read_text()))
+    return mods
+
+
+def analyze(root: Optional[pathlib.Path] = None,
+            checkers: Optional[Sequence[Checker]] = None,
+            allowlist: Optional[Iterable] = None) -> Report:
+    """Run every (or the given) checker over every ``.py`` under ``root``
+    (default: the installed ``kubernetes_tpu`` package)."""
+    from .allowlist import ALLOWLIST, validate_allowlist
+
+    root = root or PKG_ROOT
+    checkers = list(checkers) if checkers is not None else all_checkers()
+    allows = list(ALLOWLIST if allowlist is None else allowlist)
+    validate_allowlist(allows)
+
+    report = Report()
+    raw: List[Finding] = []
+    for mod in iter_sources(root):
+        report.files_scanned += 1
+        for checker in checkers:
+            if not checker.applies_to(mod.path):
+                continue
+            if mod.tree is None:
+                raw.append(Finding(checker.id, "parse-error", mod.path,
+                                   mod.parse_error.lineno or 0,
+                                   f"syntax error: {mod.parse_error.msg}"))
+                break
+            raw.extend(checker.check(mod))
+
+    used = set()
+    for f in raw:
+        allow = next((a for a in allows if a.matches(f)), None)
+        if allow is not None:
+            used.add(id(allow))
+            report.suppressed.append((f, allow))
+        else:
+            report.findings.append(f)
+    report.unused_allows = [a for a in allows if id(a) not in used]
+    report.findings.sort(key=lambda f: (f.path, f.line, f.checker, f.rule))
+    return report
+
+
+def check_source(checker: Checker, source: str,
+                 path: str = "<fixture>") -> List[Finding]:
+    """Run one checker on raw source — the self-test fixture seam. Bypasses
+    ``applies_to`` and the allowlist."""
+    mod = ModuleSource(path, source)
+    if mod.tree is None:
+        raise mod.parse_error
+    return checker.check(mod)
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def attr_chain(node: ast.AST) -> List[str]:
+    """``a.b.c`` -> ["a", "b", "c"]; empty list when the base is not a
+    plain name (e.g. a call result)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def nearest_statement(parents: Dict[ast.AST, ast.AST],
+                      node: ast.AST) -> Optional[ast.stmt]:
+    while node is not None and not isinstance(node, ast.stmt):
+        node = parents.get(node)
+    return node
+
+
+def build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    return parents
+
+
+def statement_unit(stmt: ast.stmt) -> List[ast.AST]:
+    """The nodes that belong to `stmt` itself: for a simple statement the
+    whole subtree, for a compound statement only its header expressions
+    (test/iter/items/...), never the nested bodies — those are their own
+    statements. This is the AST replacement for the old guard's "statement
+    text" scan, immune to strings/comments containing parens."""
+    compound_body_fields = ("body", "orelse", "finalbody", "handlers")
+    if not any(hasattr(stmt, f) for f in compound_body_fields):
+        return list(ast.walk(stmt))
+    nodes: List[ast.AST] = [stmt]
+    for name, value in ast.iter_fields(stmt):
+        if name in compound_body_fields:
+            continue
+        nodes.extend(_walk_value(value))
+    return nodes
+
+
+def _walk_value(value) -> Iterable[ast.AST]:
+    if isinstance(value, ast.AST):
+        yield from ast.walk(value)
+    elif isinstance(value, list):
+        for item in value:
+            if isinstance(item, ast.AST):
+                yield from ast.walk(item)

@@ -1,0 +1,98 @@
+"""index-dtype checker: the s64/s32 GSPMD miscompile class (PR 2/PR 3).
+
+Incident: under x64, a bare ``jnp.arange`` (or any index producer
+defaulting to int64) fed into scatter/gather index tuples mixes s64
+indices with the GSPMD partitioner's s32 offset math; this environment's
+XLA miscompiles the comparison ("compare(s64, s32) after
+spmd-partitioning"). PR 2 pinned every index producer in ops/ to int32 and
+added a regex guard; this checker is the AST upgrade — immune to parens in
+strings/comments — and extends the scan from ops/ + models/ to the whole
+package (delta-patch row vectors, shard bookkeeping, and mesh code all
+build index operands too).
+
+Rules:
+
+- ``arange-dtype``: every ``jnp.arange(...)`` passes an explicit ``dtype=``;
+- ``argmax-cast``: argmax/argmin/argsort/nonzero/searchsorted results are
+  cast to int32 within the same statement;
+- ``asarray-index-dtype``: ``jnp.asarray`` of an index-named vector
+  (idx/rows/dirty/...) pins int32 in the call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import (Checker, Finding, ModuleSource, attr_chain, build_parents,
+                   nearest_statement, register, statement_unit)
+
+ARG_PRODUCERS = ("argmax", "argmin", "argsort", "nonzero", "searchsorted")
+INDEXY_NAMES = ("idx", "rows", "dirty", "rows_idx", "prows", "dirty_rows")
+
+
+def _is_jnp_call(call: ast.Call, attr: str) -> bool:
+    chain = attr_chain(call.func)
+    return (len(chain) >= 2 and chain[-1] == attr
+            and (chain[-2] == "jnp" or chain[-3:-1] == ["jax", "numpy"]))
+
+
+def _mentions_int32(nodes) -> bool:
+    for n in nodes:
+        if isinstance(n, ast.Attribute) and n.attr == "int32":
+            return True
+        if isinstance(n, ast.Name) and n.id == "int32":
+            return True
+        if isinstance(n, ast.Constant) and n.value == "int32":
+            return True
+    return False
+
+
+@register
+class IndexDtypeChecker(Checker):
+    id = "index-dtype"
+    description = ("jnp index producers must pin int32 (s64/s32 GSPMD "
+                   "miscompile class)")
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        out: List[Finding] = []
+        tree = mod.tree
+        parents = build_parents(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_jnp_call(node, "arange"):
+                if not any(kw.arg == "dtype" for kw in node.keywords):
+                    out.append(Finding(
+                        self.id, "arange-dtype", mod.path, node.lineno,
+                        "jnp.arange without an explicit dtype (defaults to "
+                        "int64 under x64; pin int32 for index producers)"))
+                continue
+            for prod in ARG_PRODUCERS:
+                if _is_jnp_call(node, prod):
+                    stmt = nearest_statement(parents, node)
+                    unit = statement_unit(stmt) if stmt is not None else [node]
+                    if not _mentions_int32(unit):
+                        out.append(Finding(
+                            self.id, "argmax-cast", mod.path, node.lineno,
+                            f"jnp.{prod} without an int32 cast in the same "
+                            "statement (int64 default rides into index "
+                            "tuples)"))
+                    break
+            else:
+                if _is_jnp_call(node, "asarray") and node.args:
+                    first = node.args[0]
+                    # sorted(<name>) wrapping keeps the index-vector shape
+                    if (isinstance(first, ast.Call)
+                            and isinstance(first.func, ast.Name)
+                            and first.func.id == "sorted" and first.args):
+                        first = first.args[0]
+                    if (isinstance(first, ast.Name)
+                            and first.id in INDEXY_NAMES
+                            and not _mentions_int32(ast.walk(node))):
+                        out.append(Finding(
+                            self.id, "asarray-index-dtype", mod.path,
+                            node.lineno,
+                            f"jnp.asarray({first.id}, ...) builds an index "
+                            "vector without an explicit int32 dtype"))
+        return out

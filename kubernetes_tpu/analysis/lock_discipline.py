@@ -1,0 +1,173 @@
+"""lock-discipline checker: the apiserver/WAL locking contract (PR 2/PR 5).
+
+Incidents this encodes (docs/ANALYSIS.md):
+
+- PR 2 serialized all mutating verbs under one server write lock after
+  check-then-act races (double bind, duplicate create) and made the WAL
+  append happen under the broadcast lock BEFORE watcher fanout, so an
+  event a watcher saw is always recoverable;
+- the same PR deliberately moved request-body reads OUTSIDE the write
+  lock — a stalled sender must not wedge the whole write plane.
+
+Rules (scoped to core/apiserver.py + core/wal.py):
+
+- ``verb-write-lock``: every mutating HTTP verb handler (do_POST/do_PUT/
+  do_DELETE) either takes ``_write_lock`` itself or only delegates to a
+  method that does;
+- ``wal-under-broadcast-lock``: every ``persistence.append(...)`` is
+  lexically inside a ``with ..._lock:`` region;
+- ``wal-before-fanout``: in a function that both WAL-appends and fans out
+  to ``_watchers``, the append precedes the fanout loop and the fanout
+  itself runs under the broadcast lock;
+- ``no-blocking-read-under-lock``: no blocking socket/request read
+  (``_read_body``, ``rfile.read``, ``recv``, ``accept``, ``readline``,
+  ``getresponse``, ``urlopen``) happens while any lock is held.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from .base import Checker, Finding, ModuleSource, attr_chain, register
+
+MUTATING_VERBS = ("do_POST", "do_PUT", "do_DELETE")
+BLOCKING_READ_ATTRS = {"_read_body", "recv", "recv_into", "accept",
+                       "readline", "getresponse", "urlopen"}
+
+
+def _lock_name(expr: ast.AST) -> Optional[str]:
+    """The lock attribute a `with` item acquires, e.g. '_write_lock' for
+    `with server._write_lock:`. Only attr/name endings in 'lock' count."""
+    chain = attr_chain(expr)
+    if chain and chain[-1].endswith("lock"):
+        return chain[-1]
+    return None
+
+
+class _FunctionScan:
+    def __init__(self, fn: ast.FunctionDef):
+        self.fn = fn
+        self.acquires: Set[str] = set()          # lock attrs taken directly
+        self.calls: Set[str] = set()             # callee terminal names
+        # (lineno, locks_held) per interesting site:
+        self.wal_appends: List[Tuple[int, Tuple[str, ...]]] = []
+        self.fanouts: List[Tuple[int, Tuple[str, ...]]] = []
+        self.blocking_reads: List[Tuple[int, Tuple[str, ...], str]] = []
+        self._walk(fn, ())
+
+    def _walk(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _visit(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.With):
+            # Uniform handling wherever the With appears — including as the
+            # DIRECT first statement of an outer With's body (a nested
+            # `with self._write_lock: with self._lock:` must hold both).
+            inner = held
+            for item in node.items:
+                lock = _lock_name(item.context_expr)
+                if lock is not None:
+                    self.acquires.add(lock)
+                    inner = inner + (lock,)
+                for sub in ast.walk(item.context_expr):
+                    self._classify(sub, held)
+            for stmt in node.body:
+                self._visit(stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are their own scan
+        self._classify(node, held)
+        self._walk(node, held)
+
+    def _classify(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.For):
+            for sub in ast.walk(node.iter):
+                if ((isinstance(sub, ast.Attribute) and sub.attr == "_watchers")
+                        or (isinstance(sub, ast.Name) and sub.id == "_watchers")):
+                    self.fanouts.append((node.lineno, held))
+        if not isinstance(node, ast.Call):
+            return
+        chain = attr_chain(node.func)
+        if chain:
+            self.calls.add(chain[-1])
+        if len(chain) >= 2 and chain[-1] == "append" and chain[-2] == "persistence":
+            self.wal_appends.append((node.lineno, held))
+        if chain and chain[-1] in BLOCKING_READ_ATTRS and held:
+            self.blocking_reads.append((node.lineno, held, chain[-1]))
+        # rfile.read is a request-body read even though 'read' is generic
+        if (len(chain) >= 2 and chain[-1] == "read" and chain[-2] == "rfile"
+                and held):
+            self.blocking_reads.append((node.lineno, held, "rfile.read"))
+
+
+@register
+class LockDisciplineChecker(Checker):
+    id = "lock-discipline"
+    description = ("apiserver/WAL locking contract: write-lock on mutating "
+                   "verbs, WAL append under the broadcast lock before "
+                   "fanout, no blocking reads under a held lock")
+
+    SCOPE = ("core/apiserver.py", "core/wal.py")
+
+    def applies_to(self, relpath: str) -> bool:
+        return any(relpath == p or relpath.endswith("/" + p)
+                   for p in self.SCOPE)
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        out: List[Finding] = []
+        fns: List[ast.FunctionDef] = [
+            n for n in ast.walk(mod.tree)
+            if isinstance(n, ast.FunctionDef)]
+        # One scan PER DEF, not per name: the same file defines e.g.
+        # upsert_lease on both APIServer (locks) and HTTPClientset (a REST
+        # call) — keying by name would silently drop one of them.
+        scans: List[_FunctionScan] = [_FunctionScan(fn) for fn in fns]
+
+        # Functions that serialize on the write lock themselves — a verb
+        # handler may delegate to one instead of taking the lock inline
+        # (do_PUT's lease path delegates to upsert_lease, which CAS-es
+        # under the write lock; wrapping it twice would deadlock).
+        # Name-level: delegation is resolved by callee name.
+        serializers = {s.fn.name for s in scans
+                       if "_write_lock" in s.acquires}
+
+        for fn, scan in zip(fns, scans):
+            if fn.name in MUTATING_VERBS:
+                if ("_write_lock" not in scan.acquires
+                        and not (scan.calls & serializers)):
+                    out.append(Finding(
+                        self.id, "verb-write-lock", mod.path, fn.lineno,
+                        f"mutating verb handler {fn.name} neither takes "
+                        "_write_lock nor delegates to a method that does "
+                        "(check-then-act races: double bind, dup create)"))
+            for lineno, held in scan.wal_appends:
+                if not any(lock == "_lock" for lock in held):
+                    out.append(Finding(
+                        self.id, "wal-under-broadcast-lock", mod.path, lineno,
+                        "persistence.append outside a `with ..._lock:` "
+                        "region — a fanned-out event could be lost on crash"))
+            if scan.wal_appends and scan.fanouts:
+                first_fanout = min(l for l, _ in scan.fanouts)
+                first_append = min(l for l, _ in scan.wal_appends)
+                if first_append > first_fanout:
+                    out.append(Finding(
+                        self.id, "wal-before-fanout", mod.path, first_fanout,
+                        f"watcher fanout in {fn.name} precedes the WAL "
+                        "append — an event a watcher saw must already be "
+                        "durable"))
+                for lineno, held in scan.fanouts:
+                    if not any(lock == "_lock" for lock in held):
+                        out.append(Finding(
+                            self.id, "wal-before-fanout", mod.path, lineno,
+                            f"watcher fanout in {fn.name} outside the "
+                            "broadcast lock — events could interleave with "
+                            "backlog/WAL ordering"))
+            for lineno, held, what in scan.blocking_reads:
+                out.append(Finding(
+                    self.id, "no-blocking-read-under-lock", mod.path, lineno,
+                    f"blocking read ({what}) under held lock(s) "
+                    f"{'/'.join(held)} — a stalled sender wedges every "
+                    "writer (PR 2 keeps body reads outside the write lock)"))
+        return out

@@ -1,0 +1,222 @@
+"""metrics-discipline checker: every series used is declared, correctly.
+
+Incident class (PR 3/PR 5 satellites): new subsystems wired counters into
+hot paths and the metrics-parity test only caught them when someone
+remembered to extend its allowlist — an attribute typo (`metrics.X.inc`
+for an undeclared X) raises AttributeError at RUNTIME, on the first hit
+of a path that tests may never drive (e.g. a failover branch). Label
+mistakes are worse: a wrong positional count silently mis-keys the series
+(`inc("a")` on a 2-label counter buckets under a truncated key).
+
+Rules (usages matched: ``<...>.metrics.<attr>.inc/observe/set(...)``,
+bare ``metrics.<attr>...``, and simple aliases — ``m = self.metrics`` /
+``pet = self.metrics.plugin_evaluation_total`` — resolved through the
+enclosing function scopes; declarations parsed from
+``core/metrics.py SchedulerMetrics.__init__``):
+
+- ``undeclared-metric``: the attribute is not declared in core/metrics.py;
+- ``metric-verb-mismatch``: ``inc`` on a non-Counter, ``observe`` on a
+  non-Histogram, ``set`` on a non-Gauge;
+- ``label-arity``: the positional argument count at the call site does not
+  match the declared label tuple (inc takes exactly the labels; observe/
+  set take value-then-labels);
+- ``label-cardinality``: a series declares more than MAX_LABELS label
+  dimensions (cardinality explodes multiplicatively; the reference's
+  worst series carries 3).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from .base import (PKG_ROOT, Checker, Finding, ModuleSource, attr_chain,
+                   build_parents, register)
+
+MAX_LABELS = 3
+VERB_TO_KIND = {"inc": "Counter", "observe": "Histogram", "set": "Gauge"}
+METRIC_CLASSES = ("Counter", "Gauge", "Histogram")
+METRICS_MODULE = "core/metrics.py"
+
+
+@dataclass(frozen=True)
+class Declaration:
+    attr: str
+    kind: str                               # Counter | Gauge | Histogram
+    series: Optional[str]                   # prometheus name, if constant
+    labels: Optional[Tuple[str, ...]]       # None = not statically known
+    line: int
+
+
+def parse_declarations(source: str) -> Dict[str, Declaration]:
+    """``self.<attr> = r(Counter(name, help, (labels...)))`` assignments in
+    SchedulerMetrics.__init__ (the registration wrapper ``r``/``register``
+    is unwrapped)."""
+    decls: Dict[str, Declaration] = {}
+    tree = ast.parse(source)
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name == "SchedulerMetrics"):
+            continue
+        init = next((f for f in cls.body if isinstance(f, ast.FunctionDef)
+                     and f.name == "__init__"), None)
+        if init is None:
+            continue
+        for node in ast.walk(init):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)):
+                continue
+            value = node.value
+            # unwrap r(...) / self.registry.register(...)
+            if (isinstance(value, ast.Call) and value.args
+                    and attr_chain(value.func)[-1:] in (["r"], ["register"])):
+                value = value.args[0]
+            if not isinstance(value, ast.Call):
+                continue
+            chain = attr_chain(value.func)
+            if not chain or chain[-1] not in METRIC_CLASSES:
+                continue
+            series = (value.args[0].value
+                      if value.args and isinstance(value.args[0], ast.Constant)
+                      else None)
+            labels: Optional[Tuple[str, ...]] = ()
+            label_node = None
+            if len(value.args) >= 3:
+                label_node = value.args[2]
+            for kw in value.keywords:
+                if kw.arg == "label_names":
+                    label_node = kw.value
+            if label_node is not None:
+                if (isinstance(label_node, (ast.Tuple, ast.List))
+                        and all(isinstance(e, ast.Constant)
+                                for e in label_node.elts)):
+                    labels = tuple(e.value for e in label_node.elts)
+                else:
+                    labels = None  # dynamic; arity not statically checkable
+            decls[node.targets[0].attr] = Declaration(
+                attr=node.targets[0].attr, kind=chain[-1], series=series,
+                labels=labels, line=node.lineno)
+    return decls
+
+
+def _scope_aliases(fn: ast.AST) -> Tuple[Set[str], Dict[str, str]]:
+    """Aliases bound by simple assignment anywhere under `fn` (nested defs
+    read them by closure): names bound to a metrics OBJECT and names bound
+    to one declared metric."""
+    obj_aliases: Set[str] = set()
+    metric_aliases: Dict[str, str] = {}
+    # Module scope: only module-LEVEL assignments define module aliases —
+    # walking the whole tree would leak every function's locals into it.
+    nodes = (ast.iter_child_nodes(fn) if isinstance(fn, ast.Module)
+             else ast.walk(fn))
+    for node in nodes:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        chain = attr_chain(node.value)
+        if not chain:
+            continue
+        if chain[-1] == "metrics":
+            obj_aliases.add(node.targets[0].id)
+        elif len(chain) >= 2 and chain[-2] == "metrics":
+            metric_aliases[node.targets[0].id] = chain[-1]
+    return obj_aliases, metric_aliases
+
+
+@register
+class MetricsDisciplineChecker(Checker):
+    id = "metrics-discipline"
+    description = ("every metrics.<attr>.inc/observe/set call targets a "
+                   "series declared in core/metrics.py with matching verb "
+                   "and label arity; declarations stay under the label-"
+                   "cardinality bound")
+
+    def __init__(self, declarations: Optional[Dict[str, Declaration]] = None):
+        self._decls = declarations
+
+    @property
+    def declarations(self) -> Dict[str, Declaration]:
+        if self._decls is None:
+            self._decls = parse_declarations(
+                (PKG_ROOT / METRICS_MODULE).read_text())
+        return self._decls
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        out: List[Finding] = []
+        if mod.path.endswith(METRICS_MODULE) or mod.path == "metrics.py":
+            # Declaration-side rule: the cardinality bound.
+            for d in parse_declarations(mod.source).values():
+                if d.labels is not None and len(d.labels) > MAX_LABELS:
+                    out.append(Finding(
+                        self.id, "label-cardinality", mod.path, d.line,
+                        f"series {d.series or d.attr} declares "
+                        f"{len(d.labels)} label dimensions (bound: "
+                        f"{MAX_LABELS}) — cardinality multiplies per "
+                        "dimension"))
+            return out
+        decls = self.declarations
+        parents = build_parents(mod.tree)
+        alias_cache: Dict[ast.AST, Tuple[Set[str], Dict[str, str]]] = {}
+
+        def resolve_attr(call: ast.Call) -> Optional[str]:
+            base = call.func.value
+            if isinstance(base, ast.Attribute):
+                root = attr_chain(base.value)
+                if root and root[-1] == "metrics":
+                    return base.attr
+            # Alias forms, nearest enclosing function scope first.
+            scope: Optional[ast.AST] = parents.get(call)
+            while scope is not None:
+                if isinstance(scope, (ast.FunctionDef, ast.Module)):
+                    if scope not in alias_cache:
+                        alias_cache[scope] = _scope_aliases(scope)
+                    obj_aliases, metric_aliases = alias_cache[scope]
+                    if isinstance(base, ast.Attribute):
+                        root = attr_chain(base.value)
+                        if root and root[-1] in obj_aliases:
+                            return base.attr
+                    elif (isinstance(base, ast.Name)
+                          and base.id in metric_aliases):
+                        return metric_aliases[base.id]
+                scope = parents.get(scope)
+            return None
+
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in VERB_TO_KIND):
+                continue
+            metric_attr = resolve_attr(node)
+            if metric_attr is None:
+                continue
+            verb = node.func.attr
+            decl = decls.get(metric_attr)
+            if decl is None:
+                out.append(Finding(
+                    self.id, "undeclared-metric", mod.path, node.lineno,
+                    f"metrics.{metric_attr}.{verb}(...) targets a series "
+                    "not declared in core/metrics.py SchedulerMetrics — "
+                    "AttributeError on first hit of this path"))
+                continue
+            if VERB_TO_KIND[verb] != decl.kind:
+                out.append(Finding(
+                    self.id, "metric-verb-mismatch", mod.path, node.lineno,
+                    f"metrics.{metric_attr} is a {decl.kind} but is called "
+                    f"with .{verb}() ({VERB_TO_KIND[verb]} verb)"))
+                continue
+            if decl.labels is None:
+                continue
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                continue  # *labels splat: arity not statically known
+            npos = len(node.args)
+            expected = (len(decl.labels) if verb == "inc"
+                        else 1 + len(decl.labels))
+            if npos != expected:
+                shape = ("(*labels)" if verb == "inc" else "(value, *labels)")
+                out.append(Finding(
+                    self.id, "label-arity", mod.path, node.lineno,
+                    f"metrics.{metric_attr}.{verb}{shape} declared with "
+                    f"labels {decl.labels!r} expects {expected} positional "
+                    f"arg(s), call passes {npos} — mis-keyed series"))
+        return out

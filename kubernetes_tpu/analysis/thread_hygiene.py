@@ -1,0 +1,108 @@
+"""thread-hygiene checker: no thread may outlive shutdown unnoticed.
+
+Incident (PR 5): the apiserver's pooled keep-alive connections kept DEAD
+server handler threads alive across a restart-in-place — the port could
+not rebind for >20s and the restarted process served stale state. The
+general invariant since PR 1's chaos suite: every ``threading.Thread``
+this package starts is either a daemon (dies with the process, by
+declaration) or provably joined in a shutdown path in the same module.
+
+Rule ``daemon-or-joined``: a ``threading.Thread(...)`` construction must
+pass ``daemon=True``, or the object it is bound to must have ``.join(``
+called somewhere in the module (the shutdown path). An unbound,
+non-daemon ``Thread(...).start()`` is always a finding — nothing can ever
+join it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .base import (Checker, Finding, ModuleSource, attr_chain, build_parents,
+                   register)
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    chain = attr_chain(call.func)
+    return (chain[-2:] == ["threading", "Thread"]
+            or chain == ["Thread"])
+
+
+def _bound_name(parents, call: ast.Call) -> Optional[str]:
+    """The terminal name the Thread object is assigned to: 'x' for
+    `x = Thread(...)`, '_thread' for `self._thread = Thread(...)`; None
+    when the object is not bound (e.g. `Thread(...).start()`)."""
+    parent = parents.get(call)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        t = parent.targets[0]
+        if isinstance(t, ast.Name):
+            return t.id
+        if isinstance(t, ast.Attribute):
+            return t.attr
+    return None
+
+
+@register
+class ThreadHygieneChecker(Checker):
+    id = "thread-hygiene"
+    description = ("every threading.Thread is daemon=True or joined in a "
+                   "shutdown path in the same module")
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        out: List[Finding] = []
+        tree = mod.tree
+        parents = build_parents(tree)
+        joined: Set[str] = set()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"):
+                chain = attr_chain(node.func.value)
+                if chain:
+                    joined.add(chain[-1])
+                # `for t in self._threads: t.join()` — the loop variable's
+                # iterable names the real container; credit both.
+                stmt = parents.get(node)
+                while stmt is not None and not isinstance(stmt, ast.For):
+                    stmt = parents.get(stmt)
+                if isinstance(stmt, ast.For):
+                    it = attr_chain(stmt.iter)
+                    if it:
+                        joined.add(it[-1])
+        appended_to: Set[str] = set()  # thread appended to a joined list
+        credited_ctors: Set[int] = set()  # inline Thread() in such an append
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append"):
+                container = attr_chain(node.func.value)
+                if container and container[-1] in joined:
+                    for arg in node.args:
+                        chain = attr_chain(arg)
+                        if chain:
+                            appended_to.add(chain[-1])
+                        elif isinstance(arg, ast.Call) and _is_thread_ctor(arg):
+                            # threads.append(Thread(...)) in one line
+                            credited_ctors.add(id(arg))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                continue
+            daemon = next((kw for kw in node.keywords if kw.arg == "daemon"),
+                          None)
+            if (daemon is not None and isinstance(daemon.value, ast.Constant)
+                    and daemon.value.value is True):
+                continue
+            if id(node) in credited_ctors:
+                continue
+            name = _bound_name(parents, node)
+            if name is not None and (name in joined or name in appended_to):
+                continue
+            what = (f"thread bound to {name!r}" if name
+                    else "unbound Thread(...)")
+            out.append(Finding(
+                self.id, "daemon-or-joined", mod.path, node.lineno,
+                f"{what} is neither daemon=True nor joined in this module "
+                "— it can outlive shutdown and serve dead state (PR 5 "
+                "restart-in-place incident)"))
+        return out

@@ -1,0 +1,206 @@
+"""Runtime lock-order watchdog: the dynamic half of the lock-discipline
+story (static half: ``kubernetes_tpu.analysis.lock_discipline``).
+
+Wrap the locks of a component under test (``LockWatch.wrap`` /
+``instrument``) and run a workload; the watch records, per thread, the
+acquisition-order graph — an edge A→B for every acquisition of B while A
+is held, stamped with the source sites of both acquisitions. After the
+run:
+
+- ``cycles()`` reports lock-order cycles (ABBA and longer): two threads
+  that ever take the same pair of locks in opposite orders can deadlock
+  under the right interleaving, even if the test run happened not to —
+  this is the class a chaos run cannot reliably reproduce but a
+  lock-order graph catches every time;
+- ``long_holds`` reports holds that exceeded the threshold (a lock held
+  across a blocking call starves every other acquirer — the PR 2 incident
+  that moved request-body reads outside the apiserver write lock);
+- ``assert_no_cycles()`` is the chaos-suite assertion seam.
+
+The wrapper is deliberately thin (one monotonic read + dict work per
+acquire/release) so instrumented chaos runs stay representative.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Set, Tuple
+
+_THIS_FILE = __file__
+
+
+def _call_site(depth_limit: int = 12) -> str:
+    """file:line of the nearest caller frame outside this module."""
+    f = sys._getframe(2)
+    for _ in range(depth_limit):
+        if f is None:
+            break
+        fname = f.f_code.co_filename
+        if fname != _THIS_FILE and "threading" not in fname:
+            return f"{fname.rsplit('/', 1)[-1]}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+@dataclass
+class LongHold:
+    lock: str
+    seconds: float
+    acquire_site: str
+    release_site: str
+
+
+@dataclass
+class Cycle:
+    """A lock-order cycle: ``locks`` in cycle order; ``sites`` holds one
+    recorded (holding, acquiring, held_at, acquired_at) witness per edge —
+    for an ABBA pair that is exactly the two sites to fix."""
+    locks: Tuple[str, ...]
+    sites: Tuple[Tuple[str, str, str, str], ...]
+
+    def __str__(self) -> str:
+        arrows = " -> ".join(self.locks + (self.locks[0],))
+        edges = "; ".join(
+            f"{a}(held@{ha}) then {b}(acquired@{hb})"
+            for a, b, ha, hb in self.sites)
+        return f"lock-order cycle {arrows}: {edges}"
+
+
+class WatchedLock:
+    """Drop-in wrapper for Lock/RLock: context manager + acquire/release/
+    locked, reporting to its LockWatch. RLock re-entry is not re-recorded
+    as a new hold (no self-edge noise)."""
+
+    def __init__(self, inner, name: str, watch: "LockWatch"):
+        self._inner = inner
+        self.name = name
+        self._watch = watch
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = (self._inner.acquire(blocking, timeout) if timeout != -1
+               else self._inner.acquire(blocking))
+        if got:
+            self._watch._on_acquire(self, _call_site())
+        return got
+
+    def release(self) -> None:
+        self._watch._on_release(self, _call_site())
+        self._inner.release()
+
+    def locked(self) -> bool:
+        try:
+            return self._inner.locked()
+        except AttributeError:  # RLock has no locked() pre-3.12
+            return False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class LockWatch:
+    """Records the acquisition-order graph across every lock it wraps."""
+
+    def __init__(self, hold_threshold: float = 0.05):
+        self.hold_threshold = hold_threshold
+        self._tl = threading.local()
+        self._mu = threading.Lock()  # guards the shared graph/report state
+        # edge (a, b) -> witness sites (holding_site, acquiring_site)
+        self.edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self.long_holds: List[LongHold] = []
+        self.acquisitions = 0
+
+    # -- instrumentation ----------------------------------------------------
+
+    def wrap(self, lock, name: str) -> WatchedLock:
+        return WatchedLock(lock, name, self)
+
+    def instrument(self, obj, *attrs: str, prefix: str = "") -> None:
+        """Replace ``obj.<attr>`` locks with watched wrappers in place:
+        ``watch.instrument(api, "_lock", "_write_lock", prefix="api")``."""
+        for attr in attrs:
+            inner = getattr(obj, attr)
+            label = f"{prefix or type(obj).__name__}.{attr}"
+            setattr(obj, attr, self.wrap(inner, label))
+
+    # -- recording ----------------------------------------------------------
+
+    def _held(self) -> List[Tuple[str, str, float]]:
+        held = getattr(self._tl, "held", None)
+        if held is None:
+            held = self._tl.held = []
+        return held
+
+    def _on_acquire(self, lock: WatchedLock, site: str) -> None:
+        held = self._held()
+        now = time.monotonic()
+        if any(name == lock.name for name, _, _ in held):
+            return  # RLock re-entry
+        with self._mu:
+            self.acquisitions += 1
+            for prior_name, prior_site, _ in held:
+                self.edges.setdefault((prior_name, lock.name),
+                                      (prior_site, site))
+        held.append((lock.name, site, now))
+
+    def _on_release(self, lock: WatchedLock, site: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            name, acq_site, t0 = held[i]
+            if name == lock.name:
+                del held[i]
+                dt = time.monotonic() - t0
+                if dt >= self.hold_threshold:
+                    with self._mu:
+                        self.long_holds.append(
+                            LongHold(lock.name, dt, acq_site, site))
+                return
+
+    # -- reporting ----------------------------------------------------------
+
+    def cycles(self) -> List[Cycle]:
+        """Every elementary cycle in the acquisition-order graph (DFS over
+        the recorded edges; ABBA pairs come out as 2-cycles)."""
+        with self._mu:
+            edges = dict(self.edges)
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+        out: List[Cycle] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+
+        def dfs(start: str, node: str, path: List[str],
+                on_path: Set[str]) -> None:
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start and len(path) >= 2:
+                    # canonical rotation so each cycle reports once
+                    i = path.index(min(path))
+                    canon = tuple(path[i:] + path[:i])
+                    if canon in seen_cycles:
+                        continue
+                    seen_cycles.add(canon)
+                    sites = tuple(
+                        (a, b) + edges[(a, b)]
+                        for a, b in zip(path, path[1:] + [path[0]]))
+                    out.append(Cycle(tuple(path), sites))
+                elif nxt not in on_path and nxt > start:
+                    # only expand nodes > start: each cycle found exactly
+                    # from its smallest member
+                    dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+        for start in sorted(graph):
+            dfs(start, start, [start], {start})
+        return out
+
+    def assert_no_cycles(self) -> None:
+        cycles = self.cycles()
+        if cycles:
+            raise AssertionError(
+                "lock-order cycles detected (potential deadlock):\n"
+                + "\n".join(str(c) for c in cycles))

@@ -789,6 +789,115 @@ def test_shard_kill_adoption_mixed_churn():
 
 
 # ---------------------------------------------------------------------------
+# lock-order watchdog (testing/lockwatch.py; docs/ANALYSIS.md runtime half)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_lockwatch_reports_synthetic_abba_cycle():
+    """Two threads taking the same pair of locks in opposite orders is a
+    deadlock waiting for the right interleaving. The watch must report the
+    cycle — WITH both acquisition sites — even though this run, executed
+    serially, never deadlocks."""
+    from kubernetes_tpu.testing.lockwatch import LockWatch
+
+    watch = LockWatch()
+    a = watch.wrap(threading.Lock(), "A")
+    b = watch.wrap(threading.Lock(), "B")
+
+    def ab():
+        with a:
+            with b:  # A -> B
+                pass
+
+    def ba():
+        with b:
+            with a:  # B -> A: closes the cycle
+                pass
+
+    for fn in (ab, ba):  # run serially: the ORDER GRAPH closes, not a deadlock
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join(timeout=5)
+    cycles = watch.cycles()
+    assert len(cycles) == 1
+    cyc = cycles[0]
+    assert set(cyc.locks) == {"A", "B"}
+    # both witness edges name this file's acquisition sites
+    assert len(cyc.sites) == 2
+    for _a, _b, held_site, acq_site in cyc.sites:
+        assert "test_faults.py" in held_site
+        assert "test_faults.py" in acq_site
+    with pytest.raises(AssertionError, match="lock-order cycle"):
+        watch.assert_no_cycles()
+
+
+@pytest.mark.chaos
+def test_lockwatch_long_hold_and_rlock_reentry():
+    """A hold across a blocking call is reported with its acquire site;
+    RLock re-entry must NOT count as a second hold (no self-edges)."""
+    from kubernetes_tpu.testing.lockwatch import LockWatch
+
+    watch = LockWatch(hold_threshold=0.03)
+    slow = watch.wrap(threading.Lock(), "slow")
+    with slow:
+        time.sleep(0.06)  # a blocking call under the lock
+    assert [h.lock for h in watch.long_holds] == ["slow"]
+    assert watch.long_holds[0].seconds >= 0.03
+    assert "test_faults.py" in watch.long_holds[0].acquire_site
+
+    r = watch.wrap(threading.RLock(), "re")
+    with r:
+        with r:  # re-entry: not a new hold, no "re"->"re" edge
+            pass
+    assert not watch.cycles()
+    assert ("re", "re") not in watch.edges
+
+
+@pytest.mark.chaos
+def test_apiserver_chaos_run_under_lockwatch_is_cycle_free():
+    """Instrument the REAL apiserver's write/broadcast locks and drive the
+    full verb surface (creates, binds incl. a 409 conflict, status patch,
+    lease CAS, watch attach) — the recorded acquisition-order graph must
+    show the expected write-lock→broadcast-lock nesting and no cycles."""
+    from kubernetes_tpu.core.apiserver import APIServer, HTTPClientset
+    from kubernetes_tpu.testing.lockwatch import LockWatch
+
+    watch = LockWatch(hold_threshold=5.0)  # cycles only; holds not at issue
+    api = APIServer()
+    watch.instrument(api, "_lock", "_write_lock", prefix="apiserver")
+    port = api.serve(0)
+    client = None
+    try:
+        client = HTTPClientset(f"http://127.0.0.1:{port}")
+        for n in _nodes(4, cpu=2):
+            client.create_node(n)
+        pods = _pods(8)
+        for p in pods:
+            client.create_pod(p)
+        client.bind(pods[0], "n0")
+        client.bind(pods[1], "n1")
+        from urllib.error import HTTPError
+        with pytest.raises(HTTPError):  # AlreadyBound 409: conflict branch
+            client.bind(pods[0], "n3")
+        client.patch_pod_status(pods[2], nominated_node_name="n2")
+        assert client.upsert_lease("shard-0", "holder-a", 1.0) is not None
+        assert client.upsert_lease("shard-0", "holder-b", 1.0) is None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(client.pods) < 8:
+            time.sleep(0.05)
+    finally:
+        if client is not None:
+            client.close()
+        api.shutdown()
+    assert watch.acquisitions > 10
+    # the designed nesting was actually observed...
+    assert ("apiserver._write_lock", "apiserver._lock") in watch.edges
+    # ...and only that order, ever: no cycle anywhere in the graph
+    watch.assert_no_cycles()
+
+
+# ---------------------------------------------------------------------------
 # satellite regressions (ADVICE r5 low items)
 # ---------------------------------------------------------------------------
 

@@ -4,79 +4,42 @@ ROADMAP (resolved, PR 2): under x64, a bare `jnp.arange` (or any index
 producer defaulting to int64) fed into scatter/gather index tuples mixes
 s64 indices with the GSPMD partitioner's s32 offset math, and this
 environment's XLA miscompiles the comparison ("compare(s64, s32) after
-spmd-partitioning"). The fix pinned every index producer in
-kubernetes_tpu/ops/ to an explicit int32. This test scans the ops sources
-so the fix cannot silently regress: every `jnp.arange(` must carry an
-explicit dtype, and argmax/argsort-style index producers must cast to
-int32 in the same statement. Deliberate int64 quantity math goes on the
-allowlist below with a reason.
+spmd-partitioning").
+
+PR 7 ported the original regex scan onto the AST checker
+`kubernetes_tpu.analysis.index_dtype` (which also fixed the old
+`_call_text` helper's string-literal-naive paren matching — the AST sees
+real call structure, not characters) and widened the scope from ops/ +
+models/tpu_scheduler.py to the whole package. This file stays as a thin
+runner so the historical test IDs keep gating tier-1; deliberate int64
+quantity math goes on `kubernetes_tpu/analysis/allowlist.py` with a
+mandatory reason, never here.
 """
 
 from __future__ import annotations
 
-import pathlib
-import re
+import functools
 
-_PKG = pathlib.Path(__file__).resolve().parent.parent / "kubernetes_tpu"
-OPS_DIR = _PKG / "ops"
-
-
-def _scanned_files():
-    """Every source whose jnp index producers can reach a device kernel:
-    all of ops/, plus models/tpu_scheduler.py — its session orchestration
-    builds scatter/gather operands too (victim tensors, placement masks,
-    delta-patch row vectors), so the s64/s32 GSPMD miscompile class can
-    regress from there just as well as from ops/."""
-    return sorted(OPS_DIR.glob("*.py")) + [
-        _PKG / "models" / "tpu_scheduler.py"]
+from kubernetes_tpu.analysis import analyze
+from kubernetes_tpu.analysis.index_dtype import IndexDtypeChecker
 
 
-# (file name, 1-based line of the producer) -> reason. Quantity math that
-# genuinely needs int64 (resource units exceed int32) belongs here, never
-# anything whose result indexes a scatter/gather.
-ALLOWLIST: dict = {}
+@functools.lru_cache(maxsize=1)
+def _report():
+    # One tree scan shared by the three test IDs (the scan re-parses the
+    # whole package; the result is deterministic within a run).
+    return analyze(checkers=[IndexDtypeChecker()])
 
 
-def _call_text(src: str, open_paren: int) -> str:
-    """Source text of one call: from its opening paren to the matching
-    close (string-literal-naive is fine for this codebase's ops files)."""
-    depth = 0
-    for i in range(open_paren, len(src)):
-        if src[i] == "(":
-            depth += 1
-        elif src[i] == ")":
-            depth -= 1
-            if depth == 0:
-                return src[open_paren:i + 1]
-    return src[open_paren:]
-
-
-def _statement_text(src: str, pos: int) -> str:
-    """The logical statement around `pos`: its line plus continuation lines
-    while parens stay open (enough context to see an .astype cast)."""
-    start = src.rfind("\n", 0, pos) + 1
-    end = src.find("\n", pos)
-    stmt = src[start:end if end >= 0 else len(src)]
-    while stmt.count("(") > stmt.count(")") and end >= 0:
-        nxt = src.find("\n", end + 1)
-        stmt += src[end:nxt if nxt >= 0 else len(src)]
-        end = nxt
-    return stmt
+def _findings(rule: str):
+    return [str(f) for f in _report().findings if f.rule == rule]
 
 
 def test_ops_jnp_arange_pins_dtype():
-    """Every jnp.arange in ops/ must pass an explicit dtype (bare arange
-    defaults to int64 under x64 and these values feed index operands)."""
-    bad = []
-    for path in _scanned_files():
-        src = path.read_text()
-        for m in re.finditer(r"jnp\.arange\(", src):
-            line = src.count("\n", 0, m.start()) + 1
-            if (path.name, line) in ALLOWLIST:
-                continue
-            call = _call_text(src, m.end() - 1)
-            if "dtype=" not in call:
-                bad.append(f"{path.name}:{line}: jnp.arange without dtype")
+    """Every jnp.arange in the package must pass an explicit dtype (bare
+    arange defaults to int64 under x64 and these values feed index
+    operands)."""
+    bad = _findings("arange-dtype")
     assert not bad, (
         "index producers without an explicit dtype (s64/s32 GSPMD "
         "miscompile class — pin int32 or allowlist with a reason):\n"
@@ -86,18 +49,7 @@ def test_ops_jnp_arange_pins_dtype():
 def test_ops_argmax_style_producers_cast_int32():
     """argmax/argsort/nonzero-style jnp index producers must cast to int32
     in the same statement (their int64 default rides into index tuples)."""
-    bad = []
-    producers = r"jnp\.(argmax|argmin|argsort|nonzero|searchsorted)\("
-    for path in _scanned_files():
-        src = path.read_text()
-        for m in re.finditer(producers, src):
-            line = src.count("\n", 0, m.start()) + 1
-            if (path.name, line) in ALLOWLIST:
-                continue
-            stmt = _statement_text(src, m.start())
-            if "int32" not in stmt:
-                bad.append(f"{path.name}:{line}: {m.group(0)}... "
-                           "without an int32 cast in the statement")
+    bad = _findings("argmax-cast")
     assert not bad, (
         "argmax-style index producers without int32 pinning:\n"
         + "\n".join(bad))
@@ -105,17 +57,7 @@ def test_ops_argmax_style_producers_cast_int32():
 
 def test_ops_scatter_index_asarray_pins_dtype():
     """jnp.asarray calls that build scatter/gather index vectors (named
-    idx/rows/dirty) must pass an explicit int32 dtype."""
-    bad = []
-    pat = re.compile(r"jnp\.asarray\((?:sorted\()?(?:dirty|rows_idx|prows|"
-                     r"dirty_rows|idx)\b[^)]*\)")
-    for path in _scanned_files():
-        src = path.read_text()
-        for m in re.finditer(pat, src):
-            line = src.count("\n", 0, m.start()) + 1
-            if (path.name, line) in ALLOWLIST:
-                continue
-            if "int32" not in m.group(0):
-                bad.append(f"{path.name}:{line}: {m.group(0)}")
+    idx/rows/dirty/...) must pass an explicit int32 dtype."""
+    bad = _findings("asarray-index-dtype")
     assert not bad, ("index-vector asarray without int32 dtype:\n"
                      + "\n".join(bad))

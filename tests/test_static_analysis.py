@@ -1,0 +1,498 @@
+"""Tier-1 gate + self-tests for the project invariant analyzer
+(kubernetes_tpu/analysis/, docs/ANALYSIS.md).
+
+Three layers:
+
+- fixture corpus: every checker must flag its known-bad snippets (the
+  recorded incident patterns, seeded) and pass its known-good twins;
+- the tree gate: `analyze()` over the real package reports zero findings
+  and zero stale allowlist entries — this is what makes the analyzer a
+  floor under every future PR;
+- the CLI contract: `python -m kubernetes_tpu.analysis` exits 0 on the
+  tree and nonzero (with --json detail) on a tree seeded with violations.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from kubernetes_tpu.analysis import (ALLOWLIST, Allow, all_checkers, analyze,
+                                     check_source, checker_by_id,
+                                     validate_allowlist)
+from kubernetes_tpu.analysis.metrics_discipline import (Declaration,
+                                                        MetricsDisciplineChecker)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: index-dtype
+# ---------------------------------------------------------------------------
+
+
+class TestIndexDtypeFixtures:
+    def test_flags_bad_producers(self):
+        bad = textwrap.dedent("""
+            import jax.numpy as jnp
+            def f(x, idx, dirty):
+                a = jnp.arange(5)                     # bare arange
+                b = jnp.argmax(x, axis=0)             # uncast argmax
+                c = jnp.asarray(idx)                  # index vec, no dtype
+                d = jnp.asarray(sorted(dirty))        # ditto through sorted
+                return a, b, c, d
+        """)
+        fs = check_source(checker_by_id("index-dtype"), bad)
+        assert _rules(fs) == ["arange-dtype", "argmax-cast",
+                              "asarray-index-dtype"]
+        assert len(fs) == 4
+        assert {f.line for f in fs} == {4, 5, 6, 7}
+
+    def test_passes_pinned_producers(self):
+        good = textwrap.dedent("""
+            import jax.numpy as jnp
+            def f(x, idx, dirty):
+                a = jnp.arange(5, dtype=jnp.int32)
+                b = jnp.argmax(x, axis=0).astype(jnp.int32)
+                c = jnp.asarray(idx, jnp.int32)
+                d = jnp.asarray(sorted(dirty), dtype=jnp.int32)
+                e = jnp.asarray(x)     # not an index-named vector: exempt
+                return a, b, c, d, e
+        """)
+        assert check_source(checker_by_id("index-dtype"), good) == []
+
+    def test_string_parens_do_not_confuse_the_scan(self):
+        """The old regex guard's _call_text was string-literal-naive: a ')'
+        inside a string ended its paren matching. The AST checker must see
+        through it both ways."""
+        tricky_good = textwrap.dedent("""
+            import jax.numpy as jnp
+            def f():
+                msg = "jnp.arange(8)"     # a string, not a call
+                return jnp.arange(8, dtype=jnp.int32), msg
+        """)
+        assert check_source(checker_by_id("index-dtype"), tricky_good) == []
+        tricky_bad = textwrap.dedent("""
+            import jax.numpy as jnp
+            def f():
+                note = ") dtype= :)"      # old parser would see this text
+                return jnp.arange(8), note
+        """)
+        fs = check_source(checker_by_id("index-dtype"), tricky_bad)
+        assert _rules(fs) == ["arange-dtype"]
+
+    def test_argmax_cast_is_statement_scoped(self):
+        mixed = textwrap.dedent("""
+            import jax.numpy as jnp
+            def f(x):
+                i = jnp.argmax(x)          # bad: cast happens a line later
+                i = i.astype(jnp.int32)
+                return i
+        """)
+        fs = check_source(checker_by_id("index-dtype"), mixed)
+        assert _rules(fs) == ["argmax-cast"]
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: lock-discipline
+# ---------------------------------------------------------------------------
+
+
+BAD_APISERVER = textwrap.dedent("""
+    import threading
+    class Server:
+        def do_POST(self):                       # no write lock, no delegate
+            body = self._read_body()
+            self.store.pods[body["uid"]] = body
+        def _broadcast(self, kind, event):
+            with self._lock:
+                for q in self._watchers[kind]:   # fanout BEFORE the append
+                    q.put(event)
+                self.persistence.append(event)
+        def _wal_status(self, rec):
+            self.persistence.append(rec)         # append outside any lock
+        def do_DELETE(self):
+            with self._write_lock:
+                body = self._read_body()         # blocking read under lock
+""")
+
+GOOD_APISERVER = textwrap.dedent("""
+    import threading
+    class Server:
+        def do_POST(self):
+            body = self._read_body()             # read OUTSIDE the lock
+            with self._write_lock:
+                self.store.pods[body["uid"]] = body
+        def do_PUT(self):
+            self.upsert(self._read_body())       # delegate serializes
+        def upsert(self, rec):
+            with self._write_lock:
+                self.leases[rec["name"]] = rec
+        def _broadcast(self, kind, event):
+            with self._lock:
+                self.persistence.append(event)   # durable BEFORE fanout
+                for q in self._watchers[kind]:
+                    q.put(event)
+""")
+
+
+class TestLockDisciplineFixtures:
+    def test_flags_all_four_rules(self):
+        fs = check_source(checker_by_id("lock-discipline"), BAD_APISERVER)
+        assert _rules(fs) == ["no-blocking-read-under-lock",
+                              "verb-write-lock", "wal-before-fanout",
+                              "wal-under-broadcast-lock"]
+
+    def test_passes_disciplined_server(self):
+        assert check_source(checker_by_id("lock-discipline"),
+                            GOOD_APISERVER) == []
+
+    def test_directly_nested_withs_hold_both_locks(self):
+        """Regression (PR 7 review): a `with` as the DIRECT first statement
+        of another `with`'s body must inherit the outer lock — correct
+        code like write_lock-then-broadcast-lock used to false-positive."""
+        nested_good = textwrap.dedent("""
+            class Server:
+                def commit(self, event):
+                    with self._write_lock:
+                        with self._lock:
+                            self.persistence.append(event)
+                            for q in self._watchers["pods"]:
+                                q.put(event)
+        """)
+        assert check_source(checker_by_id("lock-discipline"),
+                            nested_good) == []
+
+    def test_duplicate_function_names_each_get_scanned(self):
+        """Regression (PR 7 review): two defs sharing a name (apiserver.py
+        has upsert_lease on BOTH APIServer and HTTPClientset) must each be
+        analyzed — the buggy version kept only the last one, silently
+        skipping the server-side locking."""
+        dup = textwrap.dedent("""
+            class Server:
+                def upsert_lease(self, rec):
+                    self.persistence.append(rec)     # VIOLATION: no lock
+            class Client:
+                def upsert_lease(self, rec):
+                    return self._call("PUT", rec)    # clean REST wrapper
+        """)
+        fs = check_source(checker_by_id("lock-discipline"), dup)
+        assert _rules(fs) == ["wal-under-broadcast-lock"]
+        assert len(fs) == 1 and fs[0].line == 4
+
+    def test_scope_is_apiserver_and_wal(self):
+        c = checker_by_id("lock-discipline")
+        assert c.applies_to("core/apiserver.py")
+        assert c.applies_to("core/wal.py")
+        assert not c.applies_to("core/scheduler.py")
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: jit-purity
+# ---------------------------------------------------------------------------
+
+
+class TestJitPurityFixtures:
+    def test_flags_impure_jit_functions(self):
+        bad = textwrap.dedent("""
+            import jax, time
+            from functools import partial
+            CALLS = 0
+            @partial(jax.jit, static_argnames=("k",))
+            def kernel(x, k):
+                global CALLS
+                CALLS += 1                 # baked in at trace time
+                print("tracing", x)        # host effect under trace
+                t = time.perf_counter()    # host clock under trace
+                return x * k
+            def build(state, cfg):
+                def step(s):
+                    cfg.calls = 1          # attr mutation under trace
+                    return s + 1
+                return jax.jit(step)
+        """)
+        fs = check_source(checker_by_id("jit-purity"), bad)
+        assert _rules(fs) == ["no-attr-assign", "no-global-mutation",
+                              "no-impure-call"]
+        assert sum(f.rule == "no-impure-call" for f in fs) == 2
+
+    def test_passes_pure_kernels(self):
+        good = textwrap.dedent("""
+            import jax
+            import jax.numpy as jnp
+            from functools import partial
+            @partial(jax.jit, static_argnames=("k",))
+            def kernel(x, k):
+                jax.debug.print("ok {}", x)   # traced debugging is fine
+                return jnp.cumsum(x) * k
+            def host_driver(state):
+                state.calls = 1               # host code may mutate freely
+                import time
+                return time.perf_counter()
+        """)
+        assert check_source(checker_by_id("jit-purity"), good) == []
+
+    def test_transitive_helpers_are_traced_too(self):
+        """A helper called from a jitted function is traced like its
+        caller — impurity there is the same bug one stack frame down."""
+        bad = textwrap.dedent("""
+            import jax
+            @jax.jit
+            def kernel(x):
+                return _helper(x)
+            def _helper(x):
+                print("traced!")       # impure, reached through kernel
+                return x + 1
+            def _host_only(x):
+                print("fine")          # never reaches a jit
+                return x
+        """)
+        fs = check_source(checker_by_id("jit-purity"), bad)
+        assert [f.rule for f in fs] == ["no-impure-call"]
+        assert fs[0].line == 7
+
+    def test_flags_donated_buffer_reuse(self):
+        bad = textwrap.dedent("""
+            import jax
+            def session(carry, feats):
+                step = jax.jit(lambda c, f: c, donate_argnums=(0,))
+                step = jax.jit(_impl, donate_argnums=(0,))
+                out = step(carry, feats)
+                return out + carry.total     # carry's buffer was donated
+            def _impl(c, f):
+                return c
+        """)
+        fs = check_source(checker_by_id("jit-purity"), bad)
+        assert any(f.rule == "donated-buffer-reuse" for f in fs)
+
+    def test_donation_rebind_is_clean(self):
+        good = textwrap.dedent("""
+            import jax
+            def session(carry, feats):
+                step = jax.jit(_impl, donate_argnums=(0,))
+                carry = step(carry, feats)   # rebound: later reads see new
+                return carry.total
+            def _impl(c, f):
+                return c
+        """)
+        assert check_source(checker_by_id("jit-purity"), good) == []
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: thread-hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestThreadHygieneFixtures:
+    def test_flags_unjoined_nondaemon_threads(self):
+        bad = textwrap.dedent("""
+            import threading
+            class Pump:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+                    threading.Thread(target=self._aux).start()
+        """)
+        fs = check_source(checker_by_id("thread-hygiene"), bad)
+        assert len(fs) == 2
+        assert _rules(fs) == ["daemon-or-joined"]
+
+    def test_passes_daemon_joined_and_pooled(self):
+        good = textwrap.dedent("""
+            import threading
+            class Pump:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+                    threading.Thread(target=self._aux, daemon=True).start()
+                    w = threading.Thread(target=self._w)
+                    self._threads.append(w)
+                    self._threads.append(threading.Thread(target=self._v))
+                def close(self):
+                    self._t.join(timeout=2)
+                    for t in self._threads:
+                        t.join(timeout=2)
+        """)
+        assert check_source(checker_by_id("thread-hygiene"), good) == []
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: metrics-discipline
+# ---------------------------------------------------------------------------
+
+
+DECLS = {
+    "hits": Declaration("hits", "Counter", "scheduler_hits_total",
+                        ("result",), 10),
+    "depth": Declaration("depth", "Gauge", "scheduler_depth", (), 11),
+    "latency": Declaration("latency", "Histogram", "scheduler_latency",
+                           ("kind",), 12),
+}
+
+
+class TestMetricsDisciplineFixtures:
+    def _check(self, src):
+        return check_source(MetricsDisciplineChecker(declarations=DECLS), src)
+
+    def test_flags_undeclared_mismatch_and_arity(self):
+        bad = textwrap.dedent("""
+            class S:
+                def go(self):
+                    self.metrics.misses.inc()              # undeclared
+                    self.metrics.depth.inc()               # Gauge via inc
+                    self.metrics.hits.inc("ok", "extra")   # arity 2 != 1
+                    self.metrics.latency.observe(0.5)      # arity 1 != 2
+        """)
+        fs = self._check(bad)
+        assert _rules(fs) == ["label-arity", "metric-verb-mismatch",
+                              "undeclared-metric"]
+        assert sum(f.rule == "label-arity" for f in fs) == 2
+
+    def test_resolves_local_aliases(self):
+        bad = textwrap.dedent("""
+            class S:
+                def go(self):
+                    m = self.metrics
+                    m.misses.inc()                    # undeclared via alias
+                    h = self.metrics.latency
+                    h.observe(0.5)                    # arity via alias
+        """)
+        fs = self._check(bad)
+        assert _rules(fs) == ["label-arity", "undeclared-metric"]
+
+    def test_passes_disciplined_usage(self):
+        good = textwrap.dedent("""
+            class S:
+                def go(self, n):
+                    self.metrics.hits.inc("ok")
+                    self.metrics.hits.inc("ok", value=n)
+                    self.metrics.depth.set(float(n))
+                    h = self.metrics.latency
+                    h.observe(0.5, "bind")
+                    self.other.inc("unrelated", "object", "calls")
+        """)
+        assert self._check(good) == []
+
+    def test_label_cardinality_bound(self):
+        over = textwrap.dedent("""
+            class SchedulerMetrics:
+                def __init__(self):
+                    r = self.registry.register
+                    self.wide = r(Counter(
+                        "scheduler_wide_total", "too many dims.",
+                        ("a", "b", "c", "d")))
+        """)
+        fs = check_source(MetricsDisciplineChecker(declarations=DECLS), over,
+                          path="core/metrics.py")
+        assert _rules(fs) == ["label-cardinality"]
+
+    def test_real_declarations_parse(self):
+        from kubernetes_tpu.analysis.metrics_discipline import (
+            parse_declarations)
+        from kubernetes_tpu.analysis.base import PKG_ROOT
+        decls = parse_declarations((PKG_ROOT / "core/metrics.py").read_text())
+        assert len(decls) > 50
+        assert decls["schedule_attempts"].kind == "Counter"
+        assert decls["schedule_attempts"].labels == ("result", "profile")
+        assert decls["pending_pods"].kind == "Gauge"
+        assert all(d.labels is None or len(d.labels) <= 3
+                   for d in decls.values())
+
+
+# ---------------------------------------------------------------------------
+# the tree gate + allowlist policy
+# ---------------------------------------------------------------------------
+
+
+def test_tree_runs_clean():
+    """The analyzer is a floor: the real package has zero findings (every
+    violation the checkers surfaced during PR 7 was fixed, not
+    allowlisted) and zero stale allowlist entries."""
+    report = analyze()
+    assert report.files_scanned > 50
+    assert not report.findings, "\n".join(str(f) for f in report.findings)
+    assert not report.unused_allows, report.unused_allows
+
+
+def test_every_checker_registered_and_described():
+    checkers = all_checkers()
+    ids = sorted(c.id for c in checkers)
+    assert ids == ["index-dtype", "jit-purity", "lock-discipline",
+                   "metrics-discipline", "thread-hygiene"]
+    assert all(c.description for c in checkers)
+
+
+def test_allowlist_reasons_are_mandatory():
+    validate_allowlist(ALLOWLIST)  # current entries all carry reasons
+    with pytest.raises(ValueError, match="no reason"):
+        validate_allowlist([Allow("index-dtype", "ops/kernel.py", 1, "  ")])
+
+
+def test_allowlist_suppresses_and_goes_stale():
+    import pathlib
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        root = pathlib.Path(td)
+        (root / "mod.py").write_text(
+            "import jax.numpy as jnp\nix = jnp.arange(4)\n")
+        hit = Allow("index-dtype", "mod.py", 2, "fixture: deliberate")
+        report = analyze(root=root, allowlist=[hit])
+        assert not report.findings and len(report.suppressed) == 1
+        stale = Allow("index-dtype", "mod.py", 99, "fixture: wrong line")
+        report = analyze(root=root, allowlist=[stale])
+        assert len(report.findings) == 1 and report.unused_allows == [stale]
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.analysis", *args],
+        capture_output=True, text=True, timeout=120)
+
+
+def test_cli_exits_zero_on_the_tree():
+    proc = _run_cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_cli_exits_nonzero_on_seeded_violations(tmp_path):
+    """Acceptance: a seeded bare `jnp.arange` in ops/ and a WAL append
+    outside the lock region must fail the scan, with --json detail."""
+    ops = tmp_path / "ops"
+    ops.mkdir()
+    (ops / "bad_kernel.py").write_text(
+        "import jax.numpy as jnp\n"
+        "def f(n):\n"
+        "    return jnp.arange(n)\n")
+    core = tmp_path / "core"
+    core.mkdir()
+    (core / "apiserver.py").write_text(
+        "class S:\n"
+        "    def _broadcast(self, event):\n"
+        "        self.persistence.append(event)\n")
+    proc = _run_cli("--root", str(tmp_path), "--json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert not report["clean"]
+    rules = {(f["checker"], f["rule"]) for f in report["findings"]}
+    assert ("index-dtype", "arange-dtype") in rules
+    assert ("lock-discipline", "wal-under-broadcast-lock") in rules
+
+
+def test_cli_single_checker_and_listing():
+    proc = _run_cli("--list-checkers")
+    assert proc.returncode == 0
+    assert "lock-discipline" in proc.stdout
+    proc = _run_cli("--checker", "thread-hygiene")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
