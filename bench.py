@@ -106,16 +106,22 @@ def make_pods(n, name_prefix):
     return [proto.clone_from_template(f"{name_prefix}-{i}") for i in range(n)]
 
 
-def main_sharded(n_shards: int) -> None:
-    """`bench.py --shards N`: the same SchedulingBasic shape through the
-    multi-process shard plane (kubernetes_tpu/shard/harness.py) — one
-    apiserver process + N scheduler processes over HTTP. N=1 is the
+def main_sharded(n_shards: int, trace: bool = False) -> None:
+    """`bench.py --shards N [--trace]`: the same SchedulingBasic shape
+    through the multi-process shard plane (kubernetes_tpu/shard/harness.py)
+    — one apiserver process + N scheduler processes over HTTP. N=1 is the
     like-for-like single-scheduler baseline (same transport, same store);
-    the acceptance comparison is N=2 vs N=1 pods/s."""
+    the acceptance comparison is N=2 vs N=1 pods/s. With --trace, every
+    process dumps its span ring (flight recorder) and the merged trace
+    analysis — per-stage p50/p99, chain completeness, conflict timeline —
+    rides the detail object (docs/OBSERVABILITY.md)."""
+    import tempfile
+
     from kubernetes_tpu.shard.harness import run_sharded_cluster
 
     n_nodes = int(os.environ.get("BENCH_NODES", 5000))
     n_pods = int(os.environ.get("BENCH_PODS", 10000))
+    flightrec_dir = tempfile.mkdtemp(prefix="bench-trace-") if trace else ""
     # PER-SHARD warmup: the uid-hash partition splits the warm burst across
     # shards, so covering each shard's top device-batch tier (the XLA
     # compile the warm phase exists to pay) needs warm_pods to scale with
@@ -125,6 +131,7 @@ def main_sharded(n_shards: int) -> None:
     warmup = int(os.environ.get("BENCH_WARMUP", 1024)) * n_shards
     out = run_sharded_cluster(
         n_shards, n_nodes, n_pods, warm_pods=warmup,
+        flightrec_dir=flightrec_dir,
         # 15s, not the chaos tests' 2-3s: the renewer is a Python thread,
         # and on an oversubscribed box (N shards + apiserver on few cores)
         # a tight lease flaps — a starved renewer misses one period, a peer
@@ -137,6 +144,24 @@ def main_sharded(n_shards: int) -> None:
     detail["api"] = out["api"]
     detail["shard_metrics"] = out["shard_metrics"]
     detail["platform"] = "cpu (sharded subprocesses)"
+    # e2e latency truth (scheduler_e2e_scheduling_duration_seconds, merged
+    # across shards from /metrics) — the p50/p99 detail line.
+    detail["e2e_ms"] = out.get("e2e_ms")
+    if trace:
+        from kubernetes_tpu import trace as trace_mod
+        spans = trace_mod.load_spans([flightrec_dir])
+        summary = trace_mod.summarize(spans)
+        detail["trace"] = {
+            "dir": flightrec_dir,
+            "spans": summary["spans"],
+            "traces": summary["traces"],
+            "processes": summary["processes"],
+            "completeness": summary["completeness"],
+            "stage_p50_p99_ms": {
+                name: [round(st["p50"] * 1e3, 3), round(st["p99"] * 1e3, 3)]
+                for name, st in summary["stages"].items()},
+            "conflicts": len(summary["conflicts"]),
+        }
     print(json.dumps({
         "metric": (f"pods scheduled/sec ({n_nodes} nodes, {n_pods} pods, "
                    f"{n_shards}-shard plane, HTTP transport)"),
@@ -147,7 +172,7 @@ def main_sharded(n_shards: int) -> None:
     }))
 
 
-def main():
+def main(trace: bool = False):
     n_nodes = int(os.environ.get("BENCH_NODES", 5000))
     n_pods = int(os.environ.get("BENCH_PODS", 10000))
     warmup = int(os.environ.get("BENCH_WARMUP", 1024))
@@ -192,6 +217,31 @@ def main():
     for a in WINDOW:
         d = getattr(sched, a, 0) - win0[a]
         detail[a] = round(d, 3) if isinstance(d, float) else d
+    # e2e latency detail line (queue admission -> bound; fed from span ends
+    # on EVERY bound pod — docs/OBSERVABILITY.md).
+    e2e = sched.metrics.e2e_scheduling_duration
+    if e2e.count():
+        detail["e2e_ms"] = {
+            "p50": round(e2e.percentile(0.50) * 1e3, 3),
+            "p99": round(e2e.percentile(0.99) * 1e3, 3),
+            "count": e2e.count()}
+    if trace:
+        import tempfile
+
+        from kubernetes_tpu import trace as trace_mod
+        from kubernetes_tpu.core import spans as _spans
+        d = tempfile.mkdtemp(prefix="bench-trace-")
+        path = _spans.default_tracer().dump_jsonl(
+            os.path.join(d, f"spans-{os.getpid()}.jsonl"))
+        summary = trace_mod.summarize(trace_mod.load_spans([path]))
+        detail["trace"] = {
+            "dir": d, "spans": summary["spans"],
+            "traces": summary["traces"],
+            "completeness": summary["completeness"],
+            "stage_p50_p99_ms": {
+                name: [round(st["p50"] * 1e3, 3), round(st["p99"] * 1e3, 3)]
+                for name, st in summary["stages"].items()},
+        }
     result = {
         "metric": f"pods scheduled/sec ({n_nodes} nodes, {n_pods} pods, device batch path)",
         "value": round(pods_per_sec, 1),
@@ -205,7 +255,9 @@ def main():
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         sys.exit(probe())
+    _trace = "--trace" in sys.argv
     if "--shards" in sys.argv:
-        main_sharded(int(sys.argv[sys.argv.index("--shards") + 1]))
+        main_sharded(int(sys.argv[sys.argv.index("--shards") + 1]),
+                     trace=_trace)
         sys.exit(0)
-    main()
+    main(trace=_trace)

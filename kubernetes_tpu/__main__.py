@@ -126,6 +126,24 @@ def main(argv=None) -> int:
     if args.cluster:
         _load_cluster(sched.clientset, args.cluster)
 
+    # Observability (docs/OBSERVABILITY.md): label this process's spans so
+    # cross-process trace merges attribute stages, and install the flight
+    # recorder when a dump directory is configured (the shard harness sets
+    # TPU_SCHED_FLIGHTREC_DIR for bench --trace and the chaos suites).
+    import os
+    sched.tracer.proc = (f"shard-{args.shard_index}"
+                         if args.shard_index >= 0 else args.identity)
+    flight = None
+    flight_dir = os.environ.get("TPU_SCHED_FLIGHTREC_DIR", "")
+    if flight_dir:
+        from .core.spans import FlightRecorder
+        flight = FlightRecorder(
+            flight_dir, tracer=sched.tracer, recorder=sched.recorder,
+            scheduler=sched).install(
+            at_exit=True,
+            autodump_interval=float(
+                os.environ.get("TPU_SCHED_FLIGHTREC_INTERVAL", "5.0")))
+
     member = None
     if args.shard_index >= 0:
         if not args.api_url or args.shard_count <= args.shard_index:
@@ -170,6 +188,9 @@ def main(argv=None) -> int:
                 time.sleep(0.02)
     finally:
         server.shutdown()
+        if flight is not None:
+            flight.dump("shutdown")
+            flight.close()
     try:
         print(f"kubernetes-tpu-scheduler: scheduled={sched.scheduled} "
               f"failures={sched.failures}", flush=True)

@@ -91,7 +91,7 @@ def register(cls: Type[Checker]) -> Type[Checker]:
 def all_checkers() -> List[Checker]:
     # Import the checker modules for their registration side effect.
     from . import (index_dtype, jit_purity, lock_discipline,  # noqa: F401
-                   metrics_discipline, thread_hygiene)
+                   metrics_discipline, span_discipline, thread_hygiene)
     return [cls() for _, cls in sorted(_REGISTRY.items())]
 
 

@@ -80,6 +80,73 @@ def _donate_argnums(call_or_dec: ast.AST) -> Optional[Set[int]]:
     return None
 
 
+def jit_reachable_functions(tree: ast.AST) -> List[ast.FunctionDef]:
+    """Every function def that can run UNDER TRACE: decorated with jit/
+    pjit (incl. partial(jax.jit, ...)), wrapped via ``jit(fn)``, or
+    transitively called (same module) from one that is. Shared with the
+    span-discipline checker — span/metric calls are host-state effects and
+    must never appear inside these (ISSUE 8 composition seam). The result
+    is memoized ON the tree object: both checkers visit every module of
+    the package, and the reachability walk is the expensive part."""
+    memo = getattr(tree, "_jit_reachable_memo", None)
+    if memo is not None:
+        return memo
+    result = _jit_reachable_uncached(tree)
+    try:
+        tree._jit_reachable_memo = result
+    except AttributeError:
+        pass  # non-Module roots (fixtures) may not accept attributes
+    return result
+
+
+def _jit_reachable_uncached(tree: ast.AST) -> List[ast.FunctionDef]:
+    defs: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs.setdefault(node.name, []).append(node)
+
+    jit_fns: List[ast.FunctionDef] = []
+    jit_ids: Set[int] = set()  # id()-keyed membership (no O(n) list scans)
+
+    def _add(fn: ast.FunctionDef) -> None:
+        if id(fn) not in jit_ids:
+            jit_ids.add(id(fn))
+            jit_fns.append(fn)
+
+    for fns in defs.values():
+        for fn in fns:
+            if any(_decorator_is_jit(dec) for dec in fn.decorator_list):
+                _add(fn)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            target = _jit_wrap_target(node)
+            if target and target in defs:
+                for f in defs[target]:
+                    _add(f)
+
+    # Transitive closure over same-module calls: a helper called from a
+    # jitted function is traced exactly like its caller (kernel helpers
+    # hold most of the actual math in ops/kernel.py).
+    reached = {fn.name for fn in jit_fns}
+    frontier = set(reached)
+    while frontier:
+        nxt = set()
+        for name in frontier:
+            for fn in defs.get(name, ()):
+                for c in ast.walk(fn):
+                    if isinstance(c, ast.Call):
+                        chain = attr_chain(c.func)
+                        if (len(chain) == 1 and chain[0] in defs
+                                and chain[0] not in reached):
+                            nxt.add(chain[0])
+        reached |= nxt
+        frontier = nxt
+    for name in reached:
+        for f in defs[name]:
+            _add(f)
+    return jit_fns
+
+
 @register
 class JitPurityChecker(Checker):
     id = "jit-purity"
@@ -95,45 +162,17 @@ class JitPurityChecker(Checker):
             if isinstance(node, ast.FunctionDef):
                 defs.setdefault(node.name, []).append(node)
 
-        jit_fns: List[ast.FunctionDef] = []
         donated_defs: Dict[str, Set[int]] = {}  # decorated fns w/ donation
         for name, fns in defs.items():
             for fn in fns:
                 for dec in fn.decorator_list:
                     if _decorator_is_jit(dec):
-                        jit_fns.append(fn)
                         don = _donate_argnums(dec)
                         if don:
                             donated_defs[name] = don
                         break
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call):
-                target = _jit_wrap_target(node)
-                if target and target in defs:
-                    jit_fns.extend(f for f in defs[target]
-                                   if f not in jit_fns)
 
-        # Transitive closure over same-module calls: a helper called from a
-        # jitted function is traced exactly like its caller (kernel helpers
-        # hold most of the actual math in ops/kernel.py).
-        reached = {fn.name for fn in jit_fns}
-        frontier = set(reached)
-        while frontier:
-            nxt = set()
-            for name in frontier:
-                for fn in defs.get(name, ()):
-                    for c in ast.walk(fn):
-                        if isinstance(c, ast.Call):
-                            chain = attr_chain(c.func)
-                            if (len(chain) == 1 and chain[0] in defs
-                                    and chain[0] not in reached):
-                                nxt.add(chain[0])
-            reached |= nxt
-            frontier = nxt
-        for name in reached:
-            jit_fns.extend(f for f in defs[name] if f not in jit_fns)
-
-        for fn in jit_fns:
+        for fn in jit_reachable_functions(tree):
             out.extend(self._check_purity(mod, fn))
 
         # Donation discipline: per enclosing scope, a name bound to

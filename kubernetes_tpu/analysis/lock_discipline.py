@@ -21,7 +21,13 @@ Rules (scoped to core/apiserver.py + core/wal.py):
   itself runs under the broadcast lock;
 - ``no-blocking-read-under-lock``: no blocking socket/request read
   (``_read_body``, ``rfile.read``, ``recv``, ``accept``, ``readline``,
-  ``getresponse``, ``urlopen``) happens while any lock is held.
+  ``getresponse``, ``urlopen``) happens while any lock is held;
+- ``no-render-under-write-lock``: metrics exposition
+  (``expose_metrics``/``.expose``) never runs while holding the write
+  lock — series rendering iterates every label set and a scrape that
+  serializes against the write plane stalls binds for the whole render
+  (PR 8: expose paths snapshot-copy instead; ROADMAP notes
+  ``/metrics/resources`` contending with the write plane).
 """
 
 from __future__ import annotations
@@ -54,6 +60,7 @@ class _FunctionScan:
         self.wal_appends: List[Tuple[int, Tuple[str, ...]]] = []
         self.fanouts: List[Tuple[int, Tuple[str, ...]]] = []
         self.blocking_reads: List[Tuple[int, Tuple[str, ...], str]] = []
+        self.metric_renders: List[Tuple[int, Tuple[str, ...], str]] = []
         self._walk(fn, ())
 
     def _walk(self, node: ast.AST, held: Tuple[str, ...]) -> None:
@@ -96,6 +103,9 @@ class _FunctionScan:
             self.wal_appends.append((node.lineno, held))
         if chain and chain[-1] in BLOCKING_READ_ATTRS and held:
             self.blocking_reads.append((node.lineno, held, chain[-1]))
+        if (chain and chain[-1] in ("expose_metrics", "expose")
+                and "_write_lock" in held):
+            self.metric_renders.append((node.lineno, held, chain[-1]))
         # rfile.read is a request-body read even though 'read' is generic
         if (len(chain) >= 2 and chain[-1] == "read" and chain[-2] == "rfile"
                 and held):
@@ -170,4 +180,11 @@ class LockDisciplineChecker(Checker):
                     f"blocking read ({what}) under held lock(s) "
                     f"{'/'.join(held)} — a stalled sender wedges every "
                     "writer (PR 2 keeps body reads outside the write lock)"))
+            for lineno, held, what in scan.metric_renders:
+                out.append(Finding(
+                    self.id, "no-render-under-write-lock", mod.path, lineno,
+                    f"metrics render ({what}) under held lock(s) "
+                    f"{'/'.join(held)} — a scrape serialized against the "
+                    "write plane stalls binds for the whole render; expose "
+                    "paths snapshot-copy series data instead"))
         return out

@@ -1,0 +1,164 @@
+"""span-discipline checker: the span/metric instrumentation contract
+(PR 8, the telemetry tentpole — docs/OBSERVABILITY.md).
+
+The metrics-discipline checker covers declaration/verb/arity mistakes;
+this one covers the two failure modes the span subsystem (core/spans.py)
+adds:
+
+- a LIVE span (``start_span``) that is not ended on every path leaks an
+  open span: the ring never sees it, the stage silently vanishes from
+  p50/p99, and the per-pod chain-completeness gate reads as a mystery gap.
+  Record-complete spans (``record``/``event``) and scoped spans
+  (``with tracer.span(...)``) are immune by construction — which is why
+  they are the default API;
+- a span or metric call inside JIT-REACHABLE code is a host-state write
+  under trace: it records once at trace time, then never again (the
+  jit-purity incident class, composed here via the same reachability
+  walker — a tracer call one helper below a kernel is the same bug).
+
+Rules:
+
+- ``span-unended``: ``x = <...>.start_span(...)`` with NO matching
+  ``x.end(...)`` / ``<tracer>.end(x)`` in the same function;
+- ``span-end-unguarded``: the end call exists but none is inside a
+  ``finally`` block (an exception between start and end leaks the span) —
+  with/try coverage is the contract;
+- ``span-in-jit``: a ``...tracer.<verb>(...)`` / ``...metrics.<attr>.
+  inc|observe|set(...)`` call lexically inside a jit-reachable function
+  (reachability shared with jit-purity: decorated, jit(fn)-wrapped, or
+  transitively called same-module).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .base import (Checker, Finding, ModuleSource, attr_chain, build_parents,
+                   register)
+from .jit_purity import jit_reachable_functions
+
+TRACER_VERBS = {"record", "event", "span", "start_span", "end",
+                "context_for", "proc_ctx"}
+METRIC_VERBS = {"inc", "observe", "set"}
+
+
+def _is_start_span(call: ast.Call) -> bool:
+    chain = attr_chain(call.func)
+    return bool(chain) and chain[-1] == "start_span"
+
+
+def _in_finally(node: ast.AST, parents: Dict[ast.AST, ast.AST],
+                stop: ast.AST) -> bool:
+    """Is `node` lexically inside some Try's finalbody (searching up to the
+    enclosing function `stop`)?"""
+    child = node
+    parent = parents.get(node)
+    while parent is not None and child is not stop:
+        if isinstance(parent, ast.Try):
+            for stmt in parent.finalbody:
+                if child is stmt or any(child is n for n in ast.walk(stmt)):
+                    return True
+        child, parent = parent, parents.get(parent)
+    return False
+
+
+@register
+class SpanDisciplineChecker(Checker):
+    id = "span-discipline"
+    description = ("live spans (start_span) must be ended on all paths "
+                   "(with/try coverage); no span or metric call may appear "
+                   "inside jit-reachable code")
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        out: List[Finding] = []
+        tree = mod.tree
+        parents = build_parents(tree)
+        out.extend(self._check_unended(mod, tree, parents))
+        out.extend(self._check_jit(mod, tree))
+        return out
+
+    # -- span-unended / span-end-unguarded ---------------------------------
+
+    def _check_unended(self, mod: ModuleSource, tree: ast.AST,
+                       parents: Dict[ast.AST, ast.AST]) -> List[Finding]:
+        out: List[Finding] = []
+        fns = [n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)]
+        for fn in fns:
+            # starts bound to a name in THIS function (nested defs are their
+            # own scope pass — same convention as the donation walker).
+            starts: List[ast.Assign] = []
+            with_items: Set[ast.Call] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        if isinstance(item.context_expr, ast.Call):
+                            with_items.add(item.context_expr)
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Call)
+                        and _is_start_span(node.value)
+                        and node.value not in with_items):
+                    starts.append(node)
+            if not starts:
+                continue
+            # end sites: <name>.end(...) or <...>.end(<name>)
+            ends: Dict[str, List[ast.Call]] = {}
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "end"):
+                    continue
+                base = attr_chain(node.func.value)
+                if base and len(base) == 1:
+                    ends.setdefault(base[0], []).append(node)
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        ends.setdefault(arg.id, []).append(node)
+            for start in starts:
+                name = start.targets[0].id
+                end_calls = ends.get(name, [])
+                if not end_calls:
+                    out.append(Finding(
+                        self.id, "span-unended", mod.path, start.lineno,
+                        f"`{name} = ...start_span(...)` is never ended in "
+                        f"{fn.name} — the span leaks and its stage vanishes "
+                        "from latency percentiles (use record()/with "
+                        "tracer.span() or end() under finally)"))
+                elif not any(_in_finally(c, parents, fn) for c in end_calls):
+                    out.append(Finding(
+                        self.id, "span-end-unguarded", mod.path, start.lineno,
+                        f"`{name}` (start_span in {fn.name}) is ended only "
+                        "on the straight-line path — an exception between "
+                        "start and end leaks the span; end it in a finally "
+                        "block or use `with tracer.span(...)`"))
+        return out
+
+    # -- span-in-jit -------------------------------------------------------
+
+    def _check_jit(self, mod: ModuleSource, tree: ast.AST) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in jit_reachable_functions(tree):
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                chain = attr_chain(node.func)
+                if not chain:
+                    continue
+                verb = chain[-1]
+                if verb in TRACER_VERBS and "tracer" in chain[:-1]:
+                    out.append(Finding(
+                        self.id, "span-in-jit", mod.path, node.lineno,
+                        f"tracer.{verb}(...) inside jit-reachable "
+                        f"{fn.name}: span recording is a host-state write "
+                        "under trace — it fires once at trace time, never "
+                        "per call"))
+                elif verb in METRIC_VERBS and "metrics" in chain[:-1]:
+                    out.append(Finding(
+                        self.id, "span-in-jit", mod.path, node.lineno,
+                        f"metrics call {'.'.join(chain)}(...) inside "
+                        f"jit-reachable {fn.name}: the observation is baked "
+                        "in at trace time, not recorded per call"))
+        return out

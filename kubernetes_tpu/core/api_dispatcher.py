@@ -47,10 +47,21 @@ class APICall:
     # the binding subresource answers same-node replays idempotently).
     bind_args: Optional[tuple] = None
     bulk_execute: Optional[Callable[[List["APICall"]], list]] = None
+    # Wire trace context (core/spans.py format_ctx) riding the queued call:
+    # a deferred call can execute well behind its enqueue on a loaded
+    # shard, so failure records name the ORIGINAL pod trace (see _fail —
+    # `trace=<ctx>` in the error log links an async bind failure to its
+    # merged cross-process trace in the analyzer).
+    trace_ctx: Optional[str] = None
 
     @property
     def relevance(self) -> int:
         return RELEVANCE.get(self.call_type, 0)
+
+    def _fail(self, err) -> str:
+        """Error-log line for a failed execution, trace-attributed."""
+        tag = f" trace={self.trace_ctx}" if self.trace_ctx else ""
+        return f"{self.call_type}/{self.object_uid}{tag}: {err!r}"
 
 
 class APIDispatcher:
@@ -137,7 +148,7 @@ class APIDispatcher:
                                 call.call_type)
                         _time.sleep(delay)
                         continue
-                self.errors.append(f"{call.call_type}/{call.object_uid}: {e!r}")
+                self.errors.append(call._fail(e))
                 if self.metrics is not None:
                     self.metrics.async_api_call_execution_total.inc(
                         call.call_type, "error")
@@ -242,7 +253,7 @@ class APIDispatcher:
             if err is None:
                 self.executed += 1
                 continue
-            self.errors.append(f"{call.call_type}/{call.object_uid}: {err!r}")
+            self.errors.append(call._fail(err))
             if call.on_error is not None:
                 deferred.append((call, err))
         if deferred:

@@ -61,6 +61,7 @@ from ..api.types import (
     Volume,
     WeightedPodAffinityTerm,
 )
+from . import spans as _spans
 from .clientset import FakeClientset
 
 
@@ -387,6 +388,13 @@ class APIServer:
         # reference alive across a restart-in-place. set add/discard are
         # GIL-atomic; handler setup/finish are the only writers.
         self._conns: set = set()
+        # Trace context of the bind currently committing (core/spans.py):
+        # set around _bind_one under the write lock, read by the BOUND
+        # broadcast that fires synchronously inside store.bind on the same
+        # thread — so the slim BOUND event and the WAL record carry the
+        # binder's trace id out to every watcher.
+        self._bind_ctx = None
+        self.tracer = _spans.default_tracer()
 
     # -- durability (WAL + snapshot; core/wal.py) ---------------------------
 
@@ -551,9 +559,28 @@ class APIServer:
         return any(u["scalar"].get(k, 0) + v > alloc.scalar_resources.get(k, 0)
                    for k, v in req.scalar_resources.items())
 
-    def _bind_one(self, uid: str, node: str):
+    def _bind_one(self, uid: str, node: str, tctx: Optional[str] = None):
         """One bind attempt (caller holds the write lock) → (code, payload).
-        Shared by the single binding subresource and the bulk endpoint."""
+        Shared by the single binding subresource and the bulk endpoint.
+        ``tctx`` is the binder's wire trace context (X-Trace-Context header
+        / bulk-item tctx field); absent, the context derives from the pod
+        uid — deterministic sampling means both sides agree anyway."""
+        tr = self.tracer
+        ctx = (_spans.parse_ctx(tctx) if tctx else None) \
+            or tr.context_for(uid)
+        if not tr.wants(ctx):
+            return self._bind_one_locked(uid, node)
+        t0 = time.perf_counter()
+        self._bind_ctx = ctx
+        try:
+            code, payload = self._bind_one_locked(uid, node)
+        finally:
+            self._bind_ctx = None
+        tr.record("api.bind", ctx, time.perf_counter() - t0,
+                  node=node, code=code)
+        return code, payload
+
+    def _bind_one_locked(self, uid: str, node: str):
         pod = self.store.pods.get(uid)
         if pod is None:
             return 404, {"error": "pod not found"}
@@ -666,12 +693,21 @@ class APIServer:
         with self._lock:
             self._seq[kind] += 1
             event["rv"] = self._seq[kind]
+            # Span context of the committing bind (None for every other
+            # event class): times the WAL append and the watcher fanout
+            # into the binder's trace (stages wal.append / bound.fanout).
+            ctx = self._bind_ctx
             if self.persistence is not None:
                 # WAL append BEFORE fanout: an event a watcher saw is always
                 # recoverable. The record is the event itself plus the kind,
                 # so recovery rebuilds both the store and the watch backlog
                 # from one stream.
+                _tw = time.perf_counter() if ctx is not None else 0.0
                 self.persistence.append({"kind": kind, **event})
+                if ctx is not None:
+                    self.tracer.record("wal.append", ctx,
+                                       time.perf_counter() - _tw,
+                                       rv=event["rv"])
                 if self.persistence.should_compact():
                     try:
                         # Safe to read the store here: the writing thread
@@ -686,8 +722,14 @@ class APIServer:
                         self.compaction_failures += 1
             data = (json.dumps(event) + "\n").encode()
             self._backlog[kind].append((self._seq[kind], data))
+            _tf = time.perf_counter() if ctx is not None else 0.0
             for q in self._watchers[kind]:
                 q.put(data)
+            if ctx is not None:
+                self.tracer.record("bound.fanout", ctx,
+                                   time.perf_counter() - _tf,
+                                   watchers=len(self._watchers[kind]),
+                                   rv=event["rv"])
 
     def _pod_event(self, kind: str, old, new) -> None:
         typ = {"add": "ADDED", "update": "MODIFIED", "delete": "DELETED"}[kind]
@@ -699,8 +741,13 @@ class APIServer:
             # slim BOUND event carries just {uid, nodeName}: N shards each
             # decode every peer's binds, so the full-pod wire encode +
             # pod_from_wire rebuild per bind per watcher is pure scaling tax.
-            self._broadcast("pods", {"type": "BOUND", "object": {
-                "uid": new.uid, "nodeName": new.node_name}})
+            # A sampled bind adds its trace context (tctx) so every foreign
+            # shard's bound.observe span joins the binder's trace — and the
+            # WAL record (the event itself) preserves it across recovery.
+            obj = {"uid": new.uid, "nodeName": new.node_name}
+            if self._bind_ctx is not None:
+                obj["tctx"] = _spans.format_ctx(self._bind_ctx)
+            self._broadcast("pods", {"type": "BOUND", "object": obj})
             return
         self._broadcast("pods", {"type": typ, "object": pod_to_wire(new)})
 
@@ -950,14 +997,16 @@ class APIServer:
                     # batch-mates' commits.
                     out = [dict(payload, code=code) for code, payload in
                            (server._bind_one(item.get("uid", ""),
-                                             item.get("node", ""))
+                                             item.get("node", ""),
+                                             tctx=item.get("tctx"))
                             for item in self._body())]
                     return self._json(200, out)
                 parts = self.path.split("/")
                 if (self.path.startswith("/api/v1/pods/")
                         and self.path.endswith("/binding")):
                     code, payload = server._bind_one(
-                        parts[4], self._body()["node"])
+                        parts[4], self._body()["node"],
+                        tctx=self.headers.get(_spans.TRACE_HEADER))
                     return self._json(code, payload)
                 if (self.path.startswith("/api/v1/pods/")
                         and self.path.endswith("/status")):
@@ -1102,13 +1151,14 @@ class KeepAliveClient:
         self._local = threading.local()
 
     def call(self, method: str, path: str, body: Optional[dict] = None,
-             timeout: Optional[float] = None):
+             timeout: Optional[float] = None,
+             headers: Optional[Dict[str, str]] = None):
         import http.client as _hc
         import io
         from urllib import error as urlerror
 
         data = json.dumps(body).encode() if body is not None else None
-        headers = {"Content-Type": "application/json"}
+        headers = dict(headers or (), **{"Content-Type": "application/json"})
         t = timeout if timeout is not None else self._timeout
         may_replay = method in ("GET", "PUT")
         for attempt in (0, 1):
@@ -1259,8 +1309,23 @@ class HTTPClientset:
         self._call("DELETE", f"/api/v1/pods/{pod.uid}")
 
     def bind(self, pod: Pod, node_name: str) -> None:
-        self._call("POST", f"/api/v1/pods/{pod.uid}/binding",
-                   {"node": node_name})
+        # Trace propagation (core/spans.py): a sampled pod's bind carries
+        # its context in the X-Trace-Context header and records the
+        # bind.post span around the POST round trip.
+        tr = _spans.default_tracer()
+        ctx = tr.context_for(pod.uid)
+        if not tr.wants(ctx):
+            self._call("POST", f"/api/v1/pods/{pod.uid}/binding",
+                       {"node": node_name})
+            return
+        t0 = time.perf_counter()
+        try:
+            self._ka.call("POST", f"/api/v1/pods/{pod.uid}/binding",
+                          {"node": node_name},
+                          headers={_spans.TRACE_HEADER: _spans.format_ctx(ctx)})
+        finally:
+            tr.record("bind.post", ctx, time.perf_counter() - t0,
+                      node=node_name)
 
     def bind_many(self, pairs) -> list:
         """Bulk binding commits (POST /api/v1/bindings): one request for a
@@ -1270,8 +1335,23 @@ class HTTPClientset:
         and the reason string naming AlreadyBound/OutOfCapacity)."""
         import io
         from urllib.error import HTTPError
-        res = self._call("POST", "/api/v1/bindings",
-                         [{"uid": p.uid, "node": node} for p, node in pairs])
+        tr = _spans.default_tracer()
+        items = []
+        sampled = []  # contexts to close bind.post spans for
+        for p, node in pairs:
+            item = {"uid": p.uid, "node": node}
+            ctx = tr.context_for(p.uid)
+            if tr.wants(ctx):
+                # Bulk-bind batch membership rides per-item tctx fields —
+                # the server opens api.bind per item under this context.
+                item["tctx"] = _spans.format_ctx(ctx)
+                sampled.append(ctx)
+            items.append(item)
+        t0 = time.perf_counter()
+        res = self._call("POST", "/api/v1/bindings", items)
+        dur = time.perf_counter() - t0
+        for ctx in sampled:
+            tr.record("bind.post", ctx, dur, bulk=len(pairs))
         out = []
         for i, (p, _node) in enumerate(pairs):
             item = res[i] if res is not None and i < len(res) else {
@@ -1474,6 +1554,15 @@ class HTTPClientset:
             old = self.pods.get(obj["uid"])
             if old is None:
                 return  # pod unseen on this stream; the next re-list corrects
+            tctx = obj.get("tctx")
+            if tctx:
+                # Foreign-shard observation: this watcher decoded another
+                # scheduler's sampled bind — the span joins the binder's
+                # trace (same id), closing the cross-process chain.
+                ctx = _spans.parse_ctx(tctx)
+                if ctx is not None:
+                    _spans.default_tracer().event(
+                        "bound.observe", ctx, node=obj.get("nodeName", ""))
             pod = copy.copy(old)
             pod.node_name = obj.get("nodeName", "")
             self.pods[pod.uid] = pod
@@ -1541,6 +1630,7 @@ def main(argv=None) -> int:
     SIGTERM/SIGINT — the other half of the two-OS-process integration seam
     (ref test/integration/framework/test_server.go:78 StartTestServer)."""
     import argparse
+    import os
     import signal
 
     ap = argparse.ArgumentParser(prog="kubernetes-tpu-apiserver")
@@ -1565,6 +1655,20 @@ def main(argv=None) -> int:
     _sys.setswitchinterval(0.001)
     api = APIServer(data_dir=args.data_dir or None, fsync=args.fsync,
                     snapshot_every=args.snapshot_every)
+    # Observability (docs/OBSERVABILITY.md): label this process's spans and
+    # install the flight recorder into the durable data dir (or the
+    # explicit TPU_SCHED_FLIGHTREC_DIR). The periodic dump is what a chaos
+    # kill -9 leaves behind — no handler observes SIGKILL.
+    api.tracer.proc = "apiserver"
+    flight = None
+    flight_dir = os.environ.get("TPU_SCHED_FLIGHTREC_DIR") or args.data_dir
+    if flight_dir:
+        from .spans import FlightRecorder
+        flight = FlightRecorder(flight_dir, tracer=api.tracer,
+                                apiserver=api).install(
+            at_exit=True,
+            autodump_interval=float(
+                os.environ.get("TPU_SCHED_FLIGHTREC_INTERVAL", "5.0")))
     port = api.serve(args.port)
     # "serving on" stays the FIRST line: spawn harnesses select()+readline()
     # on it, and a buffered readline would swallow any earlier line together
@@ -1582,6 +1686,9 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
     api.shutdown()
+    if flight is not None:
+        flight.dump("shutdown")
+        flight.close()
     return 0
 
 

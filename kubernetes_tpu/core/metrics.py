@@ -39,7 +39,11 @@ class Counter(Metric):
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for key, v in sorted(self._values.items()):
+        # Snapshot-copy before iterating: /metrics renders on an HTTP
+        # thread while the scheduling loop mutates the series dicts —
+        # sorted() iterates and would raise RuntimeError on a concurrent
+        # resize. dict.copy() is a single C-level op under the GIL.
+        for key, v in sorted(self._values.copy().items()):
             out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v}")
         return out
 
@@ -58,7 +62,9 @@ class Gauge(Metric):
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        values = self._fn() if self._fn is not None else self._values
+        # Callback gauges return a fresh dict; stored values snapshot-copy
+        # (concurrent scrape vs scheduling-loop set(), as in Counter).
+        values = self._fn() if self._fn is not None else self._values.copy()
         for key, v in sorted(values.items()):
             out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {v}")
         return out
@@ -86,10 +92,14 @@ class Histogram(Metric):
         self._sums[key] = self._sums.get(key, 0.0) + value
         self._totals[key] = self._totals.get(key, 0) + 1
 
-    def _cumulative(self, key) -> List[int]:
+    def _cumulative(self, key, counts: Optional[Dict] = None) -> List[int]:
         out = []
         c = 0
-        for v in self._counts.get(key, ()):
+        # list() copy: observe() increments slots in place on the
+        # scheduling loop while a scrape renders — per-slot reads are
+        # GIL-atomic, the copy just pins one consistent-length view.
+        for v in list((counts if counts is not None
+                       else self._counts).get(key, ())):
             c += v
             out.append(c)
         return out
@@ -122,15 +132,21 @@ class Histogram(Metric):
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        for key in sorted(self._totals):
-            cums = self._cumulative(key)
+        # Snapshot-copy all three series dicts before iterating (scrape
+        # thread vs scheduling loop; see Counter.expose). A key present in
+        # totals but racing into counts/sums reads back zero this scrape.
+        totals = self._totals.copy()
+        sums = self._sums.copy()
+        counts = self._counts.copy()
+        for key in sorted(totals):
+            cums = self._cumulative(key, counts) or [0] * (len(self.buckets) + 1)
             for i, b in enumerate(self.buckets):
                 labels = _fmt_labels(self.label_names + ("le",), key + (str(b),))
                 out.append(f"{self.name}_bucket{labels} {cums[i]}")
             inf = _fmt_labels(self.label_names + ("le",), key + ("+Inf",))
             out.append(f"{self.name}_bucket{inf} {cums[-1]}")
-            out.append(f"{self.name}_sum{_fmt_labels(self.label_names, key)} {self._sums[key]}")
-            out.append(f"{self.name}_count{_fmt_labels(self.label_names, key)} {self._totals[key]}")
+            out.append(f"{self.name}_sum{_fmt_labels(self.label_names, key)} {sums.get(key, 0.0)}")
+            out.append(f"{self.name}_count{_fmt_labels(self.label_names, key)} {totals[key]}")
         return out
 
 
@@ -175,6 +191,13 @@ class SchedulerMetrics:
             "scheduler_pod_scheduling_sli_duration_seconds",
             "E2e latency for a pod being scheduled, from first attempt.",
             ("attempts",)))
+        self.e2e_scheduling_duration = r(Histogram(
+            "scheduler_e2e_scheduling_duration_seconds",
+            "End-to-end pod scheduling latency, queue admission -> bound "
+            "(fed from pod.e2e span ends; docs/OBSERVABILITY.md). Extended "
+            "buckets: late pods in a large drain legitimately wait tens of "
+            "seconds in the queue.",
+            buckets=DURATION_BUCKETS + (32.768, 65.536, 131.072)))
         self.framework_extension_point_duration = r(Histogram(
             "scheduler_framework_extension_point_duration_seconds",
             "Latency per extension point.", ("extension_point", "status", "profile")))

@@ -81,6 +81,10 @@ class QueuedPodInfo:
     timestamp: float = 0.0
     attempts: int = 0
     initial_attempt_timestamp: Optional[float] = None
+    # Queue-admission instant (never reset by requeues of THIS info object,
+    # unlike `timestamp`): the start of the queue.wait span and of the
+    # scheduler_e2e_scheduling_duration_seconds observation.
+    enqueued_at: Optional[float] = None
     unschedulable_plugins: Set[str] = field(default_factory=set)
     pending_plugins: Set[str] = field(default_factory=set)
     gated: bool = False
@@ -448,7 +452,8 @@ class PriorityQueue:
     def _new_qpi(self, pod: Pod) -> QueuedPodInfo:
         ts = self.now()
         return QueuedPodInfo(
-            pod_info=PodInfo.of(pod), timestamp=ts, initial_attempt_timestamp=None
+            pod_info=PodInfo.of(pod), timestamp=ts,
+            initial_attempt_timestamp=None, enqueued_at=ts,
         )
 
     def add(self, pod: Pod) -> None:

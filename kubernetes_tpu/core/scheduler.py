@@ -170,6 +170,7 @@ class Handle:
         skipped once the winner's commit lands through the watch feed."""
         s = self._scheduler
         s.state_unwinds += 1
+        lost_node = pod.node_name  # captured for the conflict span below
         s.cache.forget_pod(pod)
         pod.node_name = ""
         s.scheduled = max(0, s.scheduled - 1)
@@ -185,7 +186,7 @@ class Handle:
                 msg = _json.loads(exc.read()).get("error", "") or msg
             except Exception:  # noqa: BLE001 - keep the phrase fallback
                 pass
-            s._note_bind_conflict(msg)
+            s._note_bind_conflict(msg, pod, lost_node)
             s.conflict_requeues += 1
             # Same routing as the sync path's _unwind_binding: straight to
             # the backoffQ. Plain queue.add would put the loser on the
@@ -346,6 +347,11 @@ class Scheduler:
         # Event recorder + step tracing (schedule_one.go:1138, :574).
         from .tracing import EventRecorder
         self.recorder = EventRecorder()
+        # Pod-lifecycle spans (core/spans.py; docs/OBSERVABILITY.md): the
+        # process-global tracer — head-sampled, ring-buffered; every stage
+        # below checks `tracer.wants(ctx)` before building anything.
+        from .spans import default_tracer
+        self.tracer = default_tracer()
         # metrics
         self.attempts = 0
         self.scheduled = 0
@@ -747,7 +753,10 @@ class Scheduler:
         fw = self.framework_for_pod(pod)
         self.attempts += 1
         t0 = time.perf_counter()
-        trace = StepTrace("Scheduling", pod=f"{pod.namespace}/{pod.name}")
+        ctx = self.tracer.context_for(pod.uid)
+        self.record_queue_wait(qpi, ctx)
+        trace = StepTrace("Scheduling", ctx=ctx,
+                          pod=f"{pod.namespace}/{pod.name}")
         state = CycleState()
         try:
             self._process_one_traced(fw, state, qpi, trace, t0)
@@ -782,6 +791,11 @@ class Scheduler:
         self.queue.done(pod.uid)
         trace.step("binding cycle done")
         elapsed = time.perf_counter() - t0
+        if bound:
+            # Host-path commit span: the whole cycle (algorithm + bind
+            # enqueue) — the device path records finer-grained stages.
+            self.tracer.record("host.commit", trace.ctx, elapsed,
+                               node=result.suggested_host, path="host")
         self.metrics.schedule_attempts.inc("scheduled" if bound else "error", fw.profile_name)
         self.metrics.scheduling_attempt_duration.observe(
             elapsed, "scheduled" if bound else "error", fw.profile_name)
@@ -1425,6 +1439,7 @@ class Scheduler:
         self.cache.finish_binding(pod)
         self.queue.nominator.delete_nominated_pod(pod)
         self.scheduled += 1
+        self.observe_bound(qpi, node_name)
         self.recorder.eventf(
             pod.namespace + "/" + pod.name, "Normal", "Scheduled",
             ("Successfully assigned %s/%s to %s",
@@ -1448,18 +1463,59 @@ class Scheduler:
         self.queue.move_all_to_active_or_backoff(
             EVENT_ASSIGNED_POD_DELETE, pod, None)
         if getattr(st, "conflict", False):
-            self._note_bind_conflict(st.message())
+            self._note_bind_conflict(st.message(), pod, node_name)
             self.conflict_requeues += 1
             self.queue.requeue_conflict(qpi)
             return
         self.handle_scheduling_failure(fw, qpi, st, None)
 
-    def _note_bind_conflict(self, message: str) -> None:
+    def _note_bind_conflict(self, message: str, pod: Optional[Pod] = None,
+                            node: str = "") -> None:
         reason = ("capacity" if "OutOfCapacity" in message
                   else "already_bound" if "AlreadyBound" in message
                   else "conflict")
         self.bind_conflicts += 1
         self.metrics.bind_conflict_total.inc(reason)
+        if pod is not None:
+            # Conflict paths sample at 100% (forced context): the trace
+            # analyzer's cross-shard conflict timeline is built from these.
+            self.tracer.record(
+                "bind.conflict", self.tracer.context_for(pod.uid, force=True),
+                reason=reason, node=node,
+                pod=f"{pod.namespace}/{pod.name}")
+
+    # -- span helpers (core/spans.py; docs/OBSERVABILITY.md) ----------------
+
+    def record_queue_wait(self, qpi, ctx) -> None:
+        """Retroactive queue.admission event + queue.wait span, recorded at
+        pop time (no hot add-path cost). Guarded against double recording
+        when a device-popped pod falls back to the host cycle."""
+        tr = self.tracer
+        if not tr.wants(ctx) or getattr(qpi, "_qwait_recorded", False):
+            return
+        qpi._qwait_recorded = True
+        start = getattr(qpi, "enqueued_at", None)
+        wait = max(0.0, self.now() - start) if start is not None else 0.0
+        wall_pop = time.time()
+        tr.record("queue.admission", ctx, start=wall_pop - wait)
+        tr.record("queue.wait", ctx, wait, start=wall_pop - wait,
+                  attempts=qpi.attempts)
+
+    def observe_bound(self, qpi, node_name: str) -> None:
+        """Every successful bind feeds scheduler_e2e_scheduling_duration_
+        seconds (queue admission -> bound, ALL pods — the histogram is
+        latency truth, sampling only thins the span ring) and closes the
+        sampled pod's trace with its pod.e2e span."""
+        start = getattr(qpi, "enqueued_at", None)
+        if start is None:
+            return
+        e2e = max(0.0, self.now() - start)
+        self.metrics.e2e_scheduling_duration.observe(e2e)
+        tr = self.tracer
+        ctx = tr.context_for(qpi.pod.uid)
+        if tr.wants(ctx):
+            tr.record("pod.e2e", ctx, e2e, node=node_name,
+                      attempts=qpi.attempts)
 
     # -- failure (schedule_one.go:1152 handleSchedulingFailure) ------------
 

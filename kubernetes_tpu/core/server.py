@@ -73,6 +73,13 @@ class SchedulerServer:
                     self._respond(200, server.debugger.dump())
                 elif self.path == "/debug/comparer":
                     self._respond(200, json.dumps(server.debugger.compare()))
+                elif self.path.startswith("/debug/events"):
+                    # /debug/events[?object=<ns>/<name>]: the scheduler's
+                    # EventRecorder buffer NEWEST-FIRST (recorder.recent()
+                    # re-sorts by live timestamp — aggregated events mutate
+                    # count/timestamp in place, so insertion order lies).
+                    self._respond(200, server.expose_events(self.path),
+                                  "application/json")
                 else:
                     self._respond(404, "not found")
 
@@ -89,6 +96,22 @@ class SchedulerServer:
         t.start()
         self.mark_ready()
         return self._httpd.server_address[1]
+
+    def expose_events(self, path: str) -> str:
+        """/debug/events?object=<key> — the recorder buffer newest-first
+        (client-go event read surface, collapsed to the debug plane)."""
+        _, _, query = path.partition("?")
+        object_key = None
+        for part in query.split("&"):
+            if part.startswith("object="):
+                from urllib.parse import unquote
+                object_key = unquote(part.split("=", 1)[1])
+        events = self.scheduler.recorder.recent(object_key)
+        return json.dumps([
+            {"object": e.object_key, "type": e.type, "reason": e.reason,
+             "message": e.message, "count": e.count,
+             "timestamp": e.timestamp}
+            for e in events])
 
     def expose_resource_metrics(self) -> str:
         """/metrics/resources (app/server.go:376-379 →

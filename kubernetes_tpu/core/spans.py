@@ -1,0 +1,528 @@
+"""Cross-process pod-lifecycle spans + the crash-safe flight recorder.
+
+A dependency-free, OpenTelemetry-shaped span subsystem: one causal trace
+per pod, stitched across every hop of the scheduling pipeline — queue
+admission → queue wait → plan build (delta vs full) → device dispatch →
+device wait → host commit → bind POST → apiserver WAL append → BOUND
+fanout → foreign-shard observation. The reference measures the in-process
+half of this with ``framework_extension_point_duration_seconds`` and
+utiltrace (schedule_one.go:574); the cross-process half is Dapper-style
+context propagation (PAPERS [Dapper]) over the repo's existing wire
+surfaces: an ``X-Trace-Context`` header on the binding subresource, a
+``tctx`` field on bulk-bind items and slim BOUND events.
+
+Design constraints (this rides paths benchmarked at >10k pods/s):
+
+- **Deterministic head sampling.** A pod's trace id is a keyed hash of its
+  uid, and the 1-in-N sampling decision is a pure function of that id — so
+  every process (N schedulers + the apiserver) independently agrees which
+  pods are sampled with NO coordination, and the wire context only needs
+  to carry the force-sample override (conflict/requeue/fallback/adoption
+  paths record at 100%).
+- **Lock-free recording.** Completed spans append to a per-process ring
+  buffer (``collections.deque(maxlen=…)`` — append is GIL-atomic), so the
+  reflector thread, the dispatcher worker, apiserver handler threads, and
+  the scheduling loop all record without a lock. Unsampled pods pay one
+  memoized dict lookup.
+- **Record-complete spans.** Almost every span is recorded retroactively
+  with a known duration (``record``); live spans exist only as ``with``
+  blocks (``span``) or the explicit ``start_span``/``end`` pair that the
+  ``span-discipline`` analyzer checker polices (every started span must be
+  ended on all paths, and neither spans nor metrics may appear inside
+  jit-reachable code).
+
+The flight recorder dumps the span ring plus the last-K events/errors per
+process to ``<dir>/flightrec-<pid>.jsonl`` on SIGUSR2, on a StepTrace
+slow-step breach, on unhandled crash (excepthook + atexit, with
+``faulthandler`` covering native faults), and optionally on a periodic
+timer — so a chaos ``kill -9`` (which no handler can observe) still leaves
+a recent forensic artifact on disk instead of nothing.
+
+Stage-name taxonomy (the stable contract bench/analyzer share) is pinned
+in ``STAGES``/``CORE_CHAIN``; docs/OBSERVABILITY.md is the prose spec.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional
+
+TRACE_HEADER = "X-Trace-Context"
+
+# The pinned stage names (docs/OBSERVABILITY.md). bench.py --trace and the
+# trace analyzer CLI key on these strings; renames are contract breaks.
+STAGES = (
+    "queue.admission",   # pod entered this scheduler's queue (event)
+    "queue.wait",        # admission → pop
+    "plan.build",        # session plan acquisition (attrs: kind=full|delta|resume)
+    "device.dispatch",   # kernel dispatch enqueue
+    "device.wait",       # blocked on the device result fetch
+    "host.commit",       # assume/reserve/permit/bind host tail
+    "bind.post",         # binding POST leaves the scheduler (attrs: bulk)
+    "api.bind",          # apiserver binding subresource commit
+    "wal.append",        # durable WAL append of the BOUND event
+    "bound.fanout",      # BOUND event fanout to watch streams
+    "bound.observe",     # a watcher process decoded the BOUND event
+    "pod.e2e",           # admission → bound (feeds the e2e histogram)
+)
+# A bound pod's minimal complete chain. Device stages are optional (host-
+# path pods legitimately skip them); observe spans prove the fanout landed.
+CORE_CHAIN = ("queue.wait", "host.commit", "bind.post", "api.bind",
+              "wal.append", "bound.fanout")
+# Always-sampled forensic stages (recorded with force=True contexts).
+FORCED_STAGES = ("bind.conflict", "device.fallback", "shard.adopt",
+                 "trace.slow_step")
+
+_SAMPLE_ENV = "TPU_SCHED_TRACE_SAMPLE"
+_ENABLE_ENV = "TPU_SCHED_TRACE"
+DEFAULT_SAMPLE_N = 16
+
+
+class SpanContext:
+    """Trace identity + the sampling verdict. ``trace_id`` is 16 hex chars,
+    derived from the pod uid, identical in every process."""
+
+    __slots__ = ("trace_id", "sampled")
+
+    def __init__(self, trace_id: str, sampled: bool):
+        self.trace_id = trace_id
+        self.sampled = sampled
+
+
+def trace_id_for(uid: str) -> str:
+    """Deterministic 64-bit trace id (blake2b, not Python hash() — which is
+    per-process seeded and would break cross-process agreement)."""
+    return hashlib.blake2b(uid.encode(), digest_size=8).hexdigest()
+
+
+def format_ctx(ctx: SpanContext) -> str:
+    """Wire form for X-Trace-Context / tctx fields: ``<trace_id>-<flags>``
+    (flags 01 = sampled, the W3C traceparent flag octet)."""
+    return f"{ctx.trace_id}-{'01' if ctx.sampled else '00'}"
+
+
+def parse_ctx(wire: str) -> Optional[SpanContext]:
+    tid, _, flags = wire.partition("-")
+    if len(tid) != 16:
+        return None
+    return SpanContext(tid, flags != "00")
+
+
+class Span:
+    """A live span (``start_span``/``end``). Prefer ``record``/``span`` —
+    this exists for non-lexical lifetimes, and the span-discipline checker
+    requires every start to be ended under with/try coverage."""
+
+    __slots__ = ("name", "ctx", "attrs", "_t0", "_wall")
+
+    def __init__(self, name: str, ctx: SpanContext, attrs: dict):
+        self.name = name
+        self.ctx = ctx
+        self.attrs = attrs
+        self._t0 = time.perf_counter()
+        self._wall = time.time()
+
+
+class _ScopedSpan:
+    """``with tracer.span(...)`` — records on exit, error status on raise."""
+
+    __slots__ = ("_tracer", "_span")
+
+    def __init__(self, tracer: "SpanRecorder", span: Optional[Span]):
+        self._tracer = tracer
+        self._span = span
+
+    def __enter__(self):
+        return self._span
+
+    def __exit__(self, exc_type, _exc, _tb):
+        if self._span is not None:
+            if exc_type is not None:
+                self._span.attrs["error"] = exc_type.__name__
+            self._tracer.end(self._span)
+        return False
+
+
+class SpanRecorder:
+    """The per-process tracer: head-sampled, ring-buffered, lock-free."""
+
+    def __init__(self, capacity: int = 8192, sample_n: Optional[int] = None,
+                 proc: str = "", enabled: Optional[bool] = None):
+        if sample_n is None:
+            try:
+                sample_n = int(os.environ.get(_SAMPLE_ENV,
+                                              str(DEFAULT_SAMPLE_N)))
+            except ValueError:
+                sample_n = DEFAULT_SAMPLE_N
+        self.sample_n = max(1, sample_n)
+        if enabled is None:
+            enabled = os.environ.get(_ENABLE_ENV, "1") not in ("0", "false")
+        self.enabled = enabled
+        self.proc = proc or f"pid{os.getpid()}"
+        self.ring: "deque" = deque(maxlen=capacity)
+        self.recorded = 0  # total spans accepted (ring may have evicted)
+        self._ids = itertools.count(1)
+        # uid → base SpanContext memo (bounded; cleared wholesale on cap).
+        self._ctx_memo: Dict[str, SpanContext] = {}
+        self._ctx_cap = 8192
+        self._proc_ctx: Optional[SpanContext] = None
+
+    # -- contexts ----------------------------------------------------------
+
+    def context_for(self, uid: str, force: bool = False) -> SpanContext:
+        ctx = self._ctx_memo.get(uid)
+        if ctx is None:
+            tid = trace_id_for(uid)
+            ctx = SpanContext(tid, int(tid, 16) % self.sample_n == 0)
+            if len(self._ctx_memo) >= self._ctx_cap:
+                self._ctx_memo.clear()
+            self._ctx_memo[uid] = ctx
+        if force and not ctx.sampled:
+            return SpanContext(ctx.trace_id, True)
+        return ctx
+
+    def proc_ctx(self) -> SpanContext:
+        """Force-sampled process-scoped context for non-pod forensic spans
+        (breaker trips, shard adoptions)."""
+        if self._proc_ctx is None:
+            self._proc_ctx = SpanContext(
+                trace_id_for(f"proc:{self.proc}:{os.getpid()}"), True)
+        return self._proc_ctx
+
+    def wants(self, ctx: Optional[SpanContext]) -> bool:
+        return self.enabled and ctx is not None and ctx.sampled
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, name: str, ctx: SpanContext, duration: float = 0.0,
+               start: Optional[float] = None, parent: str = "",
+               **attrs) -> None:
+        """Append one COMPLETED span. ``start`` is wall-clock seconds
+        (time.time()); None means it ended just now."""
+        if not self.wants(ctx):
+            return
+        if start is None:
+            start = time.time() - duration
+        self.recorded += 1
+        self.ring.append({
+            "trace": ctx.trace_id,
+            "span": f"{os.getpid():x}.{next(self._ids):x}",
+            "parent": parent,
+            "name": name,
+            "proc": self.proc,
+            "pid": os.getpid(),
+            "ts": start,
+            "dur": duration,
+            "attrs": attrs,
+        })
+
+    def event(self, name: str, ctx: SpanContext, **attrs) -> None:
+        self.record(name, ctx, 0.0, **attrs)
+
+    def span(self, name: str, ctx: SpanContext, **attrs) -> _ScopedSpan:
+        """Scoped live span: ``with tracer.span("api.bind", ctx): ...``."""
+        live = Span(name, ctx, attrs) if self.wants(ctx) else None
+        return _ScopedSpan(self, live)
+
+    def start_span(self, name: str, ctx: SpanContext,
+                   **attrs) -> Optional[Span]:
+        """Open a live span for a non-lexical lifetime. The span-discipline
+        checker requires a matching ``end`` reached on all paths."""
+        if not self.wants(ctx):
+            return None
+        return Span(name, ctx, attrs)
+
+    def end(self, span: Optional[Span]) -> None:
+        if span is None:
+            return
+        self.record(span.name, span.ctx,
+                    time.perf_counter() - span._t0, start=span._wall,
+                    **span.attrs)
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> List[dict]:
+        for _ in range(4):
+            try:
+                return list(self.ring)
+            except RuntimeError:
+                continue  # concurrent append mid-copy: retry on fresh state
+        return []
+
+    def clear(self) -> None:
+        self.ring.clear()
+
+    def dump_jsonl(self, path: str) -> str:
+        """Write the ring as one span per line (atomic tmp+replace)."""
+        write_jsonl(path, self.snapshot())
+        return path
+
+
+def write_jsonl(path: str, rows: Iterable[dict]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    os.replace(tmp, path)
+
+
+def chrome_trace(spans: Iterable[dict]) -> dict:
+    """Convert span rows to the Chrome trace_event format (Perfetto/
+    chrome://tracing). Processes map to integer pids with process_name
+    metadata; spans are complete ('X') events in microseconds."""
+    procs: Dict[str, int] = {}
+    events: List[dict] = []
+    for s in spans:
+        proc = s.get("proc", "?")
+        pid = procs.setdefault(proc, len(procs) + 1)
+        events.append({
+            "name": s["name"], "cat": "sched", "ph": "X",
+            "ts": s["ts"] * 1e6, "dur": max(s.get("dur", 0.0), 0.0) * 1e6,
+            "pid": pid, "tid": 1,
+            "args": dict(s.get("attrs", {}), trace=s["trace"]),
+        })
+    for proc, pid in procs.items():
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": proc}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# process-global default tracer
+# ---------------------------------------------------------------------------
+
+_DEFAULT: Optional[SpanRecorder] = None
+
+
+def default_tracer() -> SpanRecorder:
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = SpanRecorder()
+    return _DEFAULT
+
+
+def set_default_tracer(tracer: Optional[SpanRecorder]) -> None:
+    """Swap the process tracer (tests; binaries label ``proc`` instead)."""
+    global _DEFAULT
+    _DEFAULT = tracer
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+_FLIGHT: Optional["FlightRecorder"] = None
+
+
+def request_dump(reason: str) -> Optional[str]:
+    """Dump through the installed flight recorder (rate-limited); no-op
+    when none is installed. The seam StepTrace/ShardMember call so they
+    need no direct dependency on recorder wiring."""
+    if _FLIGHT is None:
+        return None
+    return _FLIGHT.dump(reason, rate_limited=True)
+
+
+class FlightRecorder:
+    """Crash-safe forensic dumps: span ring + last-K events/errors/counters
+    per process, written to ``<dir>/flightrec-<pid>.jsonl``.
+
+    Triggers: SIGUSR2, StepTrace slow-step breach (via ``request_dump``),
+    unhandled crash (sys.excepthook chain + atexit; ``faulthandler`` covers
+    native faults into ``flightrec-<pid>.crash``), an optional periodic
+    timer — the only trigger that survives SIGKILL chaos (``kill -9``
+    leaves the last periodic artifact on disk) — and process exit when
+    ``at_exit`` is set. Dumps are atomic (tmp+``os.replace``), so a crash
+    mid-dump leaves the previous artifact intact."""
+
+    MIN_DUMP_INTERVAL = 2.0  # rate limit for breach-triggered dumps
+
+    def __init__(self, directory: str, tracer: Optional[SpanRecorder] = None,
+                 recorder=None, scheduler=None, apiserver=None,
+                 keep_events: int = 256):
+        self.directory = directory
+        self.tracer = tracer or default_tracer()
+        self.recorder = recorder      # tracing.EventRecorder (optional)
+        self.scheduler = scheduler    # core.Scheduler (optional)
+        self.apiserver = apiserver    # core.apiserver.APIServer (optional)
+        self.keep_events = keep_events
+        self.path = os.path.join(directory, f"flightrec-{os.getpid()}.jsonl")
+        self.dumps = 0
+        self._last_dump = 0.0
+        self._crashed = False
+        self._prev_excepthook = None
+        self._stop = threading.Event()
+        self._timer: Optional[threading.Thread] = None
+        # Serializes dumps across the autodump thread, request_dump callers,
+        # the SIGUSR2 handler, and shutdown. Non-blocking acquire: a dump
+        # already in flight makes a concurrent one redundant, and a SIGNAL
+        # handler interrupting a main-thread dump must skip, not deadlock.
+        self._dump_lock = threading.Lock()
+
+    # -- triggers ----------------------------------------------------------
+
+    def install(self, sigusr2: bool = True, on_crash: bool = True,
+                at_exit: bool = False,
+                autodump_interval: float = 0.0) -> "FlightRecorder":
+        global _FLIGHT
+        _FLIGHT = self
+        os.makedirs(self.directory, exist_ok=True)
+        if sigusr2:
+            self._install_sigusr2()
+        if on_crash:
+            self._install_crash_hooks(at_exit)
+        if autodump_interval > 0:
+            self._timer = threading.Thread(
+                target=self._autodump_loop, args=(autodump_interval,),
+                name="flightrec-autodump", daemon=True)
+            self._timer.start()
+        return self
+
+    def _install_sigusr2(self) -> None:
+        import signal
+        prev = signal.getsignal(signal.SIGUSR2)
+
+        def handler(signum, frame):
+            self.dump("sigusr2")
+            if callable(prev):  # chain (the cache debugger may also listen)
+                prev(signum, frame)
+
+        try:
+            signal.signal(signal.SIGUSR2, handler)
+        except ValueError:
+            pass  # not the main thread: signal triggers unavailable
+
+    def _install_crash_hooks(self, at_exit: bool) -> None:
+        import atexit
+        import faulthandler
+        import sys
+        try:
+            # Native faults (segfault/abort) can't run Python hooks; leave
+            # the interpreter-level dump beside the JSONL artifact.
+            self._crash_file = open(  # noqa: SIM115 - must outlive install
+                os.path.join(self.directory,
+                             f"flightrec-{os.getpid()}.crash"), "w")
+            faulthandler.enable(self._crash_file)
+        except (OSError, RuntimeError):
+            pass
+        self._prev_excepthook = sys.excepthook
+
+        def hook(exc_type, exc, tb):
+            self._crashed = True
+            try:
+                self.dump("crash", error=f"{exc_type.__name__}: {exc}")
+            except Exception:  # noqa: BLE001 - never mask the real crash
+                pass
+            (self._prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+        sys.excepthook = hook
+        atexit.register(self._atexit_dump, at_exit)
+
+    def _atexit_dump(self, always: bool) -> None:
+        if always or self._crashed:
+            try:
+                self.dump("exit" if not self._crashed else "crash-exit")
+            except Exception:  # noqa: BLE001 - exiting anyway
+                pass
+
+    def _autodump_loop(self, interval: float) -> None:
+        last_recorded = -1
+        while not self._stop.wait(interval):
+            try:
+                # Skip unchanged rings: serializing an 8k-span ring costs
+                # tens of ms of GIL — pointless when nothing new happened
+                # (idle shard, quiet apiserver).
+                if self.tracer.recorded == last_recorded:
+                    continue
+                last_recorded = self.tracer.recorded
+                self.dump("periodic")
+            except Exception:  # noqa: BLE001 - keep the timer alive
+                pass
+
+    def close(self) -> None:
+        global _FLIGHT
+        self._stop.set()
+        if self._timer is not None:
+            self._timer.join(timeout=2)
+            self._timer = None
+        if _FLIGHT is self:
+            _FLIGHT = None
+
+    # -- the dump ----------------------------------------------------------
+
+    def dump(self, reason: str, rate_limited: bool = False,
+             error: str = "") -> Optional[str]:
+        now = time.monotonic()
+        if rate_limited and now - self._last_dump < self.MIN_DUMP_INTERVAL:
+            return None
+        if not self._dump_lock.acquire(blocking=False):
+            return None  # a dump is already being produced
+        try:
+            return self._dump_locked(reason, now, error)
+        finally:
+            self._dump_lock.release()
+
+    def _dump_locked(self, reason: str, now: float, error: str) -> str:
+        self._last_dump = now
+        rows: List[dict] = [{
+            "kind": "meta", "reason": reason, "pid": os.getpid(),
+            "proc": self.tracer.proc, "time": time.time(),
+            "dump_seq": self.dumps, "error": error,
+        }]
+        for span in self.tracer.snapshot():
+            rows.append(dict(span, kind="span"))
+        if self.recorder is not None:
+            for ev in self.recorder.recent(limit=self.keep_events):
+                rows.append({
+                    "kind": "event", "object": ev.object_key,
+                    "reason": ev.reason, "type": ev.type,
+                    "message": ev.message, "count": ev.count,
+                    "ts": ev.timestamp})
+        rows.extend(self._scheduler_rows())
+        rows.extend(self._apiserver_rows())
+        os.makedirs(self.directory, exist_ok=True)
+        write_jsonl(self.path, rows)
+        self.dumps += 1
+        return self.path
+
+    def _scheduler_rows(self) -> List[dict]:
+        s = self.scheduler
+        if s is None:
+            return []
+        rows = [{"kind": "counters",
+                 "attempts": s.attempts, "scheduled": s.scheduled,
+                 "failures": s.failures,
+                 "bind_conflicts": s.bind_conflicts,
+                 "conflict_requeues": s.conflict_requeues,
+                 "state_unwinds": s.state_unwinds,
+                 "device_scheduled": getattr(s, "device_scheduled", 0),
+                 "host_path_pods": getattr(s, "host_path_pods", 0)}]
+        for line in list(s.error_log)[-self.keep_events:]:
+            rows.append({"kind": "error", "message": line})
+        member = getattr(s, "shard_member", None)
+        if member is not None:
+            rows.append({"kind": "shard",
+                         "owned": sorted(member.owned),
+                         "adoptions": member.adoptions,
+                         "handbacks": member.handbacks,
+                         "renewals": member.renewals})
+        return rows
+
+    def _apiserver_rows(self) -> List[dict]:
+        a = self.apiserver
+        if a is None:
+            return []
+        return [{"kind": "counters",
+                 "bind_conflicts": a.bind_conflicts,
+                 "capacity_conflicts": a.capacity_conflicts,
+                 "lease_conflicts": a.lease_conflicts,
+                 "lease_transitions": a.lease_transitions,
+                 "resumed_watches": a.resumed_watches,
+                 "relisted_watches": a.relisted_watches,
+                 "pods": len(a.store.pods), "nodes": len(a.store.nodes)}]

@@ -194,17 +194,22 @@ class TPUScheduler(Scheduler):
                 qpi = self.queue.pop()
             if qpi is None:
                 return None
-            if (not isinstance(qpi, (QueuedPodGroupInfo,
-                                     QueuedCompositeGroupInfo))
-                    and (qpi.pod.deletion_ts is not None
-                         or qpi.pod.uid in self.cache.pod_states)):
-                # skipPodSchedule: deleting pods never dispatch to device,
-                # and neither do pods the cache already placed (a reconcile
-                # unwind raced the bind confirm — see core process_one).
-                # (Group/composite entities are never skipped whole — their
-                # .pod is just the first member.)
-                self.queue.done(qpi.pod.uid)
-                continue
+            if not isinstance(qpi, (QueuedPodGroupInfo,
+                                    QueuedCompositeGroupInfo)):
+                if (qpi.pod.deletion_ts is not None
+                        or qpi.pod.uid in self.cache.pod_states):
+                    # skipPodSchedule: deleting pods never dispatch to
+                    # device, and neither do pods the cache already placed
+                    # (a reconcile unwind raced the bind confirm — see core
+                    # process_one). (Group/composite entities are never
+                    # skipped whole — their .pod is just the first member.)
+                    self.queue.done(qpi.pod.uid)
+                    continue
+                if self.tracer.enabled:
+                    # queue.wait ends here for device-path pods (host-path
+                    # pods record in process_one; the qpi guard dedups).
+                    self.record_queue_wait(
+                        qpi, self.tracer.context_for(qpi.pod.uid))
             return qpi
 
     def _collect_batch(self) -> Tuple[Optional[Framework], List[QueuedPodInfo], Optional[str]]:
@@ -732,6 +737,24 @@ class TPUScheduler(Scheduler):
 
     # -- resilience: device→host fallback + circuit breaker ----------------
 
+    def _batch_spans(self, name: str, qpis, duration: float,
+                     **attrs) -> None:
+        """Record one batch-level stage span into each SAMPLED member's
+        trace (per-pod copies keep the per-pod chain complete while the
+        cost scales with sampled pods, not batch size). Entities without a
+        plain pod (group infos riding gang paths) are skipped."""
+        tr = self.tracer
+        if not tr.enabled or not qpis:
+            return
+        wall = _time.time() - duration
+        for qpi in qpis:
+            pod = getattr(qpi, "pod", None)
+            if pod is None:
+                continue
+            ctx = tr.context_for(pod.uid)
+            if ctx.sampled:
+                tr.record(name, ctx, duration, start=wall, **attrs)
+
     def _note_device_failure(self, exc: BaseException, where: str) -> None:
         """One unexpected device-path exception: log it, count it, charge
         the breaker, and discard every piece of device-resident state the
@@ -741,6 +764,13 @@ class TPUScheduler(Scheduler):
         _log.error("device path failed in %s (%s: %s) — falling back to the "
                    "host path", where, reason, exc, exc_info=True)
         self.metrics.device_path_fallback.inc(reason)
+        # Fallbacks sample at 100% (forced process context): a flight-
+        # recorder dump of the span ring around this instant is exactly the
+        # forensic artifact the breaker incidents need.
+        self.tracer.record("device.fallback", self.tracer.proc_ctx(),
+                           where=where, reason=reason)
+        from ..core import spans as _spans
+        _spans.request_dump("device_fallback")
         opened = self.device_breaker.record_failure()
         if opened:
             _log.error(
@@ -1638,8 +1668,17 @@ class TPUScheduler(Scheduler):
         # could chain onto a volume session's attach-room plan (fuzz-caught).
         aux_shape = self._aux_shape(first_batch[0].pod)
         claims_rv = getattr(self.clientset, "resource_claims_rv", 0)
+        _tp0 = _time.perf_counter()
         state, plan, carry, node_names, _rkind = self._resume_or_rebuild(
             fw, first_batch[0].pod, sig, nsig, aux_shape, claims_rv)
+        _tp = _time.perf_counter() - _tp0
+        # Plan acquisition latency: the extension-point histogram gets
+        # EVERY session (p50/p99 truth); sampled pods get plan.build spans
+        # tagged with the acquisition kind (full/delta/resume).
+        self.metrics.framework_extension_point_duration.observe(
+            _tp, "DevicePlan", "Success", "")
+        self._batch_spans("plan.build", first_batch, _tp,
+                          kind=_rkind, batch=len(first_batch))
         sd = _SessionDelta(state, carry, self.cluster_event_seq)
         del state, carry
         start_unwinds = self.state_unwinds
@@ -1681,8 +1720,12 @@ class TPUScheduler(Scheduler):
                     if batch is None:
                         break
                     pending.append(batch)
+                _td0 = _time.perf_counter()
                 results, sd.carry = self._dispatch(
                     sd.state, plan, len(batch), sd.carry)
+                self._batch_spans("device.dispatch", batch,
+                                  _time.perf_counter() - _td0,
+                                  batch=len(batch))
                 # Start the device→host copy NOW: on a tunneled TPU the
                 # result fetch pays a full pipeline-flush RTT (~10s of ms);
                 # issuing it at dispatch time overlaps that latency with the
@@ -1709,10 +1752,17 @@ class TPUScheduler(Scheduler):
             res = np.asarray(results)  # one device→host fetch
             _t1 = _time.perf_counter()
             self.device_wait_s += _t1 - _t0
+            self.metrics.framework_extension_point_duration.observe(
+                _t1 - _t0, "DeviceWait", "Success", "")
+            self._batch_spans("device.wait", b, _t1 - _t0, batch=len(b))
             if not invalidated:
                 invalidated = self._commit_batch(
                     b, res, fw, node_names, ok_rows, dirty_rows)
-                self.host_commit_s += _time.perf_counter() - _t1
+                _tc = _time.perf_counter() - _t1
+                self.host_commit_s += _tc
+                self.metrics.framework_extension_point_duration.observe(
+                    _tc, "HostCommit", "Success", "")
+                self._batch_spans("host.commit", b, _tc, batch=len(b))
                 if getattr(self, "_after_flush", False):
                     # First retired batch after a flush: its pods scheduled
                     # from a fresh (non-chained) evaluation.
@@ -1958,6 +2008,7 @@ class TPUScheduler(Scheduler):
                 if nom._pod_to_node:
                     nom.delete_nominated_pod(pod)
                 self.scheduled += 1
+                self.observe_bound(qpi, node_name)
                 self.recorder.eventf(
                     pod.namespace + "/" + pod.name, "Normal", "Scheduled",
                     ("Successfully assigned %s/%s to %s",

@@ -766,6 +766,15 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
         }
     if points:
         result.detail["extension_points"] = points
+    # e2e scheduling latency (queue admission -> bound; fed from span ends
+    # — docs/OBSERVABILITY.md): p50/p99 truth next to the throughput number.
+    e2e = sched.metrics.e2e_scheduling_duration
+    if e2e.count():
+        result.detail["e2e_ms"] = {
+            "count": e2e.count(),
+            "p50": round(e2e.percentile(0.50) * 1e3, 3),
+            "p99": round(e2e.percentile(0.99) * 1e3, 3),
+        }
     # in-flight invariant (scheduler_perf.go:878-880 checkEmptyInFlightEvents)
     assert not sched.queue._in_flight, "in-flight events remain after workload"
     close = getattr(cs, "close", None)

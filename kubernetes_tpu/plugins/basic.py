@@ -181,9 +181,13 @@ class DefaultBinder:
                     dispatcher.executed += 1
                 return OK
             from ..core.api_dispatcher import APICall, CALL_BINDING
+            from ..core import spans as _spans
             on_error = getattr(self.handle, "on_async_bind_error", None)
+            _tr = _spans.default_tracer()
+            _ctx = _tr.context_for(pod.uid)
             dispatcher.add(APICall(
                 call_type=CALL_BINDING, object_uid=pod.uid,
+                trace_ctx=_spans.format_ctx(_ctx) if _tr.wants(_ctx) else None,
                 execute=lambda: self.handle.clientset.bind(pod, node_name),
                 bind_args=(pod, node_name),
                 # Stable bound method: the dispatcher batches consecutive

@@ -55,6 +55,74 @@ def _call(base: str, method: str, path: str, body=None, timeout: float = 30):
     return client.call(method, path, body, timeout=timeout)
 
 
+def scrape_histogram(base: str, name: str) -> Optional[dict]:
+    """GET /metrics → one histogram's merged bucket table across all label
+    sets: {"buckets": [(le, cumulative_count)...], "count": n, "sum": s}.
+    None when the series is absent. Lets the harness compute cross-shard
+    p50/p99 by summing per-shard cumulative buckets (bucket bounds are
+    identical — one metrics.py declaration)."""
+    req = urlrequest.Request(base + "/metrics")
+    with urlrequest.urlopen(req, timeout=30) as resp:
+        text = resp.read().decode()
+    buckets: Dict[float, float] = {}
+    count = total = 0.0
+    seen = False
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        m = re.match(rf'{name}_bucket{{.*le="([^"]+)".*}} (\S+)', line)
+        if m is not None:
+            seen = True
+            le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+            buckets[le] = buckets.get(le, 0.0) + float(m.group(2))
+            continue
+        m = re.match(rf"{name}_count(?:{{[^}}]*}})? (\S+)", line)
+        if m is not None:
+            count += float(m.group(1))
+            continue
+        m = re.match(rf"{name}_sum(?:{{[^}}]*}})? (\S+)", line)
+        if m is not None:
+            total += float(m.group(1))
+    if not seen:
+        return None
+    return {"buckets": sorted(buckets.items()), "count": count, "sum": total}
+
+
+def merge_histograms(hists: List[Optional[dict]]) -> Optional[dict]:
+    merged: Dict[float, float] = {}
+    count = total = 0.0
+    any_seen = False
+    for h in hists:
+        if h is None:
+            continue
+        any_seen = True
+        for le, c in h["buckets"]:
+            merged[le] = merged.get(le, 0.0) + c
+        count += h["count"]
+        total += h["sum"]
+    if not any_seen:
+        return None
+    return {"buckets": sorted(merged.items()), "count": count, "sum": total}
+
+
+def histogram_percentile(hist: dict, q: float) -> float:
+    """Bucket-interpolated percentile over a merged cumulative table (the
+    same interpolation as core/metrics.py Histogram.percentile)."""
+    if not hist or hist["count"] <= 0:
+        return 0.0
+    target = q * hist["count"]
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in hist["buckets"]:
+        if cum >= target:
+            if le == float("inf"):
+                return prev_le
+            span = cum - prev_cum
+            frac = (target - prev_cum) / span if span else 1.0
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = (0.0 if le == float("inf") else le), cum
+    return prev_le
+
+
 def scrape_metrics(base: str) -> Dict[str, float]:
     """GET /metrics → {series name: value}, label sets summed per name."""
     req = urlrequest.Request(base + "/metrics")
@@ -79,11 +147,19 @@ class ShardedCluster:
 
     def __init__(self, base: str, api_proc, shard_procs: List,
                  shard_urls: List[str]):
+        from ..testing.faults import drain_pipe
+
         self.base = base
         self.api_proc = api_proc
         self.shard_procs = shard_procs
         self.shard_urls = shard_urls
         self.killed: List[int] = []
+        # Keep every child's stdout pipe DRAINED for the cluster's whole
+        # life: a logging burst (slow-step warnings after a fallback) into
+        # an unread pipe blocks the child on write mid-cycle — measured as
+        # a ~2x pods/s collapse that looks like scheduler regression.
+        self.log_tails = [drain_pipe(p)
+                          for p in [api_proc] + list(shard_procs)]
 
     def kill(self, index: int) -> None:
         """SIGKILL one shard scheduler process — no goodbye, no flush."""
@@ -110,13 +186,19 @@ class ShardedCluster:
 
 def start_sharded_cluster(n_shards: int, lease_duration: float = 15.0,
                           data_dir: str = "",
+                          flightrec_dir: str = "",
                           startup_timeout: float = 180.0) -> ShardedCluster:
     """Spawn the apiserver + N shard scheduler processes; blocks until every
     process prints its ready line (shards spawn in parallel — each pays the
-    JAX import)."""
+    JAX import). ``flightrec_dir`` installs the flight recorder in every
+    process (TPU_SCHED_FLIGHTREC_DIR): periodic + exit dumps land there, so
+    even a SIGKILLed member leaves a recent forensic artifact."""
     from ..testing.faults import spawn_ready
 
     repo, env = _repo_root(), _env()
+    if flightrec_dir:
+        os.makedirs(flightrec_dir, exist_ok=True)
+        env["TPU_SCHED_FLIGHTREC_DIR"] = flightrec_dir
     cmd = [sys.executable, "-m", "kubernetes_tpu.core.apiserver",
            "--port", "0"]
     if data_dir:
@@ -165,6 +247,7 @@ def run_sharded_cluster(
     creator_threads: int = 8,
     timeout: float = 900.0,
     progress_cb: Optional[Callable[[int, ShardedCluster], None]] = None,
+    flightrec_dir: str = "",
 ) -> dict:
     """The sharded SchedulingBasic shape end to end: create `n_nodes`,
     warm the shards with `warm_pods` (XLA compilation + first sessions land
@@ -182,7 +265,8 @@ def run_sharded_cluster(
 
     cap = node_capacity or {"cpu": 32, "memory": "256Gi", "pods": 110}
     req = pod_request or {"cpu": "100m", "memory": "128Mi"}
-    cluster = start_sharded_cluster(n_shards, lease_duration=lease_duration)
+    cluster = start_sharded_cluster(n_shards, lease_duration=lease_duration,
+                                    flightrec_dir=flightrec_dir)
     base = cluster.base
     try:
         def post_many(path: str, wires: List[dict], chunk: int = 200) -> None:
@@ -252,12 +336,25 @@ def run_sharded_cluster(
         pods = _call(base, "GET", "/api/v1/pods", timeout=60)
         bound = {p["uid"]: p["nodeName"] for p in pods if p["nodeName"]}
         shard_metrics = []
+        e2e_hists = []
         for url in cluster.alive_shard_urls():
             try:
                 shard_metrics.append(scrape_metrics(url))
+                e2e_hists.append(scrape_histogram(
+                    url, "scheduler_e2e_scheduling_duration_seconds"))
             except Exception:  # noqa: BLE001 - a killed shard has no /metrics
                 shard_metrics.append({})
         api_metrics = scrape_metrics(base)
+        # Cross-shard e2e latency truth (queue admission -> bound): merged
+        # cumulative buckets, the p50/p99 bench.py --shards reports.
+        e2e = merge_histograms(e2e_hists)
+        e2e_ms = None
+        if e2e is not None and e2e["count"]:
+            e2e_ms = {
+                "p50": round(histogram_percentile(e2e, 0.50) * 1e3, 3),
+                "p99": round(histogram_percentile(e2e, 0.99) * 1e3, 3),
+                "count": int(e2e["count"]),
+            }
         return {
             "shards": n_shards,
             "nodes": n_nodes,
@@ -274,6 +371,8 @@ def run_sharded_cluster(
             "pods_per_sec": round(n_pods / elapsed, 1) if elapsed > 0 else 0.0,
             "distinct_bound_pods": len(bound),
             "killed_shards": list(cluster.killed),
+            "e2e_ms": e2e_ms,
+            "flightrec_dir": flightrec_dir,
             "api": {k: v for k, v in api_metrics.items()
                     if "conflict" in k or "lease" in k},
             "shard_metrics": [

@@ -163,6 +163,15 @@ class ShardMember:
         if grown:
             self.adoptions += len(grown)
             self.scheduler.metrics.shard_adoptions.inc(value=len(grown))
+            # Failover is a forensic moment: a 100%-sampled span marks it
+            # in the trace stream, and the flight recorder (when installed)
+            # dumps the ring so the adoption's surroundings survive even if
+            # this process dies next (docs/OBSERVABILITY.md).
+            from ..core import spans as _spans
+            tr = _spans.default_tracer()
+            tr.record("shard.adopt", tr.proc_ctx(), shards=sorted(grown),
+                      owned=sorted(new_owned))
+            _spans.request_dump("shard_adoption")
             self.sweep_pending()
         return True
 

@@ -194,6 +194,31 @@ class ChaosTCPProxy:
         self.drop_connections()
 
 
+def drain_pipe(proc, keep: int = 200) -> "deque":
+    """Start a daemon thread that keeps reading a spawned child's stdout
+    AFTER the ready line, retaining the last `keep` lines for diagnostics.
+
+    Without this, a child that logs under load (slow-step warnings, a
+    device-fallback traceback) eventually fills the 64KB pipe buffer and
+    BLOCKS on the write — mid-scheduling-cycle — which reads as a
+    mysterious 2x throughput collapse, not a log problem (PR 8 incident:
+    one fallback's host-path slow-step flood stalled a whole shard).
+    Returns the deque of retained lines."""
+    from collections import deque
+
+    tail: "deque" = deque(maxlen=keep)
+
+    def pump():
+        try:
+            for line in proc.stdout:
+                tail.append(line)
+        except (ValueError, OSError):
+            pass  # pipe closed at process teardown
+
+    threading.Thread(target=pump, name="pipe-drain", daemon=True).start()
+    return tail
+
+
 def spawn_ready(cmd, pattern, cwd=None, env=None, timeout=120.0):
     """Spawn a subprocess and block until a stdout line matches `pattern`
     (stderr is folded into stdout). select-before-readline: a
@@ -268,6 +293,9 @@ class ApiServerProcess:
                                    timeout=self.startup_timeout)
         # Pin the OS-assigned port: restarts re-bind the same one.
         self.port = int(m.group(1))
+        # Drained stdout (see drain_pipe): an unread pipe would block the
+        # server once it logs more than the 64KB buffer.
+        self.log_tail = drain_pipe(self.proc)
 
     def kill9(self) -> None:
         """SIGKILL — the process dies mid-write, no flush, no shutdown."""

@@ -1,0 +1,270 @@
+"""Trace analyzer CLI: merge per-process span logs, print per-pod
+critical-path breakdowns, per-stage latency percentiles, and cross-shard
+conflict timelines.
+
+    python -m kubernetes_tpu.trace <spans-or-flightrec .jsonl|dir>...
+        [--stage-stats] [--critical-paths N] [--conflicts]
+        [--completeness] [--chrome-trace out.json] [--json]
+
+Inputs are span JSONL files produced by ``SpanRecorder.dump_jsonl`` or
+flight-recorder artifacts (``flightrec-*.jsonl`` — span rows carry
+``kind: span``); directories are scanned for both. Spans from any number
+of processes merge by trace id (deterministic from the pod uid, so the
+scheduler that bound a pod, the apiserver, and every foreign shard agree
+with no coordination — core/spans.py). With no section flag, every
+section prints. The stage taxonomy is the pinned contract in
+``core/spans.py STAGES``; docs/OBSERVABILITY.md documents the output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+from .core.spans import CORE_CHAIN, chrome_trace
+
+# Pipeline order for critical-path rendering (wire order of the stages).
+_STAGE_ORDER = {name: i for i, name in enumerate((
+    "queue.admission", "queue.wait", "plan.build", "device.dispatch",
+    "device.wait", "host.commit", "bind.post", "api.bind", "wal.append",
+    "bound.fanout", "bound.observe", "pod.e2e"))}
+
+
+def load_spans(paths: List[str]) -> List[dict]:
+    """Load span rows from JSONL files/directories (flightrec artifacts
+    included — only their ``kind: span`` rows qualify; a raw span dump has
+    no ``kind`` field). Unparseable lines are skipped, not fatal: a crash
+    dump may legally end mid-line."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        else:
+            files.append(p)
+    spans: List[dict] = []
+    for path in files:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue  # torn final line of a crash dump
+                    kind = row.get("kind")
+                    if kind not in (None, "span"):
+                        continue
+                    if "trace" in row and "name" in row:
+                        spans.append(row)
+        except OSError:
+            continue
+    return spans
+
+
+def merge_traces(spans: List[dict]) -> Dict[str, List[dict]]:
+    """trace id → its spans, time-ordered."""
+    traces: Dict[str, List[dict]] = {}
+    for s in spans:
+        traces.setdefault(s["trace"], []).append(s)
+    for rows in traces.values():
+        rows.sort(key=lambda s: (s.get("ts", 0.0),
+                                 _STAGE_ORDER.get(s["name"], 99)))
+    return traces
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+def stage_stats(spans: List[dict]) -> Dict[str, dict]:
+    """Per-stage duration percentiles (seconds), stage-order sorted."""
+    by_stage: Dict[str, List[float]] = {}
+    for s in spans:
+        by_stage.setdefault(s["name"], []).append(float(s.get("dur", 0.0)))
+    out: Dict[str, dict] = {}
+    for name in sorted(by_stage, key=lambda n: (_STAGE_ORDER.get(n, 99), n)):
+        vals = sorted(by_stage[name])
+        out[name] = {
+            "count": len(vals),
+            "p50": _pct(vals, 0.50),
+            "p95": _pct(vals, 0.95),
+            "p99": _pct(vals, 0.99),
+        }
+    return out
+
+
+def completeness(traces: Dict[str, List[dict]]) -> dict:
+    """Of the traces that ended bound (have a bound.fanout or pod.e2e
+    span), how many carry the full CORE_CHAIN, and how many processes each
+    spanned. The bench acceptance gate (≥99% complete chains). The
+    effective chain is the CORE_CHAIN stages the corpus exhibits AT ALL
+    (reported as ``chain``): a memory-only apiserver has no wal.append, an
+    in-process bench has no wire stages — per-trace gaps against the
+    corpus-wide pipeline shape are what completeness measures."""
+    observed = {s["name"] for rows in traces.values() for s in rows}
+    chain = tuple(st for st in CORE_CHAIN if st in observed)
+    bound = complete = 0
+    proc_counts: List[int] = []
+    missing: Dict[str, int] = {}
+    for rows in traces.values():
+        names = {s["name"] for s in rows}
+        if "bound.fanout" not in names and "pod.e2e" not in names:
+            continue
+        bound += 1
+        procs = {s.get("proc", "?") for s in rows}
+        proc_counts.append(len(procs))
+        gaps = [st for st in chain if st not in names]
+        if gaps:
+            for g in gaps:
+                missing[g] = missing.get(g, 0) + 1
+        else:
+            complete += 1
+    return {
+        "bound_traces": bound,
+        "complete_chains": complete,
+        "complete_pct": round(100.0 * complete / bound, 2) if bound else 0.0,
+        "chain": list(chain),
+        "min_processes": min(proc_counts) if proc_counts else 0,
+        "max_processes": max(proc_counts) if proc_counts else 0,
+        "missing_stage_counts": missing,
+    }
+
+
+def critical_path(rows: List[dict]) -> List[dict]:
+    """One trace's stage breakdown in pipeline order (pod.e2e excluded —
+    it IS the total)."""
+    stages = [s for s in rows if s["name"] != "pod.e2e"]
+    stages.sort(key=lambda s: (_STAGE_ORDER.get(s["name"], 99),
+                               s.get("ts", 0.0)))
+    return stages
+
+
+def conflict_timeline(traces: Dict[str, List[dict]]) -> List[dict]:
+    """Cross-shard conflict timeline: who lost which node to whom, and the
+    wait→retry cost (conflict instant → the eventual bind commit in the
+    same trace)."""
+    out: List[dict] = []
+    for tid, rows in traces.items():
+        conflicts = [s for s in rows if s["name"] == "bind.conflict"]
+        if not conflicts:
+            continue
+        bind_end = None
+        for s in rows:
+            if s["name"] in ("pod.e2e", "api.bind"):
+                end = s.get("ts", 0.0) + s.get("dur", 0.0)
+                bind_end = end if bind_end is None else max(bind_end, end)
+        for c in conflicts:
+            attrs = c.get("attrs", {})
+            retry = (bind_end - c.get("ts", 0.0)
+                     if bind_end is not None and bind_end > c.get("ts", 0.0)
+                     else None)
+            out.append({
+                "trace": tid,
+                "ts": c.get("ts", 0.0),
+                "loser": c.get("proc", "?"),
+                "node": attrs.get("node", ""),
+                "reason": attrs.get("reason", "conflict"),
+                "retry_cost_s": round(retry, 6) if retry is not None else None,
+            })
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def summarize(spans: List[dict]) -> dict:
+    traces = merge_traces(spans)
+    return {
+        "spans": len(spans),
+        "traces": len(traces),
+        "processes": sorted({s.get("proc", "?") for s in spans}),
+        "stages": stage_stats(spans),
+        "completeness": completeness(traces),
+        "conflicts": conflict_timeline(traces),
+    }
+
+
+def _fmt_ms(v: float) -> str:
+    return f"{v * 1e3:9.3f}"
+
+
+def _print_report(summary: dict, traces: Dict[str, List[dict]],
+                  n_paths: int, out=sys.stdout) -> None:
+    w = out.write
+    w(f"{summary['spans']} spans / {summary['traces']} traces from "
+      f"{len(summary['processes'])} process(es): "
+      f"{', '.join(summary['processes'])}\n")
+    comp = summary["completeness"]
+    w(f"complete chains: {comp['complete_chains']}/{comp['bound_traces']} "
+      f"bound traces ({comp['complete_pct']}%), spanning "
+      f"{comp['min_processes']}-{comp['max_processes']} processes\n")
+    if comp["missing_stage_counts"]:
+        w(f"  missing stages: {comp['missing_stage_counts']}\n")
+    w("\nper-stage latency (ms):\n")
+    w(f"{'stage':<16} {'count':>7} {'p50':>9} {'p95':>9} {'p99':>9}\n")
+    for name, st in summary["stages"].items():
+        w(f"{name:<16} {st['count']:>7} {_fmt_ms(st['p50'])} "
+          f"{_fmt_ms(st['p95'])} {_fmt_ms(st['p99'])}\n")
+    if summary["conflicts"]:
+        w("\nconflict timeline:\n")
+        for c in summary["conflicts"]:
+            cost = (f"rebound after {c['retry_cost_s'] * 1e3:.1f}ms"
+                    if c["retry_cost_s"] is not None else "never rebound")
+            w(f"  t={c['ts']:.6f} {c['loser']} lost "
+              f"{c['node'] or '<node?>'} ({c['reason']}) trace={c['trace']} "
+              f"-> {cost}\n")
+    if n_paths:
+        # Longest per-pod critical paths first: where the time actually went.
+        with_e2e = []
+        for tid, rows in traces.items():
+            e2e = next((s for s in rows if s["name"] == "pod.e2e"), None)
+            if e2e is not None:
+                with_e2e.append((float(e2e.get("dur", 0.0)), tid, rows))
+        with_e2e.sort(reverse=True)
+        w(f"\ntop {min(n_paths, len(with_e2e))} critical paths:\n")
+        for total, tid, rows in with_e2e[:n_paths]:
+            w(f"  trace {tid} e2e={total * 1e3:.3f}ms\n")
+            for s in critical_path(rows):
+                w(f"    {s['name']:<16} {_fmt_ms(float(s.get('dur', 0.0)))}ms"
+                  f"  [{s.get('proc', '?')}]"
+                  f"{' ' + json.dumps(s['attrs']) if s.get('attrs') else ''}\n")
+
+
+def main(argv: Optional[List[str]] = None, out=sys.stdout) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes-tpu-trace", description=(
+        "merge per-process span logs by trace id; print per-pod "
+        "critical paths, per-stage p50/p95/p99, conflict timelines"))
+    ap.add_argument("inputs", nargs="+",
+                    help="span/flightrec .jsonl files or directories")
+    ap.add_argument("--critical-paths", type=int, default=3, metavar="N",
+                    help="show the N slowest per-pod critical paths")
+    ap.add_argument("--chrome-trace", default="", metavar="OUT.json",
+                    help="also write a Chrome trace_event file (Perfetto)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    args = ap.parse_args(argv)
+
+    spans = load_spans(args.inputs)
+    if not spans:
+        print("no spans found", file=sys.stderr)
+        return 1
+    summary = summarize(spans)
+    if args.chrome_trace:
+        with open(args.chrome_trace, "w") as f:
+            json.dump(chrome_trace(spans), f)
+        summary["chrome_trace"] = args.chrome_trace
+    if args.json:
+        out.write(json.dumps(summary, indent=2) + "\n")
+    else:
+        _print_report(summary, merge_traces(spans), args.critical_paths, out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -294,6 +294,7 @@ def test_metric_name_parity_with_reference():
     # Our additions beyond the reference set (device-path + resilience
     # series, docs/RESILIENCE.md; shard-plane series, docs/SHARDING.md).
     assert extra <= {"scheduler_batch_size",
+                     "scheduler_e2e_scheduling_duration_seconds",
                      "scheduler_podgroup_generated_placements",
                      "scheduler_async_api_call_retries_total",
                      "scheduler_device_path_fallback_total",

@@ -5,6 +5,8 @@ Deterministic-seed tests carry the `chaos` marker and run in tier-1; the
 long kill/restart stress is `slow` (excluded by `-m 'not slow'`).
 """
 
+import json
+import os
 import socket
 import threading
 import time
@@ -724,6 +726,21 @@ def test_apiserver_kill9_restart_mixed_churn(tmp_path):
         assert api.kills == 1 and api.restarts == 1
         assert http_cs.resumes["pods"] + http_cs.resumes["nodes"] >= 1
         assert dict(http_cs.relists) == relists_before
+        # Flight recorder (core/spans.py): the chaos kill leaves forensic
+        # artifacts in the data dir instead of nothing — the SIGKILLed
+        # process's periodic dumps and/or the restarted process's dumps
+        # (its graceful stop below guarantees a shutdown dump). Every
+        # artifact parses line-by-line and leads with a meta row.
+        api.stop()  # graceful: SIGTERM → shutdown dump (idempotent w/ finally)
+        art_dir = str(tmp_path / "apiserver-state")
+        arts = [f for f in os.listdir(art_dir)
+                if f.startswith("flightrec-") and f.endswith(".jsonl")]
+        assert arts, "apiserver chaos run left no flight-recorder artifact"
+        for name in arts:
+            with open(os.path.join(art_dir, name)) as f:
+                rows = [json.loads(line) for line in f if line.strip()]
+            assert rows and rows[0]["kind"] == "meta"
+            assert rows[0]["proc"] == "apiserver"
     finally:
         if driver is not None:
             driver.stop()
@@ -738,7 +755,7 @@ def test_apiserver_kill9_restart_mixed_churn(tmp_path):
 
 
 @pytest.mark.chaos
-def test_shard_kill_adoption_mixed_churn():
+def test_shard_kill_adoption_mixed_churn(tmp_path):
     """SIGKILL one of 3 shard scheduler PROCESSES mid-MixedChurn: its lease
     ages past expiry unrenewed, the ring successor adopts the dead range
     (sweeping the informer backlog the dead shard never drained), and the
@@ -770,9 +787,10 @@ def test_shard_kill_adoption_mixed_churn():
         w["labels"] = dict(w.get("labels") or {}, churn=str(state["churn"]))
         _call(cluster.base, "PUT", f"/api/v1/nodes/{w['name']}", w)
 
+    flightrec_dir = str(tmp_path / "flightrec")
     out = run_sharded_cluster(
         3, 40, 240, lease_duration=LEASE, warm_pods=24,
-        progress_cb=cb, timeout=420.0)
+        progress_cb=cb, timeout=420.0, flightrec_dir=flightrec_dir)
     assert out["killed_shards"] == [1]
     # zero lost bindings: the dead shard's range drained through adoption
     assert out["all_bound"], f"lost bindings: {out}"
@@ -786,6 +804,23 @@ def test_shard_kill_adoption_mixed_churn():
     assert sum(m.get("scheduler_shard_owned_shards", 0)
                for m in survivors) >= 3, survivors
     assert state["killed_at"] > 0  # the kill actually fired mid-run
+    # Flight recorder (core/spans.py): the chaos kill leaves forensic
+    # artifacts — the SIGKILLed member's periodic dumps survive on disk,
+    # the survivors dump at shutdown, and the ADOPTER's artifact carries
+    # the 100%-sampled shard.adopt span marking the failover instant.
+    arts = [f for f in os.listdir(flightrec_dir)
+            if f.startswith("flightrec-") and f.endswith(".jsonl")]
+    assert len(arts) >= 3, f"expected artifacts from ≥3 processes: {arts}"
+    adopt_spans = []
+    for name in arts:
+        with open(os.path.join(flightrec_dir, name)) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+        assert rows and rows[0]["kind"] == "meta"
+        adopt_spans += [r for r in rows
+                        if r.get("kind") == "span"
+                        and r.get("name") == "shard.adopt"]
+    assert adopt_spans, "no shard.adopt span in any flight-recorder artifact"
+    assert adopt_spans[0]["attrs"]["shards"]
 
 
 # ---------------------------------------------------------------------------

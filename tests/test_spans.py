@@ -1,0 +1,569 @@
+"""End-to-end scheduling telemetry (core/spans.py; docs/OBSERVABILITY.md):
+deterministic head sampling, ring-buffer wraparound, cross-process trace
+context propagation over the real apiserver wire (bind POST → WAL → BOUND
+event → foreign observer span), the crash-safe flight recorder (SIGUSR2 +
+real two-OS-process artifacts), StepTrace slow-step span events, the
+/debug/events read surface, and the trace analyzer CLI's golden output on
+a recorded fixture trace."""
+
+import io
+import json
+import logging
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from kubernetes_tpu.core import FakeClientset, Scheduler, spans
+from kubernetes_tpu.core.spans import (FlightRecorder, SpanRecorder,
+                                       format_ctx, parse_ctx, trace_id_for,
+                                       write_jsonl)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def tracer():
+    """Fresh sample-everything tracer installed as the process default;
+    restored afterward so other tests keep the head-sampled default."""
+    prev = spans.default_tracer()
+    t = SpanRecorder(sample_n=1, proc="test")
+    spans.set_default_tracer(t)
+    yield t
+    spans.set_default_tracer(prev)
+
+
+def _node(name, cpu="8", pods=110):
+    return (make_node().name(name)
+            .capacity({"cpu": cpu, "memory": "32Gi", "pods": pods}).obj())
+
+
+def _pod(name, cpu="200m"):
+    return make_pod().name(name).req({"cpu": cpu, "memory": "128Mi"}).obj()
+
+
+# ---------------------------------------------------------------------------
+# sampling + ring mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestSampling:
+    def test_sampling_is_deterministic_across_processes(self):
+        """Two independent tracers (≈ two processes) must agree on every
+        pod's trace id AND sampling verdict with no coordination — the
+        property the whole cross-process merge stands on."""
+        a = SpanRecorder(sample_n=16, proc="a")
+        b = SpanRecorder(sample_n=16, proc="b")
+        for i in range(500):
+            uid = f"uid-{i}"
+            ca, cb = a.context_for(uid), b.context_for(uid)
+            assert ca.trace_id == cb.trace_id == trace_id_for(uid)
+            assert ca.sampled == cb.sampled
+        sampled = sum(a.context_for(f"uid-{i}").sampled for i in range(500))
+        # 1-in-16 head sampling: statistically ~31 of 500
+        assert 5 <= sampled <= 100
+
+    def test_force_overrides_head_sampling(self):
+        t = SpanRecorder(sample_n=1 << 30)  # nothing head-samples
+        uid = "conflict-pod"
+        assert not t.context_for(uid).sampled
+        forced = t.context_for(uid, force=True)
+        assert forced.sampled and forced.trace_id == trace_id_for(uid)
+        # the base memo is NOT poisoned by the forced copy
+        assert not t.context_for(uid).sampled
+
+    def test_wire_context_roundtrip(self):
+        ctx = SpanRecorder(sample_n=1).context_for("u1")
+        wire = format_ctx(ctx)
+        back = parse_ctx(wire)
+        assert back.trace_id == ctx.trace_id and back.sampled
+        assert parse_ctx("garbage") is None
+        off = parse_ctx(f"{ctx.trace_id}-00")
+        assert off is not None and not off.sampled
+
+    def test_ring_buffer_wraparound(self):
+        t = SpanRecorder(capacity=8, sample_n=1)
+        for i in range(20):
+            t.record(f"s{i}", t.context_for(f"u{i}"))
+        rows = t.snapshot()
+        assert len(rows) == 8
+        assert [r["name"] for r in rows] == [f"s{i}" for i in range(12, 20)]
+        assert t.recorded == 20  # accepted count survives eviction
+
+    def test_disabled_tracer_records_nothing(self):
+        t = SpanRecorder(sample_n=1, enabled=False)
+        t.record("x", t.context_for("u"))
+        with t.span("y", t.context_for("u")):
+            pass
+        assert t.snapshot() == []
+
+    def test_scoped_span_records_error_attr(self):
+        t = SpanRecorder(sample_n=1)
+        with pytest.raises(ValueError):
+            with t.span("stage", t.context_for("u")):
+                raise ValueError("boom")
+        (row,) = t.snapshot()
+        assert row["attrs"]["error"] == "ValueError"
+
+
+# ---------------------------------------------------------------------------
+# in-process pipeline chain + e2e histogram
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerSpans:
+    def test_host_path_chain_and_e2e_histogram(self, tracer):
+        cs = FakeClientset()
+        s = Scheduler(clientset=cs, deterministic_ties=True)
+        for i in range(4):
+            cs.create_node(_node(f"n{i}"))
+        for i in range(6):
+            cs.create_pod(_pod(f"p{i}"))
+        s.run_until_idle()
+        assert s.scheduled == 6
+        names = {r["name"] for r in s.tracer.snapshot()}
+        assert {"queue.admission", "queue.wait",
+                "host.commit", "pod.e2e"} <= names
+        # e2e histogram fed for EVERY bound pod (latency truth, unsampled
+        # pods included) and exposed on /metrics
+        assert s.metrics.e2e_scheduling_duration.count() == 6
+        assert ("scheduler_e2e_scheduling_duration_seconds"
+                in s.expose_metrics())
+
+    def test_unsampled_pods_feed_histogram_but_not_ring(self):
+        prev = spans.default_tracer()
+        spans.set_default_tracer(SpanRecorder(sample_n=1 << 30, proc="off"))
+        try:
+            cs = FakeClientset()
+            s = Scheduler(clientset=cs, deterministic_ties=True)
+            cs.create_node(_node("n0"))
+            cs.create_pod(_pod("p0"))
+            s.run_until_idle()
+            assert s.scheduled == 1
+            assert s.metrics.e2e_scheduling_duration.count() == 1
+            assert s.tracer.snapshot() == []
+        finally:
+            spans.set_default_tracer(prev)
+
+    def test_bind_conflict_records_forced_span(self, tracer):
+        from tests.test_shard_plane import _Conflict409, _ConflictOnce
+
+        cs = FakeClientset()
+        sched = Scheduler(clientset=_ConflictOnce(cs),
+                          deterministic_ties=True)
+        for i in range(4):
+            cs.create_node(_node(f"n{i}"))
+        cs.create_pod(_pod("racer"))
+        sched.run_until_idle()
+        rows = [r for r in sched.tracer.snapshot()
+                if r["name"] == "bind.conflict"]
+        assert len(rows) == 1
+        assert rows[0]["attrs"]["reason"] == "already_bound"
+        assert rows[0]["attrs"]["node"]
+        assert rows[0]["trace"] == trace_id_for(
+            next(iter(cs.pods.values())).uid)
+
+    def test_device_path_records_stage_spans(self, tracer):
+        from kubernetes_tpu.models import TPUScheduler
+
+        cs = FakeClientset()
+        s = TPUScheduler(clientset=cs)
+        for i in range(8):
+            cs.create_node(_node(f"n{i}", cpu="32"))
+        proto = _pod("proto", cpu="100m")
+        for i in range(32):
+            cs.create_pod(proto.clone_from_template(f"p{i}"))
+        s.run_until_idle()
+        assert s.device_scheduled > 0
+        names = {r["name"] for r in s.tracer.snapshot()}
+        assert {"queue.wait", "plan.build", "device.dispatch",
+                "device.wait", "host.commit", "pod.e2e"} <= names
+        kinds = {r["attrs"].get("kind") for r in s.tracer.snapshot()
+                 if r["name"] == "plan.build"}
+        assert kinds & {"full", "delta", "resume"}
+        # span ends also feed the extension-point histogram (p50/p99 truth)
+        h = s.metrics.framework_extension_point_duration
+        for point in ("DevicePlan", "DeviceWait", "HostCommit"):
+            assert h.count(point, "Success", "") >= 1, point
+
+
+# ---------------------------------------------------------------------------
+# cross-process propagation over the real wire
+# ---------------------------------------------------------------------------
+
+
+class TestWirePropagation:
+    def test_trace_id_survives_bind_wal_bound_observer(self, tracer, tmp_path):
+        """bind POST → apiserver commit → WAL append → slim BOUND event →
+        a SECOND watch client's bound.observe span, all under the pod's
+        deterministic trace id; the WAL record preserves the context."""
+        from kubernetes_tpu.core.apiserver import APIServer, HTTPClientset
+
+        api = APIServer(data_dir=str(tmp_path / "state"))
+        api.tracer = tracer
+        port = api.serve(0)
+        binder = observer = None
+        try:
+            binder = HTTPClientset(f"http://127.0.0.1:{port}")
+            observer = HTTPClientset(f"http://127.0.0.1:{port}")
+            binder.create_node(_node("n0"))
+            p = _pod("traced")
+            binder.create_pod(p)
+            binder.bind(p, "n0")
+            # Wait for the BOUND event on BOTH watch streams: each records
+            # its bound.observe before updating its bindings cache.
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if all(c.bindings.get(p.uid) == "n0"
+                       for c in (binder, observer)):
+                    break
+                time.sleep(0.02)
+            assert observer.bindings.get(p.uid) == "n0"
+            assert binder.bindings.get(p.uid) == "n0"
+            tid = trace_id_for(p.uid)
+            names = sorted(r["name"] for r in tracer.snapshot()
+                           if r["trace"] == tid)
+            # binder + observer both decode the BOUND event → 2 observes
+            assert names == ["api.bind", "bind.post", "bound.fanout",
+                             "bound.observe", "bound.observe", "wal.append"]
+            wal = (tmp_path / "state" / "wal.log").read_text()
+            assert format_ctx(tracer.context_for(p.uid)) in wal
+        finally:
+            for c in (binder, observer):
+                if c is not None:
+                    c.close()
+            api.shutdown()
+
+    def test_bulk_bind_items_carry_context(self, tracer):
+        from kubernetes_tpu.core.apiserver import APIServer, HTTPClientset
+
+        api = APIServer()
+        api.tracer = tracer
+        port = api.serve(0)
+        cs = None
+        try:
+            cs = HTTPClientset(f"http://127.0.0.1:{port}")
+            cs.create_node(_node("n0", cpu="32"))
+            pods = [_pod(f"b{i}", cpu="100m") for i in range(4)]
+            for p in pods:
+                cs.create_pod(p)
+            assert cs.bind_many([(p, "n0") for p in pods]) == [None] * 4
+            rows = tracer.snapshot()
+            posts = [r for r in rows if r["name"] == "bind.post"]
+            assert len(posts) == 4
+            assert all(r["attrs"]["bulk"] == 4 for r in posts)
+            binds = {r["trace"] for r in rows if r["name"] == "api.bind"}
+            assert binds == {trace_id_for(p.uid) for p in pods}
+        finally:
+            if cs is not None:
+                cs.close()
+            api.shutdown()
+
+    @pytest.mark.chaos
+    def test_real_two_process_roundtrip_artifact(self, tracer, tmp_path):
+        """REAL two-OS-process round trip: the apiserver runs as its own
+        process (flight recorder installed into its data dir), the client
+        binds over the socket, and the server's flight-recorder artifact
+        holds the server-side half of the SAME trace id."""
+        from kubernetes_tpu.core.apiserver import HTTPClientset
+        from kubernetes_tpu.testing.faults import ApiServerProcess
+
+        api = ApiServerProcess(str(tmp_path / "state"))
+        cs = None
+        try:
+            cs = HTTPClientset(api.url)
+            cs.create_node(_node("n0"))
+            p = _pod("crosswire")
+            cs.create_pod(p)
+            cs.bind(p, "n0")
+            # The BOUND event arrives asynchronously on the watch stream;
+            # _dispatch records bound.observe BEFORE updating the bindings
+            # cache on the same thread, so the cache is the ready signal.
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if cs.bindings.get(p.uid) == "n0":
+                    break
+                time.sleep(0.02)
+            tid = trace_id_for(p.uid)
+            local = {r["name"] for r in tracer.snapshot()
+                     if r["trace"] == tid}
+            assert {"bind.post", "bound.observe"} <= local
+        finally:
+            if cs is not None:
+                cs.close()
+            api.stop()  # SIGTERM → graceful shutdown dump
+        arts = [f for f in os.listdir(tmp_path / "state")
+                if f.startswith("flightrec-") and f.endswith(".jsonl")]
+        assert arts, "apiserver process left no flight-recorder artifact"
+        rows = []
+        for a in arts:
+            with open(tmp_path / "state" / a) as f:
+                rows.extend(json.loads(line) for line in f if line.strip())
+        server_side = {r["name"] for r in rows
+                       if r.get("kind") == "span" and r.get("trace") == tid}
+        assert {"api.bind", "wal.append", "bound.fanout"} <= server_side
+        assert any(r.get("kind") == "meta" and r.get("proc") == "apiserver"
+                   for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_dump_on_sigusr2_and_parses(self, tracer, tmp_path):
+        cs = FakeClientset()
+        s = Scheduler(clientset=cs, deterministic_ties=True)
+        cs.create_node(_node("n0"))
+        cs.create_pod(_pod("p0"))
+        s.run_until_idle()
+        fr = FlightRecorder(str(tmp_path), tracer=tracer,
+                            recorder=s.recorder, scheduler=s).install(
+            on_crash=False)
+        try:
+            signal.raise_signal(signal.SIGUSR2)
+            path = tmp_path / f"flightrec-{os.getpid()}.jsonl"
+            assert path.exists()
+            rows = [json.loads(line) for line in path.read_text().splitlines()]
+            kinds = {r["kind"] for r in rows}
+            assert {"meta", "span", "event", "counters"} <= kinds
+            meta = rows[0]
+            assert meta["kind"] == "meta" and meta["reason"] == "sigusr2"
+            counters = next(r for r in rows if r["kind"] == "counters")
+            assert counters["scheduled"] == 1
+            assert any(r["kind"] == "event" and r["reason"] == "Scheduled"
+                       for r in rows)
+        finally:
+            fr.close()
+
+    def test_rate_limited_request_dump_and_slow_step_trigger(
+            self, tracer, tmp_path, caplog):
+        from kubernetes_tpu.core.tracing import StepTrace
+
+        fr = FlightRecorder(str(tmp_path), tracer=tracer).install(
+            sigusr2=False, on_crash=False)
+        try:
+            tr = StepTrace("Scheduling", ctx=tracer.context_for("slowpod"),
+                           pod="default/slowpod")
+            tr.t0 -= 0.5
+            tr._last = tr.t0
+            tr.step("plan build")
+            tr.step("fast tail")
+            with caplog.at_level(logging.WARNING, logger="kubernetes_tpu"):
+                tr.log_if_long()
+            # offending step named explicitly (utiltrace stepThreshold)
+            assert any("slow step(s) over" in r.getMessage()
+                       and "plan build" in r.getMessage()
+                       for r in caplog.records)
+            # a span event per offending step, on the pod's trace
+            slow = [r for r in tracer.snapshot()
+                    if r["name"] == "trace.slow_step"]
+            assert slow and slow[0]["attrs"]["step"] == "plan build"
+            assert slow[0]["trace"] == trace_id_for("slowpod")
+            # the breach dumped the flight recorder (then rate-limits)
+            assert fr.dumps == 1
+            assert fr.dump("again", rate_limited=True) is None
+        finally:
+            fr.close()
+
+    def test_individual_slow_step_without_pod_ctx_uses_proc_ctx(self, tracer):
+        from kubernetes_tpu.core.tracing import StepTrace
+
+        tr = StepTrace("Scheduling", pod="default/anon")
+        tr.t0 -= 0.3
+        tr._last = tr.t0
+        tr.step("everything")
+        tr.log_if_long()
+        slow = [r for r in tracer.snapshot() if r["name"] == "trace.slow_step"]
+        assert slow and slow[0]["trace"] == tracer.proc_ctx().trace_id
+
+    def test_autodump_timer_leaves_periodic_artifacts(self, tracer, tmp_path):
+        fr = FlightRecorder(str(tmp_path), tracer=tracer).install(
+            sigusr2=False, on_crash=False, autodump_interval=0.05)
+        try:
+            deadline = time.monotonic() + 5
+            path = tmp_path / f"flightrec-{os.getpid()}.jsonl"
+            while time.monotonic() < deadline and not path.exists():
+                time.sleep(0.02)
+            assert path.exists()
+            rows = [json.loads(line) for line in path.read_text().splitlines()]
+            assert rows[0]["reason"] == "periodic"
+        finally:
+            fr.close()
+
+
+# ---------------------------------------------------------------------------
+# /debug/events (EventRecorder read-side staleness fix)
+# ---------------------------------------------------------------------------
+
+
+class TestDebugEvents:
+    def test_recent_resorts_aggregated_events_newest_first(self):
+        from kubernetes_tpu.core.tracing import EventRecorder
+
+        rec = EventRecorder()
+        rec.eventf("default/a", "Warning", "FailedScheduling", "no fit")
+        rec.eventf("default/b", "Normal", "Scheduled", "assigned b")
+        # aggregate re-fires for a: its timestamp moves PAST b's, but the
+        # deque insertion order still has a first — the staleness bug
+        rec.eventf("default/a", "Warning", "FailedScheduling", "still no fit")
+        recent = rec.recent()
+        assert [e.object_key for e in recent] == ["default/a", "default/b"]
+        assert recent[0].count == 2
+        only_b = rec.recent("default/b")
+        assert len(only_b) == 1 and only_b[0].reason == "Scheduled"
+
+    def test_debug_events_endpoint_serves_recorder(self):
+        from urllib.request import urlopen
+
+        from kubernetes_tpu.core.server import SchedulerServer
+
+        cs = FakeClientset()
+        s = Scheduler(clientset=cs, deterministic_ties=True)
+        cs.create_node(_node("n0"))
+        cs.create_pod(_pod("p0"))
+        cs.create_pod(_pod("huge", cpu="64"))
+        s.run_until_idle()
+        srv = SchedulerServer(s)
+        port = srv.serve(0)
+        try:
+            body = json.loads(urlopen(
+                f"http://127.0.0.1:{port}/debug/events", timeout=5).read())
+            assert {e["reason"] for e in body} >= {"Scheduled",
+                                                   "FailedScheduling"}
+            # newest-first: the repeatedly re-aggregated FailedScheduling
+            # (huge requeues) must sort to the top despite older insertion
+            assert body[0]["timestamp"] >= body[-1]["timestamp"]
+            one = json.loads(urlopen(
+                f"http://127.0.0.1:{port}/debug/events?object=default/p0",
+                timeout=5).read())
+            assert one and all(e["object"] == "default/p0" for e in one)
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# trace analyzer CLI (golden output on a recorded fixture trace)
+# ---------------------------------------------------------------------------
+
+
+def _fixture_spans(tmp_path):
+    """A hand-recorded 2-process fixture: one complete bound-pod trace with
+    a cross-shard conflict, one incomplete trace."""
+    t0 = 1000.0
+    tid = trace_id_for("fixture-pod")
+    shard = [
+        {"trace": tid, "span": "1.1", "parent": "", "name": "queue.admission",
+         "proc": "shard-0", "pid": 1, "ts": t0, "dur": 0.0, "attrs": {}},
+        {"trace": tid, "span": "1.2", "parent": "", "name": "queue.wait",
+         "proc": "shard-0", "pid": 1, "ts": t0, "dur": 0.010, "attrs": {}},
+        {"trace": tid, "span": "1.3", "parent": "", "name": "bind.conflict",
+         "proc": "shard-0", "pid": 1, "ts": t0 + 0.012, "dur": 0.0,
+         "attrs": {"node": "n3", "reason": "already_bound"}},
+        {"trace": tid, "span": "1.4", "parent": "", "name": "host.commit",
+         "proc": "shard-0", "pid": 1, "ts": t0 + 0.050, "dur": 0.002,
+         "attrs": {}},
+        {"trace": tid, "span": "1.5", "parent": "", "name": "bind.post",
+         "proc": "shard-0", "pid": 1, "ts": t0 + 0.052, "dur": 0.003,
+         "attrs": {"bulk": 2}},
+        {"trace": tid, "span": "1.6", "parent": "", "name": "pod.e2e",
+         "proc": "shard-0", "pid": 1, "ts": t0, "dur": 0.056, "attrs": {}},
+        {"trace": trace_id_for("incomplete"), "span": "1.7", "parent": "",
+         "name": "queue.wait", "proc": "shard-0", "pid": 1, "ts": t0,
+         "dur": 0.001, "attrs": {}},
+    ]
+    api = [
+        {"trace": tid, "span": "2.1", "parent": "", "name": "api.bind",
+         "proc": "apiserver", "pid": 2, "ts": t0 + 0.053, "dur": 0.001,
+         "attrs": {"node": "n5", "code": 200}},
+        {"trace": tid, "span": "2.2", "parent": "", "name": "wal.append",
+         "proc": "apiserver", "pid": 2, "ts": t0 + 0.0535, "dur": 0.0005,
+         "attrs": {"rv": 7}},
+        {"trace": tid, "span": "2.3", "parent": "", "name": "bound.fanout",
+         "proc": "apiserver", "pid": 2, "ts": t0 + 0.054, "dur": 0.0002,
+         "attrs": {"watchers": 2}},
+    ]
+    write_jsonl(str(tmp_path / "spans-shard0.jsonl"), shard)
+    write_jsonl(str(tmp_path / "spans-api.jsonl"), api)
+    return tid
+
+
+class TestAnalyzerCLI:
+    def test_golden_report_on_fixture_trace(self, tmp_path):
+        from kubernetes_tpu import trace as trace_mod
+
+        tid = _fixture_spans(tmp_path)
+        buf = io.StringIO()
+        rc = trace_mod.main([str(tmp_path), "--critical-paths", "1"], out=buf)
+        assert rc == 0
+        out = buf.getvalue()
+        # merged across both processes
+        assert "2 process(es): apiserver, shard-0" in out
+        # completeness: 1 bound trace, complete core chain
+        assert "complete chains: 1/1 bound traces (100.0%)" in out
+        # per-stage table with pipeline ordering and p50/p95/p99 columns
+        assert "per-stage latency (ms):" in out
+        assert out.index("queue.wait") < out.index("bind.post") \
+            < out.index("wal.append")
+        # conflict timeline: who lost which node, and the wait→retry cost
+        assert "shard-0 lost n3 (already_bound)" in out
+        assert "rebound after" in out
+        # critical path breakdown names the trace and its stages in order
+        assert f"trace {tid}" in out
+        assert "[apiserver]" in out and "[shard-0]" in out
+
+    def test_json_summary_and_chrome_trace_export(self, tmp_path):
+        from kubernetes_tpu import trace as trace_mod
+
+        _fixture_spans(tmp_path)
+        out_json = tmp_path / "chrome.json"
+        buf = io.StringIO()
+        rc = trace_mod.main([str(tmp_path), "--json",
+                             "--chrome-trace", str(out_json)], out=buf)
+        assert rc == 0
+        summary = json.loads(buf.getvalue())
+        assert summary["completeness"]["complete_chains"] == 1
+        assert summary["stages"]["queue.wait"]["count"] == 2
+        assert summary["conflicts"][0]["retry_cost_s"] > 0
+        chrome = json.loads(out_json.read_text())
+        assert chrome["traceEvents"]
+        assert {e["ph"] for e in chrome["traceEvents"]} == {"X", "M"}
+        names = {e["args"]["name"] for e in chrome["traceEvents"]
+                 if e["ph"] == "M"}
+        assert names == {"shard-0", "apiserver"}
+
+    def test_cli_module_entrypoint(self, tmp_path):
+        import subprocess
+
+        _fixture_spans(tmp_path)
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu.trace", str(tmp_path)],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr
+        assert "per-stage latency" in proc.stdout
+        empty = subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu.trace",
+             str(tmp_path / "nothing-here")],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+        assert empty.returncode == 1
+
+    def test_flightrec_artifacts_load_as_spans(self, tmp_path, tracer):
+        """load_spans must accept flight-recorder artifacts (kind-tagged
+        rows, non-span rows skipped) and torn final lines."""
+        from kubernetes_tpu import trace as trace_mod
+
+        tracer.record("queue.wait", tracer.context_for("u1"), 0.001)
+        fr = FlightRecorder(str(tmp_path), tracer=tracer)
+        fr.dump("test")
+        # torn tail: a crash can cut a line mid-write
+        with open(fr.path, "a") as f:
+            f.write('{"kind": "span", "trace": "tr')
+        spans_loaded = trace_mod.load_spans([str(tmp_path)])
+        assert len(spans_loaded) == 1
+        assert spans_loaded[0]["name"] == "queue.wait"

@@ -192,6 +192,34 @@ class TestLockDisciplineFixtures:
         assert c.applies_to("core/wal.py")
         assert not c.applies_to("core/scheduler.py")
 
+    def test_flags_metrics_render_under_write_lock(self):
+        """PR 8 rule: /metrics exposition must never hold the write lock —
+        a scrape serialized against the write plane stalls every bind for
+        the whole render (ROADMAP: /metrics/resources contention)."""
+        bad = textwrap.dedent("""
+            class Server:
+                def do_GET(self):
+                    with self._write_lock:
+                        body = self.expose_metrics()
+                def expose_metrics(self):
+                    return ""
+        """)
+        fs = check_source(checker_by_id("lock-discipline"), bad)
+        assert "no-render-under-write-lock" in _rules(fs)
+
+    def test_render_outside_write_lock_is_clean(self):
+        good = textwrap.dedent("""
+            class Server:
+                def do_GET(self):
+                    body = self.expose_metrics()   # no lock held: fine
+                    with self._lock:
+                        n = len(self._watchers)    # broadcast lock ≠ write
+                def expose_metrics(self):
+                    return ""
+        """)
+        fs = check_source(checker_by_id("lock-discipline"), good)
+        assert "no-render-under-write-lock" not in _rules(fs)
+
 
 # ---------------------------------------------------------------------------
 # fixture corpus: jit-purity
@@ -406,6 +434,80 @@ class TestMetricsDisciplineFixtures:
 
 
 # ---------------------------------------------------------------------------
+# fixture corpus: span-discipline (PR 8 telemetry contract)
+# ---------------------------------------------------------------------------
+
+
+class TestSpanDisciplineFixtures:
+    def test_flags_unended_and_unguarded_starts(self):
+        bad = textwrap.dedent("""
+            class S:
+                def leak(self, pod):
+                    sp = self.tracer.start_span("api.bind", self.ctx)
+                    self.commit(pod)               # never ended: leaks
+                def unguarded(self, pod):
+                    sp = self.tracer.start_span("api.bind", self.ctx)
+                    self.commit(pod)               # raises -> end skipped
+                    self.tracer.end(sp)
+        """)
+        fs = check_source(checker_by_id("span-discipline"), bad)
+        assert _rules(fs) == ["span-end-unguarded", "span-unended"]
+
+    def test_passes_with_scoped_and_finally_ended_spans(self):
+        good = textwrap.dedent("""
+            class S:
+                def scoped(self, pod):
+                    with self.tracer.span("api.bind", self.ctx):
+                        self.commit(pod)
+                def guarded(self, pod):
+                    sp = self.tracer.start_span("api.bind", self.ctx)
+                    try:
+                        self.commit(pod)
+                    finally:
+                        self.tracer.end(sp)
+                def method_form(self, pod):
+                    sp = self.tracer.start_span("api.bind", self.ctx)
+                    try:
+                        self.commit(pod)
+                    finally:
+                        sp.end()
+                def retro(self, pod):
+                    self.tracer.record("api.bind", self.ctx, 0.1)  # complete
+        """)
+        assert check_source(checker_by_id("span-discipline"), good) == []
+
+    def test_flags_span_and_metric_calls_in_jit_reachable_code(self):
+        """Composes with the jit-purity walker: a tracer/metrics call one
+        helper below a jitted kernel is the same trace-time-bake bug."""
+        bad = textwrap.dedent("""
+            import jax
+            @jax.jit
+            def kernel(x, self):
+                return _helper(x, self)
+            def _helper(x, self):
+                self.tracer.record("device.wait", self.ctx, 0.1)
+                self.metrics.batch_size.observe(4)
+                return x
+        """)
+        fs = check_source(checker_by_id("span-discipline"), bad)
+        assert _rules(fs) == ["span-in-jit"]
+        assert len(fs) == 2
+
+    def test_host_side_span_and_metric_calls_are_clean(self):
+        good = textwrap.dedent("""
+            import jax
+            @jax.jit
+            def kernel(x):
+                return x + 1
+            def host_commit(self, batch):
+                self.tracer.record("host.commit", self.ctx, 0.1)
+                self.metrics.batch_size.observe(len(batch))
+                return kernel(batch)
+        """)
+        assert check_source(checker_by_id("span-discipline"), good) == []
+
+
+# ---------------------------------------------------------------------------
 # the tree gate + allowlist policy
 # ---------------------------------------------------------------------------
 
@@ -424,7 +526,7 @@ def test_every_checker_registered_and_described():
     checkers = all_checkers()
     ids = sorted(c.id for c in checkers)
     assert ids == ["index-dtype", "jit-purity", "lock-discipline",
-                   "metrics-discipline", "thread-hygiene"]
+                   "metrics-discipline", "span-discipline", "thread-hygiene"]
     assert all(c.description for c in checkers)
 
 
