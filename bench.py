@@ -106,15 +106,20 @@ def make_pods(n, name_prefix):
     return [proto.clone_from_template(f"{name_prefix}-{i}") for i in range(n)]
 
 
-def main_sharded(n_shards: int, trace: bool = False) -> None:
-    """`bench.py --shards N [--trace]`: the same SchedulingBasic shape
-    through the multi-process shard plane (kubernetes_tpu/shard/harness.py)
-    — one apiserver process + N scheduler processes over HTTP. N=1 is the
-    like-for-like single-scheduler baseline (same transport, same store);
-    the acceptance comparison is N=2 vs N=1 pods/s. With --trace, every
-    process dumps its span ring (flight recorder) and the merged trace
-    analysis — per-stage p50/p99, chain completeness, conflict timeline —
-    rides the detail object (docs/OBSERVABILITY.md)."""
+def main_sharded(n_shards: int, trace: bool = False,
+                 replicas: int = 0) -> None:
+    """`bench.py --shards N [--trace] [--replicas R]`: the same
+    SchedulingBasic shape through the multi-process shard plane
+    (kubernetes_tpu/shard/harness.py) — one apiserver process + N scheduler
+    processes over HTTP. N=1 is the like-for-like single-scheduler baseline
+    (same transport, same store); the acceptance comparison is N=2 vs N=1
+    pods/s. With --trace, every process dumps its span ring (flight
+    recorder) and the merged trace analysis — per-stage p50/p99, chain
+    completeness, conflict timeline — rides the detail object
+    (docs/OBSERVABILITY.md). With --replicas R, R follower apiservers tail
+    the leader's WAL and serve each shard's read plane
+    (kubernetes_tpu/replication/); the detail line carries per-replica
+    role/lag and the leader's replication counters."""
     import tempfile
 
     from kubernetes_tpu.shard.harness import run_sharded_cluster
@@ -131,7 +136,7 @@ def main_sharded(n_shards: int, trace: bool = False) -> None:
     warmup = int(os.environ.get("BENCH_WARMUP", 1024)) * n_shards
     out = run_sharded_cluster(
         n_shards, n_nodes, n_pods, warm_pods=warmup,
-        flightrec_dir=flightrec_dir,
+        flightrec_dir=flightrec_dir, replicas=replicas,
         # 15s, not the chaos tests' 2-3s: the renewer is a Python thread,
         # and on an oversubscribed box (N shards + apiserver on few cores)
         # a tight lease flaps — a starved renewer misses one period, a peer
@@ -142,6 +147,9 @@ def main_sharded(n_shards: int, trace: bool = False) -> None:
     detail = {k: out[k] for k in ("shards", "bound", "all_bound",
                                   "elapsed_s", "distinct_bound_pods")}
     detail["api"] = out["api"]
+    if replicas:
+        detail["replicas"] = out["replicas"]
+        detail["replication"] = out["replication"]
     detail["shard_metrics"] = out["shard_metrics"]
     detail["platform"] = "cpu (sharded subprocesses)"
     # e2e latency truth (scheduler_e2e_scheduling_duration_seconds, merged
@@ -257,7 +265,9 @@ if __name__ == "__main__":
         sys.exit(probe())
     _trace = "--trace" in sys.argv
     if "--shards" in sys.argv:
+        _replicas = (int(sys.argv[sys.argv.index("--replicas") + 1])
+                     if "--replicas" in sys.argv else 0)
         main_sharded(int(sys.argv[sys.argv.index("--shards") + 1]),
-                     trace=_trace)
+                     trace=_trace, replicas=_replicas)
         sys.exit(0)
     main(trace=_trace)

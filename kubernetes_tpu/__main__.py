@@ -56,7 +56,12 @@ def main(argv=None) -> int:
     ap.add_argument("--api-url", default="",
                     help="schedule against a remote apiserver "
                          "(core/apiserver.py REST+watch) instead of the "
-                         "in-process store")
+                         "in-process store; with a replicated control "
+                         "plane, point this at the shard's FOLLOWER")
+    ap.add_argument("--api-fallbacks", default="",
+                    help="comma-separated sibling replica base URLs: the "
+                         "reflector rotates to one (and RESUMEs by rv) "
+                         "when --api-url's replica dies")
     ap.add_argument("--port", type=int, default=10259,
                     help="healthz/metrics port (0 = ephemeral)")
     ap.add_argument("--leader-elect", action="store_true")
@@ -121,7 +126,9 @@ def main(argv=None) -> int:
         # async API dispatcher retry at that layer TOO — the layers compose
         # (worst case attempts multiply, bounded by both small budgets);
         # the wrapper here is what covers the dispatcher-less sync writes.
-        cs_kw["clientset"] = RetryingClientset(HTTPClientset(args.api_url))
+        cs_kw["clientset"] = RetryingClientset(HTTPClientset(
+            args.api_url,
+            fallbacks=[u for u in args.api_fallbacks.split(",") if u]))
     sched = TPUScheduler(config=cfg, **cs_kw)
     if args.cluster:
         _load_cluster(sched.clientset, args.cluster)

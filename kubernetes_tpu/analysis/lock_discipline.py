@@ -9,19 +9,34 @@ Incidents this encodes (docs/ANALYSIS.md):
 - the same PR deliberately moved request-body reads OUTSIDE the write
   lock — a stalled sender must not wedge the whole write plane.
 
-Rules (scoped to core/apiserver.py + core/wal.py):
+Rules (scoped to core/apiserver.py + core/wal.py +
+kubernetes_tpu/replication/):
 
 - ``verb-write-lock``: every mutating HTTP verb handler (do_POST/do_PUT/
   do_DELETE) either takes ``_write_lock`` itself or only delegates to a
   method that does;
-- ``wal-under-broadcast-lock``: every ``persistence.append(...)`` is
-  lexically inside a ``with ..._lock:`` region;
+- ``wal-under-broadcast-lock``: every ``persistence.append(...)`` — and
+  every call to the frame-append primitive ``_repl_append``, whose
+  contract is caller-holds-the-lock — is lexically inside a
+  ``with ..._lock:`` region;
 - ``wal-before-fanout``: in a function that both WAL-appends and fans out
   to ``_watchers``, the append precedes the fanout loop and the fanout
-  itself runs under the broadcast lock;
+  itself runs under the broadcast lock (this is what makes a follower's
+  ``apply_frame`` crash-consistent: an event a LOCAL watcher saw is
+  already in the local WAL);
+- ``repl-apply-write-lock``: the replication mutators that rewrite store
+  state outside a verb handler (``apply_frame``, ``install_snapshot``,
+  ``promote``, ``demote``) must take ``_write_lock`` — they race verb
+  handlers on a promoted replica otherwise;
 - ``no-blocking-read-under-lock``: no blocking socket/request read
   (``_read_body``, ``rfile.read``, ``recv``, ``accept``, ``readline``,
   ``getresponse``, ``urlopen``) happens while any lock is held;
+- ``no-blocking-send-under-lock``: no blocking socket send
+  (``sendall``, ``wfile.write``) happens while any lock is held — the
+  replication ship endpoint streams to followers with arbitrary
+  backpressure, and one stalled follower socket must never wedge the
+  broadcast/write plane (PR 9; the ship loop drains a per-follower
+  queue instead);
 - ``no-render-under-write-lock``: metrics exposition
   (``expose_metrics``/``.expose``) never runs while holding the write
   lock — series rendering iterates every label set and a scrape that
@@ -40,6 +55,13 @@ from .base import Checker, Finding, ModuleSource, attr_chain, register
 MUTATING_VERBS = ("do_POST", "do_PUT", "do_DELETE")
 BLOCKING_READ_ATTRS = {"_read_body", "recv", "recv_into", "accept",
                        "readline", "getresponse", "urlopen"}
+BLOCKING_SEND_ATTRS = {"sendall"}
+# Replication mutators that rewrite store state outside a verb handler —
+# each must serialize on the server write lock (rule repl-apply-write-lock).
+REPL_MUTATORS = ("apply_frame", "install_snapshot", "promote", "demote")
+# The frame-append primitive: persistence.append lives INSIDE it (exempt
+# there), and every CALL to it must be under the broadcast lock instead.
+FRAME_APPEND_PRIMITIVE = "_repl_append"
 
 
 def _lock_name(expr: ast.AST) -> Optional[str]:
@@ -58,8 +80,10 @@ class _FunctionScan:
         self.calls: Set[str] = set()             # callee terminal names
         # (lineno, locks_held) per interesting site:
         self.wal_appends: List[Tuple[int, Tuple[str, ...]]] = []
+        self.raw_appends: List[Tuple[int, Tuple[str, ...]]] = []
         self.fanouts: List[Tuple[int, Tuple[str, ...]]] = []
         self.blocking_reads: List[Tuple[int, Tuple[str, ...], str]] = []
+        self.blocking_sends: List[Tuple[int, Tuple[str, ...], str]] = []
         self.metric_renders: List[Tuple[int, Tuple[str, ...], str]] = []
         self._walk(fn, ())
 
@@ -101,8 +125,21 @@ class _FunctionScan:
             self.calls.add(chain[-1])
         if len(chain) >= 2 and chain[-1] == "append" and chain[-2] == "persistence":
             self.wal_appends.append((node.lineno, held))
+            self.raw_appends.append((node.lineno, held))
+        if chain and chain[-1] == FRAME_APPEND_PRIMITIVE:
+            # A call to the frame-append primitive IS a WAL append: same
+            # under-the-lock + before-fanout obligations at the call site.
+            self.wal_appends.append((node.lineno, held))
         if chain and chain[-1] in BLOCKING_READ_ATTRS and held:
             self.blocking_reads.append((node.lineno, held, chain[-1]))
+        if chain and chain[-1] in BLOCKING_SEND_ATTRS and held:
+            self.blocking_sends.append((node.lineno, held, chain[-1]))
+        # wfile.write is a response-socket send even though 'write' is
+        # generic (file-handle writes under a lock — the WAL itself — are
+        # deliberate and exempt).
+        if (len(chain) >= 2 and chain[-1] == "write" and chain[-2] == "wfile"
+                and held):
+            self.blocking_sends.append((node.lineno, held, "wfile.write"))
         if (chain and chain[-1] in ("expose_metrics", "expose")
                 and "_write_lock" in held):
             self.metric_renders.append((node.lineno, held, chain[-1]))
@@ -120,10 +157,14 @@ class LockDisciplineChecker(Checker):
                    "fanout, no blocking reads under a held lock")
 
     SCOPE = ("core/apiserver.py", "core/wal.py")
+    SCOPE_DIRS = ("replication/",)
 
     def applies_to(self, relpath: str) -> bool:
-        return any(relpath == p or relpath.endswith("/" + p)
-                   for p in self.SCOPE)
+        if any(relpath == p or relpath.endswith("/" + p)
+               for p in self.SCOPE):
+            return True
+        return any(("/" + d) in relpath or relpath.startswith(d)
+                   for d in self.SCOPE_DIRS)
 
     def check(self, mod: ModuleSource) -> List[Finding]:
         out: List[Finding] = []
@@ -153,11 +194,29 @@ class LockDisciplineChecker(Checker):
                         "_write_lock nor delegates to a method that does "
                         "(check-then-act races: double bind, dup create)"))
             for lineno, held in scan.wal_appends:
+                if (fn.name == FRAME_APPEND_PRIMITIVE
+                        and (lineno, held) in scan.raw_appends):
+                    # The primitive's own persistence.append: its contract
+                    # is caller-holds-the-lock, enforced at call sites.
+                    continue
                 if not any(lock == "_lock" for lock in held):
                     out.append(Finding(
                         self.id, "wal-under-broadcast-lock", mod.path, lineno,
-                        "persistence.append outside a `with ..._lock:` "
+                        "WAL/frame append outside a `with ..._lock:` "
                         "region — a fanned-out event could be lost on crash"))
+            if fn.name in REPL_MUTATORS and "_write_lock" not in scan.acquires:
+                out.append(Finding(
+                    self.id, "repl-apply-write-lock", mod.path, fn.lineno,
+                    f"replication mutator {fn.name} does not take "
+                    "_write_lock — on a promoted replica it races the "
+                    "mutating verb handlers over the same store"))
+            for lineno, held, what in scan.blocking_sends:
+                out.append(Finding(
+                    self.id, "no-blocking-send-under-lock", mod.path, lineno,
+                    f"blocking socket send ({what}) under held lock(s) "
+                    f"{'/'.join(held)} — one stalled follower/watch socket "
+                    "wedges the broadcast/write plane; drain a per-stream "
+                    "queue outside the lock instead"))
             if scan.wal_appends and scan.fanouts:
                 first_fanout = min(l for l, _ in scan.fanouts)
                 first_append = min(l for l, _ in scan.wal_appends)

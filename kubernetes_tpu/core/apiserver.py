@@ -308,6 +308,29 @@ def node_from_wire(d: dict) -> Node:
 # ---------------------------------------------------------------------------
 
 
+class _ShipStream:
+    """One attached replication follower: its frame queue plus the ack
+    bookkeeping `_await_shipped` reads. `sent_seq` is the highest frame seq
+    whose bytes sendall() handed to the kernel — once there, a leader
+    SIGKILL cannot lose them (the kernel flushes the buffer before FIN).
+    `acked` drops a stream out of the ack quorum when it lags (a stalled
+    follower must not convoy every acked write behind its backpressure).
+    The queue is BOUNDED (the same window as the ship backlog): a
+    connected-but-stalled follower must not make the leader accumulate
+    the entire subsequent write history in memory — on overflow the
+    stream is marked `dead`, detached, and the follower re-attaches
+    (usually via 410 -> snapshot resync), mirroring the watch-backlog
+    contract."""
+
+    __slots__ = ("q", "sent_seq", "acked", "dead")
+
+    def __init__(self, since: int, maxsize: int):
+        self.q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self.sent_seq = since
+        self.acked = True
+        self.dead = False
+
+
 class APIServer:
     """REST + watch over an owned FakeClientset store.
 
@@ -323,6 +346,12 @@ class APIServer:
     WAL-logged before fanout, periodically compacted into a snapshot, and a
     restart recovers state + rv counters + epoch + backlog — the etcd3
     store seam (etcd3/store.go:284) collapsed to one process."""
+
+    # Sentinel returned by upsert_lease when this replica is not the
+    # leader (distinct from None = CAS loss / LeaseHeld): the HTTP layer
+    # maps it to 421 NotLeader, and the check lives UNDER the write lock
+    # so a racing demote() cannot let a lease write slip through.
+    NOT_LEADER = object()
 
     def __init__(self, store: Optional[FakeClientset] = None,
                  backlog: int = 8192, data_dir: Optional[str] = None,
@@ -355,6 +384,9 @@ class APIServer:
         self._seq: Dict[str, int] = {"pods": 0, "nodes": 0}
         self._backlog: Dict[str, "deque"] = {
             "pods": deque(maxlen=backlog), "nodes": deque(maxlen=backlog)}
+        # Recent shipped frames by global seq: the replication window a
+        # follower can resume from without a snapshot bootstrap.
+        self._repl_backlog = deque(maxlen=backlog)
         # Boot epoch: rv counters restart at 0 with a fresh server, so a
         # client's rv from a PREVIOUS server instance must never resume
         # against this one's unrelated event history — resume requires the
@@ -370,6 +402,28 @@ class APIServer:
         self.lease_conflicts = 0     # held-lease PUTs rejected (CAS losers)
         self.lease_transitions = 0   # holder changes (acquire + failover)
         self.compaction_failures = 0
+        # Replication plane (kubernetes_tpu/replication/, docs/RESILIENCE.md):
+        # every WAL record is a shippable frame stamped with a global
+        # monotonic `seq` and the fencing `epoch`. A follower tails
+        # GET /replication/wal, replays frames into its own store+WAL, and
+        # serves the read plane; mutating verbs answer 421 NotLeader with a
+        # redirect to `leader_url`. `promote()` flips follower->leader.
+        self.role = "leader"
+        self.leader_url = ""      # where NotLeader redirects point
+        self.advertise_url = ""   # this replica's own base URL (set by serve)
+        self.replica_rank = 0     # election order; 0 = the seed leader
+        self.repl_peers: Dict[int, str] = {}  # rank -> follower base URL
+        self.repl_epoch = 1
+        self._repl_seq = 0
+        self._ship_streams: List["_ShipStream"] = []
+        self._ship_cond = threading.Condition()
+        self.ship_wait_timeouts = 0   # acked writes that outran a follower
+        self.ship_streams_dropped = 0  # stalled followers force-detached
+        self.repl_frames_applied = 0  # follower: frames replayed locally
+        self.repl_frames_rejected = 0  # stale-epoch frames fenced off
+        self.repl_lag = 0              # follower: leader head seq - applied
+        self.repl_resyncs = 0          # snapshot bootstraps performed
+        self.failovers: Dict[str, int] = {}  # promotion reason -> count
         # Durability (core/wal.py): WAL + snapshot compaction + recovery.
         self.persistence = None
         self.recovered_objects = 0
@@ -410,33 +464,42 @@ class APIServer:
             self.epoch = self.persistence.epoch
         else:
             self.persistence.init_epoch(self.epoch)
+        # Replication fencing epoch: recover the persisted generation (a
+        # promoted-then-restarted replica must come back in the generation
+        # it won, or it would fence off its own shipped frames).
+        self.repl_epoch = max(self.repl_epoch, self.persistence.repl_epoch)
+        # Recover the persisted ROLE too: a deposed leader that restarts
+        # must come back fenced (follower, redirecting at the winner) —
+        # restarting read-write would fork history at the winner's epoch.
+        if self.persistence.role == "follower":
+            self.role = "follower"
+            self.leader_url = self.persistence.leader_url or self.leader_url
         if snap is not None:
             self._seq.update(snap.get("seq", {}))
+            repl = snap.get("repl") or {}
+            self._repl_seq = max(self._repl_seq, int(repl.get("seq", 0)))
             for w in snap.get("pods", ()):
                 self._apply_recovered("pods", "ADDED", w)
             for w in snap.get("nodes", ()):
                 self._apply_recovered("nodes", "ADDED", w)
             for w in snap.get("leases", ()):
-                if w.get("name"):
-                    self.leases[w["name"]] = {
-                        "holder": w.get("holder", ""),
-                        "duration": float(w.get("duration", 15.0)),
-                        "renew": _lease_clock(),
-                        "transitions": int(w.get("transitions", 0))}
+                self._install_lease(w)
         for rec in records:
+            seq = rec.get("seq")
+            if seq is not None and seq > self._repl_seq:
+                self._repl_seq = seq
+                # Rebuild the replication ship window too, so followers that
+                # resume against a restarted leader ride frames, not a
+                # snapshot bootstrap.
+                self._repl_backlog.append(
+                    (seq, (json.dumps(rec) + "\n").encode()))
             kind = rec.get("kind")
             if kind == "leases":
                 # Lease holders survive the restart but their clocks do not
                 # (renew stamps are this process's monotonic clock): restore
                 # renewed-at-recovery, so a live holder keeps its lease and a
                 # dead one expires exactly one lease period after recovery.
-                w = rec.get("object") or {}
-                if w.get("name"):
-                    self.leases[w["name"]] = {
-                        "holder": w.get("holder", ""),
-                        "duration": float(w.get("duration", 15.0)),
-                        "renew": _lease_clock(),
-                        "transitions": int(w.get("transitions", 0))}
+                self._install_lease(rec.get("object") or {})
                 continue
             if kind not in ("pods", "nodes"):
                 continue
@@ -447,7 +510,8 @@ class APIServer:
             # Rebuild the watch backlog exactly as _broadcast framed it (the
             # deque's maxlen keeps only the freshest `backlog` events).
             if rv is not None:
-                event = {k: v for k, v in rec.items() if k != "kind"}
+                event = {k: v for k, v in rec.items()
+                         if k not in ("kind", "seq", "epoch")}
                 self._backlog[kind].append(
                     (rv, (json.dumps(event) + "\n").encode()))
         # Object resource_versions were not persisted; fast-forward the
@@ -497,15 +561,64 @@ class APIServer:
             else:
                 self.store.nodes[node.name] = node
 
+    def _install_lease(self, w: dict) -> None:
+        """Install one recovered/replicated lease record with its renew
+        stamp restarted on THIS process's clock (clocks never cross a
+        process boundary: a live holder keeps its lease for one more
+        period, a dead one expires exactly one period from now)."""
+        if not w.get("name"):
+            return
+        self.leases[w["name"]] = {
+            "holder": w.get("holder", ""),
+            "duration": float(w.get("duration", 15.0)),
+            "renew": _lease_clock(),
+            "transitions": int(w.get("transitions", 0))}
+
     def _wal_status(self, pod) -> None:
         """Persist a non-evented status patch (nominatedNodeName): an
         rv-less `STATUS` record — recovery upserts the object but the watch
-        backlog never sees it (parity with its non-evented live fanout)."""
-        if self.persistence is None:
-            return
+        backlog never sees it (parity with its non-evented live fanout).
+        It still rides the replication stream (followers must recover the
+        nomination too)."""
         with self._lock:
-            self.persistence.append(
+            self._repl_append(
                 {"kind": "pods", "type": "STATUS", "object": pod_to_wire(pod)})
+
+    def _repl_append(self, rec: dict, stamped: bool = False) -> int:
+        """Commit one WAL frame — the ONE persist→backlog→ship sequence
+        both write paths share: the leader stamps a fresh seq + fencing
+        epoch; a follower replaying a SHIPPED frame (`stamped=True`,
+        apply_frame) keeps the leader's stamps and adopts its seq. Caller
+        holds the broadcast lock (`_lock`) — seq order IS commit order."""
+        if stamped:
+            seq = int(rec["seq"])
+            self._repl_seq = seq
+        else:
+            self._repl_seq += 1
+            seq = self._repl_seq
+            rec = dict(rec, seq=seq, epoch=self.repl_epoch)
+        if self.persistence is not None:
+            self.persistence.append(rec)
+        data = (json.dumps(rec) + "\n").encode()
+        self._repl_backlog.append((seq, data))
+        self._ship_fanout(seq, data)
+        return seq
+
+    def _ship_fanout(self, seq: int, data: bytes) -> None:
+        """Feed one frame to every attached ship stream. Caller holds the
+        broadcast lock. A stream whose bounded queue overflows (stalled
+        follower: no socket error, it just stopped reading) is marked dead
+        and detached — it re-attaches from its applied seq, or resyncs."""
+        dead = []
+        for st in self._ship_streams:
+            try:
+                st.q.put_nowait((seq, data))
+            except queue.Full:
+                st.dead = True
+                self.ship_streams_dropped += 1
+                dead.append(st)
+        for st in dead:
+            self._ship_streams.remove(st)
 
     def _snapshot_state(self) -> dict:
         """Full-state compaction snapshot. The calling thread holds BOTH the
@@ -515,6 +628,7 @@ class APIServer:
         return {
             "epoch": self.epoch,
             "seq": dict(self._seq),
+            "repl": {"seq": self._repl_seq, "epoch": self.repl_epoch},
             "pods": [pod_to_wire(p) for p in list(self.store.pods.values())],
             "nodes": [node_to_wire(n) for n in list(self.store.nodes.values())],
             "leases": [dict(rec, name=name, renew=None)
@@ -629,6 +743,8 @@ class APIServer:
         holder table (with clocks restarted, see _recover)."""
         now = _lease_clock()
         with self._write_lock:
+            if self.role != "leader":
+                return self.NOT_LEADER
             rec = self.leases.get(name)
             if (rec is not None and rec["holder"] and rec["holder"] != holder
                     and now - rec["renew"] < rec["duration"]):
@@ -644,29 +760,324 @@ class APIServer:
             rec["holder"] = holder
             rec["duration"] = float(duration)
             rec["renew"] = now
-            if self.persistence is not None:
-                with self._lock:
-                    self.persistence.append({
-                        "kind": "leases", "type": "LEASE",
-                        "object": {"name": name, "holder": holder,
-                                   "duration": rec["duration"],
-                                   "transitions": rec["transitions"]}})
-                    if self.persistence.should_compact():
-                        # Renewals are the steady-state WAL traffic of an
-                        # idle sharded plane (N shards × 3 appends per lease
-                        # period, forever); without compacting here — the
-                        # broadcast path never runs on a quiet cluster —
-                        # the WAL and its replay time grow without bound.
-                        # Same locking posture as _broadcast: this thread
-                        # holds the write lock, so the store snapshot is
-                        # stable, and a failed compaction must not fail the
-                        # renewal.
-                        try:
-                            self.persistence.write_snapshot(
-                                self._snapshot_state())
-                        except Exception:  # noqa: BLE001
-                            self.compaction_failures += 1
+            with self._lock:
+                self._repl_append({
+                    "kind": "leases", "type": "LEASE",
+                    "object": {"name": name, "holder": holder,
+                               "duration": rec["duration"],
+                               "transitions": rec["transitions"]}})
+                if (self.persistence is not None
+                        and self.persistence.should_compact()):
+                    # Renewals are the steady-state WAL traffic of an
+                    # idle sharded plane (N shards × 3 appends per lease
+                    # period, forever); without compacting here — the
+                    # broadcast path never runs on a quiet cluster —
+                    # the WAL and its replay time grow without bound.
+                    # Same locking posture as _broadcast: this thread
+                    # holds the write lock, so the store snapshot is
+                    # stable, and a failed compaction must not fail the
+                    # renewal.
+                    try:
+                        self.persistence.write_snapshot(
+                            self._snapshot_state())
+                    except Exception:  # noqa: BLE001
+                        self.compaction_failures += 1
             return self._lease_wire(name, rec, now)
+
+    # -- replication (WAL shipping + leader/follower roles) -----------------
+    #
+    # The reference splits its control plane into a replicated log (etcd3)
+    # and read-serving watch caches; this section rebuilds that split
+    # natively: every committed write is a shippable WAL frame
+    # (seq+epoch-stamped by _repl_append), followers tail
+    # GET /replication/wal and replay frames via apply_frame, and a leader
+    # kill -9 promotes a follower (promote) fenced by the monotonic
+    # replication epoch. docs/RESILIENCE.md § replication.
+
+    def replication_status(self) -> dict:
+        """The discovery document election and client leader-resolution
+        read: role, rank, fencing epoch, applied head, redirect target —
+        plus the tail's election counters when one is attached
+        (`repl_tail`, set by the follower binary): 'why is this follower
+        not converging' must be answerable from the outside."""
+        out = {"role": self.role, "rank": self.replica_rank,
+               "replEpoch": self.repl_epoch, "seq": self._repl_seq,
+               "watchEpoch": self.epoch, "leader": self.leader_url,
+               "lag": self.repl_lag}
+        tail = getattr(self, "repl_tail", None)
+        if tail is not None:
+            thread = tail._thread
+            out["tail"] = {
+                "elections": tail.elections, "deferrals": tail.deferrals,
+                "reconnects": tail.reconnects, "bootstraps": tail.bootstraps,
+                "fenced": tail.fenced_streams,
+                "alive": thread is not None and thread.is_alive(),
+                "lastContactAge": round(
+                    time.monotonic() - tail.last_contact, 3)}
+        return out
+
+    def apply_frame(self, rec: dict,
+                    stream_epoch: Optional[int] = None) -> bool:
+        """Follower-side replay of one shipped WAL frame: append to the
+        LOCAL WAL first, then upsert the store and fan the event out to
+        this replica's own watch streams — the exact write-path ordering
+        the leader uses, so an event a local watcher saw is always
+        recoverable here too. Returns False for a frame from a stale
+        fencing epoch (a deposed leader's append — rejected, the tail must
+        disconnect).
+
+        ``stream_epoch`` is the generation the SERVING leader claims
+        (election/announcement/HB): a frame stamped with an older epoch is
+        still legitimate when it is part of a newer leader's committed
+        history — a lagging survivor that adopted the winner's epoch
+        before catching up must not fence off the pre-promotion frames it
+        still needs. Only a frame whose OWN stamp and whose stream's claim
+        are both stale is a deposed leader's append."""
+        seq = int(rec.get("seq", 0))
+        ep = int(rec.get("epoch", 0))
+        with self._write_lock:
+            with self._lock:
+                if max(ep, int(stream_epoch or 0)) < self.repl_epoch:
+                    self.repl_frames_rejected += 1
+                    return False
+                if seq <= self._repl_seq:
+                    return True  # reconnect overlap: already applied
+                if ep > self.repl_epoch:
+                    # A legitimately promoted leader's first frames carry
+                    # the bumped epoch: adopt it (and persist — fencing
+                    # must survive our own restart).
+                    self.repl_epoch = ep
+                    if self.persistence is not None:
+                        self.persistence.set_repl_epoch(ep)
+                self._repl_append(rec, stamped=True)
+                self.repl_frames_applied += 1
+                kind = rec.get("kind")
+                if kind == "leases":
+                    self._install_lease(rec.get("object") or {})
+                elif kind in ("pods", "nodes"):
+                    self._apply_recovered(kind, rec.get("type", ""),
+                                          rec.get("object"))
+                    rv = rec.get("rv")
+                    if rv is not None:  # rv-less STATUS: upsert, no event
+                        if rv > self._seq[kind]:
+                            self._seq[kind] = rv
+                        event = {k: v for k, v in rec.items()
+                                 if k not in ("kind", "seq", "epoch")}
+                        edata = (json.dumps(event) + "\n").encode()
+                        self._backlog[kind].append((rv, edata))
+                        for q in self._watchers[kind]:
+                            q.put(edata)
+                # Compaction runs LAST, after the frame is in the store and
+                # _repl_seq has advanced: a snapshot taken between append
+                # and apply would exclude the triggering frame while
+                # write_snapshot resets the WAL that just absorbed it — the
+                # frame would exist nowhere durable, and recovery would
+                # fast-forward straight past the hole (silent divergence).
+                if (self.persistence is not None
+                        and self.persistence.should_compact()):
+                    try:
+                        self.persistence.write_snapshot(
+                            self._snapshot_state())
+                    except Exception:  # noqa: BLE001
+                        self.compaction_failures += 1
+        return True
+
+    def install_snapshot(self, snap: dict) -> None:
+        """Cold-follower bootstrap: replace local state with a leader
+        snapshot (GET /replication/snapshot) and persist it as OUR
+        compaction snapshot, so a restart recovers locally and re-tails
+        from the snapshot's seq. Adopts the leader's WATCH epoch too —
+        rv continuity across replicas is what lets clients RESUME against
+        any of them."""
+        with self._write_lock:
+            with self._lock:
+                self.store.pods.clear()
+                self.store.nodes.clear()
+                self.store.bindings.clear()
+                self.leases.clear()
+                self._seq.update(snap.get("seq", {}))
+                for w in snap.get("pods", ()):
+                    self._apply_recovered("pods", "ADDED", w)
+                for w in snap.get("nodes", ()):
+                    self._apply_recovered("nodes", "ADDED", w)
+                for w in snap.get("leases", ()):
+                    self._install_lease(w)
+                repl = snap.get("repl") or {}
+                self._repl_seq = int(repl.get("seq", 0))
+                self.repl_epoch = max(self.repl_epoch,
+                                      int(repl.get("epoch", 1)))
+                if snap.get("epoch"):
+                    self.epoch = snap["epoch"]
+                self.repl_resyncs += 1
+                # A RESYNC skipped frames: any ATTACHED watch stream has a
+                # gap its client cannot see, and the retained backlog spans
+                # it. Clear the resume window and end those streams
+                # (sentinel); reconnecting clients full-re-list against the
+                # installed state (reflector Replace heals their caches).
+                self._repl_backlog.clear()
+                for kind in ("pods", "nodes"):
+                    self._backlog[kind].clear()
+                    for q in self._watchers[kind]:
+                        q.put(None)
+                if self.persistence is not None:
+                    self.persistence.epoch = self.epoch
+                    self.persistence.set_repl_epoch(self.repl_epoch)
+                    try:
+                        self.persistence.write_snapshot(self._snapshot_state())
+                    except Exception:  # noqa: BLE001
+                        self.compaction_failures += 1
+
+    def promote(self, reason: str = "leader_lost") -> None:
+        """Follower -> leader: bump the fencing epoch (persisted BEFORE the
+        first write of the new generation), rebuild the Omega usage table
+        from replicated truth, fast-forward the store's rv mint, flip to
+        read-write, and tell every attached client (FAILOVER marker) so
+        writes re-resolve and schedulers reconcile any bind the dead
+        leader acked but never shipped."""
+        import itertools
+        with self._write_lock:
+            with self._lock:
+                if self.role == "leader":
+                    return
+                self.repl_epoch += 1
+                self.role = "leader"
+                self.leader_url = self.advertise_url
+                if self.persistence is not None:
+                    self.persistence.set_repl_epoch(self.repl_epoch)
+                    self.persistence.set_role("leader", self.advertise_url)
+                self.repl_lag = 0
+                self.failovers[reason] = self.failovers.get(reason, 0) + 1
+            self._usage.clear()
+            for pod in self.store.pods.values():
+                if pod.node_name:
+                    self._usage_apply(pod.node_name, pod, +1)
+            self.store._rv_counter = itertools.count(
+                self._seq["pods"] + self._seq["nodes"] + 1)
+        # Forensic moment: a 100%-sampled replication.promote span marks the
+        # takeover instant, and the flight recorder dumps the ring around it.
+        tr = self.tracer
+        tr.record("replication.promote", tr.proc_ctx(),
+                  epoch=self.repl_epoch, reason=reason, seq=self._repl_seq,
+                  rank=self.replica_rank)
+        _spans.request_dump("replication_promote")
+        self._emit_control({"type": "FAILOVER", "epoch": self.repl_epoch,
+                            "leader": self.advertise_url})
+
+    def demote(self, leader_url: str, epoch: int) -> None:
+        """Deposed-leader fencing: a peer announced a NEWER fencing epoch
+        (or won an EQUAL-epoch race by rank — the /replication/leader
+        handler decides that tie-break before calling). Stop accepting
+        writes immediately (NotLeader from here on) and point clients at
+        the winner; this replica's divergent tail, if any, resolves via
+        snapshot resync when its tail re-attaches."""
+        with self._write_lock:
+            with self._lock:
+                if int(epoch) < self.repl_epoch:
+                    return  # the claimant is from an older generation
+                self.role = "follower"
+                self.leader_url = leader_url
+                self.repl_epoch = int(epoch)
+                if self.persistence is not None:
+                    # Persist the DEPOSED role too: restarting read-write
+                    # at the winner's epoch would fork history unfenceably.
+                    self.persistence.set_repl_epoch(self.repl_epoch)
+                    self.persistence.set_role("follower", leader_url)
+                self.failovers["deposed"] = self.failovers.get("deposed", 0) + 1
+        self._emit_control({"type": "FAILOVER", "epoch": self.repl_epoch,
+                            "leader": leader_url})
+
+    def note_leader(self, leader_url: str, epoch: int) -> bool:
+        """Follower bookkeeping when its tail re-attaches: record the
+        (possibly new) leader and, when leadership actually MOVED, notify
+        local watch clients with a FAILOVER marker so their write routing
+        re-resolves and their schedulers reconcile. Returns True when the
+        leader changed."""
+        with self._lock:
+            changed = (leader_url != self.leader_url
+                       or epoch > self.repl_epoch)
+            self.leader_url = leader_url
+            if epoch > self.repl_epoch:
+                self.repl_epoch = int(epoch)
+                if self.persistence is not None:
+                    self.persistence.set_repl_epoch(self.repl_epoch)
+        if changed:
+            self._emit_control({"type": "FAILOVER", "epoch": self.repl_epoch,
+                                "leader": leader_url})
+        return changed
+
+    def _emit_control(self, event: dict) -> None:
+        """Push a control marker (FAILOVER) to every live watch stream of
+        both kinds — rv-less and never WAL'd, like BOOKMARK."""
+        data = (json.dumps(event) + "\n").encode()
+        with self._lock:
+            for kind in ("pods", "nodes"):
+                for q in self._watchers[kind]:
+                    q.put(data)
+
+    def _attach_ship(self, since: int):
+        """Attach a follower's ship stream at `since` (its last applied
+        seq). Under the broadcast lock: the backlog replay and live-queue
+        registration cannot let a frame fall between them. Returns None
+        when the window no longer covers `since` — the follower must
+        snapshot-bootstrap (RESYNC)."""
+        with self._lock:
+            if since > self._repl_seq:
+                # The follower is AHEAD of this server (it applied frames a
+                # torn-tailed restart of ours discarded): histories
+                # diverged — only a snapshot resync reconverges them.
+                return None
+            covered = (since == self._repl_seq
+                       or (self._repl_backlog
+                           and self._repl_backlog[0][0] <= since + 1))
+            if not covered:
+                return None
+            st = _ShipStream(since, self._repl_backlog.maxlen or 8192)
+            for seq, data in self._repl_backlog:
+                if seq > since:
+                    st.q.put_nowait((seq, data))
+            self._ship_streams.append(st)
+        return st
+
+    def _detach_ship(self, st) -> None:
+        with self._lock:
+            if st in self._ship_streams:
+                self._ship_streams.remove(st)
+        with self._ship_cond:
+            self._ship_cond.notify_all()
+
+    def _ship_mark_sent(self, st, seq: int) -> None:
+        """Ship thread: frame bytes for `seq` are in the kernel send buffer
+        (sendall returned) — a leader SIGKILL can no longer lose them."""
+        with self._ship_cond:
+            st.sent_seq = max(st.sent_seq, seq)
+            if not st.acked and st.sent_seq >= self._repl_seq:
+                st.acked = True  # lagging follower caught back up
+            self._ship_cond.notify_all()
+
+    def _await_shipped(self, seq: int, timeout: float = 0.25) -> bool:
+        """Reply gating for acked mutations: wait (briefly, outside every
+        lock) until each in-quorum follower stream has `seq` on the wire.
+        This is what turns a leader kill -9 from 'acked writes silently
+        vanish' into 'acked writes survive on a follower'. A follower that
+        cannot keep up inside `timeout` is dropped from the ack quorum
+        (counted) instead of convoying the whole write plane — availability
+        over completeness, the degraded-mode contract."""
+        if not self._ship_streams:
+            return True
+        deadline = time.monotonic() + timeout
+        with self._ship_cond:
+            while True:
+                laggards = [st for st in self._ship_streams
+                            if st.acked and st.sent_seq < seq]
+                if not laggards:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    for st in laggards:
+                        st.acked = False
+                    self.ship_wait_timeouts += 1
+                    return False
+                self._ship_cond.wait(remaining)
 
     def expose_metrics(self) -> str:
         """Control-plane counters (conflict/lease/watch planes) in the
@@ -682,9 +1093,36 @@ class APIServer:
                 ("apiserver_resumed_watches_total", self.resumed_watches),
                 ("apiserver_relisted_watches_total", self.relisted_watches),
                 ("apiserver_compaction_failures_total",
-                 self.compaction_failures)):
+                 self.compaction_failures),
+                ("apiserver_replication_frames_applied_total",
+                 self.repl_frames_applied),
+                ("apiserver_replication_frames_rejected_total",
+                 self.repl_frames_rejected),
+                ("apiserver_replication_resyncs_total", self.repl_resyncs),
+                ("apiserver_replication_ship_wait_timeouts_total",
+                 self.ship_wait_timeouts),
+                ("apiserver_replication_ship_streams_dropped_total",
+                 self.ship_streams_dropped)):
             out.append(f"# TYPE {name} counter")
             out.append(f"{name} {v}")
+        out.append("# TYPE apiserver_failover_total counter")
+        for reason, v in sorted(self.failovers.items()):
+            out.append('apiserver_failover_total{reason="%s"} %d'
+                       % (reason, v))
+        # Gauges: current role (1 = leader) and replication lag. On the
+        # leader, lag is its head minus the slowest attached ship stream;
+        # on a follower, the head the tail last heard minus what it applied.
+        with self._ship_cond:
+            if self._ship_streams:
+                lag = max(self._repl_seq - st.sent_seq
+                          for st in self._ship_streams)
+            else:
+                lag = self.repl_lag
+        out.append("# TYPE apiserver_replication_role gauge")
+        out.append("apiserver_replication_role %d"
+                   % (1 if self.role == "leader" else 0))
+        out.append("# TYPE apiserver_replication_lag_records gauge")
+        out.append("apiserver_replication_lag_records %d" % max(0, lag))
         return "\n".join(out) + "\n"
 
     # -- event fanout to watch streams -------------------------------------
@@ -697,29 +1135,30 @@ class APIServer:
             # event class): times the WAL append and the watcher fanout
             # into the binder's trace (stages wal.append / bound.fanout).
             ctx = self._bind_ctx
-            if self.persistence is not None:
-                # WAL append BEFORE fanout: an event a watcher saw is always
-                # recoverable. The record is the event itself plus the kind,
-                # so recovery rebuilds both the store and the watch backlog
-                # from one stream.
-                _tw = time.perf_counter() if ctx is not None else 0.0
-                self.persistence.append({"kind": kind, **event})
-                if ctx is not None:
-                    self.tracer.record("wal.append", ctx,
-                                       time.perf_counter() - _tw,
-                                       rv=event["rv"])
-                if self.persistence.should_compact():
-                    try:
-                        # Safe to read the store here: the writing thread
-                        # holds _write_lock, so no other mutation is in
-                        # flight. write_snapshot is atomic (tmp+replace)
-                        # and only resets the WAL after the replace — a
-                        # failed compaction leaves snapshot+WAL coherent,
-                        # so it must never abort the broadcast (that would
-                        # punch a hole in the fanout/backlog at this rv).
-                        self.persistence.write_snapshot(self._snapshot_state())
-                    except Exception:  # noqa: BLE001
-                        self.compaction_failures += 1
+            # WAL append BEFORE fanout: an event a watcher saw is always
+            # recoverable. The record is the event itself plus the kind
+            # (and the replication seq/epoch stamp), so recovery — and a
+            # tailing follower — rebuilds both the store and the watch
+            # backlog from one stream.
+            _tw = time.perf_counter() if ctx is not None else 0.0
+            self._repl_append({"kind": kind, **event})
+            if ctx is not None:
+                self.tracer.record("wal.append", ctx,
+                                   time.perf_counter() - _tw,
+                                   rv=event["rv"])
+            if (self.persistence is not None
+                    and self.persistence.should_compact()):
+                try:
+                    # Safe to read the store here: the writing thread
+                    # holds _write_lock, so no other mutation is in
+                    # flight. write_snapshot is atomic (tmp+replace)
+                    # and only resets the WAL after the replace — a
+                    # failed compaction leaves snapshot+WAL coherent,
+                    # so it must never abort the broadcast (that would
+                    # punch a hole in the fanout/backlog at this rv).
+                    self.persistence.write_snapshot(self._snapshot_state())
+                except Exception:  # noqa: BLE001
+                    self.compaction_failures += 1
             data = (json.dumps(event) + "\n").encode()
             self._backlog[kind].append((self._seq[kind], data))
             _tf = time.perf_counter() if ctx is not None else 0.0
@@ -878,6 +1317,33 @@ class APIServer:
                                             server.store.nodes.values()])
                 if path == "/api/v1/leases":
                     return self._json(200, server.list_leases())
+                if path == "/replication/status":
+                    return self._json(200, server.replication_status())
+                if path == "/replication/snapshot":
+                    # Cold-follower bootstrap: a consistent full-state
+                    # snapshot. Encode UNDER the locks (no write can
+                    # interleave), send after releasing them — the socket
+                    # write must never run under a held lock.
+                    with server._write_lock:
+                        with server._lock:
+                            snap = server._snapshot_state()
+                    return self._json(200, snap)
+                if path == "/replication/wal":
+                    since, repl_epoch, leader_hint, hb = 0, None, "", 1.0
+                    for part in query.split("&"):
+                        k, _, v = part.partition("=")
+                        try:
+                            if k == "from":
+                                since = int(v)
+                            elif k == "epoch":
+                                repl_epoch = int(v)
+                            elif k == "hb":
+                                hb = max(0.05, float(v))
+                        except ValueError:
+                            pass
+                        if k == "leader":
+                            leader_hint = v
+                    return self._ship(since, repl_epoch, leader_hint, hb)
                 if path == "/metrics":
                     data = server.expose_metrics().encode()
                     self.send_response(200)
@@ -914,6 +1380,10 @@ class APIServer:
                                 continue
                             idle = 0.0
                             data = b'{"type": "BOOKMARK"}\n'
+                        if data is None:
+                            # Stream-end sentinel (snapshot RESYNC skipped
+                            # frames): close; the client re-lists fresh.
+                            break
                         self.wfile.write(
                             f"{len(data):x}\r\n".encode() + data + b"\r\n")
                         self.wfile.flush()
@@ -927,12 +1397,120 @@ class APIServer:
                     # and re-lists against the next server.
                     self.close_connection = True
 
+            def _ship(self, since: int, repl_epoch: Optional[int],
+                      leader_hint: str, hb: float) -> None:
+                """Replication ship stream: WAL frames with seq > `since`,
+                one json line per chunk, heartbeats (`HB`, carrying the
+                head seq + fencing epoch) on idle. The queue is loaded and
+                registered under the broadcast lock (_attach_ship); every
+                socket send happens OUT HERE, lock-free — a slow follower
+                backpressures only its own queue, never the write plane."""
+                from urllib.parse import unquote
+                if repl_epoch is not None and repl_epoch > server.repl_epoch:
+                    # The follower has seen a newer generation: this
+                    # replica was deposed while partitioned. Fence off.
+                    # The hint is the follower's TAIL TARGET — by
+                    # construction this very server — so it never names
+                    # the winner: demote without a redirect target and
+                    # let clients re-resolve through status probing.
+                    hint = unquote(leader_hint).rstrip("/")
+                    if hint == server.advertise_url:
+                        hint = ""
+                    server.demote(hint, repl_epoch)
+                    return self._json(409, {
+                        "error": "StaleEpoch",
+                        "replEpoch": server.repl_epoch})
+                st = server._attach_ship(since)
+                if st is None:
+                    # The ship window no longer covers `since` (compaction
+                    # outran the follower): 410 Gone — snapshot bootstrap.
+                    return self._json(410, {"error": "ResyncRequired",
+                                            "seq": server._repl_seq})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    while server._httpd is not None and not st.dead:
+                        try:
+                            seq, data = st.q.get(timeout=hb)
+                        except queue.Empty:
+                            seq = None
+                            # HBs carry this replica's ROLE: a follower
+                            # tailing a stream whose server was deposed
+                            # must not count these as leader liveness.
+                            data = (json.dumps(
+                                {"type": "HB", "seq": server._repl_seq,
+                                 "epoch": server.repl_epoch,
+                                 "role": server.role}) + "\n").encode()
+                        self.wfile.write(
+                            f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                        self.wfile.flush()
+                        if seq is not None:
+                            server._ship_mark_sent(st, seq)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    server._detach_ship(st)
+                    self.close_connection = True
+
             def do_POST(self):
                 self._body_cache = self._read_body()
+                if self.path == "/replication/peers":
+                    # Replication-internal wiring (accepted in ANY role):
+                    # the harness injects the rank -> base URL map after
+                    # every replica's ephemeral port is known. Not WAL'd —
+                    # topology, not state.
+                    server.repl_peers = {
+                        int(k): v for k, v in
+                        (self._body().get("peers") or {}).items()}
+                    return self._json(200, {"peers": len(server.repl_peers)})
+                if self.path == "/replication/leader":
+                    # Promotion announcement (accepted in ANY role): the
+                    # freshly promoted leader pushes its generation to
+                    # every peer, so surviving followers re-tail
+                    # immediately (instead of waiting out their own
+                    # silence detection) and a stale co-leader demotes
+                    # itself even though no follower ever tails it. Two
+                    # followers promoting CONCURRENTLY land on the same
+                    # epoch — the rank tie-break (lower announcer rank
+                    # wins) stands one of them down; its forked tail
+                    # resolves via snapshot resync on re-attach.
+                    body = self._body()
+                    ep = int(body.get("epoch", 0))
+                    rank = int(body.get("rank", 1 << 30))
+                    url = (body.get("leader") or "").rstrip("/")
+                    if server.role == "leader":
+                        if (ep > server.repl_epoch
+                                or (ep == server.repl_epoch
+                                    and rank < server.replica_rank)):
+                            server.demote(url, ep)
+                    elif url and ep >= server.repl_epoch:
+                        server.note_leader(url, ep)
+                    return self._json(200, {"replEpoch": server.repl_epoch})
+                if server.role != "leader":
+                    return self._json(421, {"error": "NotLeader",
+                                            "leader": server.leader_url})
                 with server._write_lock:
-                    return self._do_post()
+                    if server.role != "leader":
+                        # Re-checked UNDER the lock: a demote() racing the
+                        # unlocked fast-path check above must not let this
+                        # write commit on a freshly deposed replica (it
+                        # would be stamped with the WINNER's epoch —
+                        # unfenceable divergence).
+                        code, obj, seq = 421, {
+                            "error": "NotLeader",
+                            "leader": server.leader_url}, 0
+                    else:
+                        code, obj = self._post_locked()
+                        seq = server._repl_seq
+                # Reply gating, OUTSIDE every lock: an acked write is on
+                # the wire to each in-quorum follower before the client
+                # hears 200 — a leader kill -9 cannot silently lose it.
+                server._await_shipped(seq)
+                self._json(code, obj)
 
-            def _do_post(self):
+            def _post_locked(self):
                 if self.path == "/api/v1/pods":
                     body = self._body()
                     if isinstance(body, list):
@@ -953,20 +1531,19 @@ class APIServer:
                             server.store.create_pod(pod)
                             if pod.node_name:
                                 server._usage_apply(pod.node_name, pod, +1)
-                        return self._json(
-                            201, {"created": len(body) - dup,
-                                  "alreadyExists": dup})
+                        return 201, {"created": len(body) - dup,
+                                     "alreadyExists": dup}
                     pod = pod_from_wire(body)
                     # AlreadyExists (409, like the reference registry):
                     # duplicate creates — e.g. a client retrying a write
                     # whose reply was lost — must not re-fire ADDED events
                     # or reset a pod the scheduler already bound.
                     if pod.uid in server.store.pods:
-                        return self._json(409, {"error": "AlreadyExists"})
+                        return 409, {"error": "AlreadyExists"}
                     server.store.create_pod(pod)
                     if pod.node_name:  # created pre-bound: commit its usage
                         server._usage_apply(pod.node_name, pod, +1)
-                    return self._json(201, pod_to_wire(pod))
+                    return 201, pod_to_wire(pod)
                 if self.path == "/api/v1/nodes":
                     body = self._body()
                     if isinstance(body, list):
@@ -977,18 +1554,17 @@ class APIServer:
                                 dup += 1
                                 continue
                             server.store.create_node(node)
-                        return self._json(
-                            201, {"created": len(body) - dup,
-                                  "alreadyExists": dup})
+                        return 201, {"created": len(body) - dup,
+                                     "alreadyExists": dup}
                     node = node_from_wire(body)
                     if node.name in server.store.nodes:
-                        return self._json(409, {"error": "AlreadyExists"})
+                        return 409, {"error": "AlreadyExists"}
                     server.store.create_node(node)
-                    return self._json(201, node_to_wire(node))
+                    return 201, node_to_wire(node)
                 if (self.path.startswith("/api/v1/nodes/")
                         and self.path.endswith("/status")):
                     # parity stub (kubelet heartbeat shape); no-op
-                    return self._json(200, {})
+                    return 200, {}
                 if self.path == "/api/v1/bindings":
                     # Bulk binding commits: one request, one write-lock
                     # acquisition for a whole drained dispatcher queue
@@ -1000,19 +1576,18 @@ class APIServer:
                                              item.get("node", ""),
                                              tctx=item.get("tctx"))
                             for item in self._body())]
-                    return self._json(200, out)
+                    return 200, out
                 parts = self.path.split("/")
                 if (self.path.startswith("/api/v1/pods/")
                         and self.path.endswith("/binding")):
-                    code, payload = server._bind_one(
+                    return server._bind_one(
                         parts[4], self._body()["node"],
                         tctx=self.headers.get(_spans.TRACE_HEADER))
-                    return self._json(code, payload)
                 if (self.path.startswith("/api/v1/pods/")
                         and self.path.endswith("/status")):
                     pod = server.store.pods.get(parts[4])
                     if pod is None:
-                        return self._json(404, {"error": "pod not found"})
+                        return 404, {"error": "pod not found"}
                     body = self._body()
                     server.store.patch_pod_status(
                         pod,
@@ -1023,29 +1598,46 @@ class APIServer:
                     # still survive a restart: WAL an rv-less STATUS record
                     # — replayed as an upsert, never entering the backlog.
                     server._wal_status(pod)
-                    return self._json(200, {})
-                self._json(404, {"error": "not found"})
+                    return 200, {}
+                return 404, {"error": "not found"}
 
             def do_PUT(self):
                 self._body_cache = self._read_body()
+                if server.role != "leader":
+                    return self._json(421, {"error": "NotLeader",
+                                            "leader": server.leader_url})
                 if self.path.startswith("/api/v1/leases/"):
                     # upsert_lease serializes under the write lock itself
                     # (it is also an in-process API); don't wrap it twice.
+                    # Its own under-the-lock role check covers the
+                    # demote() race (NOT_LEADER sentinel -> 421).
                     body = self._body()
                     got = server.upsert_lease(
                         self.path.split("/")[4],
                         body.get("holder", ""),
                         float(body.get("leaseDurationSeconds", 15.0)))
+                    if got is APIServer.NOT_LEADER:
+                        return self._json(421, {"error": "NotLeader",
+                                                "leader": server.leader_url})
                     if got is None:
                         return self._json(409, {"error": "LeaseHeld"})
+                    server._await_shipped(server._repl_seq)
                     return self._json(200, got)
                 with server._write_lock:
-                    return self._do_put()
+                    if server.role != "leader":
+                        code, obj, seq = 421, {
+                            "error": "NotLeader",
+                            "leader": server.leader_url}, 0
+                    else:
+                        code, obj = self._put_locked()
+                        seq = server._repl_seq
+                server._await_shipped(seq)
+                self._json(code, obj)
 
-            def _do_put(self):
+            def _put_locked(self):
                 if (self.path.startswith("/api/v1/nodes/")
                         and self.path.endswith("/status")):
-                    return self._json(200, {})  # heartbeat parity stub
+                    return 200, {}  # heartbeat parity stub
                 # Node update (relabel / retaint / capacity change): the
                 # store fans a MODIFIED event to every watch stream, so
                 # churn workloads run over the wire (eventhandlers.go
@@ -1053,16 +1645,27 @@ class APIServer:
                 if self.path.startswith("/api/v1/nodes/"):
                     node = node_from_wire(self._body())
                     if node.name != self.path.split("/")[4]:
-                        return self._json(400, {"error": "name mismatch"})
+                        return 400, {"error": "name mismatch"}
                     server.store.update_node(node)
-                    return self._json(200, node_to_wire(node))
-                self._json(404, {"error": "not found"})
+                    return 200, node_to_wire(node)
+                return 404, {"error": "not found"}
 
             def do_DELETE(self):
+                if server.role != "leader":
+                    return self._json(421, {"error": "NotLeader",
+                                            "leader": server.leader_url})
                 with server._write_lock:
-                    return self._do_delete()
+                    if server.role != "leader":
+                        code, obj, seq = 421, {
+                            "error": "NotLeader",
+                            "leader": server.leader_url}, 0
+                    else:
+                        code, obj = self._delete_locked()
+                        seq = server._repl_seq
+                server._await_shipped(seq)
+                self._json(code, obj)
 
-            def _do_delete(self):
+            def _delete_locked(self):
                 if self.path.startswith("/api/v1/pods/"):
                     uid = self.path.split("/")[4]
                     pod = server.store.pods.get(uid)
@@ -1074,16 +1677,23 @@ class APIServer:
                             # committed usage); only a completed delete
                             # releases the node's share.
                             server._usage_apply(bound_to, pod, -1)
-                    return self._json(200, {})
+                    return 200, {}
                 if self.path.startswith("/api/v1/nodes/"):
                     server.store.delete_node(self.path.split("/")[4])
-                    return self._json(200, {})
-                self._json(404, {"error": "not found"})
+                    return 200, {}
+                return 404, {"error": "not found"}
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
-        return self._httpd.server_address[1]
+        bound_port = self._httpd.server_address[1]
+        # Replication identity: this replica's own base URL is what a
+        # NotLeader redirect points at once it promotes, and what its
+        # status document advertises for election probes.
+        self.advertise_url = f"http://127.0.0.1:{bound_port}"
+        if self.role == "leader" and not self.leader_url:
+            self.leader_url = self.advertise_url
+        return bound_port
 
     def shutdown(self) -> None:
         httpd = self._httpd
@@ -1152,7 +1762,8 @@ class KeepAliveClient:
 
     def call(self, method: str, path: str, body: Optional[dict] = None,
              timeout: Optional[float] = None,
-             headers: Optional[Dict[str, str]] = None):
+             headers: Optional[Dict[str, str]] = None,
+             replay: bool = True):
         import http.client as _hc
         import io
         from urllib import error as urlerror
@@ -1160,7 +1771,12 @@ class KeepAliveClient:
         data = json.dumps(body).encode() if body is not None else None
         headers = dict(headers or (), **{"Content-Type": "application/json"})
         t = timeout if timeout is not None else self._timeout
-        may_replay = method in ("GET", "PUT")
+        # replay=False: the caller owns replays (HTTPClientset's
+        # leader-routed writes — against a REPLICATED control plane a dead
+        # connection may mean the leader itself died, and a blind same-host
+        # replay would race the promotion; the caller must re-resolve the
+        # leader first, then replay through the idempotent/409 surface).
+        may_replay = replay and method in ("GET", "PUT")
         for attempt in (0, 1):
             conn = getattr(self._local, "conn", None)
             fresh = conn is None
@@ -1203,7 +1819,7 @@ class KeepAliveClient:
                 # did-process case too (creates answer 409 AlreadyExists,
                 # same-node bind replays answer 200, deletes/status are
                 # idempotent).
-                stale = not fresh and isinstance(
+                stale = replay and not fresh and isinstance(
                     e, (_hc.RemoteDisconnected, ConnectionResetError,
                         BrokenPipeError))
                 if (may_replay or stale) and not fresh and attempt == 0:
@@ -1223,7 +1839,20 @@ class HTTPClientset:
     fanout (events arrive on the reflector thread → the scheduler's inbox).
 
     Only the pod/node surface crosses the wire (the verbs the scheduler
-    core exercises); the remaining listers return empty local dicts."""
+    core exercises); the remaining listers return empty local dicts.
+
+    Against a REPLICATED control plane (kubernetes_tpu/replication/) the
+    base URL may be a FOLLOWER: reads (list/watch/RESUME, leases) serve
+    from it, while every mutating verb routes through `_write_call` —
+    follow a ``421 NotLeader`` redirect to the leader, and on a transport
+    failure RE-RESOLVE the leader through ``/replication/status`` before
+    the single replay (a blind same-host replay would race a promotion;
+    the idempotent create-409 / same-node-bind-200 surface absorbs the
+    rare did-process replay). ``fallbacks`` lists sibling read bases: when
+    the base itself dies (follower kill), the reflector rotates to the
+    next one and RESUMEs by rv — replicas share one rv/epoch space, so no
+    re-list. A ``FAILOVER`` watch marker bumps ``failover_count`` (the
+    scheduler's reconcile trigger) and pre-warms the leader route."""
 
     # Binds terminate at the apiserver's binding subresource, whose Omega
     # commit validation rejects overcommits with 409 — the property
@@ -1231,9 +1860,23 @@ class HTTPClientset:
     # FakeClientset binds unconditionally and must not claim it.
     validates_bind_capacity = True
 
-    def __init__(self, base_url: str, sync_timeout: float = 30.0):
+    def __init__(self, base_url: str, sync_timeout: float = 30.0,
+                 fallbacks=()):
         self.base = base_url.rstrip("/")
+        # Read plane: the base plus sibling replicas the reflector may
+        # rotate to when the base dies (shared rv/epoch space -> RESUME).
+        self._bases: List[str] = [self.base] + [
+            b.rstrip("/") for b in fallbacks if b]
+        self._base_idx = 0
         self._ka = KeepAliveClient(self.base)
+        self._ka_cache: Dict[str, KeepAliveClient] = {self.base: self._ka}
+        # Write plane: the resolved leader (None until a redirect or a
+        # FAILOVER marker names one — writes optimistically try the base).
+        self._leader_base: Optional[str] = None
+        self.failover_count = 0  # FAILOVER markers seen (reconcile trigger)
+        self.write_redirects = 0  # 421 NotLeader redirects followed
+        self.leader_resolutions = 0  # transport-failure re-resolutions
+        self.read_rotations = 0  # read-base failovers (dead follower)
         self.pods: Dict[str, Pod] = {}
         self.nodes: Dict[str, Node] = {}
         self.bindings: Dict[str, str] = {}
@@ -1287,8 +1930,148 @@ class HTTPClientset:
     def _call(self, method: str, path: str, body: Optional[dict] = None) -> dict:
         # Pooled keep-alive connections (one per calling thread): the bind
         # path POSTs once per scheduled pod, and per-call connection setup
-        # was the dominant cost of the serial host-commit loop.
-        return self._ka.call(method, path, body)
+        # was the dominant cost of the serial host-commit loop. Reads serve
+        # from the (possibly follower) read base; mutations leader-route.
+        if method == "GET":
+            return self._ka.call(method, path, body)
+        return self._write_call(method, path, body)
+
+    # -- leader routing (replication/NotLeader redirect protocol) -----------
+
+    def _ka_for(self, base: str) -> KeepAliveClient:
+        client = self._ka_cache.get(base)
+        if client is None:
+            client = self._ka_cache[base] = KeepAliveClient(base)
+        return client
+
+    def _set_leader(self, base: str) -> None:
+        base = base.rstrip("/")
+        if base:
+            self._leader_base = base
+
+    def _rotate_read_base(self, from_idx: int) -> None:
+        """Advance the shared read base one step. Idempotent per
+        `from_idx`: both reflector streams fail together against the same
+        dead replica and must not double-advance past a live one."""
+        if len(self._bases) <= 1 or self._base_idx != from_idx:
+            return
+        self._base_idx = (from_idx + 1) % len(self._bases)
+        self._ka = self._ka_for(self._bases[self._base_idx])
+        self.read_rotations += 1
+
+    def _err_body(self, e) -> dict:
+        try:
+            return json.loads(e.read() or b"{}")
+        except Exception:  # noqa: BLE001 - already an error path
+            return {}
+
+    def _try_status(self, base: str) -> Optional[dict]:
+        try:
+            return self._ka_for(base).call(
+                "GET", "/replication/status", timeout=2.0)
+        except Exception:  # noqa: BLE001 - replica dead/unreachable
+            return None
+
+    def _resolve_leader(self) -> Optional[str]:
+        """Who leads, per the live replicas' status documents. All claims
+        are collected and the HIGHEST fencing epoch wins — a stale leader
+        that has not yet learned it was deposed may still claim the role,
+        and routing writes to it would lose them into a forked history.
+        Followers' leader hints are probed one hop (a follower
+        mid-election may still point at the dead leader — that hint fails
+        its own probe and is skipped)."""
+        self.leader_resolutions += 1
+        claims: List = []  # (replEpoch, base) of every role=leader claim
+        hints: List[str] = []
+        for base in list(self._bases):
+            st = self._try_status(base)
+            if st is None:
+                continue
+            if st.get("role") == "leader":
+                claims.append((int(st.get("replEpoch", 0)), base))
+            elif st.get("leader"):
+                hints.append(st["leader"].rstrip("/"))
+        seen = {base for _, base in claims} | set(self._bases)
+        for url in hints:
+            if url in seen:
+                continue
+            seen.add(url)
+            st = self._try_status(url)
+            if st is not None and st.get("role") == "leader":
+                claims.append((int(st.get("replEpoch", 0)), url))
+        if claims:
+            return max(claims)[1]
+        return None
+
+    def _write_call(self, method: str, path: str, body=None,
+                    headers: Optional[Dict[str, str]] = None):
+        """One mutating call with the NotLeader-redirect + single-replay
+        contract: optimistic send to the resolved leader (or the base),
+        follow at most one 421 redirect, and on a transport failure
+        RE-RESOLVE the leader before the one replay. Exactly-once rides
+        the server's idempotent surface (create->409 AlreadyExists,
+        same-node bind->200), including replays that land on a freshly
+        PROMOTED leader."""
+        from urllib.error import HTTPError, URLError
+
+        from .backoff import TransientAPIError
+
+        if self._leader_base:
+            client, tried = self._ka_for(self._leader_base), self._leader_base
+        else:
+            # The CURRENT read base — after a read-plane rotation self._ka
+            # no longer points at self.base, and a redirect naming the
+            # original base must still be followed.
+            client, tried = self._ka, self._bases[self._base_idx]
+        try:
+            return client.call(method, path, body, headers=headers,
+                               replay=False)
+        except HTTPError as e:
+            if e.code != 421:
+                raise
+            info = self._err_body(e)
+            leader = (info.get("leader") or "").rstrip("/")
+            if leader and leader != tried:
+                # NotLeader redirect: one follow. The followed hop can
+                # itself answer 421 (a freshly deposed leader pointing
+                # onward mid-failover) — that too is "promotion in
+                # flight", surfaced retriable, never a hard 4xx failure.
+                self.write_redirects += 1
+                self._set_leader(leader)
+                try:
+                    return self._ka_for(leader).call(
+                        method, path, body, headers=headers, replay=False)
+                except HTTPError as e2:
+                    if e2.code != 421:
+                        raise
+                    self._leader_base = None
+                    raise TransientAPIError(
+                        "NotLeader after redirect: promotion in flight"
+                    ) from e2
+            # No redirect target (or a stale one pointing back at who we
+            # just asked) — a deposed replica may not know the winner.
+            # Try one status-probe resolution; failing that, surface
+            # retriable — binds queue behind the retry layers until a
+            # leader exists.
+            self._leader_base = None
+            resolved = self._resolve_leader()
+            if resolved and resolved != tried:
+                self._set_leader(resolved)
+                return self._ka_for(resolved).call(
+                    method, path, body, headers=headers, replay=False)
+            raise TransientAPIError(
+                "NotLeader: promotion in flight") from e
+        except URLError:
+            # The server we were writing to is gone (leader death /
+            # restart). Re-resolve through the read plane FIRST, then
+            # replay once — never a blind same-host replay.
+            leader = self._resolve_leader()
+            if leader is None:
+                self._leader_base = None
+                raise
+            self._set_leader(leader)
+            return self._ka_for(leader).call(
+                method, path, body, headers=headers, replay=False)
 
     def create_pod(self, pod: Pod) -> Pod:
         self._call("POST", "/api/v1/pods", pod_to_wire(pod))
@@ -1315,14 +2098,15 @@ class HTTPClientset:
         tr = _spans.default_tracer()
         ctx = tr.context_for(pod.uid)
         if not tr.wants(ctx):
-            self._call("POST", f"/api/v1/pods/{pod.uid}/binding",
-                       {"node": node_name})
+            self._write_call("POST", f"/api/v1/pods/{pod.uid}/binding",
+                             {"node": node_name})
             return
         t0 = time.perf_counter()
         try:
-            self._ka.call("POST", f"/api/v1/pods/{pod.uid}/binding",
-                          {"node": node_name},
-                          headers={_spans.TRACE_HEADER: _spans.format_ctx(ctx)})
+            self._write_call(
+                "POST", f"/api/v1/pods/{pod.uid}/binding",
+                {"node": node_name},
+                headers={_spans.TRACE_HEADER: _spans.format_ctx(ctx)})
         finally:
             tr.record("bind.post", ctx, time.perf_counter() - t0,
                       node=node_name)
@@ -1439,9 +2223,11 @@ class HTTPClientset:
         # draining to EOF.
         import http.client as _hc
         import time as _time
-        host = self.base.split("//", 1)[1]
         backoff = 0.05
+        conn_fails = 0  # consecutive failures against the CURRENT read base
         while not self._stop.is_set():
+            base_idx = self._base_idx
+            host = self._bases[base_idx].split("//", 1)[1]
             try:
                 conn = _hc.HTTPConnection(host, timeout=60)
                 path = f"/api/v1/{kind}?watch=true"
@@ -1451,6 +2237,7 @@ class HTTPClientset:
                              f"&epoch={self._epoch[kind]}")
                 conn.request("GET", path)
                 resp = conn.getresponse()
+                conn_fails = 0
             except Exception as e:  # noqa: BLE001 - connect failure
                 if not self._synced[kind].is_set():
                     # Initial connection failed: dead on arrival is an error,
@@ -1458,6 +2245,14 @@ class HTTPClientset:
                     self._fatal[kind] = e
                     self._synced[kind].set()
                     return
+                # Read-plane failover: when the base itself stays dead
+                # (follower kill), rotate to a sibling replica and RESUME
+                # from the shared rv/epoch space — no re-list, and the
+                # stall stays bounded by a few connect backoffs.
+                conn_fails += 1
+                if conn_fails >= 3:
+                    self._rotate_read_base(base_idx)
+                    conn_fails = 0
                 if self._stop.wait(backoff):
                     return
                 backoff = min(backoff * 2, 5.0)
@@ -1474,6 +2269,16 @@ class HTTPClientset:
                     typ = event["type"]
                     if typ == "BOOKMARK":
                         continue  # server idle heartbeat
+                    if typ == "FAILOVER":
+                        # Control-plane leadership moved (promotion, or our
+                        # follower re-tailed to a new leader): pre-warm the
+                        # write route and bump the reconcile trigger — the
+                        # scheduler sweeps for binds the dead leader acked
+                        # but never shipped.
+                        if event.get("leader"):
+                            self._set_leader(event["leader"])
+                        self.failover_count += 1
+                        continue
                     if typ == "RESUME":
                         # Incremental reconnect: the server will replay the
                         # missed tail — the local cache stays authoritative,
@@ -1643,6 +2448,16 @@ def main(argv=None) -> int:
                          "just process death)")
     ap.add_argument("--snapshot-every", type=int, default=2048,
                     help="compact the WAL into a snapshot every N records")
+    ap.add_argument("--replicate-from", default="",
+                    help="run as a FOLLOWER replica of this leader base URL "
+                         "(kubernetes_tpu/replication/): tail its WAL, "
+                         "serve reads, redirect writes")
+    ap.add_argument("--replica-rank", type=int, default=1,
+                    help="election order among followers (lowest live rank "
+                         "promotes on leader death)")
+    ap.add_argument("--repl-lease-duration", type=float, default=0.0,
+                    help="leader-lease/failover-detection period in seconds "
+                         "(0 on a standalone leader = no replication lease)")
     args = ap.parse_args(argv)
     # The server is thread-per-connection with ~a dozen live threads under
     # a sharded cluster (creators, watch streams, shard write conns). At
@@ -1655,11 +2470,23 @@ def main(argv=None) -> int:
     _sys.setswitchinterval(0.001)
     api = APIServer(data_dir=args.data_dir or None, fsync=args.fsync,
                     snapshot_every=args.snapshot_every)
+    repl_lease = args.repl_lease_duration
+    tail = None
+    if args.replicate_from:
+        from ..replication import ReplicationTail
+        tail = ReplicationTail(api, args.replicate_from,
+                               rank=max(1, args.replica_rank),
+                               lease_duration=repl_lease or 2.0)
+        # Synchronous initial sync BEFORE announcing ready: a cold
+        # follower installs the leader snapshot, a restarted one already
+        # recovered its own WAL above and just re-tails the delta.
+        tail.bootstrap()
     # Observability (docs/OBSERVABILITY.md): label this process's spans and
     # install the flight recorder into the durable data dir (or the
     # explicit TPU_SCHED_FLIGHTREC_DIR). The periodic dump is what a chaos
     # kill -9 leaves behind — no handler observes SIGKILL.
-    api.tracer.proc = "apiserver"
+    api.tracer.proc = ("apiserver" if tail is None
+                       else f"apiserver-r{api.replica_rank}")
     flight = None
     flight_dir = os.environ.get("TPU_SCHED_FLIGHTREC_DIR") or args.data_dir
     if flight_dir:
@@ -1670,11 +2497,28 @@ def main(argv=None) -> int:
             autodump_interval=float(
                 os.environ.get("TPU_SCHED_FLIGHTREC_INTERVAL", "5.0")))
     port = api.serve(args.port)
+    lease = None
+    if tail is not None:
+        # The tail thread starts only after serve(): election needs this
+        # replica's advertise_url to skip itself in the peer probe. The
+        # LeaderLease no-ops until a promotion makes this replica leader.
+        from ..replication import LeaderLease
+        tail.start()
+        lease = LeaderLease(api, identity=f"apiserver-r{api.replica_rank}",
+                            duration=repl_lease or 2.0).start()
+    elif repl_lease > 0:
+        from ..replication import LeaderLease
+        lease = LeaderLease(api, identity="apiserver-leader",
+                            duration=repl_lease).start()
     # "serving on" stays the FIRST line: spawn harnesses select()+readline()
     # on it, and a buffered readline would swallow any earlier line together
     # with this one (leaving select blocked on a drained pipe).
     print(f"kubernetes-tpu-apiserver: serving on 127.0.0.1:{port}",
           flush=True)
+    if tail is not None:
+        print(f"kubernetes-tpu-apiserver: follower rank="
+              f"{api.replica_rank} of {args.replicate_from} "
+              f"seq={api._repl_seq} replEpoch={api.repl_epoch}", flush=True)
     if api.persistence is not None:
         p = api.persistence
         print(f"kubernetes-tpu-apiserver: recovered {api.recovered_objects} "
@@ -1685,6 +2529,10 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    if tail is not None:
+        tail.stop()
+    if lease is not None:
+        lease.stop()
     api.shutdown()
     if flight is not None:
         flight.dump("shutdown")

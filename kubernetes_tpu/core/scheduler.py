@@ -372,6 +372,13 @@ class Scheduler:
         # apiserver restart reported a cache-placed pod as UNBOUND): the
         # assumed-vs-recovered-truth reconciliation below unwound them.
         self.reconcile_unwinds = 0
+        # Control-plane failovers this scheduler has reacted to: a FAILOVER
+        # watch marker (replicated apiserver promotion) bumps the
+        # clientset's failover_count; run_until_idle notices and runs
+        # reconcile_bindings — a bind the dead leader acked but never
+        # shipped is unbound in the promoted truth and has NO event to
+        # trigger the per-event reconcile path above.
+        self._seen_failovers = 0
         # Shard plane (kubernetes_tpu/shard/): optional admission predicate —
         # when set, only pods it accepts enter THIS scheduler's queue (the
         # shard-scoped admission seam; the cache still mirrors the whole
@@ -669,6 +676,15 @@ class Scheduler:
 
     def run_until_idle(self, max_cycles: int = 1_000_000) -> int:
         """Drive schedule_one until the queue drains (test/bench harness)."""
+        fc = getattr(self.clientset, "failover_count", 0)
+        if fc != self._seen_failovers:
+            # Control-plane leadership moved (FAILOVER watch marker): drain
+            # the inbox so the cache reflects everything the stream already
+            # delivered, then sweep for placements whose committed bind the
+            # promoted leader does not hold (see reconcile_bindings).
+            self._seen_failovers = fc
+            self.drain_event_inbox()
+            self.reconcile_bindings()
         n = 0
         while n < max_cycles:
             if self.loop_hook is not None:
@@ -695,6 +711,36 @@ class Scheduler:
                     continue  # writes still in flight: stay responsive
             n += 1
         return n
+
+    def reconcile_bindings(self) -> int:
+        """Failover sweep (scheduling thread only): unwind every cache
+        placement whose COMPLETED bind the control plane does not hold.
+
+        The per-event reconcile in _on_pod_event covers binds revoked by a
+        re-list/resume replay — but a bind the dead LEADER acked and never
+        shipped to the promoted follower produces NO event at all (the
+        follower simply never saw it), so after a FAILOVER marker this
+        sweep compares the informer truth against the cache directly.
+        In-flight binds are deliberately untouched: their retry layers
+        re-commit through the idempotent/409 surface."""
+        unwound = 0
+        for uid, st in list(self.cache.pod_states.items()):
+            if not st.binding_finished:
+                continue
+            api_pod = self.clientset.pods.get(uid)
+            if api_pod is None or api_pod.node_name:
+                continue  # deleted -> DELETED event path; bound -> coherent
+            self.reconcile_unwinds += 1
+            self.state_unwinds += 1
+            self._record_event(EV_OTHER, uid)
+            self.cache.remove_pod(st.pod)
+            self.queue.move_all_to_active_or_backoff(
+                EVENT_ASSIGNED_POD_DELETE, st.pod, None)
+            if self._responsible_for_pod(api_pod) and self._admits(api_pod):
+                api_pod.node_name = ""
+                self.queue.add(api_pod)
+            unwound += 1
+        return unwound
 
     def process_async_api_errors(self) -> int:
         """Run deferred thread-mode on_error handlers on the scheduling loop

@@ -77,7 +77,7 @@ CORE_CHAIN = ("queue.wait", "host.commit", "bind.post", "api.bind",
               "wal.append", "bound.fanout")
 # Always-sampled forensic stages (recorded with force=True contexts).
 FORCED_STAGES = ("bind.conflict", "device.fallback", "shard.adopt",
-                 "trace.slow_step")
+                 "trace.slow_step", "replication.promote")
 
 _SAMPLE_ENV = "TPU_SCHED_TRACE_SAMPLE"
 _ENABLE_ENV = "TPU_SCHED_TRACE"

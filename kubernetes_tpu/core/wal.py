@@ -13,11 +13,16 @@ of the apiserver process.
 
 Layout of ``data_dir``:
 
-- ``meta.json``    — ``{"epoch": ...}`` written once at first boot; the
-  epoch a recovered server re-announces on SYNC/RESUME markers, which is
-  what lets clients resume (PR 1's epoch guard rejects resumes against a
-  server whose counters restarted; a recovered server's counters do NOT
-  restart, so the SAME epoch is re-used deliberately).
+- ``meta.json``    — ``{"epoch": ..., "repl_epoch": N}`` written at first
+  boot; the watch epoch a recovered server re-announces on SYNC/RESUME
+  markers, which is what lets clients resume (PR 1's epoch guard rejects
+  resumes against a server whose counters restarted; a recovered server's
+  counters do NOT restart, so the SAME epoch is re-used deliberately).
+  ``repl_epoch`` is the monotonic **replication fencing epoch**
+  (kubernetes_tpu/replication/): bumped exactly once per follower
+  promotion, stamped on every shipped WAL frame, and persisted here so a
+  restarted replica can never ship or accept frames from a deposed
+  leader's generation.
 - ``snapshot.json`` — the latest compaction: full object state + the rv
   counters at the moment of the snapshot. Written atomically
   (tmp + ``os.replace``); the WAL is reset right after.
@@ -65,7 +70,16 @@ class DurableStore:
         self.replayed_records = 0
         self.torn_records_discarded = 0
         self.compactions = 0
-        self.epoch: Optional[str] = self._read_json(self.META, {}).get("epoch")
+        meta = self._read_json(self.META, {})
+        self.epoch: Optional[str] = meta.get("epoch")
+        # Replication fencing epoch (monotonic int, bumped per promotion).
+        # 1 = the first leader generation of this data dir's history.
+        self.repl_epoch: int = int(meta.get("repl_epoch", 1))
+        # Persisted replication role: a DEPOSED leader (or a follower) must
+        # never restart read-write — it would accept acked writes into a
+        # forked history the real plane never sees.
+        self.role: str = meta.get("role", "leader")
+        self.leader_url: str = meta.get("leader_url", "")
 
     # -- small file helpers -------------------------------------------------
 
@@ -88,11 +102,33 @@ class DurableStore:
 
     # -- boot ---------------------------------------------------------------
 
+    def _write_meta(self) -> None:
+        self._write_json_atomic(self.META, {
+            "epoch": self.epoch, "repl_epoch": self.repl_epoch,
+            "role": self.role, "leader_url": self.leader_url})
+
     def init_epoch(self, epoch: str) -> None:
         """First boot of this data_dir: persist the freshly minted epoch so
         every future recovery re-announces it."""
         self.epoch = epoch
-        self._write_json_atomic(self.META, {"epoch": epoch})
+        self._write_meta()
+
+    def set_repl_epoch(self, repl_epoch: int) -> None:
+        """Persist a replication-epoch bump (promotion fencing) BEFORE the
+        new leader accepts its first write: a promoted replica that crashes
+        and recovers must come back in the generation it won, or its own
+        stale-frame rejection breaks."""
+        self.repl_epoch = int(repl_epoch)
+        self._write_meta()
+
+    def set_role(self, role: str, leader_url: str = "") -> None:
+        """Persist a role transition (promotion / deposition) atomically
+        with the current epochs: a deposed leader that restarts must come
+        back fenced (follower, redirecting at the winner), never
+        read-write into a forked history."""
+        self.role = role
+        self.leader_url = leader_url
+        self._write_meta()
 
     def load(self) -> Tuple[Optional[dict], List[dict]]:
         """Read (snapshot, wal_records) for recovery. Discards a torn final

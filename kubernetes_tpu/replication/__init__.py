@@ -1,0 +1,18 @@
+"""WAL-shipping apiserver replication (docs/RESILIENCE.md § replication).
+
+The reference survives control-plane node loss because its state plane is
+split into a replicated log (etcd3) and read-serving watch caches; this
+package rebuilds that split natively over the repo's own WAL
+(core/wal.py): a **follower** apiserver tails the leader's committed WAL
+frames over ``GET /replication/wal``, replays them into its own store +
+on-disk WAL (``APIServer.apply_frame``), and serves the full read plane
+(list / watch / RESUME / metrics) to its local shard schedulers, while
+every mutating verb answers ``421 NotLeader`` with a redirect the client
+follows to the leader. Leader death promotes the lowest-ranked live
+follower (``ReplicationTail`` election -> ``APIServer.promote``), fenced
+by a monotonic replication epoch stamped on every shipped frame.
+"""
+
+from .follower import (REPL_LEASE, LeaderLease, ReplicationTail)
+
+__all__ = ["ReplicationTail", "LeaderLease", "REPL_LEASE"]

@@ -1,0 +1,385 @@
+"""Follower-side replication: WAL tail, snapshot bootstrap, election.
+
+A follower is a full :class:`~..core.apiserver.APIServer` in
+``role="follower"`` — same store, same watch plane, same WAL — plus a
+:class:`ReplicationTail` thread that keeps it converged with the leader:
+
+- **tail**: long-lived ``GET /replication/wal?from=<seq>&epoch=<E>``
+  stream; every received frame replays through ``APIServer.apply_frame``
+  (local WAL append first, then store upsert, then local watch fanout —
+  the leader's own write ordering). Heartbeats (``HB``) carry the leader's
+  head seq, which feeds the ``apiserver_replication_lag_records`` gauge.
+- **bootstrap**: a cold follower (or one the ship window no longer
+  covers — 410 ``ResyncRequired``) installs ``GET /replication/snapshot``
+  and re-tails from the snapshot's seq. Local WAL recovery
+  (``APIServer(data_dir=...)``) already happened before the tail starts,
+  so a restarted follower resumes from its own disk, not a snapshot.
+- **election**: when nothing (frame, HB, reconnect) has been heard for a
+  full lease period, probe the peer set: follow an already-promoted
+  leader of a newer epoch; defer to a live lower-ranked follower; else —
+  this IS the lowest-ranked live follower — ``promote()``. The fencing
+  epoch bump rejects any straggler frames from the deposed generation.
+
+Failure-mode contract (docs/RESILIENCE.md): shard schedulers keep
+scheduling from follower reads throughout a failover; their writes fail
+fast (connection refused / 421 against a stale redirect) and ride the
+client retry layers until the promotion lands — degraded, never a crash.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Optional
+from urllib import request as urlrequest
+
+REPL_LEASE = "repl-leader"
+
+
+class LeaderLease:
+    """Maintains the durable ``repl-leader`` lease record — the same
+    PUT-CAS + server-side-expiry shape as the shard slots
+    (shard/leases.py), WAL'd and therefore SHIPPED, so every follower's
+    replicated lease table shows who leads and for how long it has been
+    silent. The renewer runs in every replica and simply no-ops while the
+    replica is not the leader, so a promotion needs no extra wiring: the
+    next tick after ``promote()`` CAS-takes the (by then expired) lease."""
+
+    def __init__(self, api, identity: str, duration: float = 2.0):
+        self.api = api
+        self.identity = identity
+        self.duration = duration
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.renewals = 0
+
+    def renew_once(self) -> bool:
+        if self.api.role != "leader":
+            return False
+        try:
+            got = self.api.upsert_lease(REPL_LEASE, self.identity,
+                                        self.duration)
+        except Exception:  # noqa: BLE001 - the lease simply ages
+            return False
+        # upsert_lease answers None (CAS loss) or the NOT_LEADER sentinel
+        # (we raced a deposition) — only a real lease record counts.
+        if isinstance(got, dict):
+            self.renewals += 1
+            return True
+        return False
+
+    def start(self) -> "LeaderLease":
+        if self._thread is not None:
+            return self
+
+        def loop():
+            while not self._stop.wait(self.duration / 3.0):
+                self.renew_once()
+
+        self.renew_once()
+        self._thread = threading.Thread(target=loop, name="repl-leader-lease",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class ReplicationTail:
+    """The follower's replication client + election state machine."""
+
+    def __init__(self, api, leader_url: str, rank: int,
+                 peers: Optional[Dict[int, str]] = None,
+                 lease_duration: float = 2.0,
+                 hb_interval: Optional[float] = None):
+        api.role = "follower"
+        api.leader_url = leader_url
+        api.replica_rank = rank
+        api.repl_tail = self  # surfaced via /replication/status: election
+        # deferral only honors peers whose tail is ALIVE (can promote)
+        if peers:
+            api.repl_peers.update(peers)
+        self.api = api
+        self.leader_url = leader_url
+        self.lease_duration = lease_duration
+        # Heartbeats several times per lease period: one lost HB must not
+        # look like a dead leader.
+        self.hb = hb_interval if hb_interval is not None \
+            else max(0.1, lease_duration / 4.0)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._conn = None
+        # The generation the CURRENT leader_url is known to claim (from
+        # the election probe, the promotion announcement, or stream HBs).
+        # Passed to apply_frame so a lagging survivor that already adopted
+        # the winner's epoch still accepts the winner's PRE-promotion
+        # frames (stamped with the old epoch). 0 = unknown: frames are
+        # judged on their own stamps only.
+        self.leader_epoch = 0
+        self.last_contact = time.monotonic()
+        self.reconnects = 0
+        self.bootstraps = 0
+        self.elections = 0
+        self.deferrals = 0
+        self.fenced_streams = 0
+
+    # -- bootstrap ----------------------------------------------------------
+
+    def _get_json(self, url: str, timeout: float):
+        req = urlrequest.Request(url)
+        with urlrequest.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def bootstrap(self, timeout: float = 30.0) -> None:
+        """Synchronous initial sync for a COLD follower (empty local WAL):
+        install the leader's snapshot before serving reads, so the first
+        client list/watch never sees an empty store that then re-fills.
+        A follower with local WAL state skips this — its own recovery is
+        authoritative and the tail replays the delta."""
+        if self.api._repl_seq > 0:
+            return
+        deadline = time.monotonic() + timeout
+        delay = 0.05
+        while True:
+            try:
+                self._bootstrap_snapshot()
+                return
+            except Exception:  # noqa: BLE001 - leader may still be starting
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
+    def _bootstrap_snapshot(self) -> None:
+        # Verify the source IS the current leader first: installing a
+        # snapshot from a demoted/stale peer would REGRESS this replica's
+        # store and seq to a forked, older history (and sentinel-close its
+        # clients' watch streams into a re-list against it).
+        st = self._probe(self.leader_url)
+        if (st is None or st.get("role") != "leader"
+                or int(st.get("replEpoch", 0)) < self.api.repl_epoch):
+            raise RuntimeError(
+                f"snapshot source {self.leader_url} is not the current "
+                f"leader: {st}")
+        snap = self._get_json(self.leader_url + "/replication/snapshot",
+                              timeout=max(10.0, self.lease_duration * 4))
+        self.api.install_snapshot(snap)
+        self.bootstraps += 1
+        self.last_contact = time.monotonic()
+
+    # -- the tail loop ------------------------------------------------------
+
+    def start(self) -> "ReplicationTail":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name=f"repl-tail-{self.api.replica_rank}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        conn = self._conn
+        if conn is not None:
+            try:
+                import socket
+                if conn.sock is not None:
+                    conn.sock.shutdown(socket.SHUT_RDWR)
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        backoff = 0.05
+        while not self._stop.is_set():
+            # A promotion ANNOUNCEMENT (POST /replication/leader ->
+            # note_leader) may have moved api.leader_url while this thread
+            # was tailing or backing off: adopt it before (re)connecting —
+            # the fastest convergence path, no silence detection needed.
+            api_leader = self.api.leader_url
+            if (api_leader and api_leader != self.leader_url
+                    and self.api.role == "follower"):
+                self.leader_url = api_leader
+                # note_leader adopted the announced generation already.
+                self.leader_epoch = self.api.repl_epoch
+            try:
+                progressed = self._tail_once()
+            except Exception:  # noqa: BLE001 - transport failure = dead tail
+                progressed = False
+            if self._stop.is_set() or self.api.role == "leader":
+                return  # promoted (or shutting down): the tail's job is done
+            if progressed:
+                backoff = 0.05
+                continue
+            if (time.monotonic() - self.last_contact) > self.lease_duration:
+                self._election()
+                if self.api.role == "leader":
+                    return
+            if self._stop.wait(backoff):
+                return
+            backoff = min(backoff * 2, max(0.25, self.lease_duration / 4.0))
+
+    def _tail_once(self) -> bool:
+        """One ship-stream attachment: True when the stream made contact
+        (connected and delivered at least a heartbeat) before dying."""
+        import http.client as _hc
+        from urllib.parse import quote
+
+        api = self.api
+        host = self.leader_url.split("//", 1)[1]
+        conn = _hc.HTTPConnection(host, timeout=max(
+            2.0, self.lease_duration * 2))
+        path = (f"/replication/wal?from={api._repl_seq}"
+                f"&epoch={api.repl_epoch}&hb={self.hb}"
+                f"&leader={quote(self.leader_url, safe='')}")
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+        except Exception:  # noqa: BLE001 - leader unreachable
+            conn.close()
+            return False
+        if resp.status == 410:
+            # Ship window no longer covers our seq (leader compacted past
+            # us, or our history diverged): full snapshot resync.
+            try:
+                resp.read()
+            finally:
+                conn.close()
+            self._bootstrap_snapshot()
+            return True
+        if resp.status != 200:
+            try:
+                resp.read()
+            finally:
+                conn.close()
+            return False
+        self._conn = conn
+        self.reconnects += 1
+        made_contact = False
+        try:
+            while not self._stop.is_set():
+                line = resp.readline()
+                if not line:
+                    return made_contact  # EOF: leader went away
+                rec = json.loads(line)
+                if rec.get("type") == "HB":
+                    ep = int(rec.get("epoch", 0))
+                    if (ep < api.repl_epoch
+                            or rec.get("role", "leader") != "leader"):
+                        # Deposed-generation or NON-LEADER stream: fence
+                        # it off WITHOUT refreshing last_contact — a
+                        # demoted peer's heartbeats must not hold off the
+                        # election that finds the real leader.
+                        self.fenced_streams += 1
+                        return made_contact
+                    self.last_contact = time.monotonic()
+                    made_contact = True
+                    self.leader_epoch = max(self.leader_epoch, ep)
+                    api.repl_lag = max(
+                        0, int(rec.get("seq", 0)) - api._repl_seq)
+                    continue
+                self.last_contact = time.monotonic()
+                made_contact = True
+                if not api.apply_frame(rec, stream_epoch=self.leader_epoch):
+                    # Stale-epoch frame (a deposed leader's append): drop
+                    # the stream; the election will find the real leader.
+                    self.fenced_streams += 1
+                    return made_contact
+            return made_contact
+        finally:
+            self._conn = None
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- election -----------------------------------------------------------
+
+    def _probe(self, url: str) -> Optional[dict]:
+        try:
+            return self._get_json(url + "/replication/status",
+                                  timeout=max(0.2, self.lease_duration / 4.0))
+        except Exception:  # noqa: BLE001 - peer dead/unreachable
+            return None
+
+    def _election(self) -> None:
+        """A full lease period of silence: decide between following a new
+        leader, deferring to a lower-ranked live follower, or promoting."""
+        api = self.api
+        self.elections += 1
+        statuses: Dict[int, dict] = {}
+        for rank, url in sorted(api.repl_peers.items()):
+            if url == api.advertise_url:
+                continue
+            st = self._probe(url)
+            if st is not None:
+                statuses[rank] = st
+        # 1) Someone already leads (>= our generation): follow the claim
+        # with the HIGHEST fencing epoch — a stale leader that has not yet
+        # learned it was deposed may still claim the role. This also
+        # covers the ORIGINAL leader coming back after a restart.
+        claims = [(int(st.get("replEpoch", 0)), rank) for rank, st
+                  in statuses.items()
+                  if st.get("role") == "leader"
+                  and int(st.get("replEpoch", 0)) >= api.repl_epoch]
+        if claims:
+            ep, rank = max(claims)
+            st = statuses[rank]
+            url = st.get("leader") or api.repl_peers.get(rank, "")
+            if url:
+                self.leader_url = url
+                self.leader_epoch = ep
+                api.note_leader(url, ep)
+                self.last_contact = time.monotonic()
+            return
+        # 2) A live follower with a lower rank AND a live tail exists: it
+        # promotes, we defer — but only for half a lease period, so a
+        # candidate that dies mid-election doesn't wedge the plane. A
+        # tail-less "follower" (a demoted seed leader, or a deposed
+        # ex-promotee whose tail thread exited) can never promote — do
+        # NOT defer to it, or the plane livelocks leaderless.
+        if any(st.get("role") == "follower" and rank < api.replica_rank
+               and (st.get("tail") or {}).get("alive")
+               for rank, st in statuses.items()):
+            self.deferrals += 1
+            self.last_contact = time.monotonic() - self.lease_duration / 2.0
+            return
+        # 3) This is the lowest-ranked live follower: take over. Everything
+        # readable from the dead leader's stream has been applied (the tail
+        # drains to EOF before landing here) — the WAL tail IS replayed.
+        api.promote(reason="leader_lost")
+        self.leader_url = api.advertise_url
+        self._announce_leadership()
+
+    def _announce_leadership(self) -> None:
+        """Push the new generation to every peer (POST /replication/leader):
+        surviving followers re-tail to us immediately, and a stale
+        co-claimant demotes itself even though no follower tails it. Best
+        effort — a peer that misses it converges via its own election."""
+        import json as _json
+
+        api = self.api
+        body = _json.dumps({"leader": api.advertise_url,
+                            "epoch": api.repl_epoch,
+                            "rank": api.replica_rank}).encode()
+        for rank, url in sorted(api.repl_peers.items()):
+            if url == api.advertise_url:
+                continue
+            try:
+                req = urlrequest.Request(
+                    url + "/replication/leader", data=body, method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urlrequest.urlopen(
+                        req, timeout=max(0.2, self.lease_duration / 4.0)):
+                    pass
+            except Exception:  # noqa: BLE001 - dead peer: nothing to tell
+                pass

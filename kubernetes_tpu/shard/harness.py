@@ -146,20 +146,26 @@ class ShardedCluster:
     """Handles to a running sharded cluster (context for progress_cb)."""
 
     def __init__(self, base: str, api_proc, shard_procs: List,
-                 shard_urls: List[str]):
+                 shard_urls: List[str], follower_procs: Optional[List] = None,
+                 follower_urls: Optional[List[str]] = None):
         from ..testing.faults import drain_pipe
 
         self.base = base
         self.api_proc = api_proc
         self.shard_procs = shard_procs
         self.shard_urls = shard_urls
+        # Replicated control plane (kubernetes_tpu/replication/): follower
+        # apiserver processes the shards read from (writes redirect).
+        self.follower_procs = list(follower_procs or ())
+        self.follower_urls = list(follower_urls or ())
         self.killed: List[int] = []
         # Keep every child's stdout pipe DRAINED for the cluster's whole
         # life: a logging burst (slow-step warnings after a fallback) into
         # an unread pipe blocks the child on write mid-cycle — measured as
         # a ~2x pods/s collapse that looks like scheduler regression.
         self.log_tails = [drain_pipe(p)
-                          for p in [api_proc] + list(shard_procs)]
+                          for p in [api_proc] + list(shard_procs)
+                          + self.follower_procs]
 
     def kill(self, index: int) -> None:
         """SIGKILL one shard scheduler process — no goodbye, no flush."""
@@ -175,7 +181,7 @@ class ShardedCluster:
                 if i not in self.killed]
 
     def stop(self) -> None:
-        for p in self.shard_procs + [self.api_proc]:
+        for p in self.shard_procs + self.follower_procs + [self.api_proc]:
             if p is not None and p.poll() is None:
                 p.terminate()
                 try:
@@ -187,12 +193,22 @@ class ShardedCluster:
 def start_sharded_cluster(n_shards: int, lease_duration: float = 15.0,
                           data_dir: str = "",
                           flightrec_dir: str = "",
-                          startup_timeout: float = 180.0) -> ShardedCluster:
+                          startup_timeout: float = 180.0,
+                          replicas: int = 0,
+                          repl_lease: float = 2.0) -> ShardedCluster:
     """Spawn the apiserver + N shard scheduler processes; blocks until every
     process prints its ready line (shards spawn in parallel — each pays the
     JAX import). ``flightrec_dir`` installs the flight recorder in every
     process (TPU_SCHED_FLIGHTREC_DIR): periodic + exit dumps land there, so
-    even a SIGKILLed member leaves a recent forensic artifact."""
+    even a SIGKILLed member leaves a recent forensic artifact.
+
+    ``replicas`` > 0 builds the REPLICATED control plane
+    (kubernetes_tpu/replication/): that many follower apiservers tail the
+    leader's WAL, and each shard reads (list/watch/RESUME) from follower
+    ``i % replicas`` — with the siblings + leader as reflector fallbacks —
+    while its writes redirect to the leader. One apiserver process stops
+    being both the durability point and the availability ceiling for
+    N shards x M watch streams."""
     from ..testing.faults import spawn_ready
 
     repo, env = _repo_root(), _env()
@@ -203,35 +219,74 @@ def start_sharded_cluster(n_shards: int, lease_duration: float = 15.0,
            "--port", "0"]
     if data_dir:
         cmd += ["--data-dir", data_dir]
+    if replicas:
+        cmd += ["--repl-lease-duration", str(repl_lease)]
     api_proc, m = spawn_ready(cmd, _READY, cwd=repo, env=env,
                               timeout=startup_timeout)
     base = f"http://127.0.0.1:{m.group(1)}"
 
-    def spawn_shard(i: int):
-        # Shard-per-core placement (n>1 only; a single shard keeps the whole
-        # box): without pinning, each shard's XLA pool spans every core, so
-        # one shard's device dispatch evicts its peers' GIL threads and the
-        # plane ping-pongs instead of overlapping — measured ~20% pods/s on
-        # a 2-core host. The apiserver stays unpinned (it is I/O-bound).
-        pin: List[str] = []
-        if n_shards > 1 and shutil.which("taskset"):
-            pin = ["taskset", "-c", str(i % max(1, os.cpu_count() or 1))]
-        return spawn_ready(
-            pin + [sys.executable, "-m", "kubernetes_tpu",
-                   "--api-url", base, "--platform", "cpu", "--port", "0",
-                   "--shard-index", str(i), "--shard-count", str(n_shards),
-                   "--shard-lease-duration", str(lease_duration)],
-            _READY, cwd=repo, env=env, timeout=startup_timeout)
-
+    follower_procs: List = []
+    follower_urls: List[str] = []
     try:
+        for rank in range(1, replicas + 1):
+            fcmd = [sys.executable, "-m", "kubernetes_tpu.core.apiserver",
+                    "--port", "0", "--replicate-from", base,
+                    "--replica-rank", str(rank),
+                    "--repl-lease-duration", str(repl_lease)]
+            if data_dir:
+                fcmd += ["--data-dir", f"{data_dir}-follower-{rank}"]
+            p, fm = spawn_ready(fcmd, _READY, cwd=repo, env=env,
+                                timeout=startup_timeout)
+            follower_procs.append(p)
+            follower_urls.append(f"http://127.0.0.1:{fm.group(1)}")
+        if replicas:
+            # Ephemeral ports: inject the full election topology post-spawn.
+            peers = {"0": base}
+            peers.update({str(r + 1): u
+                          for r, u in enumerate(follower_urls)})
+            for url in [base] + follower_urls:
+                _call(url, "POST", "/replication/peers", {"peers": peers})
+
+        def spawn_shard(i: int):
+            # Shard-per-core placement (n>1 only; a single shard keeps the
+            # whole box): without pinning, each shard's XLA pool spans every
+            # core, so one shard's device dispatch evicts its peers' GIL
+            # threads and the plane ping-pongs instead of overlapping —
+            # measured ~20% pods/s on a 2-core host. The apiserver stays
+            # unpinned (it is I/O-bound).
+            pin: List[str] = []
+            if n_shards > 1 and shutil.which("taskset"):
+                pin = ["taskset", "-c", str(i % max(1, os.cpu_count() or 1))]
+            api_url = base
+            extra: List[str] = []
+            if follower_urls:
+                # Reads from this shard's follower; siblings + the leader
+                # are reflector fallbacks (writes redirect regardless).
+                api_url = follower_urls[i % len(follower_urls)]
+                others = [u for u in follower_urls if u != api_url] + [base]
+                extra = ["--api-fallbacks", ",".join(others)]
+            return spawn_ready(
+                pin + [sys.executable, "-m", "kubernetes_tpu",
+                       "--api-url", api_url, "--platform", "cpu",
+                       "--port", "0",
+                       "--shard-index", str(i),
+                       "--shard-count", str(n_shards),
+                       "--shard-lease-duration", str(lease_duration)]
+                + extra,
+                _READY, cwd=repo, env=env, timeout=startup_timeout)
+
         with ThreadPoolExecutor(max_workers=max(1, n_shards)) as ex:
             spawned = list(ex.map(spawn_shard, range(n_shards)))
     except BaseException:
+        for p in follower_procs:
+            p.terminate()
         api_proc.terminate()
         raise
     procs = [p for p, _m in spawned]
     urls = [f"http://127.0.0.1:{_m.group(1)}" for _p, _m in spawned]
-    return ShardedCluster(base, api_proc, procs, urls)
+    return ShardedCluster(base, api_proc, procs, urls,
+                          follower_procs=follower_procs,
+                          follower_urls=follower_urls)
 
 
 def run_sharded_cluster(
@@ -248,6 +303,8 @@ def run_sharded_cluster(
     timeout: float = 900.0,
     progress_cb: Optional[Callable[[int, ShardedCluster], None]] = None,
     flightrec_dir: str = "",
+    replicas: int = 0,
+    repl_lease: float = 2.0,
 ) -> dict:
     """The sharded SchedulingBasic shape end to end: create `n_nodes`,
     warm the shards with `warm_pods` (XLA compilation + first sessions land
@@ -266,7 +323,8 @@ def run_sharded_cluster(
     cap = node_capacity or {"cpu": 32, "memory": "256Gi", "pods": 110}
     req = pod_request or {"cpu": "100m", "memory": "128Mi"}
     cluster = start_sharded_cluster(n_shards, lease_duration=lease_duration,
-                                    flightrec_dir=flightrec_dir)
+                                    flightrec_dir=flightrec_dir,
+                                    replicas=replicas, repl_lease=repl_lease)
     base = cluster.base
     try:
         def post_many(path: str, wires: List[dict], chunk: int = 200) -> None:
@@ -355,8 +413,28 @@ def run_sharded_cluster(
                 "p99": round(histogram_percentile(e2e, 0.99) * 1e3, 3),
                 "count": int(e2e["count"]),
             }
+        # Replication detail: per-replica role/lag (leader + followers) —
+        # the bench.py --shards --replicas detail line.
+        replication = None
+        if cluster.follower_urls:
+            replication = []
+            for url in [base] + cluster.follower_urls:
+                try:
+                    rm = scrape_metrics(url)
+                    replication.append({
+                        "url": url,
+                        "role": int(rm.get("apiserver_replication_role", 0)),
+                        "lag": int(rm.get(
+                            "apiserver_replication_lag_records", 0)),
+                        "failovers": int(rm.get(
+                            "apiserver_failover_total", 0)),
+                    })
+                except Exception:  # noqa: BLE001 - replica down
+                    replication.append({"url": url, "role": -1})
         return {
             "shards": n_shards,
+            "replicas": replicas,
+            "replication": replication,
             "nodes": n_nodes,
             "pods": n_pods,
             "bound": got - warm_pods,
@@ -374,7 +452,8 @@ def run_sharded_cluster(
             "e2e_ms": e2e_ms,
             "flightrec_dir": flightrec_dir,
             "api": {k: v for k, v in api_metrics.items()
-                    if "conflict" in k or "lease" in k},
+                    if "conflict" in k or "lease" in k
+                    or "replication" in k or "failover" in k},
             "shard_metrics": [
                 {k: v for k, v in sm.items()
                  if k.startswith(("scheduler_shard_",

@@ -262,12 +262,18 @@ class ApiServerProcess:
     _READY = re.compile(r"serving on 127\.0\.0\.1:(\d+)")
 
     def __init__(self, data_dir: str, port: int = 0, fsync: bool = False,
-                 snapshot_every: int = 2048, startup_timeout: float = 60.0):
+                 snapshot_every: int = 2048, startup_timeout: float = 60.0,
+                 extra_args=(), extra_env=None):
         self.data_dir = data_dir
         self.port = port
         self.fsync = fsync
         self.snapshot_every = snapshot_every
         self.startup_timeout = startup_timeout
+        # Extension seams for composed harnesses (ReplicaSet): replication
+        # flags + per-process env (flight-recorder dir) without a second
+        # copy of the spawn/env/teardown mechanics.
+        self.extra_args = list(extra_args)
+        self.extra_env = dict(extra_env or {})
         self.kills = 0
         self.restarts = 0
         self.proc: Optional[subprocess.Popen] = None
@@ -284,11 +290,13 @@ class ApiServerProcess:
         env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = repo_root
+        env.update(self.extra_env)
         cmd = [sys.executable, "-m", "kubernetes_tpu.core.apiserver",
                "--port", str(self.port), "--data-dir", self.data_dir,
                "--snapshot-every", str(self.snapshot_every)]
         if self.fsync:
             cmd.append("--fsync")
+        cmd += self.extra_args
         self.proc, m = spawn_ready(cmd, self._READY, cwd=repo_root, env=env,
                                    timeout=self.startup_timeout)
         # Pin the OS-assigned port: restarts re-bind the same one.
@@ -318,6 +326,106 @@ class ApiServerProcess:
             self.proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             self.proc.kill()
+
+
+class ReplicaSet:
+    """A replicated control plane under chaos control: one leader + N
+    follower apiservers (kubernetes_tpu/replication/), each a killable OS
+    process (composed :class:`ApiServerProcess` handles) with its own data
+    dir. ``kill9_leader()`` is the headline fault — the lowest-ranked live
+    follower must promote within the replication lease TTL;
+    ``kill9_follower(rank)`` exercises the read plane's client-side
+    failover (HTTPClientset fallbacks)."""
+
+    def __init__(self, data_root: str, followers: int = 1,
+                 repl_lease: float = 2.0, snapshot_every: int = 100_000,
+                 startup_timeout: float = 120.0, flightrec_dir: str = ""):
+        self.data_root = data_root
+        self.repl_lease = repl_lease
+        self.snapshot_every = snapshot_every
+        self.startup_timeout = startup_timeout
+        self.flightrec_dir = flightrec_dir
+        if flightrec_dir:
+            os.makedirs(flightrec_dir, exist_ok=True)
+        self.kills: Dict[str, int] = {}
+        # replicas[0] is the seed leader; replicas[k] is follower rank k.
+        self.replicas: list = [self._spawn_replica(
+            os.path.join(data_root, "leader"))]
+        for rank in range(1, followers + 1):
+            self.replicas.append(self._spawn_replica(
+                os.path.join(data_root, f"follower-{rank}"),
+                replicate_from=self.leader_url, rank=rank))
+        # Inject the full rank -> URL topology into every replica (ports
+        # are ephemeral, so peers are only known post-spawn). Elections
+        # probe this map.
+        self.peers = {rank: r.url for rank, r in enumerate(self.replicas)}
+        body = {"peers": {str(k): v for k, v in self.peers.items()}}
+        for r in self.replicas:
+            self._post_json(r.url, "/replication/peers", body)
+
+    @property
+    def leader_url(self) -> str:
+        return self.replicas[0].url
+
+    @property
+    def follower_urls(self) -> list:
+        return [r.url for r in self.replicas[1:]]
+
+    def _post_json(self, base: str, path: str, body: dict) -> None:
+        # shard/harness._call: the shared pooled keep-alive JSON helper
+        # (function-local import — harness itself imports from this
+        # module, so a top-level import would cycle).
+        from ..shard.harness import _call
+        _call(base, "POST", path, body)
+
+    def _spawn_replica(self, data_dir: str, replicate_from: str = "",
+                       rank: int = 0) -> ApiServerProcess:
+        extra = ["--repl-lease-duration", str(self.repl_lease)]
+        if replicate_from:
+            extra += ["--replicate-from", replicate_from,
+                      "--replica-rank", str(rank)]
+        extra_env = ({"TPU_SCHED_FLIGHTREC_DIR": self.flightrec_dir}
+                     if self.flightrec_dir else {})
+        return ApiServerProcess(
+            data_dir, snapshot_every=self.snapshot_every,
+            startup_timeout=self.startup_timeout,
+            extra_args=extra, extra_env=extra_env)
+
+    def kill9_leader(self) -> None:
+        """SIGKILL the leader mid-flight: no flush, no goodbye — the
+        promotion path's acceptance fault."""
+        self.replicas[0].kill9()
+        self.kills["leader"] = self.kills.get("leader", 0) + 1
+
+    def kill9_follower(self, index: int = 0) -> None:
+        """SIGKILL follower `index` (rank index+1): its local shards must
+        rotate reads to a sibling replica."""
+        self.replicas[index + 1].kill9()
+        self.kills[f"follower-{index + 1}"] = \
+            self.kills.get(f"follower-{index + 1}", 0) + 1
+
+    def status(self, base: str) -> Optional[dict]:
+        from ..shard.harness import _call
+        try:
+            return _call(base, "GET", "/replication/status", timeout=5)
+        except Exception:  # noqa: BLE001 - replica down
+            return None
+
+    def wait_for_leader(self, timeout: float = 30.0) -> Optional[str]:
+        """Block until some live replica reports role=leader; returns its
+        base URL (None on timeout)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for r in self.replicas:
+                st = self.status(r.url)
+                if st is not None and st.get("role") == "leader":
+                    return r.url
+            time.sleep(0.1)
+        return None
+
+    def stop(self) -> None:
+        for r in self.replicas:
+            r.stop()
 
 
 class DeviceFaults:

@@ -178,6 +178,22 @@ def conflict_timeline(traces: Dict[str, List[dict]]) -> List[dict]:
     return out
 
 
+def failover_timeline(spans: List[dict]) -> List[dict]:
+    """Control-plane promotions (replication.promote, 100%-sampled): who
+    took over, at which fencing epoch, from which applied seq — rendered
+    alongside the conflict timeline so cross-shard 409 bursts around a
+    failover window read in causal order."""
+    out = [{
+        "ts": s.get("ts", 0.0),
+        "proc": s.get("proc", "?"),
+        "epoch": s.get("attrs", {}).get("epoch"),
+        "seq": s.get("attrs", {}).get("seq"),
+        "reason": s.get("attrs", {}).get("reason", ""),
+    } for s in spans if s.get("name") == "replication.promote"]
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
 def summarize(spans: List[dict]) -> dict:
     traces = merge_traces(spans)
     return {
@@ -187,6 +203,7 @@ def summarize(spans: List[dict]) -> dict:
         "stages": stage_stats(spans),
         "completeness": completeness(traces),
         "conflicts": conflict_timeline(traces),
+        "failovers": failover_timeline(spans),
     }
 
 
@@ -211,6 +228,11 @@ def _print_report(summary: dict, traces: Dict[str, List[dict]],
     for name, st in summary["stages"].items():
         w(f"{name:<16} {st['count']:>7} {_fmt_ms(st['p50'])} "
           f"{_fmt_ms(st['p95'])} {_fmt_ms(st['p99'])}\n")
+    if summary.get("failovers"):
+        w("\nfailover timeline:\n")
+        for f in summary["failovers"]:
+            w(f"  t={f['ts']:.6f} {f['proc']} promoted to leader "
+              f"(epoch {f['epoch']}, seq {f['seq']}, {f['reason']})\n")
     if summary["conflicts"]:
         w("\nconflict timeline:\n")
         for c in summary["conflicts"]:

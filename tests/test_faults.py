@@ -824,6 +824,228 @@ def test_shard_kill_adoption_mixed_churn(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# replicated control plane: leader/follower kill -9
+# (kubernetes_tpu/replication/; docs/RESILIENCE.md § replication)
+# ---------------------------------------------------------------------------
+
+
+def _wait_true(cond, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _flight_spans(flight_dir, name):
+    spans = []
+    for fname in os.listdir(flight_dir):
+        if not (fname.startswith("flightrec-") and fname.endswith(".jsonl")):
+            continue
+        with open(os.path.join(flight_dir, fname)) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+        assert rows and rows[0]["kind"] == "meta"
+        spans += [r for r in rows
+                  if r.get("kind") == "span" and r.get("name") == name]
+    return spans
+
+
+@pytest.mark.chaos
+def test_leader_kill9_promotion_mixed_churn(tmp_path):
+    """The replication acceptance run: ``kill -9`` the LEADER apiserver
+    mid-MixedChurn with TWO shard schedulers reading from two followers.
+    The lowest-ranked live follower promotes within the lease TTL (fenced
+    by the epoch bump), the shards' follower-served watch streams never
+    re-list (no 410), every pod binds exactly once, and the terminal
+    assignments match the oracle — pods are node-selector-pinned, so the
+    expected placement is interleaving-independent and any lost/replayed/
+    misrouted bind shows up as a divergence."""
+    from kubernetes_tpu.core.apiserver import (HTTPClientset, node_from_wire,
+                                               node_to_wire)
+    from kubernetes_tpu.shard import ShardMember
+    from kubernetes_tpu.testing.faults import ReplicaSet
+
+    N_PODS, N_NODES, LEASE = 240, 20, 2.0
+    flight = str(tmp_path / "flightrec")
+    rs = ReplicaSet(str(tmp_path / "replicas"), followers=2,
+                    repl_lease=LEASE, flightrec_dir=flight)
+    members, drivers, clients = [], [], []
+    try:
+        for i in range(2):
+            base = rs.follower_urls[i]
+            fb = [u for u in rs.follower_urls if u != base] + [rs.leader_url]
+            http_cs = HTTPClientset(base, fallbacks=fb)
+            clients.append(http_cs)
+            rcs = RetryingClientset(http_cs, retry=RetryConfig(
+                initial_backoff=0.05, max_backoff=0.5, max_attempts=40,
+                seed=17 + i))
+            sched = Scheduler(clientset=rcs, deterministic_ties=True)
+            # Generous shard leases: the failover under test is the CONTROL
+            # PLANE's; shard ranges must not flap around it.
+            member = ShardMember(sched, i, 2, lease_duration=30.0,
+                                 identity=f"chaos-shard-{i}")
+            member.start_renewer()
+            members.append(member)
+            drivers.append(_Driver(sched))
+        # The create/churn driver is an API client like any other — and it
+        # rides the same NotLeader/re-resolve protocol across the kill.
+        wcs = HTTPClientset(rs.follower_urls[0],
+                            fallbacks=[rs.follower_urls[1]])
+        clients.append(wcs)
+        writer = RetryingClientset(wcs, retry=RetryConfig(
+            initial_backoff=0.05, max_backoff=0.5, max_attempts=40, seed=99))
+        nodes = [make_node().name(f"n{i}")
+                 .capacity({"cpu": 16, "memory": "64Gi", "pods": 110})
+                 .label("slot", str(i)).obj() for i in range(N_NODES)]
+        for n in nodes:
+            writer.create_node(n)
+        for cs in clients[:2]:
+            assert _wait_true(lambda cs=cs: len(cs.nodes) == N_NODES)
+        relists0 = [dict(cs.relists) for cs in clients[:2]]
+        pods = [make_pod().name(f"p{i}")
+                .req({"cpu": "100m", "memory": "64Mi"})
+                .node_selector({"slot": str(i % N_NODES)}).obj()
+                for i in range(N_PODS)]
+        t_promoted = None
+        for i, p in enumerate(pods):
+            writer.create_pod(p)
+            if i % 15 == 5:
+                # outcome-irrelevant node churn: live watch traffic the
+                # follower streams keep fanning out through the failover
+                w = node_to_wire(nodes[i % N_NODES])
+                w["labels"] = dict(w["labels"], churn=str(i))
+                writer.update_node(node_from_wire(w))
+            if i == N_PODS // 2:
+                rs.kill9_leader()  # SIGKILL: no flush, no goodbye
+                t_kill = time.monotonic()
+                new_leader = rs.wait_for_leader(timeout=LEASE * 5)
+                t_promoted = time.monotonic() - t_kill
+                # The lowest-ranked live follower took over...
+                assert new_leader == rs.follower_urls[0], new_leader
+                # ...inside the failover budget: one lease TTL of silence
+                # to detect, then probe + promote.
+                assert t_promoted < LEASE * 2.5, t_promoted
+        # drain: every measured pod bound, observed via FOLLOWER reads
+        assert _wait_true(
+            lambda: _call_http(rs.follower_urls[1], "GET",
+                               "/api/v1/pods?summary=true")["bound"]
+            >= N_PODS, timeout=120)
+        for d in drivers:
+            assert not d.errors, f"scheduler crashed: {d.errors!r}"
+        got = _call_http(rs.follower_urls[0], "GET", "/api/v1/pods")
+        bound = {p["name"]: p["nodeName"] for p in got if p["nodeName"]}
+        # zero lost bindings, zero duplicates
+        assert len(bound) == N_PODS, f"only {len(bound)}/{N_PODS} bound"
+        names = [p["name"] for p in got]
+        assert len(names) == len(set(names)) == N_PODS
+        # oracle-identical assignments (selector-pinned placement)
+        oracle = {f"p{i}": f"n{i % N_NODES}" for i in range(N_PODS)}
+        diffs = {k: (oracle[k], bound.get(k)) for k in oracle
+                 if oracle[k] != bound.get(k)}
+        assert not diffs, f"{len(diffs)} divergences: {list(diffs.items())[:5]}"
+        # follower-served reads NEVER re-listed across the failover window
+        for cs, before in zip(clients[:2], relists0):
+            assert dict(cs.relists) == before
+            assert cs.failover_count >= 1
+        # the promotion is fenced: the new leader runs epoch 2
+        st = rs.status(rs.follower_urls[0])
+        assert st["role"] == "leader" and st["replEpoch"] >= 2
+        # forensics: the promoted follower's flight-recorder artifact
+        # carries the 100%-sampled replication.promote span
+        promote_spans = _flight_spans(flight, "replication.promote")
+        assert promote_spans, "no replication.promote span in any artifact"
+        assert promote_spans[0]["attrs"]["epoch"] >= 2
+        assert promote_spans[0]["proc"] == "apiserver-r1"
+    finally:
+        for m in members:
+            m.stop()
+        for d in drivers:
+            d.stop()
+        for cs in clients:
+            cs.close()
+        rs.stop()
+
+
+@pytest.mark.chaos
+def test_follower_kill9_read_plane_failover(tmp_path):
+    """``kill -9`` a FOLLOWER mid-MixedChurn: the scheduler reading from it
+    rotates its reflector to a sibling replica and RESUMEs from the shared
+    rv/epoch space (no re-list, stall bounded by a few connect backoffs),
+    the run binds every pod exactly once, and assignments still match the
+    no-fault in-process oracle."""
+    from kubernetes_tpu.core.apiserver import (HTTPClientset, node_to_wire,
+                                               pod_to_wire)
+    from kubernetes_tpu.testing.faults import ReplicaSet
+
+    N_PODS, N_NODES = 160, 20
+    flight = str(tmp_path / "flightrec")
+    rs = ReplicaSet(str(tmp_path / "replicas"), followers=2,
+                    repl_lease=2.0, flightrec_dir=flight)
+    http_cs = None
+    driver = None
+    try:
+        http_cs = HTTPClientset(
+            rs.follower_urls[0],
+            fallbacks=[rs.follower_urls[1], rs.leader_url])
+        rcs = RetryingClientset(http_cs, retry=RetryConfig(
+            initial_backoff=0.05, max_backoff=0.5, max_attempts=40, seed=23))
+        sched = Scheduler(clientset=rcs, deterministic_ties=True)
+        driver = _Driver(sched)
+        nodes = _nodes(N_NODES)
+        for n in nodes:
+            _call_http(rs.leader_url, "POST", "/api/v1/nodes",
+                       node_to_wire(n))
+        assert _wait_true(lambda: len(http_cs.nodes) == N_NODES)
+        relists0 = dict(http_cs.relists)
+        pods = _pods(N_PODS)
+        t_kill = None
+        for i, p in enumerate(pods):
+            _call_http(rs.leader_url, "POST", "/api/v1/pods", pod_to_wire(p))
+            if i % 15 == 5:
+                n = nodes[i % N_NODES]
+                w = node_to_wire(n)
+                w["labels"]["churn"] = str(i)
+                _call_http(rs.leader_url, "PUT", f"/api/v1/nodes/{n.name}", w)
+            if i == N_PODS // 2:
+                rs.kill9_follower(0)  # the scheduler's read replica dies
+                t_kill = time.monotonic()
+        assert _wait_true(
+            lambda: _call_http(rs.leader_url, "GET",
+                               "/api/v1/pods?summary=true")["bound"]
+            >= N_PODS, timeout=120)
+        assert not driver.errors, f"scheduler crashed: {driver.errors!r}"
+        assert t_kill is not None
+        got = _call_http(rs.leader_url, "GET", "/api/v1/pods")
+        bound = {p["name"]: p["nodeName"] for p in got if p["nodeName"]}
+        assert len(bound) == N_PODS, f"only {len(bound)}/{N_PODS} bound"
+        names = [p["name"] for p in got]
+        assert len(names) == len(set(names)) == N_PODS
+        oracle = _oracle_assignments(lambda: _nodes(N_NODES),
+                                     lambda: _pods(N_PODS))
+        diffs = {k: (oracle[k], bound.get(k)) for k in oracle
+                 if oracle[k] != bound.get(k)}
+        assert not diffs, f"{len(diffs)} divergences: {list(diffs.items())[:5]}"
+        # the read plane failed over by ROTATION + RESUME, never a re-list
+        assert http_cs.read_rotations >= 1
+        assert dict(http_cs.relists) == relists0
+        assert (http_cs.resumes["pods"] + http_cs.resumes["nodes"]) >= 1
+        # forensics: graceful stop (SIGTERM -> shutdown dump; idempotent
+        # with the finally) guarantees survivor artifacts, and a run that
+        # outlives the periodic timer leaves the SIGKILLed follower's too
+        rs.stop()
+        arts = [f for f in os.listdir(flight)
+                if f.startswith("flightrec-") and f.endswith(".jsonl")]
+        assert arts, "follower chaos run left no flight-recorder artifact"
+    finally:
+        if driver is not None:
+            driver.stop()
+        if http_cs is not None:
+            http_cs.close()
+        rs.stop()
+
+
+# ---------------------------------------------------------------------------
 # lock-order watchdog (testing/lockwatch.py; docs/ANALYSIS.md runtime half)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,800 @@
+"""WAL-shipping apiserver replication (kubernetes_tpu/replication/):
+frame seq/epoch stamping, follower convergence + read serving, torn-frame
+and reconnect tolerance, stale-epoch fencing, NotLeader write routing with
+leader re-resolution, ship-ack reply gating, promotion, and the
+scheduler's failover bind reconciliation. docs/RESILIENCE.md § replication.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.core import FakeClientset, Scheduler
+from kubernetes_tpu.core.apiserver import APIServer, HTTPClientset
+from kubernetes_tpu.core.backoff import RetryConfig
+from kubernetes_tpu.core.clientset import RetryingClientset
+from kubernetes_tpu.replication import LeaderLease, ReplicationTail
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _node(name="n0", cpu=8):
+    return (make_node().name(name)
+            .capacity({"cpu": cpu, "memory": "32Gi", "pods": 110}).obj())
+
+
+def _pod(name, cpu="100m"):
+    return make_pod().name(name).req({"cpu": cpu, "memory": "64Mi"}).obj()
+
+
+def _wait(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+class _Plane:
+    """In-process leader + follower pair over REAL HTTP sockets."""
+
+    def __init__(self, tmp_path=None, lease=0.6, follower_dir=None,
+                 leader_dir=None):
+        self.leader = APIServer(
+            data_dir=str(tmp_path / leader_dir) if leader_dir else None)
+        self.leader.serve(0)
+        self.lease = LeaderLease(self.leader, "leader-0",
+                                 duration=lease).start()
+        self.follower = APIServer(
+            data_dir=str(tmp_path / follower_dir) if follower_dir else None)
+        self.tail = ReplicationTail(self.follower,
+                                    self.leader.advertise_url,
+                                    rank=1, lease_duration=lease)
+        self.tail.bootstrap()
+        self.follower.serve(0)
+        peers = {0: self.leader.advertise_url,
+                 1: self.follower.advertise_url}
+        self.leader.repl_peers.update(peers)
+        self.follower.repl_peers.update(peers)
+        self.tail.start()
+
+    def stop(self):
+        self.tail.stop()
+        self.lease.stop()
+        self.follower.shutdown()
+        self.leader.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# frame metadata + follower convergence
+# ---------------------------------------------------------------------------
+
+
+def test_wal_frames_carry_monotonic_seq_and_epoch(tmp_path):
+    api = APIServer(data_dir=str(tmp_path / "leader"))
+    api.store.create_node(_node("n0"))
+    for i in range(4):
+        api.store.create_pod(_pod(f"p{i}"))
+    api.shutdown()
+    with open(tmp_path / "leader" / "wal.log") as fh:
+        recs = [json.loads(line) for line in fh]
+    assert [r["seq"] for r in recs] == list(range(1, len(recs) + 1))
+    assert all(r["epoch"] == 1 for r in recs)
+    # restart resumes the seq counter, not restarts it
+    api2 = APIServer(data_dir=str(tmp_path / "leader"))
+    assert api2._repl_seq == len(recs)
+    api2.store.create_pod(_pod("p-post"))
+    assert api2._repl_seq == len(recs) + 1
+    api2.shutdown()
+
+
+def test_follower_converges_and_serves_watch_reads(tmp_path):
+    plane = _Plane(tmp_path)
+    try:
+        leader, follower = plane.leader, plane.follower
+        # writes via a client pointed at the FOLLOWER: reads local,
+        # mutations redirect (421 NotLeader -> leader)
+        cs = HTTPClientset(follower.advertise_url)
+        try:
+            cs.create_node(_node("n0"))
+            pods = [_pod(f"p{i}") for i in range(5)]
+            for p in pods:
+                cs.create_pod(p)
+            assert cs.write_redirects >= 1
+            # the follower's OWN store and watch plane converge: the
+            # client's informer cache is fed by the follower stream
+            assert _wait(lambda: len(cs.pods) == 5 and len(cs.nodes) == 1)
+            assert _wait(lambda: len(follower.store.pods) == 5)
+            # bind through the same redirect path; the slim BOUND event
+            # reaches the follower-watching client
+            cs.bind(pods[0], "n0")
+            assert _wait(lambda: cs.bindings.get(pods[0].uid) == "n0")
+            assert follower.store.bindings.get(pods[0].uid) == "n0"
+            # leases replicate too (the lease table rides LEASE frames)
+            leader.upsert_lease("shard-0", "holder-a", 5.0)
+            assert _wait(lambda: any(
+                l["name"] == "shard-0"
+                for l in follower.list_leases()))
+            # per-kind rv continuity: follower serves the same rv space
+            assert follower._seq == leader._seq
+        finally:
+            cs.close()
+    finally:
+        plane.stop()
+
+
+def test_cold_follower_snapshot_bootstrap(tmp_path):
+    leader = APIServer()
+    leader.serve(0)
+    leader.store.create_node(_node("n0"))
+    for i in range(6):
+        leader.store.create_pod(_pod(f"p{i}"))
+    leader.store.bind(leader.store.pods[
+        next(iter(leader.store.pods))], "n0")
+    try:
+        follower = APIServer()
+        tail = ReplicationTail(follower, leader.advertise_url, rank=1,
+                               lease_duration=0.5)
+        tail.bootstrap()
+        try:
+            # snapshot installed everything, including the binding and the
+            # leader's WATCH epoch (rv/epoch continuity for RESUME)
+            assert len(follower.store.pods) == 6
+            assert follower.store.bindings
+            assert follower.epoch == leader.epoch
+            assert follower._repl_seq == leader._repl_seq
+            assert follower.repl_resyncs == 1
+        finally:
+            tail.stop()
+            follower.shutdown()
+    finally:
+        leader.shutdown()
+
+
+def test_resync_when_ship_window_compacted(tmp_path):
+    # A tiny ship backlog: the follower's `from` falls off the window and
+    # the ship endpoint answers 410 ResyncRequired -> snapshot bootstrap.
+    leader = APIServer(backlog=8)
+    leader.serve(0)
+    for i in range(64):
+        leader.store.create_pod(_pod(f"p{i}"))
+    try:
+        follower = APIServer()
+        follower.serve(0)
+        tail = ReplicationTail(follower, leader.advertise_url, rank=1,
+                               lease_duration=0.5)
+        # deliberately NO bootstrap: from=0 is far outside the 8-frame
+        # window, so the first tail attachment must resync via snapshot
+        tail.start()
+        try:
+            assert _wait(lambda: len(follower.store.pods) == 64)
+            assert follower.repl_resyncs >= 1
+            # and the tail keeps riding frames afterwards
+            leader.store.create_pod(_pod("p-live"))
+            assert _wait(lambda: "p-live" in
+                         {p.name for p in follower.store.pods.values()})
+        finally:
+            tail.stop()
+            follower.shutdown()
+    finally:
+        leader.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# torn frames + reconnect (the DurableStore truncate contract, replicated)
+# ---------------------------------------------------------------------------
+
+
+def test_follower_recovery_discards_torn_frame_and_retails(tmp_path):
+    plane = _Plane(tmp_path, follower_dir="follower")
+    try:
+        leader = plane.leader
+        for i in range(5):
+            leader.store.create_pod(_pod(f"p{i}"))
+        assert _wait(lambda: plane.follower._repl_seq == leader._repl_seq)
+        good_seq = plane.follower._repl_seq
+        # stop the follower process-equivalent (tail + server)...
+        plane.tail.stop()
+        plane.follower.shutdown()
+        # ...and tear its WAL mid-record, as a kill -9 during append would
+        with open(tmp_path / "follower" / "wal.log", "ab") as fh:
+            fh.write(b'{"kind": "pods", "type": "ADD')
+        # more leader writes while the follower is down
+        for i in range(5, 8):
+            leader.store.create_pod(_pod(f"p{i}"))
+        # recover: the torn frame is discarded (DurableStore truncate
+        # contract), _repl_seq resumes at the last GOOD frame, and the new
+        # tail re-requests exactly from there
+        f2 = APIServer(data_dir=str(tmp_path / "follower"))
+        assert f2.persistence.torn_records_discarded == 1
+        assert f2._repl_seq == good_seq
+        t2 = ReplicationTail(f2, leader.advertise_url, rank=1,
+                             lease_duration=0.6)
+        t2.bootstrap()  # no-op: local WAL state stands
+        f2.serve(0)
+        t2.start()
+        try:
+            assert _wait(lambda: f2._repl_seq == leader._repl_seq)
+            assert len(f2.store.pods) == 8
+            # no duplicate application: each pod exactly once
+            names = [p.name for p in f2.store.pods.values()]
+            assert len(names) == len(set(names)) == 8
+        finally:
+            t2.stop()
+            f2.shutdown()
+    finally:
+        plane.lease.stop()
+        plane.leader.shutdown()
+
+
+def test_reconnect_re_requests_from_last_applied_seq(tmp_path):
+    plane = _Plane(tmp_path)
+    try:
+        leader, follower = plane.leader, plane.follower
+        for i in range(4):
+            leader.store.create_pod(_pod(f"p{i}"))
+        assert _wait(lambda: follower._repl_seq == leader._repl_seq)
+        applied_before = follower.repl_frames_applied
+        # Tear every ship stream (leader side): the tail must reconnect
+        # and re-request from its last applied seq — zero re-application.
+        with leader._lock:
+            streams = list(leader._ship_streams)
+        for st in streams:
+            st.q.put(None)  # poison: the ship loop dies on TypeError
+        for i in range(4, 7):
+            leader.store.create_pod(_pod(f"p{i}"))
+        assert _wait(lambda: follower._repl_seq == leader._repl_seq)
+        assert len(follower.store.pods) == 7
+        # only the NEW frames were applied after the reconnect
+        assert follower.repl_frames_applied - applied_before == 3
+    finally:
+        plane.stop()
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing
+# ---------------------------------------------------------------------------
+
+
+def test_stale_epoch_frame_rejected():
+    api = APIServer()
+    api.repl_epoch = 5
+    rec = {"kind": "pods", "type": "ADDED", "rv": 1, "seq": 1, "epoch": 4,
+           "object": {"uid": "u1", "name": "p", "namespace": "d",
+                      "requests": {"cpu": 100, "memory": 1}}}
+    assert api.apply_frame(rec) is False
+    assert api.repl_frames_rejected == 1
+    assert not api.store.pods  # nothing leaked into the store
+    # a frame from the CURRENT epoch applies; a newer epoch is adopted
+    rec2 = dict(rec, epoch=5)
+    assert api.apply_frame(rec2) is True
+    rec3 = dict(rec, epoch=7, seq=2, rv=2)
+    assert api.apply_frame(rec3) is True
+    assert api.repl_epoch == 7
+    api.shutdown()
+
+
+def test_deposed_leader_fenced_by_ship_request():
+    from urllib import error as urlerror
+    from urllib import request as urlrequest
+
+    stale = APIServer()
+    stale.serve(0)
+    try:
+        assert stale.role == "leader"
+        # A follower that has seen epoch 3 re-tails against this stale
+        # leader (epoch 1): the ship endpoint must refuse AND self-fence.
+        url = (stale.advertise_url
+               + "/replication/wal?from=0&epoch=3&leader=http%3A%2F%2Fnew")
+        with pytest.raises(urlerror.HTTPError) as ei:
+            urlrequest.urlopen(url, timeout=5)
+        assert ei.value.code == 409
+        assert stale.role == "follower"
+        assert stale.leader_url == "http://new"
+        assert stale.repl_epoch == 3
+        # and its write plane is fenced: NotLeader redirect
+        req = urlrequest.Request(
+            stale.advertise_url + "/api/v1/nodes", method="POST",
+            data=b"{}", headers={"Content-Type": "application/json"})
+        with pytest.raises(urlerror.HTTPError) as ei2:
+            urlrequest.urlopen(req, timeout=5)
+        assert ei2.value.code == 421
+        assert json.loads(ei2.value.read())["leader"] == "http://new"
+    finally:
+        stale.shutdown()
+
+
+def test_promotion_bumps_and_persists_fencing_epoch(tmp_path):
+    api = APIServer(data_dir=str(tmp_path / "r"))
+    api.role = "follower"
+    api.advertise_url = "http://127.0.0.1:1"
+    api.promote(reason="test")
+    assert api.role == "leader"
+    assert api.repl_epoch == 2
+    assert api.failovers == {"test": 1}
+    api.shutdown()
+    # the bumped epoch survives a restart of the promoted replica
+    api2 = APIServer(data_dir=str(tmp_path / "r"))
+    assert api2.repl_epoch == 2
+    api2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# client write routing: NotLeader redirect + re-resolution single replay
+# ---------------------------------------------------------------------------
+
+
+def test_write_replay_re_resolves_leader_across_promotion(tmp_path):
+    """Satellite regression: a bind in flight during the failover is
+    committed EXACTLY once — the replay re-resolves the leader first
+    (never a blind same-host replay) and lands on the promoted follower
+    through the idempotent/409 surface."""
+    plane = _Plane(tmp_path, lease=0.5)
+    cs = None
+    try:
+        leader, follower = plane.leader, plane.follower
+        cs = HTTPClientset(follower.advertise_url)
+        rcs = RetryingClientset(cs, retry=RetryConfig(
+            initial_backoff=0.05, max_backoff=0.3, max_attempts=40, seed=7))
+        rcs.create_node(_node("n0"))
+        p = _pod("p0")
+        rcs.create_pod(p)
+        assert _wait(lambda: p.uid in follower.store.pods)
+        # the client now routes writes at the LEADER (redirect learned);
+        # kill the leader and immediately fire the bind: it must queue
+        # behind retries until the follower promotes, then commit once
+        leader.shutdown()
+        done = {}
+
+        def bind():
+            try:
+                rcs.bind(p, "n0")
+                done["ok"] = True
+            except Exception as e:  # noqa: BLE001 - the assertion target
+                done["err"] = e
+
+        t = threading.Thread(target=bind, daemon=True)
+        t.start()
+        assert _wait(lambda: follower.role == "leader", timeout=15)
+        t.join(timeout=20)
+        assert done.get("ok"), f"bind failed across failover: {done!r}"
+        assert follower.store.bindings == {p.uid: "n0"}
+        assert cs.leader_resolutions >= 1
+        # replaying the SAME bind again rides the idempotent path (200)
+        rcs.bind(p, "n0")
+        assert follower.store.bindings == {p.uid: "n0"}
+    finally:
+        if cs is not None:
+            cs.close()
+        plane.tail.stop()
+        plane.lease.stop()
+        plane.follower.shutdown()
+
+
+def test_failover_marker_triggers_scheduler_reconcile():
+    """A bind the dead leader acked but never shipped leaves the promoted
+    truth UNBOUND with no event: the FAILOVER-driven sweep unwinds the
+    phantom placement and the pod is rescheduled."""
+    cs = FakeClientset()
+    s = Scheduler(clientset=cs, deterministic_ties=True)
+    cs.create_node(_node("n0"))
+    p = _pod("p0")
+    cs.create_pod(p)
+    s.run_until_idle()
+    assert cs.bindings.get(p.uid) == "n0"
+    # On the wire path finish_binding runs before the async BOUND event
+    # confirms; the synchronous FakeClientset confirms first, so pin the
+    # wire-path state explicitly (the leader-kill chaos test exercises
+    # the real sequence end to end).
+    s.cache.pod_states[p.uid].binding_finished = True
+    # simulate the promoted follower's truth: the bind never shipped
+    cs.bindings.pop(p.uid)
+    cs.pods[p.uid].node_name = ""
+    cs.failover_count = 1  # what the FAILOVER watch marker bumps
+    s.run_until_idle()
+    assert s.reconcile_unwinds == 1
+    assert cs.bindings.get(p.uid) == "n0"  # re-bound, exactly once
+
+
+# ---------------------------------------------------------------------------
+# ship-ack reply gating
+# ---------------------------------------------------------------------------
+
+
+def test_await_shipped_gates_acked_writes_and_drops_laggards():
+    api = APIServer()
+    api.serve(0)
+    try:
+        # a fake attached follower that never drains its queue
+        st = api._attach_ship(api._repl_seq)
+        t0 = time.perf_counter()
+        api.store.create_pod(_pod("p0"))  # in-process write, no HTTP gate
+        assert api._await_shipped(api._repl_seq, timeout=0.2) is False
+        waited = time.perf_counter() - t0
+        assert waited >= 0.15
+        assert api.ship_wait_timeouts == 1
+        assert st.acked is False  # dropped from the ack quorum
+        # once dropped, acked writes stop convoying behind it
+        t1 = time.perf_counter()
+        assert api._await_shipped(api._repl_seq, timeout=0.2) is True
+        assert time.perf_counter() - t1 < 0.1
+        # catching up re-enters the quorum
+        api._ship_mark_sent(st, api._repl_seq)
+        assert st.acked is True
+        api._detach_ship(st)
+    finally:
+        api.shutdown()
+
+
+def test_ship_ack_covers_http_acked_write(tmp_path):
+    """Over real HTTP: with a live follower attached, a 201-acked create is
+    already on the wire to the follower when the client sees the reply."""
+    plane = _Plane(tmp_path)
+    try:
+        from kubernetes_tpu.core.apiserver import (KeepAliveClient,
+                                                   pod_to_wire)
+        # quiesce the leader-lease renewer: a renewal frame landing between
+        # the POST reply and the assertion would race the seq snapshot
+        plane.lease.stop()
+        ka = KeepAliveClient(plane.leader.advertise_url)
+        ka.call("POST", "/api/v1/pods", pod_to_wire(_pod("p0")))
+        # sent_seq has reached the commit seq on every in-quorum stream
+        with plane.leader._ship_cond:
+            assert all(st.sent_seq >= plane.leader._repl_seq
+                       for st in plane.leader._ship_streams if st.acked)
+        assert _wait(lambda: len(plane.follower.store.pods) == 1)
+    finally:
+        plane.stop()
+
+
+def test_follower_compaction_never_drops_the_triggering_frame(tmp_path):
+    """Review regression (confirmed by repro): apply_frame used to run
+    WAL compaction BETWEEN append and store upsert — the snapshot
+    excluded the triggering frame while the WAL reset discarded it, so a
+    follower restart fast-forwarded straight past the hole (silently
+    missing an acked write forever). Compaction must run after apply."""
+    def frame(i):
+        return {"kind": "pods", "type": "ADDED", "rv": i, "seq": i,
+                "epoch": 1, "object": {
+                    "name": f"p{i}", "namespace": "d", "uid": f"u{i}",
+                    "requests": {"cpu": 100, "memory": 1}}}
+
+    api = APIServer(data_dir=str(tmp_path / "f"), snapshot_every=3)
+    api.role = "follower"
+    for i in range(1, 8):
+        assert api.apply_frame(frame(i)) is True
+    assert api.persistence.compactions >= 1  # compaction really fired
+    api.shutdown()
+    api2 = APIServer(data_dir=str(tmp_path / "f"))
+    assert api2._repl_seq == 7
+    assert sorted(p.name for p in api2.store.pods.values()) == [
+        f"p{i}" for i in range(1, 8)]
+    api2.shutdown()
+
+
+def test_promotion_announcement_converges_peers():
+    """Review regression: a promotion is ANNOUNCED to every peer — the
+    surviving follower re-tails immediately (no silence detection wait),
+    and a stale co-claimant leader demotes itself even though no follower
+    ever tails it."""
+    stale = APIServer()
+    stale.serve(0)  # role=leader, epoch 1 — the deposed generation
+    other = APIServer()
+    other.role = "follower"
+    other.serve(0)
+    winner = APIServer()
+    tail = ReplicationTail(winner, stale.advertise_url, rank=1,
+                           lease_duration=0.5)
+    winner.serve(0)
+    peers = {0: stale.advertise_url, 1: winner.advertise_url,
+             2: other.advertise_url}
+    winner.repl_peers.update(peers)
+    try:
+        winner.promote(reason="test")
+        tail.leader_url = winner.advertise_url
+        tail._announce_leadership()
+        # the stale co-leader fenced itself...
+        assert stale.role == "follower"
+        assert stale.repl_epoch == winner.repl_epoch
+        assert stale.leader_url == winner.advertise_url
+        # ...and the surviving follower learned the new leader instantly
+        assert other.leader_url == winner.advertise_url
+        assert other.repl_epoch == winner.repl_epoch
+    finally:
+        tail.stop()
+        for a in (stale, other, winner):
+            a.shutdown()
+
+
+def test_lagging_survivor_accepts_old_generation_frames_from_new_leader():
+    """Review regression: a survivor that adopted the winner's epoch
+    BEFORE catching up must still accept the winner's pre-promotion
+    frames (stamped with the old epoch) — the stream's claimed generation
+    legitimizes them. Without a stream claim the same frame stays fenced
+    (a deposed leader's append)."""
+    api = APIServer()
+    api.repl_epoch = 2  # adopted via the promotion announcement
+    old_frame = {"kind": "pods", "type": "ADDED", "rv": 1, "seq": 1,
+                 "epoch": 1, "object": {
+                     "name": "p", "namespace": "d", "uid": "u1",
+                     "requests": {"cpu": 100, "memory": 1}}}
+    assert api.apply_frame(old_frame) is False  # no claim: fenced
+    assert api.repl_frames_rejected == 1
+    assert api.apply_frame(old_frame, stream_epoch=2) is True
+    assert "u1" in api.store.pods
+    api.shutdown()
+
+
+def test_equal_epoch_dual_promotion_resolved_by_rank():
+    """Review regression: two followers promoting concurrently land on
+    the SAME epoch — the announcement's rank tie-break stands the
+    higher-ranked one down, and the lower-ranked claimant ignores the
+    rival's announcement."""
+    from urllib import request as urlrequest
+
+    low = APIServer()
+    tail = ReplicationTail(low, "http://dead", rank=1, lease_duration=0.5)
+    low.serve(0)
+    high = APIServer()
+    high.replica_rank = 2
+    high.serve(0)
+    try:
+        low.promote(reason="race")   # follower(rank 1) -> leader epoch 2
+        high.role = "leader"         # the concurrent rank-2 claimant
+        high.repl_epoch = 2
+        low.repl_peers.update({1: low.advertise_url, 2: high.advertise_url})
+        tail.leader_url = low.advertise_url
+        tail._announce_leadership()
+        assert high.role == "follower"  # rank 2 stood down at equal epoch
+        assert high.leader_url == low.advertise_url
+        # the reverse announcement does NOT depose the lower rank
+        body = json.dumps({"leader": high.advertise_url, "epoch": 2,
+                           "rank": 2}).encode()
+        req = urlrequest.Request(
+            low.advertise_url + "/replication/leader", data=body,
+            method="POST", headers={"Content-Type": "application/json"})
+        with urlrequest.urlopen(req, timeout=5):
+            pass
+        assert low.role == "leader"
+    finally:
+        tail.stop()
+        low.shutdown()
+        high.shutdown()
+
+
+def test_redirect_hop_notleader_surfaces_retriable():
+    """Review regression: mid-failover a followed redirect can land on a
+    freshly deposed replica that answers 421 itself — that must surface
+    as a retriable TransientAPIError (binds queue behind the retry
+    layers), never a hard non-retriable 4xx."""
+    from kubernetes_tpu.core.backoff import TransientAPIError, is_retriable
+
+    a = APIServer()
+    a.role = "follower"
+    b = APIServer()
+    b.role = "follower"
+    b.leader_url = "http://127.0.0.1:1"  # nobody leads yet
+    a.serve(0)
+    b.serve(0)
+    a.leader_url = b.advertise_url
+    cs = None
+    try:
+        cs = HTTPClientset(a.advertise_url)
+        with pytest.raises(TransientAPIError) as ei:
+            cs.create_pod(_pod("p0"))
+        assert is_retriable(ei.value)
+        assert cs.write_redirects == 1
+    finally:
+        if cs is not None:
+            cs.close()
+        a.shutdown()
+        b.shutdown()
+
+
+def test_non_leader_heartbeats_do_not_hold_off_election():
+    """Review regression: a follower whose tail landed on a DEMOTED peer
+    (role=follower, equal epoch, shipping only heartbeats) must not treat
+    those HBs as leader liveness — the stream is fenced without
+    refreshing last_contact, so the election that finds the real leader
+    still fires."""
+    demoted = APIServer()
+    demoted.role = "follower"  # equal epoch, no frames to ship
+    demoted.serve(0)
+    api = APIServer()
+    tail = ReplicationTail(api, demoted.advertise_url, rank=2,
+                           lease_duration=0.5, hb_interval=0.1)
+    api.serve(0)
+    api.repl_peers.update({1: demoted.advertise_url,
+                           2: api.advertise_url})
+    tail.start()
+    try:
+        assert _wait(lambda: tail.fenced_streams >= 1, timeout=5)
+        # the silence clock keeps running -> an election runs, and with no
+        # live-tailed lower rank it promotes this replica
+        assert _wait(lambda: tail.elections >= 1, timeout=5)
+        assert _wait(lambda: api.role == "leader", timeout=5)
+    finally:
+        tail.stop()
+        demoted.shutdown()
+        api.shutdown()
+
+
+def test_snapshot_bootstrap_refuses_non_leader_source():
+    """Review regression: installing a snapshot from a demoted/stale peer
+    would REGRESS this replica to a forked, older history — the source
+    must claim role=leader at >= our epoch."""
+    demoted = APIServer()
+    demoted.role = "follower"
+    demoted.serve(0)
+    api = APIServer()
+    tail = ReplicationTail(api, demoted.advertise_url, rank=1,
+                           lease_duration=0.5)
+    try:
+        with pytest.raises(RuntimeError):
+            tail._bootstrap_snapshot()
+        assert api.repl_resyncs == 0
+    finally:
+        tail.stop()
+        demoted.shutdown()
+        api.shutdown()
+
+
+def test_ship_fence_demote_never_names_itself_as_leader():
+    """Review regression: the fencing ship request's leader hint is the
+    follower's TAIL TARGET — this very server — so a deposed leader must
+    not record itself as the redirect target (clients would loop)."""
+    from urllib import error as urlerror
+    from urllib import request as urlrequest
+    from urllib.parse import quote
+
+    stale = APIServer()
+    stale.serve(0)
+    try:
+        url = (f"{stale.advertise_url}/replication/wal?from=0&epoch=3"
+               f"&leader={quote(stale.advertise_url, safe='')}")
+        with pytest.raises(urlerror.HTTPError) as ei:
+            urlrequest.urlopen(url, timeout=5)
+        assert ei.value.code == 409
+        assert stale.role == "follower"
+        assert stale.leader_url == ""  # never itself
+    finally:
+        stale.shutdown()
+
+
+def test_deposed_role_survives_restart(tmp_path):
+    """Review regression: a deposed leader must NEVER restart read-write —
+    it would accept acked writes into a forked history at the winner's
+    epoch, which the fencing cannot distinguish. The role rides
+    meta.json."""
+    api = APIServer(data_dir=str(tmp_path / "r"))
+    assert api.role == "leader"
+    api.demote("http://winner", 3)
+    assert api.role == "follower"
+    api.shutdown()
+    api2 = APIServer(data_dir=str(tmp_path / "r"))
+    assert api2.role == "follower"
+    assert api2.leader_url == "http://winner"
+    assert api2.repl_epoch == 3
+    # and its lease surface is fenced under the write lock too
+    assert api2.upsert_lease("shard-0", "h", 5.0) is APIServer.NOT_LEADER
+    api2.shutdown()
+
+
+def test_resolve_leader_prefers_highest_epoch(tmp_path):
+    """Review regression: with a stale leader still claiming the role
+    (it never learned it was deposed), write routing must pick the claim
+    with the HIGHEST fencing epoch, not the first one probed."""
+    stale = APIServer()
+    stale.serve(0)  # role=leader, epoch 1
+    winner = APIServer()
+    winner.repl_epoch = 3
+    winner.serve(0)  # role=leader, epoch 3
+    cs = None
+    try:
+        cs = HTTPClientset(stale.advertise_url,
+                           fallbacks=[winner.advertise_url])
+        assert cs._resolve_leader() == winner.advertise_url
+    finally:
+        if cs is not None:
+            cs.close()
+        stale.shutdown()
+        winner.shutdown()
+
+
+def test_stalled_ship_stream_is_dropped_not_unbounded():
+    """Review regression: a connected-but-stalled follower (no socket
+    error, it just stopped reading) must not make the leader buffer the
+    entire write history — its bounded queue overflows, the stream is
+    detached and counted, and the write plane keeps moving."""
+    api = APIServer(backlog=8)
+    st = api._attach_ship(0)
+    assert st is not None and st.q.maxsize == 8
+    for i in range(20):  # nobody drains the queue
+        api.store.create_pod(_pod(f"p{i}"))
+    assert st.dead is True
+    assert api._ship_streams == []
+    assert api.ship_streams_dropped == 1
+    assert len(api.store.pods) == 20  # commits never blocked on it
+    api.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# observability: replication metrics + failover trace timeline
+# ---------------------------------------------------------------------------
+
+
+def test_replication_metrics_exposed(tmp_path):
+    plane = _Plane(tmp_path)
+    try:
+        for i in range(3):
+            plane.leader.store.create_pod(_pod(f"p{i}"))
+        assert _wait(lambda: len(plane.follower.store.pods) == 3)
+        leader_text = plane.leader.expose_metrics()
+        follower_text = plane.follower.expose_metrics()
+        assert "apiserver_replication_role 1" in leader_text
+        assert "apiserver_replication_role 0" in follower_text
+        assert "apiserver_replication_lag_records" in leader_text
+        assert "apiserver_replication_frames_applied_total 0" in leader_text
+        assert ("apiserver_replication_frames_applied_total 0"
+                not in follower_text)
+    finally:
+        plane.stop()
+
+
+def test_failover_counter_and_trace_timeline():
+    from kubernetes_tpu import trace as trace_mod
+    from kubernetes_tpu.core import spans as spans_mod
+
+    api = APIServer()
+    api.role = "follower"
+    api.advertise_url = "http://127.0.0.1:9"
+    api.tracer = spans_mod.SpanRecorder(proc="apiserver-r1", sample_n=1,
+                                        enabled=True)
+    api.promote(reason="leader_lost")
+    assert ('apiserver_failover_total{reason="leader_lost"} 1'
+            in api.expose_metrics())
+    # the 100%-sampled promote span feeds the analyzer's failover timeline
+    rows = list(api.tracer.ring)
+    summary = trace_mod.summarize(rows)
+    assert summary["failovers"], rows
+    fo = summary["failovers"][0]
+    assert fo["proc"] == "apiserver-r1"
+    assert fo["epoch"] == 2 and fo["reason"] == "leader_lost"
+    assert "replication.promote" in spans_mod.FORCED_STAGES
+    api.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# watch continuity across promotion (no re-list / 410)
+# ---------------------------------------------------------------------------
+
+
+def test_follower_watch_survives_promotion_without_relist(tmp_path):
+    plane = _Plane(tmp_path, lease=0.5)
+    cs = None
+    try:
+        leader, follower = plane.leader, plane.follower
+        cs = HTTPClientset(follower.advertise_url)
+        cs.create_node(_node("n0"))
+        for i in range(3):
+            cs.create_pod(_pod(f"p{i}"))
+        assert _wait(lambda: len(cs.pods) == 3)
+        relists = dict(cs.relists)
+        leader.shutdown()
+        assert _wait(lambda: follower.role == "leader", timeout=15)
+        assert _wait(lambda: cs.failover_count >= 1)
+        # post-promotion writes flow to the same watch stream
+        cs.create_pod(_pod("p-post"))
+        assert _wait(lambda: len(cs.pods) == 4)
+        # the reads NEVER re-listed: same stream, same rv space
+        assert dict(cs.relists) == relists
+        assert cs._leader_base == follower.advertise_url
+    finally:
+        if cs is not None:
+            cs.close()
+        plane.tail.stop()
+        plane.lease.stop()
+        plane.follower.shutdown()

@@ -222,6 +222,82 @@ class TestLockDisciplineFixtures:
 
 
 # ---------------------------------------------------------------------------
+# fixture corpus: lock-discipline, replication rules (PR 9)
+# ---------------------------------------------------------------------------
+
+
+BAD_REPLICATION = textwrap.dedent("""
+    class Follower:
+        def apply_frame(self, rec):                  # no write lock taken
+            with self._lock:
+                for q in self._watchers["pods"]:     # fanout BEFORE append
+                    q.put(rec)
+                self.persistence.append(rec)
+        def _wal_status(self, rec):
+            self._repl_append(rec)                   # frame append, no lock
+        def _ship(self, st):
+            with self._lock:
+                self.wfile.write(b"x")               # send under lock
+                st.sock.sendall(b"y")                # ditto
+""")
+
+GOOD_REPLICATION = textwrap.dedent("""
+    class Follower:
+        def apply_frame(self, rec):
+            with self._write_lock:
+                with self._lock:
+                    self.persistence.append(rec)     # durable FIRST
+                    for q in self._watchers["pods"]:
+                        q.put(rec)
+        def _wal_status(self, rec):
+            with self._lock:
+                self._repl_append(rec)               # caller holds the lock
+        def _ship(self, st):
+            with self._lock:
+                frames = list(st.pending)            # snapshot under lock
+            for data in frames:
+                self.wfile.write(data)               # send OUTSIDE any lock
+""")
+
+
+class TestReplicationLockFixtures:
+    def test_flags_replication_violations(self):
+        fs = check_source(checker_by_id("lock-discipline"), BAD_REPLICATION)
+        assert _rules(fs) == ["no-blocking-send-under-lock",
+                              "repl-apply-write-lock",
+                              "wal-before-fanout",
+                              "wal-under-broadcast-lock"]
+        # both send sites (wfile.write AND sendall) are individually flagged
+        assert sum(1 for f in fs
+                   if f.rule == "no-blocking-send-under-lock") == 2
+
+    def test_passes_disciplined_follower(self):
+        assert check_source(checker_by_id("lock-discipline"),
+                            GOOD_REPLICATION) == []
+
+    def test_repl_append_inside_primitive_is_exempt(self):
+        """The frame-append primitive OWNS the raw persistence.append; its
+        contract (caller holds the broadcast lock) is enforced at call
+        sites, not inside it."""
+        primitive = textwrap.dedent("""
+            class Server:
+                def _repl_append(self, rec):
+                    self.persistence.append(rec)     # exempt: the primitive
+                def _broadcast(self, event):
+                    with self._lock:
+                        self._repl_append(event)     # call site: locked
+        """)
+        assert check_source(checker_by_id("lock-discipline"),
+                            primitive) == []
+
+    def test_scope_covers_replication_module(self):
+        c = checker_by_id("lock-discipline")
+        assert c.applies_to("replication/follower.py")
+        assert c.applies_to("kubernetes_tpu/replication/follower.py")
+        assert not c.applies_to("core/scheduler.py")
+
+
+# ---------------------------------------------------------------------------
 # fixture corpus: jit-purity
 # ---------------------------------------------------------------------------
 
