@@ -147,6 +147,13 @@ def main_sharded(n_shards: int, trace: bool = False,
     detail = {k: out[k] for k in ("shards", "bound", "all_bound",
                                   "elapsed_s", "distinct_bound_pods")}
     detail["api"] = out["api"]
+    # Per-shard decoded events/bytes by wire form (watch-cache read plane +
+    # shard-filtered streams): the 1/N event-decode claim, measurable on
+    # any box — each shard's 'full' count should approach total/N with the
+    # remainder arriving slim; 'read_plane' shows where the progress polls
+    # landed (followers when --replicas > 0).
+    detail["watch_decode"] = out.get("watch_decode")
+    detail["read_plane"] = out.get("read_plane")
     if replicas:
         detail["replicas"] = out["replicas"]
         detail["replication"] = out["replication"]

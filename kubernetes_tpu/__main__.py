@@ -126,9 +126,15 @@ def main(argv=None) -> int:
         # async API dispatcher retry at that layer TOO — the layers compose
         # (worst case attempts multiply, bounded by both small budgets);
         # the wrapper here is what covers the dispatcher-less sync writes.
+        # Shard members open SERVER-FILTERED watch streams (?shard=i/n,
+        # core/watchcache.py): foreign plain pods arrive as slim
+        # projections, so this shard's event decode scales with 1/n.
+        shard = ((args.shard_index, args.shard_count)
+                 if args.shard_index >= 0 and args.shard_count > 0 else None)
         cs_kw["clientset"] = RetryingClientset(HTTPClientset(
             args.api_url,
-            fallbacks=[u for u in args.api_fallbacks.split(",") if u]))
+            fallbacks=[u for u in args.api_fallbacks.split(",") if u],
+            shard=shard))
     sched = TPUScheduler(config=cfg, **cs_kw)
     if args.cluster:
         _load_cluster(sched.clientset, args.cluster)

@@ -42,7 +42,14 @@ kubernetes_tpu/replication/):
   lock — series rendering iterates every label set and a scrape that
   serializes against the write plane stalls binds for the whole render
   (PR 8: expose paths snapshot-copy instead; ROADMAP notes
-  ``/metrics/resources`` contending with the write plane).
+  ``/metrics/resources`` contending with the write plane);
+- ``no-read-serving-under-write-lock``: the watch-cache read plane
+  (core/watchcache.py — ``list_wire``/``read_summary``/``get_many``/
+  ``events_since``/``render_resources``) must never be called with
+  ``_write_lock`` held — the whole point of the cache is a read plane
+  that does not contend with binds; its MUTATORS (``note_event``/
+  ``reinstall``) must run under the broadcast lock, after the WAL append
+  (the frame a cached event came from must already be durable).
 """
 
 from __future__ import annotations
@@ -62,6 +69,16 @@ REPL_MUTATORS = ("apply_frame", "install_snapshot", "promote", "demote")
 # The frame-append primitive: persistence.append lives INSIDE it (exempt
 # there), and every CALL to it must be under the broadcast lock instead.
 FRAME_APPEND_PRIMITIVE = "_repl_append"
+# The commit→read-plane fanout primitive (watch cache install + watcher
+# routing): a CALL to it is a fanout — same after-the-WAL-append +
+# under-the-broadcast-lock obligations as a raw `_watchers` loop.
+FANOUT_PRIMITIVE = "_fan_event"
+# Watch-cache read plane (core/watchcache.py): reads must never hold the
+# write lock; mutators must hold the broadcast lock (rule
+# no-read-serving-under-write-lock).
+WATCHCACHE_READS = {"list_wire", "read_summary", "get_many",
+                    "events_since", "render_resources"}
+WATCHCACHE_MUTATORS = {"note_event", "reinstall"}
 
 
 def _lock_name(expr: ast.AST) -> Optional[str]:
@@ -85,6 +102,8 @@ class _FunctionScan:
         self.blocking_reads: List[Tuple[int, Tuple[str, ...], str]] = []
         self.blocking_sends: List[Tuple[int, Tuple[str, ...], str]] = []
         self.metric_renders: List[Tuple[int, Tuple[str, ...], str]] = []
+        self.cache_reads: List[Tuple[int, Tuple[str, ...], str]] = []
+        self.cache_mutations: List[Tuple[int, Tuple[str, ...], str]] = []
         self._walk(fn, ())
 
     def _walk(self, node: ast.AST, held: Tuple[str, ...]) -> None:
@@ -130,6 +149,23 @@ class _FunctionScan:
             # A call to the frame-append primitive IS a WAL append: same
             # under-the-lock + before-fanout obligations at the call site.
             self.wal_appends.append((node.lineno, held))
+        if chain and chain[-1] == FANOUT_PRIMITIVE:
+            # A call to the fanout primitive IS a watcher fanout (the raw
+            # `_watchers` loop moved inside it): the call site keeps the
+            # after-the-append + under-the-broadcast-lock obligations —
+            # modeled as a fanout AND a cache mutation.
+            self.fanouts.append((node.lineno, held))
+            self.cache_mutations.append((node.lineno, held, FANOUT_PRIMITIVE))
+        # Watch-cache calls go through a subscripted registry
+        # (`self.watch_cache[kind].note_event(...)`) — attr_chain answers []
+        # for non-Name bases, so resolve the TERMINAL attribute directly
+        # (the method names are distinctive by design).
+        term = chain[-1] if chain else (
+            node.func.attr if isinstance(node.func, ast.Attribute) else None)
+        if term in WATCHCACHE_READS and "_write_lock" in held:
+            self.cache_reads.append((node.lineno, held, term))
+        if term in WATCHCACHE_MUTATORS:
+            self.cache_mutations.append((node.lineno, held, term))
         if chain and chain[-1] in BLOCKING_READ_ATTRS and held:
             self.blocking_reads.append((node.lineno, held, chain[-1]))
         if chain and chain[-1] in BLOCKING_SEND_ATTRS and held:
@@ -156,7 +192,7 @@ class LockDisciplineChecker(Checker):
                    "verbs, WAL append under the broadcast lock before "
                    "fanout, no blocking reads under a held lock")
 
-    SCOPE = ("core/apiserver.py", "core/wal.py")
+    SCOPE = ("core/apiserver.py", "core/wal.py", "core/watchcache.py")
     SCOPE_DIRS = ("replication/",)
 
     def applies_to(self, relpath: str) -> bool:
@@ -246,4 +282,37 @@ class LockDisciplineChecker(Checker):
                     f"{'/'.join(held)} — a scrape serialized against the "
                     "write plane stalls binds for the whole render; expose "
                     "paths snapshot-copy series data instead"))
+            for lineno, held, what in scan.cache_reads:
+                out.append(Finding(
+                    self.id, "no-read-serving-under-write-lock", mod.path,
+                    lineno,
+                    f"watch-cache read ({what}) under held lock(s) "
+                    f"{'/'.join(held)} — the read plane exists so that "
+                    "list/resume/metrics reads never contend with the "
+                    "write plane; serve under the cache's own lock only"))
+            cache_mutations = scan.cache_mutations
+            if fn.name == FANOUT_PRIMITIVE:
+                # The fanout primitive OWNS the raw note_event + watcher
+                # loop; its caller-holds-the-broadcast-lock contract is
+                # enforced at call sites (same shape as _repl_append).
+                cache_mutations = []
+            for lineno, held, what in cache_mutations:
+                if not any(lock == "_lock" for lock in held):
+                    out.append(Finding(
+                        self.id, "no-read-serving-under-write-lock",
+                        mod.path, lineno,
+                        f"watch-cache mutation ({what}) outside the "
+                        "broadcast lock — cache/ring order must be commit "
+                        "order, or a resumed watcher replays a different "
+                        "history than the WAL holds"))
+            if scan.wal_appends and cache_mutations:
+                first_mut = min(l for l, _h, _w in cache_mutations)
+                first_append = min(l for l, _ in scan.wal_appends)
+                if first_mut < first_append:
+                    out.append(Finding(
+                        self.id, "no-read-serving-under-write-lock",
+                        mod.path, first_mut,
+                        f"watch-cache mutation in {fn.name} precedes the "
+                        "WAL append — a cached event a reader served must "
+                        "already be durable"))
         return out

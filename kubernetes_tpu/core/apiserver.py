@@ -63,6 +63,13 @@ from ..api.types import (
 )
 from . import spans as _spans
 from .clientset import FakeClientset
+from .watchcache import (
+    ShardFilter,
+    WatchCache,
+    encode_stream_item,
+    pod_from_slim,
+    wire_key,
+)
 
 
 def _lease_clock() -> float:
@@ -308,6 +315,19 @@ def node_from_wire(d: dict) -> Node:
 # ---------------------------------------------------------------------------
 
 
+class _WatchStream:
+    """One attached watch stream: its event queue plus the optional
+    per-stream shard filter (``?watch=true&shard=i/n``). The filter runs
+    on the fanout path (broadcast lock); the queue decouples the stream's
+    socket from the write plane exactly as before."""
+
+    __slots__ = ("q", "filter")
+
+    def __init__(self, flt: Optional[ShardFilter] = None):
+        self.q: "queue.Queue" = queue.Queue()
+        self.filter = flt
+
+
 class _ShipStream:
     """One attached replication follower: its frame queue plus the ack
     bookkeeping `_await_shipped` reads. `sent_seq` is the highest frame seq
@@ -357,7 +377,7 @@ class APIServer:
                  backlog: int = 8192, data_dir: Optional[str] = None,
                  fsync: bool = False, snapshot_every: int = 2048):
         self.store = store or FakeClientset()
-        self._watchers: Dict[str, List["queue.Queue"]] = {"pods": [], "nodes": []}
+        self._watchers: Dict[str, List[_WatchStream]] = {"pods": [], "nodes": []}
         self._lock = threading.Lock()
         # Shard-plane coordination (shard/leases.py): named lease records,
         # renewed through PUT /api/v1/leases/<name> with holder-CAS semantics
@@ -382,8 +402,17 @@ class APIServer:
         from collections import deque
         import uuid
         self._seq: Dict[str, int] = {"pods": 0, "nodes": 0}
-        self._backlog: Dict[str, "deque"] = {
-            "pods": deque(maxlen=backlog), "nodes": deque(maxlen=backlog)}
+        # Watch-cache read plane (core/watchcache.py): per-kind rv-indexed
+        # event ring (the RESUME window — what the old `_backlog` deques
+        # held, now carrying the decoded event too so filtered streams can
+        # replay) + a wire-object snapshot serving LIST / summary / uid
+        # hydration / /metrics/resources under its OWN lock — reads no
+        # longer touch the store dicts or the write lock at all.
+        self.watch_cache: Dict[str, WatchCache] = {
+            "pods": WatchCache("pods", capacity=backlog),
+            "nodes": WatchCache("nodes", capacity=backlog)}
+        self.watch_slim_events = 0       # events delivered as slim wire
+        self.watch_filtered_events = 0   # events dropped entirely
         # Recent shipped frames by global seq: the replication window a
         # follower can resume from without a snapshot bootstrap.
         self._repl_backlog = deque(maxlen=backlog)
@@ -459,6 +488,7 @@ class APIServer:
         reflectors reconnecting with their last rv get RESUME, not Replace."""
         import itertools
 
+        rings: Dict[str, list] = {"pods": [], "nodes": []}
         snap, records = self.persistence.load()
         if self.persistence.epoch is not None:
             self.epoch = self.persistence.epoch
@@ -507,18 +537,30 @@ class APIServer:
             rv = rec.get("rv")
             if rv is not None and rv > self._seq[kind]:
                 self._seq[kind] = rv
-            # Rebuild the watch backlog exactly as _broadcast framed it (the
-            # deque's maxlen keeps only the freshest `backlog` events).
+            # Rebuild the watch-cache ring exactly as _broadcast framed it
+            # (the deque's maxlen keeps only the freshest `backlog` events).
             if rv is not None:
                 event = {k: v for k, v in rec.items()
                          if k not in ("kind", "seq", "epoch")}
-                self._backlog[kind].append(
-                    (rv, (json.dumps(event) + "\n").encode()))
+                rings[kind].append(
+                    (rv, event, (json.dumps(event) + "\n").encode()))
         # Object resource_versions were not persisted; fast-forward the
         # store's counter past everything ever minted so recovered and new
         # objects never share a version.
         self.store._rv_counter = itertools.count(
             self._seq["pods"] + self._seq["nodes"] + 1)
+        # Seed the read plane from the recovered store (the ring keeps only
+        # the freshest `backlog` events, trimmed by the deque maxlen).
+        # Recovery is single-threaded, but cache mutation uniformly holds
+        # the broadcast lock (the analyzer's rule has no special cases).
+        with self._lock:
+            cap = self.watch_cache["pods"]._ring.maxlen or 8192
+            self.watch_cache["pods"].reinstall(
+                [pod_to_wire(p) for p in self.store.pods.values()],
+                self._seq["pods"], ring=rings["pods"][-cap:])
+            self.watch_cache["nodes"].reinstall(
+                [node_to_wire(n) for n in self.store.nodes.values()],
+                self._seq["nodes"], ring=rings["nodes"][-cap:])
         self.recovered_objects = len(self.store.pods) + len(self.store.nodes)
         # Rebuild the Omega commit-validation usage table from the recovered
         # bound pods — incremental maintenance resumes from here.
@@ -581,8 +623,13 @@ class APIServer:
         It still rides the replication stream (followers must recover the
         nomination too)."""
         with self._lock:
+            wire = pod_to_wire(pod)
             self._repl_append(
-                {"kind": "pods", "type": "STATUS", "object": pod_to_wire(pod)})
+                {"kind": "pods", "type": "STATUS", "object": wire})
+            # Keep the read plane's object snapshot current (LIST must show
+            # nominations) without a ring entry — parity with the
+            # non-evented live fanout.
+            self.watch_cache["pods"].note_event(None, "STATUS", wire)
 
     def _repl_append(self, rec: dict, stamped: bool = False) -> int:
         """Commit one WAL frame — the ONE persist→backlog→ship sequence
@@ -858,15 +905,22 @@ class APIServer:
                     self._apply_recovered(kind, rec.get("type", ""),
                                           rec.get("object"))
                     rv = rec.get("rv")
-                    if rv is not None:  # rv-less STATUS: upsert, no event
+                    if rv is not None:
                         if rv > self._seq[kind]:
                             self._seq[kind] = rv
                         event = {k: v for k, v in rec.items()
                                  if k not in ("kind", "seq", "epoch")}
                         edata = (json.dumps(event) + "\n").encode()
-                        self._backlog[kind].append((rv, edata))
-                        for q in self._watchers[kind]:
-                            q.put(edata)
+                        # Same fanout as the leader's broadcast: this
+                        # follower's watch cache + its own (possibly
+                        # filtered) streams stay converged in the shared
+                        # rv space — clients RESUME against any replica.
+                        self._fan_event(kind, event, edata)
+                    else:
+                        # rv-less STATUS: snapshot upsert, no ring entry
+                        # (parity with its non-evented live fanout).
+                        self.watch_cache[kind].note_event(
+                            None, rec.get("type", ""), rec.get("object"))
                 # Compaction runs LAST, after the frame is in the store and
                 # _repl_seq has advanced: a snapshot taken between append
                 # and apply would exclude the triggering frame while
@@ -915,10 +969,13 @@ class APIServer:
                 # (sentinel); reconnecting clients full-re-list against the
                 # installed state (reflector Replace heals their caches).
                 self._repl_backlog.clear()
+                self.watch_cache["pods"].reinstall(
+                    list(snap.get("pods", ())), self._seq.get("pods", 0))
+                self.watch_cache["nodes"].reinstall(
+                    list(snap.get("nodes", ())), self._seq.get("nodes", 0))
                 for kind in ("pods", "nodes"):
-                    self._backlog[kind].clear()
-                    for q in self._watchers[kind]:
-                        q.put(None)
+                    for w in self._watchers[kind]:
+                        w.q.put(None)
                 if self.persistence is not None:
                     self.persistence.epoch = self.epoch
                     self.persistence.set_repl_epoch(self.repl_epoch)
@@ -1011,8 +1068,8 @@ class APIServer:
         data = (json.dumps(event) + "\n").encode()
         with self._lock:
             for kind in ("pods", "nodes"):
-                for q in self._watchers[kind]:
-                    q.put(data)
+                for w in self._watchers[kind]:
+                    w.q.put(data)
 
     def _attach_ship(self, since: int):
         """Attach a follower's ship stream at `since` (its last applied
@@ -1102,7 +1159,24 @@ class APIServer:
                 ("apiserver_replication_ship_wait_timeouts_total",
                  self.ship_wait_timeouts),
                 ("apiserver_replication_ship_streams_dropped_total",
-                 self.ship_streams_dropped)):
+                 self.ship_streams_dropped),
+                # Watch-cache read plane (core/watchcache.py): reads served
+                # from the cache (list/summary/uids//metrics/resources),
+                # RESUME replays from the ring, resume rvs that fell off
+                # the window (410-too-old -> full re-list), and the
+                # shard-filter's slimmed/suppressed event counts.
+                ("apiserver_watch_cache_hits_total",
+                 self.watch_cache["pods"].hits
+                 + self.watch_cache["nodes"].hits),
+                ("apiserver_watch_cache_resumes_total",
+                 self.watch_cache["pods"].resumes
+                 + self.watch_cache["nodes"].resumes),
+                ("apiserver_watch_cache_too_old_total",
+                 self.watch_cache["pods"].too_old
+                 + self.watch_cache["nodes"].too_old),
+                ("apiserver_watch_events_slim_total", self.watch_slim_events),
+                ("apiserver_watch_events_filtered_out_total",
+                 self.watch_filtered_events)):
             out.append(f"# TYPE {name} counter")
             out.append(f"{name} {v}")
         out.append("# TYPE apiserver_failover_total counter")
@@ -1160,15 +1234,44 @@ class APIServer:
                 except Exception:  # noqa: BLE001
                     self.compaction_failures += 1
             data = (json.dumps(event) + "\n").encode()
-            self._backlog[kind].append((self._seq[kind], data))
             _tf = time.perf_counter() if ctx is not None else 0.0
-            for q in self._watchers[kind]:
-                q.put(data)
+            self._fan_event(kind, event, data)
             if ctx is not None:
                 self.tracer.record("bound.fanout", ctx,
                                    time.perf_counter() - _tf,
                                    watchers=len(self._watchers[kind]),
                                    rv=event["rv"])
+
+    def _fan_event(self, kind: str, event: dict, data: bytes) -> None:
+        """The one commit→read-plane fanout both write paths share (the
+        leader's _broadcast and a follower's apply_frame): install the
+        event into the watch cache (ring + object snapshot), then feed
+        every attached stream — full wire, or through its shard filter.
+        Caller holds the broadcast lock, AFTER the WAL append: ring order
+        is commit order, and a cached/fanned event is always durable."""
+        self.watch_cache[kind].note_event(
+            event.get("rv"), event.get("type", ""), event.get("object"),
+            data=data, event=event)
+        # One per-event memo shared across the filtered streams: the slim
+        # projection/encode is identical for all of them, so N shards pay
+        # ONE dict build + json encode under the broadcast lock, not N.
+        memo: dict = {}
+        for w in self._watchers[kind]:
+            self._route_to(w, event, data, self.watch_cache[kind], memo)
+
+    def _route_to(self, st: _WatchStream, event: dict, data: bytes,
+                  wc: WatchCache, memo: Optional[dict] = None) -> None:
+        """Deliver one event to one stream through its filter (or raw) —
+        the ONE routing+counting sequence the live fanout and the
+        attach-time replay both use. Caller holds the broadcast lock."""
+        if st.filter is None:
+            st.q.put(data)
+            return
+        outs, slim, dropped = st.filter.route(event, data, wc, memo)
+        self.watch_slim_events += slim
+        self.watch_filtered_events += dropped
+        for d in outs:
+            st.q.put(d)
 
     def _pod_event(self, kind: str, old, new) -> None:
         typ = {"add": "ADDED", "update": "MODIFIED", "delete": "DELETED"}[kind]
@@ -1195,49 +1298,66 @@ class APIServer:
         self._broadcast("nodes", {"type": typ, "object": node_to_wire(new)})
 
     def _attach_watch(self, kind: str, since: Optional[int] = None,
-                      epoch: Optional[str] = None) -> "queue.Queue":
+                      epoch: Optional[str] = None,
+                      flt: Optional[ShardFilter] = None) -> _WatchStream:
         """Attach a watch under the broadcast lock, THEN register for live
         events — no create can fall between snapshot and registration.
+        The snapshot and the resume ring both serve from the watch cache
+        (never the store dicts, never the write lock).
 
-        since=None (or outside the backlog window, or an epoch from another
+        since=None (or outside the ring window, or an epoch from another
         server instance): resourceVersion=0 semantics — ADDED for every
         existing object, then a SYNC marker carrying the current rv +
         epoch. since=N inside the window with a matching epoch: a RESUME
-        marker, then a replay of exactly the events with rv > N."""
-        q: "queue.Queue" = queue.Queue()
+        marker, then a replay of exactly the events with rv > N. A shard
+        filter (``flt``) routes both replays; a filtered RESUME against a
+        selector-ful cluster re-lists instead (the per-stream slim set
+        died with the old connection — see core/watchcache.py)."""
+        st = _WatchStream(flt)
+        wc = self.watch_cache[kind]
         with self._lock:
-            backlog = self._backlog[kind]
             seq = self._seq[kind]
+            tail = None
             # Resumable iff the rv names THIS server's history (epoch) and
             # NOTHING after `since` was compacted away. Anything else —
             # unknown epoch (server restarted, counters reset), a future
-            # rv, a pruned window — full-re-lists, never silently resumes.
+            # rv, a pruned ring window — full-re-lists, never silently
+            # resumes (events_since counts the 410-too-old case).
             if (since is not None and epoch == self.epoch and since <= seq
-                    and (since == seq
-                         or (backlog and backlog[0][0] <= since + 1))):
-                q.put((json.dumps({"type": "RESUME", "rv": seq,
-                                   "epoch": self.epoch}) + "\n").encode())
-                for s, data in backlog:
-                    if s > since:
-                        q.put(data)
+                    and not (flt is not None and wc.selector_refs > 0)):
+                tail = wc.events_since(since)
+            if tail is not None:
+                st.q.put((json.dumps({"type": "RESUME", "rv": seq,
+                                      "epoch": self.epoch}) + "\n").encode())
+                for _rv, event, data in tail:
+                    self._route_to(st, event, data, wc)
+                if flt is not None:
+                    # Prime AFTER the replay: the fresh filter's empty slim
+                    # map means no replayed event can be suppressed (the
+                    # primed projections are built from the CURRENT
+                    # snapshot — priming first would make a replayed
+                    # MODIFIED that produced that very state compare equal
+                    # and be dropped, losing e.g. a deletionTs the client
+                    # missed while disconnected). Priming afterwards only
+                    # seeds the upgrade set for a later selector
+                    # transition.
+                    flt.prime(wc)
                 self.resumed_watches += 1
             else:
-                if kind == "pods":
-                    objs = [pod_to_wire(p) for p in self.store.pods.values()]
-                else:
-                    objs = [node_to_wire(n) for n in self.store.nodes.values()]
-                for o in objs:
-                    q.put((json.dumps({"type": "ADDED", "object": o}) + "\n").encode())
-                q.put((json.dumps({"type": "SYNC", "rv": seq,
-                                   "epoch": self.epoch}) + "\n").encode())
+                for o in wc.list_wire():
+                    event = {"type": "ADDED", "object": o}
+                    self._route_to(st, event,
+                                   (json.dumps(event) + "\n").encode(), wc)
+                st.q.put((json.dumps({"type": "SYNC", "rv": seq,
+                                      "epoch": self.epoch}) + "\n").encode())
                 self.relisted_watches += 1
-            self._watchers[kind].append(q)
-        return q
+            self._watchers[kind].append(st)
+        return st
 
-    def _detach_watch(self, kind: str, q) -> None:
+    def _detach_watch(self, kind: str, st: _WatchStream) -> None:
         with self._lock:
-            if q in self._watchers[kind]:
-                self._watchers[kind].remove(q)
+            if st in self._watchers[kind]:
+                self._watchers[kind].remove(st)
 
     # -- http --------------------------------------------------------------
 
@@ -1285,7 +1405,7 @@ class APIServer:
             def do_GET(self):
                 path, _, query = self.path.partition("?")
                 watch = "watch=true" in query
-                since, epoch = None, None
+                since, epoch, flt, uids = None, None, None, None
                 for part in query.split("&"):
                     if part.startswith("resourceVersion="):
                         try:
@@ -1294,9 +1414,31 @@ class APIServer:
                             pass
                     elif part.startswith("epoch="):
                         epoch = part.split("=", 1)[1]
+                    elif part.startswith("shard="):
+                        # Server-side shard-filtered stream: shard=i/n
+                        # applies the shard/partition.py crc32 map HERE,
+                        # so a shard's decode cost scales with 1/n. A spec
+                        # that names no real slot (count<=0, index out of
+                        # range) is IGNORED, not coerced — a coerced
+                        # filter would slim every pod including the
+                        # stream owner's own.
+                        try:
+                            i, _, n = part.split("=", 1)[1].partition("/")
+                            idx, cnt = int(i), int(n)
+                            if cnt >= 1 and 0 <= idx < cnt:
+                                flt = ShardFilter(idx, cnt)
+                        except ValueError:
+                            pass
+                    elif part.startswith("uids="):
+                        uids = [u for u in
+                                part.split("=", 1)[1].split(",") if u]
                 if path == "/api/v1/pods":
                     if watch:
-                        return self._stream("pods", since, epoch)
+                        return self._stream("pods", since, epoch, flt)
+                    # Every non-watch read below serves from the watch
+                    # cache under ITS lock — no store-dict iteration, no
+                    # write-lock contention, and safe against concurrent
+                    # mutation by construction.
                     if "summary=true" in query:
                         # Progress-poll surface: counting is ~3 orders of
                         # magnitude cheaper than wire-encoding the full
@@ -1304,17 +1446,34 @@ class APIServer:
                         # need the counts — at 10k pods a full-list poll
                         # every 0.5s costs the control plane more CPU than
                         # the binds themselves.
-                        pods = list(server.store.pods.values())
-                        return self._json(200, {
-                            "total": len(pods),
-                            "bound": sum(1 for p in pods if p.node_name)})
-                    return self._json(200, [pod_to_wire(p) for p in
-                                            server.store.pods.values()])
+                        s = server.watch_cache["pods"].read_summary()
+                        return self._json(200, {"total": s["total"],
+                                                "bound": s["bound"]})
+                    if uids is not None:
+                        # Hydration read (shard adoption): full wire for
+                        # pods a filtered stream delivered slim.
+                        return self._json(
+                            200, server.watch_cache["pods"].get_many(uids))
+                    return self._json(200,
+                                      server.watch_cache["pods"].list_wire())
                 if path == "/api/v1/nodes":
                     if watch:
                         return self._stream("nodes", since, epoch)
-                    return self._json(200, [node_to_wire(n) for n in
-                                            server.store.nodes.values()])
+                    return self._json(200,
+                                      server.watch_cache["nodes"].list_wire())
+                if path == "/metrics/resources":
+                    # kube_pod_resource_request rendered straight from the
+                    # watch cache's wire snapshot: harness pollers scrape
+                    # this from FOLLOWER replicas, off the leader entirely.
+                    data = server.watch_cache["pods"].render_resources()
+                    data = data.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 if path == "/api/v1/leases":
                     return self._json(200, server.list_leases())
                 if path == "/replication/status":
@@ -1356,7 +1515,8 @@ class APIServer:
                 self._json(404, {"error": "not found"})
 
             def _stream(self, kind: str, since: Optional[int] = None,
-                        epoch: Optional[str] = None) -> None:
+                        epoch: Optional[str] = None,
+                        flt: Optional[ShardFilter] = None) -> None:
                 # watch.Interface: hold the connection open, one JSON event
                 # per line (chunked); blocking queue — no idle polling. A
                 # BOOKMARK heartbeat goes out on idle (~10s) so a quiet
@@ -1367,12 +1527,12 @@ class APIServer:
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
-                q = server._attach_watch(kind, since, epoch)
+                st = server._attach_watch(kind, since, epoch, flt)
                 idle = 0.0
                 try:
                     while server._httpd is not None:
                         try:
-                            data = q.get(timeout=0.5)
+                            data = st.q.get(timeout=0.5)
                             idle = 0.0
                         except queue.Empty:
                             idle += 0.5
@@ -1384,13 +1544,17 @@ class APIServer:
                             # Stream-end sentinel (snapshot RESYNC skipped
                             # frames): close; the client re-lists fresh.
                             break
+                        # Lazy upgrade markers encode HERE, on this
+                        # stream's own thread — never under the broadcast
+                        # lock the fanout path holds.
+                        data = encode_stream_item(data)
                         self.wfile.write(
                             f"{len(data):x}\r\n".encode() + data + b"\r\n")
                         self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError):
                     pass
                 finally:
-                    server._detach_watch(kind, q)
+                    server._detach_watch(kind, st)
                     # End of stream (server shutdown): close the TCP
                     # connection instead of waiting for another request on
                     # it, so the client's reflector sees EOF immediately
@@ -1861,8 +2025,19 @@ class HTTPClientset:
     validates_bind_capacity = True
 
     def __init__(self, base_url: str, sync_timeout: float = 30.0,
-                 fallbacks=()):
+                 fallbacks=(), shard=None):
         self.base = base_url.rstrip("/")
+        # Server-side shard filtering (core/watchcache.py): with
+        # shard=(index, count), the pod watch opens `?shard=i/n` and the
+        # server delivers full pod wire only for owned + wire-relevant
+        # pods; the rest arrive as slim projections this client MERGES
+        # onto its cache (pod_from_slim). The decode counters below are
+        # what bench.py --shards surfaces per shard — the measurable 1/N.
+        self.shard = tuple(shard) if shard else None
+        self.watch_events_full = 0
+        self.watch_events_slim = 0
+        self.watch_bytes_full = 0
+        self.watch_bytes_slim = 0
         # Read plane: the base plus sibling replicas the reflector may
         # rotate to when the base dies (shared rv/epoch space -> RESUME).
         self._bases: List[str] = [self.base] + [
@@ -2158,6 +2333,47 @@ class HTTPClientset:
     def update_pod(self, pod: Pod) -> Pod:  # parity stub for the surface
         return pod
 
+    # -- slim-pod hydration (shard adoption; core/watchcache.py) ------------
+
+    def hydrate_pods(self, uids) -> int:
+        """Replace slim-cached pods with their full wire (GET ?uids=...,
+        served from the server's watch cache). Used when shard ownership
+        GROWS past the stream's static filter (adoption): pods this shard
+        must now SCHEDULE arrived slim and need their real spec. The local
+        binding view is preserved (a racing BOUND flows through the
+        ordered stream as usual), and pods deleted meanwhile are skipped.
+        No handler fanout: callers re-read `self.pods` — the pods are
+        pending and foreign-until-now, so no cache/queue state exists."""
+        uids = [u for u in uids if u]
+        hydrated = 0
+        for i in range(0, len(uids), 64):
+            chunk = uids[i:i + 64]
+            wires = self._call(
+                "GET", "/api/v1/pods?uids=" + ",".join(chunk)) or []
+            with self._dispatch_lock:
+                for w in wires:
+                    pod = pod_from_wire(w)
+                    cur = self.pods.get(pod.uid)
+                    if cur is None:
+                        continue  # deleted while hydrating
+                    pod.node_name = cur.node_name
+                    pod.deletion_ts = cur.deletion_ts
+                    self.pods[pod.uid] = pod
+                    hydrated += 1
+        return hydrated
+
+    def hydrate_pod(self, uid: str) -> Optional[Pod]:
+        """Single-pod hydration (the per-event adoption path): returns the
+        full cached pod, or None when it vanished or the fetch failed."""
+        try:
+            self.hydrate_pods([uid])
+        except Exception:  # noqa: BLE001 - transient; sweep retries
+            return None
+        pod = self.pods.get(uid)
+        if pod is None or getattr(pod, "wire_slim", False):
+            return None
+        return pod
+
     # -- shard leases (shard/leases.py coordination surface) ----------------
 
     def list_leases(self) -> List[dict]:
@@ -2231,6 +2447,8 @@ class HTTPClientset:
             try:
                 conn = _hc.HTTPConnection(host, timeout=60)
                 path = f"/api/v1/{kind}?watch=true"
+                if kind == "pods" and self.shard is not None:
+                    path += f"&shard={self.shard[0]}/{self.shard[1]}"
                 if (self._last_rv[kind] is not None
                         and self._epoch[kind] is not None):
                     path += (f"&resourceVersion={self._last_rv[kind]}"
@@ -2267,6 +2485,15 @@ class HTTPClientset:
                         break  # EOF: server went away — re-list + re-watch
                     event = json.loads(line)
                     typ = event["type"]
+                    if typ in ("ADDED", "MODIFIED", "DELETED"):
+                        # Decode-cost accounting (the 1/N the shard filter
+                        # buys): slim projections vs full object wire.
+                        if (event.get("object") or {}).get("slim"):
+                            self.watch_events_slim += 1
+                            self.watch_bytes_slim += len(line)
+                        else:
+                            self.watch_events_full += 1
+                            self.watch_bytes_full += len(line)
                     if typ == "BOOKMARK":
                         continue  # server idle heartbeat
                     if typ == "FAILOVER":
@@ -2308,7 +2535,7 @@ class HTTPClientset:
                         continue
                     with self._dispatch_lock:
                         if resync_seen is not None:
-                            resync_seen.add(self._wire_key(kind, event["object"]))
+                            resync_seen.add(wire_key(kind, event["object"]))
                         self._dispatch(kind, typ, event["object"],
                                        relisting=resync_seen is not None)
                         if event.get("rv") is not None:
@@ -2331,10 +2558,6 @@ class HTTPClientset:
                 return
             if not got_sync:
                 backoff = min(backoff * 2, 5.0)
-
-    @staticmethod
-    def _wire_key(kind: str, obj: dict) -> str:
-        return obj["uid"] if kind == "pods" else obj["name"]
 
     def _replace_barrier(self, kind: str, seen: Optional[set]) -> None:
         """End of a (re-)list window: local objects the server did NOT replay
@@ -2380,7 +2603,17 @@ class HTTPClientset:
             return
         action = {"ADDED": "add", "MODIFIED": "update", "DELETED": "delete"}[typ]
         if kind == "pods":
-            pod = pod_from_wire(obj)
+            if obj.get("slim"):
+                # Slim projection (shard-filtered stream): MERGE onto the
+                # cached copy — the spec is immutable on this surface, so
+                # any previously-delivered full wire stays authoritative
+                # and only the projection fields (nodeName/deletionTs)
+                # patch. Absent a cached copy, pod_from_slim builds the
+                # minimal accounting pod and marks it `wire_slim` (the
+                # shard plane hydrates before ever SCHEDULING one).
+                pod = pod_from_slim(obj, self.pods.get(obj["uid"]))
+            else:
+                pod = pod_from_wire(obj)
             old = self.pods.get(pod.uid)
             if relisting and action == "add" and old is not None:
                 action = "update"  # re-list replay of a known object

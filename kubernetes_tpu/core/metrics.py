@@ -339,6 +339,19 @@ class SchedulerMetrics:
             "scheduler_shard_adoptions_total",
             "Expired peer shard ranges adopted (lease-expiry failover).",
             ()))
+        # watch-cache read plane (core/watchcache.py): per-shard decode
+        # cost by wire form — 'full' = whole pod/node wire, 'slim' = the
+        # shard filter's NodeInfo-accounting projection. Callback gauges
+        # fed from the HTTP clientset's reflector counters.
+        self.watch_decoded_events = r(Gauge(
+            "scheduler_watch_decoded_events",
+            "Watch events this scheduler decoded, by wire form "
+            "(shard-filtered streams deliver foreign plain pods slim).",
+            ("form",)))
+        self.watch_decoded_bytes = r(Gauge(
+            "scheduler_watch_decoded_bytes",
+            "Watch stream bytes this scheduler decoded, by wire form.",
+            ("form",)))
         # placement / pod-group series
         self.generated_placements_total = r(Counter(
             "scheduler_generated_placements_total",

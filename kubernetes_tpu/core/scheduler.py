@@ -328,6 +328,17 @@ class Scheduler:
             (): float(self.api_dispatcher.pending_count())}
         self.metrics.queued_entities._fn = self._queued_entity_counts
         self.metrics.unschedulable_pods._fn = self._unschedulable_by_plugin
+        # Watch decode cost, by wire form (core/watchcache.py shard-filtered
+        # streams): counters live on the HTTP clientset's reflector thread;
+        # the gauges read them at scrape time so bench.py --shards can show
+        # the per-shard decoded-events/bytes 1/N. Zero on a FakeClientset.
+        _cs = self.clientset
+        self.metrics.watch_decoded_events._fn = lambda: {
+            ("full",): float(getattr(_cs, "watch_events_full", 0)),
+            ("slim",): float(getattr(_cs, "watch_events_slim", 0))}
+        self.metrics.watch_decoded_bytes._fn = lambda: {
+            ("full",): float(getattr(_cs, "watch_bytes_full", 0)),
+            ("slim",): float(getattr(_cs, "watch_bytes_slim", 0))}
         # Waiting pods (Permit WAIT; framework.go waitingPods registry).
         # _next_wait_deadline makes expiry TIMER-DRIVEN: schedule_one checks
         # it every cycle (O(1)), so a parked pod times out even while the
@@ -501,6 +512,27 @@ class Scheduler:
         return self.pod_admission is None or self.pod_admission(pod)
 
     def _on_pod_event(self, kind: str, old: Optional[Pod], new: Pod) -> None:
+        if (getattr(new, "wire_slim", False) and not new.node_name
+                and kind in ("add", "update")
+                and self.pod_admission is not None
+                and self._responsible_for_pod(new) and self._admits(new)):
+            # A slim-projection pod this scheduler ADMITS: shard ownership
+            # grew past the watch stream's static `shard=i/n` filter
+            # (adoption) — the pod arrived without its real spec
+            # (selectors, tolerations, gates). Hydrate from the server's
+            # watch cache before any queue state is built from the
+            # projection; on a transient fetch failure the pod stays out
+            # of the queue and the adoption sweep retries. Gated on an
+            # ATTACHED shard plane (pod_admission): before the ShardMember
+            # exists, _admits answers True for everything, and the
+            # constructor-time handler replay would hydrate every foreign
+            # pod — while deadlocking on the clientset's _dispatch_lock,
+            # which that replay already holds on this thread.
+            hydrate = getattr(self.clientset, "hydrate_pod", None)
+            if hydrate is not None:
+                full = hydrate(new.uid)
+                if full is not None:
+                    new = full
         # cluster_event_seq versions node-state-relevant cluster changes so a
         # device batch session (models/tpu_scheduler.py) knows whether the
         # on-device carry still reflects the cluster; the typed journal
@@ -523,7 +555,10 @@ class Scheduler:
                 self.cache.add_pod(new)
                 self.queue.move_all_to_active_or_backoff(
                     EVENT_ASSIGNED_POD_ADD, None, new)
-            elif self._responsible_for_pod(new) and self._admits(new):
+            elif (self._responsible_for_pod(new) and self._admits(new)
+                    and not getattr(new, "wire_slim", False)):
+                # A still-slim pod (hydration failed) must never be
+                # SCHEDULED from its projection; the sweep retries it.
                 self.queue.add(new)
         elif kind == "update":
             if new.node_name:
@@ -564,11 +599,14 @@ class Scheduler:
                         new.node_name = ""
                         self.queue.add(new)
                 else:
-                    if self._admits(new) or self.queue.has_entity(new.uid):
+                    if ((self._admits(new) or self.queue.has_entity(new.uid))
+                            and not getattr(new, "wire_slim", False)):
                         # Non-admitted pending pods stay out of the queue;
                         # an already-queued one (ownership shrank after
                         # adoption handback) still takes spec updates — the
-                        # optimistic 409 path resolves any overlap.
+                        # optimistic 409 path resolves any overlap. A pod
+                        # still in slim projection (hydration failed) must
+                        # not fall through update() into a spec-less add.
                         self.queue.update(old, new)
         elif kind == "delete":
             if new.node_name:

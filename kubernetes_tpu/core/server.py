@@ -116,13 +116,12 @@ class SchedulerServer:
     def expose_resource_metrics(self) -> str:
         """/metrics/resources (app/server.go:376-379 →
         pkg/scheduler/metrics/resources): per-pod resource requests as
-        kube_pod_resource_request series, by namespace/pod/node/phase."""
+        kube_pod_resource_request series, by namespace/pod/node/phase —
+        one shared renderer with the apiserver's watch-cache endpoint
+        (core/watchcache.py), so the two expositions cannot drift."""
+        from .watchcache import RESOURCE_METRICS_HEADER, resource_request_lines
         cs = self.scheduler.clientset
-        lines = [
-            "# HELP kube_pod_resource_request Resources requested by "
-            "workloads on the cluster, broken down by pod.",
-            "# TYPE kube_pod_resource_request gauge",
-        ]
+        lines = list(RESOURCE_METRICS_HEADER)
         bindings = getattr(cs, "bindings", {})
         for pod in cs.pods.values():
             req = pod.resource_request()
@@ -130,19 +129,9 @@ class SchedulerServer:
             # kube_pod_resource_request convention) — `or ""` keeps a None
             # node_name from rendering as the literal string "None".
             node = bindings.get(pod.uid) or pod.node_name or ""
-            phase = "Running" if node else "Pending"
-            for res_name, val in (("cpu", req.milli_cpu / 1000.0),
-                                  ("memory", float(req.memory))):
-                if val:
-                    lines.append(
-                        f'kube_pod_resource_request{{namespace="{pod.namespace}",'
-                        f'pod="{pod.name}",node="{node}",'
-                        f'resource="{res_name}",phase="{phase}"}} {val}')
-            for name, amount in req.scalar_resources.items():
-                lines.append(
-                    f'kube_pod_resource_request{{namespace="{pod.namespace}",'
-                    f'pod="{pod.name}",node="{node}",'
-                    f'resource="{name}",phase="{phase}"}} {float(amount)}')
+            lines.extend(resource_request_lines(
+                pod.namespace, pod.name, node,
+                req.milli_cpu, float(req.memory), req.scalar_resources))
         return "\n".join(lines) + "\n"
 
     def shutdown(self) -> None:

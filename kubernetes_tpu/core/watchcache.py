@@ -1,0 +1,453 @@
+"""Watch-cache read plane + shard-filtered watch streams.
+
+Re-expresses the reference's read-serving cache layer between storage and
+watchers (`staging/.../cacher/watch_cache.go`, SURVEY §1 L1) for the
+apiserver in core/apiserver.py:
+
+- :class:`WatchCache` — per-kind: an **rv-indexed ring** of recent events
+  (the watch RESUME window) plus an **rv-stamped snapshot of wire-encoded
+  objects** (the LIST/summary/`/metrics/resources` read plane). Mutation
+  happens on the apiserver's existing `_broadcast` fanout path, under the
+  broadcast lock and AFTER the WAL append; every read serves under the
+  cache's OWN lock — list/summary/resource-metrics reads never touch the
+  server's `_write_lock`, so the read plane stops contending with binds
+  (the analyzer's `no-read-serving-under-write-lock` rule pins this).
+  A resume rv older than the ring window answers None (the 410 Gone
+  analogue) and the caller falls back to the existing full-relist path.
+  Followers maintain their cache from applied replication frames (the
+  same fanout helper), so any replica serves the identical read plane in
+  the shared rv space — including across a promotion.
+
+- **Shard-filtered watch streams** (`?watch=true&shard=i/n`): the server
+  applies the shard/partition.py crc32 map per event, delivering the full
+  pod wire only for pods the watching shard owns and for *wire-relevant*
+  foreign pods — pods whose spec can affect OTHER pods' scheduling
+  (pod affinity / anti-affinity, topology spread, host ports, PVC
+  volumes, DRA claims: exactly what NodeInfo accounting needs, the same
+  facts core/cache.py `pod_event_flags` classifies). Everything else
+  ships as a **slim event**: the NodeInfo-accounting projection
+  ``{uid, nodeName, phase, namespace, podGroup, priority, deletionTs,
+  requests}`` (+ the event-level rv) — a shard's per-event decode cost
+  scales with 1/N instead of with the whole cluster's churn. A foreign
+  slim MODIFIED whose projection did not change is dropped entirely
+  (`filtered_out`): the watcher's view of a slim pod depends only on the
+  projection.
+
+  Label-selector safety: pod-affinity and topology-spread terms match
+  OTHER pods by label, so the moment any live pod declares such a term
+  (``selector_refs > 0``) slimming is disabled — new events go out full,
+  and each filtered stream first *upgrades* every pod it previously
+  slimmed with a full rv-less MODIFIED (the same cluster-level trigger
+  PR 3's neutral signatures key on). A filtered RESUME against a
+  selector-ful cluster falls back to a full re-list (the per-stream slim
+  set died with the old connection and cannot be reconstructed).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..api.resource import Resource
+from ..api.types import Container, Pod
+from ..shard.partition import shard_of_key
+
+
+def wire_key(kind: str, obj: dict) -> str:
+    return obj["uid"] if kind == "pods" else obj["name"]
+
+
+RESOURCE_METRICS_HEADER = (
+    "# HELP kube_pod_resource_request Resources requested by "
+    "workloads on the cluster, broken down by pod.",
+    "# TYPE kube_pod_resource_request gauge",
+)
+
+
+def resource_request_lines(namespace: str, pod_name: str, node: str,
+                           cpu_milli: int, memory: float,
+                           scalars: Dict[str, float]) -> List[str]:
+    """One pod's kube_pod_resource_request series — the ONE exposition
+    format both `/metrics/resources` endpoints (the apiserver's
+    watch-cache render and the scheduler server's informer render) share.
+    Pending pods carry an EMPTY node label (reference convention)."""
+    phase = "Running" if node else "Pending"
+    lines: List[str] = []
+    for res_name, val in (("cpu", cpu_milli / 1000.0),
+                          ("memory", float(memory))):
+        if val:
+            lines.append(
+                f'kube_pod_resource_request{{namespace="{namespace}",'
+                f'pod="{pod_name}",node="{node}",'
+                f'resource="{res_name}",phase="{phase}"}} {val}')
+    for sname, amount in scalars.items():
+        lines.append(
+            f'kube_pod_resource_request{{namespace="{namespace}",'
+            f'pod="{pod_name}",node="{node}",'
+            f'resource="{sname}",phase="{phase}"}} {float(amount)}')
+    return lines
+
+
+def encode_stream_item(item) -> bytes:
+    """Resolve one watch-queue item to wire bytes: pre-encoded events pass
+    through; lazy ("MODIFIED", wire_obj) upgrade markers (ShardFilter's
+    selector-transition burst) encode HERE, on the stream's consumer
+    thread, so the fanout path never pays a json encode per slimmed pod
+    under the broadcast lock."""
+    if isinstance(item, bytes):
+        return item
+    typ, obj = item
+    return (json.dumps({"type": typ, "object": obj}) + "\n").encode()
+
+
+def shard_key_from_wire(obj: dict) -> str:
+    """shard/partition.py's stable key, computed from the WIRE dict so the
+    server never decodes a pod to route it: the gang's identity when the
+    pod belongs to one (gangs pin whole), else the pod uid."""
+    group = obj.get("podGroup", "")
+    if group:
+        return f"pg:{obj.get('namespace', 'default')}/{group}"
+    return obj["uid"]
+
+
+def shard_of_wire(obj: dict, count: int) -> int:
+    """The ONE crc32 map (shard/partition.py) applied server-side: a
+    member's admission predicate and its stream's filter must agree
+    exactly, or an owned pod could arrive slim."""
+    return shard_of_key(shard_key_from_wire(obj), count)
+
+
+def wire_plain(obj: dict) -> bool:
+    """True when this pod cannot affect any OTHER pod's scheduling: no
+    pod-(anti-)affinity terms, no topology spread, no host ports, no
+    PVC-backed volumes, no DRA claims — the wire-dict mirror of
+    core/cache.py pod_event_flags (node affinity / nodeSelector /
+    tolerations only constrain where THIS pod goes, which is its owning
+    shard's concern)."""
+    aff = obj.get("affinity") or {}
+    return not (
+        aff.get("podAffinity") or aff.get("podAntiAffinity")
+        or obj.get("topologySpread") or obj.get("hostPorts")
+        or any(v.get("pvc") for v in obj.get("volumes", ()))
+        or obj.get("resourceClaims"))
+
+
+def wire_selector_source(obj: dict) -> bool:
+    """True when this pod's spec contains label-selector terms that match
+    OTHER pods (pod affinity / anti-affinity, topology spread): while any
+    such pod is live, every pod's labels are wire-relevant and slimming
+    is disabled (selectors may be empty = match-all, so even unlabeled
+    pods can count toward a spread domain)."""
+    aff = obj.get("affinity") or {}
+    return bool(aff.get("podAffinity") or aff.get("podAntiAffinity")
+                or obj.get("topologySpread"))
+
+
+def slim_object(obj: dict) -> dict:
+    """The NodeInfo-accounting projection of a foreign plain pod: enough
+    to partition it (uid/namespace/podGroup), account it into a node's
+    committed usage when it binds (requests), rank it as a preemption
+    victim (priority), and skip it in adoption sweeps (deletionTs)."""
+    return {
+        "slim": True,
+        "uid": obj["uid"],
+        "name": obj.get("name", ""),
+        "nodeName": obj.get("nodeName", ""),
+        "phase": "Running" if obj.get("nodeName") else "Pending",
+        "namespace": obj.get("namespace", "default"),
+        "podGroup": obj.get("podGroup", ""),
+        "priority": obj.get("priority", 0),
+        "deletionTs": obj.get("deletionTs"),
+        "requests": obj.get("requests",
+                            {"cpu": 0, "memory": 0, "ephemeral": 0,
+                             "scalar": {}}),
+    }
+
+
+def pod_from_slim(d: dict, old: Optional[Pod] = None) -> Pod:
+    """Client-side decode of a slim event. With a cached copy, MERGE: the
+    spec is immutable on this surface, so keep whatever detail the cache
+    already holds (possibly the full wire from before a filter upgrade)
+    and patch only the projection fields. Without one, build a minimal
+    pod carrying exactly the accounting facts; ``wire_slim`` marks it so
+    the shard plane knows to hydrate before SCHEDULING it (adoption)."""
+    import copy as _copy
+    if old is not None:
+        pod = _copy.copy(old)
+        pod.node_name = d.get("nodeName", "")
+        pod.deletion_ts = d.get("deletionTs")
+        return pod
+    req = d.get("requests") or {}
+    res = Resource(milli_cpu=int(req.get("cpu", 0)),
+                   memory=int(req.get("memory", 0)),
+                   ephemeral_storage=int(req.get("ephemeral", 0)),
+                   scalar_resources=dict(req.get("scalar", {})))
+    pod = Pod(name=d.get("name", ""), namespace=d.get("namespace", "default"),
+              uid=d["uid"], node_name=d.get("nodeName", ""),
+              priority=int(d.get("priority", 0)),
+              containers=[Container(name="c0", requests=res)],
+              phase=d.get("phase", "Pending"))
+    pod.pod_group = d.get("podGroup", "")
+    pod.deletion_ts = d.get("deletionTs")
+    pod.wire_slim = True
+    return pod
+
+
+class WatchCache:
+    """Per-kind read-serving cache: rv-indexed event ring + wire-object
+    snapshot.
+
+    Locking contract (enforced by the lock-discipline analyzer):
+    - ``note_event``/``reset`` (mutation) are called on the apiserver's
+      broadcast path with ``_lock`` held, after the WAL append — so ring
+      order is commit order and a cached object is always durable;
+    - the read methods (``list_wire``/``get_many``/``read_summary``/
+      ``events_since``/``render_resources``) take only this cache's own
+      lock and MUST NOT be called with the server's ``_write_lock``
+      held — the whole point is a read plane that never contends with
+      the write plane."""
+
+    def __init__(self, kind: str, capacity: int = 8192):
+        self.kind = kind
+        self._lock = threading.Lock()
+        self._ring: "deque" = deque(maxlen=capacity)  # (rv, event, data)
+        self._objects: Dict[str, dict] = {}
+        self._bound = 0          # pods with a nodeName (summary read)
+        self.selector_refs = 0   # live pods with affinity/spread terms
+        self.rv = 0
+        self.hits = 0       # list/summary/uids/resource reads served
+        self.resumes = 0    # interval replays served from the ring
+        self.too_old = 0    # resume rvs that fell off the window (410)
+
+    # -- mutation (broadcast path; caller holds the server's _lock) ---------
+
+    def note_event(self, rv: Optional[int], typ: str,
+                   obj: Optional[dict], data: Optional[bytes] = None,
+                   event: Optional[dict] = None) -> None:
+        """Apply one committed event: update the object snapshot, and (for
+        rv-stamped events) append to the resume ring. rv=None is a STATUS
+        upsert (nominations): snapshot only, never the ring — parity with
+        its non-evented live fanout."""
+        with self._lock:
+            if obj is not None:
+                self._apply_object(typ, obj)
+            if rv is not None:
+                self.rv = max(self.rv, rv)
+                self._ring.append((rv, event or {"type": typ, "object": obj},
+                                   data))
+
+    def _apply_object(self, typ: str, obj: dict) -> None:
+        if typ == "BOUND":
+            cur = self._objects.get(obj.get("uid", ""))
+            if cur is not None:
+                if not cur.get("nodeName") and obj.get("nodeName"):
+                    self._bound += 1
+                # copy-on-write: handed-out list_wire() dicts stay frozen
+                self._objects[obj["uid"]] = dict(
+                    cur, nodeName=obj.get("nodeName", ""))
+            return
+        key = wire_key(self.kind, obj)
+        old = self._objects.get(key)
+        if typ == "DELETED":
+            if old is not None:
+                self._objects.pop(key, None)
+                if self.kind == "pods":
+                    if old.get("nodeName"):
+                        self._bound -= 1
+                    if wire_selector_source(old):
+                        self.selector_refs -= 1
+            return
+        # ADDED / MODIFIED / STATUS: upsert
+        self._objects[key] = obj
+        if self.kind == "pods":
+            if bool(obj.get("nodeName")) != bool(
+                    old.get("nodeName") if old else False):
+                self._bound += 1 if obj.get("nodeName") else -1
+            refs = wire_selector_source(obj)
+            had = wire_selector_source(old) if old is not None else False
+            if refs != had:
+                self.selector_refs += 1 if refs else -1
+
+    def reinstall(self, objects: List[dict], rv: int,
+                  ring: Optional[List[Tuple[int, dict, bytes]]] = None) -> None:
+        """Replace the whole cache (recovery seed / snapshot install).
+        Caller holds the server's broadcast lock."""
+        with self._lock:
+            self._objects = {}
+            self._bound = 0
+            self.selector_refs = 0
+            for obj in objects:
+                self._apply_object("ADDED", obj)
+            self._ring.clear()
+            for entry in ring or ():
+                self._ring.append(entry)
+            self.rv = max(rv, self._ring[-1][0] if self._ring else 0)
+
+    # -- reads (own lock ONLY; never under the server's _write_lock) --------
+
+    def list_wire(self) -> List[dict]:
+        with self._lock:
+            self.hits += 1
+            return list(self._objects.values())
+
+    def get(self, key: str) -> Optional[dict]:
+        with self._lock:
+            return self._objects.get(key)
+
+    def get_many(self, keys) -> List[dict]:
+        with self._lock:
+            self.hits += 1
+            return [self._objects[k] for k in keys if k in self._objects]
+
+    def read_summary(self) -> dict:
+        with self._lock:
+            self.hits += 1
+            return {"total": len(self._objects), "bound": self._bound,
+                    "rv": self.rv}
+
+    def events_since(self, since: int) -> Optional[List[tuple]]:
+        """The (rv, event, data) tail with rv > ``since`` — the RESUME
+        replay. None when the ring no longer covers ``since`` (too old:
+        the 410 Gone analogue; the caller re-lists)."""
+        with self._lock:
+            if since == self.rv:
+                self.resumes += 1
+                return []
+            if self._ring and self._ring[0][0] <= since + 1:
+                self.resumes += 1
+                return [e for e in self._ring if e[0] > since]
+            self.too_old += 1
+            return None
+
+    def render_resources(self) -> str:
+        """`/metrics/resources` (kube_pod_resource_request) straight from
+        the wire snapshot — the read that used to re-encode the store."""
+        with self._lock:
+            self.hits += 1
+            objs = list(self._objects.values())
+        lines = list(RESOURCE_METRICS_HEADER)
+        for obj in objs:
+            req = obj.get("requests") or {}
+            lines.extend(resource_request_lines(
+                obj.get("namespace", "default"), obj.get("name", ""),
+                obj.get("nodeName") or "",
+                int(req.get("cpu", 0)), float(req.get("memory", 0)),
+                req.get("scalar") or {}))
+        return "\n".join(lines) + "\n"
+
+
+class ShardFilter:
+    """Per-watch-stream shard filter state (pods kind only).
+
+    ``route`` decides, per committed event, what this stream receives:
+    the full event, a slim projection, an upgrade burst, or nothing.
+    Runs on the fanout path under the server's broadcast lock (so the
+    decision sequence is commit order), but does no socket I/O — it only
+    enqueues onto the stream's bounded-work queue."""
+
+    def __init__(self, index: int, count: int):
+        if count < 1 or not 0 <= index < count:
+            # Never coerce: a filter naming no real slot would slim every
+            # pod, including the stream owner's own.
+            raise ValueError(f"invalid shard spec {index}/{count}")
+        self.index = index
+        self.count = count
+        # uid -> last slim projection delivered (suppression + upgrades)
+        self._slimmed: Dict[str, dict] = {}
+
+    def spec(self) -> str:
+        return f"{self.index}/{self.count}"
+
+    def prime(self, cache: WatchCache) -> None:
+        """RESUME attach: the previous connection's slim set died with it.
+        Seed it with every live pod this filter WOULD slim, so a later
+        selector transition still upgrades pods slimmed before the
+        reconnect. (Only reachable while selector_refs == 0 — a
+        selector-ful cluster refuses filtered RESUME entirely.)"""
+        with cache._lock:
+            objs = list(cache._objects.values())
+        for obj in objs:
+            if wire_plain(obj) and shard_of_wire(obj, self.count) != self.index:
+                self._slimmed[obj["uid"]] = slim_object(obj)
+
+    def route(self, event: dict, data: bytes, cache: WatchCache,
+              memo: Optional[dict] = None) -> Tuple[List[object], int, int]:
+        """-> (events to deliver, slim_count, filtered_out_count). Each
+        delivered item is either encoded bytes or a lazy ("MODIFIED",
+        wire_obj) upgrade marker — resolve with ``encode_stream_item``
+        on the consumer side, outside the broadcast lock.
+
+        ``memo`` is a per-EVENT scratch dict the fanout loop shares
+        across its filtered streams: the slim projection and its encoded
+        line are identical for every stream that slims the event, so
+        only the first stream pays the dict build + json encode (the
+        loop runs under the server's broadcast lock). Projections are
+        therefore treated as IMMUTABLE once built — updates replace the
+        `_slimmed` entry, never mutate it."""
+        typ = event.get("type")
+        obj = event.get("object")
+        if typ == "BOUND":
+            # Already the slim-est wire there is; keep the filter's
+            # projection current so a later MODIFIED diffs correctly
+            # (copy-on-write: the projection may be memo-shared).
+            uid = obj.get("uid", "") if obj else ""
+            prev = self._slimmed.get(uid)
+            if prev is not None:
+                node = obj.get("nodeName", "")
+                self._slimmed[uid] = dict(
+                    prev, nodeName=node,
+                    phase="Running" if node else "Pending")
+            return [data], 0, 0
+        if typ not in ("ADDED", "MODIFIED", "DELETED") or obj is None:
+            return [data], 0, 0  # markers/control events pass through
+        out: List[object] = []
+        if cache.selector_refs > 0 and self._slimmed:
+            # Selector transition: a live pod now matches others by label,
+            # so labels (even absent ones) became wire-relevant. Upgrade
+            # everything this stream slimmed with full rv-less MODIFIEDs
+            # (rv-less: the client's resume watermark must not move).
+            # The burst runs on the fanout path with the server's
+            # broadcast lock held, so it must stay O(slimmed) dict work:
+            # ONE cache-lock pass collects the wire dicts (stable —
+            # note_event is copy-on-write) and the json encode is
+            # deferred to the stream's consumer thread via lazy
+            # ("MODIFIED", obj) markers — encoding thousands of full pod
+            # wires under the broadcast lock would stall every bind.
+            cur_uid = obj.get("uid")
+            with cache._lock:
+                fulls = [cache._objects[u] for u in self._slimmed
+                         if u != cur_uid and u in cache._objects]
+            out.extend(("MODIFIED", full) for full in fulls)
+            self._slimmed.clear()
+        if (cache.selector_refs > 0 or not wire_plain(obj)
+                or shard_of_wire(obj, self.count) == self.index):
+            out.append(data)
+            self._slimmed.pop(obj.get("uid", ""), None)
+            return out, 0, 0
+        # Foreign plain pod in a selector-free cluster: slim it. The
+        # projection + encoded line are event-level facts — memo-shared
+        # across every filtered stream in this fanout.
+        if memo is None:
+            memo = {}
+        slim = memo.get("slim")
+        if slim is None:
+            slim = memo["slim"] = slim_object(obj)
+        if typ == "DELETED":
+            self._slimmed.pop(obj["uid"], None)
+        else:
+            prev = self._slimmed.get(obj["uid"])
+            if typ == "MODIFIED" and prev == slim:
+                # Projection unchanged (e.g. a foreign gate lift): this
+                # watcher's view of a slim pod depends only on the
+                # projection — drop the event entirely.
+                return out, 0, 1
+            self._slimmed[obj["uid"]] = slim
+        sdata = memo.get("data")
+        if sdata is None:
+            ev = {k: v for k, v in event.items() if k != "object"}
+            ev["object"] = slim
+            sdata = memo["data"] = (json.dumps(ev) + "\n").encode()
+        out.append(sdata)
+        return out, 1, 0

@@ -7,8 +7,14 @@ A follower is a full :class:`~..core.apiserver.APIServer` in
 - **tail**: long-lived ``GET /replication/wal?from=<seq>&epoch=<E>``
   stream; every received frame replays through ``APIServer.apply_frame``
   (local WAL append first, then store upsert, then local watch fanout —
-  the leader's own write ordering). Heartbeats (``HB``) carry the leader's
-  head seq, which feeds the ``apiserver_replication_lag_records`` gauge.
+  the leader's own write ordering). The fanout is the shared
+  ``_fan_event`` path, so the follower's watch-cache read plane
+  (core/watchcache.py: LIST/summary/``/metrics/resources``/RESUME ring)
+  and its shard-FILTERED watch streams stay converged in the shared rv
+  space — clients keep slim-filtered streams across replica switches and
+  across this replica's own promotion, with zero re-lists. Heartbeats
+  (``HB``) carry the leader's head seq, which feeds the
+  ``apiserver_replication_lag_records`` gauge.
 - **bootstrap**: a cold follower (or one the ship window no longer
   covers — 410 ``ResyncRequired``) installs ``GET /replication/snapshot``
   and re-tails from the snapshot's seq. Local WAL recovery

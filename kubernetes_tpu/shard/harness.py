@@ -55,15 +55,15 @@ def _call(base: str, method: str, path: str, body=None, timeout: float = 30):
     return client.call(method, path, body, timeout=timeout)
 
 
-def scrape_histogram(base: str, name: str) -> Optional[dict]:
-    """GET /metrics → one histogram's merged bucket table across all label
+def scrape_histogram(base: str, name: str,
+                     text: Optional[str] = None) -> Optional[dict]:
+    """One histogram's merged bucket table across all label
     sets: {"buckets": [(le, cumulative_count)...], "count": n, "sum": s}.
     None when the series is absent. Lets the harness compute cross-shard
     p50/p99 by summing per-shard cumulative buckets (bucket bounds are
     identical — one metrics.py declaration)."""
-    req = urlrequest.Request(base + "/metrics")
-    with urlrequest.urlopen(req, timeout=30) as resp:
-        text = resp.read().decode()
+    if text is None:
+        text = _fetch_metrics(base)
     buckets: Dict[float, float] = {}
     count = total = 0.0
     seen = False
@@ -123,11 +123,37 @@ def histogram_percentile(hist: dict, q: float) -> float:
     return prev_le
 
 
-def scrape_metrics(base: str) -> Dict[str, float]:
-    """GET /metrics → {series name: value}, label sets summed per name."""
+def _fetch_metrics(base: str) -> str:
+    """GET /metrics once; every parser below accepts the fetched text so a
+    multi-way scrape (totals + histogram + labeled) costs ONE round trip."""
     req = urlrequest.Request(base + "/metrics")
     with urlrequest.urlopen(req, timeout=30) as resp:
-        text = resp.read().decode()
+        return resp.read().decode()
+
+
+def scrape_labeled(base: str, name: str, label: str,
+                   text: Optional[str] = None) -> Dict[str, float]:
+    """One series' per-label-value breakdown, e.g.
+    scrape_labeled(url, "scheduler_watch_decoded_events", "form") ->
+    {"full": n, "slim": m} (scrape_metrics sums label sets away)."""
+    if text is None:
+        text = _fetch_metrics(base)
+    out: Dict[str, float] = {}
+    pat = re.compile(rf'{name}{{.*?{label}="([^"]+)".*?}} (\S+)')
+    for line in text.splitlines():
+        m = pat.match(line)
+        if m is not None:
+            try:
+                out[m.group(1)] = out.get(m.group(1), 0.0) + float(m.group(2))
+            except ValueError:
+                continue
+    return out
+
+
+def scrape_metrics(base: str, text: Optional[str] = None) -> Dict[str, float]:
+    """{series name: value}, label sets summed per name."""
+    if text is None:
+        text = _fetch_metrics(base)
     out: Dict[str, float] = {}
     for line in text.splitlines():
         if not line or line.startswith("#"):
@@ -353,6 +379,31 @@ def run_sharded_cluster(
             return [pod_to_wire(proto.clone_from_template(f"{prefix}-{i}"))
                     for i in range(n)]
 
+        # Follower-served reads (watch-cache read plane): progress polls go
+        # to the FOLLOWER replicas when the plane has them — the leader's
+        # cycles belong to the write plane. Each replica's watch cache
+        # serves the summary under its own lock in the shared rv space;
+        # `read_counts` proves where the reads actually landed.
+        read_counts = {"leader": 0, "follower": 0}
+        poll_bases = cluster.follower_urls or [base]
+        poll_state = {"i": 0}
+
+        def poll_summary() -> dict:
+            for _ in range(len(poll_bases) + 1):
+                url = poll_bases[poll_state["i"] % len(poll_bases)]
+                poll_state["i"] += 1
+                try:
+                    s = _call(url, "GET", "/api/v1/pods?summary=true",
+                              timeout=60)
+                    read_counts["follower" if url != base else "leader"] += 1
+                    return s
+                except Exception:  # noqa: BLE001 - replica down: try next
+                    continue
+            # every follower unreachable: the leader still answers
+            s = _call(base, "GET", "/api/v1/pods?summary=true", timeout=60)
+            read_counts["leader"] += 1
+            return s
+
         def wait_bound(target: int, deadline: float,
                        cb: Optional[Callable] = None) -> int:
             bound = 0
@@ -361,9 +412,7 @@ def run_sharded_cluster(
                 # full pod list — at 10k pods a full-list poll costs the
                 # control plane more CPU than the binds themselves, CPU the
                 # shard schedulers need on a small box.
-                s = _call(base, "GET", "/api/v1/pods?summary=true",
-                          timeout=60)
-                bound = s["bound"]
+                bound = poll_summary()["bound"]
                 if cb is not None:
                     cb(bound)
                 if bound >= target:
@@ -395,14 +444,41 @@ def run_sharded_cluster(
         bound = {p["uid"]: p["nodeName"] for p in pods if p["nodeName"]}
         shard_metrics = []
         e2e_hists = []
+        watch_decode = []
         for url in cluster.alive_shard_urls():
             try:
-                shard_metrics.append(scrape_metrics(url))
+                text = _fetch_metrics(url)  # one GET, parsed three ways
+                shard_metrics.append(scrape_metrics(url, text=text))
                 e2e_hists.append(scrape_histogram(
-                    url, "scheduler_e2e_scheduling_duration_seconds"))
+                    url, "scheduler_e2e_scheduling_duration_seconds",
+                    text=text))
+                # Per-shard decoded events/bytes by wire form — the
+                # measurable 1/N of the shard-filtered watch plane.
+                watch_decode.append({
+                    "events": scrape_labeled(
+                        url, "scheduler_watch_decoded_events", "form",
+                        text=text),
+                    "bytes": scrape_labeled(
+                        url, "scheduler_watch_decoded_bytes", "form",
+                        text=text)})
             except Exception:  # noqa: BLE001 - a killed shard has no /metrics
                 shard_metrics.append({})
+                watch_decode.append({})
         api_metrics = scrape_metrics(base)
+        # Follower-served /metrics/resources: one scrape off a follower
+        # replica proves the per-pod resource read plane serves away from
+        # the leader (the same watch-cache snapshot, shared rv space).
+        resource_series = None
+        try:
+            req = urlrequest.Request(
+                (cluster.follower_urls[0] if cluster.follower_urls else base)
+                + "/metrics/resources")
+            with urlrequest.urlopen(req, timeout=30) as resp:
+                resource_series = sum(
+                    1 for ln in resp.read().decode().splitlines()
+                    if ln.startswith("kube_pod_resource_request{"))
+        except Exception:  # noqa: BLE001 - replica down mid-teardown
+            pass
         # Cross-shard e2e latency truth (queue admission -> bound): merged
         # cumulative buckets, the p50/p99 bench.py --shards reports.
         e2e = merge_histograms(e2e_hists)
@@ -428,6 +504,10 @@ def run_sharded_cluster(
                             "apiserver_replication_lag_records", 0)),
                         "failovers": int(rm.get(
                             "apiserver_failover_total", 0)),
+                        # reads THIS replica's watch cache served — the
+                        # counter proving follower-served polls landed here
+                        "cacheHits": int(rm.get(
+                            "apiserver_watch_cache_hits_total", 0)),
                     })
                 except Exception:  # noqa: BLE001 - replica down
                     replication.append({"url": url, "role": -1})
@@ -451,9 +531,15 @@ def run_sharded_cluster(
             "killed_shards": list(cluster.killed),
             "e2e_ms": e2e_ms,
             "flightrec_dir": flightrec_dir,
+            # Where the progress/summary reads landed (follower-served read
+            # plane) + one follower /metrics/resources scrape's series count.
+            "read_plane": dict(read_counts,
+                               resource_series=resource_series),
+            "watch_decode": watch_decode,
             "api": {k: v for k, v in api_metrics.items()
                     if "conflict" in k or "lease" in k
-                    or "replication" in k or "failover" in k},
+                    or "replication" in k or "failover" in k
+                    or "watch" in k},
             "shard_metrics": [
                 {k: v for k, v in sm.items()
                  if k.startswith(("scheduler_shard_",

@@ -183,10 +183,26 @@ class ShardMember:
         anywhere else), its BOUND pods are in the store, and everything
         still pending re-enters here."""
         s = self.scheduler
+        # Slim-projection hydration (core/watchcache.py): the watch stream's
+        # shard filter is static (`shard=i/n` — this member's OWN slot), so
+        # an adopted range's pods arrived as slim projections without their
+        # real spec. Fetch the full wire in bulk BEFORE enqueueing; a pod
+        # whose hydration fails stays out this sweep (the next tick — or
+        # the per-event hydration in _on_pod_event — retries).
+        stale = [p.uid for p in list(s.clientset.pods.values())
+                 if getattr(p, "wire_slim", False) and not p.node_name
+                 and p.deletion_ts is None and self.admits(p)]
+        if stale and hasattr(s.clientset, "hydrate_pods"):
+            try:
+                s.clientset.hydrate_pods(stale)
+            except Exception:  # noqa: BLE001 - transient API failure
+                pass
         added = 0
         for pod in list(s.clientset.pods.values()):
             if pod.node_name or pod.deletion_ts is not None:
                 continue
+            if getattr(pod, "wire_slim", False):
+                continue  # hydration failed: never schedule a projection
             if not s._responsible_for_pod(pod) or not self.admits(pod):
                 continue
             if pod.uid in s.cache.pod_states or s.queue.has_entity(pod.uid):

@@ -304,7 +304,9 @@ def test_metric_name_parity_with_reference():
                      "scheduler_bind_conflict_total",
                      "scheduler_shard_owned_shards",
                      "scheduler_shard_lease_renewals_total",
-                     "scheduler_shard_adoptions_total"}, extra
+                     "scheduler_shard_adoptions_total",
+                     "scheduler_watch_decoded_events",
+                     "scheduler_watch_decoded_bytes"}, extra
 
 
 def test_new_series_populate_during_scheduling():

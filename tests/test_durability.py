@@ -248,8 +248,12 @@ def test_nomination_status_patch_survives_restart(tmp_path):
 
     api2 = APIServer(data_dir=d)
     assert api2.store.pods[pod.uid].nominated_node_name == "n0"
-    # rv-less STATUS records replay into the store but never the backlog
-    assert all(rv is not None for rv, _ in api2._backlog["pods"])
+    # rv-less STATUS records replay into the store (and the watch-cache
+    # object snapshot) but never the resume ring
+    assert all(rv is not None
+               for rv, _e, _d in api2.watch_cache["pods"]._ring)
+    assert api2.watch_cache["pods"].get(
+        pod.uid)["nominatedNodeName"] == "n0"
     api2.shutdown()
 
 

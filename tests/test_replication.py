@@ -798,3 +798,44 @@ def test_follower_watch_survives_promotion_without_relist(tmp_path):
         plane.tail.stop()
         plane.lease.stop()
         plane.follower.shutdown()
+
+
+def test_filtered_stream_resumes_across_promotion_without_relist(tmp_path):
+    """Watch-cache read plane on followers: a shard's FILTERED stream
+    (?shard=i/n, core/watchcache.py) rides a leader kill -> promotion with
+    zero re-lists — the follower's watch cache was maintained from applied
+    frames in the shared rv space, so the reconnect RESUMEs and keeps
+    slimming, and post-promotion events keep flowing filtered."""
+    plane = _Plane(tmp_path, lease=0.5)
+    cs = None
+    try:
+        leader, follower = plane.leader, plane.follower
+        cs = HTTPClientset(follower.advertise_url, shard=(0, 2))
+        cs.create_node(_node("n0"))
+        for i in range(20):
+            cs.create_pod(_pod(f"p{i}"))
+        assert _wait(lambda: len(cs.pods) == 20)
+        assert cs.watch_events_slim > 0          # filter engaged pre-kill
+        slim_before = cs.watch_events_slim
+        relists = dict(cs.relists)
+        leader.shutdown()
+        assert _wait(lambda: follower.role == "leader", timeout=15)
+        assert _wait(lambda: cs.failover_count >= 1)
+        # post-promotion writes keep flowing through the SAME filtered
+        # stream; foreign plain pods still arrive slim
+        for i in range(10):
+            cs.create_pod(_pod(f"post{i}"))
+        assert _wait(lambda: len(cs.pods) == 30)
+        assert dict(cs.relists) == relists       # ZERO re-lists
+        assert cs.watch_events_slim > slim_before
+        # the promoted replica's cache serves the read plane too
+        import urllib.request as _rq
+        with _rq.urlopen(follower.advertise_url
+                         + "/api/v1/pods?summary=true", timeout=5) as r:
+            assert json.loads(r.read())["total"] == 30
+    finally:
+        if cs is not None:
+            cs.close()
+        plane.tail.stop()
+        plane.lease.stop()
+        plane.follower.shutdown()

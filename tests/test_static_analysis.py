@@ -298,6 +298,78 @@ class TestReplicationLockFixtures:
 
 
 # ---------------------------------------------------------------------------
+# fixture corpus: lock-discipline — watch-cache read plane (PR 10)
+# ---------------------------------------------------------------------------
+
+
+BAD_WATCHCACHE = textwrap.dedent("""
+    class Server:
+        def do_summary(self):
+            with self._write_lock:                       # read plane must
+                return self.watch_cache["pods"].read_summary()  # not be here
+        def do_list(self):
+            with self._write_lock:
+                return self.watch_cache["pods"].list_wire()
+        def _broadcast(self, event):
+            with self._lock:
+                self.watch_cache["pods"].note_event(1, "ADDED", event)
+                self._repl_append(event)                 # append AFTER cache
+        def _recover_seed(self, objs):
+            self.watch_cache["pods"].reinstall(objs, 0)  # outside the lock
+""")
+
+GOOD_WATCHCACHE = textwrap.dedent("""
+    class Server:
+        def do_summary(self):
+            return self.watch_cache["pods"].read_summary()   # own lock only
+        def do_resources(self):
+            return self.watch_cache["pods"].render_resources()
+        def _broadcast(self, event):
+            with self._lock:
+                self._repl_append(event)                 # durable first...
+                self._fan_event("pods", event, b"")      # ...then cache+fan
+        def _fan_event(self, kind, event, data):
+            self.watch_cache[kind].note_event(1, "ADDED", event)  # primitive
+            for w in self._watchers[kind]:
+                w.q.put(data)
+""")
+
+
+class TestWatchCacheLockFixtures:
+    def test_flags_watchcache_violations(self):
+        fs = check_source(checker_by_id("lock-discipline"), BAD_WATCHCACHE)
+        assert _rules(fs) == ["no-read-serving-under-write-lock"]
+        # two reads under the write lock + the mutation-before-append +
+        # the unlocked reinstall are each individually flagged
+        assert len(fs) == 4
+
+    def test_passes_disciplined_watchcache(self):
+        """The fanout primitive owns the raw note_event (caller-holds-lock
+        contract, enforced at its call sites) — the real apiserver shape
+        passes clean."""
+        assert check_source(checker_by_id("lock-discipline"),
+                            GOOD_WATCHCACHE) == []
+
+    def test_fan_event_call_outside_lock_flagged(self):
+        bad = textwrap.dedent("""
+            class Server:
+                def _broadcast(self, event):
+                    with self._lock:
+                        self._repl_append(event)
+                    self._fan_event("pods", event, b"")  # lock released!
+                def _fan_event(self, kind, event, data):
+                    self.watch_cache[kind].note_event(1, "ADDED", event)
+        """)
+        fs = check_source(checker_by_id("lock-discipline"), bad)
+        assert "no-read-serving-under-write-lock" in _rules(fs)
+
+    def test_scope_covers_watchcache_module(self):
+        c = checker_by_id("lock-discipline")
+        assert c.applies_to("core/watchcache.py")
+        assert c.applies_to("kubernetes_tpu/core/watchcache.py")
+
+
+# ---------------------------------------------------------------------------
 # fixture corpus: jit-purity
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,662 @@
+"""Watch-cache read plane + shard-filtered watch streams
+(kubernetes_tpu/core/watchcache.py; docs/SHARDING.md levers).
+
+Covers: ring interval replay / wraparound / 410-too-old fallback units;
+cache-served LIST / summary / uid-hydration / `/metrics/resources`;
+the filtered-stream equivalence fuzz (a shard member's scheduler cache
+after a MixedChurn run over a filtered stream is identical to an
+unfiltered oracle's, including affinity/spread/ports foreign pods and the
+selector-transition upgrade path); the ~1/N decoded-full-event assertion;
+slim-event suppression; and adoption hydration end to end."""
+
+import json
+import random
+import threading
+import time
+import zlib
+from urllib import request as urlrequest
+
+import pytest
+
+from kubernetes_tpu.core import Scheduler
+from kubernetes_tpu.core.apiserver import (
+    APIServer,
+    HTTPClientset,
+    pod_to_wire,
+)
+from kubernetes_tpu.core.watchcache import (
+    ShardFilter,
+    WatchCache,
+    pod_from_slim,
+    shard_of_wire,
+    slim_object,
+    wire_plain,
+)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+# ---------------------------------------------------------------------------
+# WatchCache units: interval replay, wraparound, too-old, read surfaces
+# ---------------------------------------------------------------------------
+
+
+def _ev(rv, typ, obj):
+    event = {"type": typ, "object": obj, "rv": rv}
+    return rv, typ, obj, (json.dumps(event) + "\n").encode(), event
+
+
+class TestWatchCacheUnits:
+    def _pod_wire(self, i, node=""):
+        p = make_pod().name(f"p{i}").req({"cpu": "100m"}).obj()
+        w = pod_to_wire(p)
+        w["nodeName"] = node
+        return w
+
+    def test_interval_replay_exact_tail(self):
+        wc = WatchCache("pods", capacity=16)
+        for i in range(1, 9):
+            rv, typ, obj, data, event = _ev(i, "ADDED", self._pod_wire(i))
+            wc.note_event(rv, typ, obj, data=data, event=event)
+        tail = wc.events_since(5)
+        assert [rv for rv, _e, _d in tail] == [6, 7, 8]
+        assert wc.events_since(8) == []          # fully caught up
+        assert wc.resumes == 2
+
+    def test_ring_wraparound_drops_oldest(self):
+        wc = WatchCache("pods", capacity=4)
+        for i in range(1, 11):
+            rv, typ, obj, data, event = _ev(i, "ADDED", self._pod_wire(i))
+            wc.note_event(rv, typ, obj, data=data, event=event)
+        # window is [7..10]: rv 6 still replays (ring head 7 <= 6+1)
+        assert [rv for rv, _e, _d in wc.events_since(6)] == [7, 8, 9, 10]
+        # ...but the OBJECT snapshot kept everything
+        assert len(wc.list_wire()) == 10
+
+    def test_too_old_resume_answers_none(self):
+        wc = WatchCache("pods", capacity=4)
+        for i in range(1, 11):
+            rv, typ, obj, data, event = _ev(i, "ADDED", self._pod_wire(i))
+            wc.note_event(rv, typ, obj, data=data, event=event)
+        assert wc.events_since(3) is None        # 410 Gone analogue
+        assert wc.too_old == 1
+
+    def test_summary_and_bound_tracking(self):
+        wc = WatchCache("pods")
+        w1, w2 = self._pod_wire(1), self._pod_wire(2)
+        wc.note_event(1, "ADDED", w1)
+        wc.note_event(2, "ADDED", w2)
+        wc.note_event(3, "BOUND", {"uid": w1["uid"], "nodeName": "n0"})
+        s = wc.read_summary()
+        assert (s["total"], s["bound"]) == (2, 1)
+        wc.note_event(4, "DELETED", dict(w1, nodeName="n0"))
+        s = wc.read_summary()
+        assert (s["total"], s["bound"]) == (1, 0)
+
+    def test_bound_event_is_copy_on_write(self):
+        """A handed-out list_wire() dict must not mutate under a later
+        BOUND (readers render outside every lock)."""
+        wc = WatchCache("pods")
+        w = self._pod_wire(1)
+        wc.note_event(1, "ADDED", w)
+        snap = wc.list_wire()[0]
+        wc.note_event(2, "BOUND", {"uid": w["uid"], "nodeName": "n3"})
+        assert snap["nodeName"] == ""
+        assert wc.get(w["uid"])["nodeName"] == "n3"
+
+    def test_render_resources_from_snapshot(self):
+        wc = WatchCache("pods")
+        w = self._pod_wire(1, node="n7")
+        wc.note_event(1, "ADDED", w)
+        text = wc.render_resources()
+        assert 'node="n7"' in text and 'phase="Running"' in text
+        assert 'resource="cpu"' in text
+
+
+# ---------------------------------------------------------------------------
+# slim wire helpers
+# ---------------------------------------------------------------------------
+
+
+class TestSlimWire:
+    def test_wire_partition_agrees_with_object_partition(self):
+        """The server-side filter and a member's admission predicate MUST
+        compute the same shard for every pod (incl. gang pinning) — an
+        owned pod arriving slim would be scheduled from a projection."""
+        from kubernetes_tpu.shard.partition import shard_of_pod
+        for i in range(64):
+            p = make_pod().name(f"x{i}").namespace(f"ns{i % 3}").obj()
+            if i % 4 == 0:
+                p.pod_group = f"g{i % 5}"
+            assert shard_of_wire(pod_to_wire(p), 3) == shard_of_pod(p, 3)
+
+    def test_wire_plain_classification(self):
+        plain = pod_to_wire(make_pod().name("a").req({"cpu": "1"}).obj())
+        ports = pod_to_wire(make_pod().name("b").host_port(80).obj())
+        spread = pod_to_wire(make_pod().name("c")
+                             .spread_constraint(1, "zone").obj())
+        aff = pod_to_wire(make_pod().name("d")
+                          .pod_affinity("zone", {"app": "x"}).obj())
+        naff = pod_to_wire(make_pod().name("e")
+                           .node_affinity_in("k", ["v"]).obj())
+        assert wire_plain(plain) and wire_plain(naff)
+        assert not wire_plain(ports)
+        assert not wire_plain(spread)
+        assert not wire_plain(aff)
+
+    def test_slim_projection_roundtrip(self):
+        p = (make_pod().name("s").namespace("ns1").req({"cpu": "250m"})
+             .priority(7).labels({"app": "x"}).obj())
+        p.pod_group = "g1"
+        slim = slim_object(pod_to_wire(p))
+        got = pod_from_slim(slim)
+        assert got.uid == p.uid and got.namespace == "ns1"
+        assert got.pod_group == "g1" and got.priority == 7
+        assert got.resource_request().milli_cpu == 250
+        assert got.wire_slim and got.labels == {}
+
+    def test_slim_merge_keeps_full_spec(self):
+        p = make_pod().name("m").req({"cpu": "1"}).labels({"a": "b"}).obj()
+        slim = slim_object(dict(pod_to_wire(p), nodeName="n1"))
+        merged = pod_from_slim(slim, old=p)
+        assert merged.node_name == "n1"
+        assert merged.labels == {"a": "b"}          # spec kept
+        assert not getattr(merged, "wire_slim", False)
+
+
+# ---------------------------------------------------------------------------
+# server fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def api():
+    server = APIServer()
+    port = server.serve(0)
+    try:
+        yield server, f"http://127.0.0.1:{port}"
+    finally:
+        server.shutdown()
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _wait_rv(api_server, clients, timeout=15.0):
+    """Every client's pod/node watermark reached the server's rv and its
+    scheduler inbox (if any) can drain deterministically."""
+    def caught_up():
+        for c in clients:
+            for kind in ("pods", "nodes"):
+                if (c._last_rv[kind] or 0) < api_server._seq[kind]:
+                    return False
+        return True
+    _wait(caught_up, timeout, "watch streams to catch up")
+
+
+# ---------------------------------------------------------------------------
+# read plane over HTTP: cache-served LIST/summary/uids/resources + 410
+# ---------------------------------------------------------------------------
+
+
+class TestReadPlane:
+    def test_list_summary_resources_served_from_cache(self, api):
+        server, base = api
+        server.store.create_node(make_node().name("n0").capacity(
+            {"cpu": 8, "memory": "32Gi", "pods": 20}).obj())
+        pods = [make_pod().name(f"p{i}").req({"cpu": "100m"}).obj()
+                for i in range(5)]
+        for p in pods:
+            server.store.create_pod(p)
+        server._bind_one(pods[0].uid, "n0")
+        hits0 = server.watch_cache["pods"].hits
+
+        def get(path):
+            with urlrequest.urlopen(base + path, timeout=10) as r:
+                return r.read().decode()
+
+        lst = json.loads(get("/api/v1/pods"))
+        assert len(lst) == 5
+        assert sum(1 for w in lst if w["nodeName"]) == 1
+        s = json.loads(get("/api/v1/pods?summary=true"))
+        assert s == {"total": 5, "bound": 1}
+        sub = json.loads(get(
+            f"/api/v1/pods?uids={pods[1].uid},{pods[2].uid}"))
+        assert {w["uid"] for w in sub} == {pods[1].uid, pods[2].uid}
+        res = get("/metrics/resources")
+        assert "kube_pod_resource_request" in res and 'node="n0"' in res
+        assert server.watch_cache["pods"].hits >= hits0 + 4
+        metrics = get("/metrics")
+        assert "apiserver_watch_cache_hits_total" in metrics
+        assert "apiserver_watch_events_slim_total" in metrics
+
+    def test_too_old_reconnect_falls_back_to_relist(self):
+        server = APIServer(backlog=8)
+        port = server.serve(0)
+        try:
+            base = f"http://127.0.0.1:{port}"
+            for i in range(4):
+                server.store.create_pod(
+                    make_pod().name(f"p{i}").req({"cpu": "1m"}).obj())
+            cs = HTTPClientset(base)
+            try:
+                _wait_rv(server, [cs])
+                # stall the reflector by killing its stream, then overflow
+                # the ring while it is away
+                for conn in list(cs._responses):
+                    from kubernetes_tpu.core.apiserver import _shutdown_conn
+                    _shutdown_conn(conn)
+                for i in range(20):
+                    server.store.create_pod(
+                        make_pod().name(f"q{i}").req({"cpu": "1m"}).obj())
+                _wait(lambda: len(cs.pods) == 24, msg="post-overflow sync")
+                assert cs.relists["pods"] >= 2      # 410 -> Replace ran
+                assert server.watch_cache["pods"].too_old >= 1
+            finally:
+                cs.close()
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# filtered streams: 1/N decode, suppression, equivalence fuzz, adoption
+# ---------------------------------------------------------------------------
+
+
+def _owned(uid, index, count=2):
+    return zlib.crc32(uid.encode()) % count == index
+
+
+class TestShardFilteredStreams:
+    def test_decoded_full_events_drop_to_half(self, api):
+        """The acceptance 1/N: with 2 shards, each filtered stream decodes
+        ~half the pods full and the rest slim; the unfiltered baseline
+        decodes everything full."""
+        server, base = api
+        server.store.create_node(make_node().name("n0").capacity(
+            {"cpu": 64, "memory": "256Gi", "pods": 400}).obj())
+        n = 200
+        for i in range(n):
+            server.store.create_pod(
+                make_pod().name(f"p{i}").req({"cpu": "10m"})
+                .labels({"app": "bench"}).obj())
+        oracle = HTTPClientset(base)
+        f0 = HTTPClientset(base, shard=(0, 2))
+        f1 = HTTPClientset(base, shard=(1, 2))
+        try:
+            _wait_rv(server, [oracle, f0, f1])
+            assert oracle.watch_events_full == n + 1  # pods + the node
+            assert oracle.watch_events_slim == 0
+            for c in (f0, f1):
+                full_pods = c.watch_events_full - 1   # the node is full
+                assert full_pods + c.watch_events_slim == n
+                assert n * 0.3 < full_pods < n * 0.7, full_pods
+                assert c.watch_bytes_slim < c.watch_bytes_full
+            # the two shards partition the pod set exactly
+            assert (f0.watch_events_full + f1.watch_events_full - 2
+                    == n)
+            assert server.watch_slim_events == (
+                f0.watch_events_slim + f1.watch_events_slim)
+        finally:
+            for c in (oracle, f0, f1):
+                c.close()
+
+    def test_unchanged_slim_modified_is_suppressed(self, api):
+        """A foreign pending pod's spec-only update (gate lift) does not
+        change the slim projection — the filtered stream drops it."""
+        server, base = api
+        pod = (make_pod().name("g").req({"cpu": "1m"})
+               .scheduling_gate("hold").obj())
+        # pick a shard index that does NOT own the pod
+        idx = 1 if _owned(pod.uid, 0) else 0
+        server.store.create_pod(pod)
+        f = HTTPClientset(base, shard=(idx, 2))
+        try:
+            _wait_rv(server, [f])
+            before = f.watch_events_slim + f.watch_events_full
+            dropped0 = server.watch_filtered_events
+            lifted = pod.clone_from_template(pod.name)
+            lifted.uid = pod.uid
+            lifted.scheduling_gates = []
+            server.store.update_pod(lifted)
+            _wait(lambda: server.watch_filtered_events > dropped0,
+                  msg="suppressed event counter")
+            # a marker event proves the stream is live, yet nothing arrived
+            time.sleep(0.2)
+            assert f.watch_events_slim + f.watch_events_full == before
+            assert pod.uid in f.pods
+        finally:
+            f.close()
+
+    def test_mixed_churn_filtered_cache_equals_oracle(self, api):
+        """Equivalence fuzz: drive MixedChurn (plain + affinity + spread +
+        host-port pods across namespaces, server-side binds, node churn,
+        deletes) through one unfiltered and one shard-filtered clientset,
+        each feeding a scheduler's cache; the filtered member's NodeInfo
+        accounting must be identical — including the selector-transition
+        upgrade that re-delivers previously-slim pods full."""
+        server, base = api
+        rng = random.Random(7)
+        for i in range(6):
+            server.store.create_node(
+                make_node().name(f"n{i}")
+                .capacity({"cpu": 64, "memory": "256Gi", "pods": 500})
+                .zone(f"z{i % 3}").obj())
+
+        oracle_cs = HTTPClientset(base)
+        member_cs = HTTPClientset(base, shard=(0, 2))
+        oracle = Scheduler(clientset=oracle_cs)
+        member = Scheduler(clientset=member_cs)
+        member.pod_admission = lambda p: _owned(p.uid, 0)
+        try:
+            live = []
+            # Phase 1: plain pods only (slimming fully engaged)
+            for i in range(60):
+                p = (make_pod().name(f"plain{i}")
+                     .namespace(f"ns{i % 3}")
+                     .req({"cpu": f"{10 + (i % 5) * 10}m",
+                           "memory": "16Mi"})
+                     .labels({"app": f"a{i % 4}"}).obj())
+                server.store.create_pod(p)
+                live.append(p)
+            # bind half server-side (BOUND events -> NodeInfo accounting)
+            for p in rng.sample(live, 30):
+                code, _ = server._bind_one(p.uid, f"n{rng.randrange(6)}")
+                assert code == 200
+            # Phase 2: wire-relevant pods join — ports, spread, affinity
+            special = []
+            for i in range(6):
+                b = make_pod().name(f"port{i}").req({"cpu": "5m"})
+                special.append(b.host_port(9000 + i).obj())
+            for i in range(4):
+                special.append(
+                    make_pod().name(f"spread{i}").req({"cpu": "5m"})
+                    .labels({"app": "a1"})
+                    .spread_constraint(1, "zone",
+                                       match_labels={"app": "a1"}).obj())
+            for i in range(4):
+                special.append(
+                    make_pod().name(f"aff{i}").req({"cpu": "5m"})
+                    .labels({"app": "a2"})
+                    .pod_affinity("zone", {"app": "a2"}).obj())
+            for p in special:
+                server.store.create_pod(p)
+                live.append(p)
+            for p in rng.sample(special, 8):
+                server._bind_one(p.uid, f"n{rng.randrange(6)}")
+            # Phase 3: churn — more plains (now full: selector_refs > 0),
+            # deletes, node updates
+            for i in range(30):
+                p = (make_pod().name(f"late{i}").namespace(f"ns{i % 3}")
+                     .req({"cpu": "20m"}).labels({"app": f"a{i % 4}"}).obj())
+                server.store.create_pod(p)
+                live.append(p)
+                if i % 3 == 0:
+                    server._bind_one(p.uid, f"n{rng.randrange(6)}")
+            for p in rng.sample(live, 15):
+                server.store.delete_pod(p)
+                live.remove(p)
+            for i in range(3):
+                node = server.store.nodes[f"n{i}"]
+                import copy as _copy
+                upd = _copy.deepcopy(node)
+                upd.labels["churn"] = str(i)
+                server.store.update_node(upd)
+
+            _wait_rv(server, [oracle_cs, member_cs])
+            oracle.drain_event_inbox()
+            member.drain_event_inbox()
+
+            assert member_cs.watch_events_slim > 0, "filter never engaged"
+
+            def cache_view(s):
+                out = {}
+                for name, ni in s.cache.nodes.items():
+                    pods = {pi.pod.uid for pi in ni.pods}
+                    req = ni.requested
+                    out[name] = {
+                        "pods": pods,
+                        "cpu": req.milli_cpu,
+                        "mem": req.memory,
+                        "ports": sorted(
+                            (hp.host_port for pi in ni.pods
+                             for hp in pi.pod.host_ports())),
+                        "affinity": sorted(
+                            pi.pod.uid for pi in ni.pods_with_affinity),
+                        # label truth drives spread/affinity matching: must
+                        # survive slimming + the upgrade path
+                        "labels": sorted(
+                            (pi.pod.uid, tuple(sorted(pi.pod.labels.items())))
+                            for pi in ni.pods),
+                    }
+                return out
+
+            ov, mv = cache_view(oracle), cache_view(member)
+            assert ov == mv
+            # informer truth on the projection facts for EVERY live pod
+            assert set(oracle_cs.pods) == set(member_cs.pods)
+            for uid, op in oracle_cs.pods.items():
+                mp = member_cs.pods[uid]
+                assert (op.node_name, op.namespace, op.priority) == \
+                       (mp.node_name, mp.namespace, mp.priority)
+                assert op.resource_request().milli_cpu == \
+                       mp.resource_request().milli_cpu
+        finally:
+            oracle_cs.close()
+            member_cs.close()
+
+    def test_adoption_hydrates_slim_pods_before_scheduling(self, api):
+        """ShardMember adoption: pods of an adopted range arrived slim on
+        this member's static filter — the sweep hydrates the full wire
+        before enqueueing, and per-event hydration covers new arrivals."""
+        from kubernetes_tpu.shard.member import ShardMember
+
+        server, base = api
+        server.store.create_node(make_node().name("n0").capacity(
+            {"cpu": 64, "memory": "256Gi", "pods": 100}).obj())
+        cs = HTTPClientset(base, shard=(0, 2))
+        sched = Scheduler(clientset=cs)
+        member = ShardMember(sched, 0, 2, lease_duration=60.0)
+        try:
+            foreign = []
+            for i in range(30):
+                p = (make_pod().name(f"f{i}").req({"cpu": "10m"})
+                     .node_selector({"zone": "nowhere"}).obj())
+                if not _owned(p.uid, 0):
+                    foreign.append(p)
+                server.store.create_pod(p)
+            _wait_rv(server, [cs])
+            sched.drain_event_inbox()
+            assert foreign and all(
+                getattr(cs.pods[p.uid], "wire_slim", False)
+                for p in foreign)
+            assert not any(sched.queue.has_entity(p.uid) for p in foreign)
+
+            # adopt the peer's range and sweep
+            member.owned = {0, 1}
+            added = member.sweep_pending()
+            assert added == len(foreign)
+            for p in foreign:
+                got = cs.pods[p.uid]
+                assert not getattr(got, "wire_slim", False)
+                # the REAL spec arrived (projection had no nodeSelector)
+                assert got.node_selector == {"zone": "nowhere"}
+                assert sched.queue.has_entity(p.uid)
+
+            # a NEW pod in the adopted range still arrives slim on the
+            # static filter; the per-event path hydrates it on admission
+            newcomers = []
+            while len(newcomers) < 1:
+                p = (make_pod().req({"cpu": "10m"})
+                     .node_selector({"zone": "nowhere"}).obj())
+                if not _owned(p.uid, 0):
+                    newcomers.append(p)
+                    server.store.create_pod(p)
+            _wait_rv(server, [cs])
+            sched.drain_event_inbox()
+            got = cs.pods[newcomers[0].uid]
+            assert not getattr(got, "wire_slim", False)
+            assert got.node_selector == {"zone": "nowhere"}
+            assert sched.queue.has_entity(newcomers[0].uid)
+        finally:
+            member.stop()
+            cs.close()
+
+
+# ---------------------------------------------------------------------------
+# filtered RESUME: across reconnects (and the selector-ful refusal)
+# ---------------------------------------------------------------------------
+
+
+class TestReviewRegressions:
+    def test_scheduler_construction_over_prepopulated_filtered_stream(
+            self, api):
+        """Deadlock regression: constructing a Scheduler over a filtered
+        clientset against a cluster that ALREADY holds pending foreign
+        pods must not hydrate (pod_admission is not attached yet) — the
+        attach-time replay holds _dispatch_lock on this very thread, and
+        hydrate_pods re-acquiring it hung construction forever."""
+        server, base = api
+        for i in range(10):
+            server.store.create_pod(
+                make_pod().name(f"pre{i}").req({"cpu": "1m"}).obj())
+        cs = HTTPClientset(base, shard=(0, 2))
+        try:
+            done = {}
+
+            def build():
+                done["sched"] = Scheduler(clientset=cs)
+
+            t = threading.Thread(target=build, daemon=True)
+            t.start()
+            t.join(timeout=20)
+            assert "sched" in done, "Scheduler construction deadlocked"
+            # foreign pods stayed slim AND unqueued (no shard member yet)
+            slim = [u for u, p in cs.pods.items()
+                    if getattr(p, "wire_slim", False)]
+            assert slim
+            for u in slim:
+                assert not done["sched"].queue.has_entity(u)
+        finally:
+            cs.close()
+
+    def test_resume_replays_projection_delta_missed_while_disconnected(
+            self, api):
+        """Suppression regression: a foreign pod's deletionTs set while
+        the client was disconnected must survive the RESUME replay (prime
+        runs AFTER the replay — priming first made the replayed MODIFIED
+        compare equal to the primed current state and get dropped)."""
+        server, base = api
+        pod = (make_pod().name("d").req({"cpu": "1m"})
+               .obj())
+        pod.finalizers = ["keep"]  # delete parks with deletionTs (update)
+        idx = 1 if _owned(pod.uid, 0) else 0
+        server.store.create_pod(pod)
+        f = HTTPClientset(base, shard=(idx, 2))
+        try:
+            _wait_rv(server, [f])
+            assert f.pods[pod.uid].deletion_ts is None
+            for conn in list(f._responses):
+                from kubernetes_tpu.core.apiserver import _shutdown_conn
+                _shutdown_conn(conn)
+            server.store.delete_pod(pod)   # parks: MODIFIED w/ deletionTs
+            _wait(lambda: f.pods.get(pod.uid) is not None
+                  and f.pods[pod.uid].deletion_ts is not None,
+                  msg="replayed deletionTs delta")
+            assert f.resumes["pods"] >= 1  # it arrived via RESUME replay
+        finally:
+            f.close()
+
+    def test_invalid_shard_spec_is_ignored_not_coerced(self, api):
+        """shard=3/0 or shard=5/2 names no real slot: the server must
+        serve the stream UNFILTERED instead of slimming every pod."""
+        server, base = api
+        for i in range(6):
+            server.store.create_pod(
+                make_pod().name(f"p{i}").req({"cpu": "1m"}).obj())
+        for spec in ("3/0", "5/2", "-1/2", "x/y"):
+            import http.client as hc
+            conn = hc.HTTPConnection(base.split("//")[1], timeout=10)
+            conn.request("GET", f"/api/v1/pods?watch=true&shard={spec}")
+            resp = conn.getresponse()
+            slim_seen = full_seen = 0
+            # read through the SYNC marker
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line or line in (b",", b"\r"):
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("type") == "SYNC":
+                    break
+                obj = ev.get("object") or {}
+                if obj.get("slim"):
+                    slim_seen += 1
+                else:
+                    full_seen += 1
+            conn.close()
+            assert slim_seen == 0, f"spec {spec} slimmed pods"
+            assert full_seen == 6
+        with pytest.raises(ValueError):
+            ShardFilter(3, 0)
+        with pytest.raises(ValueError):
+            ShardFilter(5, 2)
+
+
+class TestFilteredResume:
+    def test_filtered_stream_resumes_by_rv(self, api):
+        server, base = api
+        for i in range(10):
+            server.store.create_pod(
+                make_pod().name(f"p{i}").req({"cpu": "1m"}).obj())
+        f = HTTPClientset(base, shard=(0, 2))
+        try:
+            _wait_rv(server, [f])
+            relists0 = f.relists["pods"]
+            for conn in list(f._responses):
+                from kubernetes_tpu.core.apiserver import _shutdown_conn
+                _shutdown_conn(conn)
+            for i in range(5):
+                server.store.create_pod(
+                    make_pod().name(f"q{i}").req({"cpu": "1m"}).obj())
+            _wait(lambda: len(f.pods) == 15 and f.resumes["pods"] >= 1,
+                  msg="filtered RESUME")
+            assert f.relists["pods"] == relists0    # zero re-lists
+            assert server.watch_cache["pods"].resumes >= 1
+        finally:
+            f.close()
+
+    def test_selector_ful_cluster_refuses_filtered_resume(self, api):
+        """With live selector sources the per-stream slim set cannot be
+        reconstructed: a filtered reconnect re-lists instead of silently
+        resuming into an un-upgradable state."""
+        server, base = api
+        server.store.create_pod(
+            make_pod().name("s").req({"cpu": "1m"})
+            .spread_constraint(1, "zone").obj())
+        for i in range(5):
+            server.store.create_pod(
+                make_pod().name(f"p{i}").req({"cpu": "1m"}).obj())
+        f = HTTPClientset(base, shard=(0, 2))
+        try:
+            _wait_rv(server, [f])
+            relists0 = f.relists["pods"]
+            for conn in list(f._responses):
+                from kubernetes_tpu.core.apiserver import _shutdown_conn
+                _shutdown_conn(conn)
+            server.store.create_pod(
+                make_pod().name("x").req({"cpu": "1m"}).obj())
+            _wait(lambda: f.relists["pods"] > relists0,
+                  msg="filtered re-list under selector refs")
+        finally:
+            f.close()
