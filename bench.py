@@ -23,12 +23,12 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_PODS_PER_SEC = 680.0  # SchedulingBasic/5000Nodes_10000Pods
 
 
-def probe(timeout: float = 0.0) -> int:
-    """`python bench.py --probe`: time `jax.devices()` in a SUBPROCESS (the
-    axon tunnel can wedge backend init forever — a hang must trip a timeout,
-    never block the caller) and print one JSON line of backend availability,
-    so each round can cheaply log whether the TPU tunnel is back (VERDICT r5
-    next-item #1). Exit code 0 = a backend answered, 1 = unreachable."""
+def probe_availability(timeout: float = 0.0) -> dict:
+    """Time `jax.devices()` in a SUBPROCESS (the axon tunnel can wedge
+    backend init forever — a hang must trip a timeout, never block the
+    caller) and return the backend-availability dict. `--probe` prints
+    it; the bench mains EMBED it in their detail line so BENCH_*.json
+    trajectories keep the hardware-availability context."""
     timeout = timeout or float(os.environ.get("BENCH_PROBE_TIMEOUT", 60))
     code = ("import jax, json; ds = jax.devices(); "
             "print(json.dumps({'platform': ds[0].platform, "
@@ -38,44 +38,48 @@ def probe(timeout: float = 0.0) -> int:
         out = subprocess.run([sys.executable, "-c", code], timeout=timeout,
                              capture_output=True, text=True, check=True)
         info = json.loads(out.stdout.strip().splitlines()[-1])
-        result = {"available": True, "backend": info["platform"],
-                  "devices": info["count"],
-                  "elapsed_s": round(time.perf_counter() - t0, 2)}
+        return {"available": True, "backend": info["platform"],
+                "devices": info["count"],
+                "elapsed_s": round(time.perf_counter() - t0, 2)}
     except subprocess.TimeoutExpired:
-        result = {"available": False, "backend": "unreachable",
-                  "elapsed_s": round(time.perf_counter() - t0, 2),
-                  "reason": f"jax.devices() hung past {timeout:.0f}s "
-                            "(tunnel wedged?)"}
+        return {"available": False, "backend": "unreachable",
+                "elapsed_s": round(time.perf_counter() - t0, 2),
+                "reason": f"jax.devices() hung past {timeout:.0f}s "
+                          "(tunnel wedged?)"}
     except (subprocess.CalledProcessError, ValueError, IndexError) as e:
         stderr = getattr(e, "stderr", "") or ""
-        result = {"available": False, "backend": "unreachable",
-                  "elapsed_s": round(time.perf_counter() - t0, 2),
-                  "reason": f"backend init failed: {stderr.strip()[-200:]}"}
+        return {"available": False, "backend": "unreachable",
+                "elapsed_s": round(time.perf_counter() - t0, 2),
+                "reason": f"backend init failed: {stderr.strip()[-200:]}"}
+
+
+def probe(timeout: float = 0.0) -> int:
+    """`python bench.py --probe`: one JSON availability line (VERDICT r5
+    next-item #1). Exit code 0 = a backend answered, 1 = unreachable."""
+    result = probe_availability(timeout)
     print(json.dumps(result))
     return 0 if result["available"] else 1
 
 
-def _ensure_live_backend(probe_timeout: float = 180.0) -> str:
+def _ensure_live_backend(probe_timeout: float = 180.0):
     """The axon TPU tunnel can wedge so hard that jax.devices() blocks
     forever INSIDE backend init (observed for hours on the round-4 box) —
     which would hang the driver's bench run indefinitely. Probe device init
     in a subprocess first; on timeout/failure, force the CPU backend through
     the config API (the plugin ignores JAX_PLATFORMS) so the bench still
-    reports a number, tagged with the platform that actually ran."""
+    reports a number, tagged with the platform that actually ran.
+    Returns (platform note, availability dict for the detail line)."""
     if os.environ.get("BENCH_FORCE_CPU"):
         import jax
         jax.config.update("jax_platforms", "cpu")
-        return "cpu (forced)"
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=probe_timeout, check=True,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-        return "device"
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        return "cpu (tpu backend unreachable)"
+        return "cpu (forced)", {"available": False, "backend": "cpu",
+                                "reason": "BENCH_FORCE_CPU"}
+    avail = probe_availability(probe_timeout)
+    if avail["available"]:
+        return "device", avail
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu (tpu backend unreachable)", avail
 
 
 def build_cluster(n_nodes: int, zones: int = 50):
@@ -158,7 +162,13 @@ def main_sharded(n_shards: int, trace: bool = False,
         detail["replicas"] = out["replicas"]
         detail["replication"] = out["replication"]
     detail["shard_metrics"] = out["shard_metrics"]
+    # Peak per-process RSS (MiB), sampled by the harness poll loop — the
+    # paged read plane's bounded-memory claim as a number.
+    detail["rss_mb"] = out.get("rss_mb")
     detail["platform"] = "cpu (sharded subprocesses)"
+    # Hardware-availability context rides EVERY bench line (not just
+    # --probe), so BENCH_*.json trajectories keep it.
+    detail["availability"] = probe_availability()
     # e2e latency truth (scheduler_e2e_scheduling_duration_seconds, merged
     # across shards from /metrics) — the p50/p99 detail line.
     detail["e2e_ms"] = out.get("e2e_ms")
@@ -192,7 +202,7 @@ def main(trace: bool = False):
     n_pods = int(os.environ.get("BENCH_PODS", 10000))
     warmup = int(os.environ.get("BENCH_WARMUP", 1024))
 
-    platform_note = _ensure_live_backend()
+    platform_note, availability = _ensure_live_backend()
     cs, sched = build_cluster(n_nodes)
 
     # Warmup: compile both kernel traces (fresh + chained carry) with inert
@@ -223,11 +233,17 @@ def main(trace: bool = False):
 
     scheduled = sched.scheduled - warm_sched
     pods_per_sec = scheduled / elapsed if elapsed > 0 else 0.0
+    from kubernetes_tpu.shard.harness import rss_mb
     detail = {
         "scheduled": scheduled,
         "failures": sched.failures - warm_failures,
         "elapsed_s": round(elapsed, 2),
         "platform": platform_note + "/" + os.environ.get("JAX_PLATFORMS", "default"),
+        # Availability + RSS context on every bench line: BENCH_*.json
+        # trajectories keep the hardware story, and the memory claim is
+        # a number (post-run VmRSS of this process).
+        "availability": availability,
+        "rss_mb": {"self": rss_mb()},
     }
     for a in WINDOW:
         d = getattr(sched, a, 0) - win0[a]

@@ -9,8 +9,8 @@ Incidents this encodes (docs/ANALYSIS.md):
 - the same PR deliberately moved request-body reads OUTSIDE the write
   lock — a stalled sender must not wedge the whole write plane.
 
-Rules (scoped to core/apiserver.py + core/wal.py +
-kubernetes_tpu/replication/):
+Rules (scoped to core/apiserver.py + core/wal.py + core/watchcache.py +
+kubernetes_tpu/replication/ + kubernetes_tpu/hollow/):
 
 - ``verb-write-lock``: every mutating HTTP verb handler (do_POST/do_PUT/
   do_DELETE) either takes ``_write_lock`` itself or only delegates to a
@@ -45,9 +45,12 @@ kubernetes_tpu/replication/):
   ``/metrics/resources`` contending with the write plane);
 - ``no-read-serving-under-write-lock``: the watch-cache read plane
   (core/watchcache.py — ``list_wire``/``read_summary``/``get_many``/
-  ``events_since``/``render_resources``) must never be called with
-  ``_write_lock`` held — the whole point of the cache is a read plane
-  that does not contend with binds; its MUTATORS (``note_event``/
+  ``events_since``/``render_resources``, plus the paged-LIST
+  continuation path: ``list_page`` page serving and ``mint_continue``
+  token minting) must never be called with ``_write_lock`` held — the
+  whole point of the cache is a read plane that does not contend with
+  binds, and a 50k-node paged list serialized against the bind plane
+  would stall it once per page; the cache's MUTATORS (``note_event``/
   ``reinstall``) must run under the broadcast lock, after the WAL append
   (the frame a cached event came from must already be durable).
 """
@@ -75,9 +78,13 @@ FRAME_APPEND_PRIMITIVE = "_repl_append"
 FANOUT_PRIMITIVE = "_fan_event"
 # Watch-cache read plane (core/watchcache.py): reads must never hold the
 # write lock; mutators must hold the broadcast lock (rule
-# no-read-serving-under-write-lock).
+# no-read-serving-under-write-lock). The paged-LIST continuation path —
+# page serving (`list_page`) AND token minting (`mint_continue`) — is a
+# read too: minting a token under the write lock would serialize every
+# page of a 50k-node list against the bind plane.
 WATCHCACHE_READS = {"list_wire", "read_summary", "get_many",
-                    "events_since", "render_resources"}
+                    "events_since", "render_resources",
+                    "list_page", "mint_continue"}
 WATCHCACHE_MUTATORS = {"note_event", "reinstall"}
 
 
@@ -193,7 +200,7 @@ class LockDisciplineChecker(Checker):
                    "fanout, no blocking reads under a held lock")
 
     SCOPE = ("core/apiserver.py", "core/wal.py", "core/watchcache.py")
-    SCOPE_DIRS = ("replication/",)
+    SCOPE_DIRS = ("replication/", "hollow/")
 
     def applies_to(self, relpath: str) -> bool:
         if any(relpath == p or relpath.endswith("/" + p)

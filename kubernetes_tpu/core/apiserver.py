@@ -67,8 +67,13 @@ from .watchcache import (
     ShardFilter,
     WatchCache,
     encode_stream_item,
+    mint_continue,
+    parse_continue,
     pod_from_slim,
+    shard_of_wire,
+    slim_object,
     wire_key,
+    wire_plain,
 )
 
 
@@ -413,6 +418,16 @@ class APIServer:
             "nodes": WatchCache("nodes", capacity=backlog)}
         self.watch_slim_events = 0       # events delivered as slim wire
         self.watch_filtered_events = 0   # events dropped entirely
+        # Paged LIST plane (`?limit=&continue=`, docs/SCALE.md): pages
+        # served, continuation tokens that expired off the rv ring (the
+        # 410 Gone analogue), full-cluster single-response LISTs served
+        # (the legacy path the 50k plane must keep at zero), and object
+        # pages streamed by the replication snapshot bootstrap.
+        self.list_pages = 0
+        self.list_continue_410 = 0
+        self.list_unpaged = 0
+        self.snapshot_bootstrap_pages = 0
+        self.node_heartbeats = 0   # kubelet/hollow heartbeat sink hits
         # Recent shipped frames by global seq: the replication window a
         # follower can resume from without a snapshot bootstrap.
         self._repl_backlog = deque(maxlen=backlog)
@@ -1176,7 +1191,19 @@ class APIServer:
                  + self.watch_cache["nodes"].too_old),
                 ("apiserver_watch_events_slim_total", self.watch_slim_events),
                 ("apiserver_watch_events_filtered_out_total",
-                 self.watch_filtered_events)):
+                 self.watch_filtered_events),
+                # Paged LIST plane (docs/SCALE.md): pages served, expired
+                # continuations (410 -> the client restarts its list),
+                # legacy full-cluster single-response LISTs (zero on a
+                # paged-only plane — the 50k acceptance counter), and
+                # snapshot-bootstrap object pages streamed to followers.
+                ("apiserver_list_pages_total", self.list_pages),
+                ("apiserver_list_continue_410_total", self.list_continue_410),
+                ("apiserver_list_unpaged_total", self.list_unpaged),
+                ("apiserver_snapshot_bootstrap_pages_total",
+                 self.snapshot_bootstrap_pages),
+                ("apiserver_node_heartbeats_total",
+                 self.node_heartbeats)):
             out.append(f"# TYPE {name} counter")
             out.append(f"{name} {v}")
         out.append("# TYPE apiserver_failover_total counter")
@@ -1299,7 +1326,9 @@ class APIServer:
 
     def _attach_watch(self, kind: str, since: Optional[int] = None,
                       epoch: Optional[str] = None,
-                      flt: Optional[ShardFilter] = None) -> _WatchStream:
+                      flt: Optional[ShardFilter] = None,
+                      paged: bool = False,
+                      fresh: bool = False) -> _WatchStream:
         """Attach a watch under the broadcast lock, THEN register for live
         events — no create can fall between snapshot and registration.
         The snapshot and the resume ring both serve from the watch cache
@@ -1322,9 +1351,19 @@ class APIServer:
             # NOTHING after `since` was compacted away. Anything else —
             # unknown epoch (server restarted, counters reset), a future
             # rv, a pruned ring window — full-re-lists, never silently
-            # resumes (events_since counts the 410-too-old case).
-            if (since is not None and epoch == self.epoch and since <= seq
-                    and not (flt is not None and wc.selector_refs > 0)):
+            # resumes (events_since counts the 410-too-old case). A
+            # selector-ful FILTERED resume is refused (the old stream's
+            # slim set died with it) UNLESS `fresh` marks this attach as
+            # the one straight after a completed paged re-list: that
+            # client's cache was just rebuilt from full objects, and
+            # nothing slims while selector_refs > 0, so there is no slim
+            # set to lose.
+            resumable = (since is not None and epoch == self.epoch
+                         and since <= seq)
+            if (resumable and flt is not None and wc.selector_refs > 0
+                    and not fresh):
+                resumable = False
+            if resumable:
                 tail = wc.events_since(since)
             if tail is not None:
                 st.q.put((json.dumps({"type": "RESUME", "rv": seq,
@@ -1342,7 +1381,29 @@ class APIServer:
                     # seeds the upgrade set for a later selector
                     # transition.
                     flt.prime(wc)
+                    if wc.selector_refs > 0:
+                        # Only reachable on a `fresh` attach (non-fresh
+                        # selector-ful filtered resumes are refused
+                        # above): the paged list that just rebuilt this
+                        # client slimmed while refs were still 0, and a
+                        # selector source landed in the list→attach gap.
+                        # Upgrade everything the list slimmed NOW — the
+                        # in-band burst in route() only fires on the
+                        # next event, which a quiet cluster may never
+                        # send.
+                        for item in flt.upgrade_all(wc):
+                            st.q.put(item)
                 self.resumed_watches += 1
+            elif paged and since is not None:
+                # A paged client re-lists through `?limit=&continue=`
+                # (Replace semantics, bounded pages) instead of consuming
+                # a full ADDED replay materialized into this queue: tell
+                # it the resume window is gone and close the stream — it
+                # re-lists, then re-attaches with fresh=true at the list
+                # anchor.
+                st.q.put((json.dumps({"type": "TOO_OLD", "rv": seq,
+                                      "epoch": self.epoch}) + "\n").encode())
+                st.q.put(None)
             else:
                 for o in wc.list_wire():
                     event = {"type": "ADDED", "object": o}
@@ -1405,7 +1466,10 @@ class APIServer:
             def do_GET(self):
                 path, _, query = self.path.partition("?")
                 watch = "watch=true" in query
+                paged = "paged=true" in query
+                fresh = "fresh=true" in query
                 since, epoch, flt, uids = None, None, None, None
+                limit, cont = 0, ""
                 for part in query.split("&"):
                     if part.startswith("resourceVersion="):
                         try:
@@ -1414,6 +1478,13 @@ class APIServer:
                             pass
                     elif part.startswith("epoch="):
                         epoch = part.split("=", 1)[1]
+                    elif part.startswith("limit="):
+                        try:
+                            limit = int(part.split("=", 1)[1])
+                        except ValueError:
+                            pass
+                    elif part.startswith("continue="):
+                        cont = part.split("=", 1)[1]
                     elif part.startswith("shard="):
                         # Server-side shard-filtered stream: shard=i/n
                         # applies the shard/partition.py crc32 map HERE,
@@ -1434,7 +1505,8 @@ class APIServer:
                                 part.split("=", 1)[1].split(",") if u]
                 if path == "/api/v1/pods":
                     if watch:
-                        return self._stream("pods", since, epoch, flt)
+                        return self._stream("pods", since, epoch, flt,
+                                            paged=paged, fresh=fresh)
                     # Every non-watch read below serves from the watch
                     # cache under ITS lock — no store-dict iteration, no
                     # write-lock contention, and safe against concurrent
@@ -1454,11 +1526,22 @@ class APIServer:
                         # pods a filtered stream delivered slim.
                         return self._json(
                             200, server.watch_cache["pods"].get_many(uids))
+                    if limit:
+                        # Paged LIST (docs/SCALE.md): bounded pages with
+                        # rv-anchored continuation tokens — the 50k-node
+                        # read path. The whole cluster never rides one
+                        # response body.
+                        return self._list_paged("pods", limit, cont, flt)
+                    server.list_unpaged += 1
                     return self._json(200,
                                       server.watch_cache["pods"].list_wire())
                 if path == "/api/v1/nodes":
                     if watch:
-                        return self._stream("nodes", since, epoch)
+                        return self._stream("nodes", since, epoch,
+                                            paged=paged, fresh=fresh)
+                    if limit:
+                        return self._list_paged("nodes", limit, cont)
+                    server.list_unpaged += 1
                     return self._json(200,
                                       server.watch_cache["nodes"].list_wire())
                 if path == "/metrics/resources":
@@ -1479,7 +1562,14 @@ class APIServer:
                 if path == "/replication/status":
                     return self._json(200, server.replication_status())
                 if path == "/replication/snapshot":
-                    # Cold-follower bootstrap: a consistent full-state
+                    if limit:
+                        # Streaming paged bootstrap (docs/SCALE.md): meta
+                        # under the locks, object pages streamed from the
+                        # watch cache OUTSIDE every lock — a 50k-node
+                        # bootstrap neither stalls the write plane for
+                        # the encode nor rides one response body.
+                        return self._snapshot_stream(limit)
+                    # Legacy single-body bootstrap: a consistent full-state
                     # snapshot. Encode UNDER the locks (no write can
                     # interleave), send after releasing them — the socket
                     # write must never run under a held lock.
@@ -1514,9 +1604,130 @@ class APIServer:
                     return
                 self._json(404, {"error": "not found"})
 
+            def _write_chunk(self, data: bytes) -> None:
+                self.wfile.write(
+                    f"{len(data):x}\r\n".encode() + data + b"\r\n")
+
+            def _list_paged(self, kind: str, limit: int, token: str,
+                            flt: Optional[ShardFilter] = None) -> None:
+                """One page of `?limit=&continue=`: up to `limit` objects
+                as chunked json lines (the ship stream's framing) + a PAGE
+                trailer carrying the continuation token, the list-anchor
+                rv (`listRv` — what the client attaches its watch at) and
+                the epoch. Serves entirely from the watch cache under ITS
+                lock; an anchor that fell off the resume ring answers 410
+                and the client restarts its list."""
+                wc = server.watch_cache[kind]
+                last_key, anchor = "", None
+                if token:
+                    tok = parse_continue(token)
+                    if tok is None or tok.get("e") != server.epoch:
+                        server.list_continue_410 += 1
+                        return self._json(410, {"error": "ExpiredContinue"})
+                    last_key, anchor = tok.get("k", ""), int(tok.get("rv", 0))
+                page = wc.list_page(limit, last_key=last_key,
+                                    anchor_rv=anchor)
+                if page is None:
+                    server.list_continue_410 += 1
+                    return self._json(410, {"error": "ExpiredContinue"})
+                objs, next_key, anchor, rv = page
+                server.list_pages += 1
+                # Slim foreign plain pods through the shard filter exactly
+                # as the watch plane would deliver them (selector-free
+                # clusters only — core/watchcache.py).
+                slim_ok = (flt is not None and kind == "pods"
+                           and wc.selector_refs == 0)
+                try:
+                    # Headers inside the guard too: a client that closed
+                    # between request and response must tear only THIS
+                    # handler, quietly.
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    buf = bytearray()
+                    for obj in objs:
+                        if (slim_ok and wire_plain(obj)
+                                and shard_of_wire(obj, flt.count)
+                                != flt.index):
+                            obj = slim_object(obj)
+                            server.watch_slim_events += 1
+                        buf += (json.dumps({"type": "ADDED", "object": obj})
+                                + "\n").encode()
+                        if len(buf) >= 65536:
+                            self._write_chunk(bytes(buf))
+                            buf.clear()
+                    trailer = {"type": "PAGE", "rv": rv, "listRv": anchor,
+                               "epoch": server.epoch}
+                    if next_key:
+                        trailer["continue"] = mint_continue(
+                            anchor, next_key, server.epoch)
+                    buf += (json.dumps(trailer) + "\n").encode()
+                    self._write_chunk(bytes(buf))
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    self.close_connection = True
+
+            def _snapshot_stream(self, limit: int) -> None:
+                """Streaming replication bootstrap: SNAP_META (the control
+                cut — seq map, repl seq/epoch, leases — captured under the
+                locks), then object pages from the watch cache streamed
+                OUTSIDE every lock, then SNAP_END. Objects may be AHEAD of
+                the meta seq; the follower re-tails from meta seq and the
+                frame replay upsert-heals every difference (docs/SCALE.md
+                bootstrap contract). A torn stream (no SNAP_END) is never
+                installed."""
+                with server._write_lock:
+                    with server._lock:
+                        meta = {
+                            "epoch": server.epoch,
+                            "seq": dict(server._seq),
+                            "repl": {"seq": server._repl_seq,
+                                     "epoch": server.repl_epoch},
+                            "leases": [dict(rec, name=name, renew=None)
+                                       for name, rec in
+                                       list(server.leases.items())],
+                            "role": server.role,
+                        }
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    self._write_chunk(
+                        (json.dumps({"type": "SNAP_META", **meta})
+                         + "\n").encode())
+                    for kind in ("pods", "nodes"):
+                        last = ""
+                        while True:
+                            objs, next_key, _a, _rv = (
+                                server.watch_cache[kind].list_page(
+                                    limit, last_key=last))
+                            server.snapshot_bootstrap_pages += 1
+                            buf = bytearray()
+                            for obj in objs:
+                                buf += (json.dumps(
+                                    {"kind": kind, "object": obj})
+                                    + "\n").encode()
+                                if len(buf) >= 65536:
+                                    self._write_chunk(bytes(buf))
+                                    buf.clear()
+                            if buf:
+                                self._write_chunk(bytes(buf))
+                            if not next_key:
+                                break
+                            last = next_key
+                    self._write_chunk(b'{"type": "SNAP_END"}\n')
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    self.close_connection = True
+
             def _stream(self, kind: str, since: Optional[int] = None,
                         epoch: Optional[str] = None,
-                        flt: Optional[ShardFilter] = None) -> None:
+                        flt: Optional[ShardFilter] = None,
+                        paged: bool = False, fresh: bool = False) -> None:
                 # watch.Interface: hold the connection open, one JSON event
                 # per line (chunked); blocking queue — no idle polling. A
                 # BOOKMARK heartbeat goes out on idle (~10s) so a quiet
@@ -1527,7 +1738,8 @@ class APIServer:
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
-                st = server._attach_watch(kind, since, epoch, flt)
+                st = server._attach_watch(kind, since, epoch, flt,
+                                          paged=paged, fresh=fresh)
                 idle = 0.0
                 try:
                     while server._httpd is not None:
@@ -1727,7 +1939,14 @@ class APIServer:
                     return 201, node_to_wire(node)
                 if (self.path.startswith("/api/v1/nodes/")
                         and self.path.endswith("/status")):
-                    # parity stub (kubelet heartbeat shape); no-op
+                    # Kubelet heartbeat sink (parity stub, no event). The
+                    # hollow plane's bulk form (`/api/v1/nodes/status`,
+                    # {"names": [...]}) rides the same branch — one
+                    # request per fleet slice, counted per node.
+                    body = self._body()
+                    names = (body.get("names") if isinstance(body, dict)
+                             else None) or ()
+                    server.node_heartbeats += max(1, len(names))
                     return 200, {}
                 if self.path == "/api/v1/bindings":
                     # Bulk binding commits: one request, one write-lock
@@ -1884,6 +2103,88 @@ class APIServer:
 # ---------------------------------------------------------------------------
 # The client: REST writes + reflector-fed informer cache
 # ---------------------------------------------------------------------------
+
+
+def iter_paged(conn, kind: str, limit: int, shard=None,
+               max_restarts: int = 8):
+    """Drive one complete paged LIST (`?limit=&continue=`) over an open
+    HTTPConnection, yielding as lines arrive (bounded buffering):
+
+    - ``("restart", None, b"")`` — a continuation expired off the resume
+      ring (410): the whole list restarts; the consumer must reset any
+      accumulation;
+    - ``("object", wire_dict, raw_line)`` — one listed object;
+    - ``("done", trailer_dict, b"")`` — the final PAGE trailer (carries
+      ``listRv``/``epoch``), after which the generator ends.
+
+    The ONE consumption loop `fetch_paged` (collecting oracle) and the
+    reflector's `_paged_list_sync` (per-line dispatch) both ride —
+    request building, the 410-restart policy, and trailer parsing cannot
+    diverge between them."""
+    from urllib.error import URLError
+
+    for _attempt in range(max_restarts):
+        token = ""
+        expired = False
+        while True:
+            path = f"/api/v1/{kind}?limit={limit}"
+            if shard is not None:
+                path += f"&shard={shard[0]}/{shard[1]}"
+            if token:
+                path += f"&continue={token}"
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            if resp.status == 410:
+                resp.read()
+                expired = True
+                break
+            if resp.status != 200:
+                resp.read()
+                raise URLError(f"paged {kind} list: HTTP {resp.status}")
+            token = ""
+            trailer: Optional[dict] = None
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                d = json.loads(line)
+                if d.get("type") == "PAGE":
+                    token = d.get("continue") or ""
+                    trailer = d
+                elif d.get("object") is not None:
+                    yield "object", d["object"], line
+            if not token:
+                yield "done", trailer or {}, b""
+                return
+        if expired:
+            yield "restart", None, b""
+    raise URLError(
+        f"paged {kind} list: continuation kept expiring "
+        f"after {max_restarts} restarts")
+
+
+def fetch_paged(base_url: str, kind: str, limit: int = 1000,
+                timeout: float = 60.0, max_restarts: int = 8) -> List[dict]:
+    """Collect one complete paged LIST (`?limit=&continue=`) — the helper
+    harnesses and oracles use instead of the full-cluster single-response
+    GET."""
+    import http.client as _hc
+
+    host = base_url.rstrip("/").split("//", 1)[1]
+    conn = _hc.HTTPConnection(host, timeout=timeout)
+    try:
+        out: List[dict] = []
+        for what, payload, _line in iter_paged(conn, kind, limit,
+                                               max_restarts=max_restarts):
+            if what == "restart":
+                out = []
+            elif what == "object":
+                out.append(payload)
+            else:
+                break
+        return out
+    finally:
+        conn.close()
 
 
 class KeepAliveClient:
@@ -2421,7 +2722,62 @@ class HTTPClientset:
     def attach_pv_controller(self, ctrl) -> None:
         pass
 
-    # -- reflector (ListAndWatch: the watch carries the initial list) -------
+    # -- reflector (ListAndWatch: paged list, then watch from the anchor) ---
+
+    def _paged_list_sync(self, kind: str, host: str):
+        """Reflector (re-)list as a PAGED list (`?limit=&continue=`,
+        docs/SCALE.md): dispatch each object as its line arrives (bounded
+        client-side buffering — never a full-cluster response body), run
+        the Replace barrier at the end, and return ``(anchor, epoch)`` —
+        the list-anchor rv the following watch attach RESUMEs from,
+        replaying exactly the events that happened while paging. The
+        watermark is NOT published to ``_last_rv`` here: it becomes the
+        client's resume point only once the watch's RESUME marker
+        confirms the stream is live (a death in the gap re-lists rather
+        than resuming past events no stream was attached for). A 410
+        ExpiredContinue restarts the list from scratch; transport
+        failures raise to the watch loop's failure/rotation handling."""
+        import http.client as _hc
+        import os as _os
+
+        limit = int(_os.environ.get("TPU_SCHED_LIST_PAGE", "500"))
+        shard = self.shard if kind == "pods" else None
+        conn = _hc.HTTPConnection(host, timeout=60)
+        try:
+            seen: set = set()
+            trailer: dict = {}
+            for what, payload, line in iter_paged(conn, kind, limit,
+                                                  shard=shard):
+                if what == "restart":
+                    # Anchor off the ring mid-list: the iterator restarts
+                    # the list; objects already dispatched simply upsert
+                    # again, but the Replace seen-set must reset.
+                    seen = set()
+                    continue
+                if what == "done":
+                    trailer = payload
+                    break
+                obj = payload
+                # Decode-cost accounting, same split as the watch loop
+                # (a filtered paged list delivers foreign plain pods
+                # slim).
+                if obj.get("slim"):
+                    self.watch_events_slim += 1
+                    self.watch_bytes_slim += len(line)
+                else:
+                    self.watch_events_full += 1
+                    self.watch_bytes_full += len(line)
+                with self._dispatch_lock:
+                    seen.add(wire_key(kind, obj))
+                    self._dispatch(kind, "ADDED", obj)
+            with self._dispatch_lock:
+                self._replace_barrier(kind, seen)
+            self.relists[kind] += 1
+            anchor = trailer.get("listRv")
+            return ((int(anchor) if anchor is not None else None),
+                    trailer.get("epoch"))
+        finally:
+            conn.close()
 
     def _watch_loop(self, kind: str) -> None:
         """client-go reflector behavior (tools/cache/reflector.go:470): on
@@ -2444,12 +2800,48 @@ class HTTPClientset:
         while not self._stop.is_set():
             base_idx = self._base_idx
             host = self._bases[base_idx].split("//", 1)[1]
+            fresh = False
+            anchor: Optional[int] = None
+            anchor_epoch: Optional[str] = None
+            if self._last_rv[kind] is None or self._epoch[kind] is None:
+                # No resumable watermark (first sync, or a TOO_OLD/epoch
+                # break): paged list FIRST (Replace semantics, bounded
+                # pages), then watch from the list anchor — the
+                # full-cluster ADDED replay never materializes into a
+                # stream queue for this client.
+                try:
+                    anchor, anchor_epoch = self._paged_list_sync(kind, host)
+                    fresh = True
+                except Exception as e:  # noqa: BLE001 - list failed
+                    if not self._synced[kind].is_set():
+                        # Initial sync failed: dead on arrival is an
+                        # error, not an empty cluster.
+                        self._fatal[kind] = e
+                        self._synced[kind].set()
+                        return
+                    conn_fails += 1
+                    if conn_fails >= 3:
+                        self._rotate_read_base(base_idx)
+                        conn_fails = 0
+                    if self._stop.wait(backoff):
+                        return
+                    backoff = min(backoff * 2, 5.0)
+                    continue
             try:
                 conn = _hc.HTTPConnection(host, timeout=60)
-                path = f"/api/v1/{kind}?watch=true"
+                path = f"/api/v1/{kind}?watch=true&paged=true"
                 if kind == "pods" and self.shard is not None:
                     path += f"&shard={self.shard[0]}/{self.shard[1]}"
-                if (self._last_rv[kind] is not None
+                if fresh and anchor is not None and anchor_epoch is not None:
+                    # Attach straight after a completed paged list: resume
+                    # from the LIST ANCHOR (the ring replays exactly the
+                    # events that happened while paging). `fresh` also
+                    # allows a selector-ful FILTERED resume for this one
+                    # attach (the cache was just rebuilt from full
+                    # objects — core/watchcache.py).
+                    path += (f"&resourceVersion={anchor}"
+                             f"&epoch={anchor_epoch}&fresh=true")
+                elif (self._last_rv[kind] is not None
                         and self._epoch[kind] is not None):
                     path += (f"&resourceVersion={self._last_rv[kind]}"
                              f"&epoch={self._epoch[kind]}")
@@ -2506,6 +2898,14 @@ class HTTPClientset:
                             self._set_leader(event["leader"])
                         self.failover_count += 1
                         continue
+                    if typ == "TOO_OLD":
+                        # The resume window no longer covers our watermark
+                        # (ring overran, or the server is a new epoch):
+                        # clear it and re-list PAGED on the next loop
+                        # iteration — never a full ADDED replay.
+                        self._last_rv[kind] = None
+                        got_sync = True  # progress, not a stream failure
+                        break
                     if typ == "RESUME":
                         # Incremental reconnect: the server will replay the
                         # missed tail — the local cache stays authoritative,
@@ -2514,6 +2914,13 @@ class HTTPClientset:
                         got_sync = True
                         backoff = 0.05
                         self.resumes[kind] += 1
+                        if fresh and anchor is not None:
+                            # The stream is LIVE from the list anchor:
+                            # publish it as the resume watermark (replayed
+                            # events advance it from here). Publishing
+                            # earlier would let a death in the list→watch
+                            # gap silently resume past unwatched events.
+                            self._last_rv[kind] = anchor
                         if event.get("epoch") is not None:
                             self._epoch[kind] = event["epoch"]
                         self._synced[kind].set()
@@ -2536,8 +2943,7 @@ class HTTPClientset:
                     with self._dispatch_lock:
                         if resync_seen is not None:
                             resync_seen.add(wire_key(kind, event["object"]))
-                        self._dispatch(kind, typ, event["object"],
-                                       relisting=resync_seen is not None)
+                        self._dispatch(kind, typ, event["object"])
                         if event.get("rv") is not None:
                             self._last_rv[kind] = event["rv"]
             except Exception:  # noqa: BLE001 - stream torn down / timeout
@@ -2571,8 +2977,7 @@ class HTTPClientset:
             for name in [n for n in self.nodes if n not in seen]:
                 self._dispatch(kind, "DELETED", node_to_wire(self.nodes[name]))
 
-    def _dispatch(self, kind: str, typ: str, obj: dict,
-                  relisting: bool = False) -> None:
+    def _dispatch(self, kind: str, typ: str, obj: dict) -> None:
         if typ == "BOUND":
             # Slim bind event: the full pod is already cached (its ADDED
             # preceded it on this ordered stream) — patch nodeName on a copy
@@ -2615,8 +3020,12 @@ class HTTPClientset:
             else:
                 pod = pod_from_wire(obj)
             old = self.pods.get(pod.uid)
-            if relisting and action == "add" and old is not None:
-                action = "update"  # re-list replay of a known object
+            if action == "add" and old is not None:
+                # Replayed ADDED of a known object: a re-list replay, or
+                # the post-paged-list watch replaying a create a later
+                # page had already served — upsert as an update, handlers
+                # must never see a duplicate add.
+                action = "update"
             if action == "delete":
                 self.pods.pop(pod.uid, None)
                 self.bindings.pop(pod.uid, None)
@@ -2634,8 +3043,8 @@ class HTTPClientset:
         else:
             node = node_from_wire(obj)
             old = self.nodes.get(node.name)
-            if relisting and action == "add" and old is not None:
-                action = "update"
+            if action == "add" and old is not None:
+                action = "update"  # replayed ADDED of a known node
             if action == "delete":
                 self.nodes.pop(node.name, None)
             else:

@@ -33,6 +33,17 @@ apiserver in core/apiserver.py:
   (`filtered_out`): the watcher's view of a slim pod depends only on the
   projection.
 
+- **Paged LIST** (``?limit=&continue=``, docs/SCALE.md): ``list_page``
+  serves bounded pages of the wire snapshot in sorted-key order under the
+  cache's own lock; continuation tokens (``mint_continue``) anchor the
+  whole list to the rv of its FIRST page, validated against the resume
+  ring on every later page — when the ring no longer covers the anchor
+  the page answers the 410 Gone analogue and the client restarts the
+  list. A client that completes the list attaches its watch at the
+  anchor rv, so the ring replays exactly the events that happened while
+  it was paging (list-then-watch consistency); neither side ever
+  materializes the full cluster in one response body.
+
   Label-selector safety: pod-affinity and topology-spread terms match
   OTHER pods by label, so the moment any live pod declares such a term
   (``selector_refs > 0``) slimming is disabled — new events go out full,
@@ -45,6 +56,8 @@ apiserver in core/apiserver.py:
 
 from __future__ import annotations
 
+import base64
+import bisect
 import json
 import threading
 from collections import deque
@@ -57,6 +70,44 @@ from ..shard.partition import shard_of_key
 
 def wire_key(kind: str, obj: dict) -> str:
     return obj["uid"] if kind == "pods" else obj["name"]
+
+
+# ---------------------------------------------------------------------------
+# Continuation tokens (paged LIST: `?limit=&continue=`)
+# ---------------------------------------------------------------------------
+#
+# A token is opaque on the wire (urlsafe base64 JSON) and anchors the whole
+# paged list to the rv at which its FIRST page was served: every later page
+# re-validates that the resume ring still covers that anchor, so a client
+# that finishes the list can attach a watch at `listRv` and replay exactly
+# the events that happened WHILE it was paging (the list-then-watch
+# consistency contract, docs/SCALE.md). A token whose anchor fell off the
+# ring — or that names another server generation (epoch) — answers 410 Gone
+# and the client restarts the list from scratch.
+
+
+def mint_continue(anchor_rv: int, last_key: str, epoch: str) -> str:
+    """Encode one continuation token: (list-anchor rv, last served key,
+    server watch epoch)."""
+    return base64.urlsafe_b64encode(json.dumps(
+        {"rv": int(anchor_rv), "k": last_key, "e": epoch},
+        separators=(",", ":")).encode()).decode()
+
+
+def parse_continue(token: str) -> Optional[dict]:
+    """Decode a continuation token; None for garbage (the caller answers
+    410 — a malformed token must restart the list, never crash a page)."""
+    try:
+        d = json.loads(base64.urlsafe_b64decode(token.encode()))
+    except Exception:  # noqa: BLE001 - any malformed token is 410
+        return None
+    if (isinstance(d, dict)
+            and isinstance(d.get("rv"), int)
+            and not isinstance(d.get("rv"), bool)
+            and isinstance(d.get("k"), str)
+            and isinstance(d.get("e"), str)):
+        return d
+    return None
 
 
 RESOURCE_METRICS_HEADER = (
@@ -203,11 +254,11 @@ class WatchCache:
     - ``note_event``/``reset`` (mutation) are called on the apiserver's
       broadcast path with ``_lock`` held, after the WAL append — so ring
       order is commit order and a cached object is always durable;
-    - the read methods (``list_wire``/``get_many``/``read_summary``/
-      ``events_since``/``render_resources``) take only this cache's own
-      lock and MUST NOT be called with the server's ``_write_lock``
-      held — the whole point is a read plane that never contends with
-      the write plane."""
+    - the read methods (``list_wire``/``list_page``/``get_many``/
+      ``read_summary``/``events_since``/``render_resources``) take only
+      this cache's own lock and MUST NOT be called with the server's
+      ``_write_lock`` held — the whole point is a read plane that never
+      contends with the write plane."""
 
     def __init__(self, kind: str, capacity: int = 8192):
         self.kind = kind
@@ -220,6 +271,11 @@ class WatchCache:
         self.hits = 0       # list/summary/uids/resource reads served
         self.resumes = 0    # interval replays served from the ring
         self.too_old = 0    # resume rvs that fell off the window (410)
+        # Sorted-key cache for paged lists: (validity stamp, keys). Pages
+        # iterate the snapshot in sorted-key order so a continuation token
+        # names a stable position; the sort is cached per (rv, size) so a
+        # quiet cluster pays it once per list, not once per page.
+        self._skeys: Optional[Tuple[Tuple[int, int], List[str]]] = None
 
     # -- mutation (broadcast path; caller holds the server's _lock) ---------
 
@@ -284,6 +340,11 @@ class WatchCache:
             for entry in ring or ():
                 self._ring.append(entry)
             self.rv = max(rv, self._ring[-1][0] if self._ring else 0)
+            # The (rv, size) stamp can COLLIDE across an install (an
+            # epoch-fork snapshot may regress rv and land on the same
+            # size with different keys): drop the sorted-key cache
+            # explicitly, never trust the stamp across a reinstall.
+            self._skeys = None
 
     # -- reads (own lock ONLY; never under the server's _write_lock) --------
 
@@ -306,6 +367,43 @@ class WatchCache:
             self.hits += 1
             return {"total": len(self._objects), "bound": self._bound,
                     "rv": self.rv}
+
+    def _covers(self, rv: int) -> bool:
+        """Does the resume ring still span everything after ``rv``?
+        Caller holds this cache's lock."""
+        return rv == self.rv or bool(
+            self._ring and self._ring[0][0] <= rv + 1)
+
+    def list_page(self, limit: int, last_key: str = "",
+                  anchor_rv: Optional[int] = None):
+        """One page of the wire snapshot in sorted-key order, under this
+        cache's own lock (never the server's write lock — the analyzer's
+        ``no-read-serving-under-write-lock`` rule covers this path).
+
+        -> ``(objs, next_key, anchor, rv)``: up to ``limit`` wire dicts
+        with key > ``last_key``; ``next_key`` is "" on the final page;
+        ``anchor`` is the list-start rv (minted into the continuation
+        token — the rv the client attaches its watch at); ``rv`` is the
+        cache head now. Returns None when ``anchor_rv`` fell off the
+        resume ring (the 410 Gone analogue: events the finished list
+        would need to replay are gone, so the whole list restarts)."""
+        limit = max(1, int(limit))
+        with self._lock:
+            if anchor_rv is not None and not self._covers(anchor_rv):
+                self.too_old += 1
+                return None
+            stamp = (self.rv, len(self._objects))
+            if self._skeys is None or self._skeys[0] != stamp:
+                self._skeys = (stamp, sorted(self._objects))
+            keys = self._skeys[1]
+            i = bisect.bisect_right(keys, last_key) if last_key else 0
+            page = keys[i:i + limit]
+            objs = [self._objects[k] for k in page]
+            self.hits += 1
+            more = (i + limit) < len(keys)
+            next_key = page[-1] if (page and more) else ""
+            anchor = self.rv if anchor_rv is None else anchor_rv
+            return objs, next_key, anchor, self.rv
 
     def events_since(self, since: int) -> Optional[List[tuple]]:
         """The (rv, event, data) tail with rv > ``since`` — the RESUME
@@ -364,13 +462,30 @@ class ShardFilter:
         """RESUME attach: the previous connection's slim set died with it.
         Seed it with every live pod this filter WOULD slim, so a later
         selector transition still upgrades pods slimmed before the
-        reconnect. (Only reachable while selector_refs == 0 — a
-        selector-ful cluster refuses filtered RESUME entirely.)"""
+        reconnect. (Reachable with selector_refs == 0, or on a `fresh`
+        paged-relist attach — where selector_refs > 0 means the list
+        slimmed before a transition and the caller immediately drains
+        the seeded map through ``upgrade_all``.)"""
         with cache._lock:
             objs = list(cache._objects.values())
         for obj in objs:
             if wire_plain(obj) and shard_of_wire(obj, self.count) != self.index:
                 self._slimmed[obj["uid"]] = slim_object(obj)
+
+    def upgrade_all(self, cache: WatchCache) -> List[object]:
+        """Drain the slim map into lazy full-MODIFIED upgrade markers
+        (resolve with ``encode_stream_item`` on the consumer thread) —
+        the attach-time variant of route()'s selector-transition burst.
+        Used when a FRESH filtered attach finds selector_refs > 0: the
+        paged list that just rebuilt the client slimmed while refs were
+        still 0, and waiting for the next event to trigger the in-band
+        burst would leave label-less slims in the cache indefinitely on
+        a quiet cluster."""
+        with cache._lock:
+            fulls = [cache._objects[u] for u in self._slimmed
+                     if u in cache._objects]
+        self._slimmed = {}
+        return [("MODIFIED", full) for full in fulls]
 
     def route(self, event: dict, data: bytes, cache: WatchCache,
               memo: Optional[dict] = None) -> Tuple[List[object], int, int]:

@@ -1,0 +1,20 @@
+"""Hollow-node scale plane (the reference's kubemark layer,
+`cmd/kubemark/hollow-node.go` / `pkg/kubemark/hollow_kubelet.go`): one
+process impersonates N nodes' full kubelet lifecycle — register,
+heartbeat with capacity drift, cordon/delete/re-register churn waves —
+against a REAL apiserver over HTTP, so the control plane can be driven at
+50k–100k nodes from a box that could never run that many kubelets.
+
+- :mod:`profile` — the declarative profile (count, heterogeneity mix,
+  heartbeat cadence, drift, churn rate) a plane runs;
+- :mod:`plane` — the synthetic-kubelet thread pool itself;
+- ``python -m kubernetes_tpu.hollow`` — the standalone process the
+  shard/perf harness spawns alongside real scheduler shards.
+
+docs/SCALE.md holds the profile format and the 50k-node runbook.
+"""
+
+from .plane import HollowNodePlane
+from .profile import HollowProfile, NodeShape
+
+__all__ = ["HollowNodePlane", "HollowProfile", "NodeShape"]

@@ -1,0 +1,74 @@
+"""Standalone hollow-node plane process:
+
+    python -m kubernetes_tpu.hollow --api-url http://127.0.0.1:PORT \
+        [--profile profile.json] [--count N] [--heartbeat S] \
+        [--drift F] [--churn R] [--zones Z] [--prefix P]
+
+Registers the fleet, prints the ready line the spawn harness keys on
+(``hollow-node plane: registered N nodes``), then heartbeats/churns until
+SIGTERM/SIGINT — finally printing one JSON stats line so harnesses can
+fold the plane's activity into their detail objects. CLI flags override
+the profile file's fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+from .plane import HollowNodePlane
+from .profile import HollowProfile
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes-tpu-hollow")
+    ap.add_argument("--api-url", required=True,
+                    help="apiserver base URL (the LEADER: the plane writes)")
+    ap.add_argument("--profile", default="",
+                    help="JSON profile file (docs/SCALE.md format)")
+    ap.add_argument("--count", type=int, default=0)
+    ap.add_argument("--heartbeat", type=float, default=0.0,
+                    help="full-fleet heartbeat sweep period in seconds")
+    ap.add_argument("--drift", type=float, default=-1.0,
+                    help="fraction of heartbeats that drift capacity")
+    ap.add_argument("--churn", type=float, default=-1.0,
+                    help="cordon/delete/re-register waves per second")
+    ap.add_argument("--zones", type=int, default=-1)
+    ap.add_argument("--prefix", default="")
+    args = ap.parse_args(argv)
+
+    profile = (HollowProfile.load(args.profile) if args.profile
+               else HollowProfile())
+    if args.count:
+        profile.count = args.count
+    if args.heartbeat:
+        profile.heartbeat_s = args.heartbeat
+    if args.drift >= 0:
+        profile.drift = args.drift
+    if args.churn >= 0:
+        profile.churn_per_s = args.churn
+    if args.zones >= 0:
+        profile.zones = args.zones
+    if args.prefix:
+        profile.name_prefix = args.prefix
+
+    plane = HollowNodePlane(args.api_url, profile)
+    n = plane.register()
+    plane.start()
+    # The ready line FIRST (spawn harnesses select()+readline on it).
+    print(f"hollow-node plane: registered {n} nodes against "
+          f"{args.api_url}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    plane.stop()
+    print(json.dumps({"hollow_stats": plane.stats()}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

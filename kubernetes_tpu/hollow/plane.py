@@ -1,0 +1,252 @@
+"""The hollow-node plane: N synthetic kubelets in one process.
+
+The reference scales its control-plane tests with kubemark
+(`pkg/kubemark/hollow_kubelet.go`): a hollow node runs the real kubelet
+control loops against the real apiserver but fakes the container
+runtime, so a handful of processes impersonate thousands of nodes. This
+module is that layer for our plane:
+
+- **register** — bulk node creates (`POST /api/v1/nodes` with a JSON
+  array, the PR-5 bulk-commit shape) in profile-sized chunks from a
+  small thread pool; 50k nodes arrive in ~100 requests, not 50k;
+- **heartbeat** — a paced sweep: every tick the next slice of the fleet
+  POSTs the node-status heartbeat sink in ONE bulk request
+  (`/api/v1/nodes/status`, the kubelet heartbeat parity stub) — the
+  whole fleet heartbeats every ``heartbeat_s`` without the write plane
+  seeing per-node requests. A ``drift`` fraction of heartbeats instead
+  PUTs a REAL node update with allocatable cpu drifted ±1 core
+  (bounded to [½×, 2×] of the shape), driving genuine MODIFIED fanout,
+  journal classification, and device-mirror row patches;
+- **churn waves** — at ``churn_per_s``, cordon a victim (unschedulable
+  node update), dwell ``churn_cordon_s``, then DELETE it and register a
+  fresh replacement of the same shape (fleet size stays constant): the
+  node-lifecycle half of a MixedChurn workload at hollow scale.
+
+The plane keeps per-node wire dicts as its only state; everything it
+does to the cluster flows through the public REST surface, so leader
+redirects, WAL durability, replication shipping, and watch fanout are
+exercised exactly as real kubelets would.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Deque, Dict, List, Tuple
+
+from ..core.apiserver import KeepAliveClient
+from .profile import HollowProfile
+
+
+class HollowNodePlane:
+    def __init__(self, base_url: str, profile: HollowProfile,
+                 now=time.monotonic):
+        self.base = base_url.rstrip("/")
+        self.profile = profile
+        self.now = now
+        self._client = KeepAliveClient(self.base)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # Guards the fleet dicts (heartbeat slices, churn victims, and
+        # re-registration all touch them from different threads).
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, dict] = {}       # name -> live wire dict
+        self._shape_ix: Dict[str, int] = {}     # name -> shape index arg
+        self._order: List[str] = []             # heartbeat round-robin
+        self._hb_pos = 0
+        self._cordoned: Deque[Tuple[float, str]] = deque()
+        self._seq = profile.count               # replacement name sequence
+        self._rng = random.Random(profile.seed or 0x5ca1e)
+        # Counters (stats()): what the plane actually did to the cluster.
+        self.registered = 0
+        self.heartbeats = 0
+        self.drifts = 0
+        self.cordons = 0
+        self.deletes = 0
+        self.reregisters = 0
+        self.errors = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def register(self) -> int:
+        """Bulk-register the whole fleet. Returns the node count the
+        server acknowledged (duplicates from a retried chunk are fine —
+        the bulk create skips and reports them)."""
+        prof = self.profile
+        wires = [prof.node_wire(i) for i in range(prof.count)]
+        with self._lock:
+            for i, w in enumerate(wires):
+                self._nodes[w["name"]] = w
+                self._shape_ix[w["name"]] = i
+            self._order = [w["name"] for w in wires]
+        chunks = [wires[i:i + prof.register_chunk]
+                  for i in range(0, len(wires), prof.register_chunk)]
+
+        def post(chunk):
+            return self._client.call("POST", "/api/v1/nodes", chunk,
+                                     timeout=120.0)
+
+        with ThreadPoolExecutor(max_workers=max(1, prof.threads)) as ex:
+            for res in ex.map(post, chunks):
+                self.registered += int((res or {}).get("created", 0))
+                self.registered += int((res or {}).get("alreadyExists", 0))
+        return self.registered
+
+    def start(self) -> "HollowNodePlane":
+        if self._threads:
+            return self
+        t = threading.Thread(target=self._heartbeat_loop,
+                             name="hollow-heartbeat", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.profile.churn_per_s > 0:
+            t = threading.Thread(target=self._churn_loop,
+                                 name="hollow-churn", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+        self._threads = []
+
+    def stats(self) -> dict:
+        with self._lock:
+            live = len(self._nodes)
+        return {"count": self.profile.count, "live": live,
+                "registered": self.registered,
+                "heartbeats": self.heartbeats, "drifts": self.drifts,
+                "cordons": self.cordons, "deletes": self.deletes,
+                "reregisters": self.reregisters, "errors": self.errors}
+
+    # -- heartbeats (+ capacity drift) --------------------------------------
+
+    _TICK = 0.25
+
+    def _heartbeat_loop(self) -> None:
+        prof = self.profile
+        carry = 0.0
+        while not self._stop.wait(self._TICK):
+            # Slice size so the whole fleet sweeps once per heartbeat_s.
+            with self._lock:
+                fleet = len(self._order)
+            if not fleet:
+                continue
+            carry += fleet * self._TICK / max(self._TICK, prof.heartbeat_s)
+            due = int(carry)
+            if due <= 0:
+                continue
+            carry -= due
+            with self._lock:
+                names = [self._order[(self._hb_pos + j) % len(self._order)]
+                         for j in range(min(due, len(self._order)))]
+                self._hb_pos = (self._hb_pos + len(names)) % max(
+                    1, len(self._order))
+                names = [n for n in names if n in self._nodes]
+            if not names:
+                continue
+            try:
+                # One bulk POST to the heartbeat sink for the whole slice:
+                # the write plane sees one request, not len(names).
+                self._client.call("POST", "/api/v1/nodes/status",
+                                  {"names": names})
+                self.heartbeats += len(names)
+            except Exception:  # noqa: BLE001 - transient; next sweep retries
+                self.errors += 1
+                continue
+            if prof.drift > 0:
+                k = int(len(names) * prof.drift)
+                if k == 0 and self._rng.random() < len(names) * prof.drift:
+                    k = 1
+                for name in self._rng.sample(names, min(k, len(names))):
+                    self._drift_one(name)
+
+    def _drift_one(self, name: str) -> None:
+        """One real capacity drift: allocatable cpu ±1 core, bounded to
+        [½×, 2×] the shape's base — a genuine node UPDATE with MODIFIED
+        fanout, exactly what autoscaler/kubelet capacity jitter does."""
+        with self._lock:
+            wire = self._nodes.get(name)
+            if wire is None:
+                return
+            ix = self._shape_ix.get(name, 0)
+            base = int(self.profile.shape_for(ix).cpu) * 1000
+            cur = int(wire["allocatable"]["cpu"])
+            step = 1000 if self._rng.random() < 0.5 else -1000
+            nxt = min(base * 2, max(base // 2, cur + step))
+            if nxt == cur:
+                nxt = min(base * 2, max(base // 2, cur - step))
+            wire = dict(wire, allocatable=dict(
+                wire["allocatable"], cpu=nxt))
+            self._nodes[name] = wire
+        try:
+            self._client.call("PUT", f"/api/v1/nodes/{name}", wire)
+            self.drifts += 1
+        except Exception:  # noqa: BLE001 - transient
+            self.errors += 1
+
+    # -- churn waves (cordon -> delete -> re-register) ----------------------
+
+    def _churn_loop(self) -> None:
+        prof = self.profile
+        period = 1.0 / prof.churn_per_s
+        next_wave = self.now()
+        while not self._stop.wait(min(0.1, period / 2)):
+            now = self.now()
+            # Cordoned nodes whose dwell elapsed: delete + replace.
+            while self._cordoned and self._cordoned[0][0] <= now:
+                _deadline, name = self._cordoned.popleft()
+                self._delete_and_replace(name)
+            while now >= next_wave:
+                next_wave += period
+                self._cordon_one()
+
+    def _cordon_one(self) -> None:
+        with self._lock:
+            cordoned = {n for _d, n in self._cordoned}
+            candidates = [n for n in self._order
+                          if n in self._nodes and n not in cordoned]
+            if not candidates:
+                return
+            name = candidates[self._rng.randrange(len(candidates))]
+            wire = dict(self._nodes[name], unschedulable=True)
+            self._nodes[name] = wire
+        try:
+            self._client.call("PUT", f"/api/v1/nodes/{name}", wire)
+            self.cordons += 1
+            self._cordoned.append(
+                (self.now() + self.profile.churn_cordon_s, name))
+        except Exception:  # noqa: BLE001
+            self.errors += 1
+
+    def _delete_and_replace(self, name: str) -> None:
+        try:
+            self._client.call("DELETE", f"/api/v1/nodes/{name}")
+            self.deletes += 1
+        except Exception:  # noqa: BLE001
+            self.errors += 1
+            return
+        with self._lock:
+            self._nodes.pop(name, None)
+            ix = self._shape_ix.pop(name, 0)
+            new_ix = self._seq
+            self._seq += 1
+            wire = self.profile.node_wire(
+                ix, name=f"{self.profile.name_prefix}-r{new_ix}")
+            self._nodes[wire["name"]] = wire
+            self._shape_ix[wire["name"]] = ix
+            try:
+                pos = self._order.index(name)
+                self._order[pos] = wire["name"]
+            except ValueError:
+                self._order.append(wire["name"])
+        try:
+            self._client.call("POST", "/api/v1/nodes", wire)
+            self.reregisters += 1
+        except Exception:  # noqa: BLE001
+            self.errors += 1

@@ -1,0 +1,153 @@
+"""Declarative hollow-node profiles.
+
+A profile says WHAT cluster a hollow plane impersonates — how many nodes,
+in what heterogeneity mix (weighted shapes: capacity, labels, taints),
+how often each node heartbeats, what fraction of heartbeats drift
+allocatable capacity, and at what rate churn waves run
+(cordon → delete → re-register). The plane (plane.py) owns HOW.
+
+Profiles are plain dicts on disk (JSON) so the perf harness, the CLI, and
+tests share one format — docs/SCALE.md documents it:
+
+    {"count": 50000, "zones": 100, "heartbeat_s": 60.0,
+     "drift": 0.01, "churn_per_s": 2.0,
+     "shapes": [{"weight": 3, "cpu": 32, "memory": "256Gi", "pods": 110},
+                {"weight": 1, "cpu": 96, "memory": "1Ti", "pods": 250,
+                 "labels": {"pool": "big"},
+                 "taints": [{"key": "big", "effect": "NoSchedule"}]}]}
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api.resource import parse_quantity
+
+ZONE = "topology.kubernetes.io/zone"
+HOSTNAME = "kubernetes.io/hostname"
+
+
+@dataclass
+class NodeShape:
+    """One entry of the heterogeneity mix. ``weight`` is the relative
+    share of the node count this shape gets (shapes interleave
+    deterministically by index, so shape assignment is stable across
+    plane restarts and identical on every replica of a run)."""
+
+    weight: float = 1.0
+    cpu: int = 32              # cores
+    memory: str = "256Gi"
+    ephemeral: str = "100Gi"
+    pods: int = 110
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: List[dict] = field(default_factory=list)   # {key,value,effect}
+    scalars: Dict[str, float] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NodeShape":
+        return cls(weight=float(d.get("weight", 1.0)),
+                   cpu=int(d.get("cpu", 32)),
+                   memory=str(d.get("memory", "256Gi")),
+                   ephemeral=str(d.get("ephemeral", "100Gi")),
+                   pods=int(d.get("pods", 110)),
+                   labels=dict(d.get("labels", {})),
+                   taints=[dict(t) for t in d.get("taints", ())],
+                   scalars=dict(d.get("scalars", {})))
+
+    def to_dict(self) -> dict:
+        return {"weight": self.weight, "cpu": self.cpu,
+                "memory": self.memory, "ephemeral": self.ephemeral,
+                "pods": self.pods, "labels": dict(self.labels),
+                "taints": [dict(t) for t in self.taints],
+                "scalars": dict(self.scalars)}
+
+
+@dataclass
+class HollowProfile:
+    count: int = 1000
+    shapes: List[NodeShape] = field(default_factory=lambda: [NodeShape()])
+    zones: int = 50
+    name_prefix: str = "hollow"
+    heartbeat_s: float = 30.0   # full-fleet heartbeat sweep period
+    drift: float = 0.0          # fraction of heartbeats that drift capacity
+    churn_per_s: float = 0.0    # cordon->delete->re-register waves
+    churn_cordon_s: float = 0.5  # dwell between cordon and delete
+    threads: int = 4            # register/heartbeat worker threads
+    register_chunk: int = 500   # nodes per bulk-create POST
+    seed: int = 0               # drift/churn victim selection
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HollowProfile":
+        shapes = [NodeShape.from_dict(s) for s in d.get("shapes", ())]
+        return cls(count=int(d.get("count", 1000)),
+                   shapes=shapes or [NodeShape()],
+                   zones=int(d.get("zones", 50)),
+                   name_prefix=str(d.get("name_prefix", "hollow")),
+                   heartbeat_s=float(d.get("heartbeat_s", 30.0)),
+                   drift=float(d.get("drift", 0.0)),
+                   churn_per_s=float(d.get("churn_per_s", 0.0)),
+                   churn_cordon_s=float(d.get("churn_cordon_s", 0.5)),
+                   threads=int(d.get("threads", 4)),
+                   register_chunk=int(d.get("register_chunk", 500)),
+                   seed=int(d.get("seed", 0)))
+
+    def to_dict(self) -> dict:
+        return {"count": self.count,
+                "shapes": [s.to_dict() for s in self.shapes],
+                "zones": self.zones, "name_prefix": self.name_prefix,
+                "heartbeat_s": self.heartbeat_s, "drift": self.drift,
+                "churn_per_s": self.churn_per_s,
+                "churn_cordon_s": self.churn_cordon_s,
+                "threads": self.threads,
+                "register_chunk": self.register_chunk, "seed": self.seed}
+
+    @classmethod
+    def load(cls, path: str) -> "HollowProfile":
+        with open(path) as fh:
+            return cls.from_dict(json.load(fh))
+
+    # Conjugate golden ratio: frac(i*φ') is a low-discrepancy sequence —
+    # every shape's share of any index range is within O(1) of its weight
+    # quota, so even a weight-1-in-10000 shape gets its ~N/10000 nodes
+    # (a fixed modular period would quantize small weights to ZERO).
+    _GOLDEN = 0.6180339887498949
+
+    def shape_for(self, i: int) -> NodeShape:
+        """Deterministic weighted interleave: node i's shape depends only
+        on the profile, never on registration order or timing."""
+        total = sum(max(0.0, s.weight) for s in self.shapes) or 1.0
+        x = (i * self._GOLDEN) % 1.0
+        acc = 0.0
+        for s in self.shapes:
+            acc += max(0.0, s.weight) / total
+            if x < acc:
+                return s
+        return self.shapes[-1]
+
+    def node_wire(self, i: int, name: Optional[str] = None) -> dict:
+        """The wire dict (core/apiserver.py node codec) for node i —
+        built directly so registering 50k nodes never allocates 50k
+        intermediate Node objects."""
+        shape = self.shape_for(i)
+        name = name or f"{self.name_prefix}-{i}"
+        labels = dict(shape.labels)
+        labels[HOSTNAME] = name
+        if self.zones:
+            labels[ZONE] = f"zone-{i % self.zones}"
+        return {
+            "name": name, "uid": name, "labels": labels,
+            "unschedulable": False,
+            "allocatable": {
+                "cpu": int(shape.cpu) * 1000,
+                "memory": int(parse_quantity(shape.memory)),
+                "ephemeral": int(parse_quantity(shape.ephemeral)),
+                "pods": int(shape.pods),
+                "scalar": dict(shape.scalars)},
+            "taints": [
+                {"key": t.get("key", ""), "value": t.get("value", ""),
+                 "effect": t.get("effect", "NoSchedule")}
+                for t in shape.taints],
+            "declaredFeatures": {},
+        }

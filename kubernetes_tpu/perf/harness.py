@@ -71,14 +71,21 @@ class PerfResult:
     detail: Dict[str, Any] = field(default_factory=dict)
 
     def meets_thresholds(self) -> bool:
-        """Thresholds gate `performance`-labeled runs only — the reference
-        asserts them on perf hardware, not on integration-test variants
-        (scheduler_perf.go:282-368 / misc/performance-config.yaml:1-19)."""
-        if "performance" not in self.workload.labels:
+        """Thresholds gate `performance`- and `hollow`-labeled runs only —
+        the reference asserts them on perf hardware, not on
+        integration-test variants (scheduler_perf.go:282-368 /
+        misc/performance-config.yaml:1-19). A threshold named ``Max*`` is
+        a CEILING (e.g. MaxApiserverRssMb — the bounded-memory floor of
+        the paged read plane); everything else is a floor."""
+        if ("performance" not in self.workload.labels
+                and "hollow" not in self.workload.labels):
             return True
-        for name, floor in self.workload.thresholds.items():
+        for name, bound in self.workload.thresholds.items():
             got = self.metrics.get(name, {}).get("Average", 0.0)
-            if got < floor:
+            if name.startswith("Max"):
+                if got > bound:
+                    return False
+            elif got < bound:
                 return False
         return True
 
@@ -484,11 +491,84 @@ def run_sharded_workload(wl: Workload,
     return result
 
 
+def run_hollow_workload(wl: Workload) -> PerfResult:
+    """Run a hollow-plane scale workload (docs/SCALE.md): the node fleet
+    is impersonated by a kubernetes_tpu/hollow plane process (register +
+    heartbeats + capacity drift + cordon/delete/re-register churn) while
+    `shards` scheduler processes bind the measured pods over the paged
+    read plane. The result carries the scale-plane acceptance numbers:
+
+    - ``SchedulingThroughput`` — the usual floor;
+    - ``MaxApiserverRssMb`` / ``MaxShardRssMb`` — peak RSS CEILINGS
+      (sampled by the harness poll loop), the bounded-memory claim;
+    - ``MaxUnpagedLists`` — apiserver_list_unpaged_total, asserted 0:
+      zero full-cluster single-response LISTs crossed the wire."""
+    from ..shard.harness import run_sharded_cluster
+
+    n_nodes = n_pods = 0
+    pod_tpl: Dict[str, Any] = dict(wl.default_pod_template or {})
+    for op in wl.ops:
+        if op["opcode"] == "createNodes":
+            n_nodes += _resolve_count(op, wl.params)
+        elif op["opcode"] == "createPods":
+            n_pods += _resolve_count(op, wl.params)
+            pod_tpl = dict(op.get("podTemplate") or pod_tpl)
+        else:
+            raise ValueError(
+                f"hollow workloads support createNodes/createPods only, "
+                f"got {op['opcode']!r}")
+    params = wl.params
+    profile = {
+        "heartbeat_s": float(params.get("hollowHeartbeatS", 30.0)),
+        "drift": float(params.get("hollowDrift", 0.0)),
+        "churn_per_s": float(params.get("hollowChurnPerS", 0.0)),
+        "zones": int(params.get("zones", 100)),
+    }
+    out = run_sharded_cluster(
+        int(params.get("shards", 1)), n_nodes, n_pods,
+        hollow=profile,
+        replicas=int(params.get("replicas", 0)),
+        lease_duration=float(params.get("leaseDuration", 15.0)),
+        warm_pods=int(params.get("warmPods", min(256, max(1, n_pods // 8)))),
+        timeout=float(params.get("timeoutS", 3600.0)),
+        pod_request={"cpu": pod_tpl.get("cpu", "100m"),
+                     "memory": pod_tpl.get("memory", "128Mi")})
+    result = PerfResult(workload=wl, scheduled=out["bound"],
+                        failed=0 if out["all_bound"] else 1,
+                        elapsed=out["elapsed_s"])
+    rate = out["pods_per_sec"]
+    result.metrics["SchedulingThroughput"] = {
+        "Average": rate, "Perc50": rate, "Perc90": rate, "Perc95": rate,
+        "Perc99": rate}
+    rss = out.get("rss_mb") or {}
+    result.metrics["MaxApiserverRssMb"] = {"Average": max(
+        [rss.get("apiserver", 0.0)] + list(rss.get("followers", ())))}
+    result.metrics["MaxShardRssMb"] = {"Average": max(
+        list(rss.get("shards", ())) or [0.0])}
+    # Zero-unpaged must hold on EVERY replica (the shards list from
+    # followers): the replication detail scrapes each one, leader
+    # included; without replicas, fall back to the leader's counter.
+    reps = out.get("replication")
+    if reps:
+        unpaged = sum(float(rep.get("listUnpaged", 0)) for rep in reps)
+    else:
+        unpaged = float(
+            (out.get("api") or {}).get("apiserver_list_unpaged_total", 0.0))
+    result.metrics["MaxUnpagedLists"] = {"Average": unpaged}
+    result.detail = dict(out)
+    return result
+
+
 def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
     """Execute one workload's opcode list (the RunBenchmarkPerfScheduling
     inner loop, scheduler_perf.go:282+)."""
     from ..models.tpu_scheduler import TPUScheduler
 
+    if wl.params.get("hollow") and sched is None:
+        # Hollow-plane scale workloads (HollowNodeScale): the node fleet
+        # is impersonated by a hollow plane process, pods bind through
+        # real scheduler shards over the paged read plane.
+        return run_hollow_workload(wl)
     if wl.params.get("shards") and sched is None:
         # Sharded workloads (ShardedSchedulingBasic) run the multi-process
         # shard plane — one apiserver + N scheduler processes — rather than

@@ -102,7 +102,8 @@ class ReplicationTail:
     def __init__(self, api, leader_url: str, rank: int,
                  peers: Optional[Dict[int, str]] = None,
                  lease_duration: float = 2.0,
-                 hb_interval: Optional[float] = None):
+                 hb_interval: Optional[float] = None,
+                 page_limit: int = 512):
         api.role = "follower"
         api.leader_url = leader_url
         api.replica_rank = rank
@@ -113,6 +114,10 @@ class ReplicationTail:
         self.api = api
         self.leader_url = leader_url
         self.lease_duration = lease_duration
+        # Streaming paged bootstrap (docs/SCALE.md): objects arrive as
+        # json lines in pages of this size — a 50k-node snapshot never
+        # rides one response body on either side.
+        self.page_limit = max(1, int(page_limit))
         # Heartbeats several times per lease period: one lost HB must not
         # look like a dead leader.
         self.hb = hb_interval if hb_interval is not None \
@@ -172,11 +177,59 @@ class ReplicationTail:
             raise RuntimeError(
                 f"snapshot source {self.leader_url} is not the current "
                 f"leader: {st}")
-        snap = self._get_json(self.leader_url + "/replication/snapshot",
-                              timeout=max(10.0, self.lease_duration * 4))
-        self.api.install_snapshot(snap)
+        self.api.install_snapshot(self._fetch_snapshot_stream())
         self.bootstraps += 1
         self.last_contact = time.monotonic()
+
+    def _fetch_snapshot_stream(self) -> dict:
+        """Consume the STREAMING paged bootstrap
+        (`GET /replication/snapshot?limit=N`, docs/SCALE.md): SNAP_META,
+        then one json line per object, then SNAP_END. Lines are parsed as
+        they arrive (bounded buffering — the snapshot never exists as one
+        response body or one parse on either side); a torn stream (no
+        SNAP_END: the leader died mid-bootstrap) raises and is NEVER
+        installed. The meta's role is re-checked — pages may have been
+        served across a demotion."""
+        import http.client as _hc
+
+        host = self.leader_url.split("//", 1)[1]
+        conn = _hc.HTTPConnection(
+            host, timeout=max(10.0, self.lease_duration * 4))
+        try:
+            conn.request(
+                "GET", f"/replication/snapshot?limit={self.page_limit}")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                resp.read()
+                raise RuntimeError(
+                    f"snapshot stream: HTTP {resp.status}")
+            snap: Optional[dict] = None
+            objs: Dict[str, list] = {"pods": [], "nodes": []}
+            complete = False
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                d = json.loads(line)
+                typ = d.get("type")
+                if typ == "SNAP_META":
+                    if d.get("role") != "leader":
+                        raise RuntimeError(
+                            "snapshot source demoted mid-stream")
+                    snap = {k: d[k] for k in
+                            ("epoch", "seq", "repl", "leases") if k in d}
+                elif typ == "SNAP_END":
+                    complete = True
+                    break
+                elif d.get("kind") in objs:
+                    objs[d["kind"]].append(d["object"])
+            if snap is None or not complete:
+                raise RuntimeError("snapshot stream torn before SNAP_END")
+            snap["pods"] = objs["pods"]
+            snap["nodes"] = objs["nodes"]
+            return snap
+        finally:
+            conn.close()
 
     # -- the tail loop ------------------------------------------------------
 

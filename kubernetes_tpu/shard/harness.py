@@ -42,6 +42,21 @@ def _env() -> dict:
 _KA_CLIENTS: Dict[str, object] = {}
 
 
+def rss_mb(pid=None) -> float:
+    """VmRSS of `pid` (default: this process) in MiB, straight from
+    /proc/<pid>/status — 0.0 when unreadable (process gone, non-Linux).
+    The bench/perf poll loops sample this so the bounded-memory claims
+    are numbers, not assertions."""
+    try:
+        with open(f"/proc/{pid or 'self'}/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except (OSError, ValueError, IndexError):
+        pass
+    return 0.0
+
+
 def _call(base: str, method: str, path: str, body=None, timeout: float = 30):
     """Pooled keep-alive call (core/apiserver.py KeepAliveClient): the
     creator threads POST thousands of pods — per-call connection setup
@@ -184,7 +199,19 @@ class ShardedCluster:
         # apiserver processes the shards read from (writes redirect).
         self.follower_procs = list(follower_procs or ())
         self.follower_urls = list(follower_urls or ())
+        # Hollow-node plane process (kubernetes_tpu/hollow/), when the run
+        # impersonates its nodes instead of bulk-creating them.
+        self.hollow_proc = None
+        self.hollow_tail = None
         self.killed: List[int] = []
+        # Peak RSS (MiB) per process role, sampled by the progress poll
+        # loop (sample_rss) — the bounded-memory claim of the paged read
+        # plane as a measured number.
+        self.rss_peaks: Dict[str, object] = {
+            "apiserver": 0.0,
+            "shards": [0.0] * len(shard_procs),
+            "followers": [0.0] * len(self.follower_procs),
+        }
         # Keep every child's stdout pipe DRAINED for the cluster's whole
         # life: a logging burst (slow-step warnings after a fallback) into
         # an unread pipe blocks the child on write mid-cycle — measured as
@@ -192,6 +219,52 @@ class ShardedCluster:
         self.log_tails = [drain_pipe(p)
                           for p in [api_proc] + list(shard_procs)
                           + self.follower_procs]
+
+    def attach_hollow(self, proc) -> None:
+        from ..testing.faults import drain_pipe
+        self.hollow_proc = proc
+        self.hollow_tail = drain_pipe(proc)
+        self.log_tails.append(self.hollow_tail)
+        self.rss_peaks["hollow"] = 0.0
+
+    def sample_rss(self) -> Dict[str, object]:
+        """Fold the current per-process VmRSS into the peaks. Called from
+        the progress poll loop (one /proc read per process per poll)."""
+        peaks = self.rss_peaks
+        peaks["apiserver"] = max(peaks["apiserver"],
+                                 rss_mb(self.api_proc.pid))
+        for i, p in enumerate(self.shard_procs):
+            if p.poll() is None:
+                peaks["shards"][i] = max(peaks["shards"][i], rss_mb(p.pid))
+        for i, p in enumerate(self.follower_procs):
+            peaks["followers"][i] = max(peaks["followers"][i],
+                                        rss_mb(p.pid))
+        if self.hollow_proc is not None:
+            peaks["hollow"] = max(peaks["hollow"],
+                                  rss_mb(self.hollow_proc.pid))
+        return peaks
+
+    def stop_hollow(self) -> Optional[dict]:
+        """SIGTERM the hollow plane and collect its final stats line
+        (`{"hollow_stats": ...}`) from the drained tail."""
+        proc = self.hollow_proc
+        if proc is None:
+            return None
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+        self.hollow_proc = None
+        time.sleep(0.1)  # let the drain thread swallow the stats line
+        for line in reversed(list(self.hollow_tail or ())):
+            if "hollow_stats" in line:
+                try:
+                    return json.loads(line)["hollow_stats"]
+                except (ValueError, KeyError):
+                    return None
+        return None
 
     def kill(self, index: int) -> None:
         """SIGKILL one shard scheduler process — no goodbye, no flush."""
@@ -207,7 +280,9 @@ class ShardedCluster:
                 if i not in self.killed]
 
     def stop(self) -> None:
-        for p in self.shard_procs + self.follower_procs + [self.api_proc]:
+        extra = [self.hollow_proc] if self.hollow_proc is not None else []
+        for p in self.shard_procs + self.follower_procs + extra \
+                + [self.api_proc]:
             if p is not None and p.poll() is None:
                 p.terminate()
                 try:
@@ -315,6 +390,35 @@ def start_sharded_cluster(n_shards: int, lease_duration: float = 15.0,
                           follower_urls=follower_urls)
 
 
+def start_hollow_plane(base: str, profile, cwd: str, env: dict,
+                       timeout: float = 900.0):
+    """Spawn the hollow-node plane process (`python -m
+    kubernetes_tpu.hollow`) against `base` and block until its fleet is
+    registered. Returns (proc, registered_count)."""
+    import tempfile
+
+    from ..testing.faults import spawn_ready
+
+    prof_dict = (profile.to_dict() if hasattr(profile, "to_dict")
+                 else dict(profile))
+    fd, path = tempfile.mkstemp(prefix="hollow-profile-", suffix=".json")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            json.dump(prof_dict, fh)
+        cmd = [sys.executable, "-m", "kubernetes_tpu.hollow",
+               "--api-url", base, "--profile", path]
+        proc, m = spawn_ready(cmd, r"registered (\d+) nodes", cwd=cwd,
+                              env=env, timeout=timeout)
+    finally:
+        # The child reads the profile before printing its ready line —
+        # once spawn_ready returns (or fails), the file is garbage.
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    return proc, int(m.group(1))
+
+
 def run_sharded_cluster(
     n_shards: int,
     n_nodes: int,
@@ -331,6 +435,7 @@ def run_sharded_cluster(
     flightrec_dir: str = "",
     replicas: int = 0,
     repl_lease: float = 2.0,
+    hollow=None,
 ) -> dict:
     """The sharded SchedulingBasic shape end to end: create `n_nodes`,
     warm the shards with `warm_pods` (XLA compilation + first sessions land
@@ -339,11 +444,17 @@ def run_sharded_cluster(
     measured pod is bound. `progress_cb(bound_count, cluster)` fires on
     every poll — chaos tests churn nodes / SIGKILL shards from it.
 
+    With ``hollow`` set (a kubernetes_tpu/hollow profile dict or
+    HollowProfile), the `n_nodes` fleet is IMPERSONATED by a hollow-node
+    plane process — registration, heartbeats, capacity drift, and
+    cordon/delete/re-register churn all run against the leader for the
+    whole measured window — instead of being bulk-created inert.
+
     Returns the one-line-JSON-able result dict: pods/s, per-shard metric
-    scrapes, apiserver conflict counters, and a bound-exactly-once check
-    (the store can't hold duplicates, so 'duplicates' asserts bindings ==
-    bound pods)."""
-    from ..core.apiserver import node_to_wire, pod_to_wire
+    scrapes, apiserver conflict counters, peak per-process RSS, and a
+    bound-exactly-once check (the store can't hold duplicates, so
+    'duplicates' asserts bindings == bound pods)."""
+    from ..core.apiserver import fetch_paged, node_to_wire, pod_to_wire
     from ..testing.wrappers import make_node, make_pod
 
     cap = node_capacity or {"cpu": 32, "memory": "256Gi", "pods": 110}
@@ -364,13 +475,27 @@ def run_sharded_cluster(
                     lambda c: _call(base, "POST", path, c, timeout=120),
                     parts))
 
-        nodes = []
-        for i in range(n_nodes):
-            b = make_node().name(f"node-{i}").capacity(dict(cap))
-            if zones:
-                b = b.zone(f"zone-{i % zones}")
-            nodes.append(node_to_wire(b.obj()))
-        post_many("/api/v1/nodes", nodes)
+        if hollow is not None:
+            # Hollow-node plane: the fleet is impersonated (registered +
+            # heartbeated + churned) by its own process for the whole
+            # run, not bulk-created inert.
+            from ..hollow import HollowProfile
+            prof = (hollow if isinstance(hollow, HollowProfile)
+                    else HollowProfile.from_dict(dict(hollow)))
+            prof.count = n_nodes
+            if not prof.zones:
+                prof.zones = zones
+            hproc, _registered = start_hollow_plane(
+                base, prof, _repo_root(), _env(), timeout=timeout)
+            cluster.attach_hollow(hproc)
+        else:
+            nodes = []
+            for i in range(n_nodes):
+                b = make_node().name(f"node-{i}").capacity(dict(cap))
+                if zones:
+                    b = b.zone(f"zone-{i % zones}")
+                nodes.append(node_to_wire(b.obj()))
+            post_many("/api/v1/nodes", nodes)
 
         proto = make_pod().name("proto").req(dict(req)).labels(
             {"app": "sharded"}).obj()
@@ -413,6 +538,10 @@ def run_sharded_cluster(
                 # control plane more CPU than the binds themselves, CPU the
                 # shard schedulers need on a small box.
                 bound = poll_summary()["bound"]
+                # Peak-RSS sampling rides the existing poll cadence: the
+                # bounded-memory claim of the paged read plane is a
+                # sampled number in every detail line.
+                cluster.sample_rss()
                 if cb is not None:
                     cb(bound)
                 if bound >= target:
@@ -440,8 +569,12 @@ def run_sharded_cluster(
             if progress_cb is not None else None)
         elapsed = time.perf_counter() - t0
 
-        pods = _call(base, "GET", "/api/v1/pods", timeout=60)
+        # Exactly-once oracle read, PAGED (`?limit=&continue=`): even the
+        # harness's own final sweep never asks for a full-cluster
+        # single-response body — apiserver_list_unpaged_total stays 0.
+        pods = fetch_paged(base, "pods", limit=2000)
         bound = {p["uid"]: p["nodeName"] for p in pods if p["nodeName"]}
+        hollow_stats = cluster.stop_hollow() if hollow is not None else None
         shard_metrics = []
         e2e_hists = []
         watch_decode = []
@@ -508,6 +641,13 @@ def run_sharded_cluster(
                         # counter proving follower-served polls landed here
                         "cacheHits": int(rm.get(
                             "apiserver_watch_cache_hits_total", 0)),
+                        # paged-plane truth per replica: the shards list
+                        # from FOLLOWERS, so the zero-unpaged claim must
+                        # hold on every replica, not just the leader
+                        "listPages": int(rm.get(
+                            "apiserver_list_pages_total", 0)),
+                        "listUnpaged": int(rm.get(
+                            "apiserver_list_unpaged_total", 0)),
                     })
                 except Exception:  # noqa: BLE001 - replica down
                     replication.append({"url": url, "role": -1})
@@ -531,6 +671,10 @@ def run_sharded_cluster(
             "killed_shards": list(cluster.killed),
             "e2e_ms": e2e_ms,
             "flightrec_dir": flightrec_dir,
+            # Peak per-process RSS (MiB), sampled every progress poll —
+            # the bounded-memory claim as a number.
+            "rss_mb": cluster.sample_rss(),
+            "hollow": hollow_stats,
             # Where the progress/summary reads landed (follower-served read
             # plane) + one follower /metrics/resources scrape's series count.
             "read_plane": dict(read_counts,
@@ -539,7 +683,8 @@ def run_sharded_cluster(
             "api": {k: v for k, v in api_metrics.items()
                     if "conflict" in k or "lease" in k
                     or "replication" in k or "failover" in k
-                    or "watch" in k},
+                    or "watch" in k or "list" in k
+                    or "snapshot" in k or "heartbeat" in k},
             "shard_metrics": [
                 {k: v for k, v in sm.items()
                  if k.startswith(("scheduler_shard_",

@@ -1,0 +1,221 @@
+"""Hollow-node scale plane (kubernetes_tpu/hollow/, docs/SCALE.md).
+
+Covers: profile roundtrip + deterministic shape mix + node-wire schema;
+plane lifecycle against a real apiserver (bulk registration, bulk
+heartbeats through the status sink, capacity drift as real node updates,
+cordon/delete/re-register churn keeping the fleet size constant); a
+scheduler binding pods against a hollow fleet while churn runs
+(exactly-once); and the `python -m kubernetes_tpu.hollow` process the
+shard/perf harness spawns.
+"""
+
+import json
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubernetes_tpu.core import Scheduler
+from kubernetes_tpu.core.apiserver import (
+    APIServer,
+    HTTPClientset,
+    node_from_wire,
+)
+from kubernetes_tpu.hollow import HollowNodePlane, HollowProfile, NodeShape
+from kubernetes_tpu.testing.wrappers import make_pod
+
+
+def _wait(pred, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def api():
+    server = APIServer()
+    port = server.serve(0)
+    try:
+        yield server, f"http://127.0.0.1:{port}"
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# profile
+# ---------------------------------------------------------------------------
+
+
+class TestProfile:
+    def test_dict_roundtrip(self):
+        prof = HollowProfile(
+            count=321, zones=7, heartbeat_s=12.0, drift=0.25,
+            churn_per_s=1.5,
+            shapes=[NodeShape(weight=3),
+                    NodeShape(weight=1, cpu=96, memory="1Ti", pods=250,
+                              labels={"pool": "big"},
+                              taints=[{"key": "big",
+                                       "effect": "NoSchedule"}])])
+        again = HollowProfile.from_dict(prof.to_dict())
+        assert again.to_dict() == prof.to_dict()
+
+    def test_shape_mix_is_weighted_and_deterministic(self):
+        prof = HollowProfile(
+            count=1000,
+            shapes=[NodeShape(weight=3, cpu=32),
+                    NodeShape(weight=1, cpu=96)])
+        picks = [prof.shape_for(i).cpu for i in range(1000)]
+        assert picks == [prof.shape_for(i).cpu for i in range(1000)]
+        big = sum(1 for c in picks if c == 96)
+        assert 150 < big < 350     # ~1/4 of the fleet
+        # single-shape profile: everything is that shape
+        assert all(HollowProfile(count=10).shape_for(i).cpu == 32
+                   for i in range(10))
+
+    def test_low_weight_shapes_never_quantize_to_zero(self):
+        """A 1-in-100 shape must still get ~1% of a big fleet — a fixed
+        modular period would round it down to ZERO nodes."""
+        prof = HollowProfile(
+            count=50000,
+            shapes=[NodeShape(weight=99, cpu=32),
+                    NodeShape(weight=1, cpu=96)])
+        big = sum(1 for i in range(50000) if prof.shape_for(i).cpu == 96)
+        assert 300 < big < 700     # ~500 expected
+
+    def test_node_wire_decodes_through_the_server_codec(self):
+        prof = HollowProfile(
+            count=4, zones=2,
+            shapes=[NodeShape(cpu=16, memory="64Gi", pods=55,
+                              labels={"pool": "x"},
+                              taints=[{"key": "k", "value": "v",
+                                       "effect": "NoSchedule"}],
+                              scalars={"example.com/foo": 3})])
+        node = node_from_wire(prof.node_wire(1))
+        assert node.name == "hollow-1" and node.uid == "hollow-1"
+        assert node.allocatable.milli_cpu == 16000
+        assert node.allocatable.allowed_pod_number == 55
+        assert node.allocatable.scalar_resources == {"example.com/foo": 3}
+        assert node.labels["pool"] == "x"
+        assert node.labels["topology.kubernetes.io/zone"] == "zone-1"
+        assert node.labels["kubernetes.io/hostname"] == "hollow-1"
+        assert node.taints[0].key == "k"
+
+
+# ---------------------------------------------------------------------------
+# plane lifecycle against a real apiserver
+# ---------------------------------------------------------------------------
+
+
+class TestPlane:
+    def test_register_heartbeat_drift_churn(self, api):
+        server, base = api
+        prof = HollowProfile(
+            count=120, zones=6, heartbeat_s=0.8, drift=0.3,
+            churn_per_s=8.0, churn_cordon_s=0.05, register_chunk=50,
+            shapes=[NodeShape(weight=2),
+                    NodeShape(weight=1, cpu=96, labels={"pool": "big"})])
+        plane = HollowNodePlane(base, prof)
+        assert plane.register() == 120
+        assert len(server.store.nodes) == 120
+        assert sum(1 for n in server.store.nodes.values()
+                   if n.labels.get("pool") == "big") > 20
+        plane.start()
+        try:
+            _wait(lambda: plane.heartbeats >= 240,
+                  msg="two full heartbeat sweeps")
+            # bulk heartbeats landed on the server's sink, per node
+            assert server.node_heartbeats >= 120
+            _wait(lambda: plane.drifts >= 5, msg="capacity drift")
+            # a drifted node's allocatable really changed in the store
+            drifted = [n for n in server.store.nodes.values()
+                       if n.allocatable.milli_cpu
+                       not in (32000, 96000)]
+            assert drifted
+            _wait(lambda: plane.deletes >= 3 and plane.reregisters >= 3,
+                  msg="churn waves")
+            assert plane.cordons >= plane.deletes
+        finally:
+            plane.stop()
+        # fleet size stays constant through churn: every delete was
+        # matched by a replacement registration
+        assert len(server.store.nodes) == 120
+        assert any(n.startswith("hollow-r")
+                   for n in server.store.nodes)
+        assert plane.errors == 0
+        stats = plane.stats()
+        assert stats["live"] == 120 and stats["registered"] == 120
+
+    def test_scheduler_binds_against_hollow_fleet_under_churn(self, api):
+        """Exactly-once scheduling against an impersonated fleet while
+        cordon/delete/re-register waves run — the hollow plane's events
+        flow through the same watch plane as real node churn."""
+        server, base = api
+        prof = HollowProfile(count=40, zones=4, heartbeat_s=1.0,
+                             drift=0.1, churn_per_s=4.0,
+                             churn_cordon_s=0.05)
+        plane = HollowNodePlane(base, prof)
+        plane.register()
+        plane.start()
+        cs = HTTPClientset(base)
+        sched = Scheduler(clientset=cs)
+        try:
+            _wait(lambda: len(cs.nodes) >= 40, msg="fleet in cache")
+            pods = [make_pod().name(f"p{i}").req(
+                {"cpu": "100m", "memory": "64Mi"}).obj()
+                for i in range(30)]
+            for p in pods:
+                cs.create_pod(p)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                sched.run_until_idle()
+                if len(server.store.bindings) >= 30:
+                    break
+                time.sleep(0.05)
+            bound = {u: n for u, n in server.store.bindings.items()}
+            assert len(bound) == 30
+            assert set(bound) == {p.uid for p in pods}
+            # every placement names a node that existed in the fleet
+            assert all(n.startswith("hollow") for n in bound.values())
+        finally:
+            plane.stop()
+            cs.close()
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the spawned process (what the shard/perf harness runs)
+# ---------------------------------------------------------------------------
+
+
+class TestHollowProcess:
+    def test_cli_registers_heartbeats_and_reports_stats(self, api, tmp_path):
+        server, base = api
+        prof_path = tmp_path / "profile.json"
+        prof_path.write_text(json.dumps(HollowProfile(
+            count=30, zones=3, heartbeat_s=0.5, churn_per_s=2.0,
+            churn_cordon_s=0.05).to_dict()))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.hollow",
+             "--api-url", base, "--profile", str(prof_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            line = proc.stdout.readline()
+            assert "registered 30 nodes" in line
+            assert len(server.store.nodes) == 30
+            _wait(lambda: server.node_heartbeats >= 30,
+                  msg="heartbeats from the process")
+            proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=15)
+            stats = json.loads(
+                [ln for ln in out.splitlines()
+                 if "hollow_stats" in ln][-1])["hollow_stats"]
+            assert stats["registered"] == 30
+            assert stats["heartbeats"] >= 30
+        finally:
+            if proc.poll() is None:
+                proc.kill()

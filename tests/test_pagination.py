@@ -1,0 +1,583 @@
+"""Streaming paged LIST (`?limit=&continue=`, docs/SCALE.md).
+
+Covers: list_page / continuation-token units; paged-vs-unpaged oracle
+equality over HTTP; the randomized pagination fuzz with concurrent writes
+between pages (window-contract during churn, exact equality quiesced) on
+BOTH leader and follower replicas; the continuation-off-ring 410 path and
+reflector RESUME-after-410 (TOO_OLD -> paged re-list, zero server-side
+full ADDED replays); and the streaming paged snapshot bootstrap.
+"""
+
+import json
+import random
+import threading
+import time
+from urllib import request as urlrequest
+
+import pytest
+
+from kubernetes_tpu.core.apiserver import (
+    APIServer,
+    HTTPClientset,
+    _shutdown_conn,
+    fetch_paged,
+    pod_to_wire,
+)
+from kubernetes_tpu.core.watchcache import (
+    WatchCache,
+    mint_continue,
+    parse_continue,
+)
+from kubernetes_tpu.replication import ReplicationTail
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _pod(name, cpu="1m"):
+    return make_pod().name(name).req({"cpu": cpu}).obj()
+
+
+# ---------------------------------------------------------------------------
+# units: page walking + continuation tokens
+# ---------------------------------------------------------------------------
+
+
+class TestListPageUnits:
+    def _fill(self, wc, n):
+        for i in range(1, n + 1):
+            w = pod_to_wire(_pod(f"p{i:03d}"))
+            event = {"type": "ADDED", "object": w, "rv": i}
+            wc.note_event(i, "ADDED", w,
+                          data=(json.dumps(event) + "\n").encode(),
+                          event=event)
+
+    def test_pages_reassemble_the_sorted_snapshot(self):
+        wc = WatchCache("pods")
+        self._fill(wc, 23)
+        for limit in (1, 4, 7, 23, 50):
+            got, last, anchor = [], "", None
+            while True:
+                objs, next_key, anchor, _rv = wc.list_page(
+                    limit, last_key=last, anchor_rv=anchor)
+                got.extend(objs)
+                if not next_key:
+                    break
+                last = next_key
+            keys = [o["uid"] for o in got]
+            assert keys == sorted(keys)
+            assert len(got) == 23, limit
+
+    def test_anchor_off_ring_is_410(self):
+        wc = WatchCache("pods", capacity=4)
+        self._fill(wc, 12)
+        # ring holds [9..12]: an anchor of 2 can no longer be replayed
+        assert wc.list_page(5, last_key="p002", anchor_rv=2) is None
+        assert wc.too_old >= 1
+        # a fresh anchor (head) still pages fine
+        objs, _nk, anchor, rv = wc.list_page(5)
+        assert len(objs) == 5 and anchor == rv == 12
+
+    def test_empty_snapshot_single_empty_page(self):
+        wc = WatchCache("pods")
+        objs, next_key, anchor, rv = wc.list_page(10)
+        assert objs == [] and next_key == "" and anchor == rv == 0
+
+    def test_continue_token_roundtrip_and_garbage(self):
+        tok = mint_continue(42, "pod-k", "ep1")
+        d = parse_continue(tok)
+        assert (d["rv"], d["k"], d["e"]) == (42, "pod-k", "ep1")
+        assert parse_continue("!!!not-base64!!!") is None
+        assert parse_continue("") is None
+        import base64
+        assert parse_continue(
+            base64.urlsafe_b64encode(b'{"rv": 1}').decode()) is None
+        # wrong TYPES inside valid JSON are malformed too (an int() crash
+        # in the page handler would tear the connection instead of 410)
+        for bad in (b'{"rv": "x", "k": "", "e": "ep"}',
+                    b'{"rv": true, "k": "", "e": "ep"}',
+                    b'{"rv": 1, "k": 2, "e": "ep"}',
+                    b'{"rv": 1, "k": "", "e": null}'):
+            assert parse_continue(
+                base64.urlsafe_b64encode(bad).decode()) is None
+
+    def test_reinstall_invalidates_sorted_key_cache(self):
+        """An install can land on the SAME (rv, size) stamp with different
+        keys (epoch-fork snapshot): the sorted-key cache must not serve
+        stale keys into a KeyError."""
+        wc = WatchCache("pods")
+        self._fill(wc, 5)
+        wc.list_page(3)   # populate the sorted-key cache at (5, 5)
+        other = [pod_to_wire(_pod(f"z{i}")) for i in range(5)]
+        wc.reinstall(other, 5)   # same rv, same size, different keys
+        objs, _nk, _a, _rv = wc.list_page(10)
+        assert {o["uid"] for o in objs} == {w["uid"] for w in other}
+
+
+# ---------------------------------------------------------------------------
+# HTTP: paged == unpaged oracle; 410 paths
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def api():
+    server = APIServer()
+    port = server.serve(0)
+    try:
+        yield server, f"http://127.0.0.1:{port}"
+    finally:
+        server.shutdown()
+
+
+class TestPagedListHTTP:
+    def test_paged_equals_unpaged_oracle(self, api):
+        server, base = api
+        server.store.create_node(make_node().name("n0").capacity(
+            {"cpu": 64, "memory": "64Gi", "pods": 100}).obj())
+        pods = [_pod(f"p{i}") for i in range(37)]
+        for p in pods:
+            server.store.create_pod(p)
+        server._bind_one(pods[0].uid, "n0")
+        paged = fetch_paged(base, "pods", limit=5)
+        with urlrequest.urlopen(base + "/api/v1/pods", timeout=10) as r:
+            oracle = json.loads(r.read())
+        key = lambda w: w["uid"]  # noqa: E731
+        assert sorted(paged, key=key) == sorted(oracle, key=key)
+        assert server.list_pages >= 8          # ceil(37/5) pages
+        assert server.list_unpaged == 1        # only the oracle read
+        nodes = fetch_paged(base, "nodes", limit=1)
+        assert [n["name"] for n in nodes] == ["n0"]
+
+    def test_malformed_continue_is_410(self, api):
+        server, base = api
+        server.store.create_pod(_pod("p0"))
+        import base64
+        crafted = base64.urlsafe_b64encode(
+            json.dumps({"rv": "x", "k": "", "e": server.epoch})
+            .encode()).decode()
+        for token in ("garbage", crafted):
+            req = urlrequest.Request(
+                base + f"/api/v1/pods?limit=5&continue={token}")
+            with pytest.raises(Exception) as ei:
+                urlrequest.urlopen(req, timeout=10)
+            assert getattr(ei.value, "code", None) == 410
+        assert server.list_continue_410 >= 2
+
+    def test_expired_continue_is_410_then_restart_completes(self):
+        server = APIServer(backlog=8)
+        port = server.serve(0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            for i in range(10):
+                server.store.create_pod(_pod(f"p{i:02d}"))
+            # First page by hand, keeping its continuation token.
+            import http.client as hc
+            conn = hc.HTTPConnection("127.0.0.1", port, timeout=10)
+            conn.request("GET", "/api/v1/pods?limit=3")
+            resp = conn.getresponse()
+            token = ""
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                d = json.loads(line)
+                if d.get("type") == "PAGE":
+                    token = d.get("continue") or ""
+            assert token
+            # Overflow the ring (capacity 8) past the anchor.
+            for i in range(20):
+                server.store.create_pod(_pod(f"q{i:02d}"))
+            conn.request("GET", f"/api/v1/pods?limit=3&continue={token}")
+            resp = conn.getresponse()
+            assert resp.status == 410
+            resp.read()
+            conn.close()
+            assert server.list_continue_410 >= 1
+            # fetch_paged restarts from scratch and completes.
+            got = fetch_paged(base, "pods", limit=3)
+            assert len(got) == 30
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# reflector RESUME-after-410: TOO_OLD -> paged re-list, never a full replay
+# ---------------------------------------------------------------------------
+
+
+class TestReflectorPagedRelist:
+    def test_too_old_triggers_paged_relist_not_full_replay(self):
+        server = APIServer(backlog=8)
+        port = server.serve(0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            for i in range(4):
+                server.store.create_pod(_pod(f"p{i}"))
+            cs = HTTPClientset(base)
+            try:
+                _wait(lambda: (cs._last_rv["pods"] or 0)
+                      >= server._seq["pods"], msg="watch live")
+                relists0 = cs.relists["pods"]
+                for conn in list(cs._responses):
+                    _shutdown_conn(conn)
+                for i in range(30):
+                    server.store.create_pod(_pod(f"q{i}"))
+                _wait(lambda: len(cs.pods) == 34, msg="post-overflow sync")
+                # the reconnect rode TOO_OLD -> paged re-list...
+                assert cs.relists["pods"] > relists0
+                assert server.watch_cache["pods"].too_old >= 1
+                # ...and the server NEVER served a full ADDED replay: a
+                # paged client's re-list is pages, not a materialized
+                # stream queue.
+                assert server.relisted_watches == 0
+                assert server.list_pages > 0
+                # the re-attached stream is live: a late create arrives
+                server.store.create_pod(_pod("late"))
+                _wait(lambda: len(cs.pods) == 35, msg="live after re-list")
+            finally:
+                cs.close()
+        finally:
+            server.shutdown()
+
+    def test_server_restart_new_epoch_paged_relist(self):
+        server = APIServer()
+        port = server.serve(0)
+        base = f"http://127.0.0.1:{port}"
+        for i in range(6):
+            server.store.create_pod(_pod(f"p{i}"))
+        cs = None
+        server2 = None
+        try:
+            cs = HTTPClientset(base)
+            _wait(lambda: len(cs.pods) == 6, msg="initial sync")
+            server.shutdown()
+            # A NEW server generation on the same port (fresh epoch, fresh
+            # rv counters): the stale-epoch reconnect must ride
+            # TOO_OLD -> paged re-list, never resume into foreign history.
+            server2 = APIServer()
+            server2.serve(port)
+            for i in range(3):
+                server2.store.create_pod(_pod(f"r{i}"))
+            _wait(lambda: set(cs.pods) == set(server2.store.pods),
+                  timeout=20, msg="re-list against the new epoch")
+            assert server2.relisted_watches == 0
+            assert server2.list_pages > 0
+        finally:
+            if cs is not None:
+                cs.close()
+            if server2 is not None:
+                server2.shutdown()
+
+
+class TestFreshFilteredAttach:
+    def test_selector_transition_in_list_to_attach_gap_upgrades_slims(
+            self, api):
+        """A shard-filtered paged list slims while selector_refs == 0; a
+        selector source lands BEFORE the fresh watch attach. The attach
+        must upgrade everything the list slimmed immediately (full
+        rv-less MODIFIEDs) — waiting for the next event would leave
+        label-less slims in the cache forever on a quiet cluster."""
+        from kubernetes_tpu.core.watchcache import shard_of_wire
+
+        server, base = api
+        pods = [make_pod().name(f"p{i}").req({"cpu": "1m"})
+                .labels({"app": "x"}).obj() for i in range(8)]
+        for p in pods:
+            server.store.create_pod(p)
+        anchor = server._seq["pods"]
+        foreign = {p.uid for p in pods
+                   if shard_of_wire({"uid": p.uid, "podGroup": ""}, 2) != 0}
+        assert foreign  # crc spread: some pods are foreign to shard 0
+        # the transition lands in the list->attach gap
+        server.store.create_pod(
+            make_pod().name("s").req({"cpu": "1m"})
+            .spread_constraint(1, "zone").obj())
+        import http.client as hc
+        conn = hc.HTTPConnection("127.0.0.1", int(base.rsplit(":", 1)[1]),
+                                 timeout=10)
+        conn.request(
+            "GET", f"/api/v1/pods?watch=true&paged=true&fresh=true"
+                   f"&shard=0/2&resourceVersion={anchor}"
+                   f"&epoch={server.epoch}")
+        resp = conn.getresponse()
+        try:
+            assert resp.status == 200
+            upgraded = set()
+            saw_resume = saw_spread = False
+            deadline = time.monotonic() + 10
+            while upgraded != foreign and time.monotonic() < deadline:
+                d = json.loads(resp.readline())
+                typ = d.get("type")
+                if typ == "RESUME":
+                    saw_resume = True
+                elif typ == "ADDED" and d["object"].get("name") == "s":
+                    saw_spread = True   # replayed transition event, full
+                    assert not d["object"].get("slim")
+                elif typ == "MODIFIED" and d.get("rv") is None:
+                    obj = d["object"]
+                    assert not obj.get("slim")
+                    assert obj.get("labels") == {"app": "x"}
+                    upgraded.add(obj["uid"])
+            assert saw_resume and saw_spread
+            assert upgraded == foreign
+        finally:
+            conn.close()
+
+
+# ---------------------------------------------------------------------------
+# the pagination fuzz: random page sizes + concurrent writes between pages
+# ---------------------------------------------------------------------------
+
+
+class _ChurnWriter:
+    """Background creates/deletes/binds against an in-process server,
+    tracking the uid sets the window contract is asserted against."""
+
+    def __init__(self, server, seed=0):
+        self.server = server
+        self.rng = random.Random(seed)
+        self.lock = threading.Lock()
+        self.live = {}          # uid -> pod
+        self.created = set()    # every uid ever created
+        self.deleted = set()
+        self._stop = threading.Event()
+        self._seq = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def _run(self):
+        while not self._stop.is_set():
+            roll = self.rng.random()
+            with self.lock:
+                if roll < 0.55 or not self.live:
+                    self._seq += 1
+                    p = _pod(f"w{self._seq:05d}")
+                    self.server.store.create_pod(p)
+                    self.live[p.uid] = p
+                    self.created.add(p.uid)
+                elif roll < 0.8:
+                    uid = self.rng.choice(list(self.live))
+                    self.server.store.delete_pod(self.live.pop(uid))
+                    self.deleted.add(uid)
+                else:
+                    uid = self.rng.choice(list(self.live))
+                    self.server._bind_one(uid, "n0")
+            time.sleep(0.001)
+
+
+def _paged_random(base, kind, rng, server=None):
+    """One paged list with a RANDOM page size per request — exercises the
+    token chain across uneven pages. Restarts on 410."""
+    import http.client as hc
+    host = base.split("//", 1)[1]
+    conn = hc.HTTPConnection(host, timeout=30)
+    try:
+        for _ in range(20):
+            out, token, expired = [], "", False
+            while True:
+                limit = rng.randint(1, 40)
+                path = f"/api/v1/{kind}?limit={limit}"
+                if token:
+                    path += f"&continue={token}"
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                if resp.status == 410:
+                    resp.read()
+                    expired = True
+                    break
+                assert resp.status == 200
+                token = ""
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    d = json.loads(line)
+                    if d.get("type") == "PAGE":
+                        token = d.get("continue") or ""
+                    elif d.get("object") is not None:
+                        out.append(d["object"])
+                if not token:
+                    return out
+            if not expired:
+                return out
+        raise AssertionError("paged list never completed (kept expiring)")
+    finally:
+        conn.close()
+
+
+def _run_fuzz(server, read_base, rounds=6, seed=7,
+              contract_store=None, converged=lambda: True):
+    """The fuzz body, shared by the leader and follower variants: churn
+    while paging (window contract per round), then quiesce and assert the
+    paged result is IDENTICAL to the unpaged oracle. ``contract_store``
+    is the store BEHIND ``read_base`` (the follower's own store when
+    paging a replica) — the window contract is asserted against what the
+    serving replica actually held."""
+    contract_store = contract_store or server.store
+    server.store.create_node(make_node().name("n0").capacity(
+        {"cpu": 10000, "memory": "1Ti", "pods": 100000}).obj())
+    for i in range(60):
+        server.store.create_pod(_pod(f"seed{i:03d}"))
+    _wait(converged, timeout=20, msg="seed convergence")
+    rng = random.Random(seed)
+    writer = _ChurnWriter(server, seed=seed).start()
+    try:
+        for _round in range(rounds):
+            with writer.lock:
+                before_alive = set(contract_store.pods)
+            got = _paged_random(read_base, "pods", rng)
+            got_uids = {w["uid"] for w in got}
+            with writer.lock:
+                after_alive = set(contract_store.pods)
+                deleted_during = set(writer.deleted)
+                created_ever = set(writer.created)
+            # Window contract (docs/SCALE.md): every pod alive on the
+            # serving replica through the whole list appears exactly
+            # once; pods created/deleted DURING the list may or may not;
+            # nothing else can.
+            stable = before_alive & after_alive
+            missing = stable - got_uids - deleted_during
+            assert not missing, f"stable pods missing: {missing}"
+            phantom = got_uids - before_alive - created_ever
+            assert not phantom, f"phantom pods: {phantom}"
+            assert len(got_uids) == len(got), "duplicate uid in one list"
+    finally:
+        writer.stop()
+    # Quiesced: paged (random page sizes) == unpaged oracle, exactly —
+    # including bind state.
+    _wait(converged, timeout=20, msg="replica convergence")
+    with urlrequest.urlopen(read_base + "/api/v1/pods",
+                            timeout=30) as r:
+        oracle = {w["uid"]: w.get("nodeName", "")
+                  for w in json.loads(r.read())}
+    for _ in range(3):
+        got = _paged_random(read_base, "pods", rng)
+        assert {w["uid"]: w.get("nodeName", "") for w in got} == oracle
+    return writer
+
+
+class TestPaginationFuzz:
+    def test_fuzz_on_leader(self, api):
+        server, base = api
+        _run_fuzz(server, base)
+
+    def test_fuzz_on_follower_replica(self):
+        leader = APIServer()
+        leader.serve(0)
+        follower = APIServer()
+        tail = ReplicationTail(follower, leader.advertise_url, rank=1,
+                               lease_duration=5.0, page_limit=16)
+        try:
+            tail.bootstrap()
+            fport = follower.serve(0)
+            tail.start()
+            _run_fuzz(
+                leader, f"http://127.0.0.1:{fport}",
+                contract_store=follower.store,
+                converged=lambda: (
+                    follower._seq == leader._seq
+                    and len(follower.store.pods) == len(leader.store.pods)))
+            # the cold bootstrap streamed PAGES, not one body
+            assert leader.snapshot_bootstrap_pages >= 1
+            assert tail.bootstraps == 1
+        finally:
+            tail.stop()
+            follower.shutdown()
+            leader.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# streaming paged snapshot bootstrap
+# ---------------------------------------------------------------------------
+
+
+class TestPagedSnapshotBootstrap:
+    def test_cold_follower_pages_the_bootstrap(self):
+        leader = APIServer()
+        leader.serve(0)
+        leader.store.create_node(make_node().name("n0").capacity(
+            {"cpu": 64, "memory": "64Gi", "pods": 500}).obj())
+        pods = [_pod(f"p{i:03d}") for i in range(90)]
+        for p in pods:
+            leader.store.create_pod(p)
+        leader._bind_one(pods[0].uid, "n0")
+        leader.upsert_lease("shard-0", "holder-a", 5.0)
+        follower = APIServer()
+        tail = ReplicationTail(follower, leader.advertise_url, rank=1,
+                               lease_duration=5.0, page_limit=7)
+        try:
+            tail.bootstrap()
+            assert len(follower.store.pods) == 90
+            assert len(follower.store.nodes) == 1
+            assert follower.store.bindings.get(pods[0].uid) == "n0"
+            assert any(rec["name"] == "shard-0"
+                       for rec in follower.list_leases())
+            assert follower.epoch == leader.epoch
+            assert follower._repl_seq == leader._repl_seq
+            # ceil(90/7) pod pages + 1 node page at least
+            assert leader.snapshot_bootstrap_pages >= 14
+        finally:
+            tail.stop()
+            follower.shutdown()
+            leader.shutdown()
+
+    def test_torn_snapshot_stream_is_never_installed(self):
+        """A stream without SNAP_END (leader died mid-bootstrap) must
+        raise, not install a partial store."""
+        leader = APIServer()
+        port = leader.serve(0)
+        for i in range(10):
+            leader.store.create_pod(_pod(f"p{i}"))
+        follower = APIServer()
+        tail = ReplicationTail(follower, f"http://127.0.0.1:{port}",
+                               rank=1, lease_duration=5.0, page_limit=3)
+
+        class _TornResp:
+            """Wrap the response: deliver a few lines, then EOF early."""
+
+            def __init__(self, resp):
+                self._resp = resp
+                self._served = 0
+
+            @property
+            def status(self):
+                return self._resp.status
+
+            def read(self, *a):
+                return self._resp.read(*a)
+
+            def readline(self):
+                self._served += 1
+                if self._served > 4:
+                    return b""   # torn: connection died mid-stream
+                return self._resp.readline()
+
+        import http.client as hc
+        orig_getresponse = hc.HTTPConnection.getresponse
+
+        def torn_getresponse(conn):
+            return _TornResp(orig_getresponse(conn))
+
+        hc.HTTPConnection.getresponse = torn_getresponse
+        try:
+            with pytest.raises(Exception, match="torn|SNAP_END"):
+                tail._fetch_snapshot_stream()
+        finally:
+            hc.HTTPConnection.getresponse = orig_getresponse
+            follower.shutdown()
+            leader.shutdown()
+        assert len(follower.store.pods) == 0

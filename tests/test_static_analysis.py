@@ -370,6 +370,47 @@ class TestWatchCacheLockFixtures:
 
 
 # ---------------------------------------------------------------------------
+# fixture corpus: lock-discipline — paged-LIST continuation path (PR 11)
+# ---------------------------------------------------------------------------
+
+
+BAD_CONTINUATION = textwrap.dedent("""
+    class Server:
+        def serve_page(self, limit, last_key):
+            with self._write_lock:                        # continuation
+                objs = self.watch_cache["pods"].list_page(limit, last_key)
+                token = mint_continue(1, last_key, "e")   # minted under it
+            return objs, token
+""")
+
+GOOD_CONTINUATION = textwrap.dedent("""
+    class Server:
+        def serve_page(self, limit, last_key):
+            objs = self.watch_cache["pods"].list_page(limit, last_key)
+            token = mint_continue(1, last_key, "e")       # lock-free mint
+            return objs, token
+""")
+
+
+class TestContinuationLockFixtures:
+    def test_flags_page_serving_and_minting_under_write_lock(self):
+        """The continuation-serving path is a READ: a 50k-node paged list
+        serialized against the bind plane stalls it once per page."""
+        fs = check_source(checker_by_id("lock-discipline"), BAD_CONTINUATION)
+        assert _rules(fs) == ["no-read-serving-under-write-lock"]
+        assert len(fs) == 2   # the page serve AND the token mint
+
+    def test_passes_lock_free_continuation(self):
+        assert check_source(checker_by_id("lock-discipline"),
+                            GOOD_CONTINUATION) == []
+
+    def test_scope_covers_hollow_plane(self):
+        c = checker_by_id("lock-discipline")
+        assert c.applies_to("hollow/plane.py")
+        assert c.applies_to("kubernetes_tpu/hollow/plane.py")
+
+
+# ---------------------------------------------------------------------------
 # fixture corpus: jit-purity
 # ---------------------------------------------------------------------------
 
