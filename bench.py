@@ -248,6 +248,13 @@ def main(trace: bool = False):
     for a in WINDOW:
         d = getattr(sched, a, 0) - win0[a]
         detail[a] = round(d, 3) if isinstance(d, float) else d
+    # Score-hint fast path engagement (models/score_hints.py): the share of
+    # the window's pods bound host-side off the signature-keyed hint, with
+    # zero device dispatches. A/B the dispatch-only baseline with
+    # TPU_SCHED_SCORE_HINTS=0 on the same harness.
+    if hasattr(sched, "hint_hits") and scheduled:
+        detail["hint_hit_rate"] = round(detail.get("hint_hits", 0)
+                                        / scheduled, 4)
     # e2e latency detail line (queue admission -> bound; fed from span ends
     # on EVERY bound pod — docs/OBSERVABILITY.md).
     e2e = sched.metrics.e2e_scheduling_duration

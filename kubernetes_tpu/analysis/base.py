@@ -90,8 +90,9 @@ def register(cls: Type[Checker]) -> Type[Checker]:
 
 def all_checkers() -> List[Checker]:
     # Import the checker modules for their registration side effect.
-    from . import (index_dtype, jit_purity, lock_discipline,  # noqa: F401
-                   metrics_discipline, span_discipline, thread_hygiene)
+    from . import (hint_freshness, index_dtype, jit_purity,  # noqa: F401
+                   lock_discipline, metrics_discipline, span_discipline,
+                   thread_hygiene)
     return [cls() for _, cls in sorted(_REGISTRY.items())]
 
 

@@ -1,0 +1,173 @@
+"""hint-freshness checker: NodeInfo-accounting mutations must be visible
+to the score-hint cache.
+
+Incident class (ISSUE 12): the score-hint fast path (models/score_hints.py)
+binds identical replicas host-side off a per-node walk state whose
+freshness is EVENT-DRIVEN — it survives exactly the changes the journal
+classification records (core/cache.py EventJournal) plus the counters its
+serve() fences (``attempts``, ``state_unwinds``, ``reconcile_unwinds``, the
+conflict hook). A code path that mutates the cache's NodeInfo accounting
+(``cache.assume_pod`` / ``forget_pod`` / ``add_pod`` / ``remove_pod`` /
+``update_pod``) WITHOUT being on that call graph would silently stale the
+hint: the walker keeps serving placements computed against rows that no
+longer reflect the cluster — the exact bug class the always-dispatch
+oracle can never hit, and the hardest to catch in review because the
+mutation looks innocent locally.
+
+Rule ``accounting-outside-invalidation-graph``: in the scheduler layers
+(``core/scheduler.py``, ``models/``), every function that calls a cache
+NodeInfo-accounting mutator must be on the hint-invalidation call graph —
+i.e. some same-module call-graph slice containing the mutation also
+contains an invalidation sink:
+
+- a journal record (``_record_event`` / ``_record_pod_event``), or
+- a serve-fence counter bump (``attempts`` / ``state_unwinds`` /
+  ``reconcile_unwinds`` assignment), or
+- an explicit hint-cache call (``_hints.<anything>`` /
+  ``_note_bind_conflict``, the per-node conflict hook).
+
+"Slice" is computed over the module's own call graph (bare/self method
+calls), in both directions: the sink may live in the mutating function, in
+a transitive callee, or in a caller whose callee closure contains both the
+mutation and a sink (the ``process_one → scheduling_cycle`` shape, where
+the attempt counter bumps one frame above the assume).
+
+Snapshot what-if mutations (``snapshot.assume_pod`` — gang simulations)
+are exempt by construction: the chain is matched on a ``cache`` base.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .base import (Checker, Finding, ModuleSource, attr_chain, register)
+
+SCOPE = ("core/scheduler.py", "models/")
+
+MUTATORS = {"assume_pod", "forget_pod", "add_pod", "remove_pod",
+            "update_pod"}
+SINK_CALLS = {"_record_event", "_record_pod_event", "_note_bind_conflict"}
+SINK_COUNTERS = {"attempts", "state_unwinds", "reconcile_unwinds"}
+HINT_ATTRS = {"_hints"}
+
+
+def _is_accounting_mutation(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    chain = attr_chain(call.func)
+    return (len(chain) >= 2 and chain[-1] in MUTATORS
+            and "cache" in chain[:-1])
+
+
+def _fn_facts(fn: ast.AST):
+    """(mutation linenos, has_sink, called same-module names) for one def."""
+    mutations: List[int] = []
+    has_sink = False
+    calls: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            if _is_accounting_mutation(node):
+                mutations.append(node.lineno)
+            chain = attr_chain(node.func)
+            if chain:
+                if chain[-1] in SINK_CALLS:
+                    has_sink = True
+                if any(part in HINT_ATTRS for part in chain[:-1]):
+                    has_sink = True  # self._hints.<anything>(...)
+                # candidate same-module call: bare f() or self.f(...)
+                if (len(chain) == 1
+                        or (len(chain) == 2 and chain[0] == "self")):
+                    calls.add(chain[-1])
+        elif isinstance(node, (ast.AugAssign, ast.Assign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                tc = attr_chain(t)
+                if tc and tc[-1] in SINK_COUNTERS:
+                    has_sink = True
+    return mutations, has_sink, calls
+
+
+@register
+class HintFreshnessChecker(Checker):
+    id = "hint-freshness"
+    description = ("cache NodeInfo-accounting mutations stay on the "
+                   "score-hint invalidation call graph (journal record, "
+                   "serve-fence counter, or hint-cache call in the same "
+                   "call-graph slice)")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(SCOPE[1]) or relpath == SCOPE[0]
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        tree = mod.tree
+        if tree is None:
+            return []
+        # EVERY def is scanned individually (lock-discipline's lesson:
+        # duplicate method names across classes — Handle vs Scheduler
+        # delegates — must not shadow each other). Call-graph edges stay
+        # name-level (a `self.f()` cannot be resolved to one class here),
+        # so per-NAME facts merge each name's defs: calls union, sink OR.
+        defs: List = []  # (name, mutations, has_sink, calls)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mutations, has_sink, calls = _fn_facts(node)
+                defs.append((node.name, mutations, has_sink, calls))
+        name_sink: Dict[str, bool] = {}
+        name_calls: Dict[str, Set[str]] = {}
+        for name, _m, sink, calls in defs:
+            name_sink[name] = name_sink.get(name, False) or sink
+            name_calls.setdefault(name, set()).update(calls)
+        # reach(name): same-module callee-name closure
+        reach_memo: Dict[str, Set[str]] = {}
+
+        def reach(name: str) -> Set[str]:
+            got = reach_memo.get(name)
+            if got is not None:
+                return got
+            reach_memo[name] = out = set()
+            stack = [name]
+            while stack:
+                for callee in name_calls.get(stack.pop(), ()):
+                    if callee not in out and callee in name_calls:
+                        out.add(callee)
+                        stack.append(callee)
+            return out
+
+        def closure_has_sink(names) -> bool:
+            return any(name_sink.get(n, False) for n in names)
+
+        def def_covered(name: str, own_sink: bool, calls: Set[str]) -> bool:
+            if own_sink:
+                return True
+            # callee direction, seeded from THIS def's own call set
+            down: Set[str] = set()
+            for c in calls:
+                if c in name_calls:
+                    down.add(c)
+                    down |= reach(c)
+            if closure_has_sink(down):
+                return True
+            # caller direction: a function whose callee-name closure
+            # contains this def's NAME and a sink covers the mutation
+            for g, _m, g_sink, _c in defs:
+                gr = reach(g)
+                if name in gr and (g_sink or closure_has_sink(gr)):
+                    return True
+            return False
+
+        out: List[Finding] = []
+        for name, mutations, own_sink, calls in defs:
+            if not mutations or def_covered(name, own_sink, calls):
+                continue
+            for line in mutations:
+                out.append(Finding(
+                    self.id, "accounting-outside-invalidation-graph",
+                    mod.path, line,
+                    f"{name}() mutates cache NodeInfo accounting but no "
+                    "call-graph slice through it records a journal event, "
+                    "bumps a serve-fence counter (attempts/state_unwinds/"
+                    "reconcile_unwinds), or touches the hint cache — a "
+                    "live score hint would keep serving stale rows"))
+        return out

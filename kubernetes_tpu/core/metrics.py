@@ -320,6 +320,34 @@ class SchedulerMetrics:
         self.get_node_hint_duration = r(Histogram(
             "scheduler_get_node_hint_duration_seconds",
             "Batch reuse lookup latency (session-resume check)."))
+        # score-hint fast path (models/score_hints.py; KEP-5598
+        # OpportunisticBatch, cross-cycle)
+        self.hint_cache_hits = r(Counter(
+            "scheduler_hint_cache_hits_total",
+            "Pods bound through the score-hint fast path (no device "
+            "dispatch), by matching signature kind: 'exact' | 'neutral' "
+            "(namespace-erased).", ("reason",)))
+        self.hint_cache_misses = r(Counter(
+            "scheduler_hint_cache_misses_total",
+            "Hint-path fall-throughs to the normal batch, by reason: "
+            "'empty' = no live hint, 'signature' = different pod shape, "
+            "'stale' = freshness fence tripped (see invalidations), "
+            "'infeasible' = no node passed the hinted walk, plus "
+            "pod-eligibility reasons (claims/unsupported/extender/"
+            "unsignable/profile/affinity_gate).", ("reason",)))
+        self.hint_cache_invalidations = r(Counter(
+            "scheduler_hint_cache_invalidations_total",
+            "Hint invalidations, by reason: journal event kinds "
+            "(pod_terms/pns_taint/structural/other/namespace), "
+            "'journal_gap', 'foreign_attempt', 'state_unwind', "
+            "'nomination', 'affinity_transition' (0->1 affinity-pod "
+            "transition disables hints cluster-wide), 'bind_conflict' "
+            "(single-NODE invalidation, the hint survives), "
+            "'device_failure'.", ("reason",)))
+        self.hint_validation_duration = r(Histogram(
+            "scheduler_hint_validation_duration_seconds",
+            "Host-side hint validate+select latency per consulted pod "
+            "(journal replay + the kernel's selection math in numpy)."))
         # shard plane (kubernetes_tpu/shard/): optimistic multi-scheduler
         self.bind_conflict_total = r(Counter(
             "scheduler_bind_conflict_total",

@@ -451,9 +451,15 @@ class PriorityQueue:
 
     def _new_qpi(self, pod: Pod) -> QueuedPodInfo:
         ts = self.now()
+        # A pod that already rode the queue (conflict requeue via
+        # on_async_bind_error, generic async-error re-add) keeps its
+        # ORIGINAL admission instant: pop() stamps it on the pod, so
+        # scheduler_e2e_scheduling_duration_seconds covers the whole
+        # conflict-retry span instead of restarting at the requeue.
         return QueuedPodInfo(
             pod_info=PodInfo.of(pod), timestamp=ts,
-            initial_attempt_timestamp=None, enqueued_at=ts,
+            initial_attempt_timestamp=None,
+            enqueued_at=pod.__dict__.get("_enqueued_at", ts),
         )
 
     def add(self, pod: Pod) -> None:
@@ -679,6 +685,14 @@ class PriorityQueue:
         qpi.attempts += 1
         if qpi.initial_attempt_timestamp is None:
             qpi.initial_attempt_timestamp = self.now()
+        eq = getattr(qpi, "enqueued_at", None)
+        pi = getattr(qpi, "pod_info", None)
+        if eq is not None and pi is not None:
+            # Stamp the admission instant on the pod itself: requeue paths
+            # that only have the Pod (async bind conflicts build a fresh
+            # QueuedPodInfo) recover it in _new_qpi, keeping the e2e
+            # histogram honest across conflict retries.
+            pi.pod.__dict__["_enqueued_at"] = eq
         self._in_flight[qpi.uid] = len(self._event_log)
         return qpi
 

@@ -547,7 +547,7 @@ class Scheduler:
             # onto the node (note `old` may alias the scheduler's mutated
             # object, so old.node_name can't distinguish the transition —
             # the assumed set can).
-            pass
+            self._note_own_bind_confirm(new)
         else:
             self._record_pod_event(kind, old, new)
         if kind == "add":
@@ -615,6 +615,12 @@ class Scheduler:
                     EVENT_ASSIGNED_POD_DELETE, new, None)
             else:
                 self.queue.delete(new)
+
+    def _note_own_bind_confirm(self, new: Pod) -> None:
+        """Seam: the watch stream confirmed one of OUR binds (the pod is in
+        the assumed set and arrived bound). Subclasses settle any
+        optimistic-commit bookkeeping here — models/tpu_scheduler.py drops
+        the score-hint take-back tag, since no 409 can follow a confirm."""
 
     def _record_pod_event(self, kind: str, old: Optional[Pod], new: Pod) -> None:
         """Journal classification for a non-benign watch pod event."""

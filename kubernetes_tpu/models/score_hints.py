@@ -1,0 +1,523 @@
+"""Signature-keyed score-hint fast path: bind identical replicas without a
+device dispatch.
+
+The reference's opportunistic batching (KEP-5598, framework/runtime/batch.go
+OpportunisticBatch) caches the previous cycle's sorted score list keyed by
+pod signature so the next identical pod gets a node hint and skips
+filter/score entirely. This module is that cache's TPU-era form: when a
+device session ends cleanly, the session's final carry — per-node requested
+aggregates plus the carried fit/balance score vector, i.e. the kernel's OWN
+sorted-score truth — is persisted host-side, keyed by the session's exact
+AND namespace-erased neutral signature and stamped with the
+cluster_event_seq it reflects. The next identical pod then walks the hinted
+score vector entirely on the host: a numpy replica of the kernel's
+scores_carried/incremental_feas selection (the only shapes eligible — see
+``hint_eligible``) picks the SAME node the kernel would, the pod binds
+through the existing commit tail (bulk-binding path included), and the
+walker applies the placement to its own row state — a host-only bind loop
+with the device reserved for novel signatures.
+
+Exactness contract: hint placements must be bit-identical to the
+always-dispatch oracle. That holds because eligibility is restricted to
+plans where the kernel itself proves the total score row-local
+(``scores_carried``: no spread/IPA/NA-pref normalization, no
+PreferNoSchedule counts) and feasibility row-local (``incremental_feas``
+with no anti/affinity axes at all — ``BatchPlan.pod_local``), so the walk
+is the kernel's scan step with the dead lanes removed: same int64 fit/BA
+arithmetic (ops/kernel.py _resource_eval), same adaptive-sampling
+truncation and rotation (schedule_one.go:779-892 emulation), same
+max-score-then-min-rotation packed selection.
+
+Freshness is event-driven, not TTL-driven (the journal decides which hints
+survive — core/cache.py EventJournal):
+
+    event kind          hint survival
+    ------------------  ------------------------------------------------
+    queue               free (nothing node-side moved)
+    namespace           free while no affinity-term pod exists
+    pod_add/remove/upd  plain pod: re-encode that ROW from cache truth
+                        (and unblock a 409-blocked row); terms: killed
+    node_update         re-validate that ROW's taints/alloc/unschedulable
+                        (labels/images intact by the kind's contract);
+                        a PreferNoSchedule taint kills the hint (the plan
+                        compiled the no-PNS fast path)
+    structural/other    killed
+    journal gap         killed (anything may have changed)
+
+Out-of-journal state moves are fenced by counters the serve path checks:
+any scheduling attempt the walker did not make itself (``attempts``), any
+cache unwind (``state_unwinds``), any nomination change
+(``Nominator.version``), and the cluster-wide 0→1 affinity-pod transition
+(``cache.affinity_pod_refs`` — mirroring the watch plane's selector gate)
+all invalidate. A bind-409 invalidates the hinted NODE only: the row is
+blocked until the winner's commit re-encodes it through the journal.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..api.types import PREFER_NO_SCHEDULE
+from ..core.cache import (EV_NAMESPACE, EV_NODE_UPDATE, EV_POD_ADD,
+                          EV_POD_REMOVE, EV_POD_UPDATE, EV_QUEUE)
+
+MAX_NODE_SCORE = 100
+_BA_SCALE = 1_000_000
+
+
+def hint_eligible(plan, mesh, aux_shape, head_pod, extenders,
+                  nominator, affinity_pod_refs: int) -> bool:
+    """Can a clean session of this shape seed a score hint? Mirrors the
+    kernel's scores_carried ∧ incremental_feas preconditions (the walk
+    replicates exactly that fast path) plus the host-side state the walk
+    does not model: counted claims, extenders, nominated lanes, sharded
+    meshes, and any live affinity-term pod (cluster-wide disable — the
+    0→1 transition mirrors the watch plane's selector gate)."""
+    return (mesh is None
+            and plan.pod_local
+            and not (plan.has_pns or plan.has_ipa_base or plan.has_na_pref
+                     or plan.port_selfblock or plan.has_aux or plan.has_nom)
+            and aux_shape == (None, None)
+            and not head_pod.volumes
+            and not getattr(head_pod, "resource_claims", None)
+            and not extenders
+            and not nominator.has_nominated_pods()
+            and affinity_pod_refs == 0)
+
+
+class HintEntry:
+    """One live hint: the per-node walk state for one pod signature."""
+
+    __slots__ = (
+        "keys", "fw_id", "pod", "node_names", "row_of",
+        "NP", "num", "to_find",
+        # pod-spec facts (ints / small np vectors)
+        "request", "nz_request", "has_request", "ba_skip",
+        "fit_slots", "fit_weights", "fit_strategy",
+        "w_tt", "w_fit", "w_ba", "w_il", "tolerates_unsched", "enable",
+        # per-node state (np arrays, entry-owned copies)
+        "alloc_r", "alloc_pods", "req_r", "nonzero", "pod_count",
+        "static_ok", "fit_ok", "fit_sc", "ba", "total", "ok", "blocked",
+        "il_score", "sel_ok", "extra_ok", "name_ok", "valid", "_idx",
+        # freshness watermarks
+        "seq", "attempts", "unwinds", "nom_version",
+        # scalar-slot interning view (read-only; a slot the map lacks
+        # cannot affect this plan — its request is zero)
+        "scalar_slots",
+    )
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_session(cls, sched, fw, head_pod, sig, nsig, plan, node_names,
+                     carry) -> "HintEntry":
+        """Capture the session's end state. `carry` is the final ScanCarry —
+        its req_r/nonzero/pod_count/fit_ok/fit_sc/ba ARE the kernel's
+        post-commit truth, so copying them (one device→host fetch) makes
+        the walk bit-identical to what the next dispatch would compute."""
+        e = cls()
+        e.keys = {("exact", sig)}
+        if nsig is not None:
+            e.keys.add(("neutral", nsig))
+        e.fw_id = id(fw)
+        e.pod = head_pod
+        e.node_names = list(node_names)
+        e.row_of = {n: i for i, n in enumerate(node_names)}
+        f = plan.features
+        mirror = sched.mirror
+        e.NP = int(mirror.np_cap)
+        e.num = max(int(np.asarray(f.num_nodes)), 1)
+        e.to_find = int(np.asarray(f.to_find))
+        e._idx = np.arange(e.NP, dtype=np.int64)
+        # pod-spec facts
+        e.request = np.asarray(f.request).astype(np.int64)
+        e.nz_request = np.asarray(f.nz_request).astype(np.int64)
+        e.has_request = int(np.asarray(f.has_request))
+        e.ba_skip = int(np.asarray(f.ba_skip))
+        e.fit_slots = np.asarray(f.fit_slots).astype(np.int64)
+        e.fit_weights = np.asarray(f.fit_weights).astype(np.int64)
+        e.fit_strategy = int(plan.fit_strategy)
+        w = np.asarray(f.weights)
+        e.w_tt, e.w_fit, e.w_ba, e.w_il = (
+            int(w[0]), int(w[1]), int(w[4]), int(w[6]))
+        e.tolerates_unsched = int(np.asarray(f.tolerates_unsched))
+        e.enable = tuple(int(x) for x in np.asarray(f.enable))
+        e.scalar_slots = mirror.scalar_slots
+        # per-node dynamic state: the carry's own arrays (post-commit truth)
+        e.req_r = np.asarray(carry.req_r).astype(np.int64).copy()
+        e.nonzero = np.asarray(carry.nonzero).astype(np.int64).copy()
+        e.pod_count = np.asarray(carry.pod_count).astype(np.int64).copy()
+        e.fit_ok = np.asarray(carry.fit_ok).astype(bool).copy()
+        e.fit_sc = np.asarray(carry.fit_sc).astype(np.int64).copy()
+        e.ba = np.asarray(carry.ba).astype(np.int64).copy()
+        # per-node static state (mirror staging is in line after adopt())
+        e.alloc_r = mirror.h_alloc_r.astype(np.int64).copy()
+        e.alloc_pods = mirror.h_alloc_pods.astype(np.int64).copy()
+        e.il_score = np.asarray(f.il_score).astype(np.int64)
+        e.sel_ok = np.asarray(f.sel_match).astype(bool)
+        e.extra_ok = np.asarray(f.extra_ok).astype(bool)
+        e.valid = mirror.h_valid.copy() & (e._idx < e.num)
+        nid = int(np.asarray(f.node_name_id))
+        e.name_ok = ((nid == 0) | (mirror.h_name_id == nid)
+                     | (e.enable[0] == 0))
+        e.static_ok = e.valid & e.name_ok & e.sel_ok_effective() \
+            & e.extra_ok & e._taint_unsched_ok(mirror, f)
+        e.blocked = np.zeros(e.NP, bool)
+        e.total = (e.w_tt * MAX_NODE_SCORE + e.w_fit * e.fit_sc
+                   + e.w_ba * e.ba + e.w_il * e.il_score)
+        e.ok = e.static_ok & e.fit_ok & ~e.blocked
+        # freshness watermarks
+        e.seq = sched.cluster_event_seq
+        e.attempts = sched.attempts
+        e.unwinds = sched.state_unwinds
+        e.nom_version = sched.queue.nominator.version
+        return e
+
+    def sel_ok_effective(self) -> np.ndarray:
+        return self.sel_ok | (self.enable[3] == 0)
+
+    def _taint_unsched_ok(self, mirror, f) -> np.ndarray:
+        """Vectorized _static_masks taint + unschedulable verdicts over the
+        staging arrays (ops/kernel.py semantics, numpy)."""
+        from ..ops.codebook import (EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE,
+                                    OP_EXISTS)
+        tk = np.asarray(f.tol_key)
+        tv = np.asarray(f.tol_val)
+        te = np.asarray(f.tol_eff)
+        to = np.asarray(f.tol_op)
+        k = mirror.h_taint_key[:, :, None]
+        v = mirror.h_taint_val[:, :, None]
+        ef = mirror.h_taint_eff[:, :, None]
+        if tk.shape[0]:
+            eff_ok = (te[None, None, :] == 0) | (te[None, None, :] == ef)
+            key_ok = (tk[None, None, :] == 0) | (tk[None, None, :] == k)
+            val_ok = (to[None, None, :] == OP_EXISTS) | (tv[None, None, :] == v)
+            tolerated = (eff_ok & key_ok & val_ok).any(axis=2)
+        else:
+            tolerated = np.zeros(mirror.h_taint_key.shape, bool)
+        relevant = ((mirror.h_taint_eff == EFFECT_NO_SCHEDULE)
+                    | (mirror.h_taint_eff == EFFECT_NO_EXECUTE))
+        taint_ok = ~(relevant & ~tolerated).any(axis=1) | (self.enable[2] == 0)
+        unsched_ok = (~mirror.h_unsched | (self.tolerates_unsched == 1)
+                      | (self.enable[1] == 0))
+        return taint_ok & unsched_ok
+
+    # -- the walk (the kernel's scores_carried scan step, host-side) --------
+
+    def select(self, start: int) -> Tuple[int, int]:
+        """One pod's selection against the current walk state: returns
+        (row or -1, evaluated) where `evaluated` advances the rotation
+        exactly as the kernel's window-boundary reduction does."""
+        num, NP, to_find = self.num, self.NP, self.to_find
+        start = start % num
+        ok = self.ok
+        F = np.cumsum(ok, dtype=np.int64)
+        total_feas = int(F[-1])
+        idx = self._idx
+        f_start = int(F[start - 1]) if start > 0 else 0
+        rank = np.where(idx >= start, F - f_start,
+                        F + total_feas - f_start)
+        rot = (idx - start) % num
+        boundary = ok & (rank == to_find)
+        mx = int(np.max(np.where(boundary, num - 1 - rot, 0))) \
+            if NP else 0
+        evaluated = num - mx
+        kept = ok & (rank <= to_find)
+        if not total_feas:
+            return -1, evaluated
+        key = np.where(kept, self.total * NP + (NP - 1 - rot), -1)
+        best = int(key.max())
+        if best < 0:
+            return -1, evaluated
+        chosen_rot = (NP - 1) - (best % NP)
+        return (start + chosen_rot) % num, evaluated
+
+    def apply(self, row: int) -> None:
+        """Commit one placement into the walk state (the scan's carry
+        update restricted to the landed row)."""
+        self.req_r[row] += self.request
+        self.nonzero[row] += self.nz_request
+        self.pod_count[row] += 1
+        self._reval_row(row)
+
+    # -- row re-evaluation (ops/kernel.py _resource_eval, one row) ----------
+
+    def _reval_row(self, row: int) -> None:
+        alloc = self.alloc_r[row]
+        pods_ok = int(self.pod_count[row]) + 1 <= int(self.alloc_pods[row])
+        avail = alloc - self.req_r[row]
+        viol = bool(((self.request > 0) & (self.request > avail)).any())
+        fit_ok = ((pods_ok and (not viol or self.has_request == 0))
+                  or self.enable[4] == 0)
+        used0 = int(self.nonzero[row, 0]) + int(self.nz_request[0])
+        used1 = int(self.nonzero[row, 1]) + int(self.nz_request[1])
+        num_ = den = 0
+        for j in range(self.fit_slots.shape[0]):
+            slot = int(self.fit_slots[j])
+            wj = int(self.fit_weights[j])
+            a = int(alloc[slot])
+            if slot == 0:
+                used = used0
+            elif slot == 1:
+                used = used1
+            else:
+                used = int(self.req_r[row, slot]) + int(self.request[slot])
+            if self.fit_strategy == 0:  # LeastAllocated
+                rscore = ((a - used) * MAX_NODE_SCORE // max(a, 1)
+                          if (a > 0 and used <= a) else 0)
+            else:  # MostAllocated
+                rscore = (min(used, a) * MAX_NODE_SCORE // max(a, 1)
+                          if a > 0 else 0)
+            if a > 0:
+                num_ += rscore * wj
+                den += wj
+        fit_sc = num_ // max(den, 1) if den > 0 else 0
+        a_cpu, a_mem = int(alloc[0]), int(alloc[1])
+        q_cpu = min(used0 * _BA_SCALE // max(a_cpu, 1), _BA_SCALE)
+        q_mem = min(used1 * _BA_SCALE // max(a_mem, 1), _BA_SCALE)
+        if self.ba_skip == 1:
+            ba = 0
+        elif a_cpu > 0 and a_mem > 0:
+            ba = (MAX_NODE_SCORE * _BA_SCALE
+                  - 50 * abs(q_cpu - q_mem)) // _BA_SCALE
+        else:
+            ba = MAX_NODE_SCORE
+        self.fit_ok[row] = fit_ok
+        self.fit_sc[row] = fit_sc
+        self.ba[row] = ba
+        self.total[row] = (self.w_tt * MAX_NODE_SCORE + self.w_fit * fit_sc
+                           + self.w_ba * ba + self.w_il * int(self.il_score[row]))
+        self.ok[row] = (bool(self.static_ok[row]) and fit_ok
+                        and not self.blocked[row])
+
+    # -- event-driven freshness (the journal replay) ------------------------
+
+    def block_row(self, node: str) -> bool:
+        """Bind-409: the hint's view of this node understates committed
+        usage — exclude the row until a journal pod event re-encodes it
+        from cache truth (the winner's commit arrives as exactly that)."""
+        row = self.row_of.get(node)
+        if row is None:
+            return False
+        self.blocked[row] = True
+        self.ok[row] = False
+        return True
+
+    def _resource_vec(self, r) -> np.ndarray:
+        """Entry-width resource vector. Scalar resources the interning map
+        lacks are ignored: this plan's request for them is zero by
+        construction, so they cannot move its fit filter or scores."""
+        out = np.zeros(self.req_r.shape[1], np.int64)
+        out[0] = r.milli_cpu
+        out[1] = r.memory
+        out[2] = r.ephemeral_storage
+        for name, amount in r.scalar_resources.items():
+            slot = self.scalar_slots.get(name)
+            if slot is not None and slot < out.shape[0]:
+                out[slot] = amount
+        return out
+
+    def _reencode_pod_row(self, cache, key: str) -> Optional[str]:
+        row = self.row_of.get(key)
+        ni = cache.nodes.get(key)
+        if row is None or ni is None or ni.node is None:
+            return "structural"  # row set changed shape after all
+        self.req_r[row] = self._resource_vec(ni.requested)
+        self.nonzero[row, 0] = ni.non_zero_requested.milli_cpu
+        self.nonzero[row, 1] = ni.non_zero_requested.memory
+        self.pod_count[row] = len(ni.pods)
+        self.blocked[row] = False  # post-conflict truth re-read
+        self._reval_row(row)
+        return None
+
+    def _revalidate_node_row(self, cache, key: str) -> Optional[str]:
+        """EV_NODE_UPDATE: taints/allocatable/unschedulable moved on one
+        row (labels/images/declared-features intact by the event kind's
+        contract, so sel/extra/name verdicts stay valid)."""
+        row = self.row_of.get(key)
+        ni = cache.nodes.get(key)
+        if row is None or ni is None or ni.node is None:
+            return "structural"
+        node = ni.node
+        if any(t.effect == PREFER_NO_SCHEDULE for t in node.taints):
+            # The plan compiled the no-PNS fast path (has_pns=False); the
+            # oracle would now score PreferNoSchedule counts.
+            return "pns_taint"
+        tols = self.pod.tolerations
+        taint_ok = (self.enable[2] == 0) or all(
+            any(tol.tolerates(t) for tol in tols)
+            for t in node.taints if t.effect != PREFER_NO_SCHEDULE)
+        unsched_ok = ((not node.unschedulable)
+                      or self.tolerates_unsched == 1
+                      or self.enable[1] == 0)
+        self.static_ok[row] = (bool(self.valid[row])
+                               and bool(self.name_ok[row])
+                               and bool(self.sel_ok_effective()[row])
+                               and bool(self.extra_ok[row])
+                               and taint_ok and unsched_ok)
+        self.alloc_r[row] = self._resource_vec(ni.allocatable)
+        self.alloc_pods[row] = ni.allocatable.allowed_pod_number
+        self._reval_row(row)
+        return None
+
+    def consume(self, sched, events) -> Optional[str]:
+        """Replay journal events into the walk state. Returns None when the
+        hint survives (rows patched as needed) or the invalidation reason."""
+        cache = sched.cache
+        for ev in events:
+            if ev.kind == EV_QUEUE:
+                continue
+            if ev.kind == EV_NAMESPACE:
+                if cache.affinity_pod_refs == 0:
+                    continue  # namespace labels feed only affinity selectors
+                return "namespace"
+            if ev.kind in (EV_POD_ADD, EV_POD_REMOVE, EV_POD_UPDATE):
+                if not ev.pod_plain:
+                    return "pod_terms"
+                reason = self._reencode_pod_row(cache, ev.key)
+                if reason:
+                    return reason
+            elif ev.kind == EV_NODE_UPDATE:
+                reason = self._revalidate_node_row(cache, ev.key)
+                if reason:
+                    return reason
+            else:
+                return ev.kind  # structural / other
+        return None
+
+
+class ScoreHintCache:
+    """The scheduler's single live hint + serve/install/invalidate
+    protocol. Counters live on the scheduler (WINDOW_COUNTERS surface);
+    labeled series on its SchedulerMetrics."""
+
+    def __init__(self, sched, enabled: bool = True):
+        self.sched = sched
+        self.enabled = enabled
+        self.entry: Optional[HintEntry] = None
+
+    # -- counters -----------------------------------------------------------
+
+    def _miss(self, reason: str) -> None:
+        self.sched.hint_misses += 1
+        self.sched.metrics.hint_cache_misses.inc(reason)
+
+    def _hit(self, kind: str) -> None:
+        self.sched.hint_hits += 1
+        self.sched.metrics.hint_cache_hits.inc(kind)
+
+    def invalidate(self, reason: str) -> None:
+        if self.entry is None:
+            return
+        self.entry = None
+        self.sched.hint_invalidations += 1
+        self.sched.metrics.hint_cache_invalidations.inc(reason)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def install(self, fw, head_pod, sig, nsig, plan, node_names,
+                carry) -> None:
+        if not self.enabled:
+            return
+        self.entry = HintEntry.from_session(
+            self.sched, fw, head_pod, sig, nsig, plan, node_names, carry)
+
+    def note_conflict(self, node: str) -> None:
+        """Bind-409 on `node`: invalidate the hint for that node ONLY. The
+        conflict's unwind (forget_pod) is absorbed — its entire effect is
+        on the blocked row, which re-encodes from cache truth when the
+        winner's commit lands through the journal."""
+        e = self.entry
+        if e is None:
+            return
+        if e.block_row(node):
+            e.unwinds += 1
+            self.sched.hint_invalidations += 1
+            self.sched.metrics.hint_cache_invalidations.inc("bind_conflict")
+        else:
+            self.invalidate("bind_conflict")
+
+    def note_own_attempt(self) -> None:
+        e = self.entry
+        if e is not None:
+            e.attempts += 1
+
+    # -- serve --------------------------------------------------------------
+
+    def serve(self, fw, pod) -> Optional[Tuple[HintEntry, str]]:
+        """Validate the live entry against `pod` and the world; returns
+        (entry, hit kind) when the hint path may bind this pod, else None
+        (counted as a miss; stale entries are dropped + counted as
+        invalidations)."""
+        if not self.enabled:
+            # The A/B seam (`_hints.enabled = False` /
+            # TPU_SCHED_SCORE_HINTS=0) must hold on a WARM scheduler too:
+            # a live entry installed before the flip may not keep serving,
+            # or the dispatch-only baseline is silently invalid.
+            self.entry = None
+            return None
+        e = self.entry
+        s = self.sched
+        if e is None:
+            self._miss("empty")
+            return None
+        if s.cache.affinity_pod_refs:
+            # 0→1 affinity-pod transition: hints disabled cluster-wide
+            # (labels/namespaces just became scheduling-relevant).
+            self.invalidate("affinity_transition")
+            self._miss("affinity_gate")
+            return None
+        sig = fw.sign_pod(pod)
+        if sig is None:
+            self._miss("unsignable")
+            return None
+        if id(fw) != e.fw_id:
+            self._miss("profile")
+            return None
+        if ("exact", sig) in e.keys:
+            kind = "exact"
+        else:
+            nsig = s._neutral_sig(fw, pod, sig)
+            if nsig is None or ("neutral", nsig) not in e.keys:
+                self._miss("signature")
+                return None
+            kind = "neutral"
+        if pod.volumes or getattr(pod, "resource_claims", None):
+            self._miss("claims")
+            return None
+        if s._batch_supported_memo(pod, fw) is not None:
+            self._miss("unsupported")
+            return None
+        if s.extenders and any(x.is_interested(pod) for x in s.extenders):
+            self._miss("extender")
+            return None
+        if s.queue.nominator.version != e.nom_version:
+            self.invalidate("nomination")
+            self._miss("stale")
+            return None
+        if s.attempts != e.attempts:
+            # A scheduling attempt the walker did not make (host path,
+            # device session, fall-through) moved cache state the journal
+            # does not record (own binds are deliberately benign there).
+            self.invalidate("foreign_attempt")
+            self._miss("stale")
+            return None
+        if s.state_unwinds != e.unwinds:
+            self.invalidate("state_unwind")
+            self._miss("stale")
+            return None
+        if s.cluster_event_seq != e.seq:
+            events = s.journal.since(e.seq)
+            if events is None:
+                self.invalidate("journal_gap")
+                self._miss("stale")
+                return None
+            reason = e.consume(s, events)
+            if reason is not None:
+                self.invalidate(reason)
+                self._miss("stale")
+                return None
+            e.seq = s.cluster_event_seq
+        return e, kind

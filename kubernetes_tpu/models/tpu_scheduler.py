@@ -183,6 +183,22 @@ class TPUScheduler(Scheduler):
         # Chaos seam (testing/faults.py DeviceFaults): called at every
         # device kernel boundary crossing; may raise.
         self._fault_hook = None
+        # Signature-keyed score-hint fast path (models/score_hints.py;
+        # KEP-5598 OpportunisticBatch, cross-cycle): a clean session's end
+        # carry seeds a host-side walk that binds the NEXT identical pods
+        # without any device dispatch. Event-driven freshness rides the
+        # journal; TPU_SCHED_SCORE_HINTS=0 forces the dispatch-only
+        # baseline (the bench A/B seam).
+        import os as _os
+        from .score_hints import ScoreHintCache
+        self._hints = ScoreHintCache(
+            self,
+            enabled=(self.device_enabled
+                     and _os.environ.get("TPU_SCHED_SCORE_HINTS", "1") != "0"
+                     and getattr(self.config, "score_hints", True)))
+        self.hint_hits = 0
+        self.hint_misses = 0
+        self.hint_invalidations = 0
 
     # -- batch accumulation ------------------------------------------------
 
@@ -782,6 +798,7 @@ class TPUScheduler(Scheduler):
             0.0 if self.device_breaker.allows() else 1.0)
         self.mirror.invalidate()
         self._resume = None
+        self._hints.invalidate("device_failure")
         self._placement_plan_cache = None
         self._placement_mask_cache = None
         self._fail_memo.clear()
@@ -791,6 +808,29 @@ class TPUScheduler(Scheduler):
     def _note_device_success(self) -> None:
         self.device_breaker.record_success()
         self.metrics.device_breaker_state.set(0.0)
+
+    def _note_bind_conflict(self, message: str, pod=None, node: str = "") -> None:
+        """Bind-409 (sync unwind or async dispatcher error): beyond the
+        base accounting, invalidate the score hint for the conflicted NODE
+        only — the winner's commit re-encodes the row through the journal
+        (docs/PERF.md hint-cache freshness contract). An async 409 also
+        takes back the optimistic hint hit: the loser was counted when its
+        thread-mode bind committed, and it will be counted again when it
+        actually binds."""
+        super()._note_bind_conflict(message, pod, node)
+        if pod is not None and pod.__dict__.pop("_hint_bound", False):
+            self.hint_hits = max(0, self.hint_hits - 1)
+        if node:
+            self._hints.note_conflict(node)
+
+    def _note_own_bind_confirm(self, new) -> None:
+        """The bind settled: drop the optimistic-hit take-back tag from the
+        SCHEDULER's assumed object (the watch copy replaces it in the cache
+        right after) — a later requeue of that object must not erase a hit
+        that really bound."""
+        st = self.cache.pod_states.get(new.uid)
+        if st is not None:
+            st.pod.__dict__.pop("_hint_bound", None)
 
     def _recover_qpi(self, qpi) -> None:
         """Host-path one entity stranded by a mid-session device failure.
@@ -1814,6 +1854,17 @@ class TPUScheduler(Scheduler):
             if sd.carry is not None and not dirty_rows:
                 self._save_resume(fw, first_batch[0].pod, sig, aux_shape,
                                   sd.state, plan, sd.carry, node_names)
+                # Score-hint install (the cross-cycle OpportunisticBatch
+                # save): the final host-commit completed cleanly, so the
+                # carry IS the kernel's sorted-score truth for the next
+                # identical pod — persist it for the host-only bind loop.
+                from .score_hints import hint_eligible
+                if self._hints.enabled and hint_eligible(
+                        plan, self.mesh, aux_shape, first_batch[0].pod,
+                        self.extenders, self.queue.nominator,
+                        self.cache.affinity_pod_refs):
+                    self._hints.install(fw, first_batch[0].pod, sig, nsig,
+                                        plan, node_names, sd.carry)
         # The session ran to completion (invalidation included — that is a
         # NORMAL end, not a device failure): a half-open breaker closes.
         self._note_device_success()
@@ -2057,6 +2108,98 @@ class TPUScheduler(Scheduler):
         self.queue.done(pod.uid)
         return True
 
+    # -- score-hint fast path (models/score_hints.py) ----------------------
+
+    def _try_hint_binds(self) -> int:
+        """Bind a run of identical replicas host-side off the live score
+        hint — the steady-state execution model for deployment-shaped
+        traffic: per pod, a cheap validate (journal replay + counters) and
+        the kernel's own selection math in numpy, then the existing commit
+        tail (bulk-binding path included). Any miss — signature, validation,
+        infeasibility — parks the entity in the holdover slot and returns,
+        so the normal batch path owns it. Returns pods bound."""
+        hints = self._hints
+        if hints.entry is None:
+            return 0
+        bound = 0
+        handled = 0
+        while True:
+            if bound and bound % 64 == 0:
+                # Surface thread-mode async bind errors (409 → per-node
+                # hint invalidation) while the loop runs.
+                self.process_async_api_errors()
+            qpi = self._pop()
+            if qpi is None:
+                if self._event_inbox:
+                    # Concurrent creators park pod-adds in the inbox
+                    # (queue-only events): drain so a creation burst does
+                    # not end the hint run early — the session refill seam.
+                    self.drain_event_inbox()
+                    qpi = self._pop()
+                if qpi is None:
+                    break
+            if (isinstance(qpi, (QueuedPodGroupInfo,
+                                 QueuedCompositeGroupInfo))
+                    or qpi.pod.scheduler_name not in self.profiles):
+                self._holdover = qpi
+                break
+            fw = self.framework_for_pod(qpi.pod)
+            _t0 = _time.perf_counter()
+            served = hints.serve(fw, qpi.pod)
+            if served is None:
+                # Misses pay validation too (a stale-entry journal replay
+                # is the EXPENSIVE path) — the histogram must see them.
+                self.metrics.hint_validation_duration.observe(
+                    _time.perf_counter() - _t0)
+                self._holdover = qpi
+                break
+            entry, kind = served
+            row, evaluated = entry.select(self.next_start_node_index)
+            self.metrics.hint_validation_duration.observe(
+                _time.perf_counter() - _t0)
+            if row < 0:
+                # No feasible node under the hint: the normal path owns the
+                # exact diagnosis (FitError / PostFilter) — fall through.
+                hints._miss("infeasible")
+                self._holdover = qpi
+                break
+            node = entry.node_names[row]
+            committed = self._commit(fw, qpi, node)
+            hints.note_own_attempt()
+            handled += 1
+            if not committed:
+                # A sync 409 already blocked the row via _note_bind_conflict
+                # (the pod re-enters through requeue_conflict); any other
+                # rejection moved state the next serve() fences. Either way
+                # the attempt was hint-path work — report it handled so the
+                # surviving hint keeps the NEXT replica off the device.
+                break
+            entry.apply(row)
+            self.next_start_node_index = (
+                self.next_start_node_index % entry.num + evaluated) % entry.num
+            bound += 1
+            if qpi.pod.uid not in self.waiting_pods:
+                # Hits count BINDS only. A Permit-WAIT park returns True
+                # from _commit with the pod assumed-but-unbound — the
+                # walker must apply the placement (it occupies the node),
+                # but the hit waits for a real bind (a rejected/expired
+                # waiter unwinds through state_unwinds, killing the hint).
+                hints._hit(kind)
+                if qpi.pod.uid in self.cache.assumed_pods:
+                    # Still assumed ⇒ the bind committed OPTIMISTICALLY
+                    # (thread-mode dispatcher; an inline clientset confirms
+                    # inside _commit and never reaches here). Tag the pod
+                    # so a later async 409 takes this hit back — hint_hits
+                    # must never exceed pods actually bound, or HintHitRate
+                    # reads > 1.0 on exactly the contended runs where it
+                    # matters. The tag is dropped at the own-bind confirm
+                    # (_note_own_bind_confirm): once settled, a later life
+                    # of the same object must not erase a real hit.
+                    qpi.pod.__dict__["_hint_bound"] = True
+            if hints.entry is not entry:
+                break  # invalidated mid-loop (conflict burst)
+        return handled
+
     # -- run loop ----------------------------------------------------------
 
     def schedule_one(self) -> bool:
@@ -2075,6 +2218,12 @@ class TPUScheduler(Scheduler):
                 return True
             return super().schedule_one()
         self.process_async_api_errors()
+        # Score-hint fast path FIRST: while a fresh hint matches the queue
+        # head, identical replicas bind in a host-only loop with zero
+        # device dispatches; the first miss falls through to the batch
+        # path below (the popped entity waits in the holdover slot).
+        if self._hints.entry is not None and self._try_hint_binds():
+            return True
         fw, batch, fallback_reason = self._collect_batch()
         if not batch:
             return False

@@ -72,14 +72,27 @@ def main(argv=None) -> int:
                 }
                 results.append(entry)
             thr = entry["threshold"] or 0
-            # Thresholds gate performance-labeled workloads only
-            # (scheduler_perf.go:282-368; harness.PerfResult.meets_thresholds)
-            asserted = "performance" in wl.labels
+            # Thresholds gate performance- AND hollow-labeled workloads —
+            # the SAME label gate as harness.PerfResult.meets_thresholds
+            # (scheduler_perf.go:282-368); hollow rows carry Max* RSS/
+            # unpaged-LIST ceilings that must assert here too.
+            asserted = ("performance" in wl.labels
+                        or "hollow" in wl.labels)
             try:
                 res = run_workload(wl)
                 tp = res.metrics.get("SchedulingThroughput", {})
                 avg = tp.get("Average", 0.0)
                 entry["runs"].append(round(avg, 1))
+                # Non-throughput thresholds (HintHitRate floor, Max*
+                # ceilings) assert per run too — every run must clear them.
+                for name, bound in wl.thresholds.items():
+                    if name == "SchedulingThroughput" or not asserted:
+                        continue
+                    got = res.metrics.get(name, {}).get("Average", 0.0)
+                    run_ok = (got <= bound if name.startswith("Max")
+                              else got >= bound)
+                    entry["other_thresholds_ok"] = (
+                        entry.get("other_thresholds_ok", True) and run_ok)
                 if run_i == 0:
                     entry.update({
                         "percentiles": {k: round(v, 1) for k, v in tp.items()},
@@ -88,6 +101,10 @@ def main(argv=None) -> int:
                         "wall_s": round(time.perf_counter() - t0, 1),
                         "detail": res.detail,
                     })
+                    extras = {k: v for k, v in res.metrics.items()
+                              if k != "SchedulingThroughput"}
+                    if extras:
+                        entry["metrics"] = extras
             except Exception as e:  # noqa: BLE001
                 entry["runs"].append(0.0)
                 entry.update({"error": repr(e),
@@ -98,7 +115,8 @@ def main(argv=None) -> int:
             entry["vs_baseline"] = round(worst / thr, 2) if thr else None
             entry["meets_threshold"] = (
                 "error" not in entry
-                and (not asserted or not thr or worst >= thr))
+                and (not asserted or not thr or worst >= thr)
+                and entry.get("other_thresholds_ok", True))
             print(json.dumps({"run": run_i + 1, "workload": key,
                               "pods_per_second": entry["runs"][-1],
                               "worst": worst}), flush=True)

@@ -151,7 +151,8 @@ class _ThroughputCollector:
     WINDOW_COUNTERS = ("plan_build_s", "device_wait_s", "host_commit_s",
                        "device_scheduled", "host_path_pods", "device_batches",
                        "plan_rebuilds_full", "plan_rebuilds_delta",
-                       "plan_rebuilds_resume", "delta_dirty_rows")
+                       "plan_rebuilds_resume", "delta_dirty_rows",
+                       "hint_hits", "hint_misses", "hint_invalidations")
 
     def start(self) -> None:
         self.active = True
@@ -182,6 +183,10 @@ class _ThroughputCollector:
             if v is not None:
                 d = v - self._win0.get(a, 0)
                 self.in_window[a] = round(d, 3) if isinstance(d, float) else d
+        # Window scheduled count + hint-hit rate (share of the window's
+        # pods bound via the score-hint fast path — the
+        # HomogeneousReplicaSurge threshold's denominator).
+        self.window_scheduled = total
         avg = total / elapsed if elapsed > 0 else 0.0
         s = sorted(self.samples) or [avg]
 
@@ -190,6 +195,18 @@ class _ThroughputCollector:
 
         return {"Average": avg, "Perc50": pct(0.50), "Perc90": pct(0.90),
                 "Perc95": pct(0.95), "Perc99": pct(0.99)}
+
+
+def _record_hint_hit_rate(result: "PerfResult",
+                          collector: _ThroughputCollector) -> None:
+    """HintHitRate metric (HomogeneousReplicaSurge threshold): the share of
+    the measured window's scheduled pods bound through the score-hint fast
+    path (models/score_hints.py) — zero/absent on host-only schedulers."""
+    hits = collector.in_window.get("hint_hits")
+    if hits is None:
+        return
+    rate = hits / max(1, getattr(collector, "window_scheduled", 0))
+    result.metrics["HintHitRate"] = {"Average": round(rate, 4)}
 
 
 def _make_node_from_template(i: int, tpl: Dict[str, Any]):
@@ -751,6 +768,7 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
                 _drain(sched, collector, tickers)
             if collect:
                 result.metrics["SchedulingThroughput"] = collector.stop()
+                _record_hint_hit_rate(result, collector)
                 result.detail["in_window"] = collector.in_window
         elif opcode == "deletePods":
             namespace = op.get("namespace", "default")
@@ -800,6 +818,7 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
             collector.start()
         elif opcode == "stopCollectingMetrics":
             result.metrics["SchedulingThroughput"] = collector.stop()
+            _record_hint_hit_rate(result, collector)
             result.detail["in_window"] = collector.in_window
         elif opcode == "createResourceSlices":
             # One slice per node with N devices (dra configs' resource-slice

@@ -688,7 +688,8 @@ def run_sharded_cluster(
             "shard_metrics": [
                 {k: v for k, v in sm.items()
                  if k.startswith(("scheduler_shard_",
-                                  "scheduler_bind_conflict"))}
+                                  "scheduler_bind_conflict",
+                                  "scheduler_hint_"))}
                 for sm in shard_metrics],
         }
     finally:

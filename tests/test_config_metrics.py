@@ -301,6 +301,10 @@ def test_metric_name_parity_with_reference():
                      "scheduler_device_path_breaker_open",
                      "scheduler_plan_rebuild_total",
                      "scheduler_plan_rebuild_dirty_rows_total",
+                     "scheduler_hint_cache_hits_total",
+                     "scheduler_hint_cache_misses_total",
+                     "scheduler_hint_cache_invalidations_total",
+                     "scheduler_hint_validation_duration_seconds",
                      "scheduler_bind_conflict_total",
                      "scheduler_shard_owned_shards",
                      "scheduler_shard_lease_renewals_total",

@@ -51,6 +51,13 @@ def _pair(n_nodes=24, max_batch=64, taints=None, mesh=None):
     rebuild (their aggregates also ride the adopt seam)."""
     host = Scheduler(deterministic_ties=True)
     dev = TPUScheduler(max_batch=max_batch, mesh=mesh)
+    # This suite asserts the SESSION path's delta machinery engages
+    # (plan_rebuilds_delta, carry continuation). The score-hint fast path
+    # (models/score_hints.py) would otherwise bind identical replicas
+    # before any session starts — it has its own engagement + equivalence
+    # suite in tests/test_hint_cache.py.
+    dev._hints.enabled = False
+    dev._hints.entry = None
     taints = taints or {}
     for s in (host, dev):
         for i in range(n_nodes):

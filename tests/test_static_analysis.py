@@ -697,6 +697,119 @@ class TestSpanDisciplineFixtures:
 
 
 # ---------------------------------------------------------------------------
+# fixture corpus: hint-freshness
+# ---------------------------------------------------------------------------
+
+
+class TestHintFreshnessFixtures:
+    """Cache NodeInfo-accounting mutations must be on the score-hint
+    invalidation call graph (ISSUE 12: a mutation the journal/fences never
+    see would silently stale a live hint)."""
+
+    def test_flags_unfenced_mutation(self):
+        bad = textwrap.dedent("""
+            class S:
+                def sneaky_rebalance(self, pod):
+                    # moves accounting with no journal record, no fence
+                    self.cache.forget_pod(pod)
+                    self.cache.assume_pod(pod)
+        """)
+        fs = check_source(checker_by_id("hint-freshness"), bad)
+        assert _rules(fs) == ["accounting-outside-invalidation-graph"]
+        assert len(fs) == 2
+
+    def test_passes_journaled_mutation(self):
+        good = textwrap.dedent("""
+            class S:
+                def on_event(self, kind, new):
+                    self._record_pod_event(kind, None, new)
+                    self.cache.add_pod(new)
+        """)
+        assert check_source(checker_by_id("hint-freshness"), good) == []
+
+    def test_passes_fence_counter_bump(self):
+        good = textwrap.dedent("""
+            class S:
+                def unwind(self, pod):
+                    self.state_unwinds += 1
+                    self.cache.forget_pod(pod)
+        """)
+        assert check_source(checker_by_id("hint-freshness"), good) == []
+
+    def test_passes_hint_cache_call(self):
+        good = textwrap.dedent("""
+            class S:
+                def conflict(self, pod, node):
+                    self.cache.forget_pod(pod)
+                    self._hints.note_conflict(node)
+        """)
+        assert check_source(checker_by_id("hint-freshness"), good) == []
+
+    def test_caller_direction_credits_the_slice(self):
+        """The process_one → scheduling_cycle shape: the assume lives one
+        frame below the attempt-counter bump — the SLICE has the sink."""
+        good = textwrap.dedent("""
+            class S:
+                def process_one(self, qpi):
+                    self.attempts += 1
+                    self.scheduling_cycle(qpi)
+                def scheduling_cycle(self, qpi):
+                    self.cache.assume_pod(qpi.pod)
+        """)
+        assert check_source(checker_by_id("hint-freshness"), good) == []
+
+    def test_callee_direction_credits_the_slice(self):
+        good = textwrap.dedent("""
+            class S:
+                def commit(self, pod):
+                    self.cache.assume_pod(pod)
+                    self.note_it()
+                def note_it(self):
+                    self.attempts += 1
+        """)
+        assert check_source(checker_by_id("hint-freshness"), good) == []
+
+    def test_snapshot_whatif_mutations_exempt(self):
+        """snapshot.assume_pod is a gang-simulation what-if, not cache
+        accounting — matched on the `cache` base, so exempt."""
+        good = textwrap.dedent("""
+            class S:
+                def simulate(self, pod):
+                    self.snapshot.assume_pod(pod)
+                    self.snapshot.forget_pod(pod)
+        """)
+        assert check_source(checker_by_id("hint-freshness"), good) == []
+
+    def test_unrelated_caller_does_not_credit(self):
+        """A sink-holding function that never reaches the mutator must not
+        launder it."""
+        bad = textwrap.dedent("""
+            class S:
+                def elsewhere(self):
+                    self.attempts += 1
+                def sneaky(self, pod):
+                    self.cache.forget_pod(pod)
+        """)
+        fs = check_source(checker_by_id("hint-freshness"), bad)
+        assert len(fs) == 1 and fs[0].line == 6
+
+    def test_duplicate_method_names_both_scanned(self):
+        """lock-discipline's lesson, re-learned here in review: a Handle
+        delegate sharing a Scheduler method's NAME must not shadow the
+        real def — the SECOND def's unfenced mutation is a finding."""
+        bad = textwrap.dedent("""
+            class Handle:
+                def reject_waiting_pod(self, uid):
+                    return self._scheduler.lookup(uid)
+            class S:
+                def reject_waiting_pod(self, uid):
+                    self.cache.forget_pod(uid)   # unfenced, 2nd def
+        """)
+        fs = check_source(checker_by_id("hint-freshness"), bad)
+        assert len(fs) == 1 and fs[0].line == 7
+
+
+# ---------------------------------------------------------------------------
 # the tree gate + allowlist policy
 # ---------------------------------------------------------------------------
 
@@ -714,8 +827,9 @@ def test_tree_runs_clean():
 def test_every_checker_registered_and_described():
     checkers = all_checkers()
     ids = sorted(c.id for c in checkers)
-    assert ids == ["index-dtype", "jit-purity", "lock-discipline",
-                   "metrics-discipline", "span-discipline", "thread-hygiene"]
+    assert ids == ["hint-freshness", "index-dtype", "jit-purity",
+                   "lock-discipline", "metrics-discipline",
+                   "span-discipline", "thread-hygiene"]
     assert all(c.description for c in checkers)
 
 
