@@ -157,6 +157,11 @@ def main_sharded(n_shards: int, trace: bool = False,
     # remainder arriving slim; 'read_plane' shows where the progress polls
     # landed (followers when --replicas > 0).
     detail["watch_decode"] = out.get("watch_decode")
+    # Wire-plane summary (core/wire.py): server bytes by codec/surface +
+    # per-shard decoded bytes by codec — the proof of WHICH plane ran and
+    # the decoded-bytes delta vs the JSON baseline (PR-10: 4.87MB full /
+    # 1.71MB slim per shard on this workload).
+    detail["wire"] = out.get("wire")
     detail["read_plane"] = out.get("read_plane")
     if replicas:
         detail["replicas"] = out["replicas"]

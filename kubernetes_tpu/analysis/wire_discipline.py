@@ -1,0 +1,98 @@
+"""wire-discipline checker: hot wire surfaces route through core/wire.py.
+
+Incident class (PR 13): the whole point of the binary wire refactor is
+that every hot surface — WAL records, the replication ship stream,
+snapshot bootstrap pages, watch events, bulk bindings, paged LIST — speaks
+the NEGOTIATED codec. A stray ``json.dumps``/``json.loads`` on one of
+those modules silently pins that path to the JSON plane: everything still
+works, every test still passes, and the byte savings quietly disappear for
+that surface (exactly the regression class that is invisible without the
+per-surface ``apiserver_wire_bytes_total`` counters).
+
+Rule (``json-on-wire-surface``): inside the hot wire modules
+(core/apiserver.py, core/watchcache.py, core/wal.py,
+replication/follower.py), no direct calls to ``json.dumps`` /
+``json.loads`` / ``json.dump`` / ``json.load`` — encode/decode must route
+through :mod:`kubernetes_tpu.core.wire` (``wire.encode`` / ``wire.decode``
+/ ``read_event`` / ``scan`` for the negotiated plane, ``wire.jdumps`` /
+``wire.jloads`` for the deliberate JSON debug/compat surfaces, so the
+deliberate ones are grep-able and reviewed at the seam). Import aliases
+(``import json as _json``, ``from json import dumps``) are resolved;
+core/wire.py itself is the seam and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set, Tuple
+
+from .base import Checker, Finding, ModuleSource, attr_chain, register
+
+HOT_MODULES: Tuple[str, ...] = (
+    "core/apiserver.py",
+    "core/watchcache.py",
+    "core/wal.py",
+    "replication/follower.py",
+)
+SEAM = "core/wire.py"
+VERBS = frozenset({"dumps", "loads", "dump", "load"})
+
+
+def _json_aliases(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(names bound to the json MODULE, names bound to a json VERB) — any
+    ``import json [as x]`` / ``from json import dumps [as y]`` anywhere in
+    the module (function-local imports included)."""
+    mod_names: Set[str] = set()
+    verb_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "json":
+                    mod_names.add(alias.asname or "json")
+        elif isinstance(node, ast.ImportFrom) and node.module == "json":
+            for alias in node.names:
+                if alias.name in VERBS:
+                    verb_names.add(alias.asname or alias.name)
+    return mod_names, verb_names
+
+
+@register
+class WireDisciplineChecker(Checker):
+    id = "wire-discipline"
+    description = ("hot wire surfaces (apiserver/watchcache/wal/follower) "
+                   "never call json.dumps/loads directly — encode/decode "
+                   "routes through the core/wire.py codec seam so the "
+                   "negotiated binary plane cannot silently regress to "
+                   "JSON on one surface")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath in HOT_MODULES
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        if mod.path == SEAM:
+            return []
+        if mod.path not in HOT_MODULES and not mod.path.startswith("<"):
+            return []
+        mod_names, verb_names = _json_aliases(mod.tree)
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            verb = None
+            chain = attr_chain(node.func)
+            if (len(chain) >= 2 and chain[-1] in VERBS
+                    and chain[-2] in (mod_names or {"json"})):
+                verb = chain[-1]
+            elif (isinstance(node.func, ast.Name)
+                    and node.func.id in verb_names):
+                verb = node.func.id
+            if verb is None:
+                continue
+            out.append(Finding(
+                self.id, "json-on-wire-surface", mod.path, node.lineno,
+                f"json.{verb}(...) on a hot wire surface — route through "
+                "the core/wire.py codec seam (wire.encode/decode for the "
+                "negotiated plane, wire.jdumps/jloads for deliberate "
+                "JSON debug surfaces) so the binary plane cannot "
+                "silently regress on this path"))
+        return out

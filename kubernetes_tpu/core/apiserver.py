@@ -33,7 +33,6 @@ across a ``kill -9``.
 from __future__ import annotations
 
 import copy
-import json
 import queue
 import threading
 import time
@@ -62,6 +61,7 @@ from ..api.types import (
     WeightedPodAffinityTerm,
 )
 from . import spans as _spans
+from . import wire
 from .clientset import FakeClientset
 from .watchcache import (
     ShardFilter,
@@ -418,6 +418,24 @@ class APIServer:
             "nodes": WatchCache("nodes", capacity=backlog)}
         self.watch_slim_events = 0       # events delivered as slim wire
         self.watch_filtered_events = 0   # events dropped entirely
+        # Wire-plane accounting (core/wire.py): bytes served/consumed per
+        # (codec, surface) — the `apiserver_wire_bytes_total{codec,surface}`
+        # series that proves which plane (binary vs JSON) actually ran on
+        # each hot surface. Bumped on stream/handler threads without a
+        # lock: a lost increment under race is observability noise, never
+        # state (same posture as node_heartbeats). PRE-SEEDED with every
+        # (codec, surface) pair so the dict never grows after init — a
+        # concurrent /metrics iteration must never see a structural
+        # mutation (RuntimeError), only a slightly stale count.
+        self.wire_bytes: Dict[tuple, int] = {
+            (codec, surface): 0
+            for codec in (wire.JSON, wire.BINARY)
+            for surface in ("watch", "ship", "list", "snapshot", "bindings")}
+        # Per-SERVER negotiation override: True = answer every Accept
+        # offer with JSON (a pre-wire server, for interop tests/mixed
+        # fleets, without pinning the whole process the way
+        # TPU_SCHED_WIRE=json does).
+        self.json_only = False
         # Paged LIST plane (`?limit=&continue=`, docs/SCALE.md): pages
         # served, continuation tokens that expired off the rv ring (the
         # 410 Gone analogue), full-cluster single-response LISTs served
@@ -536,8 +554,7 @@ class APIServer:
                 # Rebuild the replication ship window too, so followers that
                 # resume against a restarted leader ride frames, not a
                 # snapshot bootstrap.
-                self._repl_backlog.append(
-                    (seq, (json.dumps(rec) + "\n").encode()))
+                self._repl_backlog.append((seq, wire.WireItem(rec)))
             kind = rec.get("kind")
             if kind == "leases":
                 # Lease holders survive the restart but their clocks do not
@@ -557,8 +574,7 @@ class APIServer:
             if rv is not None:
                 event = {k: v for k, v in rec.items()
                          if k not in ("kind", "seq", "epoch")}
-                rings[kind].append(
-                    (rv, event, (json.dumps(event) + "\n").encode()))
+                rings[kind].append((rv, event, wire.WireItem(event)))
         # Object resource_versions were not persisted; fast-forward the
         # store's counter past everything ever minted so recovered and new
         # objects never share a version.
@@ -659,28 +675,37 @@ class APIServer:
             self._repl_seq += 1
             seq = self._repl_seq
             rec = dict(rec, seq=seq, epoch=self.repl_epoch)
+        # ONE WireItem per frame: the WAL append and every attached ship
+        # stream share its per-codec encodings (a binary WAL + N binary
+        # followers = one binary encode, total).
+        item = wire.WireItem(rec)
         if self.persistence is not None:
-            self.persistence.append(rec)
-        data = (json.dumps(rec) + "\n").encode()
-        self._repl_backlog.append((seq, data))
-        self._ship_fanout(seq, data)
+            self.persistence.append(item)
+        self._repl_backlog.append((seq, item))
+        self._ship_fanout(seq, item)
         return seq
 
-    def _ship_fanout(self, seq: int, data: bytes) -> None:
-        """Feed one frame to every attached ship stream. Caller holds the
-        broadcast lock. A stream whose bounded queue overflows (stalled
-        follower: no socket error, it just stopped reading) is marked dead
-        and detached — it re-attaches from its applied seq, or resyncs."""
+    def _ship_fanout(self, seq: int, item) -> None:
+        """Feed one frame (a shared WireItem) to every attached ship
+        stream. Caller holds the broadcast lock. A stream whose bounded
+        queue overflows (stalled follower: no socket error, it just
+        stopped reading) is marked dead and detached — it re-attaches
+        from its applied seq, or resyncs."""
         dead = []
         for st in self._ship_streams:
             try:
-                st.q.put_nowait((seq, data))
+                st.q.put_nowait((seq, item))
             except queue.Full:
                 st.dead = True
                 self.ship_streams_dropped += 1
                 dead.append(st)
         for st in dead:
             self._ship_streams.remove(st)
+
+    def _count_wire(self, codec: str, surface: str, n: int) -> None:
+        """Attribute `n` served/consumed wire bytes to (codec, surface)."""
+        key = (codec, surface)
+        self.wire_bytes[key] = self.wire_bytes.get(key, 0) + n
 
     def _snapshot_state(self) -> dict:
         """Full-state compaction snapshot. The calling thread holds BOTH the
@@ -925,12 +950,11 @@ class APIServer:
                             self._seq[kind] = rv
                         event = {k: v for k, v in rec.items()
                                  if k not in ("kind", "seq", "epoch")}
-                        edata = (json.dumps(event) + "\n").encode()
                         # Same fanout as the leader's broadcast: this
                         # follower's watch cache + its own (possibly
                         # filtered) streams stay converged in the shared
                         # rv space — clients RESUME against any replica.
-                        self._fan_event(kind, event, edata)
+                        self._fan_event(kind, event, wire.WireItem(event))
                     else:
                         # rv-less STATUS: snapshot upsert, no ring entry
                         # (parity with its non-evented live fanout).
@@ -1079,12 +1103,13 @@ class APIServer:
 
     def _emit_control(self, event: dict) -> None:
         """Push a control marker (FAILOVER) to every live watch stream of
-        both kinds — rv-less and never WAL'd, like BOOKMARK."""
-        data = (json.dumps(event) + "\n").encode()
+        both kinds — rv-less and never WAL'd, like BOOKMARK. One shared
+        WireItem: each stream's consumer encodes it in its own codec."""
+        item = wire.WireItem(event)
         with self._lock:
             for kind in ("pods", "nodes"):
                 for w in self._watchers[kind]:
-                    w.q.put(data)
+                    w.q.put(item)
 
     def _attach_ship(self, since: int):
         """Attach a follower's ship stream at `since` (its last applied
@@ -1210,6 +1235,12 @@ class APIServer:
         for reason, v in sorted(self.failovers.items()):
             out.append('apiserver_failover_total{reason="%s"} %d'
                        % (reason, v))
+        # Wire plane: bytes per (codec, surface) — the bench's `wire`
+        # summary and the binary-negotiated acceptance check read this.
+        out.append("# TYPE apiserver_wire_bytes_total counter")
+        for (codec, surface), v in sorted(self.wire_bytes.items()):
+            out.append('apiserver_wire_bytes_total{codec="%s",surface="%s"}'
+                       ' %d' % (codec, surface, v))
         # Gauges: current role (1 = leader) and replication lag. On the
         # leader, lag is its head minus the slowest attached ship stream;
         # on a follower, the head the tail last heard minus what it applied.
@@ -1260,33 +1291,36 @@ class APIServer:
                     self.persistence.write_snapshot(self._snapshot_state())
                 except Exception:  # noqa: BLE001
                     self.compaction_failures += 1
-            data = (json.dumps(event) + "\n").encode()
+            item = wire.WireItem(event)
             _tf = time.perf_counter() if ctx is not None else 0.0
-            self._fan_event(kind, event, data)
+            self._fan_event(kind, event, item)
             if ctx is not None:
                 self.tracer.record("bound.fanout", ctx,
                                    time.perf_counter() - _tf,
                                    watchers=len(self._watchers[kind]),
                                    rv=event["rv"])
 
-    def _fan_event(self, kind: str, event: dict, data: bytes) -> None:
+    def _fan_event(self, kind: str, event: dict, item) -> None:
         """The one commit→read-plane fanout both write paths share (the
         leader's _broadcast and a follower's apply_frame): install the
         event into the watch cache (ring + object snapshot), then feed
         every attached stream — full wire, or through its shard filter.
-        Caller holds the broadcast lock, AFTER the WAL append: ring order
-        is commit order, and a cached/fanned event is always durable."""
+        ``item`` is the event's shared WireItem: every stream's consumer
+        encodes it in its OWN codec, once per codec total. Caller holds
+        the broadcast lock, AFTER the WAL append: ring order is commit
+        order, and a cached/fanned event is always durable."""
         self.watch_cache[kind].note_event(
             event.get("rv"), event.get("type", ""), event.get("object"),
-            data=data, event=event)
+            data=item, event=event)
         # One per-event memo shared across the filtered streams: the slim
-        # projection/encode is identical for all of them, so N shards pay
-        # ONE dict build + json encode under the broadcast lock, not N.
+        # projection/item is identical for all of them, so N shards pay
+        # ONE dict build under the broadcast lock, not N — and the encode
+        # itself runs on the consumer threads, once per codec.
         memo: dict = {}
         for w in self._watchers[kind]:
-            self._route_to(w, event, data, self.watch_cache[kind], memo)
+            self._route_to(w, event, item, self.watch_cache[kind], memo)
 
-    def _route_to(self, st: _WatchStream, event: dict, data: bytes,
+    def _route_to(self, st: _WatchStream, event: dict, data,
                   wc: WatchCache, memo: Optional[dict] = None) -> None:
         """Deliver one event to one stream through its filter (or raw) —
         the ONE routing+counting sequence the live fanout and the
@@ -1366,8 +1400,8 @@ class APIServer:
             if resumable:
                 tail = wc.events_since(since)
             if tail is not None:
-                st.q.put((json.dumps({"type": "RESUME", "rv": seq,
-                                      "epoch": self.epoch}) + "\n").encode())
+                st.q.put(wire.WireItem({"type": "RESUME", "rv": seq,
+                                        "epoch": self.epoch}))
                 for _rv, event, data in tail:
                     self._route_to(st, event, data, wc)
                 if flt is not None:
@@ -1401,16 +1435,15 @@ class APIServer:
                 # it the resume window is gone and close the stream — it
                 # re-lists, then re-attaches with fresh=true at the list
                 # anchor.
-                st.q.put((json.dumps({"type": "TOO_OLD", "rv": seq,
-                                      "epoch": self.epoch}) + "\n").encode())
+                st.q.put(wire.WireItem({"type": "TOO_OLD", "rv": seq,
+                                        "epoch": self.epoch}))
                 st.q.put(None)
             else:
                 for o in wc.list_wire():
                     event = {"type": "ADDED", "object": o}
-                    self._route_to(st, event,
-                                   (json.dumps(event) + "\n").encode(), wc)
-                st.q.put((json.dumps({"type": "SYNC", "rv": seq,
-                                      "epoch": self.epoch}) + "\n").encode())
+                    self._route_to(st, event, wire.WireItem(event), wc)
+                st.q.put(wire.WireItem({"type": "SYNC", "rv": seq,
+                                        "epoch": self.epoch}))
                 self.relisted_watches += 1
             self._watchers[kind].append(st)
         return st
@@ -1449,16 +1482,35 @@ class APIServer:
             def _read_body(self) -> dict:
                 # Socket I/O — must run OUTSIDE the write lock (a stalled
                 # sender would otherwise wedge the whole write plane).
+                # Sniff-decoded (core/wire.py): a negotiated client sends
+                # binary frames (bulk bindings, bulk creates), everything
+                # else stays the JSON compat plane.
                 n = int(self.headers.get("Content-Length", 0))
-                return json.loads(self.rfile.read(n) or b"{}")
+                raw = self.rfile.read(n) or b"{}"
+                self._body_len = len(raw)
+                self._body_codec = (wire.BINARY if raw[0] == wire.MAGIC
+                                    else wire.JSON)
+                return wire.decode(raw)
 
             def _body(self) -> dict:
                 return self._body_cache
 
-            def _json(self, code: int, obj) -> None:
-                data = json.dumps(obj).encode()
+            def _accept(self) -> str:
+                """This request's negotiated reply codec (Accept:-style;
+                core/wire.py). Error bodies stay JSON regardless — the
+                debug plane."""
+                if server.json_only:
+                    return wire.JSON
+                return wire.accept_codec(self.headers.get("Accept"))
+
+            def _json(self, code: int, obj,
+                      surface: Optional[str] = None) -> None:
+                codec = self._accept() if code < 400 else wire.JSON
+                data = wire.encode(obj, codec)
+                if surface is not None:
+                    server._count_wire(codec, surface, len(data))
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", wire.mime_for(codec))
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -1632,6 +1684,7 @@ class APIServer:
                     return self._json(410, {"error": "ExpiredContinue"})
                 objs, next_key, anchor, rv = page
                 server.list_pages += 1
+                codec = self._accept()
                 # Slim foreign plain pods through the shard filter exactly
                 # as the watch plane would deliver them (selector-free
                 # clusters only — core/watchcache.py).
@@ -1642,19 +1695,21 @@ class APIServer:
                     # between request and response must tear only THIS
                     # handler, quietly.
                     self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Type", wire.mime_for(codec))
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
                     buf = bytearray()
+                    sent = 0
                     for obj in objs:
                         if (slim_ok and wire_plain(obj)
                                 and shard_of_wire(obj, flt.count)
                                 != flt.index):
                             obj = slim_object(obj)
                             server.watch_slim_events += 1
-                        buf += (json.dumps({"type": "ADDED", "object": obj})
-                                + "\n").encode()
+                        buf += wire.encode({"type": "ADDED", "object": obj},
+                                           codec)
                         if len(buf) >= 65536:
+                            sent += len(buf)
                             self._write_chunk(bytes(buf))
                             buf.clear()
                     trailer = {"type": "PAGE", "rv": rv, "listRv": anchor,
@@ -1662,7 +1717,8 @@ class APIServer:
                     if next_key:
                         trailer["continue"] = mint_continue(
                             anchor, next_key, server.epoch)
-                    buf += (json.dumps(trailer) + "\n").encode()
+                    buf += wire.encode(trailer, codec)
+                    server._count_wire(codec, "list", sent + len(buf))
                     self._write_chunk(bytes(buf))
                     self.wfile.write(b"0\r\n\r\n")
                     self.wfile.flush()
@@ -1690,14 +1746,16 @@ class APIServer:
                                        list(server.leases.items())],
                             "role": server.role,
                         }
+                codec = self._accept()
                 try:
                     self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Type", wire.mime_for(codec))
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
-                    self._write_chunk(
-                        (json.dumps({"type": "SNAP_META", **meta})
-                         + "\n").encode())
+                    sent = 0
+                    data = wire.encode({"type": "SNAP_META", **meta}, codec)
+                    sent += len(data)
+                    self._write_chunk(data)
                     for kind in ("pods", "nodes"):
                         last = ""
                         while True:
@@ -1707,18 +1765,22 @@ class APIServer:
                             server.snapshot_bootstrap_pages += 1
                             buf = bytearray()
                             for obj in objs:
-                                buf += (json.dumps(
-                                    {"kind": kind, "object": obj})
-                                    + "\n").encode()
+                                buf += wire.encode(
+                                    {"kind": kind, "object": obj}, codec)
                                 if len(buf) >= 65536:
+                                    sent += len(buf)
                                     self._write_chunk(bytes(buf))
                                     buf.clear()
                             if buf:
+                                sent += len(buf)
                                 self._write_chunk(bytes(buf))
                             if not next_key:
                                 break
                             last = next_key
-                    self._write_chunk(b'{"type": "SNAP_END"}\n')
+                    data = wire.encode({"type": "SNAP_END"}, codec)
+                    sent += len(data)
+                    self._write_chunk(data)
+                    server._count_wire(codec, "snapshot", sent)
                     self.wfile.write(b"0\r\n\r\n")
                     self.wfile.flush()
                 except (BrokenPipeError, ConnectionResetError, OSError):
@@ -1734,8 +1796,9 @@ class APIServer:
                 # cluster keeps the client's read timeout from killing the
                 # watch (the reference's watch bookmarks serve the same
                 # liveness role).
+                codec = self._accept()
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", wire.mime_for(codec))
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 st = server._attach_watch(kind, since, epoch, flt,
@@ -1751,15 +1814,18 @@ class APIServer:
                             if idle < 10.0:
                                 continue
                             idle = 0.0
-                            data = b'{"type": "BOOKMARK"}\n'
+                            data = wire.encode({"type": "BOOKMARK"}, codec)
                         if data is None:
                             # Stream-end sentinel (snapshot RESYNC skipped
                             # frames): close; the client re-lists fresh.
                             break
-                        # Lazy upgrade markers encode HERE, on this
-                        # stream's own thread — never under the broadcast
-                        # lock the fanout path holds.
-                        data = encode_stream_item(data)
+                        # Encode HERE, on this stream's own thread, in
+                        # THIS stream's codec — never under the broadcast
+                        # lock the fanout path holds; WireItems cache the
+                        # result so it happens once per codec, not per
+                        # stream.
+                        data = encode_stream_item(data, codec)
+                        server._count_wire(codec, "watch", len(data))
                         self.wfile.write(
                             f"{len(data):x}\r\n".encode() + data + b"\r\n")
                         self.wfile.flush()
@@ -1802,23 +1868,29 @@ class APIServer:
                     # outran the follower): 410 Gone — snapshot bootstrap.
                     return self._json(410, {"error": "ResyncRequired",
                                             "seq": server._repl_seq})
+                codec = self._accept()
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", wire.mime_for(codec))
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 try:
                     while server._httpd is not None and not st.dead:
                         try:
-                            seq, data = st.q.get(timeout=hb)
+                            seq, item = st.q.get(timeout=hb)
+                            # Shared frame WireItem: the encode is cached
+                            # per codec, so N binary followers reuse the
+                            # WAL append's bytes.
+                            data = item.bytes(codec)
                         except queue.Empty:
                             seq = None
                             # HBs carry this replica's ROLE: a follower
                             # tailing a stream whose server was deposed
                             # must not count these as leader liveness.
-                            data = (json.dumps(
+                            data = wire.encode(
                                 {"type": "HB", "seq": server._repl_seq,
                                  "epoch": server.repl_epoch,
-                                 "role": server.role}) + "\n").encode()
+                                 "role": server.role}, codec)
+                        server._count_wire(codec, "ship", len(data))
                         self.wfile.write(
                             f"{len(data):x}\r\n".encode() + data + b"\r\n")
                         self.wfile.flush()
@@ -1884,6 +1956,13 @@ class APIServer:
                 # the wire to each in-quorum follower before the client
                 # hears 200 — a leader kill -9 cannot silently lose it.
                 server._await_shipped(seq)
+                if self.path == "/api/v1/bindings":
+                    # Bulk-binding wire accounting: the request envelope
+                    # (in its sniffed codec) and the per-item verdict
+                    # reply (negotiated) both land on the same surface.
+                    server._count_wire(self._body_codec, "bindings",
+                                       self._body_len)
+                    return self._json(code, obj, surface="bindings")
                 self._json(code, obj)
 
             def _post_locked(self):
@@ -2110,12 +2189,13 @@ def iter_paged(conn, kind: str, limit: int, shard=None,
     """Drive one complete paged LIST (`?limit=&continue=`) over an open
     HTTPConnection, yielding as lines arrive (bounded buffering):
 
-    - ``("restart", None, b"")`` — a continuation expired off the resume
-      ring (410): the whole list restarts; the consumer must reset any
-      accumulation;
-    - ``("object", wire_dict, raw_line)`` — one listed object;
-    - ``("done", trailer_dict, b"")`` — the final PAGE trailer (carries
-      ``listRv``/``epoch``), after which the generator ends.
+    - ``("restart", None, (0, ""))`` — a continuation expired off the
+      resume ring (410): the whole list restarts; the consumer must reset
+      any accumulation;
+    - ``("object", wire_dict, (wire_bytes, codec))`` — one listed object,
+      with its decode-cost accounting (core/wire.py negotiated codec);
+    - ``("done", trailer_dict, (0, ""))`` — the final PAGE trailer
+      (carries ``listRv``/``epoch``), after which the generator ends.
 
     The ONE consumption loop `fetch_paged` (collecting oracle) and the
     reflector's `_paged_list_sync` (per-line dispatch) both ride —
@@ -2132,7 +2212,7 @@ def iter_paged(conn, kind: str, limit: int, shard=None,
                 path += f"&shard={shard[0]}/{shard[1]}"
             if token:
                 path += f"&continue={token}"
-            conn.request("GET", path)
+            conn.request("GET", path, headers=wire.client_headers())
             resp = conn.getresponse()
             if resp.status == 410:
                 resp.read()
@@ -2144,20 +2224,20 @@ def iter_paged(conn, kind: str, limit: int, shard=None,
             token = ""
             trailer: Optional[dict] = None
             while True:
-                line = resp.readline()
-                if not line:
+                got = wire.read_event(resp)
+                if got is None:
                     break
-                d = json.loads(line)
+                d, nbytes, codec = got
                 if d.get("type") == "PAGE":
                     token = d.get("continue") or ""
                     trailer = d
                 elif d.get("object") is not None:
-                    yield "object", d["object"], line
+                    yield "object", d["object"], (nbytes, codec)
             if not token:
-                yield "done", trailer or {}, b""
+                yield "done", trailer or {}, (0, "")
                 return
         if expired:
-            yield "restart", None, b""
+            yield "restart", None, (0, "")
     raise URLError(
         f"paged {kind} list: continuation kept expiring "
         f"after {max_restarts} restarts")
@@ -2224,6 +2304,13 @@ class KeepAliveClient:
         self._base = base_url.rstrip("/")
         self._timeout = timeout
         self._local = threading.local()
+        # Wire negotiation state (core/wire.py): None until the first
+        # response proves what the server speaks. Request BODIES go out
+        # binary only after a binary reply has been seen — a JSON-only
+        # server must never receive a frame it cannot parse (the Accept
+        # offer itself is always safe). Shared across threads; benignly
+        # racy (worst case: one extra JSON body).
+        self._server_wire: Optional[bool] = None
 
     def call(self, method: str, path: str, body: Optional[dict] = None,
              timeout: Optional[float] = None,
@@ -2233,8 +2320,15 @@ class KeepAliveClient:
         import io
         from urllib import error as urlerror
 
-        data = json.dumps(body).encode() if body is not None else None
-        headers = dict(headers or (), **{"Content-Type": "application/json"})
+        offer = wire.client_headers()
+        body_codec = (wire.BINARY if self._server_wire and offer
+                      else wire.JSON)
+        if body is not None:
+            data = wire.encode(body, body_codec)
+        else:
+            data = None
+        headers = dict(headers or (), **offer,
+                       **{"Content-Type": wire.mime_for(body_codec)})
         t = timeout if timeout is not None else self._timeout
         # replay=False: the caller owns replays (HTTPClientset's
         # leader-routed writes — against a REPLICATED control plane a dead
@@ -2293,9 +2387,21 @@ class KeepAliveClient:
                     raise
                 raise urlerror.URLError(e) from e
             if status >= 400:
+                # Error bodies are always JSON (the server's debug-plane
+                # contract) — callers' .read()+jloads keep working.
                 raise urlerror.HTTPError(f"{self._base}{path}", status,
                                          reason, hdrs, io.BytesIO(payload))
-            return json.loads(payload) if payload else None
+            if offer:
+                # Learn the server's plane from a SUCCESS reply: binary
+                # content-type => binary bodies from here on; a JSON 2xx
+                # despite our offer => JSON-only server (never regress a
+                # learned binary peer on a bodyless reply).
+                if wire.codec_of_mime(
+                        hdrs.get("Content-Type")) == wire.BINARY:
+                    self._server_wire = True
+                elif payload:
+                    self._server_wire = False
+            return wire.decode(payload) if payload else None
 
 
 class HTTPClientset:
@@ -2339,6 +2445,15 @@ class HTTPClientset:
         self.watch_events_slim = 0
         self.watch_bytes_full = 0
         self.watch_bytes_slim = 0
+        # The same decode accounting split by (form, codec): which plane
+        # (binary vs JSON) this client's watch/list decode actually ran
+        # on — scheduler_watch_decoded_*{form,codec} reads these.
+        self.wire_decode_events: Dict[tuple, int] = {
+            ("full", wire.JSON): 0, ("full", wire.BINARY): 0,
+            ("slim", wire.JSON): 0, ("slim", wire.BINARY): 0}
+        self.wire_decode_bytes: Dict[tuple, int] = {
+            ("full", wire.JSON): 0, ("full", wire.BINARY): 0,
+            ("slim", wire.JSON): 0, ("slim", wire.BINARY): 0}
         # Read plane: the base plus sibling replicas the reflector may
         # rotate to when the base dies (shared rv/epoch space -> RESUME).
         self._bases: List[str] = [self.base] + [
@@ -2437,7 +2552,7 @@ class HTTPClientset:
 
     def _err_body(self, e) -> dict:
         try:
-            return json.loads(e.read() or b"{}")
+            return wire.jloads(e.read() or b"{}")
         except Exception:  # noqa: BLE001 - already an error path
             return {}
 
@@ -2620,7 +2735,7 @@ class HTTPClientset:
             out.append(None if code < 400 else HTTPError(
                 f"{self.base}/api/v1/bindings", code,
                 item.get("error", ""), None,
-                io.BytesIO(json.dumps(item).encode())))
+                io.BytesIO(wire.jdumps(item).encode())))
         return out
 
     def patch_pod_status(self, pod: Pod, nominated_node_name: str = "",
@@ -2760,13 +2875,10 @@ class HTTPClientset:
                 obj = payload
                 # Decode-cost accounting, same split as the watch loop
                 # (a filtered paged list delivers foreign plain pods
-                # slim).
-                if obj.get("slim"):
-                    self.watch_events_slim += 1
-                    self.watch_bytes_slim += len(line)
-                else:
-                    self.watch_events_full += 1
-                    self.watch_bytes_full += len(line)
+                # slim); `line` is (wire_bytes, codec) from iter_paged.
+                self._note_decode(
+                    "slim" if obj.get("slim") else "full",
+                    line[1], line[0])
                 with self._dispatch_lock:
                     seen.add(wire_key(kind, obj))
                     self._dispatch(kind, "ADDED", obj)
@@ -2778,6 +2890,22 @@ class HTTPClientset:
                     trailer.get("epoch"))
         finally:
             conn.close()
+
+    def _note_decode(self, form: str, codec: str, nbytes: int) -> None:
+        """One decoded wire record's cost accounting: by form (full wire
+        vs slim projection — the shard filter's 1/N) and by codec (binary
+        vs JSON — the wire refactor's raw-bytes lever). Reflector-thread
+        only; the legacy aggregate counters stay for existing readers."""
+        if form == "slim":
+            self.watch_events_slim += 1
+            self.watch_bytes_slim += nbytes
+        else:
+            self.watch_events_full += 1
+            self.watch_bytes_full += nbytes
+        key = (form, codec)
+        self.wire_decode_events[key] = self.wire_decode_events.get(key, 0) + 1
+        self.wire_decode_bytes[key] = (
+            self.wire_decode_bytes.get(key, 0) + nbytes)
 
     def _watch_loop(self, kind: str) -> None:
         """client-go reflector behavior (tools/cache/reflector.go:470): on
@@ -2845,7 +2973,7 @@ class HTTPClientset:
                         and self._epoch[kind] is not None):
                     path += (f"&resourceVersion={self._last_rv[kind]}"
                              f"&epoch={self._epoch[kind]}")
-                conn.request("GET", path)
+                conn.request("GET", path, headers=wire.client_headers())
                 resp = conn.getresponse()
                 conn_fails = 0
             except Exception as e:  # noqa: BLE001 - connect failure
@@ -2872,20 +3000,18 @@ class HTTPClientset:
             resync_seen: Optional[set] = set()  # keys replayed pre-SYNC
             try:
                 while not self._stop.is_set():
-                    line = resp.readline()
-                    if not line:
+                    got = wire.read_event(resp)
+                    if got is None:
                         break  # EOF: server went away — re-list + re-watch
-                    event = json.loads(line)
+                    event, nbytes, codec = got
                     typ = event["type"]
                     if typ in ("ADDED", "MODIFIED", "DELETED"):
                         # Decode-cost accounting (the 1/N the shard filter
-                        # buys): slim projections vs full object wire.
-                        if (event.get("object") or {}).get("slim"):
-                            self.watch_events_slim += 1
-                            self.watch_bytes_slim += len(line)
-                        else:
-                            self.watch_events_full += 1
-                            self.watch_bytes_full += len(line)
+                        # buys, times the codec's bytes-per-event): slim
+                        # projections vs full object wire, binary vs JSON.
+                        self._note_decode(
+                            "slim" if (event.get("object") or {}).get("slim")
+                            else "full", codec, nbytes)
                     if typ == "BOOKMARK":
                         continue  # server idle heartbeat
                     if typ == "FAILOVER":

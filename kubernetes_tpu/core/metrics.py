@@ -374,12 +374,14 @@ class SchedulerMetrics:
         self.watch_decoded_events = r(Gauge(
             "scheduler_watch_decoded_events",
             "Watch events this scheduler decoded, by wire form "
-            "(shard-filtered streams deliver foreign plain pods slim).",
-            ("form",)))
+            "(shard-filtered streams deliver foreign plain pods slim) "
+            "and codec (core/wire.py negotiated binary vs JSON).",
+            ("form", "codec")))
         self.watch_decoded_bytes = r(Gauge(
             "scheduler_watch_decoded_bytes",
-            "Watch stream bytes this scheduler decoded, by wire form.",
-            ("form",)))
+            "Watch stream bytes this scheduler decoded, by wire form "
+            "and codec.",
+            ("form", "codec")))
         # placement / pod-group series
         self.generated_placements_total = r(Counter(
             "scheduler_generated_placements_total",

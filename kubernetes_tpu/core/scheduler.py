@@ -329,16 +329,18 @@ class Scheduler:
         self.metrics.queued_entities._fn = self._queued_entity_counts
         self.metrics.unschedulable_pods._fn = self._unschedulable_by_plugin
         # Watch decode cost, by wire form (core/watchcache.py shard-filtered
-        # streams): counters live on the HTTP clientset's reflector thread;
-        # the gauges read them at scrape time so bench.py --shards can show
-        # the per-shard decoded-events/bytes 1/N. Zero on a FakeClientset.
+        # streams) and codec (core/wire.py binary vs JSON): counters live on
+        # the HTTP clientset's reflector thread; the gauges read them at
+        # scrape time so bench.py --shards can show the per-shard
+        # decoded-events/bytes 1/N and which plane ran. Empty on a
+        # FakeClientset (no wire).
         _cs = self.clientset
         self.metrics.watch_decoded_events._fn = lambda: {
-            ("full",): float(getattr(_cs, "watch_events_full", 0)),
-            ("slim",): float(getattr(_cs, "watch_events_slim", 0))}
+            k: float(v) for k, v in
+            getattr(_cs, "wire_decode_events", {}).items()}
         self.metrics.watch_decoded_bytes._fn = lambda: {
-            ("full",): float(getattr(_cs, "watch_bytes_full", 0)),
-            ("slim",): float(getattr(_cs, "watch_bytes_slim", 0))}
+            k: float(v) for k, v in
+            getattr(_cs, "wire_decode_bytes", {}).items()}
         # Waiting pods (Permit WAIT; framework.go waitingPods registry).
         # _next_wait_deadline makes expiry TIMER-DRIVEN: schedule_one checks
         # it every cycle (O(1)), so a parked pod times out even while the

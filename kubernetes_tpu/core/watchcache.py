@@ -58,7 +58,6 @@ from __future__ import annotations
 
 import base64
 import bisect
-import json
 import threading
 from collections import deque
 from typing import Dict, List, Optional, Tuple
@@ -66,6 +65,7 @@ from typing import Dict, List, Optional, Tuple
 from ..api.resource import Resource
 from ..api.types import Container, Pod
 from ..shard.partition import shard_of_key
+from . import wire
 
 
 def wire_key(kind: str, obj: dict) -> str:
@@ -89,16 +89,15 @@ def wire_key(kind: str, obj: dict) -> str:
 def mint_continue(anchor_rv: int, last_key: str, epoch: str) -> str:
     """Encode one continuation token: (list-anchor rv, last served key,
     server watch epoch)."""
-    return base64.urlsafe_b64encode(json.dumps(
-        {"rv": int(anchor_rv), "k": last_key, "e": epoch},
-        separators=(",", ":")).encode()).decode()
+    return base64.urlsafe_b64encode(wire.jdumps(
+        {"rv": int(anchor_rv), "k": last_key, "e": epoch}).encode()).decode()
 
 
 def parse_continue(token: str) -> Optional[dict]:
     """Decode a continuation token; None for garbage (the caller answers
     410 — a malformed token must restart the list, never crash a page)."""
     try:
-        d = json.loads(base64.urlsafe_b64decode(token.encode()))
+        d = wire.jloads(base64.urlsafe_b64decode(token.encode()))
     except Exception:  # noqa: BLE001 - any malformed token is 410
         return None
     if (isinstance(d, dict)
@@ -141,16 +140,20 @@ def resource_request_lines(namespace: str, pod_name: str, node: str,
     return lines
 
 
-def encode_stream_item(item) -> bytes:
-    """Resolve one watch-queue item to wire bytes: pre-encoded events pass
-    through; lazy ("MODIFIED", wire_obj) upgrade markers (ShardFilter's
-    selector-transition burst) encode HERE, on the stream's consumer
-    thread, so the fanout path never pays a json encode per slimmed pod
-    under the broadcast lock."""
+def encode_stream_item(item, codec: str = wire.JSON) -> bytes:
+    """Resolve one watch-queue item to wire bytes in the STREAM's
+    negotiated codec: :class:`~.wire.WireItem` events encode once per
+    codec (cached — every stream of that codec reuses the bytes);
+    pre-encoded bytes pass through; lazy ("MODIFIED", wire_obj) upgrade
+    markers (ShardFilter's selector-transition burst) encode HERE, on the
+    stream's consumer thread, so the fanout path never pays an encode per
+    slimmed pod under the broadcast lock."""
+    if isinstance(item, wire.WireItem):
+        return item.bytes(codec)
     if isinstance(item, bytes):
         return item
     typ, obj = item
-    return (json.dumps({"type": typ, "object": obj}) + "\n").encode()
+    return wire.encode({"type": typ, "object": obj}, codec)
 
 
 def shard_key_from_wire(obj: dict) -> str:
@@ -487,20 +490,22 @@ class ShardFilter:
         self._slimmed = {}
         return [("MODIFIED", full) for full in fulls]
 
-    def route(self, event: dict, data: bytes, cache: WatchCache,
+    def route(self, event: dict, data, cache: WatchCache,
               memo: Optional[dict] = None) -> Tuple[List[object], int, int]:
         """-> (events to deliver, slim_count, filtered_out_count). Each
-        delivered item is either encoded bytes or a lazy ("MODIFIED",
-        wire_obj) upgrade marker — resolve with ``encode_stream_item``
-        on the consumer side, outside the broadcast lock.
+        delivered item is a :class:`~.wire.WireItem` (or pre-encoded
+        bytes) or a lazy ("MODIFIED", wire_obj) upgrade marker — resolve
+        with ``encode_stream_item`` on the consumer side, outside the
+        broadcast lock, in the stream's own negotiated codec.
 
         ``memo`` is a per-EVENT scratch dict the fanout loop shares
-        across its filtered streams: the slim projection and its encoded
-        line are identical for every stream that slims the event, so
-        only the first stream pays the dict build + json encode (the
-        loop runs under the server's broadcast lock). Projections are
-        therefore treated as IMMUTABLE once built — updates replace the
-        `_slimmed` entry, never mutate it."""
+        across its filtered streams: the slim projection and its wire
+        item are identical for every stream that slims the event, so
+        only the first stream pays the dict build (the loop runs under
+        the server's broadcast lock), and the encode itself happens once
+        per CODEC on the consumer side. Projections are therefore
+        treated as IMMUTABLE once built — updates replace the `_slimmed`
+        entry, never mutate it."""
         typ = event.get("type")
         obj = event.get("object")
         if typ == "BOUND":
@@ -563,6 +568,6 @@ class ShardFilter:
         if sdata is None:
             ev = {k: v for k, v in event.items() if k != "object"}
             ev["object"] = slim
-            sdata = memo["data"] = (json.dumps(ev) + "\n").encode()
+            sdata = memo["data"] = wire.WireItem(ev)
         out.append(sdata)
         return out, 1, 0

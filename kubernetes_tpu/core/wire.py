@@ -1,0 +1,516 @@
+"""Binary wire codec for the control plane — the ONE codec seam.
+
+Every hot wire surface (WAL records on disk, the replication ship stream,
+snapshot bootstrap pages, watch events incl. slim projections, bulk
+binding envelopes, paged LIST pages) routes its encode/decode through this
+module; the `wire-discipline` analyzer rule forbids raw ``json.dumps`` /
+``json.loads`` on those surfaces anywhere else. The reference serves
+protobuf/CBOR alongside JSON for exactly this reason (apimachinery runtime
+codecs, SURVEY §1 L2); here the compact plane is a dependency-free binary
+format and JSON remains the debug/compat plane forever.
+
+Frame format (docs/WIRE.md):
+
+- ``MAGIC (0xBF)  VERSION (1 byte)  varint payload_len  payload`` — a
+  reader can always tell binary from JSON by the first byte (JSON lines on
+  these surfaces start with ``{``; 0xBF is also not valid UTF-8 lead byte
+  for JSON text). The length prefix gives WAL replay and stream reads the
+  exact torn-frame semantics of newline-framed JSON: an incomplete or
+  undecodable final frame is discarded and truncated away.
+- The payload is ONE self-describing value:
+  - one byte ``0x00..0xBE`` — the small int itself (rv deltas, ports,
+    priorities, request milli-values);
+  - ``0xC0`` None, ``0xC1`` True, ``0xC2`` False;
+  - ``0xC3`` int: zigzag varint;
+  - ``0xC4`` float: 8-byte IEEE-754 big-endian;
+  - ``0xC6`` string define: varint byte-length + UTF-8 — and the string
+    joins the intern table at the next free index;
+  - ``0xC7`` string ref: varint index into the intern table;
+  - ``0xC8`` list: varint count + items;
+  - ``0xC9`` dict: varint count + (string key, value) pairs;
+  - ``0xCA`` bytes: varint length + raw passthrough (already-encoded
+    payloads ride untouched — JSON has no analogue, so the JSON codec
+    refuses them).
+
+Intern table: the wire on these surfaces is dominated by repeated dict
+keys, kinds, namespaces, and node names. The table is seeded with the
+protocol's WELL-KNOWN strings (bound to the VERSION byte — extending the
+list bumps the version) and grows per frame: the first occurrence of any
+other string is a define (same cost as inline), every later occurrence in
+the SAME frame is a 2-3 byte ref. The table RESETS at every frame — so a
+frame is self-contained, encode results are shareable across streams and
+safe to replay after any prefix of the log is truncated away.
+
+Negotiation (Accept:-style): a client that speaks binary sends
+``Accept: application/x-tpu-wire``; a willing server answers binary
+(``Content-Type: application/x-tpu-wire``) on success replies and data
+streams — error bodies stay JSON always (the debug plane). Anything else
+falls back to JSON on both sides. ``TPU_SCHED_WIRE=json`` pins a process
+(client offers and server answers) to JSON — the A/B and interop lever.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+WIRE_MIME = "application/x-tpu-wire"
+JSON_MIME = "application/json"
+
+MAGIC = 0xBF
+VERSION = 1
+
+BINARY = "binary"
+JSON = "json"
+
+# Well-known strings, seeded into every frame's intern table (indexes
+# 0..N-1). ORDER IS THE WIRE CONTRACT: append only, and bump VERSION when
+# you do — a reader keys its seed table off the frame's version byte.
+WELL_KNOWN: Tuple[str, ...] = (
+    # event / frame envelope
+    "type", "object", "rv", "kind", "seq", "epoch", "tctx",
+    "ADDED", "MODIFIED", "DELETED", "BOUND", "STATUS", "LEASE",
+    "SYNC", "RESUME", "BOOKMARK", "FAILOVER", "TOO_OLD", "PAGE", "HB",
+    "SNAP_META", "SNAP_END", "pods", "nodes", "leases",
+    # pod wire
+    "name", "namespace", "uid", "nodeName", "schedulerName",
+    "nominatedNodeName", "labels", "annotations", "priority", "podGroup",
+    "deletionTs", "finalizers", "requests", "cpu", "memory", "ephemeral",
+    "scalar", "hostPorts", "port", "protocol", "hostIP", "tolerations",
+    "key", "operator", "value", "effect", "nodeSelector", "affinity",
+    "topologySpread", "maxSkew", "topologyKey", "whenUnsatisfiable",
+    "labelSelector", "minDomains", "nodeAffinityPolicy", "nodeTaintsPolicy",
+    "schedulingGates", "volumes", "pvc", "resourceClaims", "slim", "phase",
+    "Pending", "Running", "default", "default-scheduler", "TCP",
+    # selectors / affinity terms
+    "matchLabels", "matchExpressions", "matchFields", "values", "op",
+    "required", "preferred", "weight", "term", "namespaces",
+    "namespaceSelector", "nodeAffinity", "podAffinity", "podAntiAffinity",
+    # node wire
+    "allocatable", "capacity", "taints", "unschedulable",
+    "declaredFeatures", "NoSchedule", "zone", "topology.kubernetes.io/zone",
+    # lease / replication / paging envelopes
+    "holder", "duration", "transitions", "renew", "leaseDurationSeconds",
+    "ageSeconds", "expired", "leader", "role", "follower", "repl",
+    "listRv", "continue", "error", "code", "node", "bound", "created",
+    "alreadyExists", "names", "k", "e",
+)
+_WK_INDEX: Dict[str, int] = {s: i for i, s in enumerate(WELL_KNOWN)}
+_WK_N = len(WELL_KNOWN)
+
+_TAG_NONE = 0xC0
+_TAG_TRUE = 0xC1
+_TAG_FALSE = 0xC2
+_TAG_INT = 0xC3
+_TAG_FLOAT = 0xC4
+_TAG_STR_DEF = 0xC6
+_TAG_STR_REF = 0xC7
+_TAG_LIST = 0xC8
+_TAG_DICT = 0xC9
+_TAG_BYTES = 0xCA
+_SMALL_INT_MAX = 0xBE  # 0x00..0xBE inline; 0xBF is the frame MAGIC
+
+
+class WireError(ValueError):
+    """Corrupt or truncated binary frame (the torn-record signal)."""
+
+
+# ---------------------------------------------------------------------------
+# JSON compat plane — the module-local seam the analyzer rule points at
+# ---------------------------------------------------------------------------
+
+
+def jdumps(obj: Any) -> str:
+    """Compact JSON text — the debug/compat encode every non-binary wire
+    path routes through (one call site class for the analyzer rule)."""
+    return json.dumps(obj, separators=(",", ":"))
+
+
+def jloads(data) -> Any:
+    """JSON decode (str or bytes) — the compat-plane twin of jdumps."""
+    return json.loads(data)
+
+
+# ---------------------------------------------------------------------------
+# binary encode
+# ---------------------------------------------------------------------------
+
+
+def _append_varint(buf: bytearray, n: int) -> None:
+    while n > 0x7F:
+        buf.append((n & 0x7F) | 0x80)
+        n >>= 7
+    buf.append(n)
+
+
+def _encode_value(buf: bytearray, obj: Any, interns: Dict[str, int],
+                  pack_double=struct.Struct(">d").pack) -> None:
+    # bool before int: bool is an int subclass but must round-trip as bool
+    if obj is None:
+        buf.append(_TAG_NONE)
+    elif obj is True:
+        buf.append(_TAG_TRUE)
+    elif obj is False:
+        buf.append(_TAG_FALSE)
+    elif type(obj) is int:
+        if 0 <= obj <= _SMALL_INT_MAX:
+            buf.append(obj)
+        else:
+            buf.append(_TAG_INT)
+            # zigzag over arbitrary-precision ints (Python has no 64-bit
+            # wrap to lean on): non-negatives go even, negatives odd
+            _append_varint(buf, (obj << 1) if obj >= 0 else ((-obj) << 1) - 1)
+    elif type(obj) is str:
+        _encode_str(buf, obj, interns)
+    elif type(obj) is dict:
+        buf.append(_TAG_DICT)
+        _append_varint(buf, len(obj))
+        for k, v in obj.items():
+            if type(k) is not str:
+                raise TypeError(f"wire dict keys must be str, got {type(k)}")
+            _encode_str(buf, k, interns)
+            _encode_value(buf, v, interns)
+    elif type(obj) is list or type(obj) is tuple:
+        buf.append(_TAG_LIST)
+        _append_varint(buf, len(obj))
+        for item in obj:
+            _encode_value(buf, item, interns)
+    elif type(obj) is float:
+        buf.append(_TAG_FLOAT)
+        buf += pack_double(obj)
+    elif type(obj) is bytes:
+        buf.append(_TAG_BYTES)
+        _append_varint(buf, len(obj))
+        buf += obj
+    elif isinstance(obj, (int, float, str, dict, list, tuple, bytes)):
+        # subclasses (IntEnum etc.): normalize through the base type
+        base = (int if isinstance(obj, int) else
+                float if isinstance(obj, float) else
+                str if isinstance(obj, str) else
+                bytes if isinstance(obj, bytes) else
+                dict if isinstance(obj, dict) else list)
+        _encode_value(buf, base(obj), interns)
+    else:
+        raise TypeError(f"not wire-encodable: {type(obj)}")
+
+
+def _encode_str(buf: bytearray, s: str, interns: Dict[str, int]) -> None:
+    idx = _WK_INDEX.get(s)
+    if idx is None:
+        idx = interns.get(s)
+    if idx is not None:
+        buf.append(_TAG_STR_REF)
+        _append_varint(buf, idx)
+        return
+    interns[s] = _WK_N + len(interns)
+    raw = s.encode()
+    buf.append(_TAG_STR_DEF)
+    _append_varint(buf, len(raw))
+    buf += raw
+
+
+def encode_binary(obj: Any) -> bytes:
+    """One framed binary record: MAGIC VERSION varint(len) payload."""
+    payload = bytearray()
+    _encode_value(payload, obj, {})
+    frame = bytearray((MAGIC, VERSION))
+    _append_varint(frame, len(payload))
+    frame += payload
+    return bytes(frame)
+
+
+# ---------------------------------------------------------------------------
+# binary decode
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(buf, pos: int) -> Tuple[int, int]:
+    n = 0
+    shift = 0
+    ln = len(buf)
+    while True:
+        if pos >= ln:
+            raise WireError("varint past end")
+        b = buf[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+        if shift > 70:
+            raise WireError("varint too long")
+
+
+def _decode_value(buf, pos: int, dyn: List[str], wk=WELL_KNOWN, wk_n=_WK_N,
+                  unpack_double=struct.Struct(">d").unpack_from):
+    """Hot decode loop. Truncation surfaces as IndexError (byte indexing
+    past the end) — the public entry points convert it to WireError; the
+    fast path pays no explicit bounds checks. Varints are read inline:
+    nearly every count/index/ref on this wire fits one byte."""
+    tag = buf[pos]
+    pos += 1
+    if tag <= _SMALL_INT_MAX:
+        return tag, pos
+    if tag == _TAG_STR_REF:
+        idx = buf[pos]
+        pos += 1
+        if idx & 0x80:
+            idx, pos = _read_varint_cont(buf, pos, idx)
+        if idx < wk_n:
+            return wk[idx], pos
+        try:
+            return dyn[idx - wk_n], pos
+        except IndexError:
+            raise WireError(f"intern ref {idx} undefined") from None
+    if tag == _TAG_DICT:
+        n = buf[pos]
+        pos += 1
+        if n & 0x80:
+            n, pos = _read_varint_cont(buf, pos, n)
+        d = {}
+        dec = _decode_value
+        for _ in range(n):
+            k, pos = dec(buf, pos, dyn)
+            if type(k) is not str:
+                raise WireError("non-str dict key")
+            d[k], pos = dec(buf, pos, dyn)
+        return d, pos
+    if tag == _TAG_STR_DEF:
+        n = buf[pos]
+        pos += 1
+        if n & 0x80:
+            n, pos = _read_varint_cont(buf, pos, n)
+        end = pos + n
+        if end > len(buf):
+            raise WireError("string past end")
+        try:
+            s = bytes(buf[pos:end]).decode()
+        except UnicodeDecodeError as e:
+            raise WireError("bad utf-8") from e
+        dyn.append(s)
+        return s, end
+    if tag == _TAG_LIST:
+        n = buf[pos]
+        pos += 1
+        if n & 0x80:
+            n, pos = _read_varint_cont(buf, pos, n)
+        out = []
+        append = out.append
+        dec = _decode_value
+        for _ in range(n):
+            v, pos = dec(buf, pos, dyn)
+            append(v)
+        return out, pos
+    if tag == _TAG_INT:
+        z, pos = _read_varint(buf, pos)
+        return (z >> 1) ^ -(z & 1), pos
+    if tag == _TAG_FLOAT:
+        if pos + 8 > len(buf):
+            raise WireError("float past end")
+        return unpack_double(buf, pos)[0], pos + 8
+    if tag == _TAG_NONE:
+        return None, pos
+    if tag == _TAG_TRUE:
+        return True, pos
+    if tag == _TAG_FALSE:
+        return False, pos
+    if tag == _TAG_BYTES:
+        n, pos = _read_varint(buf, pos)
+        end = pos + n
+        if end > len(buf):
+            raise WireError("bytes past end")
+        return bytes(buf[pos:end]), end
+    raise WireError(f"unknown tag 0x{tag:02x}")
+
+
+def _read_varint_cont(buf, pos: int, first: int) -> Tuple[int, int]:
+    """Continue a varint whose first byte had the continuation bit set."""
+    n = first & 0x7F
+    shift = 7
+    while True:
+        b = buf[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+        if shift > 70:
+            raise WireError("varint too long")
+
+
+def decode_binary(data) -> Any:
+    """Decode ONE complete binary frame (header included)."""
+    got = scan(data, 0)
+    if got is None:
+        raise WireError("incomplete frame")
+    obj, end = got
+    if end != len(data):
+        raise WireError("trailing bytes after frame")
+    return obj
+
+
+def scan(buf, pos: int) -> Optional[Tuple[Any, int]]:
+    """Parse one record (binary frame OR ``{...}\\n`` JSON line) at ``pos``
+    in ``buf``. Returns ``(obj, next_pos)``, or None when everything from
+    ``pos`` on is torn — incomplete or undecodable — and must be truncated
+    away (the WAL replay contract, identical for both codecs)."""
+    ln = len(buf)
+    if pos >= ln:
+        return None
+    first = buf[pos]
+    if first == MAGIC:
+        try:
+            if pos + 2 > ln:
+                return None
+            # version byte reserved: today only VERSION is ever written,
+            # and an unknown version in a terminated frame is torn data
+            if buf[pos + 1] != VERSION:
+                return None
+            n, p = _read_varint(buf, pos + 2)
+            if p + n > ln:
+                return None
+            obj, end = _decode_value(buf[p:p + n], 0, [])
+            if end != n:
+                return None
+            return obj, p + n
+        except (WireError, IndexError):
+            return None
+    # JSON line plane (old WALs / JSON peers)
+    nl = buf.find(b"\n", pos) if isinstance(buf, (bytes, bytearray)) else -1
+    if nl < 0:
+        return None
+    try:
+        return json.loads(bytes(buf[pos:nl])), nl + 1
+    except ValueError:
+        return None
+
+
+def decode(data) -> Any:
+    """Sniff-decode one complete record, either codec (bodies, frames)."""
+    if data and data[0] == MAGIC:
+        return decode_binary(data)
+    return json.loads(data)
+
+
+# ---------------------------------------------------------------------------
+# the negotiated seam
+# ---------------------------------------------------------------------------
+
+
+def encode(obj: Any, codec: str = JSON) -> bytes:
+    """One wire record in the given codec: a binary frame, or the JSON
+    plane's ``{...}\\n`` line."""
+    if codec == BINARY:
+        return encode_binary(obj)
+    return (jdumps(obj) + "\n").encode()
+
+
+def wire_enabled() -> bool:
+    """Process-wide binary-plane gate: ``TPU_SCHED_WIRE=json`` pins this
+    process (offers AND answers) to the JSON compat plane."""
+    return os.environ.get("TPU_SCHED_WIRE", BINARY).lower() != JSON
+
+
+def accept_codec(accept_header: Optional[str]) -> str:
+    """Server side of the negotiation: binary iff the client offered
+    ``Accept: application/x-tpu-wire`` and this server is willing."""
+    if accept_header and WIRE_MIME in accept_header and wire_enabled():
+        return BINARY
+    return JSON
+
+
+def client_headers() -> Dict[str, str]:
+    """Client side of the negotiation: the Accept offer (empty when this
+    process is pinned to JSON)."""
+    if wire_enabled():
+        return {"Accept": WIRE_MIME}
+    return {}
+
+
+def mime_for(codec: str) -> str:
+    return WIRE_MIME if codec == BINARY else JSON_MIME
+
+
+def codec_of_mime(content_type: Optional[str]) -> str:
+    return BINARY if (content_type and WIRE_MIME in content_type) else JSON
+
+
+# ---------------------------------------------------------------------------
+# stream reading (watch / ship / paged LIST / snapshot bootstrap)
+# ---------------------------------------------------------------------------
+
+
+def read_event(fp) -> Optional[Tuple[Any, int, str]]:
+    """Read one record off a stream (file-like, e.g. an HTTPResponse):
+    ``(obj, wire_bytes, codec)``, or None at EOF. Sniffs PER RECORD, so a
+    stream whose peer switches codec mid-flight (a binary follower tailing
+    through a JSON leader's promotion) keeps decoding. Raises
+    :class:`WireError` on a frame torn mid-stream — the caller's
+    reconnect/re-list handling owns what happens next (exactly what a torn
+    JSON line did via json.JSONDecodeError)."""
+    first = fp.read(1)
+    if not first:
+        return None
+    if first[0] == MAGIC:
+        head = fp.read(1)
+        if not head:
+            raise WireError("stream torn in frame header")
+        if head[0] != VERSION:
+            raise WireError(f"unknown wire version {head[0]}")
+        n = 0
+        shift = 0
+        nbytes = 2
+        while True:
+            b = fp.read(1)
+            if not b:
+                raise WireError("stream torn in frame length")
+            nbytes += 1
+            n |= (b[0] & 0x7F) << shift
+            if not b[0] & 0x80:
+                break
+            shift += 7
+            if shift > 70:
+                raise WireError("varint too long")
+        payload = fp.read(n)
+        while len(payload) < n:
+            more = fp.read(n - len(payload))
+            if not more:
+                raise WireError("stream torn in frame payload")
+            payload += more
+        try:
+            obj, end = _decode_value(payload, 0, [])
+        except IndexError:
+            raise WireError("frame truncated") from None
+        if end != n:
+            raise WireError("trailing bytes in frame")
+        return obj, nbytes + n, BINARY
+    line = first + fp.readline()
+    return json.loads(line), len(line), JSON
+
+
+# ---------------------------------------------------------------------------
+# encode-once-per-codec carrier
+# ---------------------------------------------------------------------------
+
+
+class WireItem:
+    """One wire record with its encodings cached per codec: the watch
+    fanout, the resume ring, and the replication backlog hold WireItems so
+    an event is encoded ONCE per codec — not once per attached stream, and
+    the WAL append shares the binary bytes with every binary follower.
+    Benignly racy: two stream threads may both encode the first time; the
+    encodes are identical and one wins."""
+
+    __slots__ = ("obj", "_enc")
+
+    def __init__(self, obj: Any, enc: Optional[Dict[str, bytes]] = None):
+        self.obj = obj
+        self._enc = enc if enc is not None else {}
+
+    def bytes(self, codec: str = JSON) -> bytes:
+        b = self._enc.get(codec)
+        if b is None:
+            b = self._enc[codec] = encode(self.obj, codec)
+        return b

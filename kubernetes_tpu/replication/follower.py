@@ -34,11 +34,12 @@ client retry layers until the promotion lands — degraded, never a crash.
 
 from __future__ import annotations
 
-import json
 import threading
 import time
 from typing import Dict, Optional
 from urllib import request as urlrequest
+
+from ..core import wire
 
 REPL_LEASE = "repl-leader"
 
@@ -142,9 +143,11 @@ class ReplicationTail:
     # -- bootstrap ----------------------------------------------------------
 
     def _get_json(self, url: str, timeout: float):
+        # Status probes stay JSON (no Accept offer): the election path is
+        # the debug plane, and a probe must parse against ANY peer.
         req = urlrequest.Request(url)
         with urlrequest.urlopen(req, timeout=timeout) as resp:
-            return json.loads(resp.read())
+            return wire.jloads(resp.read())
 
     def bootstrap(self, timeout: float = 30.0) -> None:
         """Synchronous initial sync for a COLD follower (empty local WAL):
@@ -197,7 +200,8 @@ class ReplicationTail:
             host, timeout=max(10.0, self.lease_duration * 4))
         try:
             conn.request(
-                "GET", f"/replication/snapshot?limit={self.page_limit}")
+                "GET", f"/replication/snapshot?limit={self.page_limit}",
+                headers=wire.client_headers())
             resp = conn.getresponse()
             if resp.status != 200:
                 resp.read()
@@ -207,10 +211,10 @@ class ReplicationTail:
             objs: Dict[str, list] = {"pods": [], "nodes": []}
             complete = False
             while True:
-                line = resp.readline()
-                if not line:
+                got = wire.read_event(resp)
+                if got is None:
                     break
-                d = json.loads(line)
+                d, _nbytes, _codec = got
                 typ = d.get("type")
                 if typ == "SNAP_META":
                     if d.get("role") != "leader":
@@ -301,7 +305,7 @@ class ReplicationTail:
                 f"&epoch={api.repl_epoch}&hb={self.hb}"
                 f"&leader={quote(self.leader_url, safe='')}")
         try:
-            conn.request("GET", path)
+            conn.request("GET", path, headers=wire.client_headers())
             resp = conn.getresponse()
         except Exception:  # noqa: BLE001 - leader unreachable
             conn.close()
@@ -326,10 +330,14 @@ class ReplicationTail:
         made_contact = False
         try:
             while not self._stop.is_set():
-                line = resp.readline()
-                if not line:
+                # Sniff-decoded per frame (core/wire.py): a binary
+                # follower keeps tailing through a JSON peer's frames —
+                # codec continuity is NOT part of the stream contract,
+                # which is what lets mixed fleets promote across planes.
+                got = wire.read_event(resp)
+                if got is None:
                     return made_contact  # EOF: leader went away
-                rec = json.loads(line)
+                rec, _nbytes, _codec = got
                 if rec.get("type") == "HB":
                     ep = int(rec.get("epoch", 0))
                     if (ep < api.repl_epoch
@@ -424,10 +432,8 @@ class ReplicationTail:
         surviving followers re-tail to us immediately, and a stale
         co-claimant demotes itself even though no follower tails it. Best
         effort — a peer that misses it converges via its own election."""
-        import json as _json
-
         api = self.api
-        body = _json.dumps({"leader": api.advertise_url,
+        body = wire.jdumps({"leader": api.advertise_url,
                             "epoch": api.repl_epoch,
                             "rank": api.replica_rank}).encode()
         for rank, url in sorted(api.repl_peers.items()):

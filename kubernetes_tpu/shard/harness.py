@@ -586,18 +586,54 @@ def run_sharded_cluster(
                     url, "scheduler_e2e_scheduling_duration_seconds",
                     text=text))
                 # Per-shard decoded events/bytes by wire form — the
-                # measurable 1/N of the shard-filtered watch plane.
+                # measurable 1/N of the shard-filtered watch plane — and
+                # by codec (core/wire.py): which plane this shard's
+                # decode actually ran on, and what it cost in bytes.
                 watch_decode.append({
                     "events": scrape_labeled(
                         url, "scheduler_watch_decoded_events", "form",
                         text=text),
                     "bytes": scrape_labeled(
                         url, "scheduler_watch_decoded_bytes", "form",
+                        text=text),
+                    "events_by_codec": scrape_labeled(
+                        url, "scheduler_watch_decoded_events", "codec",
+                        text=text),
+                    "bytes_by_codec": scrape_labeled(
+                        url, "scheduler_watch_decoded_bytes", "codec",
                         text=text)})
             except Exception:  # noqa: BLE001 - a killed shard has no /metrics
                 shard_metrics.append({})
                 watch_decode.append({})
-        api_metrics = scrape_metrics(base)
+        api_text = _fetch_metrics(base)
+        api_metrics = scrape_metrics(base, text=api_text)
+        # Wire-plane summary (apiserver_wire_bytes_total{codec,surface}):
+        # server-served bytes by codec and by surface — aggregated over
+        # the LEADER and every follower replica (the shards' watch/list
+        # reads land on followers when the plane has them) — plus the
+        # per-shard decoded-bytes totals by codec: the one detail object
+        # that proves WHICH plane (binary vs JSON) ran end-to-end.
+        wire_by_codec: Dict[str, float] = {}
+        wire_by_surface: Dict[str, float] = {}
+        for url in [base] + list(cluster.follower_urls):
+            try:
+                text = api_text if url == base else _fetch_metrics(url)
+                for k, v in scrape_labeled(
+                        url, "apiserver_wire_bytes_total", "codec",
+                        text=text).items():
+                    wire_by_codec[k] = wire_by_codec.get(k, 0.0) + v
+                for k, v in scrape_labeled(
+                        url, "apiserver_wire_bytes_total", "surface",
+                        text=text).items():
+                    wire_by_surface[k] = wire_by_surface.get(k, 0.0) + v
+            except Exception:  # noqa: BLE001 - replica down mid-teardown
+                continue
+        wire_summary = {
+            "server_bytes_by_codec": wire_by_codec,
+            "server_bytes_by_surface": wire_by_surface,
+            "shard_decoded_bytes_by_codec": [
+                wd.get("bytes_by_codec", {}) for wd in watch_decode],
+        }
         # Follower-served /metrics/resources: one scrape off a follower
         # replica proves the per-pod resource read plane serves away from
         # the leader (the same watch-cache snapshot, shared rv space).
@@ -680,6 +716,7 @@ def run_sharded_cluster(
             "read_plane": dict(read_counts,
                                resource_series=resource_series),
             "watch_decode": watch_decode,
+            "wire": wire_summary,
             "api": {k: v for k, v in api_metrics.items()
                     if "conflict" in k or "lease" in k
                     or "replication" in k or "failover" in k

@@ -1,0 +1,108 @@
+"""Wire-codec CLI: `python -m kubernetes_tpu.wire --bench`.
+
+Re-exports the codec seam (:mod:`kubernetes_tpu.core.wire`) and runs the
+encode/decode micro-bench the docs/WIRE.md perf table quotes: MB/s and
+bytes-per-event for the JSON plane vs the binary plane, over the event
+shapes that dominate the control-plane wire — a full pod ADDED, the
+shard filter's slim projection, a BOUND commit, a node ADDED, and a
+seq+epoch-stamped WAL/ship frame.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from .core.wire import (  # noqa: F401 - re-exported seam
+    BINARY,
+    JSON,
+    MAGIC,
+    VERSION,
+    WELL_KNOWN,
+    WIRE_MIME,
+    WireError,
+    WireItem,
+    accept_codec,
+    client_headers,
+    decode,
+    decode_binary,
+    encode,
+    encode_binary,
+    jdumps,
+    jloads,
+    read_event,
+    scan,
+    wire_enabled,
+)
+
+
+def _shapes():
+    from .core.apiserver import node_to_wire, pod_to_wire
+    from .core.watchcache import slim_object
+    from .testing.wrappers import make_node, make_pod
+
+    pod = (make_pod().name("wire-bench-000123")
+           .req({"cpu": "100m", "memory": "128Mi"})
+           .labels({"app": "wire-bench"}).obj())
+    node = (make_node().name("node-0123")
+            .capacity({"cpu": 32, "memory": "256Gi", "pods": 110})
+            .zone("zone-7").obj())
+    pw = pod_to_wire(pod)
+    full = {"type": "ADDED", "object": pw, "rv": 123456}
+    return (
+        ("pod_full", full),
+        ("pod_slim", {"type": "MODIFIED", "object": slim_object(pw),
+                      "rv": 123457}),
+        ("bound", {"type": "BOUND",
+                   "object": {"uid": pw["uid"], "nodeName": "node-0123"},
+                   "rv": 123458}),
+        ("node_full", {"type": "ADDED", "object": node_to_wire(node),
+                       "rv": 77}),
+        ("wal_frame", dict(full, kind="pods", seq=987654, epoch=3)),
+    )
+
+
+def bench(n: int = 20000) -> dict:
+    out = {"events_per_shape": n, "shapes": {}}
+    for name, obj in _shapes():
+        row = {}
+        for codec in (JSON, BINARY):
+            data = encode(obj, codec)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                encode(obj, codec)
+            t_enc = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(n):
+                decode(data)
+            t_dec = time.perf_counter() - t0
+            mb = len(data) * n / 1e6
+            row[codec] = {
+                "bytes_per_event": len(data),
+                "encode_mb_s": round(mb / t_enc, 1),
+                "decode_mb_s": round(mb / t_dec, 1),
+                "encode_us": round(1e6 * t_enc / n, 2),
+                "decode_us": round(1e6 * t_dec / n, 2),
+            }
+        row["bytes_ratio"] = round(
+            row[JSON]["bytes_per_event"] / row[BINARY]["bytes_per_event"], 2)
+        out["shapes"][name] = row
+    return out
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--bench" in argv:
+        n = 20000
+        if "--n" in argv:
+            n = int(argv[argv.index("--n") + 1])
+        print(json.dumps(bench(n), indent=2))
+        return 0
+    print("usage: python -m kubernetes_tpu.wire --bench [--n N]",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

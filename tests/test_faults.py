@@ -652,7 +652,9 @@ def test_chaos_end_to_end_100n_1000p():
 
 
 @pytest.mark.chaos
-def test_apiserver_kill9_restart_mixed_churn(tmp_path):
+@pytest.mark.parametrize("wire_plane", ["binary", "json"])
+def test_apiserver_kill9_restart_mixed_churn(tmp_path, monkeypatch,
+                                             wire_plane):
     """The durability acceptance run: ``kill -9`` the apiserver OS process
     mid-MixedChurn, restart it in place from WAL+snapshot (same port, same
     data dir) — the reflector resumes on the PERSISTED epoch (RESUME, never
@@ -662,6 +664,11 @@ def test_apiserver_kill9_restart_mixed_churn(tmp_path):
                                                pod_to_wire)
     from kubernetes_tpu.testing.faults import ApiServerProcess
 
+    # Both wire planes (core/wire.py): binary is the negotiated default;
+    # the json run pins the whole plane (WAL records, watch streams,
+    # bodies) to the compat codec — the exactly-once/RESUME contract is
+    # codec-independent. Subprocesses inherit the env.
+    monkeypatch.setenv("TPU_SCHED_WIRE", wire_plane)
     N_PODS = 240
     # snapshot_every > total writes: this run recovers through pure WAL
     # replay, which keeps the recovered backlog covering the reflector's rv
@@ -755,7 +762,9 @@ def test_apiserver_kill9_restart_mixed_churn(tmp_path):
 
 
 @pytest.mark.chaos
-def test_shard_kill_adoption_mixed_churn(tmp_path):
+@pytest.mark.parametrize("wire_plane", [
+    "binary", pytest.param("json", marks=pytest.mark.slow)])
+def test_shard_kill_adoption_mixed_churn(tmp_path, monkeypatch, wire_plane):
     """SIGKILL one of 3 shard scheduler PROCESSES mid-MixedChurn: its lease
     ages past expiry unrenewed, the ring successor adopts the dead range
     (sweeping the informer backlog the dead shard never drained), and the
@@ -765,6 +774,10 @@ def test_shard_kill_adoption_mixed_churn(tmp_path):
     through the binding subresource's 409s."""
     from kubernetes_tpu.shard.harness import _call, run_sharded_cluster
 
+    # Wire-plane parameterization: binary (the negotiated default) in
+    # tier-1, the json compat plane in the slow tier — adoption and
+    # exactly-once must hold identically on both.
+    monkeypatch.setenv("TPU_SCHED_WIRE", wire_plane)
     LEASE = 2.0
     state = {"killed_at": 0.0, "nodes": None, "churn": 0}
 
@@ -852,7 +865,10 @@ def _flight_spans(flight_dir, name):
 
 
 @pytest.mark.chaos
-def test_leader_kill9_promotion_mixed_churn(tmp_path):
+@pytest.mark.parametrize("wire_plane", [
+    "binary", pytest.param("json", marks=pytest.mark.slow)])
+def test_leader_kill9_promotion_mixed_churn(tmp_path, monkeypatch,
+                                            wire_plane):
     """The replication acceptance run: ``kill -9`` the LEADER apiserver
     mid-MixedChurn with TWO shard schedulers reading from two followers.
     The lowest-ranked live follower promotes within the lease TTL (fenced
@@ -866,6 +882,10 @@ def test_leader_kill9_promotion_mixed_churn(tmp_path):
     from kubernetes_tpu.shard import ShardMember
     from kubernetes_tpu.testing.faults import ReplicaSet
 
+    # Wire-plane parameterization (core/wire.py): the binary run is the
+    # negotiated default (tier-1); the json run rides the slow tier and
+    # proves promotion/exactly-once are codec-independent.
+    monkeypatch.setenv("TPU_SCHED_WIRE", wire_plane)
     N_PODS, N_NODES, LEASE = 240, 20, 2.0
     flight = str(tmp_path / "flightrec")
     rs = ReplicaSet(str(tmp_path / "replicas"), followers=2,

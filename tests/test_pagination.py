@@ -547,24 +547,31 @@ class TestPagedSnapshotBootstrap:
                                rank=1, lease_duration=5.0, page_limit=3)
 
         class _TornResp:
-            """Wrap the response: deliver a few lines, then EOF early."""
+            """Wrap the response: deliver a bounded byte budget, then EOF
+            early — tears mid-stream under EITHER codec (the binary
+            reader consumes via read(), the JSON plane via readline())."""
 
             def __init__(self, resp):
                 self._resp = resp
-                self._served = 0
+                self._budget = 160
 
             @property
             def status(self):
                 return self._resp.status
 
             def read(self, *a):
-                return self._resp.read(*a)
+                if self._budget <= 0:
+                    return b""   # torn: connection died mid-stream
+                data = self._resp.read(*a)
+                self._budget -= len(data)
+                return data
 
             def readline(self):
-                self._served += 1
-                if self._served > 4:
-                    return b""   # torn: connection died mid-stream
-                return self._resp.readline()
+                if self._budget <= 0:
+                    return b""
+                line = self._resp.readline()
+                self._budget -= len(line)
+                return line
 
         import http.client as hc
         orig_getresponse = hc.HTTPConnection.getresponse

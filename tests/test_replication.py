@@ -79,8 +79,17 @@ def test_wal_frames_carry_monotonic_seq_and_epoch(tmp_path):
     for i in range(4):
         api.store.create_pod(_pod(f"p{i}"))
     api.shutdown()
-    with open(tmp_path / "leader" / "wal.log") as fh:
-        recs = [json.loads(line) for line in fh]
+    # WAL records are binary frames by default now (core/wire.py); scan()
+    # sniffs per record, so this read works for either codec's history.
+    from kubernetes_tpu.core import wire
+    buf = (tmp_path / "leader" / "wal.log").read_bytes()
+    recs, pos = [], 0
+    while True:
+        got = wire.scan(buf, pos)
+        if got is None:
+            break
+        rec, pos = got
+        recs.append(rec)
     assert [r["seq"] for r in recs] == list(range(1, len(recs) + 1))
     assert all(r["epoch"] == 1 for r in recs)
     # restart resumes the seq counter, not restarts it

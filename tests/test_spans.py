@@ -229,8 +229,21 @@ class TestWirePropagation:
             # binder + observer both decode the BOUND event → 2 observes
             assert names == ["api.bind", "bind.post", "bound.fanout",
                              "bound.observe", "bound.observe", "wal.append"]
-            wal = (tmp_path / "state" / "wal.log").read_text()
-            assert format_ctx(tracer.context_for(p.uid)) in wal
+            # WAL records are binary wire frames now (core/wire.py):
+            # interning splits a string's bytes across define/ref sites,
+            # so decode the records instead of grepping raw text.
+            from kubernetes_tpu.core import wire as _wire
+            buf = (tmp_path / "state" / "wal.log").read_bytes()
+            tctxs, pos = [], 0
+            while True:
+                got = _wire.scan(buf, pos)
+                if got is None:
+                    break
+                rec, pos = got
+                tctx = (rec.get("object") or {}).get("tctx")
+                if tctx:
+                    tctxs.append(tctx)
+            assert format_ctx(tracer.context_for(p.uid)) in tctxs
         finally:
             for c in (binder, observer):
                 if c is not None:

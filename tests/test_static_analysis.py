@@ -829,7 +829,7 @@ def test_every_checker_registered_and_described():
     ids = sorted(c.id for c in checkers)
     assert ids == ["hint-freshness", "index-dtype", "jit-purity",
                    "lock-discipline", "metrics-discipline",
-                   "span-discipline", "thread-hygiene"]
+                   "span-discipline", "thread-hygiene", "wire-discipline"]
     assert all(c.description for c in checkers)
 
 
@@ -852,6 +852,66 @@ def test_allowlist_suppresses_and_goes_stale():
         stale = Allow("index-dtype", "mod.py", 99, "fixture: wrong line")
         report = analyze(root=root, allowlist=[stale])
         assert len(report.findings) == 1 and report.unused_allows == [stale]
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus: wire-discipline (PR 13)
+# ---------------------------------------------------------------------------
+
+
+class TestWireDiscipline:
+    BAD_FANOUT = (
+        "import json\n"
+        "class S:\n"
+        "    def _broadcast(self, event):\n"
+        "        data = (json.dumps(event) + '\\n').encode()\n"
+        "        self.fan(data)\n"
+        "    def _tail(self, line):\n"
+        "        return json.loads(line)\n")
+
+    def test_json_on_hot_surface_flagged(self):
+        fs = check_source(checker_by_id("wire-discipline"),
+                          self.BAD_FANOUT, path="core/apiserver.py")
+        assert {(f.rule, f.line) for f in fs} == {
+            ("json-on-wire-surface", 4), ("json-on-wire-surface", 7)}
+
+    def test_aliased_imports_resolved(self):
+        aliased = (
+            "import json as _j\n"
+            "from json import loads as _loads\n"
+            "def ship(rec, line):\n"
+            "    return _j.dumps(rec), _loads(line)\n")
+        fs = check_source(checker_by_id("wire-discipline"),
+                          aliased, path="core/wal.py")
+        assert len(fs) == 2 and all(
+            f.rule == "json-on-wire-surface" for f in fs)
+
+    def test_routing_through_the_seam_is_clean(self):
+        good = (
+            "from . import wire\n"
+            "class S:\n"
+            "    def _broadcast(self, event):\n"
+            "        self.fan(wire.WireItem(event))\n"
+            "    def _meta(self, raw):\n"
+            "        return wire.jloads(raw)\n"
+            "    def _reply(self, obj, codec):\n"
+            "        return wire.encode(obj, codec)\n")
+        assert check_source(checker_by_id("wire-discipline"),
+                            good, path="core/watchcache.py") == []
+
+    def test_non_hot_modules_and_the_seam_are_out_of_scope(self):
+        src = "import json\nx = json.dumps({'a': 1})\n"
+        # the codec seam itself IS the json call site
+        assert check_source(checker_by_id("wire-discipline"),
+                            src, path="core/wire.py") == []
+        # harness/bench/debug modules keep plain json freely
+        assert check_source(checker_by_id("wire-discipline"),
+                            src, path="shard/harness.py") == []
+
+    def test_tree_is_clean(self):
+        checker = checker_by_id("wire-discipline")
+        report = analyze(checkers=[checker], allowlist=[])
+        assert report.findings == [], [str(f) for f in report.findings]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,474 @@
+"""Binary wire codec (core/wire.py, docs/WIRE.md).
+
+Covers: randomized round-trip fuzz vs the JSON oracle over protocol-shaped
+objects (pods incl. slim projections, nodes, leases, seq+epoch WAL/ship
+frames, continuation trailers); truncation fuzz at EVERY byte offset
+asserting torn binary frames truncate exactly like torn JSON (WAL replay +
+stream reads); Accept:-style negotiation end-to-end with per-surface
+byte attribution; mixed-plane interop (binary client vs JSON-only server
+and vice versa, a binary follower tailing a JSON leader across promotion,
+old JSON WAL dirs recovered by the binary-default store); and the bulk
+binding envelope's verdict mapping on the binary plane.
+"""
+
+import io
+import json
+import random
+import time
+
+import pytest
+
+from kubernetes_tpu.core import wire
+from kubernetes_tpu.core.apiserver import (
+    APIServer,
+    HTTPClientset,
+    fetch_paged,
+    node_to_wire,
+    pod_to_wire,
+)
+from kubernetes_tpu.core.wal import DurableStore
+from kubernetes_tpu.core.watchcache import slim_object
+from kubernetes_tpu.replication import ReplicationTail
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _wait(pred, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# round-trip fuzz vs the JSON oracle
+# ---------------------------------------------------------------------------
+
+
+def _rand_scalar(rng: random.Random):
+    kind = rng.randrange(8)
+    if kind == 0:
+        return None
+    if kind == 1:
+        return rng.random() < 0.5
+    if kind == 2:
+        # small ints (the inline fast path), boundary values, negatives,
+        # and >64-bit magnitudes (Python ints are unbounded)
+        return rng.choice([0, 1, 0xBE, 0xBF, 0xC0, 255, -1, -7,
+                           2**31, -2**31, 2**63 + 12345, -2**70,
+                           rng.randrange(-10**6, 10**6)])
+    if kind == 3:
+        return rng.choice([0.0, -0.5, 3.141592653589793, 1e-12, 1e300,
+                           rng.random() * 1e6])
+    if kind == 4:
+        return ""
+    if kind == 5:
+        # repeated protocol-ish strings (the intern table's bread)
+        return rng.choice(["nodeName", "uid", "ADDED", "default",
+                           "zone-7", "node-00123"])
+    if kind == 6:
+        return "uid-%032x" % rng.getrandbits(128)
+    return rng.choice(["ünïcode-∞", "tab\tnl\nquote\"", "汉字", "🦀",
+                       "x" * rng.randrange(0, 300)])
+
+
+def _rand_obj(rng: random.Random, depth: int = 0):
+    if depth >= 3 or rng.random() < 0.4:
+        return _rand_scalar(rng)
+    if rng.random() < 0.5:
+        return {("k%d" % i if rng.random() < 0.5
+                 else str(_rand_scalar(rng))): _rand_obj(rng, depth + 1)
+                for i in range(rng.randrange(0, 6))}
+    return [_rand_obj(rng, depth + 1) for _ in range(rng.randrange(0, 6))]
+
+
+class TestRoundTripFuzz:
+    def test_randomized_objects_vs_json_oracle(self):
+        rng = random.Random(0xC0DEC)
+        for i in range(400):
+            obj = _rand_obj(rng)
+            frame = wire.encode_binary(obj)
+            got = wire.decode_binary(frame)
+            oracle = json.loads(json.dumps(obj))
+            assert got == oracle == obj, (i, obj)
+            # the sniffing decoder agrees on both planes
+            assert wire.decode(frame) == obj
+            assert wire.decode(wire.encode(obj, wire.JSON)) == obj
+
+    def test_protocol_shapes_roundtrip(self):
+        rng = random.Random(7)
+        for i in range(60):
+            pod = (make_pod().name(f"p{i}")
+                   .req({"cpu": f"{rng.randrange(1, 2000)}m",
+                         "memory": f"{rng.randrange(1, 512)}Mi"})
+                   .labels({"app": f"a{i % 5}", "tier": "fuzz"})
+                   .priority(rng.randrange(0, 100)).obj())
+            node = (make_node().name(f"n{i}")
+                    .capacity({"cpu": 8, "memory": "32Gi", "pods": 110})
+                    .zone(f"z{i % 3}").obj())
+            pw, nw = pod_to_wire(pod), node_to_wire(node)
+            shapes = [
+                {"type": "ADDED", "object": pw, "rv": i + 1},
+                {"type": "MODIFIED", "object": slim_object(pw), "rv": i + 2},
+                {"type": "BOUND",
+                 "object": {"uid": pw["uid"], "nodeName": nw["name"]},
+                 "rv": i + 3},
+                {"type": "ADDED", "object": nw, "rv": i + 4},
+                # seq+epoch-stamped WAL/ship frame
+                {"kind": "pods", "type": "ADDED", "object": pw,
+                 "rv": i + 1, "seq": 10_000 + i, "epoch": 3},
+                {"kind": "leases", "type": "LEASE",
+                 "object": {"name": "shard-0", "holder": f"s{i}",
+                            "duration": 2.5, "transitions": i}},
+                # PAGE trailer (continuation tokens ride it opaque)
+                {"type": "PAGE", "rv": i, "listRv": i - 1, "epoch": "e1",
+                 "continue": "dG9rZW4="},
+            ]
+            for obj in shapes:
+                frame = wire.encode_binary(obj)
+                assert wire.decode_binary(frame) == obj
+                assert json.loads(json.dumps(obj)) == obj
+
+    def test_bytes_passthrough_binary_only(self):
+        payload = {"raw": b"\x00\xbf\x01already-encoded\xff"}
+        assert wire.decode_binary(wire.encode_binary(payload)) == payload
+        with pytest.raises(TypeError):
+            wire.encode(payload, wire.JSON)
+
+    def test_intern_table_resets_per_frame(self):
+        # the same novel strings in two frames: each frame is
+        # self-contained, so the SECOND decodes alone (stream prefixes can
+        # be truncated away without poisoning later frames)
+        obj = {"novel-key-xyz": ["novel-key-xyz", "novel-value-abc",
+                                 "novel-value-abc"]}
+        f1, f2 = wire.encode_binary(obj), wire.encode_binary(obj)
+        assert f1 == f2
+        assert wire.decode_binary(f2) == obj
+        # refs are cheaper than defs: the repeated strings shrank frame 1
+        assert len(f1) < len((json.dumps(obj) + "\n").encode())
+
+    def test_well_known_table_is_duplicate_free_and_versioned(self):
+        # a duplicate entry would shadow an index and corrupt every frame;
+        # the version byte is what lets a reader key its seed table
+        assert len(set(wire.WELL_KNOWN)) == len(wire.WELL_KNOWN)
+        assert wire.VERSION == 1
+        assert wire.encode_binary({})[1] == wire.VERSION
+
+
+# ---------------------------------------------------------------------------
+# truncation fuzz: torn binary == torn JSON, at every byte offset
+# ---------------------------------------------------------------------------
+
+
+def _fuzz_records():
+    return [
+        {"kind": "pods", "type": "ADDED", "rv": i,
+         "object": {"uid": f"u{i}", "name": f"p{i}", "deletionTs": None,
+                    "requests": {"cpu": 100 + i, "memory": 2.5 * i,
+                                 "scalar": {}},
+                    "labels": {"app": "fuzz", "note": "ünïcode-∞"}},
+         "seq": i, "epoch": 1}
+        for i in range(1, 7)
+    ]
+
+
+class TestTruncationFuzz:
+    @pytest.mark.parametrize("codec", [wire.BINARY, wire.JSON])
+    def test_wal_truncated_at_every_offset(self, tmp_path, codec):
+        """Identical torn-tail contract on both codecs: at EVERY byte
+        offset, replay yields exactly the longest clean prefix of records,
+        counts at most one torn record, and truncates the file back to the
+        last good frame so the next append starts clean."""
+        recs = _fuzz_records()
+        src = tmp_path / "src"
+        ds = DurableStore(str(src), codec=codec)
+        ds.load()
+        for r in recs:
+            ds.append(r)
+        ds.close()
+        buf = (src / DurableStore.WAL).read_bytes()
+        # record boundaries via the same sniffing scanner replay uses
+        bounds, pos = [0], 0
+        while True:
+            got = wire.scan(buf, pos)
+            if got is None:
+                break
+            _, pos = got
+            bounds.append(pos)
+        assert len(bounds) == len(recs) + 1 and bounds[-1] == len(buf)
+        for cut in range(len(buf) + 1):
+            d = tmp_path / f"cut-{codec}-{cut}"
+            d.mkdir()
+            (d / DurableStore.WAL).write_bytes(buf[:cut])
+            ds2 = DurableStore(str(d), codec=codec)
+            _snap, replayed = ds2.load()
+            n_good = max(i for i, b in enumerate(bounds) if b <= cut)
+            assert replayed == recs[:n_good], (codec, cut)
+            at_boundary = cut in bounds
+            assert ds2.torn_records_discarded == (0 if at_boundary else 1), (
+                codec, cut)
+            # the torn tail is gone from disk: a new append starts clean
+            ds2.append(recs[0])
+            ds2.close()
+            ds3 = DurableStore(str(d), codec=codec)
+            _snap, replayed = ds3.load()
+            assert replayed == recs[:n_good] + [recs[0]], (codec, cut)
+            assert ds3.torn_records_discarded == 0
+            ds3.close()
+
+    @pytest.mark.parametrize("codec", [wire.BINARY, wire.JSON])
+    def test_stream_torn_at_every_offset_never_yields_garbage(self, codec):
+        """The follower-tail / watch-stream read path: a stream cut at any
+        byte yields exactly a clean prefix of records, then EOF or a torn
+        error — never a corrupt record (the json.JSONDecodeError analogue
+        is WireError)."""
+        recs = _fuzz_records()
+        buf = b"".join(wire.encode(r, codec) for r in recs)
+        for cut in range(len(buf) + 1):
+            fp = io.BytesIO(buf[:cut])
+            got = []
+            try:
+                while True:
+                    item = wire.read_event(fp)
+                    if item is None:
+                        break
+                    got.append(item[0])
+            except (wire.WireError, ValueError):
+                pass
+            assert got == recs[:len(got)], (codec, cut)
+
+    def test_mixed_codec_wal_history_replays(self, tmp_path):
+        """An old JSON WAL a binary-default server appended to: one file,
+        two codecs, replayed record-by-record by header sniffing."""
+        d = str(tmp_path / "mixed")
+        recs = _fuzz_records()
+        ds = DurableStore(d, codec=wire.JSON)
+        ds.load()
+        for r in recs[:3]:
+            ds.append(r)
+        ds.close()
+        ds2 = DurableStore(d)  # binary default
+        assert ds2.codec == wire.BINARY
+        _snap, replayed = ds2.load()
+        assert replayed == recs[:3]
+        for r in recs[3:]:
+            ds2.append(r)
+        ds2.close()
+        ds3 = DurableStore(d)
+        _snap, replayed = ds3.load()
+        assert replayed == recs and ds3.torn_records_discarded == 0
+        ds3.close()
+
+
+# ---------------------------------------------------------------------------
+# negotiation + per-surface attribution, end-to-end over HTTP
+# ---------------------------------------------------------------------------
+
+
+def _pod(name, cpu="100m"):
+    return make_pod().name(name).req({"cpu": cpu, "memory": "64Mi"}).obj()
+
+
+def _node(name, cpu=8):
+    return (make_node().name(name)
+            .capacity({"cpu": cpu, "memory": "32Gi", "pods": 110}).obj())
+
+
+class TestNegotiation:
+    def test_binary_negotiated_end_to_end_with_surface_attribution(self):
+        api = APIServer()
+        port = api.serve(0)
+        cs = None
+        try:
+            api.store.create_node(_node("n0"))
+            for i in range(30):
+                api.store.create_pod(_pod(f"p{i}"))
+            cs = HTTPClientset(f"http://127.0.0.1:{port}")
+            _wait(lambda: len(cs.pods) == 30, msg="reflector sync")
+            # decode plane: everything arrived binary, nothing full-JSON
+            assert cs.wire_decode_events[("full", wire.BINARY)] >= 31
+            assert cs.wire_decode_events[("full", wire.JSON)] == 0
+            assert cs.wire_decode_bytes[("full", wire.BINARY)] > 0
+            # live watch events ride binary too
+            api.store.create_pod(_pod("p-live"))
+            _wait(lambda: "p-live" in {p.name for p in cs.pods.values()},
+                  msg="live event")
+            # bulk bindings: the negotiation learned from earlier replies,
+            # so the envelope goes out binary and verdicts come back binary
+            cs._call("GET", "/api/v1/pods?summary=true")  # prime _ka
+            errs = cs.bind_many([(cs.pods[u], "n0")
+                                 for u in list(cs.pods)[:5]])
+            assert errs == [None] * 5
+            # server-side attribution: binary bytes on list/watch/bindings
+            surfaces = {s for (c, s), v in api.wire_bytes.items()
+                        if c == wire.BINARY and v > 0}
+            assert {"list", "watch", "bindings"} <= surfaces, (
+                api.wire_bytes)
+            # binary is strictly smaller than the JSON plane would be:
+            # re-encode one pod event both ways
+            ev = {"type": "ADDED", "object": pod_to_wire(_pod("x")), "rv": 1}
+            assert len(wire.encode(ev, wire.BINARY)) * 2 < len(
+                wire.encode(ev, wire.JSON))
+        finally:
+            if cs is not None:
+                cs.close()
+            api.shutdown()
+
+    def test_binary_client_vs_json_only_server_falls_back(self):
+        api = APIServer()
+        api.json_only = True   # a pre-wire server: ignores every offer
+        port = api.serve(0)
+        cs = None
+        try:
+            api.store.create_node(_node("n0"))
+            for i in range(8):
+                api.store.create_pod(_pod(f"p{i}"))
+            cs = HTTPClientset(f"http://127.0.0.1:{port}")
+            _wait(lambda: len(cs.pods) == 8, msg="reflector sync")
+            assert cs.wire_decode_events[("full", wire.JSON)] >= 9
+            assert cs.wire_decode_events[("full", wire.BINARY)] == 0
+            # writes work and stay JSON (the client never learned binary)
+            cs.bind(cs.pods[list(cs.pods)[0]], "n0")
+            _wait(lambda: len(cs.bindings) == 1, msg="bound event")
+            assert all(v == 0 for (c, _s), v in api.wire_bytes.items()
+                       if c == wire.BINARY), api.wire_bytes
+        finally:
+            if cs is not None:
+                cs.close()
+            api.shutdown()
+
+    def test_json_client_vs_binary_server_falls_back(self, monkeypatch):
+        # a JSON-pinned CLIENT (no Accept offer) against a binary-willing
+        # server: every surface answers JSON
+        monkeypatch.setattr(wire, "client_headers", lambda: {})
+        api = APIServer()
+        port = api.serve(0)
+        cs = None
+        try:
+            api.store.create_node(_node("n0"))
+            for i in range(8):
+                api.store.create_pod(_pod(f"p{i}"))
+            cs = HTTPClientset(f"http://127.0.0.1:{port}")
+            _wait(lambda: len(cs.pods) == 8, msg="reflector sync")
+            assert cs.wire_decode_events[("full", wire.JSON)] >= 9
+            assert cs.wire_decode_events[("full", wire.BINARY)] == 0
+            assert all(v == 0 for (c, _s), v in api.wire_bytes.items()
+                       if c == wire.BINARY), api.wire_bytes
+        finally:
+            if cs is not None:
+                cs.close()
+            api.shutdown()
+
+    def test_paged_list_oracle_identical_across_codecs(self, monkeypatch):
+        api = APIServer()
+        port = api.serve(0)
+        try:
+            for i in range(37):
+                api.store.create_pod(_pod(f"p{i:03d}"))
+            base = f"http://127.0.0.1:{port}"
+            binary = fetch_paged(base, "pods", limit=7)
+            monkeypatch.setattr(wire, "client_headers", lambda: {})
+            as_json = fetch_paged(base, "pods", limit=7)
+            assert binary == as_json and len(binary) == 37
+        finally:
+            api.shutdown()
+
+    def test_bulk_binding_verdicts_on_the_binary_plane(self):
+        api = APIServer()
+        port = api.serve(0)
+        cs = None
+        try:
+            api.store.create_node(_node("n0", cpu=1))
+            api.store.create_pod(_pod("p0", cpu="600m"))
+            api.store.create_pod(_pod("p1", cpu="600m"))
+            cs = HTTPClientset(f"http://127.0.0.1:{port}")
+            _wait(lambda: len(cs.pods) == 2, msg="sync")
+            cs._call("GET", "/api/v1/pods?summary=true")  # learn binary
+            uids = sorted(cs.pods)
+            errs = cs.bind_many([(cs.pods[uids[0]], "n0"),
+                                 (cs.pods[uids[1]], "n0")])
+            # one commits, one loses Omega validation with a 409 verdict
+            # whose reason survives the binary envelope
+            assert errs[0] is None
+            assert errs[1] is not None and errs[1].code == 409
+            assert "OutOfCapacity" in errs[1].read().decode()
+            assert api.wire_bytes[("binary", "bindings")] > 0
+        finally:
+            if cs is not None:
+                cs.close()
+            api.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# mixed-plane replication interop
+# ---------------------------------------------------------------------------
+
+
+class TestReplicationInterop:
+    def test_binary_follower_tails_json_leader_across_promotion(self):
+        """A binary-default follower bootstraps from and tails a JSON-only
+        leader (sniff-decoded frame by frame), converges, and promotes
+        cleanly when the leader dies — codec continuity is not part of the
+        stream contract."""
+        leader = APIServer()
+        leader.json_only = True
+        lport = leader.serve(0)
+        follower = APIServer()
+        tail = ReplicationTail(follower, f"http://127.0.0.1:{lport}",
+                               rank=1, lease_duration=0.5)
+        fport = follower.serve(0)
+        follower.repl_peers.update(
+            {0: f"http://127.0.0.1:{lport}", 1: f"http://127.0.0.1:{fport}"})
+        try:
+            leader.store.create_node(_node("n0"))
+            for i in range(10):
+                leader.store.create_pod(_pod(f"p{i}"))
+            tail.bootstrap()
+            tail.start()
+            _wait(lambda: follower._repl_seq >= leader._repl_seq
+                  and len(follower.store.pods) == 10, msg="convergence")
+            # mid-stream traffic keeps flowing json -> binary store
+            for i in range(10, 16):
+                leader.store.create_pod(_pod(f"p{i}"))
+            _wait(lambda: len(follower.store.pods) == 16, msg="tail")
+            old_epoch = follower.repl_epoch
+            leader.shutdown()
+            _wait(lambda: follower.role == "leader", timeout=20.0,
+                  msg="promotion")
+            assert follower.repl_epoch > old_epoch
+            # the promoted (binary-plane) leader accepts writes
+            follower.store.create_pod(_pod("p-after"))
+            assert len(follower.store.pods) == 17
+        finally:
+            tail.stop()
+            follower.shutdown()
+            leader.shutdown()
+
+    def test_old_json_wal_dir_recovered_by_binary_default_server(
+            self, tmp_path, monkeypatch):
+        """A data dir written entirely on the JSON plane (a pre-wire
+        server) recovers under the binary-default store; new appends go
+        binary into the same file; a third boot replays the mixed
+        history."""
+        d = str(tmp_path / "state")
+        monkeypatch.setenv("TPU_SCHED_WIRE", "json")
+        api = APIServer(data_dir=d)
+        assert api.persistence.codec == wire.JSON
+        api.store.create_node(_node("n0"))
+        for i in range(6):
+            api.store.create_pod(_pod(f"p{i}"))
+        epoch = api.epoch
+        api.shutdown()
+        monkeypatch.delenv("TPU_SCHED_WIRE")
+        api2 = APIServer(data_dir=d)
+        assert api2.persistence.codec == wire.BINARY
+        assert api2.epoch == epoch
+        assert len(api2.store.pods) == 6
+        assert api2.persistence.torn_records_discarded == 0
+        api2.store.create_pod(_pod("p-binary"))
+        api2.shutdown()
+        api3 = APIServer(data_dir=d)
+        assert len(api3.store.pods) == 7
+        assert api3.persistence.torn_records_discarded == 0
+        api3.shutdown()
