@@ -1,0 +1,136 @@
+"""shed-discipline checker: the overload plane's three contracts.
+
+Incident class (PR 14): flow-control shedding (core/flowcontrol.py) only
+protects the plane if three invariants hold everywhere, and each is a
+one-line mistake away from silently rotting:
+
+- ``429-without-retry-after`` — every 429 reply must carry a
+  ``Retry-After`` header (``_json(429, ..., retry_after=...)``). A bare
+  429 turns the polite shed contract into a blind retry storm: clients
+  fall back to their generic exponential schedule, re-synchronize, and
+  hammer the very server that is trying to shed load.
+
+- ``shed-under-write-lock`` — flow-control admission (``_flow_admit`` /
+  ``flowcontrol.admit``) must never run lexically under a held
+  ``_write_lock``. The whole point of admission is to reject overload
+  BEFORE it can contend for the write plane; admitting under the lock
+  would make every shed serialize behind the writes it was supposed to
+  protect.
+
+- ``retry-after-parse-outside-backoff`` — the ``"Retry-After"`` header is
+  *parsed* in exactly one place: :func:`core.backoff.retry_after_of`.
+  Any other module reading it means a client retry loop grew its own 429
+  handling beside the shared backoff stack — a loop that will not get the
+  decorrelated jitter, the cap, or future policy fixes. Producers
+  (core/apiserver.py setting the header, core/flowcontrol.py computing
+  it) are exempt; everyone else routes through core/backoff.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from .base import Checker, Finding, ModuleSource, attr_chain, register
+
+# Rules 1+2 scope: where 429s are produced and admission runs.
+SHED_MODULES: Tuple[str, ...] = (
+    "core/apiserver.py",
+    "core/flowcontrol.py",
+)
+# Rule 3: modules allowed to mention the Retry-After header literally —
+# the one parser seam plus the two producers.
+RETRY_AFTER_SEAMS: Tuple[str, ...] = (
+    "core/backoff.py",
+    "core/flowcontrol.py",
+    "core/apiserver.py",
+)
+
+ADMIT_NAMES = frozenset({"admit", "_flow_admit"})
+
+
+def _is_write_lock_with(node: ast.With) -> bool:
+    for item in node.items:
+        chain = attr_chain(item.context_expr)
+        if chain and chain[-1] == "_write_lock":
+            return True
+    return False
+
+
+@register
+class ShedDisciplineChecker(Checker):
+    id = "shed-discipline"
+    description = ("flow-control shed contracts: 429 replies carry "
+                   "Retry-After, admission never runs under _write_lock, "
+                   "and Retry-After parsing lives only in core/backoff.py "
+                   "(client retry loops route through the shared stack)")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.endswith(".py")
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        out: List[Finding] = []
+        fixture = mod.path.startswith("<")
+        in_shed_scope = mod.path in SHED_MODULES or fixture
+        if in_shed_scope:
+            out.extend(self._check_429_envelope(mod))
+            out.extend(self._check_admit_under_lock(mod))
+        if (mod.path not in RETRY_AFTER_SEAMS
+                and not mod.path.startswith("analysis/")):
+            # analysis/ names the literal to describe the rule itself.
+            out.extend(self._check_retry_after_literal(mod))
+        return out
+
+    def _check_429_envelope(self, mod: ModuleSource) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain or chain[-1] != "_json":
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == 429):
+                continue
+            if any(kw.arg == "retry_after" for kw in node.keywords):
+                continue
+            out.append(Finding(
+                self.id, "429-without-retry-after", mod.path, node.lineno,
+                "429 reply without a Retry-After header — a shed must name "
+                "its horizon (retry_after=...) or clients re-synchronize "
+                "into a retry storm instead of backing off past it"))
+        return out
+
+    def _check_admit_under_lock(self, mod: ModuleSource) -> List[Finding]:
+        out: List[Finding] = []
+        for wnode in ast.walk(mod.tree):
+            if not isinstance(wnode, ast.With) or \
+                    not _is_write_lock_with(wnode):
+                continue
+            for node in ast.walk(wnode):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                if not chain or chain[-1] not in ADMIT_NAMES:
+                    continue
+                if chain[-1] == "admit" and "flowcontrol" not in chain:
+                    continue  # some other object's admit()
+                out.append(Finding(
+                    self.id, "shed-under-write-lock", mod.path, node.lineno,
+                    f"{'.'.join(chain)}(...) under _write_lock — admission "
+                    "must reject overload BEFORE the write plane; a shed "
+                    "that waits on the lock protects nothing"))
+        return out
+
+    def _check_retry_after_literal(self, mod: ModuleSource) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Constant) and node.value == "Retry-After":
+                out.append(Finding(
+                    self.id, "retry-after-parse-outside-backoff", mod.path,
+                    node.lineno,
+                    '"Retry-After" handled outside core/backoff.py — client '
+                    "retry loops on the 429 surface must route through "
+                    "retry_call/retry_after_of so they inherit the "
+                    "decorrelated jitter and the cap"))
+        return out

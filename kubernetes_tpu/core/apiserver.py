@@ -63,6 +63,7 @@ from ..api.types import (
 from . import spans as _spans
 from . import wire
 from .clientset import FakeClientset
+from .flowcontrol import FlowController
 from .watchcache import (
     ShardFilter,
     WatchCache,
@@ -326,11 +327,24 @@ class _WatchStream:
     on the fanout path (broadcast lock); the queue decouples the stream's
     socket from the write plane exactly as before."""
 
-    __slots__ = ("q", "filter")
+    __slots__ = ("q", "filter", "replay_rv", "replay_epoch", "replay_slim")
 
     def __init__(self, flt: Optional[ShardFilter] = None):
         self.q: "queue.Queue" = queue.Queue()
         self.filter = flt
+        # Lazy-cursor attach replay (docs/SCALE.md): a non-resumable attach
+        # no longer materializes the full ADDED replay into this queue —
+        # the stream's consumer thread pages the watch-cache snapshot
+        # itself (list_page) up to `replay_rv`, then emits SYNC and goes
+        # live off the queue. None = resumed (or TOO_OLD'd) attach.
+        # `replay_slim` freezes the slim decision AT ATTACH, in lockstep
+        # with the filter prime that records the slimmed set: if
+        # selector_refs drops to 0 only mid-replay, the replay must keep
+        # serving fulls — slimming then would leave pods the later
+        # selector-transition upgrade burst can't find in `_slimmed`.
+        self.replay_rv: Optional[int] = None
+        self.replay_epoch: Optional[str] = None
+        self.replay_slim: bool = False
 
 
 class _ShipStream:
@@ -445,7 +459,15 @@ class APIServer:
         self.list_continue_410 = 0
         self.list_unpaged = 0
         self.snapshot_bootstrap_pages = 0
+        self.watch_replay_pages = 0  # lazy-cursor attach replay pages served
         self.node_heartbeats = 0   # kubelet/hollow heartbeat sink hits
+        # Overload protection (core/flowcontrol.py, docs/RESILIENCE.md
+        # § overload & fairness): every mutating request is classified into
+        # a flow and admitted through per-priority-level bounded-concurrency
+        # fair queues BEFORE it can touch `_write_lock`; a full queue sheds
+        # with 429 + Retry-After. Replication/lease control traffic rides
+        # the exempt lane — a tenant flood can never starve failover.
+        self.flowcontrol = FlowController()
         # Recent shipped frames by global seq: the replication window a
         # follower can resume from without a snapshot bootstrap.
         self._repl_backlog = deque(maxlen=backlog)
@@ -1225,12 +1247,34 @@ class APIServer:
                 ("apiserver_list_pages_total", self.list_pages),
                 ("apiserver_list_continue_410_total", self.list_continue_410),
                 ("apiserver_list_unpaged_total", self.list_unpaged),
+                ("apiserver_watch_replay_pages_total",
+                 self.watch_replay_pages),
                 ("apiserver_snapshot_bootstrap_pages_total",
                  self.snapshot_bootstrap_pages),
                 ("apiserver_node_heartbeats_total",
                  self.node_heartbeats)):
             out.append(f"# TYPE {name} counter")
             out.append(f"{name} {v}")
+        # Flow-control plane (core/flowcontrol.py): per-priority-level
+        # admission counters + live seat/queue gauges — the series the
+        # flood chaos scenario reads to prove the exempt lane bypassed
+        # tenant queues while the flood was shed.
+        fc = self.flowcontrol.snapshot()
+        for metric, key in (("rejected", "rejected"),
+                            ("dispatched", "dispatched"),
+                            ("queued", "queued")):
+            name = f"apiserver_flowcontrol_{metric}_total"
+            out.append(f"# TYPE {name} counter")
+            for level in sorted(fc):
+                out.append('%s{priority_level="%s"} %d'
+                           % (name, level, fc[level][key]))
+        for name, key in (("apiserver_flowcontrol_current_seats", "seats"),
+                          ("apiserver_flowcontrol_queue_depth",
+                           "queue_depth")):
+            out.append(f"# TYPE {name} gauge")
+            for level in sorted(fc):
+                out.append('%s{priority_level="%s"} %d'
+                           % (name, level, fc[level][key]))
         out.append("# TYPE apiserver_failover_total counter")
         for reason, v in sorted(self.failovers.items()):
             out.append('apiserver_failover_total{reason="%s"} %d'
@@ -1439,11 +1483,30 @@ class APIServer:
                                         "epoch": self.epoch}))
                 st.q.put(None)
             else:
-                for o in wc.list_wire():
-                    event = {"type": "ADDED", "object": o}
-                    self._route_to(st, event, wire.WireItem(event), wc)
-                st.q.put(wire.WireItem({"type": "SYNC", "rv": seq,
-                                        "epoch": self.epoch}))
+                # Lazy-cursor replay (the legacy path materialized a full
+                # ADDED event per object INTO this queue, under the
+                # broadcast lock — at 50k nodes that is the whole cluster
+                # encoded per attaching client). Now the attach only
+                # records the snapshot rv; the stream's consumer thread
+                # pages the watch-cache snapshot itself (list_page, the
+                # cache's own lock) and emits SYNC at this rv. Live events
+                # queue from here on as usual — an object mutated while
+                # paging upserts twice (pages serve current copy-on-write
+                # state), which the client's replayed-ADDED upsert path
+                # already absorbs.
+                st.replay_rv = seq
+                st.replay_epoch = self.epoch
+                if flt is not None and wc.selector_refs == 0:
+                    # Seed the filter's slim map for the objects the page
+                    # replay will slim (pre-attach pods); pods created
+                    # DURING the replay are recorded by their own queued
+                    # live events routing through the filter. The replay
+                    # slims IFF this prime ran (st.replay_slim): decision
+                    # and bookkeeping are frozen together, so a
+                    # selector_refs flip mid-replay can't produce slims
+                    # the upgrade burst has no record of.
+                    flt.prime(wc)
+                    st.replay_slim = True
                 self.relisted_watches += 1
             self._watchers[kind].append(st)
         return st
@@ -1504,16 +1567,67 @@ class APIServer:
                 return wire.accept_codec(self.headers.get("Accept"))
 
             def _json(self, code: int, obj,
-                      surface: Optional[str] = None) -> None:
+                      surface: Optional[str] = None,
+                      retry_after: Optional[int] = None) -> None:
                 codec = self._accept() if code < 400 else wire.JSON
                 data = wire.encode(obj, codec)
                 if surface is not None:
                     server._count_wire(codec, surface, len(data))
                 self.send_response(code)
                 self.send_header("Content-Type", wire.mime_for(codec))
+                if retry_after is not None:
+                    # The shed contract (core/flowcontrol.py): a 429 always
+                    # carries Retry-After — the client half honors it with
+                    # decorrelated jitter (core/backoff.py), so shed work
+                    # returns after the backlog horizon, never as a
+                    # synchronized retry storm.
+                    self.send_header("Retry-After", str(int(retry_after)))
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _flow_namespace(self) -> str:
+                """The tenant namespace this mutating request bills to
+                (workload flow key). Binding/delete paths carry only a uid;
+                the pod's namespace resolves through the store dict (a
+                GIL-atomic get — no lock, a racing delete just falls back
+                to the default flow)."""
+                path, body = self.path, self._body_cache
+                if path == "/api/v1/pods":
+                    if isinstance(body, list):
+                        return (body[0].get("namespace", "")
+                                if body else "")
+                    if isinstance(body, dict):
+                        return body.get("namespace", "")
+                    return ""
+                uid = ""
+                if path == "/api/v1/bindings":
+                    if isinstance(body, list) and body:
+                        uid = body[0].get("uid", "")
+                elif path.startswith("/api/v1/pods/"):
+                    parts = path.split("/")
+                    uid = parts[4] if len(parts) > 4 else ""
+                if uid:
+                    pod = server.store.pods.get(uid)
+                    if pod is not None:
+                        return pod.namespace
+                return ""
+
+            def _flow_admit(self, method: str):
+                """Admission through the priority-and-fairness plane
+                (core/flowcontrol.py) — BEFORE `_write_lock`, always. A
+                shed request is answered 429 + Retry-After right here
+                (returns None); the caller must release the ticket in a
+                finally once the write plane is done with it."""
+                fc = server.flowcontrol
+                level, flow = fc.classify(method, self.path,
+                                          self._flow_namespace())
+                ticket = fc.admit(level, flow)
+                if ticket is None:
+                    ra = fc.retry_after(level)
+                    self._json(429, {"error": "TooManyRequests",
+                                     "retryAfter": ra}, retry_after=ra)
+                return ticket
 
             def do_GET(self):
                 path, _, query = self.path.partition("?")
@@ -1786,6 +1900,47 @@ class APIServer:
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     self.close_connection = True
 
+            def _replay_lazy(self, kind: str, st, codec: str) -> None:
+                """The attach-time replay as a lazy cursor into the watch
+                cache's snapshot: bounded pages in sorted-key order
+                (list_page — the cache's own lock, never the broadcast or
+                write lock), encoded and sent on this stream's consumer
+                thread. Shard filters slim statelessly here, exactly as
+                the paged LIST plane does; live events committed while
+                paging are already queued and upsert over the replay."""
+                wc = server.watch_cache[kind]
+                flt = st.filter
+                last = ""
+                sent = 0
+                while server._httpd is not None:
+                    page = wc.list_page(500, last_key=last)
+                    if page is None:  # unanchored pages never expire
+                        break
+                    objs, next_key, _anchor, _rv = page
+                    server.watch_replay_pages += 1
+                    buf = bytearray()
+                    for obj in objs:
+                        if (st.replay_slim and kind == "pods"
+                                and wire_plain(obj)
+                                and shard_of_wire(obj, flt.count)
+                                != flt.index):
+                            obj = slim_object(obj)
+                            server.watch_slim_events += 1
+                        data = wire.encode(
+                            {"type": "ADDED", "object": obj}, codec)
+                        sent += len(data)
+                        buf += f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                        if len(buf) >= 65536:
+                            self.wfile.write(bytes(buf))
+                            buf.clear()
+                    if buf:
+                        self.wfile.write(bytes(buf))
+                    self.wfile.flush()
+                    if not next_key:
+                        break
+                    last = next_key
+                server._count_wire(codec, "watch", sent)
+
             def _stream(self, kind: str, since: Optional[int] = None,
                         epoch: Optional[str] = None,
                         flt: Optional[ShardFilter] = None,
@@ -1805,6 +1960,19 @@ class APIServer:
                                           paged=paged, fresh=fresh)
                 idle = 0.0
                 try:
+                    if st.replay_rv is not None:
+                        # Lazy-cursor attach replay: page the snapshot on
+                        # THIS consumer thread (watch-cache lock only, one
+                        # bounded page at a time — the full cluster never
+                        # materializes in the stream queue or under the
+                        # broadcast lock), then SYNC at the attach rv.
+                        self._replay_lazy(kind, st, codec)
+                        data = wire.encode(
+                            {"type": "SYNC", "rv": st.replay_rv,
+                             "epoch": st.replay_epoch}, codec)
+                        server._count_wire(codec, "watch", len(data))
+                        self._write_chunk(data)
+                        self.wfile.flush()
                     while server._httpd is not None:
                         try:
                             data = st.q.get(timeout=0.5)
@@ -1908,7 +2076,9 @@ class APIServer:
                     # Replication-internal wiring (accepted in ANY role):
                     # the harness injects the rank -> base URL map after
                     # every replica's ephemeral port is known. Not WAL'd —
-                    # topology, not state.
+                    # topology, not state. Exempt lane by construction:
+                    # answered before admission ever runs.
+                    server.flowcontrol.count_exempt()
                     server.repl_peers = {
                         int(k): v for k, v in
                         (self._body().get("peers") or {}).items()}
@@ -1924,6 +2094,7 @@ class APIServer:
                     # epoch — the rank tie-break (lower announcer rank
                     # wins) stands one of them down; its forked tail
                     # resolves via snapshot resync on re-attach.
+                    server.flowcontrol.count_exempt()
                     body = self._body()
                     ep = int(body.get("epoch", 0))
                     rank = int(body.get("rank", 1 << 30))
@@ -1939,23 +2110,34 @@ class APIServer:
                 if server.role != "leader":
                     return self._json(421, {"error": "NotLeader",
                                             "leader": server.leader_url})
-                with server._write_lock:
-                    if server.role != "leader":
-                        # Re-checked UNDER the lock: a demote() racing the
-                        # unlocked fast-path check above must not let this
-                        # write commit on a freshly deposed replica (it
-                        # would be stamped with the WINNER's epoch —
-                        # unfenceable divergence).
-                        code, obj, seq = 421, {
-                            "error": "NotLeader",
-                            "leader": server.leader_url}, 0
-                    else:
-                        code, obj = self._post_locked()
-                        seq = server._repl_seq
-                # Reply gating, OUTSIDE every lock: an acked write is on
-                # the wire to each in-quorum follower before the client
-                # hears 200 — a leader kill -9 cannot silently lose it.
-                server._await_shipped(seq)
+                # Flow-control admission strictly BEFORE the write lock: a
+                # shed request (429 + Retry-After, sent inside _flow_admit)
+                # must never have contended for — let alone held — the
+                # write plane's lock.
+                ticket = self._flow_admit("POST")
+                if ticket is None:
+                    return
+                try:
+                    with server._write_lock:
+                        if server.role != "leader":
+                            # Re-checked UNDER the lock: a demote() racing
+                            # the unlocked fast-path check above must not
+                            # let this write commit on a freshly deposed
+                            # replica (it would be stamped with the
+                            # WINNER's epoch — unfenceable divergence).
+                            code, obj, seq = 421, {
+                                "error": "NotLeader",
+                                "leader": server.leader_url}, 0
+                        else:
+                            code, obj = self._post_locked()
+                            seq = server._repl_seq
+                    # Reply gating, OUTSIDE every lock: an acked write is
+                    # on the wire to each in-quorum follower before the
+                    # client hears 200 — a leader kill -9 cannot silently
+                    # lose it.
+                    server._await_shipped(seq)
+                finally:
+                    server.flowcontrol.release(ticket)
                 if self.path == "/api/v1/bindings":
                     # Bulk-binding wire accounting: the request envelope
                     # (in its sniffed codec) and the per-item verdict
@@ -2072,7 +2254,11 @@ class APIServer:
                     # upsert_lease serializes under the write lock itself
                     # (it is also an in-process API); don't wrap it twice.
                     # Its own under-the-lock role check covers the
-                    # demote() race (NOT_LEADER sentinel -> 421).
+                    # demote() race (NOT_LEADER sentinel -> 421). Lease CAS
+                    # is the EXEMPT flow-control lane: shard/leader lease
+                    # renewals are what failover detection runs on, and a
+                    # tenant flood must never queue them behind itself.
+                    server.flowcontrol.count_exempt()
                     body = self._body()
                     got = server.upsert_lease(
                         self.path.split("/")[4],
@@ -2085,15 +2271,21 @@ class APIServer:
                         return self._json(409, {"error": "LeaseHeld"})
                     server._await_shipped(server._repl_seq)
                     return self._json(200, got)
-                with server._write_lock:
-                    if server.role != "leader":
-                        code, obj, seq = 421, {
-                            "error": "NotLeader",
-                            "leader": server.leader_url}, 0
-                    else:
-                        code, obj = self._put_locked()
-                        seq = server._repl_seq
-                server._await_shipped(seq)
+                ticket = self._flow_admit("PUT")
+                if ticket is None:
+                    return
+                try:
+                    with server._write_lock:
+                        if server.role != "leader":
+                            code, obj, seq = 421, {
+                                "error": "NotLeader",
+                                "leader": server.leader_url}, 0
+                        else:
+                            code, obj = self._put_locked()
+                            seq = server._repl_seq
+                    server._await_shipped(seq)
+                finally:
+                    server.flowcontrol.release(ticket)
                 self._json(code, obj)
 
             def _put_locked(self):
@@ -2113,18 +2305,25 @@ class APIServer:
                 return 404, {"error": "not found"}
 
             def do_DELETE(self):
+                self._body_cache = {}
                 if server.role != "leader":
                     return self._json(421, {"error": "NotLeader",
                                             "leader": server.leader_url})
-                with server._write_lock:
-                    if server.role != "leader":
-                        code, obj, seq = 421, {
-                            "error": "NotLeader",
-                            "leader": server.leader_url}, 0
-                    else:
-                        code, obj = self._delete_locked()
-                        seq = server._repl_seq
-                server._await_shipped(seq)
+                ticket = self._flow_admit("DELETE")
+                if ticket is None:
+                    return
+                try:
+                    with server._write_lock:
+                        if server.role != "leader":
+                            code, obj, seq = 421, {
+                                "error": "NotLeader",
+                                "leader": server.leader_url}, 0
+                        else:
+                            code, obj = self._delete_locked()
+                            seq = server._repl_seq
+                    server._await_shipped(seq)
+                finally:
+                    server.flowcontrol.release(ticket)
                 self._json(code, obj)
 
             def _delete_locked(self):

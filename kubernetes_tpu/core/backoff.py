@@ -50,10 +50,13 @@ def is_retriable(exc: BaseException) -> bool:
         # ConnectionResetError/BrokenPipeError/ConnectionRefusedError and
         # socket.timeout are subclasses.
         return True
-    # urllib.error.HTTPError: retry server-side (5xx) failures only.
+    # urllib.error.HTTPError: retry server-side (5xx) failures — and 429
+    # TooManyRequests, the flow-control shed (core/flowcontrol.py): the
+    # request was REJECTED before any state changed, so a replay is safe
+    # by construction, and retry_call honors its Retry-After.
     code = getattr(exc, "code", None)
     if isinstance(code, int):
-        return code >= 500
+        return code == 429 or code >= 500
     # urllib.error.URLError wraps the transport failure in .reason.
     reason = getattr(exc, "reason", None)
     if isinstance(reason, BaseException) and reason is not exc:
@@ -65,6 +68,31 @@ def is_retriable(exc: BaseException) -> bool:
     if isinstance(exc, OSError):
         return exc.errno in _TRANSIENT_ERRNOS
     return False
+
+
+def retry_after_of(exc: BaseException) -> Optional[float]:
+    """The server's Retry-After hint off a 429 (or 503) reply, in seconds;
+    None when the reply carries no parseable hint. This is the ONE place
+    the client stack parses the header — every retry loop on the shed
+    surface routes through retry_call, which calls this (the
+    ``shed-discipline`` analyzer rule pins the seam)."""
+    if getattr(exc, "code", None) not in (429, 503):
+        return None
+    headers = getattr(exc, "headers", None)
+    if headers is None:
+        headers = getattr(exc, "hdrs", None)
+    if headers is None:
+        return None
+    try:
+        value = headers.get("Retry-After")
+    except AttributeError:
+        return None
+    if value is None:
+        return None
+    try:
+        return max(0.0, float(value))
+    except (TypeError, ValueError):
+        return None
 
 
 @dataclass
@@ -80,6 +108,9 @@ class RetryConfig:
     max_attempts: int = 4
     seed: Optional[int] = 0
     retriable: Callable[[BaseException], bool] = field(default=is_retriable)
+    # Ceiling for Retry-After-driven delays (a shed server names its own
+    # horizon; a buggy or hostile header must not park a client forever).
+    retry_after_cap: float = 30.0
 
     def delays(self) -> Iterator[float]:
         """The (max_attempts - 1) sleep durations between tries."""
@@ -97,10 +128,24 @@ def retry_call(fn: Callable, config: Optional[RetryConfig] = None,
     """Run `fn()`; on a retriable failure, back off and replay, up to
     config.max_attempts total tries. Non-retriable exceptions (and the
     final retriable one) propagate. `on_retry(attempt_no, exc)` fires
-    before each sleep — callers hang metrics/logging off it."""
+    before each sleep — callers hang metrics/logging off it.
+
+    A reply carrying ``Retry-After`` (the 429 flow-control shed,
+    core/flowcontrol.py) overrides the exponential schedule with
+    **decorrelated jitter anchored at the server's hint**: sleep uniformly
+    in [hint, max(1.5*hint, 3*previous_sleep)], capped at
+    ``retry_after_cap``. The hint is a floor (coming back sooner just gets
+    shed again); the spread keeps a herd of shed clients from
+    re-synchronizing into the next wave, and the 3x-previous growth backs a
+    persistently-shed client off harder each round."""
     cfg = config or RetryConfig()
     attempt = 0
     delays = cfg.delays()
+    # Decorrelated-jitter state, seeded independently of the exponential
+    # schedule's RNG so adding a 429 mid-sequence never perturbs the
+    # deterministic delay replay chaos tests assert on.
+    rng = random.Random(None if cfg.seed is None else cfg.seed ^ 0x5EED)
+    prev_ra_sleep = 0.0
     while True:
         try:
             return fn()
@@ -112,6 +157,12 @@ def retry_call(fn: Callable, config: Optional[RetryConfig] = None,
                 delay = next(delays)
             except StopIteration:
                 raise e from None
+            ra = retry_after_of(e)
+            if ra is not None:
+                hi = max(ra * 1.5, prev_ra_sleep * 3.0)
+                delay = min(cfg.retry_after_cap,
+                            rng.uniform(ra, max(hi, ra + 1e-9)))
+                prev_ra_sleep = delay
             if on_retry is not None:
                 on_retry(attempt, e)
             sleep(delay)

@@ -65,6 +65,11 @@ class SchedulerConfiguration:
     # Async API writes run on a worker thread when set (the reference's
     # dispatcher goroutine); inline otherwise for determinism.
     async_dispatch_threads: bool = False
+    # Per-tenant weighted fair dequeue on the pending queue (core/queue.py
+    # _FairTenantHeap; docs/RESILIENCE.md § overload & fairness). Off by
+    # default — single-tenant workloads keep the global queue-sort order.
+    fair_tenant_dequeue: bool = False
+    tenant_weights: Dict[str, float] = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, d: Mapping) -> "SchedulerConfiguration":
@@ -92,6 +97,8 @@ class SchedulerConfiguration:
             max_batch=d.get("maxBatch", 1024),
             extenders=list(d.get("extenders", ())),
             async_dispatch_threads=bool(d.get("asyncDispatchThreads", False)),
+            fair_tenant_dequeue=bool(d.get("fairTenantDequeue", False)),
+            tenant_weights=dict(d.get("tenantWeights", {})),
         )
 
     def gates(self) -> FeatureGates:

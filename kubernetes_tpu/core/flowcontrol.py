@@ -1,0 +1,320 @@
+"""API priority & fairness: overload-protected admission for the apiserver.
+
+Re-expresses the reference's request-admission layer
+(``staging/src/k8s.io/apiserver/pkg/server/filters/priority-and-fairness.go``
+over ``util/flowcontrol``): every mutating request is classified into a
+**flow** inside a **priority level**, and each level admits through
+bounded-concurrency **shuffle-sharded fair queues** — so one adversarial
+tenant hammering creates/binds degrades *its own* lane, never the whole
+write plane, and never the control traffic failover depends on.
+
+The three levels the apiserver ships with (:func:`default_levels`):
+
+- ``exempt`` — replication ship/ack, lease CAS, leader announcements,
+  peer-topology injection: the traffic *promotion itself* depends on.
+  Never queued, never shed — a tenant flood must not be able to convoy a
+  lease renewal behind its own backlog (the failover-starvation incident
+  class this module exists for).
+- ``system`` — node lifecycle writes (registration, heartbeats, drift,
+  churn): the kubelet/hollow plane. One shared flow, bounded seats.
+- ``workload`` — pod creates/binds/deletes, flow-keyed **by namespace**.
+  This is where tenants meet: shuffle-sharded queue assignment keeps a
+  flood tenant's backlog in *its* hand of queues, weighted round-robin
+  dequeue serves the remaining flows proportionally, and a full queue
+  sheds with **429 + Retry-After** — loudly, never a silent drop, and
+  never while holding the server's ``_write_lock`` (the shed path runs
+  entirely before admission; the ``shed-discipline`` analyzer rule pins
+  this).
+
+Locking: one controller-private lock. ``admit`` blocks (outside that
+lock) on a per-request event until a seat frees or ``max_wait`` elapses —
+timeout is a shed too, with the same 429 contract. ``release`` hands the
+freed seat to the next flow picked by smooth weighted round-robin across
+the level's non-empty queues.
+
+Client half: :mod:`kubernetes_tpu.core.backoff` recognizes 429 as
+retriable and honors ``Retry-After`` with decorrelated jitter, so shed
+clients back off past the server's horizon instead of re-synchronizing
+into a retry storm (docs/RESILIENCE.md § overload & fairness).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+EXEMPT = "exempt"
+SYSTEM = "system"
+WORKLOAD = "workload"
+
+
+def _flow_hash(level: str, flow: str) -> int:
+    """Stable 64-bit flow hash (level-scoped, process-independent): the
+    shuffle-shard dealer draws from it, so a flow lands in the same hand
+    on every replica."""
+    digest = hashlib.blake2b(f"{level}/{flow}".encode(),
+                             digest_size=8).digest()
+    return int.from_bytes(digest, "big")
+
+
+def shuffle_shard_hand(level: str, flow: str, queues: int,
+                       hand_size: int) -> List[int]:
+    """Deal ``hand_size`` DISTINCT queue indices for ``flow`` (the
+    reference's shuffle-sharding dealer, shufflesharding/dealer.go): draw
+    successive modulo digits off the flow hash, each selecting from the
+    queues not yet dealt. Two flows share a whole hand only with
+    probability ~(hand/queues)^hand — the isolation bound the unit suite
+    asserts."""
+    hand_size = max(1, min(hand_size, queues))
+    h = _flow_hash(level, flow)
+    remaining = list(range(queues))
+    hand: List[int] = []
+    for i in range(hand_size):
+        d = h % (queues - i)
+        h //= (queues - i)
+        hand.append(remaining.pop(d))
+    return hand
+
+
+class _Waiter:
+    """One queued request: the event its handler thread parks on, plus the
+    flow key the WRR dequeue weighs it by."""
+
+    __slots__ = ("event", "flow", "seated", "cancelled")
+
+    def __init__(self, flow: str):
+        self.event = threading.Event()
+        self.flow = flow
+        self.seated = False
+        self.cancelled = False
+
+
+class Ticket:
+    """Proof of admission; hand back via :meth:`FlowController.release`.
+    Exempt tickets hold no seat (release is a no-op for them)."""
+
+    __slots__ = ("level", "seated")
+
+    def __init__(self, level: "PriorityLevel", seated: bool):
+        self.level = level
+        self.seated = seated
+
+
+class PriorityLevel:
+    """One bounded-concurrency lane: ``seats`` concurrent dispatches,
+    ``queues`` fair queues of ``queue_length`` each, shuffle-shard hand
+    size ``hand_size``. ``queues=0`` marks the exempt lane (no seats, no
+    queues, no shedding — ever)."""
+
+    def __init__(self, name: str, seats: int = 8, queues: int = 8,
+                 queue_length: int = 16, hand_size: int = 2,
+                 max_wait: float = 1.0,
+                 flow_weights: Optional[Dict[str, float]] = None):
+        self.name = name
+        self.seats = max(1, seats)
+        self.queue_length = max(1, queue_length)
+        self.hand_size = hand_size
+        self.max_wait = max_wait
+        self.flow_weights = dict(flow_weights or {})
+        self.exempt = queues <= 0
+        self._queues: List[deque] = [deque() for _ in range(max(0, queues))]
+        # Smooth-WRR credit per queue: each dequeue round adds the head
+        # flow's weight to every non-empty queue, serves the max-credit
+        # queue, and charges it the round's total — long-run service is
+        # proportional to weight (the property the unit suite measures).
+        self._credit: List[float] = [0.0] * max(0, queues)
+        self.seats_in_use = 0
+        # Counters (apiserver_flowcontrol_*_total{priority_level}).
+        self.dispatched = 0   # requests that got a seat (or exempt pass)
+        self.queued = 0       # requests that waited in a queue first
+        self.rejected = 0     # requests shed (queue full / wait timeout)
+
+    def weight_of(self, flow: str) -> float:
+        return max(1e-6, float(self.flow_weights.get(flow, 1.0)))
+
+    def queue_depth(self) -> int:
+        return sum(len(q) for q in self._queues)
+
+    # -- internals (caller holds the controller lock) -----------------------
+
+    def _enqueue(self, flow: str) -> Optional[_Waiter]:
+        """Queue one request into the shortest queue of its shuffle-shard
+        hand; None when every queue in the hand is full (shed)."""
+        hand = shuffle_shard_hand(self.name, flow, len(self._queues),
+                                  self.hand_size)
+        qidx = min(hand, key=lambda i: (len(self._queues[i]), i))
+        if len(self._queues[qidx]) >= self.queue_length:
+            return None
+        w = _Waiter(flow)
+        self._queues[qidx].append(w)
+        return w
+
+    def _dispatch_next(self) -> None:
+        """Hand freed seats to queued work: smooth weighted round-robin
+        across non-empty queues, weighed by each queue's HEAD flow."""
+        while self.seats_in_use < self.seats:
+            nonempty = [i for i, q in enumerate(self._queues) if q]
+            if not nonempty:
+                return
+            total = 0.0
+            for i in nonempty:
+                w = self.weight_of(self._queues[i][0].flow)
+                self._credit[i] += w
+                total += w
+            best = max(nonempty, key=lambda i: (self._credit[i], -i))
+            self._credit[best] -= total
+            waiter = self._queues[best].popleft()
+            if waiter.cancelled:
+                continue  # timed out while queued; its thread already shed
+            waiter.seated = True
+            self.seats_in_use += 1
+            self.dispatched += 1
+            waiter.event.set()
+
+
+class FlowController:
+    """The admission gate the apiserver's mutating verbs pass through.
+
+    Thread-safe behind its OWN lock — by contract (and the
+    ``shed-discipline`` analyzer rule) it is never entered while the
+    server's ``_write_lock`` is held: classification, queuing, and the
+    shed decision all happen strictly before the write plane."""
+
+    def __init__(self, levels: Optional[Dict[str, PriorityLevel]] = None):
+        self.levels: Dict[str, PriorityLevel] = levels or default_levels()
+        self._lock = threading.Lock()
+
+    # -- classification -----------------------------------------------------
+
+    def classify(self, method: str, path: str,
+                 namespace: str = "") -> Tuple[str, str]:
+        """(priority level, flow key) for one mutating request.
+
+        Exempt: the control traffic failover depends on — replication
+        ship/ack + peer/leader announcements (``/replication/*``) and
+        lease CAS (``/api/v1/leases/*``, shard + leader leases). System:
+        node lifecycle (registration/heartbeats/drift/churn — the
+        kubelet/hollow plane, one shared flow). Workload: everything
+        pod-shaped, flow-keyed by tenant namespace."""
+        if path.startswith("/replication/") or \
+                path.startswith("/api/v1/leases"):
+            return EXEMPT, "control"
+        if path.startswith("/api/v1/nodes"):
+            return SYSTEM, "nodes"
+        return WORKLOAD, namespace or "default"
+
+    # -- admission ----------------------------------------------------------
+
+    def admit(self, level_name: str, flow: str) -> Optional[Ticket]:
+        """Admit one request into ``level_name`` under flow ``flow``.
+
+        Returns a :class:`Ticket` (release it in a finally), or None when
+        the request is SHED — the caller answers 429 with a Retry-After
+        header and must not have touched the write lock. Blocks (outside
+        the controller lock) up to the level's ``max_wait`` while queued."""
+        lvl = self.levels[level_name]
+        with self._lock:
+            if lvl.exempt:
+                lvl.dispatched += 1
+                return Ticket(lvl, seated=False)
+            if lvl.seats_in_use < lvl.seats and lvl.queue_depth() == 0:
+                # Fast path: free seat, nothing ahead of us.
+                lvl.seats_in_use += 1
+                lvl.dispatched += 1
+                return Ticket(lvl, seated=True)
+            waiter = lvl._enqueue(flow)
+            if waiter is None:
+                lvl.rejected += 1
+                return None
+            lvl.queued += 1
+        if waiter.event.wait(lvl.max_wait):
+            return Ticket(lvl, seated=True)
+        with self._lock:
+            if waiter.seated:
+                # Seated between the timeout and this lock: keep the seat.
+                return Ticket(lvl, seated=True)
+            waiter.cancelled = True  # lazily skipped by _dispatch_next
+            lvl.rejected += 1
+            return None
+
+    def release(self, ticket: Optional[Ticket]) -> None:
+        """Free the admitted request's seat and dispatch queued work."""
+        if ticket is None or not ticket.seated:
+            return
+        with self._lock:
+            ticket.level.seats_in_use -= 1
+            ticket.level._dispatch_next()
+
+    def count_exempt(self) -> None:
+        """Account one exempt-lane dispatch that bypassed admit() entirely
+        (the replication endpoints answer before classification)."""
+        with self._lock:
+            self.levels[EXEMPT].dispatched += 1
+
+    def retry_after(self, level_name: str) -> int:
+        """The Retry-After seconds a shed reply carries: at least the
+        level's queue-wait horizon, scaled up when the backlog is deep —
+        a shed client must come back AFTER the current wave drains, and
+        the client's decorrelated jitter (core/backoff.py) keeps the
+        returning herd spread out."""
+        lvl = self.levels[level_name]
+        with self._lock:
+            depth = lvl.queue_depth()
+        capacity = max(1, len(lvl._queues) * lvl.queue_length)
+        import math
+        return max(1, int(math.ceil(lvl.max_wait * (1.0 + depth / capacity))))
+
+    # -- observability ------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Per-level counters + gauges for /metrics exposition."""
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for name, lvl in self.levels.items():
+                out[name] = {
+                    "dispatched": lvl.dispatched,
+                    "queued": lvl.queued,
+                    "rejected": lvl.rejected,
+                    "seats": lvl.seats_in_use,
+                    "queue_depth": lvl.queue_depth(),
+                }
+        return out
+
+
+def _level_from_env(name: str, default: PriorityLevel) -> PriorityLevel:
+    """Optional sizing override: ``TPU_SCHED_APF_<LEVEL>`` =
+    "seats,queues,queue_length,hand_size,max_wait". The chaos harness
+    tightens lanes through this seam (OS-process apiservers take no
+    constructor args); malformed specs keep the default. The exempt lane
+    deliberately has NO override — nothing may make it sheddable."""
+    import os
+    spec = os.environ.get(f"TPU_SCHED_APF_{name.upper()}", "")
+    if not spec:
+        return default
+    try:
+        seats, queues, qlen, hand, max_wait = spec.split(",")
+        return PriorityLevel(name, seats=int(seats), queues=int(queues),
+                             queue_length=int(qlen), hand_size=int(hand),
+                             max_wait=float(max_wait))
+    except (ValueError, TypeError):
+        return default
+
+
+def default_levels() -> Dict[str, PriorityLevel]:
+    """The apiserver's stock lanes. Workload sizing rationale: the write
+    plane is one lock, so a handful of seats saturates it; 8 queues x 16
+    with a 2-wide hand bounds any single flow to 2 queues' worth of
+    backlog (32 requests) while leaving 6+ queues for everyone else —
+    a flood saturates its own hand and sheds, well-behaved tenants keep
+    landing in mostly-empty queues."""
+    return {
+        EXEMPT: PriorityLevel(EXEMPT, queues=0),
+        SYSTEM: _level_from_env(SYSTEM, PriorityLevel(
+            SYSTEM, seats=4, queues=4, queue_length=64,
+            hand_size=1, max_wait=2.0)),
+        WORKLOAD: _level_from_env(WORKLOAD, PriorityLevel(
+            WORKLOAD, seats=8, queues=8, queue_length=16,
+            hand_size=2, max_wait=1.0)),
+    }

@@ -52,10 +52,19 @@ class Status:
     # shared state. Not an error and not unschedulable — the scheduler
     # requeues through the backoffQ and re-plans against the watch feed.
     conflict: bool = False
+    # Flow-control shed (HTTP 429 from the apiserver's admission plane,
+    # core/flowcontrol.py): the write never ran — like a conflict, the pod
+    # only needs to wait out a backoff (the server's Retry-After horizon),
+    # never the unschedulable pool, and never the error log.
+    shed: bool = False
 
     @classmethod
     def bind_conflict(cls, *reasons: str, plugin: str = "") -> "Status":
         return cls(ERROR, tuple(reasons), plugin, conflict=True)
+
+    @classmethod
+    def bind_shed(cls, *reasons: str, plugin: str = "") -> "Status":
+        return cls(ERROR, tuple(reasons), plugin, shed=True)
 
     @classmethod
     def unschedulable(cls, *reasons: str, plugin: str = "") -> "Status":

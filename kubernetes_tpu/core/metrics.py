@@ -262,6 +262,16 @@ class SchedulerMetrics:
             "scheduler_queue_incoming_entities_total",
             "Group/composite entities added to queues by event.",
             ("queue", "event")))
+        # Overload/fairness plane (docs/RESILIENCE.md § overload &
+        # fairness): per-tenant starvation truth — how long each
+        # namespace's longest-waiting runnable entity has sat in the
+        # active/backoff queues. Callback gauge fed from
+        # PriorityQueue.starvation_by_namespace at scrape time.
+        self.queue_starvation = r(Gauge(
+            "scheduler_queue_starvation_seconds",
+            "Per-namespace longest wait (seconds) of a runnable queued "
+            "entity since queue admission — the starvation signal the "
+            "fair-dequeue plane bounds.", ("namespace",)))
         self.permit_wait_duration = r(Histogram(
             "scheduler_permit_wait_duration_seconds",
             "Time pods spend waiting on Permit.", ("result",)))

@@ -307,6 +307,108 @@ class _Heap:
         return [e[2] for e in self._by_uid.values()]
 
 
+class _FairTenantHeap:
+    """activeQ with per-tenant weighted fair dequeue (the scheduler-side
+    half of the overload plane, docs/RESILIENCE.md § overload & fairness;
+    the queue-admission analogue of the apiserver's priority-and-fairness
+    dequeue in core/flowcontrol.py).
+
+    One :class:`_Heap` per namespace preserves the queue-sort order WITHIN
+    a tenant; `pop` picks the tenant by smooth weighted round-robin, so a
+    namespace flooding the queue gets its weight's share of scheduling
+    cycles and nothing more — the other tenants' heads keep popping at
+    their own proportional cadence instead of starving behind the flood's
+    (equal-priority) backlog. Same interface as _Heap, so the queue's
+    flows (update/delete/activate/requeue) need no special cases."""
+
+    def __init__(self, less: Callable[[QueuedPodInfo, QueuedPodInfo], bool],
+                 sort_key: Optional[Callable[[QueuedPodInfo], tuple]] = None,
+                 weights: Optional[Dict[str, float]] = None,
+                 now: Callable[[], float] = time.monotonic):
+        self._less = less
+        self._sort_key = sort_key
+        self.weights: Dict[str, float] = dict(weights or {})
+        self.now = now
+        self._heaps: Dict[str, _Heap] = {}
+        self._ns_of: Dict[str, str] = {}   # entity uid -> namespace
+        self._credit: Dict[str, float] = {}
+        self.pops: Dict[str, int] = {}     # per-tenant service counts
+        self.last_served: Dict[str, float] = {}
+
+    def _ns(self, qpi) -> str:
+        return qpi.pod.namespace or "default"
+
+    def _weight(self, ns: str) -> float:
+        return max(1e-6, float(self.weights.get(ns, 1.0)))
+
+    def push(self, qpi) -> None:
+        uid = qpi.uid
+        self.delete(uid)
+        ns = self._ns(qpi)
+        heap = self._heaps.get(ns)
+        if heap is None:
+            heap = self._heaps[ns] = _Heap(self._less, self._sort_key)
+            self._credit.setdefault(ns, 0.0)
+        heap.push(qpi)
+        self._ns_of[uid] = ns
+
+    def pop(self) -> Optional[QueuedPodInfo]:
+        nonempty = [ns for ns, h in self._heaps.items() if len(h)]
+        if not nonempty:
+            return None
+        # Smooth WRR: every tenant with queued work earns its weight, the
+        # richest tenant is served and charged the round's total — long-run
+        # service converges to the weight proportions (fairness unit suite).
+        total = 0.0
+        for ns in nonempty:
+            w = self._weight(ns)
+            self._credit[ns] = self._credit.get(ns, 0.0) + w
+            total += w
+        best = max(nonempty, key=lambda ns: (self._credit[ns], ns))
+        self._credit[best] -= total
+        qpi = self._heaps[best].pop()
+        if qpi is not None:
+            self._ns_of.pop(qpi.uid, None)
+            self.pops[best] = self.pops.get(best, 0) + 1
+            self.last_served[best] = self.now()
+        self._gc(best)
+        return qpi
+
+    def _gc(self, ns: str) -> None:
+        heap = self._heaps.get(ns)
+        if heap is not None and not len(heap):
+            del self._heaps[ns]
+            self._credit.pop(ns, None)
+
+    def peek(self) -> Optional[QueuedPodInfo]:
+        for heap in self._heaps.values():
+            got = heap.peek()
+            if got is not None:
+                return got
+        return None
+
+    def delete(self, uid: str) -> Optional[QueuedPodInfo]:
+        ns = self._ns_of.pop(uid, None)
+        if ns is None:
+            return None
+        got = self._heaps[ns].delete(uid)
+        self._gc(ns)
+        return got
+
+    def get(self, uid: str) -> Optional[QueuedPodInfo]:
+        ns = self._ns_of.get(uid)
+        return self._heaps[ns].get(uid) if ns is not None else None
+
+    def __contains__(self, uid: str) -> bool:
+        return uid in self._ns_of
+
+    def __len__(self) -> int:
+        return len(self._ns_of)
+
+    def items(self):
+        return [q for h in self._heaps.values() for q in h.items()]
+
+
 class Nominator:
     """backend/queue/nominator.go — preemption-nominated pods per node."""
 
@@ -392,6 +494,8 @@ class PriorityQueue:
         gang_enabled: bool = True,
         queueing_hints_enabled: bool = True,
         composite_enabled: bool = False,
+        fair_tenant_dequeue: bool = False,
+        tenant_weights: Optional[Dict[str, float]] = None,
     ):
         self.framework = framework
         self.metrics = None  # optional SchedulerMetrics (hint latency series)
@@ -407,7 +511,17 @@ class PriorityQueue:
 
         less = framework.less if framework is not None else (lambda a, b: a.timestamp < b.timestamp)
         sort_key = framework.queue_sort_key if framework is not None else None
-        self.active_q = _Heap(less, sort_key=sort_key)
+        # Per-tenant fairness (docs/RESILIENCE.md § overload & fairness):
+        # with fair_tenant_dequeue, the activeQ becomes per-namespace heaps
+        # popped by smooth weighted round-robin — one flooding tenant gets
+        # its weight's share of cycles, not the whole scheduler. Off by
+        # default: single-tenant workloads keep the global queue-sort order.
+        self.fair_tenant_dequeue = fair_tenant_dequeue
+        if fair_tenant_dequeue:
+            self.active_q = _FairTenantHeap(less, sort_key=sort_key,
+                                            weights=tenant_weights, now=now)
+        else:
+            self.active_q = _Heap(less, sort_key=sort_key)
         self.backoff_q = _Heap(self._backoff_less)
         self.unschedulable: "_UnschedulableMap" = _UnschedulableMap()
         self.nominator = Nominator()
@@ -717,6 +831,25 @@ class PriorityQueue:
 
     def pending_counts(self) -> Tuple[int, int, int]:
         return len(self.active_q), len(self.backoff_q), len(self.unschedulable)
+
+    def starvation_by_namespace(self) -> Dict[str, float]:
+        """Starvation accounting (`scheduler_queue_starvation_seconds`
+        {namespace}): per tenant, how long its LONGEST-waiting runnable
+        entity (active + backoff — not the unschedulable pool, which waits
+        on cluster events by design) has been queued since admission.
+        Computed from live queue contents at scrape time — O(pending),
+        zero bookkeeping on the hot add/pop paths."""
+        now = self.now()
+        out: Dict[str, float] = {}
+        for qpi in list(self.active_q.items()) + list(self.backoff_q.items()):
+            ns = qpi.pod.namespace or "default"
+            start = getattr(qpi, "enqueued_at", None)
+            if start is None:
+                start = qpi.timestamp
+            wait = max(0.0, now - start)
+            if wait > out.get(ns, 0.0):
+                out[ns] = wait
+        return out
 
     # -- requeue on failure -------------------------------------------------
 

@@ -195,6 +195,16 @@ class Handle:
             # full cycle speed until the watch feed catches up.
             s.queue.requeue_conflict(s.queue._new_qpi(pod))
             return
+        if getattr(exc, "code", None) == 429:
+            # Flow-control shed (core/flowcontrol.py) surviving the retry
+            # layers' Retry-After backoff: route through the SAME
+            # conflict-style backoff requeue — never the error log, and
+            # never a plain add() (activeQ would re-pop into the shed wave
+            # at full cycle speed). _new_qpi recovers the pod's original
+            # enqueued_at stamp, so the e2e histogram spans the shed retry.
+            s._note_bind_shed(pod, lost_node)
+            s.queue.requeue_conflict(s.queue._new_qpi(pod))
+            return
         s.error_log.append(
             f"async bind {pod.namespace}/{pod.name}: {exc!r}")
         s.queue.add(pod)
@@ -231,6 +241,10 @@ class Handle:
 
 
 class Scheduler:
+    # Queue wait past this horizon force-samples the pod's trace and emits
+    # a queue.starved event (overload plane, docs/RESILIENCE.md).
+    STARVATION_FORCE_S = 30.0
+
     def __init__(
         self,
         clientset: Optional[FakeClientset] = None,
@@ -283,6 +297,7 @@ class Scheduler:
             self.profiles = default_profiles(handle)
         self.handle = handle
         first = next(iter(self.profiles.values()))
+        import os as _os
         self.queue = PriorityQueue(
             framework=first,
             initial_backoff=self.config.pod_initial_backoff_seconds,
@@ -292,6 +307,13 @@ class Scheduler:
             gang_enabled=self.gates.enabled(GENERIC_WORKLOAD),
             queueing_hints_enabled=self.gates.enabled(SCHEDULER_QUEUEING_HINTS),
             composite_enabled=self.gates.enabled(COMPOSITE_POD_GROUP),
+            # Per-tenant weighted fair dequeue (overload plane, docs/
+            # RESILIENCE.md): config-driven, with an env seam so the shard
+            # harness's OS-process schedulers can switch it on uniformly.
+            fair_tenant_dequeue=(
+                getattr(self.config, "fair_tenant_dequeue", False)
+                or _os.environ.get("TPU_SCHED_FAIR_TENANTS", "") == "1"),
+            tenant_weights=getattr(self.config, "tenant_weights", None),
         )
         self.queue.metrics = self.metrics  # queueing-hint latency series
         # Extenders (extender.go; config extenders or injected objects).
@@ -328,6 +350,11 @@ class Scheduler:
             (): float(self.api_dispatcher.pending_count())}
         self.metrics.queued_entities._fn = self._queued_entity_counts
         self.metrics.unschedulable_pods._fn = self._unschedulable_by_plugin
+        # Per-tenant starvation gauge (overload plane): computed from live
+        # queue contents at scrape time, zero hot-path bookkeeping.
+        self.metrics.queue_starvation._fn = lambda: {
+            (ns,): v
+            for ns, v in self.queue.starvation_by_namespace().items()}
         # Watch decode cost, by wire form (core/watchcache.py shard-filtered
         # streams) and codec (core/wire.py binary vs JSON): counters live on
         # the HTTP clientset's reflector thread; the gauges read them at
@@ -400,6 +427,10 @@ class Scheduler:
         # requeued through the backoffQ (see _unwind_binding).
         self.pod_admission: Optional[Callable[[Pod], bool]] = None
         self.shard_member = None  # set by shard.ShardMember (debugger dump)
+        # Flow-control sheds (429) this scheduler's binds absorbed: each
+        # one requeued through the conflict-style backoff path with its
+        # original queue-admission stamp preserved.
+        self.shed_requeues = 0
         # Per-cycle hook (run_until_idle): the shard member's ownership
         # refresh runs here so queue-mutating failover stays on the
         # scheduling thread even through long drains.
@@ -846,6 +877,16 @@ class Scheduler:
         self.attempts += 1
         t0 = time.perf_counter()
         ctx = self.tracer.context_for(pod.uid)
+        eq = getattr(qpi, "enqueued_at", None)
+        if eq is not None and self.now() - eq >= self.STARVATION_FORCE_S:
+            # A pod that waited past the starvation horizon is FORCE-
+            # sampled (overload forensics): its whole trace — queue.wait
+            # through bind — survives into the flight ring regardless of
+            # the head-sampling rate.
+            ctx = self.tracer.context_for(pod.uid, force=True)
+            self.tracer.event("queue.starved", ctx,
+                              wait=round(self.now() - eq, 3),
+                              namespace=pod.namespace)
         self.record_queue_wait(qpi, ctx)
         trace = StepTrace("Scheduling", ctx=ctx,
                           pod=f"{pod.namespace}/{pod.name}")
@@ -1559,7 +1600,26 @@ class Scheduler:
             self.conflict_requeues += 1
             self.queue.requeue_conflict(qpi)
             return
+        if getattr(st, "shed", False):
+            # Flow-control shed (429): the write plane rejected before any
+            # state changed. Same routing as a conflict — straight to the
+            # backoffQ with the ORIGINAL enqueued_at preserved (qpi is the
+            # popped info object), so scheduler_e2e_scheduling_duration
+            # spans the shed-and-retried pod too. 100%-sampled span: shed
+            # pods are exactly the ones worth tracing under overload.
+            self._note_bind_shed(pod, node_name)
+            self.queue.requeue_conflict(qpi)
+            return
         self.handle_scheduling_failure(fw, qpi, st, None)
+
+    def _note_bind_shed(self, pod: Pod, node: str = "") -> None:
+        """One shed bind's accounting: counter + a FORCED bind.shed span
+        (overload forensics — the trace analyzer's overload timeline needs
+        every shed, not a sample)."""
+        self.shed_requeues += 1
+        self.tracer.record(
+            "bind.shed", self.tracer.context_for(pod.uid, force=True),
+            node=node, pod=f"{pod.namespace}/{pod.name}")
 
     def _note_bind_conflict(self, message: str, pod: Optional[Pod] = None,
                             node: str = "") -> None:

@@ -72,12 +72,14 @@ def main(argv=None) -> int:
                 }
                 results.append(entry)
             thr = entry["threshold"] or 0
-            # Thresholds gate performance- AND hollow-labeled workloads —
-            # the SAME label gate as harness.PerfResult.meets_thresholds
-            # (scheduler_perf.go:282-368); hollow rows carry Max* RSS/
-            # unpaged-LIST ceilings that must assert here too.
-            asserted = ("performance" in wl.labels
-                        or "hollow" in wl.labels)
+            # Thresholds gate performance-, hollow-, and flood-labeled
+            # workloads — the SAME label gate as
+            # harness.PerfResult.meets_thresholds (scheduler_perf.go:
+            # 282-368); hollow rows carry Max* RSS/unpaged-LIST ceilings
+            # and flood rows FloodSheds/MaxFloodErrors floors that must
+            # assert here too.
+            asserted = bool({"performance", "hollow", "flood"}
+                            & set(wl.labels))
             try:
                 res = run_workload(wl)
                 tp = res.metrics.get("SchedulingThroughput", {})

@@ -71,14 +71,16 @@ class PerfResult:
     detail: Dict[str, Any] = field(default_factory=dict)
 
     def meets_thresholds(self) -> bool:
-        """Thresholds gate `performance`- and `hollow`-labeled runs only —
-        the reference asserts them on perf hardware, not on
+        """Thresholds gate `performance`-, `hollow`-, and `flood`-labeled
+        runs only — the reference asserts them on perf hardware, not on
         integration-test variants (scheduler_perf.go:282-368 /
-        misc/performance-config.yaml:1-19). A threshold named ``Max*`` is
-        a CEILING (e.g. MaxApiserverRssMb — the bounded-memory floor of
-        the paged read plane); everything else is a floor."""
-        if ("performance" not in self.workload.labels
-                and "hollow" not in self.workload.labels):
+        misc/performance-config.yaml:1-19). `flood` rows assert their
+        overload floors (FloodSheds/MaxFloodErrors) wherever they run —
+        they ARE the scenario's acceptance contract. A threshold named
+        ``Max*`` is a CEILING (e.g. MaxApiserverRssMb — the
+        bounded-memory floor of the paged read plane); everything else
+        is a floor."""
+        if not {"performance", "hollow", "flood"} & set(self.workload.labels):
             return True
         for name, bound in self.workload.thresholds.items():
             got = self.metrics.get(name, {}).get("Average", 0.0)
@@ -487,6 +489,15 @@ def run_sharded_workload(wl: Workload,
                 f"sharded workloads support createNodes/createPods only, "
                 f"got {op['opcode']!r}")
     shards = int(n_shards or wl.params.get("shards", 2))
+    # Adversarial-tenant flood (overload plane, docs/RESILIENCE.md):
+    # `floodThreads` in params spawns that many flood workers hammering
+    # single-pod creates in their own namespace for the measured window —
+    # the apiserver's flow control must shed them (429 + Retry-After)
+    # while the measured tenant's pods keep binding.
+    flood = None
+    if wl.params.get("floodThreads"):
+        flood = {"threads": int(wl.params["floodThreads"]),
+                 "namespace": wl.params.get("floodNamespace", "flood-tenant")}
     out = run_sharded_cluster(
         shards, n_nodes, n_pods,
         lease_duration=float(wl.params.get("leaseDuration", 3.0)),
@@ -496,7 +507,8 @@ def run_sharded_workload(wl: Workload,
                        "memory": node_tpl.get("memory", "256Gi"),
                        "pods": node_tpl.get("pods", 110)},
         pod_request={"cpu": pod_tpl.get("cpu", "100m"),
-                     "memory": pod_tpl.get("memory", "128Mi")})
+                     "memory": pod_tpl.get("memory", "128Mi")},
+        flood=flood)
     result = PerfResult(workload=wl, scheduled=out["bound"],
                         failed=0 if out["all_bound"] else 1,
                         elapsed=out["elapsed_s"])
@@ -504,6 +516,11 @@ def run_sharded_workload(wl: Workload,
     result.metrics["SchedulingThroughput"] = {
         "Average": rate, "Perc50": rate, "Perc90": rate, "Perc95": rate,
         "Perc99": rate}
+    if flood is not None and out.get("flood") is not None:
+        # FloodSheds floor: the flood really was shed (not absorbed);
+        # MaxFloodErrors ceiling: sheds are 429s, never transport failures.
+        result.metrics["FloodSheds"] = {"Average": out["flood"]["shed"]}
+        result.metrics["MaxFloodErrors"] = {"Average": out["flood"]["errors"]}
     result.detail = dict(out)
     return result
 
@@ -873,6 +890,18 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
             "count": e2e.count(),
             "p50": round(e2e.percentile(0.50) * 1e3, 3),
             "p99": round(e2e.percentile(0.99) * 1e3, 3),
+        }
+    # Preemption-storm attribution (the PreemptionStorm rows): attempts,
+    # victim totals, and async victim-deletion results in the detail line.
+    m = sched.metrics
+    if m.preemption_attempts.value() or m.workload_preemption_attempts._values:
+        result.detail["preemption"] = {
+            "attempts": int(m.preemption_attempts.value()),
+            "victims": int(m.preemption_victims.count()),
+            "workload_attempts": {
+                k[0]: int(v)
+                for k, v in m.workload_preemption_attempts._values.items()},
+            "workload_victims": int(m.workload_preemption_victims.count()),
         }
     # in-flight invariant (scheduler_perf.go:878-880 checkEmptyInFlightEvents)
     assert not sched.queue._in_flight, "in-flight events remain after workload"

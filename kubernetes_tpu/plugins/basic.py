@@ -161,6 +161,13 @@ class DefaultBinder:
                 try:
                     self.handle.clientset.bind(pod, node_name)
                 except Exception as e:  # noqa: BLE001
+                    if getattr(e, "code", None) == 429:
+                        # Flow-control shed (core/flowcontrol.py): the bind
+                        # never ran. Tagged so the binding cycle requeues
+                        # through the backoffQ with the admission stamp
+                        # intact — the retry layers already honored
+                        # Retry-After before this surfaced.
+                        return Status.bind_shed(str(e))
                     if getattr(e, "code", None) == 409:
                         # Optimistic-binding loss (AlreadyBound /
                         # OutOfCapacity): another scheduler committed first.

@@ -296,7 +296,9 @@ def start_sharded_cluster(n_shards: int, lease_duration: float = 15.0,
                           flightrec_dir: str = "",
                           startup_timeout: float = 180.0,
                           replicas: int = 0,
-                          repl_lease: float = 2.0) -> ShardedCluster:
+                          repl_lease: float = 2.0,
+                          fair_tenants: bool = False,
+                          apf_workload: str = "") -> ShardedCluster:
     """Spawn the apiserver + N shard scheduler processes; blocks until every
     process prints its ready line (shards spawn in parallel — each pays the
     JAX import). ``flightrec_dir`` installs the flight recorder in every
@@ -316,6 +318,17 @@ def start_sharded_cluster(n_shards: int, lease_duration: float = 15.0,
     if flightrec_dir:
         os.makedirs(flightrec_dir, exist_ok=True)
         env["TPU_SCHED_FLIGHTREC_DIR"] = flightrec_dir
+    if fair_tenants:
+        # Per-tenant weighted fair dequeue in every shard scheduler
+        # (core/queue.py _FairTenantHeap) — the flood/fairness scenarios
+        # switch it on uniformly across the plane's OS processes.
+        env["TPU_SCHED_FAIR_TENANTS"] = "1"
+    if apf_workload:
+        # Workload-lane sizing override for the spawned apiserver
+        # (core/flowcontrol.py env seam: "seats,queues,qlen,hand,wait") —
+        # flood scenarios tighten it so shedding is demonstrable at
+        # test-box scale; the exempt lane has no knob by design.
+        env["TPU_SCHED_APF_WORKLOAD"] = apf_workload
     cmd = [sys.executable, "-m", "kubernetes_tpu.core.apiserver",
            "--port", "0"]
     if data_dir:
@@ -436,6 +449,7 @@ def run_sharded_cluster(
     replicas: int = 0,
     repl_lease: float = 2.0,
     hollow=None,
+    flood=None,
 ) -> dict:
     """The sharded SchedulingBasic shape end to end: create `n_nodes`,
     warm the shards with `warm_pods` (XLA compilation + first sessions land
@@ -450,29 +464,58 @@ def run_sharded_cluster(
     cordon/delete/re-register churn all run against the leader for the
     whole measured window — instead of being bulk-created inert.
 
+    With ``flood`` set (``{"threads": T, "namespace": ns, "cpu": req}``),
+    an adversarial-tenant flood hammers single-pod creates in its own
+    namespace for the whole measured window — flood pods request an
+    unsatisfiable CPU so they never consume the measured capacity; the
+    result carries ``flood`` stats (posted / shed-at-429 / errors) next
+    to the apiserver's flowcontrol counters (docs/RESILIENCE.md
+    § overload & fairness), and every shard runs per-tenant fair dequeue.
+
     Returns the one-line-JSON-able result dict: pods/s, per-shard metric
     scrapes, apiserver conflict counters, peak per-process RSS, and a
     bound-exactly-once check (the store can't hold duplicates, so
     'duplicates' asserts bindings == bound pods)."""
+    import threading as _threading
+    from urllib.error import HTTPError
+
     from ..core.apiserver import fetch_paged, node_to_wire, pod_to_wire
     from ..testing.wrappers import make_node, make_pod
 
     cap = node_capacity or {"cpu": 32, "memory": "256Gi", "pods": 110}
     req = pod_request or {"cpu": "100m", "memory": "128Mi"}
-    cluster = start_sharded_cluster(n_shards, lease_duration=lease_duration,
-                                    flightrec_dir=flightrec_dir,
-                                    replicas=replicas, repl_lease=repl_lease)
+    cluster = start_sharded_cluster(
+        n_shards, lease_duration=lease_duration,
+        flightrec_dir=flightrec_dir,
+        replicas=replicas, repl_lease=repl_lease,
+        fair_tenants=flood is not None,
+        # A tightened workload lane makes shedding demonstrable at
+        # test-box scale (stock lanes mostly ADMIT a paced flood — APF
+        # bounds concurrency, not rate) while leaving enough seats for
+        # the measured tenant's create/bind traffic; override via
+        # flood["apf_workload"].
+        apf_workload=(flood or {}).get("apf_workload", "4,8,4,2,0.5")
+        if flood is not None else "")
     base = cluster.base
     try:
         def post_many(path: str, wires: List[dict], chunk: int = 200) -> None:
             """Bulk creates (JSON-array POST): one HTTP turnaround per
             chunk instead of per object. Chunks stay modest so each bulk
             request's write-lock hold (~0.3ms/object) never stalls the
-            bind plane for more than ~60ms."""
+            bind plane for more than ~60ms. The creator is a client on
+            the 429 surface like any other: sheds replay through
+            core/backoff.py's Retry-After-honoring retry_call — the
+            well-behaved tenant backs off and lands, never errors out."""
+            from ..core.backoff import RetryConfig, retry_call
+
+            cfg = RetryConfig(initial_backoff=0.05, max_backoff=1.0,
+                              max_attempts=30, seed=11, retry_after_cap=2.0)
             parts = [wires[i:i + chunk] for i in range(0, len(wires), chunk)]
             with ThreadPoolExecutor(max_workers=creator_threads) as ex:
                 list(ex.map(
-                    lambda c: _call(base, "POST", path, c, timeout=120),
+                    lambda c: retry_call(
+                        lambda c=c: _call(base, "POST", path, c,
+                                          timeout=120), cfg),
                     parts))
 
         if hollow is not None:
@@ -557,6 +600,81 @@ def run_sharded_cluster(
                 raise TimeoutError(
                     f"warm phase stalled: {got}/{warm_pods} bound")
 
+        # Adversarial-tenant flood (overload plane acceptance): T threads
+        # hammer single-pod creates in the flood namespace for the whole
+        # measured window. Flood pods request an unsatisfiable CPU, so
+        # they stress the write plane + scheduler queues without consuming
+        # the capacity the measured pods bind into. Each worker keeps its
+        # OWN counters (no racy shared increments); stats sum at stop.
+        flood_stop = _threading.Event()
+        flood_threads: List[_threading.Thread] = []
+        flood_counts: List[dict] = []
+        if flood is not None:
+            flood_ns = flood.get("namespace", "flood-tenant")
+            flood_proto = make_pod().name("proto").namespace(flood_ns).req(
+                {"cpu": str(flood.get("cpu", 4096)),
+                 "memory": "1Gi"}).obj()
+
+            # Pacing: a shed worker backs off briefly (even an adversary
+            # pays a network RTT, and an unpaced spin would measure the
+            # harness box's CPU, not the plane's shedding). Each accepted
+            # pod is deleted right back — the flood is a create/delete
+            # churn hammer (TWO admissions per iteration), so it stresses
+            # the write plane and the watch fanout at full rate without
+            # accumulating an unbounded unschedulable pool in every
+            # shard (that accumulation measures the harness box's memory,
+            # not the plane's fairness).
+            shed_pause = float(flood.get("shed_pause_s", 0.25))
+            think = float(flood.get("think_s", 0.05))
+
+            def flood_worker(widx: int) -> None:
+                # "shed" counts CREATE 429s only — the FloodSheds floor
+                # asserts the create path was shed, not the cleanup. A
+                # shed delete-back retries (bounded) so accepted flood
+                # pods don't leak into every shard's unschedulable pool
+                # for the measured window.
+                stats = {"posted": 0, "shed": 0, "errors": 0}
+                flood_counts.append(stats)
+                seq = 0
+                while not flood_stop.is_set():
+                    seq += 1
+                    pod = flood_proto.clone_from_template(
+                        f"flood-{widx}-{seq}")
+                    try:
+                        _call(base, "POST", "/api/v1/pods",
+                              pod_to_wire(pod), timeout=30)
+                        stats["posted"] += 1
+                    except HTTPError as e:
+                        if e.code == 429:
+                            stats["shed"] += 1
+                            flood_stop.wait(shed_pause)
+                        else:
+                            stats["errors"] += 1
+                        continue
+                    except Exception:  # noqa: BLE001 - transport noise
+                        stats["errors"] += 1
+                        continue
+                    for _ in range(4):
+                        try:
+                            _call(base, "DELETE",
+                                  f"/api/v1/pods/{pod.uid}", timeout=30)
+                            break
+                        except HTTPError as e:
+                            if e.code != 429:
+                                stats["errors"] += 1
+                                break
+                            flood_stop.wait(shed_pause)
+                        except Exception:  # noqa: BLE001 - transport noise
+                            stats["errors"] += 1
+                            break
+                    flood_stop.wait(think)
+
+            for widx in range(int(flood.get("threads", 48))):
+                t = _threading.Thread(target=flood_worker, args=(widx,),
+                                      name=f"flood-{widx}", daemon=True)
+                t.start()
+                flood_threads.append(t)
+
         t0 = time.perf_counter()
         wires = pod_wires("pod", n_pods)
         t_wires = time.perf_counter()
@@ -568,6 +686,18 @@ def run_sharded_cluster(
             cb=(lambda b: progress_cb(b - warm_pods, cluster))
             if progress_cb is not None else None)
         elapsed = time.perf_counter() - t0
+        flood_result = None
+        if flood is not None:
+            flood_stop.set()
+            for t in flood_threads:
+                t.join(timeout=30)
+            flood_result = {
+                "namespace": flood.get("namespace", "flood-tenant"),
+                "threads": len(flood_threads),
+                "posted": sum(s["posted"] for s in flood_counts),
+                "shed": sum(s["shed"] for s in flood_counts),
+                "errors": sum(s["errors"] for s in flood_counts),
+            }
 
         # Exactly-once oracle read, PAGED (`?limit=&continue=`): even the
         # harness's own final sweep never asks for a full-cluster
@@ -717,16 +847,28 @@ def run_sharded_cluster(
                                resource_series=resource_series),
             "watch_decode": watch_decode,
             "wire": wire_summary,
+            # Overload plane (core/flowcontrol.py): flood-tenant stats +
+            # the leader's per-priority-level admission counters ride the
+            # bench detail line ("flowcontrol" matches the api filter).
+            "flood": flood_result,
+            "flowcontrol": {
+                metric: scrape_labeled(
+                    base, f"apiserver_flowcontrol_{metric}_total",
+                    "priority_level", text=api_text)
+                for metric in ("rejected", "dispatched", "queued")
+            },
             "api": {k: v for k, v in api_metrics.items()
                     if "conflict" in k or "lease" in k
                     or "replication" in k or "failover" in k
                     or "watch" in k or "list" in k
-                    or "snapshot" in k or "heartbeat" in k},
+                    or "snapshot" in k or "heartbeat" in k
+                    or "flowcontrol" in k},
             "shard_metrics": [
                 {k: v for k, v in sm.items()
                  if k.startswith(("scheduler_shard_",
                                   "scheduler_bind_conflict",
-                                  "scheduler_hint_"))}
+                                  "scheduler_hint_",
+                                  "scheduler_queue_starvation"))}
                 for sm in shard_metrics],
         }
     finally:

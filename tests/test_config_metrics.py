@@ -310,7 +310,8 @@ def test_metric_name_parity_with_reference():
                      "scheduler_shard_lease_renewals_total",
                      "scheduler_shard_adoptions_total",
                      "scheduler_watch_decoded_events",
-                     "scheduler_watch_decoded_bytes"}, extra
+                     "scheduler_watch_decoded_bytes",
+                     "scheduler_queue_starvation_seconds"}, extra
 
 
 def test_new_series_populate_during_scheduling():

@@ -1,0 +1,579 @@
+"""Overload & fairness suite (docs/RESILIENCE.md § overload & fairness):
+
+- core/flowcontrol.py units — shuffle-shard collision bounds, weighted
+  round-robin dequeue proportions, exempt-lane bypass under saturation;
+- the HTTP shed contract — queue-full 429 + Retry-After envelope against
+  a LIVE apiserver, with the shed path never blocking on the write lock;
+- the client half — core/backoff.py honors Retry-After with decorrelated
+  jitter, and a RetryingClientset rides a shed to eventual success;
+- core/queue.py per-tenant fair dequeue — proportions, within-tenant
+  order preservation, and the starvation gauge.
+"""
+
+import threading
+import time
+from urllib.error import HTTPError
+
+import pytest
+
+from kubernetes_tpu.core.backoff import (RetryConfig, is_retriable,
+                                         retry_after_of, retry_call)
+from kubernetes_tpu.core.flowcontrol import (EXEMPT, WORKLOAD, FlowController,
+                                             PriorityLevel, default_levels,
+                                             shuffle_shard_hand)
+
+
+def _err429(retry_after="2"):
+    headers = {"Retry-After": retry_after} if retry_after is not None else {}
+    return HTTPError("http://x/api/v1/pods", 429, "Too Many Requests",
+                     headers, None)
+
+
+# ---------------------------------------------------------------------------
+# shuffle sharding
+# ---------------------------------------------------------------------------
+
+
+class TestShuffleSharding:
+    def test_hand_is_distinct_and_stable(self):
+        for flow in ("tenant-a", "tenant-b", "flood"):
+            hand = shuffle_shard_hand(WORKLOAD, flow, 8, 2)
+            assert len(hand) == len(set(hand)) == 2
+            assert all(0 <= i < 8 for i in hand)
+            # deterministic: same flow, same hand, every call/process
+            assert hand == shuffle_shard_hand(WORKLOAD, flow, 8, 2)
+
+    def test_collision_bound(self):
+        """The isolation claim: a flood flow's hand pins only ITS queues.
+        Over many tenants, the share whose entire hand lands inside the
+        flood's hand must stay near (hand/queues)^hand — with 8 queues and
+        hand 2 that is ~(2/8)^2 ≈ 6%; assert a generous 15% bound."""
+        queues, hand_size = 8, 2
+        flood = set(shuffle_shard_hand(WORKLOAD, "flood", queues, hand_size))
+        trapped = sum(
+            1 for i in range(400)
+            if set(shuffle_shard_hand(WORKLOAD, f"ns-{i}", queues,
+                                      hand_size)) <= flood)
+        assert trapped / 400 < 0.15, trapped
+
+    def test_level_scoping_changes_hands(self):
+        # The same flow key in different levels deals independent hands
+        # (statistically; assert they differ for at least one probe flow).
+        assert any(
+            shuffle_shard_hand("workload", f"ns-{i}", 16, 2)
+            != shuffle_shard_hand("system", f"ns-{i}", 16, 2)
+            for i in range(8))
+
+
+# ---------------------------------------------------------------------------
+# priority levels: WRR proportions + exempt bypass + shed accounting
+# ---------------------------------------------------------------------------
+
+
+class TestPriorityLevel:
+    def _saturated_level(self, weights):
+        lvl = PriorityLevel(WORKLOAD, seats=1, queues=8, queue_length=64,
+                            hand_size=2, max_wait=5.0, flow_weights=weights)
+        lvl.seats_in_use = 1  # the seat is taken; everyone below queues
+        return lvl
+
+    def test_weighted_dequeue_proportions(self):
+        """Smooth WRR: with weights 3:1 and both flows saturated, service
+        counts converge to 3:1 (exact over any window of 4 rounds)."""
+        lvl = self._saturated_level({"gold": 3.0, "bronze": 1.0})
+        for _ in range(40):
+            assert lvl._enqueue("gold") is not None
+            assert lvl._enqueue("bronze") is not None
+        served = {"gold": 0, "bronze": 0}
+        for _ in range(40):
+            lvl.seats_in_use -= 1  # release
+            before = {f: served[f] for f in served}
+            lvl._dispatch_next()
+            # exactly one waiter seated per free seat
+            assert lvl.seats_in_use == 1
+            for q in lvl._queues:
+                pass
+            seated = [w for q in lvl._queues for w in q]
+            # count by elimination: 80 - len(still queued) - already served
+            total_served = 80 - len(seated)
+            got = total_served - sum(before.values())
+            assert got == 1
+            # attribute: find which flow shrank
+            remaining = {"gold": 0, "bronze": 0}
+            for w in seated:
+                remaining[w.flow] += 1
+            for f in served:
+                served[f] = 40 - remaining[f]
+        assert served["gold"] == 30 and served["bronze"] == 10, served
+
+    def test_queue_full_sheds(self):
+        lvl = PriorityLevel(WORKLOAD, seats=1, queues=4, queue_length=2,
+                            hand_size=1, max_wait=0.1)
+        lvl.seats_in_use = 1
+        flow = "flood"
+        assert lvl._enqueue(flow) is not None
+        assert lvl._enqueue(flow) is not None
+        assert lvl._enqueue(flow) is None  # its one queue is full
+
+    def test_flood_cannot_fill_foreign_queues(self):
+        """A flood saturating its own hand leaves the other queues — and
+        therefore other tenants — untouched."""
+        lvl = PriorityLevel(WORKLOAD, seats=1, queues=8, queue_length=4,
+                            hand_size=2, max_wait=0.1)
+        lvl.seats_in_use = 1
+        while lvl._enqueue("flood") is not None:
+            pass
+        assert lvl.queue_depth() <= 2 * 4  # bounded by the flood's hand
+        # a well-behaved tenant outside the flood's hand still queues
+        hand_flood = set(shuffle_shard_hand(WORKLOAD, "flood", 8, 2))
+        victim = next(f"ns-{i}" for i in range(64)
+                      if not set(shuffle_shard_hand(WORKLOAD, f"ns-{i}",
+                                                    8, 2)) & hand_flood)
+        assert lvl._enqueue(victim) is not None
+
+
+class TestFlowController:
+    def test_exempt_bypass_under_saturation(self):
+        fc = FlowController({
+            EXEMPT: PriorityLevel(EXEMPT, queues=0),
+            WORKLOAD: PriorityLevel(WORKLOAD, seats=1, queues=1,
+                                    queue_length=1, hand_size=1,
+                                    max_wait=0.05),
+        })
+        seat = fc.admit(WORKLOAD, "ns-a")
+        assert seat is not None and seat.seated
+        # workload is saturated: one waiter queues (and will time out),
+        # the next sheds instantly...
+        t0 = time.monotonic()
+        assert fc.admit(WORKLOAD, "ns-a") is None  # waited max_wait, shed
+        assert time.monotonic() - t0 < 1.0
+        # ...but the exempt lane admits instantly, every time.
+        for _ in range(32):
+            t1 = time.monotonic()
+            ticket = fc.admit(EXEMPT, "control")
+            assert ticket is not None
+            assert time.monotonic() - t1 < 0.05
+            fc.release(ticket)  # no seat held; must be a no-op
+        snap = fc.snapshot()
+        assert snap[EXEMPT]["dispatched"] == 32
+        assert snap[EXEMPT]["rejected"] == 0
+        assert snap[WORKLOAD]["rejected"] >= 1
+        fc.release(seat)
+
+    def test_release_dispatches_queued_waiter(self):
+        fc = FlowController({
+            WORKLOAD: PriorityLevel(WORKLOAD, seats=1, queues=2,
+                                    queue_length=4, hand_size=1,
+                                    max_wait=5.0)})
+        first = fc.admit(WORKLOAD, "ns-a")
+        got = {}
+
+        def queued():
+            got["ticket"] = fc.admit(WORKLOAD, "ns-b")
+
+        t = threading.Thread(target=queued, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        assert "ticket" not in got  # parked in the fair queue
+        fc.release(first)
+        t.join(timeout=5)
+        assert got["ticket"] is not None and got["ticket"].seated
+        fc.release(got["ticket"])
+        snap = fc.snapshot()
+        assert snap[WORKLOAD]["queued"] == 1
+        assert snap[WORKLOAD]["dispatched"] == 2
+        assert snap[WORKLOAD]["seats"] == 0
+
+    def test_classification(self):
+        fc = FlowController(default_levels())
+        assert fc.classify("PUT", "/api/v1/leases/shard-0") == (EXEMPT,
+                                                                "control")
+        assert fc.classify("POST", "/replication/leader")[0] == EXEMPT
+        assert fc.classify("POST", "/api/v1/nodes/status")[0] == "system"
+        assert fc.classify("POST", "/api/v1/pods", "team-a") == (WORKLOAD,
+                                                                 "team-a")
+        assert fc.classify("POST", "/api/v1/bindings", "") == (WORKLOAD,
+                                                               "default")
+
+    def test_retry_after_scales_with_depth(self):
+        fc = FlowController({
+            WORKLOAD: PriorityLevel(WORKLOAD, seats=1, queues=1,
+                                    queue_length=8, hand_size=1,
+                                    max_wait=1.0)})
+        base = fc.retry_after(WORKLOAD)
+        assert base >= 1
+        lvl = fc.levels[WORKLOAD]
+        lvl.seats_in_use = 1
+        for _ in range(8):
+            lvl._enqueue("flood")
+        assert fc.retry_after(WORKLOAD) >= base
+
+
+# ---------------------------------------------------------------------------
+# the client half: 429 + Retry-After through core/backoff.py
+# ---------------------------------------------------------------------------
+
+
+class TestClientBackoff:
+    def test_429_is_retriable_and_parsed(self):
+        e = _err429("3")
+        assert is_retriable(e)
+        assert retry_after_of(e) == 3.0
+        assert retry_after_of(_err429(None)) is None
+        assert retry_after_of(_err429("garbage")) is None
+        assert retry_after_of(HTTPError("u", 404, "nope", {}, None)) is None
+
+    def test_retry_after_floor_and_decorrelated_jitter(self):
+        """Sleeps honor the server's hint as a FLOOR, spread with
+        decorrelated jitter (never the bare exponential schedule), grow
+        against persistent sheds, and stay under the cap."""
+        sleeps = []
+        calls = {"n": 0}
+
+        def shed_twice():
+            calls["n"] += 1
+            if calls["n"] <= 3:
+                raise _err429("2")
+            return "ok"
+
+        cfg = RetryConfig(initial_backoff=0.001, max_backoff=0.01,
+                          max_attempts=5, seed=7, retry_after_cap=30.0)
+        assert retry_call(shed_twice, cfg, sleep=sleeps.append) == "ok"
+        assert len(sleeps) == 3
+        for d in sleeps:
+            assert 2.0 <= d <= 30.0  # floor = the hint, cap respected
+        # decorrelated: successive sleeps differ (no synchronized herd)
+        assert len(set(sleeps)) == len(sleeps)
+        # deterministic per seed (chaos replay contract)
+        calls["n"] = 0
+        replay = []
+        retry_call(shed_twice, cfg, sleep=replay.append)
+        assert replay == sleeps
+
+    def test_retry_after_cap_bounds_hostile_header(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def shed_once():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise _err429("86400")  # a day — hostile/buggy
+            return "ok"
+
+        cfg = RetryConfig(max_attempts=3, seed=1, retry_after_cap=5.0)
+        assert retry_call(shed_once, cfg, sleep=sleeps.append) == "ok"
+        assert sleeps == [5.0]
+
+    def test_budget_still_bounds_attempts(self):
+        cfg = RetryConfig(max_attempts=3, seed=0)
+        calls = {"n": 0}
+
+        def always_shed():
+            calls["n"] += 1
+            raise _err429("1")
+
+        with pytest.raises(HTTPError):
+            retry_call(always_shed, cfg, sleep=lambda d: None)
+        assert calls["n"] == 3
+
+
+# ---------------------------------------------------------------------------
+# the HTTP shed contract against a live apiserver
+# ---------------------------------------------------------------------------
+
+
+def _tiny_controller(max_wait=2.0):
+    return FlowController({
+        EXEMPT: PriorityLevel(EXEMPT, queues=0),
+        "system": PriorityLevel("system", seats=4, queues=4,
+                                queue_length=64, hand_size=1),
+        WORKLOAD: PriorityLevel(WORKLOAD, seats=1, queues=1, queue_length=1,
+                                hand_size=1, max_wait=max_wait),
+    })
+
+
+class TestHTTPShedEnvelope:
+    def test_queue_full_429_with_retry_after(self):
+        """Saturate a 1-seat/1-queue workload lane by parking the write
+        plane: the first POST holds the seat (blocked on _write_lock), the
+        second queues, the third sheds 429 with Retry-After — served
+        entirely off the write lock, while the exempt lane (lease CAS)
+        keeps landing."""
+        import http.client
+
+        from kubernetes_tpu.core.apiserver import APIServer, pod_to_wire
+        from kubernetes_tpu.core import wire
+        from kubernetes_tpu.testing.wrappers import make_pod
+
+        api = APIServer()
+        api.flowcontrol = _tiny_controller()
+        port = api.serve(0)
+        results = []
+
+        def post(i):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            try:
+                body = wire.jdumps(pod_to_wire(
+                    make_pod().name(f"p{i}").req({"cpu": "100m"})
+                    .obj())).encode()
+                conn.request("POST", "/api/v1/pods", body=body)
+                resp = conn.getresponse()
+                results.append((resp.status, resp.getheader("Retry-After")))
+                resp.read()
+            finally:
+                conn.close()
+
+        api._write_lock.acquire()  # park the write plane
+        try:
+            threads = []
+            for i in range(2):  # seat holder + one queued waiter
+                t = threading.Thread(target=post, args=(i,), daemon=True)
+                t.start()
+                threads.append(t)
+                time.sleep(0.2)
+            # the lane is saturated: this one must shed, FAST, with the
+            # envelope — even though the write lock is still held.
+            t0 = time.monotonic()
+            post(99)
+            shed_latency = time.monotonic() - t0
+            assert shed_latency < 1.0, shed_latency
+            status, ra = results[-1]
+            assert status == 429
+            assert ra is not None and int(ra) >= 1
+            # exempt lane unaffected by the saturation: lease CAS lands
+            # (it serializes under the write lock itself, so just assert
+            # admission-side accounting here, not the full round trip).
+            assert api.flowcontrol.admit(EXEMPT, "control") is not None
+        finally:
+            api._write_lock.release()
+        for t in threads:
+            t.join(timeout=30)
+        # seat holder + queued waiter both landed once the plane freed
+        codes = sorted(s for s, _ in results)
+        assert codes == [201, 201, 429], codes
+        snap = api.flowcontrol.snapshot()
+        assert snap[WORKLOAD]["rejected"] == 1
+        assert snap[WORKLOAD]["dispatched"] == 2
+        assert snap[WORKLOAD]["seats"] == 0
+        m = api.expose_metrics()
+        assert ('apiserver_flowcontrol_rejected_total'
+                '{priority_level="workload"} 1') in m
+        api.shutdown()
+
+    def test_retrying_clientset_rides_shed_to_success(self):
+        """A shed write backs off per Retry-After and lands on the next
+        try — the live-server client-backoff test: RetryingClientset +
+        HTTPClientset against a saturated lane that frees mid-backoff."""
+        from kubernetes_tpu.core.apiserver import APIServer, HTTPClientset
+        from kubernetes_tpu.core.clientset import RetryingClientset
+        from kubernetes_tpu.testing.wrappers import make_pod
+
+        api = APIServer()
+        api.flowcontrol = _tiny_controller(max_wait=0.2)
+        port = api.serve(0)
+        http_cs = HTTPClientset(f"http://127.0.0.1:{port}")
+        rcs = RetryingClientset(http_cs, retry=RetryConfig(
+            initial_backoff=0.01, max_backoff=0.1, max_attempts=8, seed=3,
+            retry_after_cap=3.0))
+        try:
+            # Saturate the 1-seat lane: a slow POST holds the seat while
+            # the write lock is parked; queue_length=1 fills with one more.
+            api._write_lock.acquire()
+            blockers = []
+
+            def hold(i):
+                try:
+                    http_cs._call("POST", "/api/v1/pods",
+                                  __import__(
+                                      "kubernetes_tpu.core.apiserver",
+                                      fromlist=["pod_to_wire"]).pod_to_wire(
+                                      make_pod().name(f"h{i}")
+                                      .req({"cpu": "1m"}).obj()))
+                except Exception:  # noqa: BLE001 - may shed; irrelevant
+                    pass
+
+            for i in range(2):
+                t = threading.Thread(target=hold, args=(i,), daemon=True)
+                t.start()
+                blockers.append(t)
+                time.sleep(0.2)
+
+            def free_later():
+                time.sleep(1.0)
+                api._write_lock.release()
+
+            threading.Thread(target=free_later, daemon=True).start()
+            # This create sheds (lane saturated), backs off per
+            # Retry-After, and succeeds once the plane frees.
+            rcs.create_pod(make_pod().name("measured")
+                           .req({"cpu": "100m"}).obj())
+            assert rcs.retries_total >= 1
+            assert api.store.pods  # it landed
+            assert any(p.name == "measured"
+                       for p in api.store.pods.values())
+            snap = api.flowcontrol.snapshot()
+            assert snap[WORKLOAD]["rejected"] >= 1
+            for t in blockers:
+                t.join(timeout=30)
+        finally:
+            http_cs.close()
+            api.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# scheduler queue: per-tenant fair dequeue + starvation accounting
+# ---------------------------------------------------------------------------
+
+
+class TestFairTenantQueue:
+    def _queue(self, weights=None):
+        from kubernetes_tpu.core.queue import PriorityQueue
+        return PriorityQueue(fair_tenant_dequeue=True,
+                             tenant_weights=weights)
+
+    def _pod(self, name, ns, priority=0):
+        from kubernetes_tpu.testing.wrappers import make_pod
+        return (make_pod().name(name).namespace(ns)
+                .req({"cpu": "100m"}).priority(priority).obj())
+
+    def test_wrr_proportions_under_synthetic_load(self):
+        q = self._queue(weights={"gold": 3.0, "bronze": 1.0})
+        for i in range(40):
+            q.add(self._pod(f"g{i}", "gold"))
+            q.add(self._pod(f"b{i}", "bronze"))
+        served = {"gold": 0, "bronze": 0}
+        for _ in range(40):
+            qpi = q.pop()
+            served[qpi.pod.namespace] += 1
+            q.done(qpi.uid)
+        assert served == {"gold": 30, "bronze": 10}, served
+
+    def test_flood_cannot_starve_other_tenants(self):
+        """10k flood pods vs 10 well-behaved ones: equal weights mean the
+        well-behaved tenant's pods all pop inside the first 2N cycles."""
+        q = self._queue()
+        for i in range(2000):
+            q.add(self._pod(f"f{i}", "flood"))
+        for i in range(10):
+            q.add(self._pod(f"w{i}", "web"))
+        seen_web = 0
+        for cycle in range(40):
+            qpi = q.pop()
+            if qpi.pod.namespace == "web":
+                seen_web += 1
+            q.done(qpi.uid)
+        assert seen_web == 10  # all well-behaved pods served in 40 cycles
+
+    def test_within_tenant_priority_order_preserved(self):
+        """The fair heap only changes WHICH tenant pops next; inside a
+        tenant the framework's queue-sort order (PrioritySort) holds."""
+        from kubernetes_tpu.core.node_info import PodInfo
+        from kubernetes_tpu.core.queue import QueuedPodInfo, _FairTenantHeap
+        from kubernetes_tpu.plugins.basic import PrioritySort
+
+        ps = PrioritySort()
+        heap = _FairTenantHeap(ps.less, sort_key=PrioritySort.sort_key)
+        for name, prio in (("lo", 1), ("hi", 100), ("mid", 50)):
+            heap.push(QueuedPodInfo(
+                pod_info=PodInfo.of(self._pod(name, "a", priority=prio)),
+                timestamp=1.0))
+        assert [heap.pop().pod.name for _ in range(3)] == ["hi", "mid", "lo"]
+
+    def test_heap_interface_parity(self):
+        q = self._queue()
+        p = self._pod("x", "a")
+        q.add(p)
+        assert q.active_q.get(p.uid) is not None
+        assert p.uid in q.active_q
+        assert len(q.active_q) == 1
+        q.delete(p)
+        assert q.active_q.get(p.uid) is None
+        assert len(q.active_q) == 0
+        assert q.pop() is None
+
+    def test_starvation_by_namespace(self):
+        clock = {"t": 100.0}
+        from kubernetes_tpu.core.queue import PriorityQueue
+        q = PriorityQueue(fair_tenant_dequeue=True,
+                          now=lambda: clock["t"])
+        q.add(self._pod("a0", "alpha"))
+        clock["t"] = 105.0
+        q.add(self._pod("b0", "beta"))
+        clock["t"] = 110.0
+        starve = q.starvation_by_namespace()
+        assert starve["alpha"] == pytest.approx(10.0)
+        assert starve["beta"] == pytest.approx(5.0)
+        qpi = q.pop()  # WRR serves one of them
+        q.done(qpi.uid)
+        starve = q.starvation_by_namespace()
+        assert len(starve) == 1  # the served tenant's entry drained
+
+    def test_plain_queue_starvation_also_works(self):
+        from kubernetes_tpu.core.queue import PriorityQueue
+        clock = {"t": 0.0}
+        q = PriorityQueue(now=lambda: clock["t"])
+        q.add(self._pod("p", "solo"))
+        clock["t"] = 3.0
+        assert q.starvation_by_namespace()["solo"] == pytest.approx(3.0)
+
+
+class TestShedRequeuePreservesEnqueuedAt:
+    """The ISSUE 14 satellite extending the PR-12 conflict fix to 429s:
+    a shed bind must requeue through the conflict-style backoff path with
+    the ORIGINAL queue-admission instant, so the e2e histogram spans the
+    whole shed-and-retry — never the error log, never a fresh clock."""
+
+    def _scheduler(self):
+        from kubernetes_tpu.core.scheduler import Scheduler
+        from kubernetes_tpu.testing.wrappers import make_node
+        s = Scheduler()
+        s.clientset.create_node(
+            make_node().name("n-0").capacity(
+                {"cpu": 8, "memory": "32Gi", "pods": 110}).obj())
+        return s
+
+    def _popped(self, s, name="shed-victim"):
+        from kubernetes_tpu.testing.wrappers import make_pod
+        p = make_pod().name(name).req({"cpu": "100m"}).obj()
+        s.queue.add(p)
+        qpi = s.queue.pop()
+        assert qpi.enqueued_at is not None
+        s.queue.done(p.uid)
+        return p, qpi
+
+    def test_async_shed_requeues_with_original_stamp(self):
+        s = self._scheduler()
+        p, qpi = self._popped(s)
+        orig = qpi.enqueued_at
+        p.node_name = "n-0"
+        s.cache.assume_pod(p, qpi.pod_info)
+
+        class _E(Exception):
+            code = 429
+
+            def read(self):
+                return b'{"error": "TooManyRequests"}'
+
+        s.handle.on_async_bind_error(p, _E())
+        assert s.shed_requeues == 1
+        assert not s.error_log, s.error_log
+        requeued = s.queue.backoff_q.get(p.uid) or s.queue.active_q.get(p.uid)
+        assert requeued is not None
+        assert requeued.enqueued_at == orig, (
+            "shed requeue restarted the e2e clock")
+
+    def test_sync_shed_status_routes_through_conflict_requeue(self):
+        from kubernetes_tpu.core.framework import CycleState, Status
+        s = self._scheduler()
+        p, qpi = self._popped(s)
+        orig = qpi.enqueued_at
+        p.node_name = "n-0"
+        s.cache.assume_pod(p, qpi.pod_info)
+        st = Status.bind_shed("429 TooManyRequests")
+        assert st.shed and not st.conflict
+        fw = next(iter(s.profiles.values()))
+        s._unwind_binding(fw, CycleState(), qpi, "n-0", st)
+        assert s.shed_requeues == 1
+        assert not s.error_log, s.error_log
+        got = s.queue.backoff_q.get(p.uid) or s.queue.active_q.get(p.uid)
+        assert got is qpi and got.enqueued_at == orig

@@ -21,7 +21,10 @@ def _short_workloads():
 def test_short_workload(wl):
     res = run_workload(wl)
     # Every measured pod must land (these configs are satisfiable).
-    assert res.failed == 0 or wl.testcase == "PreemptionAsync"
+    # Preemption testcases legitimately record failed attempts: a
+    # preemptor's first cycle fails while its victims drain.
+    assert res.failed == 0 or wl.testcase in ("PreemptionAsync",
+                                              "PreemptionStorm")
     assert res.scheduled > 0
     assert "SchedulingThroughput" in res.metrics
     # CPU-mode smoke thresholds are intentionally loose; the perf labels run

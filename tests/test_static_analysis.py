@@ -810,6 +810,103 @@ class TestHintFreshnessFixtures:
 
 
 # ---------------------------------------------------------------------------
+# fixture corpus: shed-discipline (overload plane, PR 14)
+# ---------------------------------------------------------------------------
+
+
+BAD_SHED = textwrap.dedent("""
+    class Handler:
+        def do_POST(self):
+            ticket = None
+            with server._write_lock:
+                # admission under the very lock it exists to protect
+                ticket = self._flow_admit("POST")
+                code, obj = self._post_locked()
+            if ticket is None:
+                # 429 with no Retry-After: the shed contract broken
+                self._json(429, {"error": "TooManyRequests"})
+""")
+
+GOOD_SHED = textwrap.dedent("""
+    class Handler:
+        def do_POST(self):
+            ticket = self._flow_admit("POST")
+            if ticket is None:
+                return  # 429 + Retry-After already sent by _flow_admit
+            try:
+                with server._write_lock:
+                    code, obj = self._post_locked()
+            finally:
+                server.flowcontrol.release(ticket)
+            self._json(code, obj)
+
+        def _flow_admit(self, method):
+            ticket = server.flowcontrol.admit("workload", "ns")
+            if ticket is None:
+                self._json(429, {"error": "TooManyRequests"},
+                           retry_after=1)
+            return ticket
+""")
+
+
+class TestShedDisciplineFixtures:
+    def test_flags_shed_violations(self):
+        fs = check_source(checker_by_id("shed-discipline"), BAD_SHED)
+        assert _rules(fs) == ["429-without-retry-after",
+                              "shed-under-write-lock"]
+
+    def test_passes_disciplined_shed_path(self):
+        assert check_source(checker_by_id("shed-discipline"),
+                            GOOD_SHED) == []
+
+    def test_flowcontrol_admit_under_lock_flagged(self):
+        bad = textwrap.dedent("""
+            class Handler:
+                def do_PUT(self):
+                    with server._write_lock:
+                        t = server.flowcontrol.admit("workload", "ns")
+        """)
+        fs = check_source(checker_by_id("shed-discipline"), bad)
+        assert _rules(fs) == ["shed-under-write-lock"]
+
+    def test_unrelated_admit_not_flagged(self):
+        good = textwrap.dedent("""
+            class Handler:
+                def do_PUT(self):
+                    with server._write_lock:
+                        self.gatekeeper.admit(pod)  # not flow control
+        """)
+        assert check_source(checker_by_id("shed-discipline"), good) == []
+
+    def test_retry_after_literal_outside_backoff_flagged(self):
+        """A client module growing its own Retry-After parsing beside the
+        shared backoff stack is the rot this rule exists for."""
+        bad = textwrap.dedent("""
+            def my_retry_loop(call):
+                try:
+                    return call()
+                except Exception as e:
+                    wait = float(e.headers.get("Retry-After", 1))
+                    time.sleep(wait)
+        """)
+        fs = check_source(checker_by_id("shed-discipline"), bad,
+                          path="shard/member.py")
+        assert _rules(fs) == ["retry-after-parse-outside-backoff"]
+
+    def test_retry_after_literal_in_seams_exempt(self):
+        src = 'HEADER = "Retry-After"\n'
+        for seam in ("core/backoff.py", "core/apiserver.py",
+                     "core/flowcontrol.py"):
+            assert check_source(checker_by_id("shed-discipline"), src,
+                                path=seam) == []
+
+    def test_scope(self):
+        c = checker_by_id("shed-discipline")
+        assert c.applies_to("core/apiserver.py")
+        assert c.applies_to("shard/member.py")
+
+
+# ---------------------------------------------------------------------------
 # the tree gate + allowlist policy
 # ---------------------------------------------------------------------------
 
@@ -829,7 +926,8 @@ def test_every_checker_registered_and_described():
     ids = sorted(c.id for c in checkers)
     assert ids == ["hint-freshness", "index-dtype", "jit-purity",
                    "lock-discipline", "metrics-discipline",
-                   "span-discipline", "thread-hygiene", "wire-discipline"]
+                   "shed-discipline", "span-discipline", "thread-hygiene",
+                   "wire-discipline"]
     assert all(c.description for c in checkers)
 
 
