@@ -207,6 +207,17 @@ def main(trace: bool = False):
     n_pods = int(os.environ.get("BENCH_PODS", 10000))
     warmup = int(os.environ.get("BENCH_WARMUP", 1024))
 
+    # BENCH_MESH_DEVICES=N: force an N-device virtual CPU mesh so the
+    # SPMD plane (sharded state + shard_map row-local dispatch) benches
+    # without hardware — the 50k-node SchedulingBasic acceptance shape.
+    # Must land in XLA_FLAGS before any backend init.
+    nd = int(os.environ.get("BENCH_MESH_DEVICES", 0))
+    if nd > 1 and "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={nd}").strip()
+
     platform_note, availability = _ensure_live_backend()
     cs, sched = build_cluster(n_nodes)
 
@@ -260,6 +271,17 @@ def main(trace: bool = False):
     if hasattr(sched, "hint_hits") and scheduled:
         detail["hint_hit_rate"] = round(detail.get("hint_hits", 0)
                                         / scheduled, 4)
+    # Mesh plane: per-step ici/dcn collective counts of the EXACT dispatch
+    # this workload's plan runs (shard_map row-local path vs GSPMD), plus
+    # the shard_map engagement counter — the MULTICHIP rows regression-pin
+    # the collective budget (docs/PERF.md § mesh plane).
+    if getattr(sched, "mesh", None) is not None:
+        detail["shard_map_dispatches"] = sched.shard_map_dispatches
+        try:
+            detail["collectives"] = sched.collective_counts(
+                make_pods(1, "probe")[0])
+        except Exception as e:  # noqa: BLE001 - detail only, never the run
+            detail["collectives"] = {"error": str(e)[:200]}
     # e2e latency detail line (queue admission -> bound; fed from span ends
     # on EVERY bound pod — docs/OBSERVABILITY.md).
     e2e = sched.metrics.e2e_scheduling_duration

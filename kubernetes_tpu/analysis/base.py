@@ -92,7 +92,8 @@ def all_checkers() -> List[Checker]:
     # Import the checker modules for their registration side effect.
     from . import (hint_freshness, index_dtype, jit_purity,  # noqa: F401
                    lock_discipline, metrics_discipline, shed_discipline,
-                   span_discipline, thread_hygiene, wire_discipline)
+                   sharding_discipline, span_discipline, thread_hygiene,
+                   wire_discipline)
     return [cls() for _, cls in sorted(_REGISTRY.items())]
 
 

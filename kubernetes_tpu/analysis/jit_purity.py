@@ -42,9 +42,14 @@ def _is_jit_expr(node: ast.AST) -> bool:
 
 
 def _jit_wrap_target(call: ast.Call) -> Optional[str]:
-    """For `jax.jit(fn, ...)` / `pjit(fn, ...)`: the wrapped function name."""
-    if (_is_jit_expr(call.func) and call.args
-            and isinstance(call.args[0], ast.Name)):
+    """For `jax.jit(fn, ...)` / `pjit(fn, ...)` / `shard_map(fn, ...)`:
+    the wrapped function name. shard_map BODIES run under trace exactly
+    like jitted functions (ISSUE 15: the explicit mesh lap kernel), so
+    they join the purity scan scope — and, transitively, index-dtype's."""
+    chain = attr_chain(call.func)
+    is_wrap = (_is_jit_expr(call.func)
+               or (bool(chain) and chain[-1] == "shard_map"))
+    if is_wrap and call.args and isinstance(call.args[0], ast.Name):
         return call.args[0].id
     return None
 

@@ -1,0 +1,103 @@
+"""sharding-discipline checker: jits that touch sharded state pin their
+shardings.
+
+Incident class (ISSUE 15, the mesh-first device plane): a mesh session's
+kernel trace keys on its inputs' COMMITTED placements. Every jit that
+rewrites a piece of sharded session state — the dirty-row scatter, the
+carry patch — must pin ``out_shardings`` (and/or ``in_shardings``) to the
+session's committed shardings, or XLA hands back GSPMD-chosen placements:
+everything still computes correctly, every test still passes, and the next
+dispatch silently RETRACES the session kernel (~1 min of XLA compile inside
+the measured window per occurrence). That placement-drift-then-retrace
+failure mode is exactly what kept mesh sessions on the full-rebuild path
+before the pinned patch seam landed (ops/device_state.py _sharded_scatter,
+ops/kernel.py patch_carry_rows_pinned).
+
+Rule (``bare-jit-on-sharded-state``): inside the sharded seam — any
+function that takes a ``sharded_state``/``out_shardings`` parameter, or
+that passes ``sharded_state=`` to a callee — a ``jax.jit``/``jit``/
+``pjit`` call must carry an ``out_shardings`` or ``in_shardings`` keyword.
+jits wrapping a ``shard_map(...)`` expression are exempt: shard_map's
+in/out_specs ARE the pinned placement. (shard_map BODIES additionally join
+the jit-purity and index-dtype scan scopes — enforced by those checkers
+via jit_purity.jit_reachable_functions recognizing shard_map wrapping.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import Checker, Finding, ModuleSource, attr_chain, register
+
+SEAM_PARAMS = frozenset({"sharded_state", "out_shardings", "in_shardings"})
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    chain = attr_chain(node.func)
+    return bool(chain) and chain[-1] in ("jit", "pjit")
+
+
+def _wraps_shard_map(node: ast.Call) -> bool:
+    """jax.jit(shard_map(...), ...): the specs pin the placement."""
+    if not node.args:
+        return False
+    a0 = node.args[0]
+    if isinstance(a0, ast.Call):
+        chain = attr_chain(a0.func)
+        return bool(chain) and chain[-1] == "shard_map"
+    return False
+
+
+def _in_sharded_seam(fn: ast.FunctionDef) -> bool:
+    """The function's signature or body handles sharded session state."""
+    args = fn.args
+    names = {a.arg for a in (args.args + args.kwonlyargs
+                             + args.posonlyargs)}
+    if names & SEAM_PARAMS:
+        return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "sharded_state":
+                    return True
+    return False
+
+
+@register
+class ShardingDisciplineChecker(Checker):
+    id = "sharding-discipline"
+    description = ("any jit compiled against sharded session state must "
+                   "pin out_shardings/in_shardings (or wrap a shard_map) — "
+                   "an unpinned jit hands back GSPMD-chosen placements and "
+                   "the session kernel silently retraces on next dispatch")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(("ops/", "parallel/", "models/"))
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        out: List[Finding] = []
+        if mod.tree is None:
+            return out
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            if not _in_sharded_seam(fn):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or not _is_jit_call(node):
+                    continue
+                if _wraps_shard_map(node):
+                    continue
+                kws = {kw.arg for kw in node.keywords}
+                if kws & {"out_shardings", "in_shardings"}:
+                    continue
+                out.append(Finding(
+                    self.id, "bare-jit-on-sharded-state", mod.path,
+                    node.lineno,
+                    "bare jax.jit inside the sharded-state seam "
+                    f"(function {fn.name!r} handles sharded_state/"
+                    "out_shardings) — pin out_shardings/in_shardings to "
+                    "the session's committed placement or the next "
+                    "dispatch retraces the session kernel"))
+        return out

@@ -322,8 +322,11 @@ class SchedulerMetrics:
             "scheduler_plan_rebuild_total",
             "Device-session plan acquisitions, by kind: 'full' = complete "
             "snapshot→features rebuild, 'resume' = untouched cache hit, "
-            "'delta' = journal-driven row patch of a live plan+carry.",
-            ("kind",)))
+            "'delta' = journal-driven row patch of a live plan+carry; "
+            "'plane' splits mesh (sharded) sessions from single-device — "
+            "a mesh 'full' tears down and re-uploads the whole sharded "
+            "state, the cost the delta patches exist to avoid.",
+            ("kind", "plane")))
         self.plan_rebuild_dirty_rows = r(Counter(
             "scheduler_plan_rebuild_dirty_rows_total",
             "Node rows re-encoded + scattered by delta plan patches.", ()))

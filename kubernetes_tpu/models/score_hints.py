@@ -62,6 +62,10 @@ import numpy as np
 from ..api.types import PREFER_NO_SCHEDULE
 from ..core.cache import (EV_NAMESPACE, EV_NODE_UPDATE, EV_POD_ADD,
                           EV_POD_REMOVE, EV_POD_UPDATE, EV_QUEUE)
+# The kernel's own lap bound: windows of consecutive pods are disjoint
+# until the rotation laps the cluster — ONE shared constant, so the host
+# walk's batching can never silently diverge from the device lap's.
+from ..ops.kernel import LAP_MAX as _LAP_MAX
 
 MAX_NODE_SCORE = 100
 _BA_SCALE = 1_000_000
@@ -72,11 +76,15 @@ def hint_eligible(plan, mesh, aux_shape, head_pod, extenders,
     """Can a clean session of this shape seed a score hint? Mirrors the
     kernel's scores_carried ∧ incremental_feas preconditions (the walk
     replicates exactly that fast path) plus the host-side state the walk
-    does not model: counted claims, extenders, nominated lanes, sharded
-    meshes, and any live affinity-term pod (cluster-wide disable — the
-    0→1 transition mirrors the watch plane's selector gate)."""
-    return (mesh is None
-            and plan.pod_local
+    does not model: counted claims, extenders, nominated lanes, and any
+    live affinity-term pod (cluster-wide disable — the 0→1 transition
+    mirrors the watch plane's selector gate). Mesh sessions are eligible
+    too (ROADMAP 12d): the install fetches the per-node aggregates/score
+    vector from the SHARDED carry via one device→host gather at clean
+    session end — sharded and single-device carries are bit-identical
+    (integer arithmetic), so the walk stays oracle-exact."""
+    del mesh  # sharded carries install through the same gather
+    return (plan.pod_local
             and not (plan.has_pns or plan.has_ipa_base or plan.has_na_pref
                      or plan.port_selfblock or plan.has_aux or plan.has_nom)
             and aux_shape == (None, None)
@@ -106,6 +114,12 @@ class HintEntry:
         # scalar-slot interning view (read-only; a slot the map lacks
         # cannot affect this plan — its request is zero)
         "scalar_slots",
+        # batched-walk state (ROADMAP 12a): precomputed (row, evaluated,
+        # expected_start) placements for the rest of the current LAP —
+        # adaptive-sampling windows of consecutive pods are disjoint, so
+        # one cumsum serves up to total_feas//to_find pods. Any row
+        # mutation that is NOT the served head's own apply() clears it.
+        "_pending", "lap_enabled", "lap_walks",
     )
 
     # -- construction -------------------------------------------------------
@@ -145,13 +159,20 @@ class HintEntry:
         e.tolerates_unsched = int(np.asarray(f.tolerates_unsched))
         e.enable = tuple(int(x) for x in np.asarray(f.enable))
         e.scalar_slots = mirror.scalar_slots
-        # per-node dynamic state: the carry's own arrays (post-commit truth)
-        e.req_r = np.asarray(carry.req_r).astype(np.int64).copy()
-        e.nonzero = np.asarray(carry.nonzero).astype(np.int64).copy()
-        e.pod_count = np.asarray(carry.pod_count).astype(np.int64).copy()
-        e.fit_ok = np.asarray(carry.fit_ok).astype(bool).copy()
-        e.fit_sc = np.asarray(carry.fit_sc).astype(np.int64).copy()
-        e.ba = np.asarray(carry.ba).astype(np.int64).copy()
+        # per-node dynamic state: the carry's own arrays (post-commit
+        # truth). ONE device→host gather for all six lanes — under a mesh
+        # the carry is sharded across chips and per-leaf np.asarray would
+        # pay a separate cross-device gather each (ROADMAP 12d).
+        import jax
+        req_r, nonzero, pod_count, fit_ok, fit_sc, ba = jax.device_get(
+            (carry.req_r, carry.nonzero, carry.pod_count,
+             carry.fit_ok, carry.fit_sc, carry.ba))
+        e.req_r = np.asarray(req_r).astype(np.int64).copy()
+        e.nonzero = np.asarray(nonzero).astype(np.int64).copy()
+        e.pod_count = np.asarray(pod_count).astype(np.int64).copy()
+        e.fit_ok = np.asarray(fit_ok).astype(bool).copy()
+        e.fit_sc = np.asarray(fit_sc).astype(np.int64).copy()
+        e.ba = np.asarray(ba).astype(np.int64).copy()
         # per-node static state (mirror staging is in line after adopt())
         e.alloc_r = mirror.h_alloc_r.astype(np.int64).copy()
         e.alloc_pods = mirror.h_alloc_pods.astype(np.int64).copy()
@@ -173,6 +194,10 @@ class HintEntry:
         e.attempts = sched.attempts
         e.unwinds = sched.state_unwinds
         e.nom_version = sched.queue.nominator.version
+        import os
+        e._pending = []
+        e.lap_enabled = os.environ.get("TPU_SCHED_HINT_LAP", "1") != "0"
+        e.lap_walks = 0
         return e
 
     def sel_ok_effective(self) -> np.ndarray:
@@ -209,9 +234,24 @@ class HintEntry:
     def select(self, start: int) -> Tuple[int, int]:
         """One pod's selection against the current walk state: returns
         (row or -1, evaluated) where `evaluated` advances the rotation
-        exactly as the kernel's window-boundary reduction does."""
+        exactly as the kernel's window-boundary reduction does.
+
+        Batched walk (ROADMAP 12a): when adaptive-sampling truncation is
+        live (total_feas // to_find >= 2), consecutive pods examine
+        DISJOINT windows — the kernel's own lap-vectorization fact
+        (ops/kernel.py _lap_schedule) — so ONE cumsum pass segments up to
+        a lap of placements and the per-pod cost drops to ~1/L of a full
+        walk (the per-pod numpy cumsum over np_cap rows was ~200µs/pod at
+        5k nodes). Served placements pop off `_pending`; any row mutation
+        other than the served head's own apply() clears it. Bit-exact:
+        window w's selection reads only rows later windows never touch."""
         num, NP, to_find = self.num, self.NP, self.to_find
         start = start % num
+        if self._pending:
+            if self._pending[0][2] == start:
+                row, evaluated, _ = self._pending.pop(0)
+                return row, evaluated
+            self._pending = []  # rotation moved outside the walk: recompute
         ok = self.ok
         F = np.cumsum(ok, dtype=np.int64)
         total_feas = int(F[-1])
@@ -220,19 +260,69 @@ class HintEntry:
         rank = np.where(idx >= start, F - f_start,
                         F + total_feas - f_start)
         rot = (idx - start) % num
+        if total_feas:
+            # Lap attempt FIRST: when it serves, the single-pod boundary
+            # reduction below is never needed (the lap carries its own
+            # per-window evaluated values).
+            tf = max(to_find, 1)
+            L = min(total_feas // tf, _LAP_MAX)
+            if self.lap_enabled and L >= 2:
+                got = self._lap_select(start, ok, rank, rot, int(L), tf,
+                                       num, NP)
+                if got is not None:
+                    return got
         boundary = ok & (rank == to_find)
         mx = int(np.max(np.where(boundary, num - 1 - rot, 0))) \
             if NP else 0
         evaluated = num - mx
-        kept = ok & (rank <= to_find)
         if not total_feas:
             return -1, evaluated
+        kept = ok & (rank <= to_find)
         key = np.where(kept, self.total * NP + (NP - 1 - rot), -1)
         best = int(key.max())
         if best < 0:
             return -1, evaluated
         chosen_rot = (NP - 1) - (best % NP)
         return (start + chosen_rot) % num, evaluated
+
+    def _lap_select(self, start, ok, rank, rot, L, tf, num, NP):
+        """Segment the feasible rotation into L disjoint sampling windows
+        (window w = feasible ranks (w·tf, (w+1)·tf]) and pick each
+        window's max-score-then-min-rotation key in ONE vectorized pass —
+        the numpy restatement of the kernel lap's segmented argmax. Every
+        window holds exactly tf feasible rows, so its boundary row
+        (rank == (w+1)·tf) exists and the per-window `evaluated` is the
+        boundary-to-boundary rotation span, exactly the scan's per-pod
+        advance. Returns the first (row, evaluated) and stashes the rest
+        on `_pending`, or None to fall back to the single-pod path."""
+        key = np.where(ok, self.total * NP + (NP - 1 - rot), -1)
+        w = np.zeros_like(rank)
+        np.floor_divide(rank - 1, tf, out=w, where=ok)
+        sel = ok & (w < L)
+        best = np.full(L, -1, np.int64)
+        np.maximum.at(best, w[sel], key[sel])
+        is_b = ok & (rank % tf == 0) & (rank >= tf) & (rank // tf <= L)
+        # Sentinel num+1: a genuine boundary at the LAST rotation slot is
+        # ev == num (the scan's evaluated=num full-wrap case) and must be
+        # kept; only a truly boundary-less window exceeds it.
+        ev_abs = np.full(L, num + 1, np.int64)
+        np.minimum.at(ev_abs, rank[is_b] // tf - 1, rot[is_b] + 1)
+        entries = []
+        cur, prev_abs = start, 0
+        for wi in range(L):
+            k = int(best[wi])
+            if k < 0 or ev_abs[wi] > num:
+                break  # defensive: empty / unbounded window ends the lap
+            row = (start + (NP - 1 - k % NP)) % num
+            entries.append((int(row), int(ev_abs[wi]) - prev_abs, cur))
+            prev_abs = int(ev_abs[wi])
+            cur = (start + prev_abs) % num
+        if not entries:
+            return None
+        self.lap_walks += 1
+        row, evaluated, _ = entries.pop(0)
+        self._pending = entries
+        return row, evaluated
 
     def apply(self, row: int) -> None:
         """Commit one placement into the walk state (the scan's carry
@@ -303,6 +393,7 @@ class HintEntry:
             return False
         self.blocked[row] = True
         self.ok[row] = False
+        self._pending = []  # feasibility shrank outside the walk
         return True
 
     def _resource_vec(self, r) -> np.ndarray:
@@ -330,6 +421,7 @@ class HintEntry:
         self.pod_count[row] = len(ni.pods)
         self.blocked[row] = False  # post-conflict truth re-read
         self._reval_row(row)
+        self._pending = []  # a row moved outside the walk: re-segment
         return None
 
     def _revalidate_node_row(self, cache, key: str) -> Optional[str]:
@@ -360,6 +452,7 @@ class HintEntry:
         self.alloc_r[row] = self._resource_vec(ni.allocatable)
         self.alloc_pods[row] = ni.allocatable.allowed_pod_number
         self._reval_row(row)
+        self._pending = []  # a row moved outside the walk: re-segment
         return None
 
     def consume(self, sched, events) -> Optional[str]:

@@ -115,9 +115,17 @@ class TPUScheduler(Scheduler):
             self.mesh = mesh  # explicit Mesh, or None to force single-device
         self.mirror = NodeStateMirror()
         self._holdover: Optional[QueuedPodInfo] = None
+        # Explicit shard_map dispatch for row-local plans under a mesh
+        # (parallel/mesh.py sharded_lap_schedule): cross-shard collectives
+        # are hand-placed and minimal instead of GSPMD-inferred.
+        # TPU_SCHED_SHARD_MAP=0 pins the GSPMD path (the A/B seam).
+        import os as _os2
+        self._shard_map_enabled = (
+            _os2.environ.get("TPU_SCHED_SHARD_MAP", "1") != "0")
         # metrics
         self.device_batches = 0
         self.device_scheduled = 0
+        self.shard_map_dispatches = 0
         self.host_path_pods = 0
         # Plan acquisition attribution (scheduler_plan_rebuild_total):
         # full = snapshot→features rebuild, resume = untouched cache hit,
@@ -659,10 +667,7 @@ class TPUScheduler(Scheduler):
             plan = cache[1]
             self.cache.update_snapshot(self.snapshot)
             self.mirror.sync(self.snapshot.node_info_list)
-            state = self.mirror.flush()
-            if self.mesh is not None:
-                from ..parallel import shard_node_state
-                state = shard_node_state(state, self.mesh)
+            state = self.mirror.flush()  # resident stays mesh-committed
         else:
             try:
                 state, plan = self.build_plan(fw, p0, len(members))
@@ -1065,8 +1070,19 @@ class TPUScheduler(Scheduler):
     def build_plan(self, fw: Framework, pod, batch_size: int):
         """Snapshot → mirror sync → batch feature build → device flush.
         Returns (device_state, BatchPlan). Also the graft/bench entry's way
-        to produce kernel inputs."""
+        to produce kernel inputs.
+
+        Mesh-first: under a mesh the mirror's RESIDENT copy is committed to
+        mesh_state_shardings, so flush() uploads host staging straight to
+        the sharded placement and later dirty scatters / delta patches ride
+        pinned jits on the resident itself — no per-session single-device
+        copy + device_put round-trip of the whole state."""
         self.cache.update_snapshot(self.snapshot)
+        if self.mesh is not None:
+            from ..parallel import mesh_state_shardings
+            self.mirror.commit_shardings(mesh_state_shardings(self.mesh))
+        else:
+            self.mirror.commit_shardings(None)
         self.mirror.sync(self.snapshot.node_info_list)
         ipa = fw.plugin("InterPodAffinity")
         dra_enabled, dra_in_use = self._dra_ctx(fw)
@@ -1094,10 +1110,9 @@ class TPUScheduler(Scheduler):
             dra_in_use=dra_in_use,
             nominated=self._nominated_lane(pod),
         )
-        state = self.mirror.flush()
+        state = self.mirror.flush()  # committed to the mesh placement
         if self.mesh is not None:
-            from ..parallel import shard_features, shard_node_state
-            state = shard_node_state(state, self.mesh)
+            from ..parallel import shard_features
             plan.features = shard_features(plan.features, self.mesh)
         return state, plan
 
@@ -1121,9 +1136,25 @@ class TPUScheduler(Scheduler):
                            fit_plugin=fw.plugin("NodeResourcesFit")) is not None:
             return
         state, plan = self.build_plan(fw, pod, self.max_batch)
+        # Warm dispatches must ride _dispatch (call-path identity) but must
+        # not count as engagement: shard_map_dispatches is what the bench
+        # detail and the MULTICHIP dryrun assert LIVE dispatches against.
+        _smd0 = self.shard_map_dispatches
         results, carry = self._dispatch(state, plan, 0, None)
         results2, _ = self._dispatch(state, plan, 0, carry)
         np.asarray(results2)  # block until compiled + executed
+        self.shard_map_dispatches = _smd0
+        if self._shard_map_fn(plan) is not None:
+            # The live dispatch rides the shard_map lap path — but a
+            # mid-workload row_local flip (an anti-affinity pod lands and
+            # exist_anti goes nonzero, or a node-tier regrow breaks shard
+            # divisibility) drops later sessions onto the GSPMD
+            # schedule_batch fallback. Warm that trace too, or the flip
+            # puts its ~1min XLA compile inside the measured window (the
+            # same hazard as the anti_rowlocal fallback below).
+            r1, c1 = self._gspmd_dispatch(state, plan, 0, None)
+            r2, _ = self._gspmd_dispatch(state, plan, 0, c1)
+            np.asarray(r2)
         if plan.anti_rowlocal:
             # anti_rowlocal is topology-derived (all anti axes singleton) and
             # can flip to False mid-workload (e.g. churn adds a node sharing a
@@ -1198,13 +1229,44 @@ class TPUScheduler(Scheduler):
             spread_overrides=overrides)
         np.asarray(res)
 
+    def _shard_map_fn(self, plan):
+        """The explicit-collectives lap kernel for this plan, or None when
+        the GSPMD-compiled schedule_batch owns the dispatch. Row-local
+        plans (BatchPlan.row_local) at production batch tiers ride
+        shard_map: per-shard work is provably local and the per-lap
+        collectives are two small exchanges (vs GSPMD's inferred ~2×
+        count, MULTICHIP_r05). Small batches keep the scan path — the lap
+        gains nothing there (ops/kernel.py static_scores threshold)."""
+        if (self.mesh is None or not self._shard_map_enabled
+                or not plan.row_local or plan.batch_pad <= 64):
+            return None
+        from ..parallel.mesh import mesh_shard_count, sharded_lap_schedule
+        if self.mirror.np_cap % mesh_shard_count(self.mesh):
+            return None  # node tier not divisible across shards
+        return sharded_lap_schedule(self.mesh, plan.batch_pad,
+                                    plan.fit_strategy, plan.vmax)
+
     def _dispatch(self, state, plan, n_active: int, carry):
-        """The ONLY schedule_batch call site. Every dispatch — warm or live —
-        must be call-signature-identical (kwarg set included: static kwargs
-        are part of jit's cache-key pytree structure), or the warmed trace
-        misses and a ~1min XLA compile lands inside the measured window."""
+        """The ONLY kernel call site. Every dispatch — warm or live — must
+        be call-signature-identical (kwarg set included: static kwargs are
+        part of jit's cache-key pytree structure), or the warmed trace
+        misses and a ~1min XLA compile lands inside the measured window.
+        The path choice (shard_map lap vs GSPMD schedule_batch) is a pure
+        function of (mesh, plan statics), so it is constant for a
+        session's lifetime and warm_for warms the same path the live
+        session runs."""
         if self._fault_hook is not None:
             self._fault_hook("dispatch")
+        fn = self._shard_map_fn(plan)
+        if fn is not None:
+            self.shard_map_dispatches += 1
+            return fn(state, plan.features, np.int32(n_active), carry)
+        return self._gspmd_dispatch(state, plan, n_active, carry)
+
+    def _gspmd_dispatch(self, state, plan, n_active: int, carry):
+        """The GSPMD-compiled schedule_batch call — one kwargs set shared
+        by the live fallback dispatch and warm_for's fallback warming (a
+        differing kwarg pytree would be a separate jit cache entry)."""
         return schedule_batch(
             state, plan.features, plan.batch_pad, plan.fit_strategy,
             plan.vmax, n_active=np.int32(n_active), carry_in=carry,
@@ -1212,6 +1274,38 @@ class TPUScheduler(Scheduler):
             anti_rowlocal=plan.anti_rowlocal, has_na_pref=plan.has_na_pref,
             port_selfblock=plan.port_selfblock, has_aux=plan.has_aux,
             has_nom=plan.has_nom)
+
+    def collective_counts(self, pod, batch_size: Optional[int] = None):
+        """Compile-time per-step collective profile of the EXACT dispatch a
+        `pod`-shaped session runs (ici/dcn split via
+        parallel/mesh.py collective_report), or None off-mesh. This is the
+        number the MULTICHIP rows regression-pin: the row-local shard_map
+        path must stay at-or-below the GSPMD baseline per step."""
+        if self.mesh is None:
+            return None
+        from ..parallel.mesh import collective_report, mesh_host_split
+        fw = self.framework_for_pod(pod)
+        bs = batch_size or self.max_batch
+        state, plan = self.build_plan(fw, pod, bs)
+        fn = self._shard_map_fn(plan)
+        if fn is not None:
+            lowered = fn.lower(state, plan.features, np.int32(bs), None)
+            path = "shard_map"
+        else:
+            lowered = schedule_batch.lower(
+                state, plan.features, plan.batch_pad, plan.fit_strategy,
+                plan.vmax, n_active=np.int32(bs), carry_in=None,
+                has_pns=plan.has_pns, has_ipa_base=plan.has_ipa_base,
+                anti_rowlocal=plan.anti_rowlocal,
+                has_na_pref=plan.has_na_pref,
+                port_selfblock=plan.port_selfblock, has_aux=plan.has_aux,
+                has_nom=plan.has_nom)
+            path = "gspmd"
+        n_hosts, per_host = mesh_host_split(self.mesh)
+        report = collective_report(lowered.compile().as_text(),
+                                   n_hosts, per_host)
+        report["path"] = path
+        return report
 
     # -- device session ----------------------------------------------------
     #
@@ -1251,7 +1345,11 @@ class TPUScheduler(Scheduler):
             self.plan_rebuilds_delta += 1
         else:
             self.plan_rebuilds_resume += 1
-        self.metrics.plan_rebuild_total.inc(kind)
+        # plane label: mesh full rebuilds are the cost the delta patches
+        # exist to avoid (a sharded teardown re-uploads the whole state) —
+        # the MULTICHIP rows regression-pin the split.
+        self.metrics.plan_rebuild_total.inc(
+            kind, "mesh" if self.mesh is not None else "single")
 
     def _neutral_sig(self, fw: Framework, pod, sig):
         """Namespace/label-erased session signature, or None when ineligible.
@@ -1353,7 +1451,7 @@ class TPUScheduler(Scheduler):
         cls = self._classify_delta(events, plan)
         if cls is None:
             return False
-        level, names, node_only, pod_only = cls
+        level, names, _node_only, pod_only = cls
         if not names:
             sd.start_seq = self.cluster_event_seq
             sd.patch_pending = False
@@ -1376,8 +1474,7 @@ class TPUScheduler(Scheduler):
                 # (_SessionDelta.busy_patch_rows) so session-end adoption
                 # re-encodes them from post-commit truth.
                 patched = self._apply_delta_patch(
-                    plan, node_names, names, sd.state, sd.carry,
-                    node_only=node_only)
+                    plan, node_names, names, sd.state, sd.carry, busy=True)
                 if patched is not None:
                     sd.state, sd.carry = patched
                     row_of = self._session_row_of[1]
@@ -1395,8 +1492,7 @@ class TPUScheduler(Scheduler):
             sd.patch_pending = True
             return True
         patched = self._apply_delta_patch(
-            plan, node_names, names, sd.state, sd.carry,
-            node_only=node_only)
+            plan, node_names, names, sd.state, sd.carry)
         if patched is None:
             return False
         sd.state, sd.carry = patched
@@ -1406,23 +1502,23 @@ class TPUScheduler(Scheduler):
         return True
 
     def _apply_delta_patch(self, plan, node_names, names, state, carry,
-                           node_only: bool = False):
+                           busy: bool = False):
         """Patch the journal's dirty rows into mirror staging, the resident
         device state, and the session carry. Returns (state, carry) or None
         when the patch can't apply — the caller's full-rebuild fallback
         recovers from every None.
 
-        Mesh sessions patch too, for taint/alloc NODE updates only (the
-        ROADMAP's scoped re-enable): the row scatter and the carry re-eval
-        run through jits pinned to the session's committed shardings
+        Mesh sessions patch EVERY classifiable kind — POD-event aggregates
+        (pod_add/pod_remove/pod_update) included, the events that dominate
+        churn workloads: the row scatter and the carry re-eval run through
+        jits pinned to the session's committed shardings
         (mesh_state_shardings / patch_carry_rows_pinned), so the patched
-        pytrees keep the exact placement the session kernel's traces key on.
-        Pod events still decline under a mesh — their aggregates also ride
-        the adopt/donate seam, which has no sharded variant yet."""
+        pytrees keep the exact placement the session kernel's traces key
+        on, and the stale state/carry buffers are DONATED into the patch
+        jits (reused in place) when no dispatched batch still reads them
+        (`busy`)."""
         if not names:
             return state, carry
-        if self.mesh is not None and not node_only:
-            return None  # pod-event patches: full (sharded) rebuild path
         row_of = getattr(self, "_session_row_of", None)
         if row_of is None or row_of[0] is not node_names:
             row_of = (node_names, {n: i for i, n in enumerate(node_names)})
@@ -1438,7 +1534,8 @@ class TPUScheduler(Scheduler):
             from ..parallel import mesh_state_shardings
             new_state = self.mirror.patch_rows(
                 updates, sharded_state=state,
-                out_shardings=mesh_state_shardings(self.mesh))
+                out_shardings=mesh_state_shardings(self.mesh),
+                donate=not busy)
         else:
             new_state = self.mirror.patch_rows(updates)
         if new_state is None:
@@ -1500,8 +1597,7 @@ class TPUScheduler(Scheduler):
                         # No pipeline is in flight at session start: every
                         # level (benign/safe/strict) may patch here.
                         patched = self._apply_delta_patch(
-                            plan, node_names, cls[1], state, carry,
-                            node_only=cls[2])
+                            plan, node_names, cls[1], state, carry)
                         if patched is not None:
                             state, carry = patched
                             kind = "delta"

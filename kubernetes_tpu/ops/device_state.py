@@ -137,21 +137,30 @@ def _scatter_rows_impl(state: DeviceNodeState, idx, rows: DeviceNodeState) -> De
 
 _scatter_rows = jax.jit(_scatter_rows_impl)
 
-# Mesh variant: one jitted scatter per out_shardings pytree (one per mesh —
+# Mesh variant: one jitted scatter per (out_shardings pytree, donation) —
 # parallel/mesh.py mesh_state_shardings caches the pytree, NamedSharding
-# hashes, so the pytree itself is the cache key).
+# hashes, so the pytree itself is the cache key.
 _SHARDED_SCATTER_CACHE: dict = {}
 
 
-def _sharded_scatter(out_shardings):
+def _sharded_scatter(out_shardings, donate: bool = False):
     """_scatter_rows with explicit out_shardings: a mesh session's state is
-    committed to shard_node_state's placement and the session kernel's jit
-    keys on those input shardings — an unconstrained scatter would hand
-    back GSPMD-chosen placements and retrace the kernel on next dispatch."""
-    fn = _SHARDED_SCATTER_CACHE.get(out_shardings)
+    committed to the mirror's placement and the session kernel's jit keys
+    on those input shardings — an unconstrained scatter would hand back
+    GSPMD-chosen placements and retrace the kernel on next dispatch.
+
+    ``donate=True`` additionally donates the OLD state buffers into the
+    scatter (the session patch seam): the patched state replaces the old
+    one in-place on device instead of allocating a full sharded copy per
+    patch wave. Callers must rebind every live reference to the returned
+    pytree — the mirror resident and the session's _SessionDelta.state are
+    the only two, both rebound at the patch_rows call site."""
+    key = (out_shardings, donate)
+    fn = _SHARDED_SCATTER_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(_scatter_rows_impl, out_shardings=out_shardings)
-        _SHARDED_SCATTER_CACHE[out_shardings] = fn
+        fn = jax.jit(_scatter_rows_impl, out_shardings=out_shardings,
+                     donate_argnums=(0,) if donate else ())
+        _SHARDED_SCATTER_CACHE[key] = fn
     return fn
 
 
@@ -186,6 +195,13 @@ class NodeStateMirror:
         self._dirty: set = set()
         self._full_flush = True
         self._device: Optional[DeviceNodeState] = None
+        # Shardings the resident device copy is COMMITTED to (None =
+        # single-device). Under a mesh, flush() uploads host staging
+        # straight to the sharded placement and dirty-row scatters ride a
+        # jit pinned to these shardings — the sharded state IS the resident
+        # (mesh-first), not a per-session device_put round-trip of a
+        # single-device copy.
+        self._shardings = None
         self.num_nodes = 0
 
     # -- storage -----------------------------------------------------------
@@ -352,34 +368,59 @@ class NodeStateMirror:
             jnp.asarray(self.h_topo[:, dirty]))
         return idx, rows
 
+    def commit_shardings(self, out_shardings) -> None:
+        """Commit the resident device copy to these NamedShardings (None =
+        single-device). Called by build_plan before sync/flush; a changed
+        commitment forces a full re-upload at the new placement. Identity
+        comparison is exact: parallel/mesh.py mesh_state_shardings caches
+        one pytree per mesh."""
+        if out_shardings is not self._shardings:
+            self._shardings = out_shardings
+            self._device = None
+            self._full_flush = True
+
+    def _upload(self) -> DeviceNodeState:
+        """Full host→device upload of staging, straight to the committed
+        placement (one transfer per array; no intermediate single-device
+        copy when sharded)."""
+        if self._shardings is None:
+            return DeviceNodeState(
+                *[jnp.asarray(a) for a in self._arrays()],
+                jnp.asarray(self.h_topo))
+        return DeviceNodeState(
+            *[jax.device_put(a, s) for a, s in
+              zip(self._arrays() + (self.h_topo,), self._shardings)])
+
+    def _resident_deleted(self) -> bool:
+        """True when the resident arrays came from a session carry (adopt)
+        that was later DONATED back to the kernel or a patch jit. adopt and
+        the patch seam keep host staging in line, so a full upload from
+        staging reproduces the exact device truth."""
+        if self._device is None:
+            return False
+        try:
+            return self._device.req_r.is_deleted()
+        except AttributeError:
+            return False
+
     def _scatter_dirty(self, dirty) -> DeviceNodeState:
         """Scatter the given staging rows into the resident device state."""
         idx, rows = self._dirty_payload(dirty)
+        if self._shardings is not None:
+            return _sharded_scatter(self._shardings)(self._device, idx, rows)
         return _scatter_rows(self._device, idx, rows)
 
     def flush(self) -> DeviceNodeState:
-        """Upload pending changes; returns the device pytree. Scatter when the
-        dirty fraction is small, full device_put otherwise."""
-        if self._device is not None and not self._full_flush:
-            try:
-                deleted = self._device.req_r.is_deleted()
-            except AttributeError:
-                deleted = False
-            if deleted:
-                # The resident arrays came from a session carry (adopt) that
-                # was later DONATED back to the kernel (session resume).
-                # adopt kept the host staging in line, so a full upload from
-                # staging reproduces the exact device truth.
-                self._full_flush = True
+        """Upload pending changes; returns the device pytree (committed to
+        `commit_shardings`' placement). Scatter when the dirty fraction is
+        small, full upload otherwise."""
+        if not self._full_flush and self._resident_deleted():
+            self._full_flush = True
         if self._device is None or self._full_flush:
-            self._device = DeviceNodeState(
-                *[jnp.asarray(a) for a in self._arrays()], jnp.asarray(self.h_topo)
-            )
+            self._device = self._upload()
         elif self._dirty:
             if len(self._dirty) > self.scatter_threshold * self.np_cap:
-                self._device = DeviceNodeState(
-                    *[jnp.asarray(a) for a in self._arrays()], jnp.asarray(self.h_topo)
-                )
+                self._device = self._upload()
             else:
                 self._device = self._scatter_dirty(sorted(self._dirty))
         self._dirty.clear()
@@ -388,7 +429,8 @@ class NodeStateMirror:
 
 
     def patch_rows(self, updates, sharded_state=None,
-                   out_shardings=None) -> Optional[DeviceNodeState]:
+                   out_shardings=None,
+                   donate: bool = True) -> Optional[DeviceNodeState]:
         """Event-delta row flush: re-encode the given (row, NodeInfo) pairs
         from the LIVE cache NodeInfos and scatter them into the resident
         device state WITHOUT a snapshot refresh — the journal-driven
@@ -399,12 +441,20 @@ class NodeStateMirror:
         to the full rebuild path, which recovers from every one of those.
 
         Mesh sessions pass `sharded_state` (their mesh-committed state) plus
-        `out_shardings` (parallel/mesh.py mesh_state_shardings): the same
-        dirty rows then also scatter into the sharded copy through a jit
-        pinned to those shardings, and THAT is what's returned — the
-        mirror's own resident (single-device) copy stays patched in line
-        either way, so later unsharded flushes remain incremental."""
+        `out_shardings` (parallel/mesh.py mesh_state_shardings): the dirty
+        rows scatter through a jit pinned to those shardings, so the
+        patched pytree keeps the exact placement the session kernel's
+        traces key on. When the session state IS the mirror's resident
+        (the mesh-first steady state — build_plan commits the resident to
+        the mesh placement), ONE donated scatter updates both: the old
+        buffers are donated into the patch jit and every live reference
+        (resident + _SessionDelta.state) is rebound to the result."""
         if self._device is None or self._full_flush:
+            return None
+        if self._resident_deleted():
+            # The resident was donated back to a kernel/patch jit (session
+            # resume chain); staging is authoritative — full upload path.
+            self._full_flush = True
             return None
         # Validate EVERY row before encoding ANY: a late-row guard failure
         # after earlier rows hit staging would leave those rows encoded with
@@ -424,7 +474,18 @@ class NodeStateMirror:
             return None  # staging reset: next flush rebuilds everything
         dirty = sorted({row for row, _ in updates})
         idx, rows = self._dirty_payload(dirty)
-        self._device = _scatter_rows(self._device, idx, rows)
+        if sharded_state is not None and sharded_state is self._device:
+            # Mesh-first steady state: session state == resident. One
+            # pinned scatter patches it — DONATED (in-place buffer reuse)
+            # unless the caller's dispatch pipeline still holds in-flight
+            # reads of the old state (`donate=False`, the busy-patch seam).
+            self._device = _sharded_scatter(out_shardings, donate=donate)(
+                sharded_state, idx, rows)
+            self._dirty.difference_update(dirty)
+            return self._device
+        self._device = (_sharded_scatter(self._shardings)(
+            self._device, idx, rows) if self._shardings is not None
+            else _scatter_rows(self._device, idx, rows))
         self._dirty.difference_update(dirty)
         if sharded_state is not None:
             return _sharded_scatter(out_shardings)(sharded_state, idx, rows)

@@ -185,6 +185,19 @@ class BatchPlan:
     # ONLY row n's resource aggregates — the precondition for the event-
     # journal delta patch (models/tpu_scheduler.py _classify_delta).
     pod_local: bool = False
+    @property
+    def row_local(self) -> bool:
+        """True when a landing changes feasibility AND scores only at its
+        own landed row (the kernel's scores_carried ∧ incremental_feas with
+        zero cross-row coupling of any kind): the precondition for the
+        explicit shard_map lap kernel (parallel/mesh.py sharded_lap_schedule
+        — per-shard work is provably local, collectives are two small
+        per-lap exchanges) and, with the same math host-side, for the
+        score-hint walk (models/score_hints.py)."""
+        return (self.pod_local and not self.has_pns and not self.has_na_pref
+                and not self.has_nom and not self.port_selfblock
+                and not self.has_aux)
+
     # Host-side per-node topology-spread columns (numpy, NOT shipped to the
     # kernel): per-constraint per-node matching-pod counts + domain
     # eligibility. schedule_placements rebuilds each candidate placement's

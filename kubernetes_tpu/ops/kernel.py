@@ -631,11 +631,15 @@ def patch_carry_rows_pinned(
     has_nom: bool = False,
 ) -> ScanCarry:
     """patch_carry_rows with out_shardings pinned to the live carry's OWN
-    committed shardings. A mesh session's chained-carry kernel trace keys on
-    the carry's placement (GSPMD chose it on the first dispatch); the patch
-    must hand back the identical placement or the next dispatch retraces —
-    the exact failure mode that kept mesh sessions on the full-rebuild path
-    (ROADMAP: delta resume under a sharded mesh)."""
+    committed shardings, and the stale carry DONATED into the patch (its
+    buffers are dead the moment the call returns — every caller rebinds
+    its reference to the result, so the patched carry reuses the old
+    carry's device memory instead of allocating a sharded copy per patch
+    wave). A mesh session's chained-carry kernel trace keys on the carry's
+    placement; the patch must hand back the identical placement or the
+    next dispatch retraces — the exact failure mode that kept mesh
+    sessions on the full-rebuild path (ROADMAP: delta resume under a
+    sharded mesh)."""
     out = ScanCarry(*[x.sharding for x in carry])
     key = (out, fit_strategy, has_nom)
     fn = _CARRY_PATCH_PINNED_CACHE.get(key)
@@ -643,7 +647,7 @@ def patch_carry_rows_pinned(
         fn = jax.jit(
             partial(patch_carry_rows.__wrapped__,
                     fit_strategy=fit_strategy, has_nom=has_nom),
-            out_shardings=out)
+            out_shardings=out, donate_argnums=(2,))
         _CARRY_PATCH_PINNED_CACHE[key] = fn
     return fn(state, f, carry, idx, req_rows, nz_rows, cnt_rows)
 

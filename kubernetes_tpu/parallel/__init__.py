@@ -9,14 +9,18 @@ TPU-native equivalents here:
   (tensor-parallel style: the "model" being sharded is the cluster state);
 - independent scheduling *cells* (Borg-style cells / multi-cluster shards)
   map to a data-parallel mesh axis;
-- XLA inserts the collectives (the cross-shard argmax/min/max reductions in
-  the kernel) — no hand-written communication.
+- GSPMD inserts the collectives for the general kernel; ROW-LOCAL plans
+  dispatch through an explicit `shard_map` lap kernel instead
+  (`sharded_lap_schedule`) whose two small per-lap collectives are
+  hand-placed and regression-pinned ≤ the GSPMD baseline (docs/PERF.md §5).
 """
 
 from .mesh import (collective_report, make_mesh, make_multihost_mesh,
-                   mesh_state_shardings, shard_features, shard_node_state,
+                   mesh_host_split, mesh_shard_count, mesh_state_shardings,
+                   shard_features, shard_node_state, sharded_lap_schedule,
                    sharded_schedule_batch)
 
 __all__ = ["collective_report", "make_mesh", "make_multihost_mesh",
-           "mesh_state_shardings", "shard_features", "shard_node_state",
+           "mesh_host_split", "mesh_shard_count", "mesh_state_shardings",
+           "shard_features", "shard_node_state", "sharded_lap_schedule",
            "sharded_schedule_batch"]

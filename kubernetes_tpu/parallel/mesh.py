@@ -25,11 +25,14 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.device_state import DeviceNodeState
 from ..ops.features import BatchFeatures
-from ..ops.kernel import schedule_batch
+from ..ops.kernel import (LAP_MAX, MAX_NODE_SCORE, ScanCarry, _resource_eval,
+                          _static_masks, schedule_batch)
 
 
 def make_mesh(
@@ -185,6 +188,242 @@ def collective_report(compiled_text: str, n_hosts: int, per_host: int) -> dict:
         out[axis][op] = out[axis].get(op, 0) + 1
         out["total"][op] = out["total"].get(op, 0) + 1
     return out
+
+
+def mesh_shard_count(mesh: Mesh) -> int:
+    """Shards along the cluster-state node axis (the state's row dimension
+    must divide by this for the explicit shard_map kernel)."""
+    axis = _node_axis_of(mesh)
+    names = axis if isinstance(axis, tuple) else (axis,)
+    n = 1
+    for a in names:
+        n *= mesh.shape[a]
+    return n
+
+
+def mesh_host_split(mesh: Mesh):
+    """(n_hosts, per_host) for collective_report: a ("dcn", "ici") mesh
+    spans hosts on its outer axis; a ("cells", "nodes") mesh is one host —
+    every collective (cells-spanning groups included) rides ICI, so
+    per_host must cover ALL the mesh's devices, not just the node axis."""
+    if "dcn" in mesh.axis_names:
+        return mesh.shape["dcn"], mesh.shape["ici"]
+    total = 1
+    for n in mesh.axis_names:
+        total *= mesh.shape[n]
+    return 1, total
+
+
+def _carry_specs(axis) -> ScanCarry:
+    """shard_map specs for a row-local session carry: per-node lanes shard
+    the node axis, the (empty, [0, V]) count tables and the rotation scalar
+    replicate."""
+    return ScanCarry(
+        req_r=P(axis, None), nonzero=P(axis, None), pod_count=P(axis),
+        fit_ok=P(axis), fit_sc=P(axis), ba=P(axis),
+        dns_counts=P(), sa_counts=P(), anti_counts=P(), aff_counts=P(),
+        ipa_delta=P(), start=P(), blocked=P(axis), aux_cnt=P(axis))
+
+
+def _lap_body(state: DeviceNodeState, f: BatchFeatures, n_active, ext0,
+              *, batch_pad: int, fit_strategy: int, axis_sizes,
+              n_shards: int):
+    """Per-shard body of the explicit shard_map lap kernel: the row-local
+    (scores_carried ∧ incremental_feas) greedy assignment of
+    ops/kernel.py:_lap_schedule, restated so every cross-shard exchange is
+    a VISIBLE collective — exactly two small ones per lap:
+
+    1. one ``all_gather`` of an i32 pair per shard — the shard's feasible
+       count (global prefix-sum offsets + total_feas) and its contribution
+       to F[start-1] (the rotation-rank origin);
+    2. one packed ``pmax`` over [2·LAP_MAX] lanes — the per-window
+       max-score-then-min-rotation selection keys and (negated) the
+       per-window evaluated boundaries.
+
+    Everything else — fit/BA re-eval, window segmentation, the landed-row
+    aggregate updates — touches only shard-local rows. Integer arithmetic
+    is exactly associative, so results are bit-identical to the
+    single-device lap (and therefore to the scan and the host oracle).
+    GSPMD compiles the same math from sharding propagation but inserts
+    ~2× the collectives per step because it cannot prove the carried
+    per-node lanes stay shard-local (MULTICHIP_r05 baseline)."""
+    NPl = state.valid.shape[0]
+    NP = NPl * n_shards
+    B = batch_pad
+    names = tuple(n for n, _s in axis_sizes)
+    gather_axis = names if len(names) > 1 else names[0]
+    # Flattened shard index, outer-axis-major — matching the host-major
+    # device layout of make_multihost_mesh so global row ids line up with
+    # the committed sharding's block order.
+    shard = None
+    for name, size in axis_sizes:
+        ai = lax.axis_index(name)
+        shard = ai if shard is None else shard * jnp.int32(size) + ai
+    gidx = (shard * NPl + jnp.arange(NPl, dtype=jnp.int32)).astype(jnp.int32)
+    num = jnp.maximum(f.num_nodes, 1)
+    tf = jnp.maximum(f.to_find, 1)
+    lanes = jnp.arange(LAP_MAX, dtype=jnp.int32)
+    svec = jnp.arange(n_shards, dtype=jnp.int32)
+    n_act = n_active.astype(jnp.int32)
+
+    taint_ok, _pns, sel_ok, name_ok, unsched_ok, exist_anti_ok = \
+        _static_masks(state, f)
+    static_ok = (state.valid & name_ok & unsched_ok & taint_ok & sel_ok
+                 & exist_anti_ok & f.extra_ok)
+    w_tt, w_fit, _w_pts, _w_ipa, w_ba, _w_na, w_il = (
+        f.weights[i] for i in range(7))
+    il_term = w_il * f.il_score
+
+    def cond(c):
+        return c[0] < n_act
+
+    def body(c):
+        done, req_r, nonzero, pod_count, start, out = c
+        fit_ok, fit_sc, ba = _resource_eval(
+            f, fit_strategy, state.alloc_r, state.alloc_pods,
+            req_r, nonzero, pod_count)
+        okd = static_ok & fit_ok & (gidx < num)
+        Fl = jnp.cumsum(okd.astype(jnp.int32))
+        total = (w_tt * jnp.int64(MAX_NODE_SCORE) + w_fit * fit_sc
+                 + w_ba * ba + il_term)
+        # ---- collective 1: shard feasible-counts + F[start-1] origin -----
+        sidx = start - jnp.int32(1)
+        own = (start > 0) & (sidx >= shard * NPl) & (sidx < (shard + 1) * NPl)
+        lpos = jnp.clip(sidx - shard * NPl, 0, NPl - 1)
+        pair = jnp.stack([Fl[-1], jnp.where(own, Fl[lpos], 0)])
+        g = lax.all_gather(pair, gather_axis)
+        tots = g[:, 0]                                       # [S]
+        total_feas = tots.sum()
+        F = Fl + jnp.where(svec < shard, tots, 0).sum()      # global prefix
+        owner = jnp.clip(sidx // jnp.int32(NPl), 0, n_shards - 1)
+        f_start = jnp.where(
+            start > 0,
+            jnp.where(svec < owner, tots, 0).sum() + g[owner, 1], 0)
+        rank = jnp.where(gidx >= start, F - f_start,
+                         F + total_feas - f_start)
+        rot = (gidx - start) % num
+        l_full = total_feas // tf
+        L = jnp.clip(jnp.minimum(l_full, n_act - done),
+                     1, LAP_MAX).astype(jnp.int32)
+        w = jnp.minimum((rank - 1) // tf, LAP_MAX)
+        seg = jnp.where(okd & (w < L), w, LAP_MAX)
+        in_w = seg[None, :] == lanes[:, None]                # [LAP_MAX, NPl]
+        key = total * NP + (jnp.int32(NP - 1) - rot)
+        key_w_l = jnp.max(jnp.where(in_w, key[None, :], -1), axis=1)
+        is_b = okd & (rank % tf == 0)
+        seg_b = jnp.where(is_b, jnp.minimum(rank // tf - 1, LAP_MAX), LAP_MAX)
+        in_b = seg_b[None, :] == lanes[:, None]
+        ev_w_l = jnp.min(jnp.where(in_b, rot[None, :] + 1, num), axis=1)
+        # ---- collective 2: packed per-window reduction (mins negated) ----
+        packed = jnp.concatenate([key_w_l, -ev_w_l.astype(jnp.int64)])
+        red = lax.pmax(packed, gather_axis)
+        key_w = red[:LAP_MAX]
+        ev_w = (-red[LAP_MAX:]).astype(jnp.int32)
+        has_w = (lanes < L) & (key_w >= 0)
+        rot_w = jnp.int32(NP - 1) - (key_w % NP).astype(jnp.int32)
+        row_w = jnp.where(has_w, (start + rot_w) % num, -1).astype(jnp.int32)
+        start_w = (start + ev_w) % num
+        # ---- apply the landings: shard-local one-hot updates -------------
+        chosen_1h = (gidx[None, :] == row_w[:, None]) & has_w[:, None]
+        cnt = chosen_1h.any(axis=0)
+        c64 = cnt.astype(jnp.int64)
+        req_r = req_r + f.request[None, :] * c64[:, None]
+        nonzero = nonzero + f.nz_request[None, :] * c64[:, None]
+        pod_count = pod_count + cnt.astype(jnp.int32)
+        chosen_w = jnp.where(has_w, row_w, -1)
+        block = jnp.stack([chosen_w, start_w.astype(jnp.int32)])
+        out = lax.dynamic_update_slice(out, block, (jnp.int32(0), done))
+        start = start_w[jnp.maximum(L - 1, 0)]
+        return (done + L, req_r, nonzero, pod_count, start, out)
+
+    out0 = jnp.full((2, B + LAP_MAX), -1, jnp.int32)
+    c0 = (jnp.int32(0), ext0.req_r, ext0.nonzero, ext0.pod_count,
+          ext0.start, out0)
+    (_done, req_r, nonzero, pod_count, start, out) = lax.while_loop(
+        cond, body, c0)
+    fit_ok, fit_sc, ba = _resource_eval(
+        f, fit_strategy, state.alloc_r, state.alloc_pods,
+        req_r, nonzero, pod_count)
+    carry = ScanCarry(req_r, nonzero, pod_count, fit_ok, fit_sc, ba,
+                      ext0.dns_counts, ext0.sa_counts, ext0.anti_counts,
+                      ext0.aff_counts, ext0.ipa_delta, start,
+                      ext0.blocked, ext0.aux_cnt)
+    return out[:, :B], carry
+
+
+class _ShardedLap:
+    """The compiled explicit-collectives lap kernel for one (mesh,
+    batch_pad, fit_strategy, vmax): ``__call__(state, feats, n_active,
+    carry_in)`` mirrors TPUScheduler._dispatch's schedule_batch contract —
+    fresh (carry_in=None) and chained traces are separate jits, and the
+    chained trace DONATES carry_in exactly like schedule_batch does."""
+
+    def __init__(self, mesh: Mesh, batch_pad: int, fit_strategy: int,
+                 vmax: int):
+        self.mesh = mesh
+        axis = _node_axis_of(mesh)
+        names = axis if isinstance(axis, tuple) else (axis,)
+        axis_sizes = tuple((a, mesh.shape[a]) for a in names)
+        n_shards = mesh_shard_count(mesh)
+        state_specs = _state_specs(axis)
+        feat_specs = _feature_specs(axis)
+        carry_specs = _carry_specs(axis)
+
+        def body(state, f, n_active, ext0):
+            return _lap_body(state, f, n_active, ext0,
+                             batch_pad=batch_pad, fit_strategy=fit_strategy,
+                             axis_sizes=axis_sizes, n_shards=n_shards)
+
+        def fresh(state, f, n_active):
+            fit_ok0, fit_sc0, ba0 = _resource_eval(
+                f, fit_strategy, state.alloc_r, state.alloc_pods,
+                state.req_r, state.nonzero, state.pod_count)
+            npl = state.valid.shape[0]
+            ext0 = ScanCarry(state.req_r, state.nonzero, state.pod_count,
+                             fit_ok0, fit_sc0, ba0,
+                             f.dns_counts, f.sa_counts, f.anti_counts,
+                             f.aff_counts,
+                             jnp.zeros((0, vmax), jnp.int64), f.start_index,
+                             jnp.zeros(npl, bool), jnp.zeros(npl, jnp.int32))
+            return body(state, f, n_active, ext0)
+
+        def chained(state, f, n_active, carry_in):
+            return body(state, f, n_active, carry_in)
+
+        self.fresh = jax.jit(shard_map(
+            fresh, mesh=mesh,
+            in_specs=(state_specs, feat_specs, P()),
+            out_specs=(P(), carry_specs), check_rep=False))
+        self.chained = jax.jit(shard_map(
+            chained, mesh=mesh,
+            in_specs=(state_specs, feat_specs, P(), carry_specs),
+            out_specs=(P(), carry_specs), check_rep=False),
+            donate_argnums=3)
+
+    def __call__(self, state, feats, n_active, carry_in=None):
+        if carry_in is None:
+            return self.fresh(state, feats, n_active)
+        return self.chained(state, feats, n_active, carry_in)
+
+    def lower(self, state, feats, n_active, carry_in=None):
+        if carry_in is None:
+            return self.fresh.lower(state, feats, n_active)
+        return self.chained.lower(state, feats, n_active, carry_in)
+
+
+_SHARDED_LAP_CACHE: dict = {}
+
+
+def sharded_lap_schedule(mesh: Mesh, batch_pad: int, fit_strategy: int,
+                         vmax: int) -> _ShardedLap:
+    """Cached _ShardedLap per (mesh, statics) — the production dispatch's
+    row-local path under a mesh (TPUScheduler._dispatch)."""
+    key = (mesh, batch_pad, fit_strategy, vmax)
+    fn = _SHARDED_LAP_CACHE.get(key)
+    if fn is None:
+        fn = _ShardedLap(mesh, batch_pad, fit_strategy, vmax)
+        _SHARDED_LAP_CACHE[key] = fn
+    return fn
 
 
 def sharded_schedule_batch(mesh: Mesh, batch_pad: int, fit_strategy: int, vmax: int):

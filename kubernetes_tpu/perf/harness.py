@@ -865,10 +865,23 @@ def run_workload(wl: Workload, sched: Optional[Scheduler] = None) -> PerfResult:
     result.scheduled = sched.scheduled
     result.failed = sched.failures
     for attr in _ThroughputCollector.WINDOW_COUNTERS + (
-            "placement_device_evals",):
+            "placement_device_evals", "shard_map_dispatches"):
         v = getattr(sched, attr, None)
         if v is not None:
             result.detail[attr] = round(v, 3) if isinstance(v, float) else v
+    # Mesh plane: compile-time per-step ici/dcn collective counts of the
+    # workload's own dispatch path (the MULTICHIP collective budget).
+    # Opt-in (one lower+compile per run) — the bench/dryrun mains set it.
+    import os as _os
+    if (getattr(sched, "mesh", None) is not None
+            and wl.default_pod_template
+            and _os.environ.get("TPU_SCHED_COLLECTIVES_DETAIL") == "1"):
+        try:
+            result.detail["collectives"] = sched.collective_counts(
+                _make_pod_from_template("collective-probe",
+                                        dict(wl.default_pod_template)))
+        except Exception as e:  # noqa: BLE001 - detail only, never the run
+            result.detail["collectives"] = {"error": str(e)[:200]}
     # Per-extension-point latency (scheduler_perf.go:866-871 collects the
     # framework_extension_point_duration_seconds histogram per workload).
     hist = sched.metrics.framework_extension_point_duration

@@ -47,9 +47,10 @@ def _pod(name, ns="default", cpu="200m", labels=None, anti=None):
 def _pair(n_nodes=24, max_batch=64, oracle_hints=False):
     """(always-dispatch oracle, hint-enabled device scheduler) over
     identical clusters. The oracle is a TPUScheduler with the hint cache
-    disabled — the exact code path every pod takes today. mesh=None:
-    hints decline sharded meshes by design (hint_eligible), so the suite
-    pins the single-device plane the fast path targets."""
+    disabled — the exact code path every pod takes today. mesh=None keeps
+    this suite on the single-device plane; mesh sessions install hints
+    from the sharded carry too (one device→host gather — ROADMAP 12d,
+    TestMeshAndLapWalk)."""
     oracle = TPUScheduler(max_batch=max_batch, mesh=None)
     oracle._hints.enabled = oracle_hints
     dev = TPUScheduler(max_batch=max_batch, mesh=None)
@@ -421,6 +422,81 @@ class TestBindConflict409:
         dev.run_until_idle()
         if dev._hints.entry is entry:  # survived the replay
             assert not entry.blocked[entry.row_of[node]]
+
+
+class TestMeshAndLapWalk:
+    """ROADMAP 12a/12d: the lap-batched walk (one cumsum serves a lap of
+    replicas) and mesh-session hint installs (the HintEntry fetches the
+    per-node aggregates/score vector from the SHARDED carry via one
+    device→host gather at clean session end)."""
+
+    def test_mesh_session_installs_hint_from_sharded_carry(self):
+        from kubernetes_tpu.parallel import make_mesh
+        oracle = TPUScheduler(max_batch=64, mesh=None)
+        oracle._hints.enabled = False
+        dev = TPUScheduler(max_batch=64, mesh=make_mesh(n_cells=1))
+        assert dev.mesh is not None and dev._hints.enabled
+        for s in (oracle, dev):
+            for i in range(24):
+                s.clientset.create_node(_node(f"node-{i}"))
+        proto = _pod("proto")
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            proto.clone_from_template(f"a-{i}")) for i in range(8)])
+        # clean mesh session end → hint installed from the sharded carry
+        assert dev._hints.entry is not None, (
+            "mesh session did not install a score hint")
+        batches0 = dev.device_batches
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            proto.clone_from_template(f"b-{i}")) for i in range(12)])
+        _assert_identical(oracle, dev, "(mesh hint binds)")
+        assert dev.hint_hits >= 12, dev.hint_hits
+        assert dev.device_batches == batches0, (
+            "hint-eligible replicas dispatched to the mesh anyway")
+
+    def test_lap_batched_walk_is_bit_identical_and_engaged(self):
+        """With adaptive-sampling truncation live (to_find << feasible),
+        the walk precomputes a LAP of placements per cumsum — assert it
+        demonstrably engages (lap_walks < hits) and stays bit-identical
+        to the always-dispatch oracle."""
+        oracle = TPUScheduler(max_batch=32, mesh=None)
+        oracle._hints.enabled = False
+        dev = TPUScheduler(max_batch=32, mesh=None)
+        for s in (oracle, dev):
+            s.percentage_of_nodes_to_score = 10  # to_find=20 at 200 nodes
+            for i in range(200):
+                s.clientset.create_node(_node(f"node-{i}"))
+        proto = _pod("proto", cpu="100m")
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            proto.clone_from_template(f"a-{i}")) for i in range(8)])
+        entry = dev._hints.entry
+        assert entry is not None and entry.lap_enabled
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            proto.clone_from_template(f"b-{i}")) for i in range(60)])
+        _assert_identical(oracle, dev, "(lap walk)")
+        assert dev.hint_hits >= 60
+        e = dev._hints.entry
+        assert e is not None and e.lap_walks >= 1
+        # batching engaged: far fewer full walks than pods served
+        assert e.lap_walks * 2 <= dev.hint_hits, (
+            e.lap_walks, dev.hint_hits)
+
+    def test_lap_disabled_env_pins_per_pod_walk(self, monkeypatch):
+        monkeypatch.setenv("TPU_SCHED_HINT_LAP", "0")
+        oracle = TPUScheduler(max_batch=32, mesh=None)
+        oracle._hints.enabled = False
+        dev = TPUScheduler(max_batch=32, mesh=None)
+        for s in (oracle, dev):
+            s.percentage_of_nodes_to_score = 10
+            for i in range(200):
+                s.clientset.create_node(_node(f"node-{i}"))
+        proto = _pod("proto", cpu="100m")
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            proto.clone_from_template(f"a-{i}")) for i in range(8)])
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            proto.clone_from_template(f"b-{i}")) for i in range(20)])
+        _assert_identical(oracle, dev, "(per-pod walk)")
+        e = dev._hints.entry
+        assert e is not None and not e.lap_enabled and e.lap_walks == 0
 
 
 class TestRequeueConflictEnqueuedAt:

@@ -191,12 +191,14 @@ class TestDeltaResumeBetweenSessions:
 
 
 class TestDeltaResumeUnderMesh:
-    """ROADMAP re-enable (scoped): under a sharded mesh, taint/alloc NODE
-    updates delta-patch the session through jits pinned to the committed
-    shardings (parallel/mesh.py mesh_state_shardings out_shardings on the
-    row scatter, ops/kernel.py patch_carry_rows_pinned on the carry), so
-    multi-chip sessions stop full-rebuilding on every taint churn. Pod
-    events still decline (their aggregates also ride the adopt seam)."""
+    """Mesh-first device plane: under a sharded mesh, EVERY classifiable
+    journal kind — taint/alloc NODE updates AND the POD-event aggregates
+    that dominate churn — delta-patches the session through jits pinned to
+    the committed shardings (parallel/mesh.py mesh_state_shardings on the
+    row scatter, ops/kernel.py patch_carry_rows_pinned on the carry, both
+    donating the stale buffers). The resident mirror copy IS the sharded
+    state (NodeStateMirror.commit_shardings), so adopt/resume never
+    round-trip the whole state through the host."""
 
     def test_taint_updates_take_delta_path_under_mesh(self):
         from kubernetes_tpu.parallel import make_mesh
@@ -227,14 +229,16 @@ class TestDeltaResumeUnderMesh:
         assert dev.host_path_pods == 0
         assert any(n == "node-0" for n in _assignments(dev).values())
 
-    def test_pod_events_still_decline_under_mesh(self):
-        """A bound-pod delete (pod_remove, delta-patchable single-device)
-        must still take the full-rebuild path under a mesh — and match."""
+    def test_pod_events_take_delta_path_under_mesh(self):
+        """The tentpole inversion: a bound-pod delete (pod_remove) between
+        mesh sessions row-patches the SHARDED state + carry — zero full
+        rebuilds on the patchable POD kind — and stays bit-identical to
+        the host oracle."""
         from kubernetes_tpu.parallel import make_mesh
         host, dev = _pair(mesh=make_mesh(n_cells=1))
         _both(host, dev, lambda s: [s.clientset.create_pod(
             _pod(f"a-{i}")) for i in range(8)])
-        full0 = dev.plan_rebuilds_full
+        full0, delta0 = dev.plan_rebuilds_full, dev.plan_rebuilds_delta
 
         def delete_one(s):
             vs = [p for p in s.clientset.pods.values() if p.node_name]
@@ -243,9 +247,99 @@ class TestDeltaResumeUnderMesh:
         _both(host, dev, lambda s: [s.clientset.create_pod(
             _pod(f"b-{i}")) for i in range(8)])
         _assert_identical(host, dev)
-        assert dev.plan_rebuilds_full > full0, (
-            "pod-event patch applied under a mesh (adopt seam has no "
-            "sharded variant — this must decline)")
+        assert dev.plan_rebuilds_full == full0, (
+            "patchable POD event forced a full rebuild under the mesh")
+        assert dev.plan_rebuilds_delta > delta0
+        assert dev.host_path_pods == 0
+        # the sharded resident really is the session state: one committed
+        # placement, no per-session device_put round-trip
+        assert dev.mirror._shardings is not None
+
+    def test_mesh_churn_fuzz_zero_full_rebuilds_on_patchable_events(self):
+        """Churn-equivalence fuzz delta-ENGAGED on the virtual 8-device
+        mesh (acceptance): after the first session, a stream of ONLY
+        patchable events — bound-pod deletes (shrink), pod adds, taint
+        flips — must produce ZERO further full rebuilds, with assignments
+        bit-identical to the always-rebuild host oracle."""
+        import random
+        from kubernetes_tpu.parallel import make_mesh
+        rng = random.Random(7)
+        host, dev = _pair(n_nodes=16, mesh=make_mesh(n_cells=1))
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"seed-{i}", tolerate="dedicated")) for i in range(8)])
+        assert dev.plan_rebuilds_full == 1
+        for r in range(10):
+            op = rng.random()
+            if op < 0.4:
+                def kill(s):
+                    bound = sorted((p for p in s.clientset.pods.values()
+                                    if p.node_name),
+                                   key=lambda p: (p.namespace, p.name))
+                    if bound:
+                        s.clientset.delete_pod(bound[0])
+                _both(host, dev, kill)
+            elif op < 0.7:
+                i = rng.randint(0, 15)
+                tainted = rng.random() < 0.5
+                _both(host, dev, lambda s, i=i, t=tainted:
+                      s.clientset.update_node(_node(
+                          f"node-{i}",
+                          taint=("dedicated", "x", "NoSchedule")
+                          if t else None)))
+            k = rng.randint(2, 5)
+            _both(host, dev, lambda s, r=r, k=k: [s.clientset.create_pod(
+                _pod(f"w{r}-{i}", tolerate="dedicated"))
+                for i in range(k)])
+        _assert_identical(host, dev)
+        assert dev.failures == host.failures == 0
+        assert dev.plan_rebuilds_full == 1, (
+            "a patchable event stream forced full rebuilds under the mesh")
+        assert dev.plan_rebuilds_delta >= 3
+        assert dev.host_path_pods == 0
+
+    def test_donated_resident_never_read_after_patch(self):
+        """Donation safety (the pjit donate_argnums contract): the patch
+        seam donates the stale sharded state/carry into the pinned jits —
+        the OLD buffers must be deleted (reused in place) and never read
+        again; the rebound resident keeps serving sessions correctly."""
+        from kubernetes_tpu.parallel import make_mesh
+        host, dev = _pair(mesh=make_mesh(n_cells=1))
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"a-{i}")) for i in range(8)])
+        old_state = dev.mirror._device
+        old_req = old_state.req_r
+
+        def delete_one(s):
+            vs = [p for p in s.clientset.pods.values() if p.node_name]
+            s.clientset.delete_pod(min(vs, key=lambda p: p.name))
+        _both(host, dev, delete_one)
+        _both(host, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"b-{i}")) for i in range(8)])
+        assert dev.plan_rebuilds_delta >= 1, "delta patch did not engage"
+        # the patch rebound the resident; donation deleted the old buffers
+        assert dev.mirror._device is not old_state
+        assert old_req.is_deleted(), (
+            "stale sharded state was not donated into the patch jit")
+        _assert_identical(host, dev)
+
+    def test_patch_rows_declines_on_deleted_resident(self):
+        """A resident whose buffers were donated back to a kernel must
+        make patch_rows return None (→ full-rebuild fallback), never read
+        the deleted arrays."""
+        from kubernetes_tpu.parallel import make_mesh
+        _host, dev = _pair(mesh=make_mesh(n_cells=1))
+        for i in range(4):
+            dev.clientset.create_pod(_pod(f"a-{i}"))
+        dev.run_until_idle()
+        mirror = dev.mirror
+        assert mirror._device is not None
+        ni = dev.cache.nodes.get("node-0")
+        # simulate the donation: delete one resident leaf out from under it
+        mirror._device.req_r.delete()
+        assert mirror.patch_rows([(0, ni)]) is None
+        # ... and the forced full flush recovers from staging truth
+        state = mirror.flush()
+        assert not state.req_r.is_deleted()
 
 
 class TestMidSessionContinuation:

@@ -165,6 +165,71 @@ def test_multihost_mesh_matches_single_device():
     assert report["total"], "no collectives in the multi-host step"
 
 
+def test_shard_map_is_production_dispatch_for_row_local_plans():
+    """Row-local plans at production batch tiers (>64) dispatch through the
+    EXPLICIT shard_map lap kernel (parallel/mesh.py sharded_lap_schedule) —
+    hand-placed minimal collectives instead of GSPMD inference — and the
+    chained multi-batch session stays bit-identical to the host oracle."""
+    def build(cls):
+        cs = FakeClientset()
+        kw = ({"max_batch": 128} if cls is TPUScheduler
+              else {"deterministic_ties": True})
+        s = cls(clientset=cs, **kw)
+        for i in range(96):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": 16, "memory": "64Gi",
+                                      "pods": 110})
+                           .zone(f"z{i % 5}").obj())
+        proto = (make_pod().name("proto")
+                 .req({"cpu": "250m", "memory": "128Mi"})
+                 .labels({"app": "rl"}).obj())
+        for i in range(300):  # 3 chained dispatches of 128
+            cs.create_pod(proto.clone_from_template(f"p{i}"))
+        s.run_until_idle()
+        return {p.name: p.node_name for p in cs.pods.values()}, s
+    host_asg, _ = build(Scheduler)
+    dev_asg, dev = build(TPUScheduler)
+    assert dev.mesh is not None
+    assert dev.shard_map_dispatches >= 3, (
+        "row-local plan did not ride the shard_map lap kernel")
+    assert host_asg == dev_asg
+    assert dev.host_path_pods == 0
+
+
+def test_shard_map_collectives_at_or_below_gspmd_baseline():
+    """The collective budget (MULTICHIP acceptance): per step, the
+    explicit shard_map path must not exceed the GSPMD-compiled baseline in
+    any op class total, and should drive the overall count DOWN."""
+    import numpy as np
+    from kubernetes_tpu.ops.kernel import schedule_batch
+    from kubernetes_tpu.parallel.mesh import (collective_report,
+                                              mesh_host_split)
+
+    cs = FakeClientset()
+    s = TPUScheduler(clientset=cs, max_batch=128)
+    for i in range(96):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": 16, "memory": "64Gi", "pods": 110})
+                       .zone(f"z{i % 4}").obj())
+    probe = make_pod().name("probe").req({"cpu": "250m"}).obj()
+    rep = s.collective_counts(probe)
+    assert rep is not None and rep["path"] == "shard_map", rep
+    assert rep["total"], "shard_map step compiled with no collectives"
+    # GSPMD baseline of the SAME plan
+    fw = s.framework_for_pod(probe)
+    state, plan = s.build_plan(fw, probe, 128)
+    lowered = schedule_batch.lower(
+        state, plan.features, plan.batch_pad, plan.fit_strategy, plan.vmax,
+        n_active=np.int32(128), carry_in=None, has_pns=plan.has_pns,
+        has_ipa_base=plan.has_ipa_base, anti_rowlocal=plan.anti_rowlocal,
+        has_na_pref=plan.has_na_pref, port_selfblock=plan.port_selfblock,
+        has_aux=plan.has_aux)
+    n_hosts, per_host = mesh_host_split(s.mesh)
+    base = collective_report(lowered.compile().as_text(), n_hosts, per_host)
+    assert sum(rep["total"].values()) <= sum(base["total"].values()), (
+        rep["total"], base["total"])
+
+
 def test_sidecar_over_uds_matches_in_process():
     """The UDS sidecar prototype (docs/SIDECAR.md): a separate OS process
     owns the device path; scheduling a batch over the socket produces the

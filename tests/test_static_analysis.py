@@ -906,6 +906,97 @@ class TestShedDisciplineFixtures:
         assert c.applies_to("shard/member.py")
 
 
+class TestShardingDisciplineFixtures:
+    """ISSUE 15 mesh-first plane: a bare jax.jit inside the sharded-state
+    seam hands back GSPMD-chosen placements and silently retraces the
+    session kernel on the next dispatch."""
+
+    def test_bare_jit_with_sharded_state_param_flagged(self):
+        bad = textwrap.dedent("""
+            import jax
+
+            def sharded_scatter(sharded_state, idx, rows):
+                fn = jax.jit(scatter_impl)
+                return fn(sharded_state, idx, rows)
+        """)
+        fs = check_source(checker_by_id("sharding-discipline"), bad)
+        assert _rules(fs) == ["bare-jit-on-sharded-state"]
+
+    def test_bare_jit_near_sharded_state_callsite_flagged(self):
+        bad = textwrap.dedent("""
+            import jax
+
+            def apply_patch(self, updates, state):
+                patch = jax.jit(patch_impl)
+                new = self.mirror.patch_rows(updates, sharded_state=state)
+                return patch(new)
+        """)
+        fs = check_source(checker_by_id("sharding-discipline"), bad)
+        assert _rules(fs) == ["bare-jit-on-sharded-state"]
+
+    def test_pinned_jit_passes(self):
+        good = textwrap.dedent("""
+            import jax
+
+            def sharded_scatter(out_shardings, sharded_state, idx, rows):
+                fn = jax.jit(scatter_impl, out_shardings=out_shardings)
+                return fn(sharded_state, idx, rows)
+        """)
+        assert check_source(checker_by_id("sharding-discipline"),
+                            good) == []
+
+    def test_jit_wrapping_shard_map_exempt(self):
+        """shard_map's in/out_specs ARE the placement pin."""
+        good = textwrap.dedent("""
+            import jax
+            from jax.experimental.shard_map import shard_map
+
+            def build(mesh, in_specs, out_specs, out_shardings):
+                return jax.jit(shard_map(body, mesh=mesh,
+                                         in_specs=in_specs,
+                                         out_specs=out_specs))
+        """)
+        assert check_source(checker_by_id("sharding-discipline"),
+                            good) == []
+
+    def test_bare_jit_outside_seam_not_flagged(self):
+        good = textwrap.dedent("""
+            import jax
+
+            def plain_helper(x):
+                return jax.jit(lambda a: a + 1)(x)
+        """)
+        assert check_source(checker_by_id("sharding-discipline"),
+                            good) == []
+
+    def test_scope(self):
+        c = checker_by_id("sharding-discipline")
+        assert c.applies_to("ops/device_state.py")
+        assert c.applies_to("parallel/mesh.py")
+        assert c.applies_to("models/tpu_scheduler.py")
+        assert not c.applies_to("core/apiserver.py")
+
+    def test_shard_map_bodies_join_jit_purity_scope(self):
+        """A shard_map-wrapped function is jit-reachable: impure host
+        effects inside it are flagged by jit-purity (the ISSUE's 'bodies
+        join the jit-purity scan scope')."""
+        bad = textwrap.dedent("""
+            import time
+            from jax.experimental.shard_map import shard_map
+
+            def body(x):
+                time.sleep(1)
+                return x
+
+            def build(mesh, specs):
+                return shard_map(body, mesh=mesh, in_specs=specs,
+                                 out_specs=specs)
+        """)
+        fs = check_source(checker_by_id("jit-purity"), bad)
+        assert any("time" in f.message or "impure" in f.message
+                   for f in fs), fs
+
+
 # ---------------------------------------------------------------------------
 # the tree gate + allowlist policy
 # ---------------------------------------------------------------------------
@@ -926,8 +1017,8 @@ def test_every_checker_registered_and_described():
     ids = sorted(c.id for c in checkers)
     assert ids == ["hint-freshness", "index-dtype", "jit-purity",
                    "lock-discipline", "metrics-discipline",
-                   "shed-discipline", "span-discipline", "thread-hygiene",
-                   "wire-discipline"]
+                   "sharding-discipline", "shed-discipline",
+                   "span-discipline", "thread-hygiene", "wire-discipline"]
     assert all(c.description for c in checkers)
 
 
