@@ -1,0 +1,155 @@
+"""eviction-discipline checker: controllers never evict outside the funnel.
+
+Incident class (ISSUE 16): the node-lifecycle controller drains unreachable
+nodes through ONE funnel — `RateLimitedEvictor.run_once` takes a token from
+the zone's bucket (the rate limiter) and `_evict_one` stamps the
+deterministic intent id (the idempotency record) before calling the
+apiserver's eviction subresource. A pod delete/evict call site in
+``controllers/`` that bypasses that funnel is the mass-eviction storm
+waiting to happen: no zone throttle (a partitioned rack becomes 500
+simultaneous "evictions"), and no intent ledger (a controller restart
+mid-wave re-evicts pods the dead incarnation already drained — the
+exactly-once contract silently becomes at-least-once).
+
+Rule ``eviction-outside-funnel``: in ``controllers/``, every function that
+calls a pod-removal verb (``.delete_pod(...)`` / ``.evict_pod(...)``) must
+sit on a same-module call-graph slice that contains BOTH
+
+- a rate-limiter grant (``.try_take(...)``), and
+- an idempotent intent record (``intent_for(...)``).
+
+"Slice" follows hint_freshness's shape: the sinks may live in the calling
+function itself, in its same-module callee closure, or in a caller whose
+callee closure contains both the call site and the sinks (the
+``run_once → _evict_one`` shape, where the token is taken one frame above
+the intent stamp). Both sinks must appear in ONE slice — a limiter with no
+ledger rate-limits the double-evictions, it doesn't prevent them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .base import Checker, Finding, ModuleSource, attr_chain, register
+
+SCOPE_DIR = "controllers/"
+
+REMOVAL_VERBS = {"delete_pod", "evict_pod"}
+LIMITER_SINKS = {"try_take"}
+INTENT_SINKS = {"intent_for"}
+
+
+def _fn_facts(fn: ast.AST) -> Tuple[List[int], bool, bool, Set[str]]:
+    """(removal-call linenos, has_limiter, has_intent, same-module callee
+    names) for one def."""
+    removals: List[int] = []
+    has_limiter = False
+    has_intent = False
+    calls: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            # Attribute-name match, not attr_chain: the limiter grant is
+            # `self._buckets[zone].try_take()` — a subscript base, which
+            # attr_chain refuses — and the removal verbs ride whatever
+            # clientset spelling the controller holds.
+            if func.attr in REMOVAL_VERBS:
+                removals.append(node.lineno)
+            if func.attr in LIMITER_SINKS:
+                has_limiter = True
+            if func.attr in INTENT_SINKS:
+                has_intent = True
+        elif isinstance(func, ast.Name):
+            if func.id in INTENT_SINKS:
+                has_intent = True
+            if func.id in LIMITER_SINKS:
+                has_limiter = True
+        chain = attr_chain(func)
+        if chain and (len(chain) == 1
+                      or (len(chain) == 2 and chain[0] == "self")):
+            calls.add(chain[-1])
+    return removals, has_limiter, has_intent, calls
+
+
+@register
+class EvictionDisciplineChecker(Checker):
+    id = "eviction-discipline"
+    description = ("controllers/ pod delete/evict call sites stay on a "
+                   "call-graph slice containing both the rate-limiter "
+                   "grant (try_take) and the idempotent intent record "
+                   "(intent_for)")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(SCOPE_DIR) or ("/" + SCOPE_DIR) in relpath
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        tree = mod.tree
+        if tree is None:
+            return []
+        # Per-def facts, merged per NAME for call-graph edges (name-level
+        # resolution, same caveat as hint_freshness: `self.f()` cannot be
+        # pinned to one class here).
+        defs: List[Tuple[str, List[int], bool, bool, Set[str]]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.append((node.name, *_fn_facts(node)))
+        name_limiter: Dict[str, bool] = {}
+        name_intent: Dict[str, bool] = {}
+        name_calls: Dict[str, Set[str]] = {}
+        for name, _r, lim, intent, calls in defs:
+            name_limiter[name] = name_limiter.get(name, False) or lim
+            name_intent[name] = name_intent.get(name, False) or intent
+            name_calls.setdefault(name, set()).update(calls)
+        reach_memo: Dict[str, Set[str]] = {}
+
+        def reach(name: str) -> Set[str]:
+            got = reach_memo.get(name)
+            if got is not None:
+                return got
+            reach_memo[name] = out = set()
+            stack = [name]
+            while stack:
+                for callee in name_calls.get(stack.pop(), ()):
+                    if callee not in out and callee in name_calls:
+                        out.add(callee)
+                        stack.append(callee)
+            return out
+
+        def slice_ok(names: Set[str]) -> bool:
+            return (any(name_limiter.get(n, False) for n in names)
+                    and any(name_intent.get(n, False) for n in names))
+
+        def def_covered(name: str, calls: Set[str]) -> bool:
+            # own def + callee closure
+            down = {name}
+            for c in calls:
+                if c in name_calls:
+                    down.add(c)
+                    down |= reach(c)
+            if slice_ok(down):
+                return True
+            # caller direction: a def whose callee closure contains this
+            # def's NAME gives the slice {caller} ∪ reach(caller)
+            for g, _r, _l, _i, _c in defs:
+                gr = reach(g)
+                if name in gr and slice_ok(gr | {g}):
+                    return True
+            return False
+
+        out: List[Finding] = []
+        for name, removals, _lim, _intent, calls in defs:
+            if not removals or def_covered(name, calls):
+                continue
+            for line in removals:
+                out.append(Finding(
+                    self.id, "eviction-outside-funnel", mod.path, line,
+                    f"{name}() deletes/evicts a pod but no call-graph "
+                    "slice through it takes a rate-limiter token "
+                    "(try_take) AND records an idempotent intent "
+                    "(intent_for) — a naked eviction: unthrottled under "
+                    "zone disruption and replayable after a controller "
+                    "restart"))
+        return out

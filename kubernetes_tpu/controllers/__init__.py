@@ -1,0 +1,13 @@
+"""Native controller plane (kube-controller-manager analogue, PAPER.md L4).
+
+First resident: the node-lifecycle controller — lease/heartbeat-driven
+health monitoring, taint-on-unready (NoSchedule -> NoExecute ladder),
+rate-limited zone-aware eviction, and pod GC — run as its own process
+(`python -m kubernetes_tpu.controllers --api-url ...`) against the real
+apiserver via HTTPClientset. docs/RESILIENCE.md § node lifecycle.
+"""
+
+from .evictor import RateLimitedEvictor, TokenBucket
+from .node_lifecycle import NodeLifecycleController
+
+__all__ = ["NodeLifecycleController", "RateLimitedEvictor", "TokenBucket"]

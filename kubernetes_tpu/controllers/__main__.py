@@ -1,0 +1,93 @@
+"""Standalone node-lifecycle controller process:
+
+    python -m kubernetes_tpu.controllers --api-url http://127.0.0.1:PORT \
+        [--fallback URL ...] [--grace S] [--noexec-after S] [--tick S] \
+        [--primary-qps Q] [--secondary-qps Q] [--unhealthy-threshold F] \
+        [--metrics-port P]
+
+Connects an HTTPClientset (reads may land on follower replicas via
+--fallback; writes and the heartbeat-ages poll leader-route), prints the
+ready line the spawn harness keys on (``node-lifecycle controller:
+watching ...``), serves its own /metrics (`node_lifecycle_*` series) on
+an ephemeral port, reconciles until SIGTERM/SIGINT, then prints one JSON
+stats line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..core.apiserver import HTTPClientset
+from .node_lifecycle import NodeLifecycleController
+
+
+def _serve_metrics(ctrl: NodeLifecycleController, port: int):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: D102 - silence request logs
+            pass
+
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            data = ctrl.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes-tpu-controllers")
+    ap.add_argument("--api-url", required=True,
+                    help="apiserver base URL (reads; writes leader-route)")
+    ap.add_argument("--fallback", action="append", default=[],
+                    help="sibling replica URL for read-plane failover "
+                         "(repeatable)")
+    ap.add_argument("--grace", type=float, default=4.0,
+                    help="heartbeat silence before Ready->Unknown")
+    ap.add_argument("--noexec-after", type=float, default=2.0,
+                    help="further silence before the NoExecute taint")
+    ap.add_argument("--tick", type=float, default=0.5)
+    ap.add_argument("--primary-qps", type=float, default=2.0)
+    ap.add_argument("--secondary-qps", type=float, default=0.1)
+    ap.add_argument("--unhealthy-threshold", type=float, default=0.55)
+    ap.add_argument("--metrics-port", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    cs = HTTPClientset(args.api_url, fallbacks=args.fallback)
+    ctrl = NodeLifecycleController(
+        cs, grace=args.grace, noexec_after=args.noexec_after,
+        tick=args.tick, primary_qps=args.primary_qps,
+        secondary_qps=args.secondary_qps,
+        unhealthy_threshold=args.unhealthy_threshold)
+    httpd = _serve_metrics(ctrl, args.metrics_port)
+    mport = httpd.server_address[1]
+    ctrl.start()
+    # The ready line FIRST (spawn harnesses select()+readline on it).
+    print(f"node-lifecycle controller: watching {args.api_url} "
+          f"metrics on 127.0.0.1:{mport}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    ctrl.stop()
+    httpd.shutdown()
+    cs.close()
+    print(json.dumps({"controller_stats": ctrl.stats()}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

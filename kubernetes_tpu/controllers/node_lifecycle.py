@@ -1,0 +1,254 @@
+"""Node-lifecycle controller: the first native reconcile loop
+(node_lifecycle_controller.go collapsed to one standing loop).
+
+Health is heartbeat freshness read off the apiserver's leader-local ages
+surface (`GET /api/v1/nodes/heartbeats` — the node-status sink the hollow
+plane already drives). A node silent past `grace` transitions
+Ready -> Unknown and climbs the taint ladder: `node.kubernetes.io/
+unreachable` NoSchedule immediately (the scheduler's existing taint
+predicate stops NEW placements, and the MODIFIED fanout invalidates
+score-hint rows with zero new device code), then NoExecute after
+`noexec_after` more seconds of silence, at which point its bound pods
+drain through the RateLimitedEvictor. A node that heartbeats again lifts
+the ladder and cancels its still-pending evictions. Pods bound to a node
+that no longer EXISTS are reaped by the same loop (pod GC).
+
+Failover posture: ages are leader-local, so a freshly promoted apiserver
+answers with an empty (or young) map — nodes absent from the map age from
+this controller's own first-sight stamp, i.e. the fleet gets one full
+grace period after any failover before anything is declared Unknown.
+Evictions stay exactly-once regardless: intent ids are deterministic and
+the ledger rides the replicated WAL.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..core.apiserver import UNREACHABLE_TAINT, node_from_wire, node_to_wire
+from .evictor import ZONE_FULL, ZONE_PARTIAL, RateLimitedEvictor
+
+ZONE_LABEL = "topology.kubernetes.io/zone"
+GC_ZONE = ""  # deleted-node pod GC drains through this (always-Normal) queue
+
+READY = "Ready"
+UNKNOWN = "Unknown"
+
+
+class NodeLifecycleController:
+    def __init__(self, clientset, grace: float = 4.0,
+                 noexec_after: float = 2.0, tick: float = 0.5,
+                 primary_qps: float = 2.0, secondary_qps: float = 0.1,
+                 unhealthy_threshold: float = 0.55,
+                 eviction_burst: float = 1.0,
+                 ages_fn: Optional[Callable[[], Dict[str, float]]] = None,
+                 now: Callable[[], float] = time.monotonic):
+        self.cs = clientset
+        self.grace = float(grace)
+        self.noexec_after = float(noexec_after)
+        self.tick = float(tick)
+        self._now = now
+        self._ages = ages_fn or clientset.node_heartbeat_ages
+        self.evictor = RateLimitedEvictor(
+            clientset, primary_qps=primary_qps, secondary_qps=secondary_qps,
+            unhealthy_threshold=unhealthy_threshold, burst=eviction_burst,
+            now=now)
+        self.node_health: Dict[str, str] = {}   # name -> Ready/Unknown
+        self._first_seen: Dict[str, float] = {}  # age fallback (failover)
+        self._unready_at: Dict[str, float] = {}  # Unknown since (our clock)
+        self.reconciles = 0
+        self.taints_noschedule = 0
+        self.taints_noexecute = 0
+        self.taints_lifted = 0
+        self.pods_gc = 0
+        self.age_poll_errors = 0
+        self.taint_errors = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- taint ladder --------------------------------------------------------
+
+    @staticmethod
+    def _our_effects(node) -> set:
+        return {t.effect for t in node.taints if t.key == UNREACHABLE_TAINT}
+
+    def _retaint(self, node, effects) -> bool:
+        """PUT the node with exactly `effects` of OUR taint (every other
+        taint preserved) — idempotent, driven off the informer cache so a
+        settled ladder step never re-PUTs."""
+        w = node_to_wire(node)
+        taints = [t for t in w["taints"] if t["key"] != UNREACHABLE_TAINT]
+        taints.extend({"key": UNREACHABLE_TAINT, "value": "",
+                       "effect": e} for e in sorted(effects))
+        w["taints"] = taints
+        try:
+            self.cs.update_node(node_from_wire(w))
+            return True
+        except Exception:  # noqa: BLE001 - transient: retried next tick
+            self.taint_errors += 1
+            return False
+
+    # -- one reconcile pass --------------------------------------------------
+
+    def reconcile_once(self) -> None:
+        self.reconciles += 1
+        try:
+            ages = self._ages()
+        except Exception:  # noqa: BLE001 - leader unreachable mid-failover
+            self.age_poll_errors += 1
+            return
+        now = self._now()
+        nodes = dict(self.cs.nodes)
+        # Health census first: zone eviction rates must reflect THIS pass's
+        # view before any eviction token is spent.
+        zone_total: Dict[str, int] = {}
+        zone_unhealthy: Dict[str, int] = {}
+        unhealthy = []
+        for name, node in nodes.items():
+            age = ages.get(name)
+            if age is None:
+                # Not in the leader's map (fresh leader after failover, or
+                # registered-elsewhere): age from OUR first sight — one
+                # full grace period before judgment.
+                age = now - self._first_seen.setdefault(name, now)
+            zone = node.labels.get(ZONE_LABEL, "")
+            zone_total[zone] = zone_total.get(zone, 0) + 1
+            if age >= self.grace:
+                self.node_health[name] = UNKNOWN
+                zone_unhealthy[zone] = zone_unhealthy.get(zone, 0) + 1
+                unhealthy.append((name, node, zone))
+            else:
+                if self.node_health.get(name) == UNKNOWN:
+                    self._recover_node(name, node)
+                self.node_health[name] = READY
+                self._unready_at.pop(name, None)
+        for zone, total in zone_total.items():
+            self.evictor.set_zone_state(
+                zone, zone_unhealthy.get(zone, 0), total)
+        for name, node, zone in unhealthy:
+            self._degrade_node(name, node, zone, now)
+        self._gc_pods(nodes)
+        self.evictor.run_once()
+        # Forget state for nodes that left the cluster.
+        for name in list(self.node_health):
+            if name not in nodes:
+                self.node_health.pop(name, None)
+                self._unready_at.pop(name, None)
+                self._first_seen.pop(name, None)
+
+    def _degrade_node(self, name: str, node, zone: str, now: float) -> None:
+        """Climb the taint ladder for one Unknown node and, once it holds
+        NoExecute, queue its bound pods for rate-limited eviction."""
+        since = self._unready_at.setdefault(name, now)
+        have = self._our_effects(node)
+        want = {"NoSchedule"}
+        if now - since >= self.noexec_after:
+            want = {"NoSchedule", "NoExecute"}
+        if want != have:
+            if not self._retaint(node, want):
+                return
+            if "NoExecute" in want and "NoExecute" not in have:
+                self.taints_noexecute += 1
+            elif "NoSchedule" not in have:
+                self.taints_noschedule += 1
+        if "NoExecute" in want:
+            for pod in list(self.cs.pods.values()):
+                if pod.node_name == name:
+                    self.evictor.enqueue(zone, name, pod.uid)
+
+    def _recover_node(self, name: str, node) -> None:
+        """Heartbeats returned mid-ladder: lift our taints and cancel any
+        eviction still queued off this node — taint-lift-mid-wave means
+        those pods keep their placement."""
+        self.evictor.cancel_node(name)
+        if self._our_effects(node):
+            if self._retaint(node, set()):
+                self.taints_lifted += 1
+
+    def _gc_pods(self, nodes: Dict[str, object]) -> None:
+        """Pods bound to a node that no longer exists: reap through the
+        same eviction funnel (rate-limited + intent-ledgered), so node
+        deletion mid-wave cannot double-release anything either."""
+        for pod in list(self.cs.pods.values()):
+            if pod.node_name and pod.node_name not in nodes:
+                if self.evictor.enqueue(GC_ZONE, pod.node_name, pod.uid):
+                    self.pods_gc += 1
+
+    # -- standing loop -------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="node-lifecycle", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.reconcile_once()
+            if self._stop.wait(self.tick):
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        ev = self.evictor
+        return {
+            "reconciles": self.reconciles,
+            "nodes_unknown": sum(1 for s in self.node_health.values()
+                                 if s == UNKNOWN),
+            "taints_noschedule": self.taints_noschedule,
+            "taints_noexecute": self.taints_noexecute,
+            "taints_lifted": self.taints_lifted,
+            "pods_gc": self.pods_gc,
+            "age_poll_errors": self.age_poll_errors,
+            "taint_errors": self.taint_errors,
+            "evictions": ev.evictions_total,
+            "evictions_throttled": ev.evictions_throttled_total,
+            "evictions_replayed": ev.evictions_replayed,
+            "evictions_cancelled": ev.evictions_cancelled,
+            "eviction_errors": ev.eviction_errors,
+            "zone_states": dict(ev.zone_states),
+        }
+
+    def metrics_text(self) -> str:
+        """Prometheus text: the `node_lifecycle_*` series the chaos
+        acceptance asserts (evictions + throttle), plus ladder/GC/zone
+        observability."""
+        ev = self.evictor
+        out = []
+        for name, v in (
+                ("node_lifecycle_evictions_total", ev.evictions_total),
+                ("node_lifecycle_evictions_throttled_total",
+                 ev.evictions_throttled_total),
+                ("node_lifecycle_evictions_replayed_total",
+                 ev.evictions_replayed),
+                ("node_lifecycle_evictions_cancelled_total",
+                 ev.evictions_cancelled),
+                ("node_lifecycle_eviction_errors_total", ev.eviction_errors),
+                ("node_lifecycle_taints_noschedule_total",
+                 self.taints_noschedule),
+                ("node_lifecycle_taints_noexecute_total",
+                 self.taints_noexecute),
+                ("node_lifecycle_taints_lifted_total", self.taints_lifted),
+                ("node_lifecycle_pods_gc_total", self.pods_gc),
+                ("node_lifecycle_reconciles_total", self.reconciles)):
+            out.append(f"# TYPE {name} counter")
+            out.append(f"{name} {v}")
+        out.append("# TYPE node_lifecycle_nodes_unknown gauge")
+        out.append("node_lifecycle_nodes_unknown %d"
+                   % sum(1 for s in self.node_health.values()
+                         if s == UNKNOWN))
+        out.append("# TYPE node_lifecycle_zone_state gauge")
+        level = {ZONE_PARTIAL: 1, ZONE_FULL: 2}
+        for zone, state in sorted(ev.zone_states.items()):
+            out.append('node_lifecycle_zone_state{zone="%s"} %d'
+                       % (zone, level.get(state, 0)))
+        return "\n".join(out) + "\n"

@@ -316,6 +316,47 @@ def node_from_wire(d: dict) -> Node:
     return n
 
 
+def pod_group_to_wire(g) -> dict:
+    """PodGroup / CompositePodGroup wire. One kind ("podgroups") carries
+    both object classes — a `composite` flag picks the decode — because
+    they share a handler channel everywhere else (the FakeClientset fans
+    both through on_pod_group_event, handlers type-switch)."""
+    from ..api.types import CompositePodGroup
+    d = {"name": g.name, "namespace": g.namespace, "uid": g.uid,
+         "priority": int(g.priority),
+         "parentName": g.parent_name,
+         "composite": isinstance(g, CompositePodGroup)}
+    if not d["composite"]:
+        d["minCount"] = int(g.min_count)
+        d["labels"] = dict(g.labels)
+        d["topologyKeys"] = list(g.topology_keys)
+    return d
+
+
+def pod_group_from_wire(d: dict):
+    from ..api.types import CompositePodGroup, PodGroup
+    if d.get("composite"):
+        return CompositePodGroup(
+            name=d["name"], namespace=d.get("namespace") or "default",
+            uid=d.get("uid", ""), parent_name=d.get("parentName", ""),
+            priority=int(d.get("priority", 0)))
+    return PodGroup(
+        name=d["name"], namespace=d.get("namespace") or "default",
+        uid=d.get("uid", ""), min_count=int(d.get("minCount", 0)),
+        priority=int(d.get("priority", 0)),
+        labels=dict(d.get("labels", {})),
+        topology_keys=tuple(d.get("topologyKeys", ())),
+        parent_name=d.get("parentName", ""))
+
+
+# Node-lifecycle plane (kubernetes_tpu/controllers/): the taint the
+# controller PUTs on a silent node, and the annotation an evicted-then-
+# recreated pod carries (stamped server-side in the eviction subresource,
+# under the write lock) so the scheduler can count eviction requeues.
+UNREACHABLE_TAINT = "node.kubernetes.io/unreachable"
+EVICTED_ANNOTATION = "node-lifecycle.kubernetes.io/evicted"
+
+
 # ---------------------------------------------------------------------------
 # The apiserver
 # ---------------------------------------------------------------------------
@@ -396,7 +437,8 @@ class APIServer:
                  backlog: int = 8192, data_dir: Optional[str] = None,
                  fsync: bool = False, snapshot_every: int = 2048):
         self.store = store or FakeClientset()
-        self._watchers: Dict[str, List[_WatchStream]] = {"pods": [], "nodes": []}
+        self._watchers: Dict[str, List[_WatchStream]] = {
+            "pods": [], "nodes": [], "podgroups": []}
         self._lock = threading.Lock()
         # Shard-plane coordination (shard/leases.py): named lease records,
         # renewed through PUT /api/v1/leases/<name> with holder-CAS semantics
@@ -420,7 +462,7 @@ class APIServer:
         self._write_lock = threading.Lock()
         from collections import deque
         import uuid
-        self._seq: Dict[str, int] = {"pods": 0, "nodes": 0}
+        self._seq: Dict[str, int] = {"pods": 0, "nodes": 0, "podgroups": 0}
         # Watch-cache read plane (core/watchcache.py): per-kind rv-indexed
         # event ring (the RESUME window — what the old `_backlog` deques
         # held, now carrying the decoded event too so filtered streams can
@@ -429,7 +471,8 @@ class APIServer:
         # longer touch the store dicts or the write lock at all.
         self.watch_cache: Dict[str, WatchCache] = {
             "pods": WatchCache("pods", capacity=backlog),
-            "nodes": WatchCache("nodes", capacity=backlog)}
+            "nodes": WatchCache("nodes", capacity=backlog),
+            "podgroups": WatchCache("podgroups", capacity=backlog)}
         self.watch_slim_events = 0       # events delivered as slim wire
         self.watch_filtered_events = 0   # events dropped entirely
         # Wire-plane accounting (core/wire.py): bytes served/consumed per
@@ -461,6 +504,21 @@ class APIServer:
         self.snapshot_bootstrap_pages = 0
         self.watch_replay_pages = 0  # lazy-cursor attach replay pages served
         self.node_heartbeats = 0   # kubelet/hollow heartbeat sink hits
+        # Node-lifecycle health plane: per-node last-heartbeat stamp
+        # (monotonic, LEADER-LOCAL — heartbeats are a sink, never WAL'd, so
+        # a promoted replica starts empty and the controller re-ages the
+        # fleet from first sight). Own lock: stamped on the heartbeat fast
+        # path which must not touch the write or broadcast locks.
+        self.node_hb: Dict[str, float] = {}
+        self._hb_lock = threading.Lock()
+        # Eviction idempotency ledger (pod uid -> last eviction intent id):
+        # rides the WAL as "evictions" records so a controller retry —
+        # across its own restart or an apiserver failover — replays as a
+        # no-op instead of double-evicting. Mutated only under the write
+        # lock (the eviction subresource / frame apply / recovery).
+        self.evictions: Dict[str, str] = {}
+        self.pod_evictions = 0           # evictions committed
+        self.pod_evictions_replayed = 0  # idempotent replays answered
         # Overload protection (core/flowcontrol.py, docs/RESILIENCE.md
         # § overload & fairness): every mutating request is classified into
         # a flow and admitted through per-priority-level bounded-concurrency
@@ -518,6 +576,13 @@ class APIServer:
             self._recover()
         self.store.on_pod_event(self._pod_event)
         self.store.on_node_event(self._node_event)
+        # Muted registration: on_pod_group_event replays every existing
+        # group at subscribe time (informer list semantics) — recovered
+        # groups were already reinstalled into the watch cache and must
+        # not re-broadcast as fresh WAL'd events.
+        self._pg_mute = True
+        self.store.on_pod_group_event(self._pod_group_event)
+        self._pg_mute = False
         self._httpd: Optional[ThreadingHTTPServer] = None
         # Accepted connections (REST keep-alive + watch streams), so
         # shutdown() can tear them down: pooled clients (KeepAliveClient)
@@ -543,7 +608,7 @@ class APIServer:
         reflectors reconnecting with their last rv get RESUME, not Replace."""
         import itertools
 
-        rings: Dict[str, list] = {"pods": [], "nodes": []}
+        rings: Dict[str, list] = {"pods": [], "nodes": [], "podgroups": []}
         snap, records = self.persistence.load()
         if self.persistence.epoch is not None:
             self.epoch = self.persistence.epoch
@@ -567,8 +632,13 @@ class APIServer:
                 self._apply_recovered("pods", "ADDED", w)
             for w in snap.get("nodes", ()):
                 self._apply_recovered("nodes", "ADDED", w)
+            for w in snap.get("podgroups", ()):
+                self._apply_recovered("podgroups", "ADDED", w)
             for w in snap.get("leases", ()):
                 self._install_lease(w)
+            for w in snap.get("evictions", ()):
+                if w.get("uid"):
+                    self.evictions[w["uid"]] = w.get("intent", "")
         for rec in records:
             seq = rec.get("seq")
             if seq is not None and seq > self._repl_seq:
@@ -585,7 +655,14 @@ class APIServer:
                 # dead one expires exactly one lease period after recovery.
                 self._install_lease(rec.get("object") or {})
                 continue
-            if kind not in ("pods", "nodes"):
+            if kind == "evictions":
+                # Eviction intent ledger: replayed so a controller retry
+                # after OUR restart still answers idempotently.
+                obj = rec.get("object") or {}
+                if obj.get("uid"):
+                    self.evictions[obj["uid"]] = obj.get("intent", "")
+                continue
+            if kind not in ("pods", "nodes", "podgroups"):
                 continue
             self._apply_recovered(kind, rec.get("type", ""), rec.get("object"))
             rv = rec.get("rv")
@@ -614,7 +691,20 @@ class APIServer:
             self.watch_cache["nodes"].reinstall(
                 [node_to_wire(n) for n in self.store.nodes.values()],
                 self._seq["nodes"], ring=rings["nodes"][-cap:])
+            self.watch_cache["podgroups"].reinstall(
+                [pod_group_to_wire(g) for g in
+                 list(self.store.pod_groups.values())
+                 + list(self.store.composite_pod_groups.values())],
+                self._seq["podgroups"], ring=rings["podgroups"][-cap:])
         self.recovered_objects = len(self.store.pods) + len(self.store.nodes)
+        # Recovered nodes heartbeat-age from NOW: clocks never cross a
+        # process boundary (same contract as lease renew stamps) — a live
+        # node re-stamps within one period, a dead one ages out exactly one
+        # grace period after recovery.
+        now = time.monotonic()
+        with self._hb_lock:
+            for name in self.store.nodes:
+                self.node_hb[name] = now
         # Rebuild the Omega commit-validation usage table from the recovered
         # bound pods — incremental maintenance resumes from here.
         self._usage.clear()
@@ -649,6 +739,15 @@ class APIServer:
                     self.store.bindings[pod.uid] = pod.node_name
                 else:
                     self.store.bindings.pop(pod.uid, None)
+        elif kind == "podgroups":
+            g = pod_group_from_wire(wire)
+            target = (self.store.composite_pod_groups
+                      if wire.get("composite") else self.store.pod_groups)
+            key = f"{g.namespace}/{g.name}"
+            if typ == "DELETED":
+                target.pop(key, None)
+            else:
+                target[key] = g
         else:
             node = node_from_wire(wire)
             if typ == "DELETED":
@@ -740,8 +839,13 @@ class APIServer:
             "repl": {"seq": self._repl_seq, "epoch": self.repl_epoch},
             "pods": [pod_to_wire(p) for p in list(self.store.pods.values())],
             "nodes": [node_to_wire(n) for n in list(self.store.nodes.values())],
+            "podgroups": [pod_group_to_wire(g) for g in
+                          list(self.store.pod_groups.values())
+                          + list(self.store.composite_pod_groups.values())],
             "leases": [dict(rec, name=name, renew=None)
                        for name, rec in list(self.leases.items())],
+            "evictions": [{"uid": u, "intent": i}
+                          for u, i in list(self.evictions.items())],
         }
 
     # -- Omega commit validation (per-node committed usage) -----------------
@@ -963,7 +1067,14 @@ class APIServer:
                 kind = rec.get("kind")
                 if kind == "leases":
                     self._install_lease(rec.get("object") or {})
-                elif kind in ("pods", "nodes"):
+                elif kind == "evictions":
+                    # Replicated intent ledger: a promoted follower must
+                    # answer an in-flight eviction wave's retries
+                    # idempotently — losing this would double-evict.
+                    obj = rec.get("object") or {}
+                    if obj.get("uid"):
+                        self.evictions[obj["uid"]] = obj.get("intent", "")
+                elif kind in ("pods", "nodes", "podgroups"):
                     self._apply_recovered(kind, rec.get("type", ""),
                                           rec.get("object"))
                     rv = rec.get("rv")
@@ -1009,14 +1120,22 @@ class APIServer:
                 self.store.pods.clear()
                 self.store.nodes.clear()
                 self.store.bindings.clear()
+                self.store.pod_groups.clear()
+                self.store.composite_pod_groups.clear()
                 self.leases.clear()
+                self.evictions.clear()
                 self._seq.update(snap.get("seq", {}))
                 for w in snap.get("pods", ()):
                     self._apply_recovered("pods", "ADDED", w)
                 for w in snap.get("nodes", ()):
                     self._apply_recovered("nodes", "ADDED", w)
+                for w in snap.get("podgroups", ()):
+                    self._apply_recovered("podgroups", "ADDED", w)
                 for w in snap.get("leases", ()):
                     self._install_lease(w)
+                for w in snap.get("evictions", ()):
+                    if w.get("uid"):
+                        self.evictions[w["uid"]] = w.get("intent", "")
                 repl = snap.get("repl") or {}
                 self._repl_seq = int(repl.get("seq", 0))
                 self.repl_epoch = max(self.repl_epoch,
@@ -1034,7 +1153,10 @@ class APIServer:
                     list(snap.get("pods", ())), self._seq.get("pods", 0))
                 self.watch_cache["nodes"].reinstall(
                     list(snap.get("nodes", ())), self._seq.get("nodes", 0))
-                for kind in ("pods", "nodes"):
+                self.watch_cache["podgroups"].reinstall(
+                    list(snap.get("podgroups", ())),
+                    self._seq.get("podgroups", 0))
+                for kind in self._watchers:
                     for w in self._watchers[kind]:
                         w.q.put(None)
                 if self.persistence is not None:
@@ -1129,7 +1251,7 @@ class APIServer:
         WireItem: each stream's consumer encodes it in its own codec."""
         item = wire.WireItem(event)
         with self._lock:
-            for kind in ("pods", "nodes"):
+            for kind in self._watchers:
                 for w in self._watchers[kind]:
                     w.q.put(item)
 
@@ -1228,14 +1350,17 @@ class APIServer:
                 # the window (410-too-old -> full re-list), and the
                 # shard-filter's slimmed/suppressed event counts.
                 ("apiserver_watch_cache_hits_total",
-                 self.watch_cache["pods"].hits
-                 + self.watch_cache["nodes"].hits),
+                 sum(wc.hits for wc in self.watch_cache.values())),
                 ("apiserver_watch_cache_resumes_total",
-                 self.watch_cache["pods"].resumes
-                 + self.watch_cache["nodes"].resumes),
+                 sum(wc.resumes for wc in self.watch_cache.values())),
                 ("apiserver_watch_cache_too_old_total",
-                 self.watch_cache["pods"].too_old
-                 + self.watch_cache["nodes"].too_old),
+                 sum(wc.too_old for wc in self.watch_cache.values())),
+                # Incremental paged-LIST key index: full re-sorts actually
+                # paid (lazy builds after reinstall / first page) — a
+                # churning hollow fleet must hold this near-constant
+                # instead of re-sorting 50k keys per page.
+                ("apiserver_watch_cache_key_resorts_total",
+                 sum(wc.key_resorts for wc in self.watch_cache.values())),
                 ("apiserver_watch_events_slim_total", self.watch_slim_events),
                 ("apiserver_watch_events_filtered_out_total",
                  self.watch_filtered_events),
@@ -1252,7 +1377,14 @@ class APIServer:
                 ("apiserver_snapshot_bootstrap_pages_total",
                  self.snapshot_bootstrap_pages),
                 ("apiserver_node_heartbeats_total",
-                 self.node_heartbeats)):
+                 self.node_heartbeats),
+                # Eviction subresource (node-lifecycle controller plane):
+                # committed DELETE-then-recreate evictions, and idempotent
+                # intent replays answered without touching the pod —
+                # exactly-once across controller restart and failover.
+                ("apiserver_pod_evictions_total", self.pod_evictions),
+                ("apiserver_pod_evictions_replayed_total",
+                 self.pod_evictions_replayed)):
             out.append(f"# TYPE {name} counter")
             out.append(f"{name} {v}")
         # Flow-control plane (core/flowcontrol.py): per-priority-level
@@ -1401,6 +1533,95 @@ class APIServer:
     def _node_event(self, kind: str, old, new) -> None:
         typ = {"add": "ADDED", "update": "MODIFIED", "delete": "DELETED"}[kind]
         self._broadcast("nodes", {"type": typ, "object": node_to_wire(new)})
+
+    def _pod_group_event(self, group) -> None:
+        # Pod groups are create-only upserts on this surface (the store has
+        # no update/delete verb), so every event is ADDED. Muted during
+        # registration: the store replays recovered groups at subscribe
+        # time and those are already in the WAL + watch cache.
+        if self._pg_mute:
+            return
+        self._broadcast("podgroups",
+                        {"type": "ADDED", "object": pod_group_to_wire(group)})
+
+    # -- node-lifecycle health plane (controllers/node_lifecycle.py) --------
+
+    def _note_heartbeats(self, names) -> None:
+        """Stamp last-heartbeat for `names` on THIS process's clock. Called
+        from the heartbeat sink and node create/PUT paths; never WAL'd."""
+        now = time.monotonic()
+        with self._hb_lock:
+            for n in names:
+                self.node_hb[n] = now
+
+    def _drop_heartbeat(self, name: str) -> None:
+        with self._hb_lock:
+            self.node_hb.pop(name, None)
+
+    def heartbeat_ages(self) -> Dict[str, float]:
+        """Seconds since each node's last heartbeat (leader-local truth —
+        the GET /api/v1/nodes/heartbeats surface the lifecycle controller
+        polls; followers answer 421 so the client leader-routes)."""
+        now = time.monotonic()
+        with self._hb_lock:
+            snap = dict(self.node_hb)
+        return {n: round(now - t, 3) for n, t in snap.items()}
+
+    # -- eviction subresource (POST /api/v1/pods/<uid>/eviction) ------------
+
+    def _evict_locked(self, uid: str, body: dict):
+        """Evict one bound pod: DELETE-then-recreate-pending, so the
+        scheduler re-places it through the normal queue. Caller holds the
+        write lock. Idempotent by intent id: the (uid, intent) pair is
+        ledgered in `self.evictions` and WAL'd, so any retry — controller
+        restart, or replay against a promoted leader — answers
+        `already=True` without touching the pod. Mutation-before-ledger is
+        the crash-safe order: a crash between them leaves a pending pod
+        the retry sees as already-evicted work (no-op), whereas
+        ledger-first could ack an eviction that never happened."""
+        intent = str(body.get("intent") or "")
+        want_node = str(body.get("node") or "")
+        if not intent:
+            return 400, {"error": "intent required"}
+        if self.evictions.get(uid) == intent:
+            self.pod_evictions_replayed += 1
+            return 200, {"evicted": True, "already": True}
+        pod = self.store.pods.get(uid)
+        if pod is None:
+            return 404, {"error": "pod not found"}
+        if not pod.node_name:
+            # Already pending (a prior wave's recreate, or never bound):
+            # nothing to evict — and NOT a ledger entry, so a later bind
+            # to a fresh failing node can still be evicted under a new
+            # intent.
+            return 200, {"evicted": False, "pending": True}
+        if want_node and pod.node_name != want_node:
+            # The pod moved since the controller planned this eviction
+            # (taint lifted / already rescheduled): refuse — evicting a
+            # healthy placement would be the storm the rate limiter exists
+            # to prevent.
+            return 409, {"error": "NodeMismatch", "node": pod.node_name}
+        if pod.finalizers:
+            return 409, {"error": "FinalizerParked"}
+        bound_to = pod.node_name
+        self.store.delete_pod(pod)
+        if uid in self.store.pods:
+            return 409, {"error": "FinalizerParked"}
+        self._usage_apply(bound_to, pod, -1)
+        w = pod_to_wire(pod)
+        w["nodeName"] = ""
+        w["nominatedNodeName"] = ""
+        ann = dict(w.get("annotations") or {})
+        ann[EVICTED_ANNOTATION] = intent
+        w["annotations"] = ann
+        self.store.create_pod(pod_from_wire(w))
+        with self._lock:
+            self._repl_append({"kind": "evictions", "type": "EVICT",
+                               "object": {"uid": uid, "intent": intent,
+                                          "node": bound_to}})
+        self.evictions[uid] = intent
+        self.pod_evictions += 1
+        return 200, {"evicted": True, "node": bound_to}
 
     def _attach_watch(self, kind: str, since: Optional[int] = None,
                       epoch: Optional[str] = None,
@@ -1593,7 +1814,7 @@ class APIServer:
                 GIL-atomic get — no lock, a racing delete just falls back
                 to the default flow)."""
                 path, body = self.path, self._body_cache
-                if path == "/api/v1/pods":
+                if path in ("/api/v1/pods", "/api/v1/podgroups"):
                     if isinstance(body, list):
                         return (body[0].get("namespace", "")
                                 if body else "")
@@ -1710,6 +1931,30 @@ class APIServer:
                     server.list_unpaged += 1
                     return self._json(200,
                                       server.watch_cache["nodes"].list_wire())
+                if path == "/api/v1/nodes/heartbeats":
+                    # Heartbeat ages are LEADER-LOCAL (the sink is never
+                    # WAL'd): a follower answering from its empty/stale map
+                    # would age out the whole fleet — 421 so the lifecycle
+                    # controller's client leader-routes this GET.
+                    if server.role != "leader":
+                        return self._json(421, {"error": "NotLeader",
+                                                "leader": server.leader_url})
+                    return self._json(200, {"ages": server.heartbeat_ages()})
+                if path == "/api/v1/podgroups":
+                    if watch:
+                        return self._stream("podgroups", since, epoch,
+                                            paged=paged, fresh=fresh)
+                    if limit:
+                        return self._list_paged("podgroups", limit, cont)
+                    server.list_unpaged += 1
+                    return self._json(
+                        200, server.watch_cache["podgroups"].list_wire())
+                if path == "/flow":
+                    # APF admin surface: current per-level weights + live
+                    # admission counters (the POST half re-weights).
+                    return self._json(
+                        200, {"levels": server.flowcontrol.snapshot(),
+                              "weights": server.flowcontrol.weights()})
                 if path == "/metrics/resources":
                     # kube_pod_resource_request rendered straight from the
                     # watch cache's wire snapshot: harness pollers scrape
@@ -2107,6 +2352,23 @@ class APIServer:
                     elif url and ep >= server.repl_epoch:
                         server.note_leader(url, ep)
                     return self._json(200, {"replEpoch": server.repl_epoch})
+                if self.path == "/flow":
+                    # Live APF re-weight (operator plane, accepted in ANY
+                    # role — each replica admits with its own controller).
+                    # Applied under the FlowController's OWN lock, never
+                    # the write lock: re-weighting mid-storm must not queue
+                    # behind the flooded write plane it is trying to fix.
+                    server.flowcontrol.count_exempt()
+                    body = self._body()
+                    level = str(body.get("level") or "")
+                    try:
+                        got = server.flowcontrol.set_weights(
+                            level, body.get("weights") or {})
+                    except KeyError:
+                        return self._json(404, {"error": "unknown level"})
+                    except ValueError as e:
+                        return self._json(400, {"error": str(e)})
+                    return self._json(200, {"level": level, "weights": got})
                 if server.role != "leader":
                     return self._json(421, {"error": "NotLeader",
                                             "leader": server.leader_url})
@@ -2191,24 +2453,46 @@ class APIServer:
                                 dup += 1
                                 continue
                             server.store.create_node(node)
+                            server._note_heartbeats((node.name,))
                         return 201, {"created": len(body) - dup,
                                      "alreadyExists": dup}
                     node = node_from_wire(body)
                     if node.name in server.store.nodes:
                         return 409, {"error": "AlreadyExists"}
                     server.store.create_node(node)
+                    # Registration counts as the first heartbeat: a node is
+                    # never born already-silent.
+                    server._note_heartbeats((node.name,))
                     return 201, node_to_wire(node)
                 if (self.path.startswith("/api/v1/nodes/")
                         and self.path.endswith("/status")):
                     # Kubelet heartbeat sink (parity stub, no event). The
                     # hollow plane's bulk form (`/api/v1/nodes/status`,
                     # {"names": [...]}) rides the same branch — one
-                    # request per fleet slice, counted per node.
+                    # request per fleet slice, counted per node. Each name
+                    # stamps the lifecycle controller's freshness map.
                     body = self._body()
                     names = (body.get("names") if isinstance(body, dict)
                              else None) or ()
+                    if not names:
+                        nm = self.path.split("/")[4]
+                        names = (nm,) if nm != "status" else ()
                     server.node_heartbeats += max(1, len(names))
+                    server._note_heartbeats(names)
                     return 200, {}
+                if self.path == "/api/v1/podgroups":
+                    body = self._body()
+                    g = pod_group_from_wire(body)
+                    target = (server.store.composite_pod_groups
+                              if body.get("composite")
+                              else server.store.pod_groups)
+                    if f"{g.namespace}/{g.name}" in target:
+                        return 409, {"error": "AlreadyExists"}
+                    if body.get("composite"):
+                        server.store.create_composite_pod_group(g)
+                    else:
+                        server.store.create_pod_group(g)
+                    return 201, pod_group_to_wire(g)
                 if self.path == "/api/v1/bindings":
                     # Bulk binding commits: one request, one write-lock
                     # acquisition for a whole drained dispatcher queue
@@ -2222,6 +2506,9 @@ class APIServer:
                             for item in self._body())]
                     return 200, out
                 parts = self.path.split("/")
+                if (self.path.startswith("/api/v1/pods/")
+                        and self.path.endswith("/eviction")):
+                    return server._evict_locked(parts[4], self._body())
                 if (self.path.startswith("/api/v1/pods/")
                         and self.path.endswith("/binding")):
                     return server._bind_one(
@@ -2291,7 +2578,11 @@ class APIServer:
             def _put_locked(self):
                 if (self.path.startswith("/api/v1/nodes/")
                         and self.path.endswith("/status")):
-                    return 200, {}  # heartbeat parity stub
+                    # heartbeat parity stub — stamps freshness, no event
+                    nm = self.path.split("/")[4]
+                    if nm != "status":
+                        server._note_heartbeats((nm,))
+                    return 200, {}
                 # Node update (relabel / retaint / capacity change): the
                 # store fans a MODIFIED event to every watch stream, so
                 # churn workloads run over the wire (eventhandlers.go
@@ -2340,7 +2631,9 @@ class APIServer:
                             server._usage_apply(bound_to, pod, -1)
                     return 200, {}
                 if self.path.startswith("/api/v1/nodes/"):
-                    server.store.delete_node(self.path.split("/")[4])
+                    name = self.path.split("/")[4]
+                    server.store.delete_node(name)
+                    server._drop_heartbeat(name)
                     return 200, {}
                 return 404, {"error": "not found"}
 
@@ -2670,10 +2963,13 @@ class HTTPClientset:
         self.pods: Dict[str, Pod] = {}
         self.nodes: Dict[str, Node] = {}
         self.bindings: Dict[str, str] = {}
-        # unused-surface listers (volume/DRA plugins see empty cluster state)
-        self.namespaces: Dict[str, object] = {}
+        # Gang state over the wire: the podgroups reflector fills these
+        # ("ns/name" keys, same as the FakeClientset) so multi-process
+        # shard members see one gang truth.
         self.pod_groups: Dict[str, object] = {}
         self.composite_pod_groups: Dict[str, object] = {}
+        # unused-surface listers (volume/DRA plugins see empty cluster state)
+        self.namespaces: Dict[str, object] = {}
         self.pvs: Dict[str, object] = {}
         self.pvcs: Dict[str, object] = {}
         self.storage_classes: Dict[str, object] = {}
@@ -2683,29 +2979,33 @@ class HTTPClientset:
         self.device_classes: Dict[str, object] = {}
         self._pod_handlers: List = []
         self._node_handlers: List = []
+        self._pod_group_handlers: List = []
         self._dispatch_lock = threading.Lock()
         self._stop = threading.Event()
         self._responses: List = []
-        self._synced = {"pods": threading.Event(), "nodes": threading.Event()}
+        self._synced = {"pods": threading.Event(), "nodes": threading.Event(),
+                        "podgroups": threading.Event()}
         self._fatal: Dict[str, Exception] = {}
         self.last_sync: Dict[str, float] = {}
         # resourceVersion resume (reflector.go lastSyncResourceVersion):
         # the rv of the last event (or SYNC snapshot) each stream consumed;
         # reconnects ask the server to replay from here instead of
         # re-listing. relists/resumes count how each reconnect was served.
-        self._last_rv: Dict[str, Optional[int]] = {"pods": None, "nodes": None}
+        self._last_rv: Dict[str, Optional[int]] = {
+            "pods": None, "nodes": None, "podgroups": None}
         # Server boot epoch (from SYNC/RESUME): sent with the rv so a
         # restarted server (fresh counters) re-lists instead of resuming.
-        self._epoch: Dict[str, Optional[str]] = {"pods": None, "nodes": None}
-        self.relists: Dict[str, int] = {"pods": 0, "nodes": 0}
-        self.resumes: Dict[str, int] = {"pods": 0, "nodes": 0}
+        self._epoch: Dict[str, Optional[str]] = {
+            "pods": None, "nodes": None, "podgroups": None}
+        self.relists: Dict[str, int] = {"pods": 0, "nodes": 0, "podgroups": 0}
+        self.resumes: Dict[str, int] = {"pods": 0, "nodes": 0, "podgroups": 0}
         self._threads: List[threading.Thread] = []
-        for kind in ("pods", "nodes"):
+        for kind in ("pods", "nodes", "podgroups"):
             t = threading.Thread(target=self._watch_loop, args=(kind,),
                                  name=f"reflector-{kind}", daemon=True)
             t.start()
             self._threads.append(t)
-        for kind in ("pods", "nodes"):
+        for kind in ("pods", "nodes", "podgroups"):
             if not self._synced[kind].wait(sync_timeout):
                 self.close()  # stop the reflector threads before raising
                 raise TimeoutError(f"reflector {kind} never synced")
@@ -2881,6 +3181,30 @@ class HTTPClientset:
     def delete_pod(self, pod: Pod) -> None:
         self._call("DELETE", f"/api/v1/pods/{pod.uid}")
 
+    def evict_pod(self, uid: str, node: str, intent: str) -> dict:
+        """Eviction subresource: DELETE-then-recreate-pending, idempotent
+        by `intent` (the server's WAL'd ledger answers retries with
+        already=True — exactly-once across controller restart/failover).
+        `node` guards against evicting a pod that moved since the plan
+        (409 NodeMismatch)."""
+        return self._call("POST", f"/api/v1/pods/{uid}/eviction",
+                          {"intent": intent, "node": node}) or {}
+
+    def create_pod_group(self, group):
+        self._call("POST", "/api/v1/podgroups", pod_group_to_wire(group))
+        return group
+
+    def create_composite_pod_group(self, cpg):
+        self._call("POST", "/api/v1/podgroups", pod_group_to_wire(cpg))
+        return cpg
+
+    def node_heartbeat_ages(self) -> Dict[str, float]:
+        """Seconds-since-last-heartbeat per node, leader-routed (the ages
+        live only on the leader — followers answer 421 and _write_call
+        follows the redirect even though this is a read)."""
+        got = self._write_call("GET", "/api/v1/nodes/heartbeats") or {}
+        return dict(got.get("ages") or {})
+
     def bind(self, pod: Pod, node_name: str) -> None:
         # Trace propagation (core/spans.py): a sampled pod's bind carries
         # its context in the X-Trace-Context header and records the
@@ -3028,7 +3352,14 @@ class HTTPClientset:
         pass
 
     def on_pod_group_event(self, handler) -> None:
-        pass
+        # Replay-then-subscribe, FakeClientset parity: handlers get every
+        # known group (plain then composite) once, then live upserts.
+        with self._dispatch_lock:
+            for g in list(self.pod_groups.values()):
+                handler(g)
+            for g in list(self.composite_pod_groups.values()):
+                handler(g)
+            self._pod_group_handlers.append(handler)
 
     def on_storage_event(self, handler) -> None:
         pass
@@ -3298,6 +3629,15 @@ class HTTPClientset:
         if kind == "pods":
             for uid in [u for u in self.pods if u not in seen]:
                 self._dispatch(kind, "DELETED", pod_to_wire(self.pods[uid]))
+        elif kind == "podgroups":
+            for key in [k for k in self.pod_groups if k not in seen]:
+                self._dispatch(kind, "DELETED",
+                               pod_group_to_wire(self.pod_groups[key]))
+            for key in [k for k in self.composite_pod_groups
+                        if k not in seen]:
+                self._dispatch(
+                    kind, "DELETED",
+                    pod_group_to_wire(self.composite_pod_groups[key]))
         else:
             for name in [n for n in self.nodes if n not in seen]:
                 self._dispatch(kind, "DELETED", node_to_wire(self.nodes[name]))
@@ -3365,6 +3705,25 @@ class HTTPClientset:
                     self.bindings.pop(pod.uid, None)
             for h in self._pod_handlers:
                 h(action, old, pod)
+        elif kind == "podgroups":
+            g = pod_group_from_wire(obj)
+            target = (self.composite_pod_groups if obj.get("composite")
+                      else self.pod_groups)
+            key = f"{g.namespace}/{g.name}"
+            if action == "delete":
+                # Replace-barrier correction only (the server has no group
+                # delete verb): drop the local copy, no handler channel for
+                # group deletion exists (FakeClientset parity).
+                target.pop(key, None)
+                return
+            known = key in target
+            target[key] = g
+            if not known:
+                # Single-arg handler fanout, FakeClientset parity: only
+                # first sight fans out — a re-list replay of a known group
+                # must not re-register it with the gang queue.
+                for h in self._pod_group_handlers:
+                    h(g)
         else:
             node = node_from_wire(obj)
             old = self.nodes.get(node.name)

@@ -340,6 +340,10 @@ class RetryingClientset:
         "create_pv", "create_pvc", "create_storage_class", "create_csi_node",
         "create_resource_slice", "create_resource_claim",
         "create_device_class", "bind_volume", "remove_pod_finalizers",
+        # Safe to replay blindly: the eviction subresource is idempotent by
+        # intent id (the server's WAL'd ledger answers a replay with
+        # already=True instead of double-evicting).
+        "evict_pod",
     })
 
     def __init__(self, inner, retry=None):

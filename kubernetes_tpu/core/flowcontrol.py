@@ -253,6 +253,35 @@ class FlowController:
         with self._lock:
             self.levels[EXEMPT].dispatched += 1
 
+    # -- live re-weighting (/flow admin endpoint) ---------------------------
+
+    def weights(self) -> Dict[str, Dict[str, float]]:
+        """Per-level flow weights (the /flow GET surface)."""
+        with self._lock:
+            return {name: dict(lvl.flow_weights)
+                    for name, lvl in self.levels.items()}
+
+    def set_weights(self, level_name: str,
+                    weights: Dict[str, float]) -> Dict[str, float]:
+        """Re-weight flows inside one priority level, live, under THIS
+        controller's lock — never the server's write lock (the /flow POST
+        surface; lets operators starve down a flood tenant mid-storm).
+        The exempt lane takes no weights by design (it has no queues).
+        Raises KeyError for an unknown level, ValueError for the exempt
+        lane or a non-positive weight."""
+        with self._lock:
+            lvl = self.levels[level_name]
+            if lvl.exempt:
+                raise ValueError("exempt lane is not re-weightable")
+            staged = {}
+            for flow, w in weights.items():
+                w = float(w)
+                if w <= 0:
+                    raise ValueError(f"weight for {flow!r} must be > 0")
+                staged[str(flow)] = w
+            lvl.flow_weights.update(staged)
+            return dict(lvl.flow_weights)
+
     def retry_after(self, level_name: str) -> int:
         """The Retry-After seconds a shed reply carries: at least the
         level's queue-wait horizon, scaled up when the backlog is deep —

@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..api.types import Pod
+from .apiserver import EVICTED_ANNOTATION
 from .cache import (
     EV_NAMESPACE,
     EV_NODE_UPDATE,
@@ -431,6 +432,11 @@ class Scheduler:
         # one requeued through the conflict-style backoff path with its
         # original queue-admission stamp preserved.
         self.shed_requeues = 0
+        # Pods re-entering the queue after a node-lifecycle eviction (the
+        # server's recreate carries the eviction-intent annotation). One
+        # eviction = one recreate event = exactly one bump — the chaos
+        # acceptance diffs this against the controller's evictions_total.
+        self.eviction_requeues = 0
         # Per-cycle hook (run_until_idle): the shard member's ownership
         # refresh runs here so queue-mutating failover stays on the
         # scheduling thread even through long drains.
@@ -592,6 +598,8 @@ class Scheduler:
                     and not getattr(new, "wire_slim", False)):
                 # A still-slim pod (hydration failed) must never be
                 # SCHEDULED from its projection; the sweep retries it.
+                if EVICTED_ANNOTATION in new.annotations:
+                    self.eviction_requeues += 1
                 self.queue.add(new)
         elif kind == "update":
             if new.node_name:
@@ -1797,6 +1805,7 @@ class Scheduler:
                  getattr(self, "device_batches", 0)),
                 ("scheduler_state_unwinds_total", self.state_unwinds),
                 ("scheduler_conflict_requeues_total", self.conflict_requeues),
+                ("scheduler_eviction_requeues_total", self.eviction_requeues),
                 ("scheduler_attempts_total", self.attempts)):
             extra.append(f"# TYPE {name} counter")
             extra.append(f"{name} {float(val)}")

@@ -69,7 +69,13 @@ from . import wire
 
 
 def wire_key(kind: str, obj: dict) -> str:
-    return obj["uid"] if kind == "pods" else obj["name"]
+    if kind == "pods":
+        return obj["uid"]
+    if kind == "podgroups":
+        # Pod groups are namespaced; "ns/name" matches the store/clientset
+        # keying so one key space spans the wire and both local maps.
+        return f'{obj.get("namespace") or "default"}/{obj["name"]}'
+    return obj["name"]
 
 
 # ---------------------------------------------------------------------------
@@ -274,11 +280,15 @@ class WatchCache:
         self.hits = 0       # list/summary/uids/resource reads served
         self.resumes = 0    # interval replays served from the ring
         self.too_old = 0    # resume rvs that fell off the window (410)
-        # Sorted-key cache for paged lists: (validity stamp, keys). Pages
-        # iterate the snapshot in sorted-key order so a continuation token
-        # names a stable position; the sort is cached per (rv, size) so a
-        # quiet cluster pays it once per list, not once per page.
-        self._skeys: Optional[Tuple[Tuple[int, int], List[str]]] = None
+        # Sorted-key index for paged lists: pages iterate the snapshot in
+        # sorted-key order so a continuation token names a stable
+        # position. Built lazily by the FIRST page served, then maintained
+        # incrementally (insort on insert, bisect-remove on delete) by the
+        # broadcast path — a churning 50k-node fleet no longer pays a full
+        # re-sort per page (docs/SCALE.md). `key_resorts` counts full
+        # sorts actually paid (lazy build + post-reinstall rebuilds).
+        self._skeys: Optional[List[str]] = None
+        self.key_resorts = 0
 
     # -- mutation (broadcast path; caller holds the server's _lock) ---------
 
@@ -312,6 +322,7 @@ class WatchCache:
         if typ == "DELETED":
             if old is not None:
                 self._objects.pop(key, None)
+                self._skeys_remove(key)
                 if self.kind == "pods":
                     if old.get("nodeName"):
                         self._bound -= 1
@@ -320,6 +331,8 @@ class WatchCache:
             return
         # ADDED / MODIFIED / STATUS: upsert
         self._objects[key] = obj
+        if old is None and self._skeys is not None:
+            bisect.insort(self._skeys, key)
         if self.kind == "pods":
             if bool(obj.get("nodeName")) != bool(
                     old.get("nodeName") if old else False):
@@ -329,11 +342,28 @@ class WatchCache:
             if refs != had:
                 self.selector_refs += 1 if refs else -1
 
+    def _skeys_remove(self, key: str) -> None:
+        """Drop one key from the incremental sorted index (caller holds
+        this cache's lock and has already popped it from the snapshot)."""
+        if self._skeys is None:
+            return
+        i = bisect.bisect_left(self._skeys, key)
+        if i < len(self._skeys) and self._skeys[i] == key:
+            del self._skeys[i]
+        else:
+            # Index out of step with the snapshot (should be impossible):
+            # fail safe to a rebuild rather than serve a phantom page.
+            self._skeys = None
+
     def reinstall(self, objects: List[dict], rv: int,
                   ring: Optional[List[Tuple[int, dict, bytes]]] = None) -> None:
         """Replace the whole cache (recovery seed / snapshot install).
         Caller holds the server's broadcast lock."""
         with self._lock:
+            # Drop the sorted-key index FIRST so the apply loop below
+            # doesn't insort into the dead generation's list; the next
+            # page rebuilds it lazily from the installed snapshot.
+            self._skeys = None
             self._objects = {}
             self._bound = 0
             self.selector_refs = 0
@@ -343,11 +373,6 @@ class WatchCache:
             for entry in ring or ():
                 self._ring.append(entry)
             self.rv = max(rv, self._ring[-1][0] if self._ring else 0)
-            # The (rv, size) stamp can COLLIDE across an install (an
-            # epoch-fork snapshot may regress rv and land on the same
-            # size with different keys): drop the sorted-key cache
-            # explicitly, never trust the stamp across a reinstall.
-            self._skeys = None
 
     # -- reads (own lock ONLY; never under the server's _write_lock) --------
 
@@ -395,10 +420,10 @@ class WatchCache:
             if anchor_rv is not None and not self._covers(anchor_rv):
                 self.too_old += 1
                 return None
-            stamp = (self.rv, len(self._objects))
-            if self._skeys is None or self._skeys[0] != stamp:
-                self._skeys = (stamp, sorted(self._objects))
-            keys = self._skeys[1]
+            if self._skeys is None:
+                self._skeys = sorted(self._objects)
+                self.key_resorts += 1
+            keys = self._skeys
             i = bisect.bisect_right(keys, last_key) if last_key else 0
             page = keys[i:i + limit]
             objs = [self._objects[k] for k in page]

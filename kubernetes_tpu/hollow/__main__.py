@@ -2,7 +2,9 @@
 
     python -m kubernetes_tpu.hollow --api-url http://127.0.0.1:PORT \
         [--profile profile.json] [--count N] [--heartbeat S] \
-        [--drift F] [--churn R] [--zones Z] [--prefix P]
+        [--drift F] [--churn R] [--zones Z] [--prefix P] \
+        [--silence F] [--silence-after S] [--flap F] \
+        [--outage-zone Z] [--outage-after S]
 
 Registers the fleet, prints the ready line the spawn harness keys on
 (``hollow-node plane: registered N nodes``), then heartbeats/churns until
@@ -38,6 +40,17 @@ def main(argv=None) -> int:
                     help="cordon/delete/re-register waves per second")
     ap.add_argument("--zones", type=int, default=-1)
     ap.add_argument("--prefix", default="")
+    ap.add_argument("--silence", type=float, default=-1.0,
+                    help="fraction of the fleet that goes permanently "
+                         "silent (dead kubelets)")
+    ap.add_argument("--silence-after", type=float, default=-1.0,
+                    help="seconds into the run silence begins")
+    ap.add_argument("--flap", type=float, default=-1.0,
+                    help="fraction of the fleet that flaps silent/alive")
+    ap.add_argument("--outage-zone", type=int, default=-2,
+                    help="zone index to black out entirely (-1 disables)")
+    ap.add_argument("--outage-after", type=float, default=-1.0,
+                    help="seconds into the run the zone outage begins")
     args = ap.parse_args(argv)
 
     profile = (HollowProfile.load(args.profile) if args.profile
@@ -54,6 +67,16 @@ def main(argv=None) -> int:
         profile.zones = args.zones
     if args.prefix:
         profile.name_prefix = args.prefix
+    if args.silence >= 0:
+        profile.silence = args.silence
+    if args.silence_after >= 0:
+        profile.silence_after_s = args.silence_after
+    if args.flap >= 0:
+        profile.flap = args.flap
+    if args.outage_zone >= -1:
+        profile.outage_zone = args.outage_zone
+    if args.outage_after >= 0:
+        profile.outage_after_s = args.outage_after
 
     plane = HollowNodePlane(args.api_url, profile)
     n = plane.register()

@@ -20,7 +20,15 @@ module is that layer for our plane:
 - **churn waves** — at ``churn_per_s``, cordon a victim (unschedulable
   node update), dwell ``churn_cordon_s``, then DELETE it and register a
   fresh replacement of the same shape (fleet size stays constant): the
-  node-lifecycle half of a MixedChurn workload at hollow scale.
+  node-lifecycle half of a MixedChurn workload at hollow scale;
+- **failure injection** — a ``silence`` fraction of the fleet simply
+  stops heartbeating ``silence_after_s`` seconds into the run (the nodes
+  stay registered: a dead kubelet, not a deleted node), a ``flap``
+  fraction alternates silent/alive every ``flap_period_s``, and
+  ``outage_zone`` blacks out one whole topology zone after
+  ``outage_after_s``. Victims are picked deterministically from the
+  profile seed, so a chaos scenario knows EXACTLY which nodes the
+  node-lifecycle controller must declare Unknown and drain.
 
 The plane keeps per-node wire dicts as its only state; everything it
 does to the cluster flows through the public REST surface, so leader
@@ -60,6 +68,15 @@ class HollowNodePlane:
         self._cordoned: Deque[Tuple[float, str]] = deque()
         self._seq = profile.count               # replacement name sequence
         self._rng = random.Random(profile.seed or 0x5ca1e)
+        # Failure-injection victims get their OWN rng stream so enabling
+        # silence/flap never perturbs the drift/churn sequences of an
+        # otherwise-identical profile (scenario diffing stays apples-to-
+        # apples). Victims are picked at start(); replacements for churned
+        # victims are new names and therefore healthy — like real fleets.
+        self._fault_rng = random.Random((profile.seed or 0x5ca1e) ^ 0xFA11)
+        self._silent: set = set()
+        self._flappers: set = set()
+        self._started_at: float = float("inf")
         # Counters (stats()): what the plane actually did to the cluster.
         self.registered = 0
         self.heartbeats = 0
@@ -67,6 +84,7 @@ class HollowNodePlane:
         self.cordons = 0
         self.deletes = 0
         self.reregisters = 0
+        self.silenced_beats = 0
         self.errors = 0
 
     # -- lifecycle ----------------------------------------------------------
@@ -98,6 +116,8 @@ class HollowNodePlane:
     def start(self) -> "HollowNodePlane":
         if self._threads:
             return self
+        self._started_at = self.now()
+        self._pick_fault_victims()
         t = threading.Thread(target=self._heartbeat_loop,
                              name="hollow-heartbeat", daemon=True)
         t.start()
@@ -122,7 +142,54 @@ class HollowNodePlane:
                 "registered": self.registered,
                 "heartbeats": self.heartbeats, "drifts": self.drifts,
                 "cordons": self.cordons, "deletes": self.deletes,
-                "reregisters": self.reregisters, "errors": self.errors}
+                "reregisters": self.reregisters,
+                "silenced": len(self._silent),
+                "flapping": len(self._flappers),
+                "silenced_beats": self.silenced_beats,
+                "errors": self.errors}
+
+    # -- failure injection (silence / flap / zone outage) -------------------
+
+    def _pick_fault_victims(self) -> None:
+        """Deterministic victim selection off the fault rng: the chaos
+        harness replays the same picks from the profile alone and asserts
+        the controller drains exactly this set and nothing else."""
+        prof = self.profile
+        with self._lock:
+            fleet = [n for n in self._order if n in self._nodes]
+        k_silent = min(len(fleet), int(len(fleet) * max(0.0, prof.silence)))
+        if k_silent:
+            self._silent = set(self._fault_rng.sample(fleet, k_silent))
+        rest = [n for n in fleet if n not in self._silent]
+        k_flap = min(len(rest), int(len(fleet) * max(0.0, prof.flap)))
+        if k_flap:
+            self._flappers = set(self._fault_rng.sample(rest, k_flap))
+
+    def silent_nodes(self) -> List[str]:
+        """The permanently-silent victim set (NOT flappers / outage zone) —
+        the oracle the chaos scenarios diff survivor placements against."""
+        return sorted(self._silent)
+
+    def _silent_now(self, name: str, now: float) -> bool:
+        """Is this node refusing to heartbeat at `now`? Callers hold
+        `_lock` (reads `_shape_ix` for the zone check)."""
+        prof = self.profile
+        elapsed = now - self._started_at
+        if elapsed < 0:
+            return False
+        if name in self._silent and elapsed >= prof.silence_after_s:
+            return True
+        if (prof.outage_zone >= 0 and prof.zones
+                and elapsed >= prof.outage_after_s):
+            ix = self._shape_ix.get(name)
+            if ix is not None and ix % prof.zones == prof.outage_zone:
+                return True
+        if name in self._flappers and prof.flap_period_s > 0:
+            # Phase 0 alive, phase 1 silent, ... — a flapper always gets
+            # one clean period of heartbeats before its first death.
+            if int(elapsed / prof.flap_period_s) % 2 == 1:
+                return True
+        return False
 
     # -- heartbeats (+ capacity drift) --------------------------------------
 
@@ -148,6 +215,12 @@ class HollowNodePlane:
                 self._hb_pos = (self._hb_pos + len(names)) % max(
                     1, len(self._order))
                 names = [n for n in names if n in self._nodes]
+                if self._silent or self._flappers or prof.outage_zone >= 0:
+                    now = self.now()
+                    kept = [n for n in names
+                            if not self._silent_now(n, now)]
+                    self.silenced_beats += len(names) - len(kept)
+                    names = kept
             if not names:
                 continue
             try:
@@ -208,9 +281,14 @@ class HollowNodePlane:
 
     def _cordon_one(self) -> None:
         with self._lock:
+            now = self.now()
             cordoned = {n for _d, n in self._cordoned}
+            # Silent nodes are the lifecycle controller's prey — churn must
+            # not delete them out from under the taint ladder (a silent
+            # node stays silently dead, it doesn't get gracefully drained).
             candidates = [n for n in self._order
-                          if n in self._nodes and n not in cordoned]
+                          if n in self._nodes and n not in cordoned
+                          and not self._silent_now(n, now)]
             if not candidates:
                 return
             name = candidates[self._rng.randrange(len(candidates))]

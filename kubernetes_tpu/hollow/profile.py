@@ -77,6 +77,20 @@ class HollowProfile:
     threads: int = 4            # register/heartbeat worker threads
     register_chunk: int = 500   # nodes per bulk-create POST
     seed: int = 0               # drift/churn victim selection
+    # Failure injection (the node-lifecycle controller's standing prey,
+    # docs/RESILIENCE.md § node lifecycle): a `silence` fraction of the
+    # fleet stops heartbeating `silence_after_s` seconds into the run
+    # (dead kubelets); a `flap` fraction alternates silent/alive every
+    # `flap_period_s` (a flapping NIC — the taint must lift when it
+    # speaks and re-arm when it dies again); `outage_zone >= 0` blacks
+    # out one whole topology zone after `outage_after_s` (the
+    # full-disruption case the zone-aware evictor must throttle to zero).
+    silence: float = 0.0
+    silence_after_s: float = 0.0
+    flap: float = 0.0
+    flap_period_s: float = 2.0
+    outage_zone: int = -1
+    outage_after_s: float = 0.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "HollowProfile":
@@ -91,7 +105,13 @@ class HollowProfile:
                    churn_cordon_s=float(d.get("churn_cordon_s", 0.5)),
                    threads=int(d.get("threads", 4)),
                    register_chunk=int(d.get("register_chunk", 500)),
-                   seed=int(d.get("seed", 0)))
+                   seed=int(d.get("seed", 0)),
+                   silence=float(d.get("silence", 0.0)),
+                   silence_after_s=float(d.get("silence_after_s", 0.0)),
+                   flap=float(d.get("flap", 0.0)),
+                   flap_period_s=float(d.get("flap_period_s", 2.0)),
+                   outage_zone=int(d.get("outage_zone", -1)),
+                   outage_after_s=float(d.get("outage_after_s", 0.0)))
 
     def to_dict(self) -> dict:
         return {"count": self.count,
@@ -101,7 +121,12 @@ class HollowProfile:
                 "churn_per_s": self.churn_per_s,
                 "churn_cordon_s": self.churn_cordon_s,
                 "threads": self.threads,
-                "register_chunk": self.register_chunk, "seed": self.seed}
+                "register_chunk": self.register_chunk, "seed": self.seed,
+                "silence": self.silence,
+                "silence_after_s": self.silence_after_s,
+                "flap": self.flap, "flap_period_s": self.flap_period_s,
+                "outage_zone": self.outage_zone,
+                "outage_after_s": self.outage_after_s}
 
     @classmethod
     def load(cls, path: str) -> "HollowProfile":

@@ -40,7 +40,13 @@ survive — core/cache.py EventJournal):
     node_update         re-validate that ROW's taints/alloc/unschedulable
                         (labels/images intact by the kind's contract);
                         a PreferNoSchedule taint kills the hint (the plan
-                        compiled the no-PNS fast path)
+                        compiled the no-PNS fast path). This row is how
+                        the node-lifecycle controller's unreachable taint
+                        (controllers/node_lifecycle.py NoSchedule ladder
+                        step) reaches the fast path: the taint PUT fans a
+                        MODIFIED node event, the journal records
+                        node_update, and the tainted node's hint row dies
+                        here — zero lifecycle-specific device code
     structural/other    killed
     journal gap         killed (anything may have changed)
 

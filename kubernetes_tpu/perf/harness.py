@@ -557,6 +557,15 @@ def run_hollow_workload(wl: Workload) -> PerfResult:
         "drift": float(params.get("hollowDrift", 0.0)),
         "churn_per_s": float(params.get("hollowChurnPerS", 0.0)),
         "zones": int(params.get("zones", 100)),
+        # Failure injection (hollow/profile.py): silenced/flapping slices
+        # and zone blackout for node-lifecycle-controller runs
+        # (docs/RESILIENCE.md § node lifecycle).
+        "silence": float(params.get("hollowSilence", 0.0)),
+        "silence_after_s": float(params.get("hollowSilenceAfterS", 0.0)),
+        "flap": float(params.get("hollowFlap", 0.0)),
+        "flap_period_s": float(params.get("hollowFlapPeriodS", 2.0)),
+        "outage_zone": int(params.get("hollowOutageZone", -1)),
+        "outage_after_s": float(params.get("hollowOutageAfterS", 0.0)),
     }
     out = run_sharded_cluster(
         int(params.get("shards", 1)), n_nodes, n_pods,

@@ -432,6 +432,52 @@ def start_hollow_plane(base: str, profile, cwd: str, env: dict,
     return proc, int(m.group(1))
 
 
+def start_controller(base: str, cwd: str, env: dict,
+                     fallbacks=(), grace: float = 4.0,
+                     noexec_after: float = 2.0, tick: float = 0.5,
+                     primary_qps: float = 2.0, secondary_qps: float = 0.1,
+                     unhealthy_threshold: float = 0.55,
+                     timeout: float = 120.0):
+    """Spawn the node-lifecycle controller process (`python -m
+    kubernetes_tpu.controllers`) against `base` and block until its ready
+    line. Returns (proc, metrics_url) — `metrics_url` serves the
+    `node_lifecycle_*` series the chaos acceptance scrapes."""
+    from ..testing.faults import spawn_ready
+
+    cmd = [sys.executable, "-m", "kubernetes_tpu.controllers",
+           "--api-url", base,
+           "--grace", str(grace), "--noexec-after", str(noexec_after),
+           "--tick", str(tick), "--primary-qps", str(primary_qps),
+           "--secondary-qps", str(secondary_qps),
+           "--unhealthy-threshold", str(unhealthy_threshold)]
+    for url in fallbacks:
+        cmd += ["--fallback", url]
+    proc, m = spawn_ready(cmd, r"metrics on (127\.0\.0\.1:\d+)", cwd=cwd,
+                          env=env, timeout=timeout)
+    return proc, f"http://{m.group(1)}"
+
+
+def stop_controller(proc, tail=None):
+    """SIGTERM the controller and collect its final stats line
+    (`{"controller_stats": ...}`) from a drained tail, if one was kept."""
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except Exception:  # noqa: BLE001
+            proc.kill()
+    if tail is None:
+        return None
+    time.sleep(0.1)  # let the drain thread swallow the stats line
+    for line in reversed(list(tail)):
+        if "controller_stats" in line:
+            try:
+                return json.loads(line)["controller_stats"]
+            except (ValueError, KeyError):
+                return None
+    return None
+
+
 def run_sharded_cluster(
     n_shards: int,
     n_nodes: int,

@@ -54,7 +54,7 @@ from ..core.backoff import TransientAPIError
 # surface the scheduler exercises).
 WRITE_VERBS = (
     "create_pod", "update_pod", "delete_pod", "bind", "patch_pod_status",
-    "create_node", "update_node", "delete_node",
+    "create_node", "update_node", "delete_node", "evict_pod",
 )
 
 

@@ -191,3 +191,121 @@ def test_dead_initial_connection_raises():
     import pytest
     with pytest.raises((ConnectionError, TimeoutError)):
         HTTPClientset("http://127.0.0.1:1", sync_timeout=5.0)
+
+
+def _json_call(base, method, path, body=None):
+    import json as _json
+    from urllib import request as _rq
+    data = _json.dumps(body).encode() if body is not None else None
+    req = _rq.Request(base + path, data=data, method=method,
+                      headers={"Content-Type": "application/json"})
+    with _rq.urlopen(req, timeout=30) as resp:
+        raw = resp.read()
+    return _json.loads(raw) if raw else None
+
+
+def test_pod_groups_over_the_wire_gate_gangs_and_replay():
+    """Gang state over the real HTTP LIST/watch (PR-16 satellite): a
+    PodGroup created through one clientset gates the gang on a scheduler
+    reading through ANOTHER clientset — the all-or-nothing cycle holds
+    across the process boundary, a late subscriber gets the group from
+    LIST replay, and the arrival of the final member (over the wire)
+    releases the whole gang."""
+    from kubernetes_tpu.api.types import PodGroup
+
+    api = APIServer()
+    port = api.serve(0)
+    base = f"http://127.0.0.1:{port}"
+    writer = HTTPClientset(base)
+    reader = HTTPClientset(base)
+    sched = Scheduler(clientset=reader, deterministic_ties=True)
+    try:
+        for i in range(3):
+            writer.create_node(make_node().name(f"n{i}")
+                               .capacity({"cpu": 4, "memory": "8Gi",
+                                          "pods": 10}).obj())
+        writer.create_pod_group(PodGroup(name="gang", min_count=3))
+        pods = []
+        for i in range(2):
+            p = make_pod().name(f"gang-{i}").req({"cpu": "1"}).obj()
+            p.pod_group = "gang"
+            pods.append(p)
+            writer.create_pod(p)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and (
+                len(reader.pods) < 2 or len(reader.nodes) < 3
+                or "default/gang" not in reader.pod_groups):
+            time.sleep(0.02)
+        # the group crossed the wire: the reading scheduler must hold the
+        # gang (2 of 3 members present -> nothing schedules)
+        assert reader.pod_groups["default/gang"].min_count == 3
+        sched.run_until_idle()
+        assert not api.store.bindings
+        # a LATE subscriber sees the group via LIST replay, no watch race
+        late = HTTPClientset(base)
+        try:
+            assert "default/gang" in late.pod_groups
+            assert late.pod_groups["default/gang"].min_count == 3
+        finally:
+            late.close()
+        # the final member arrives over the wire: whole gang releases
+        p3 = make_pod().name("gang-2").req({"cpu": "1"}).obj()
+        p3.pod_group = "gang"
+        writer.create_pod(p3)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(api.store.bindings) < 3:
+            sched.run_until_idle()
+            time.sleep(0.02)
+        assert len(api.store.bindings) == 3
+        assert set(api.store.bindings) == {p.uid for p in pods} | {p3.uid}
+    finally:
+        writer.close()
+        reader.close()
+        api.shutdown()
+
+
+def test_flow_admin_endpoint_reweights_live():
+    """/flow (PR-16 satellite): GET exposes per-level weights + admission
+    counters; POST re-weights one level's flows live (applied under the
+    flow controller's own lock). Unknown level -> 404; the exempt lane and
+    non-positive weights -> 400."""
+    api = APIServer()
+    port = api.serve(0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        got = _json_call(base, "GET", "/flow")
+        assert "workload" in got["weights"] and "workload" in got["levels"]
+        # live re-weight: starve down a flood tenant mid-storm
+        got = _json_call(base, "POST", "/flow",
+                         {"level": "workload",
+                          "weights": {"tenant-flood": 0.25,
+                                      "tenant-gold": 4.0}})
+        assert got["weights"]["tenant-flood"] == 0.25
+        again = _json_call(base, "GET", "/flow")
+        assert again["weights"]["workload"]["tenant-flood"] == 0.25
+        assert again["weights"]["workload"]["tenant-gold"] == 4.0
+        # the write plane still admits (the re-weight never touched the
+        # write lock, but prove the server is alive and serving writes)
+        cs = HTTPClientset(base)
+        try:
+            cs.create_node(make_node().name("n0")
+                           .capacity({"cpu": 4, "pods": 10}).obj())
+            assert "n0" in api.store.nodes
+        finally:
+            cs.close()
+        import pytest
+        from urllib.error import HTTPError
+        with pytest.raises(HTTPError) as e:
+            _json_call(base, "POST", "/flow",
+                       {"level": "nope", "weights": {"t": 1.0}})
+        assert e.value.code == 404
+        with pytest.raises(HTTPError) as e:
+            _json_call(base, "POST", "/flow",
+                       {"level": "exempt", "weights": {"t": 1.0}})
+        assert e.value.code == 400
+        with pytest.raises(HTTPError) as e:
+            _json_call(base, "POST", "/flow",
+                       {"level": "workload", "weights": {"t": 0.0}})
+        assert e.value.code == 400
+    finally:
+        api.shutdown()

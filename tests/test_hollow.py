@@ -219,3 +219,97 @@ class TestHollowProcess:
         finally:
             if proc.poll() is None:
                 proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# failure injection: silence / flap / zone outage (PR-16 node lifecycle)
+# ---------------------------------------------------------------------------
+
+
+class TestFailureInjection:
+    def test_profile_roundtrip_with_failure_fields(self):
+        prof = HollowProfile(
+            count=50, zones=5, silence=0.1, silence_after_s=1.5,
+            flap=0.05, flap_period_s=3.0, outage_zone=2,
+            outage_after_s=4.0)
+        again = HollowProfile.from_dict(prof.to_dict())
+        assert again.to_dict() == prof.to_dict()
+        assert again.silence == 0.1 and again.outage_zone == 2
+
+    def test_silent_victims_are_deterministic_and_churn_exempt(self, api):
+        """The silenced set is a pure function of the profile seed (the
+        chaos oracle direct-binds victim pods onto it), silence never
+        perturbs the drift/churn RNG streams, and churn never cordons a
+        silent node — a dead node stays dead instead of being recycled
+        into a healthy replacement."""
+        server, base = api
+        prof = HollowProfile(count=60, zones=6, heartbeat_s=0.3,
+                             churn_per_s=4.0, churn_cordon_s=0.05,
+                             silence=0.2, silence_after_s=0.2, seed=13)
+        plane = HollowNodePlane(base, prof)
+        plane.register()
+        plane.start()
+        try:
+            silent = plane.silent_nodes()
+            assert len(silent) == 12
+            assert plane.stats()["silenced"] == 12
+            _wait(lambda: plane.stats()["silenced_beats"] > 0,
+                  msg="silence filtering")
+            _wait(lambda: plane.deletes >= 3, msg="churn waves")
+            # silent nodes survived every churn wave untouched
+            assert set(silent) <= set(server.store.nodes)
+            # the server's freshness map shows them aging while the rest
+            # of the fleet stays young
+            time.sleep(0.8)
+            ages = server.heartbeat_ages()
+            stale = [n for n in silent if ages[n] > 0.6]
+            assert len(stale) == len(silent), (len(stale), len(silent))
+        finally:
+            plane.stop()
+        # same profile, fresh plane+server: identical victim set
+        server2 = APIServer()
+        port2 = server2.serve(0)
+        plane2 = HollowNodePlane(f"http://127.0.0.1:{port2}", prof)
+        plane2.register()
+        plane2.start()
+        try:
+            assert plane2.silent_nodes() == silent
+        finally:
+            plane2.stop()
+            server2.shutdown()
+
+    def test_flappers_alternate_and_outage_zone_goes_dark(self, api):
+        server, base = api
+        prof = HollowProfile(count=40, zones=4, heartbeat_s=0.2,
+                             flap=0.1, flap_period_s=0.6,
+                             outage_zone=1, outage_after_s=0.4, seed=5)
+        plane = HollowNodePlane(base, prof)
+        plane.register()
+        plane.start()
+        try:
+            assert plane.stats()["flapping"] == 4
+            # outage zone: every zone-1 node stops heartbeating after
+            # outage_after_s while other zones stay fresh
+            time.sleep(1.2)
+            ages = server.heartbeat_ages()
+            zone_of = {n: node.labels["topology.kubernetes.io/zone"]
+                       for n, node in server.store.nodes.items()}
+            dark = [n for n, z in zone_of.items() if z == "zone-1"]
+            lit = [n for n, z in zone_of.items()
+                   if z != "zone-1" and n not in plane._flappers]
+            assert all(ages[n] > 0.6 for n in dark)
+            assert any(ages[n] < 0.5 for n in lit)
+            # flappers come back: within one full period each flapper
+            # heartbeats again (age resets) at least once
+            flapper = sorted(plane._flappers)[0]
+            if zone_of[flapper] == "zone-1":
+                flapper = next(n for n in sorted(plane._flappers)
+                               if zone_of[n] != "zone-1") \
+                    if any(zone_of[n] != "zone-1"
+                           for n in plane._flappers) else flapper
+            if zone_of[flapper] != "zone-1":
+                def _beats_again():
+                    return server.heartbeat_ages()[flapper] < 0.3
+                _wait(_beats_again, timeout=3.0, msg="flapper alive phase")
+        finally:
+            plane.stop()

@@ -1,0 +1,589 @@
+"""Node-lifecycle controller (kubernetes_tpu/controllers/): heartbeat
+health, the taint ladder, rate-limited zone-aware eviction, idempotent
+eviction intents, and the closed loop against the real apiserver
+(docs/RESILIENCE.md § node lifecycle)."""
+
+import copy
+import json
+import threading
+import time
+from urllib import request as urlrequest
+from urllib.error import HTTPError
+
+import pytest
+
+from kubernetes_tpu.controllers import (NodeLifecycleController,
+                                        RateLimitedEvictor, TokenBucket)
+from kubernetes_tpu.controllers.evictor import (ZONE_FULL, ZONE_NORMAL,
+                                                ZONE_PARTIAL, intent_for)
+from kubernetes_tpu.controllers.node_lifecycle import UNKNOWN
+from kubernetes_tpu.core import FakeClientset, Scheduler
+from kubernetes_tpu.core.apiserver import (EVICTED_ANNOTATION,
+                                           UNREACHABLE_TAINT, APIServer,
+                                           HTTPClientset, node_to_wire,
+                                           pod_to_wire)
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+
+def _call(base, method, path, body=None, timeout=30.0):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urlrequest.Request(base + path, data=data, method=method,
+                            headers={"Content-Type": "application/json"})
+    with urlrequest.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw else None
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# TokenBucket units (injected clock: no sleeps)
+# ---------------------------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_burst_then_rate(self):
+        clock = [0.0]
+        b = TokenBucket(qps=2.0, burst=2.0, now=lambda: clock[0])
+        assert b.try_take() and b.try_take()   # burst balance
+        assert not b.try_take()                # dry until refill
+        clock[0] = 0.5                         # 0.5s * 2qps = 1 token
+        assert b.try_take()
+        assert not b.try_take()
+
+    def test_balance_capped_at_burst(self):
+        clock = [0.0]
+        b = TokenBucket(qps=10.0, burst=1.0, now=lambda: clock[0])
+        assert b.try_take()
+        clock[0] = 100.0                       # huge idle stretch
+        assert b.try_take()
+        assert not b.try_take()                # capped at burst=1, not 1000
+
+    def test_zero_qps_never_grants(self):
+        clock = [0.0]
+        b = TokenBucket(qps=0.0, burst=4.0, now=lambda: clock[0])
+        assert not b.try_take()                # full-disruption bucket
+        clock[0] = 1e6
+        assert not b.try_take()
+
+    def test_set_rate_keeps_accumulated_balance(self):
+        clock = [0.0]
+        b = TokenBucket(qps=1.0, burst=2.0, now=lambda: clock[0])
+        clock[0] = 1.0                         # balance pinned at burst
+        b.set_rate(0.0)                        # zone went FullDisruption
+        assert not b.try_take()                # zero-rate wins immediately
+        b.set_rate(1.0)
+        clock[0] = 2.5
+        assert b.try_take()                    # refills resume on recovery
+
+
+# ---------------------------------------------------------------------------
+# RateLimitedEvictor units (stub clientset, injected clock)
+# ---------------------------------------------------------------------------
+
+
+class _StubClientset:
+    """Programmable eviction endpoint: records calls, mimics the server's
+    ledger/404 answers without a socket."""
+
+    def __init__(self):
+        self.calls = []
+        self.ledger = {}
+        self.gone = set()
+
+    def evict_pod(self, uid, node, intent):
+        self.calls.append((uid, node, intent))
+        if uid in self.gone:
+            raise HTTPError("http://stub", 404, "pod not found", None, None)
+        if self.ledger.get(uid) == intent:
+            return {"evicted": True, "already": True}
+        self.ledger[uid] = intent
+        return {"evicted": True, "node": node}
+
+
+class TestRateLimitedEvictor:
+    def _evictor(self, **kw):
+        clock = [0.0]
+        cs = _StubClientset()
+        ev = RateLimitedEvictor(cs, now=lambda: clock[0], **kw)
+        return ev, cs, clock
+
+    def test_zone_state_machine(self):
+        ev, _cs, _clock = self._evictor(primary_qps=4.0, secondary_qps=0.5,
+                                        unhealthy_threshold=0.5)
+        assert ev.set_zone_state("a", 0, 10) == ZONE_NORMAL
+        assert ev.set_zone_state("a", 6, 10) == ZONE_PARTIAL
+        assert ev.set_zone_state("a", 10, 10) == ZONE_FULL
+        assert ev._buckets["a"].qps == 0.0
+        assert ev.set_zone_state("a", 1, 10) == ZONE_NORMAL
+        assert ev._buckets["a"].qps == 4.0
+
+    def test_enqueue_dedupes_by_uid(self):
+        ev, _cs, _clock = self._evictor()
+        assert ev.enqueue("a", "n1", "u1")
+        assert not ev.enqueue("a", "n1", "u1")  # reconcile re-plans
+        assert ev.pending_count() == 1
+
+    def test_throttle_counts_and_resumes(self):
+        ev, cs, clock = self._evictor(primary_qps=1.0, burst=1.0)
+        ev.set_zone_state("a", 0, 10)
+        for i in range(3):
+            ev.enqueue("a", "n1", f"u{i}")
+        assert ev.run_once() == 1              # burst grants exactly one
+        assert ev.evictions_throttled_total == 1
+        assert ev.pending_count() == 2
+        clock[0] = 10.0                        # refill (capped at burst)
+        assert ev.run_once() == 1
+        assert len(cs.calls) == 2
+
+    def test_full_disruption_zone_evicts_nothing(self):
+        ev, cs, clock = self._evictor(primary_qps=100.0, burst=10.0)
+        ev.set_zone_state("dead", 10, 10)      # FULL: qps=0
+        ev.enqueue("dead", "n1", "u1")
+        clock[0] = 1e6
+        assert ev.run_once() == 0
+        assert cs.calls == []
+        assert ev.evictions_throttled_total >= 1
+
+    def test_cancel_node_drops_pending(self):
+        ev, cs, _clock = self._evictor(primary_qps=100.0, burst=10.0)
+        ev.enqueue("a", "n1", "u1")
+        ev.enqueue("a", "n2", "u2")
+        assert ev.cancel_node("n1") == 1       # taint lifted mid-wave
+        assert ev.evictions_cancelled == 1
+        ev.run_once()
+        assert [c[0] for c in cs.calls] == ["u2"]  # n1's pod kept placement
+        # a cancelled uid may be re-planned later (node died again)
+        assert ev.enqueue("a", "n1", "u1")
+
+    def test_restart_replay_is_exactly_once(self):
+        """A restarted controller re-plans the same wave: deterministic
+        intent ids make the server's ledger answer already=True — counted
+        as replayed, never as a second eviction."""
+        ev1, cs, _clock = self._evictor(primary_qps=100.0, burst=10.0)
+        ev1.enqueue("a", "n1", "u1")
+        assert ev1.run_once() == 1
+        # fresh evictor (controller restart), same clientset/ledger
+        ev2 = RateLimitedEvictor(cs, primary_qps=100.0, burst=10.0,
+                                 now=lambda: 0.0)
+        ev2.enqueue("a", "n1", "u1")
+        assert ev2.run_once() == 0
+        assert ev2.evictions_replayed == 1 and ev2.evictions_total == 0
+        assert [c[2] for c in cs.calls] == [intent_for("u1", "n1")] * 2
+
+    def test_pod_gone_404_cancels(self):
+        ev, cs, _clock = self._evictor(primary_qps=100.0, burst=10.0)
+        cs.gone.add("u1")
+        ev.enqueue("a", "n1", "u1")
+        assert ev.run_once() == 0
+        assert ev.evictions_cancelled == 1 and ev.eviction_errors == 0
+
+
+# ---------------------------------------------------------------------------
+# Taint ladder + GC units (FakeClientset-backed, injected clock + ages)
+# ---------------------------------------------------------------------------
+
+
+class _LadderClientset(FakeClientset):
+    """FakeClientset + an in-memory eviction subresource mirroring the
+    server's semantics (ledger, unbind, pending recreate)."""
+
+    def __init__(self):
+        super().__init__()
+        self.ledger = {}
+        self.evicted_uids = []
+
+    def evict_pod(self, uid, node, intent):
+        if self.ledger.get(uid) == intent:
+            return {"evicted": True, "already": True}
+        pod = self.pods.get(uid)
+        if pod is None:
+            raise HTTPError("http://fake", 404, "pod not found", None, None)
+        if not pod.node_name:
+            return {"evicted": False, "pending": True}
+        self.delete_pod(pod)
+        recreated = copy.deepcopy(pod)
+        recreated.node_name = ""
+        recreated.annotations = dict(recreated.annotations,
+                                     **{EVICTED_ANNOTATION: intent})
+        self.create_pod(recreated)
+        self.ledger[uid] = intent
+        self.evicted_uids.append(uid)
+        return {"evicted": True, "node": node}
+
+
+def _ladder(grace=5.0, noexec_after=3.0, **ev_kw):
+    clock = [0.0]
+    ages = {}
+    cs = _LadderClientset()
+    ctrl = NodeLifecycleController(
+        cs, grace=grace, noexec_after=noexec_after,
+        ages_fn=lambda: dict(ages), now=lambda: clock[0], **ev_kw)
+    return ctrl, cs, clock, ages
+
+
+class TestTaintLadder:
+    def _cluster(self, cs):
+        for i in range(3):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": 8, "memory": "16Gi",
+                                      "pods": 110})
+                           .zone("z0").obj())
+        pods = []
+        for i in range(2):
+            p = make_pod().name(f"p{i}").req({"cpu": "100m"}).obj()
+            p.node_name = "n1"
+            cs.create_pod(p)
+            pods.append(p)
+        return pods
+
+    def test_ladder_climbs_noschedule_then_noexecute(self):
+        ctrl, cs, clock, ages = _ladder(primary_qps=100.0,
+                                        eviction_burst=10.0)
+        pods = self._cluster(cs)
+        ages.update({"n0": 0.0, "n1": 0.0, "n2": 0.0})
+        ctrl.reconcile_once()
+        assert ctrl.node_health == {"n0": "Ready", "n1": "Ready",
+                                    "n2": "Ready"}
+        assert all(not n.taints for n in cs.nodes.values())
+        # n1 goes silent past grace: Unknown + NoSchedule, nothing evicted
+        ages["n1"] = 6.0
+        ctrl.reconcile_once()
+        assert ctrl.node_health["n1"] == UNKNOWN
+        effects = {t.effect for t in cs.nodes["n1"].taints
+                   if t.key == UNREACHABLE_TAINT}
+        assert effects == {"NoSchedule"}
+        assert ctrl.taints_noschedule == 1 and cs.evicted_uids == []
+        # still silent but inside the tolerance window: idempotent (no
+        # double-taint — the settled ladder step must not re-PUT)
+        ctrl.reconcile_once()
+        assert ctrl.taints_noschedule == 1 and ctrl.taint_errors == 0
+        # tolerance expires: NoExecute lands and the bound pods drain
+        clock[0] = 4.0
+        ages["n1"] = 10.0
+        ctrl.reconcile_once()
+        effects = {t.effect for t in cs.nodes["n1"].taints
+                   if t.key == UNREACHABLE_TAINT}
+        assert effects == {"NoSchedule", "NoExecute"}
+        assert ctrl.taints_noexecute == 1
+        assert sorted(cs.evicted_uids) == sorted(p.uid for p in pods)
+        # evicted pods were recreated pending with the intent annotation
+        for p in pods:
+            got = cs.pods[p.uid]
+            assert got.node_name == ""
+            assert got.annotations[EVICTED_ANNOTATION] == intent_for(
+                p.uid, "n1")
+
+    def test_heartbeat_return_lifts_taints_and_cancels_wave(self):
+        # burst=1: one eviction per pass, the rest stay pending
+        ctrl, cs, clock, ages = _ladder(primary_qps=1e-9,
+                                        eviction_burst=1.0)
+        pods = self._cluster(cs)
+        ages.update({"n0": 0.0, "n1": 20.0, "n2": 0.0})
+        ctrl.reconcile_once()                  # NoSchedule
+        clock[0] = 4.0
+        ctrl.reconcile_once()                  # NoExecute + 1 eviction
+        assert len(cs.evicted_uids) == 1
+        assert ctrl.evictor.pending_count() == 1
+        # n1 heartbeats again: taints lift, the pending eviction cancels
+        ages["n1"] = 0.0
+        ctrl.reconcile_once()
+        assert ctrl.taints_lifted == 1
+        assert cs.nodes["n1"].taints == []
+        assert ctrl.evictor.pending_count() == 0
+        assert ctrl.evictor.evictions_cancelled >= 1
+        # the survivor kept its placement
+        survivors = [p for p in pods if p.uid not in cs.evicted_uids]
+        assert len(survivors) == 1
+        assert cs.pods[survivors[0].uid].node_name == "n1"
+
+    def test_pod_gc_reaps_deleted_node_pods(self):
+        ctrl, cs, _clock, ages = _ladder(primary_qps=100.0,
+                                         eviction_burst=10.0)
+        self._cluster(cs)
+        ghost = make_pod().name("ghost").req({"cpu": "100m"}).obj()
+        ghost.node_name = "vanished-node"
+        cs.create_pod(ghost)
+        ages.update({"n0": 0.0, "n1": 0.0, "n2": 0.0})
+        ctrl.reconcile_once()
+        assert ctrl.pods_gc == 1
+        assert cs.pods[ghost.uid].node_name == ""
+        assert EVICTED_ANNOTATION in cs.pods[ghost.uid].annotations
+
+    def test_zone_census_throttles_before_evicting(self):
+        """A fully-silent zone must never storm: every one of its nodes is
+        Unknown, so its bucket is zero-rate BEFORE any eviction token is
+        taken this pass."""
+        ctrl, cs, clock, ages = _ladder(primary_qps=100.0,
+                                        eviction_burst=10.0,
+                                        unhealthy_threshold=0.55)
+        self._cluster(cs)                      # all three nodes in z0
+        ages.update({"n0": 20.0, "n1": 20.0, "n2": 20.0})
+        ctrl.reconcile_once()
+        clock[0] = 10.0
+        ctrl.reconcile_once()                  # NoExecute everywhere
+        assert ctrl.evictor.zone_states["z0"] == ZONE_FULL
+        assert cs.evicted_uids == []           # zero evictions: outage
+        assert ctrl.evictor.evictions_throttled_total >= 1
+        s = ctrl.stats()
+        assert s["nodes_unknown"] == 3 and s["evictions"] == 0
+
+    def test_metrics_text_exposes_series(self):
+        ctrl, cs, _clock, ages = _ladder()
+        self._cluster(cs)
+        ages.update({"n0": 0.0, "n1": 0.0, "n2": 0.0})
+        ctrl.reconcile_once()
+        text = ctrl.metrics_text()
+        for series in ("node_lifecycle_evictions_total",
+                       "node_lifecycle_evictions_throttled_total",
+                       "node_lifecycle_reconciles_total",
+                       "node_lifecycle_nodes_unknown",
+                       'node_lifecycle_zone_state{zone="z0"}'):
+            assert series in text, series
+
+
+# ---------------------------------------------------------------------------
+# Eviction subresource semantics (real apiserver over the wire)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def api():
+    server = APIServer()
+    port = server.serve(0)
+    yield server, f"http://127.0.0.1:{port}"
+    server.shutdown()
+
+
+class TestEvictionSubresource:
+    def _bound_pod(self, base, name="victim", node="n0"):
+        _call(base, "POST", "/api/v1/nodes",
+              node_to_wire(make_node().name(node)
+                           .capacity({"cpu": 8, "pods": 110}).obj()))
+        p = make_pod().name(name).req({"cpu": "100m"}).obj()
+        w = pod_to_wire(p)
+        w["nodeName"] = node
+        _call(base, "POST", "/api/v1/pods", w)
+        return w["uid"]
+
+    def test_evict_unbinds_and_recreates_pending(self, api):
+        server, base = api
+        uid = self._bound_pod(base)
+        intent = intent_for(uid, "n0")
+        got = _call(base, "POST", f"/api/v1/pods/{uid}/eviction",
+                    {"intent": intent, "node": "n0"})
+        assert got == {"evicted": True, "node": "n0"}
+        pod = server.store.pods[uid]
+        assert pod.node_name == ""
+        assert pod.annotations[EVICTED_ANNOTATION] == intent
+        assert server.pod_evictions == 1
+        assert server.evictions[uid] == intent
+
+    def test_replay_answers_already_without_mutating(self, api):
+        server, base = api
+        uid = self._bound_pod(base)
+        intent = intent_for(uid, "n0")
+        _call(base, "POST", f"/api/v1/pods/{uid}/eviction",
+              {"intent": intent, "node": "n0"})
+        got = _call(base, "POST", f"/api/v1/pods/{uid}/eviction",
+                    {"intent": intent, "node": "n0"})
+        assert got.get("already") is True
+        assert server.pod_evictions == 1           # no second mutation
+        assert server.pod_evictions_replayed == 1
+
+    def test_missing_intent_is_400(self, api):
+        _server, base = api
+        uid = self._bound_pod(base)
+        with pytest.raises(HTTPError) as e:
+            _call(base, "POST", f"/api/v1/pods/{uid}/eviction", {})
+        assert e.value.code == 400
+
+    def test_unknown_pod_is_404(self, api):
+        _server, base = api
+        with pytest.raises(HTTPError) as e:
+            _call(base, "POST", "/api/v1/pods/nope/eviction",
+                  {"intent": "i", "node": "n0"})
+        assert e.value.code == 404
+
+    def test_node_mismatch_is_409(self, api):
+        """The pod moved since the controller planned the wave: the stale
+        plan must NOT evict it off its new home."""
+        _server, base = api
+        uid = self._bound_pod(base)
+        with pytest.raises(HTTPError) as e:
+            _call(base, "POST", f"/api/v1/pods/{uid}/eviction",
+                  {"intent": intent_for(uid, "other"), "node": "other"})
+        assert e.value.code == 409
+
+    def test_unbound_pod_answers_pending(self, api):
+        _server, base = api
+        p = make_pod().name("loose").req({"cpu": "100m"}).obj()
+        w = pod_to_wire(p)
+        _call(base, "POST", "/api/v1/pods", w)
+        got = _call(base, "POST", f"/api/v1/pods/{w['uid']}/eviction",
+                    {"intent": "i", "node": "n0"})
+        assert got == {"evicted": False, "pending": True}
+
+    def test_ledger_survives_restart(self, api, tmp_path):
+        """Controller restart AND apiserver restart: the eviction ledger
+        rides the WAL, so a replayed intent stays exactly-once across
+        both."""
+        data = str(tmp_path / "state")
+        server = APIServer(data_dir=data)
+        port = server.serve(0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            uid = self._bound_pod(base)
+            intent = intent_for(uid, "n0")
+            got = _call(base, "POST", f"/api/v1/pods/{uid}/eviction",
+                        {"intent": intent, "node": "n0"})
+            assert got["evicted"] is True
+        finally:
+            server.shutdown()
+        server2 = APIServer(data_dir=data)
+        port2 = server2.serve(0)
+        base2 = f"http://127.0.0.1:{port2}"
+        try:
+            assert server2.evictions[uid] == intent   # recovered from WAL
+            pod = server2.store.pods[uid]
+            assert pod.node_name == ""                # recreate recovered
+            assert pod.annotations[EVICTED_ANNOTATION] == intent
+            got = _call(base2, "POST", f"/api/v1/pods/{uid}/eviction",
+                        {"intent": intent, "node": "n0"})
+            assert got.get("already") is True
+            assert server2.pod_evictions == 0         # replay, not mutation
+        finally:
+            server2.shutdown()
+
+
+class TestHeartbeatAges:
+    def test_ages_track_the_status_sink(self, api):
+        server, base = api
+        _call(base, "POST", "/api/v1/nodes",
+              node_to_wire(make_node().name("hb0")
+                           .capacity({"cpu": 4, "pods": 10}).obj()))
+        ages = _call(base, "GET", "/api/v1/nodes/heartbeats")["ages"]
+        assert "hb0" in ages and ages["hb0"] < 1.0   # create stamps
+        time.sleep(0.15)
+        aged = _call(base, "GET", "/api/v1/nodes/heartbeats")["ages"]["hb0"]
+        assert aged >= 0.1
+        _call(base, "POST", "/api/v1/nodes/status", {"names": ["hb0"]})
+        fresh = _call(base, "GET", "/api/v1/nodes/heartbeats")["ages"]["hb0"]
+        assert fresh < aged
+        _call(base, "DELETE", "/api/v1/nodes/hb0")
+        assert "hb0" not in _call(base, "GET",
+                                  "/api/v1/nodes/heartbeats")["ages"]
+
+    def test_clientset_ages_verb(self, api):
+        _server, base = api
+        cs = HTTPClientset(base)
+        try:
+            cs.create_node(make_node().name("hb1")
+                           .capacity({"cpu": 4, "pods": 10}).obj())
+            ages = cs.node_heartbeat_ages()
+            assert "hb1" in ages
+        finally:
+            cs.close()
+
+
+# ---------------------------------------------------------------------------
+# Closed loop: hollow-style silence -> taint -> evict -> reschedule
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_closed_loop_silence_taint_evict_reschedule(api):
+    """The standing loop in one process: nodes heartbeat except one; the
+    controller declares it Unknown, climbs the taint ladder, drains its
+    pods through the rate-limited evictor; the scheduler re-places every
+    victim elsewhere exactly once; the heartbeat's return lifts the
+    taints."""
+    server, base = api
+    cs = HTTPClientset(base)
+    ctrl_cs = HTTPClientset(base)
+    sched = Scheduler(clientset=cs, deterministic_ties=True)
+    errors = []
+    stop = threading.Event()
+
+    def drive():
+        while not stop.is_set():
+            try:
+                if not sched.run_until_idle():
+                    time.sleep(0.01)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    ctrl = NodeLifecycleController(
+        ctrl_cs, grace=1.0, noexec_after=0.4, tick=0.1,
+        primary_qps=200.0, eviction_burst=32.0)
+    hb_stop = threading.Event()
+
+    def heartbeat():
+        while not hb_stop.is_set():
+            _call(base, "POST", "/api/v1/nodes/status",
+                  {"names": ["n0", "n1", "n2"]})   # n3 is silent
+            hb_stop.wait(0.2)
+
+    hb = threading.Thread(target=heartbeat, daemon=True)
+    try:
+        for i in range(4):
+            cs.create_node(make_node().name(f"n{i}")
+                           .capacity({"cpu": 8, "memory": "32Gi",
+                                      "pods": 110})
+                           .zone(f"z{i % 2}").obj())
+        pods = [make_pod().name(f"p{i}").req({"cpu": "100m",
+                                              "memory": "64Mi"}).obj()
+                for i in range(24)]
+        for p in pods:
+            cs.create_pod(p)
+        _wait(lambda: len(server.store.bindings) == 24, msg="initial binds")
+        initial = dict(server.store.bindings)       # uid -> node
+        victims = sorted(u for u, n in initial.items() if n == "n3")
+        assert victims, "spread placement put nothing on n3?"
+        hb.start()
+        ctrl.start()
+        # ladder: n3 -> Unknown -> NoSchedule+NoExecute, victims drain
+        _wait(lambda: server.pod_evictions >= len(victims),
+              msg="eviction wave")
+        # every victim re-placed, off n3, exactly once
+        _wait(lambda: all(server.store.bindings.get(u, "n3") != "n3"
+                          for u in victims), msg="re-placement")
+        final = dict(server.store.bindings)
+        assert len(final) == 24
+        for uid in victims:
+            assert final[uid] != "n3", (uid, final[uid])
+        # survivors untouched: zero spurious evictions
+        for uid, node in initial.items():
+            if uid not in victims:
+                assert final[uid] == node
+        # exactly-once bookkeeping end to end: one server mutation and one
+        # scheduler requeue per victim, every intent in the ledger
+        assert server.pod_evictions == len(victims)
+        assert sched.eviction_requeues == len(victims)
+        assert len(server.evictions) == len(victims)
+        assert ctrl.evictor.evictions_total == len(victims)
+        # heartbeats return: the ladder unwinds
+        hb_stop.set()
+        hb.join(timeout=5)
+        _call(base, "POST", "/api/v1/nodes/status",
+              {"names": ["n0", "n1", "n2", "n3"]})
+        _wait(lambda: ctrl.taints_lifted >= 1, msg="taint lift")
+        _wait(lambda: not server.store.nodes["n3"].taints, msg="clean node")
+        assert not errors, errors
+    finally:
+        stop.set()
+        hb_stop.set()
+        ctrl.stop()
+        t.join(timeout=10)
+        cs.close()
+        ctrl_cs.close()

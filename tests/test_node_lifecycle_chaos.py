@@ -1,0 +1,415 @@
+"""Node-lifecycle chaos acceptance (ISSUE PR-16, docs/RESILIENCE.md § node
+lifecycle): (a) a 5k-node hollow MixedChurn fleet with 10% of nodes going
+permanently silent — every pod on a silenced node is evicted and
+rescheduled exactly once while the surviving fleet's placements stay
+untouched; (b) a full zone outage engages the FullDisruption throttle
+(zero evictions in the dead zone) while isolated failures elsewhere still
+drain; (c) ``kill -9`` of the apiserver LEADER mid-eviction-wave — the
+wave resumes against the promoted follower with zero double-evictions
+(deterministic intents + the WAL-replicated ledger)."""
+
+import json
+import threading
+import time
+from urllib import request as urlrequest
+from urllib.error import HTTPError
+
+import pytest
+
+from kubernetes_tpu.controllers import NodeLifecycleController
+from kubernetes_tpu.controllers.evictor import ZONE_FULL, ZONE_NORMAL, intent_for
+from kubernetes_tpu.core import Scheduler
+from kubernetes_tpu.core.apiserver import (EVICTED_ANNOTATION,
+                                           UNREACHABLE_TAINT, APIServer,
+                                           HTTPClientset, pod_to_wire)
+from kubernetes_tpu.core.backoff import RetryConfig
+from kubernetes_tpu.core.clientset import RetryingClientset
+from kubernetes_tpu.hollow import HollowNodePlane, HollowProfile
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+ZONE_LABEL = "topology.kubernetes.io/zone"
+
+
+def _call(base, method, path, body=None, timeout=30.0):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urlrequest.Request(base + path, data=data, method=method,
+                            headers={"Content-Type": "application/json"})
+    with urlrequest.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw else None
+
+
+def _get_text(base, path, timeout=10.0):
+    with urlrequest.urlopen(base + path, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _wait(pred, timeout=60.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _metric(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    raise AssertionError(f"series {name} not exposed")
+
+
+class _Driver:
+    """Scheduler thread that records crashes instead of swallowing them."""
+
+    def __init__(self, sched):
+        self.sched = sched
+        self.errors = []
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                if not self.sched.run_until_idle():
+                    time.sleep(0.01)
+            except Exception as e:  # noqa: BLE001
+                self.errors.append(e)
+                return
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(timeout=30)
+
+
+def _bind_wire(pod, node):
+    w = pod_to_wire(pod)
+    w["nodeName"] = node
+    return w
+
+
+# ---------------------------------------------------------------------------
+# (a) 5k-node MixedChurn + 10% silence: exactly-once eviction/reschedule
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("wire_plane", [
+    "binary", pytest.param("json", marks=pytest.mark.slow)])
+def test_hollow_5k_silence_evicts_and_reschedules_exactly_once(
+        monkeypatch, wire_plane):
+    """The PR-16 acceptance run. 5000 hollow nodes across 50 zones with
+    churn running and 10% of the fleet permanently silent: the controller
+    taints every silenced node, drains its pods through the rate-limited
+    funnel, and the scheduler re-places each exactly once
+    (``scheduler_eviction_requeues_total == apiserver_pod_evictions_total``
+    — one requeue per eviction mutation, no lost pods, no duplicates).
+    Pods on surviving nodes keep their placement. Per-zone the unhealthy
+    fraction is ~10% (< threshold), so the wave runs at the primary rate.
+    The binary wire plane is tier-1; json rides slow to prove the loop is
+    codec-independent."""
+    monkeypatch.setenv("TPU_SCHED_WIRE", wire_plane)
+    server = APIServer()
+    port = server.serve(0)
+    base = f"http://127.0.0.1:{port}"
+    prof = HollowProfile(
+        count=5000, zones=50, heartbeat_s=1.5, drift=0.02,
+        churn_per_s=1.0, churn_cordon_s=0.05, register_chunk=500,
+        silence=0.10, silence_after_s=1.0, seed=7)
+    plane = HollowNodePlane(base, prof)
+    assert plane.register() == 5000
+    sched_cs = HTTPClientset(base, sync_timeout=120.0)
+    ctrl_cs = HTTPClientset(base, sync_timeout=120.0)
+    sched = Scheduler(clientset=sched_cs, deterministic_ties=True)
+    driver = _Driver(sched)
+    ctrl = NodeLifecycleController(
+        ctrl_cs, grace=3.0, noexec_after=0.75, tick=0.25,
+        primary_qps=400.0, eviction_burst=64.0)
+    try:
+        plane.start()
+        silent = set(plane.silent_nodes())
+        assert len(silent) == 500
+        assert plane.stats()["silenced"] == 500
+        # Victims: pods direct-bound onto known-silent nodes (the silenced
+        # set is deterministic from the profile seed). Survivors: pods
+        # direct-bound onto healthy nodes — their placement is the oracle.
+        silent_picks = sorted(silent)[:16]
+        healthy_picks = [n for n in sorted(server.store.nodes)
+                         if n not in silent][:24]
+        victims = {}
+        batch = []
+        for i, node in enumerate(silent_picks * 3):   # 3 pods per node
+            p = make_pod().name(f"victim-{i}").req(
+                {"cpu": "50m", "memory": "32Mi"}).obj()
+            victims[p.uid] = node
+            batch.append(_bind_wire(p, node))
+        survivors = {}
+        for i, node in enumerate(healthy_picks):
+            p = make_pod().name(f"survivor-{i}").req(
+                {"cpu": "50m", "memory": "32Mi"}).obj()
+            survivors[p.uid] = node
+            batch.append(_bind_wire(p, node))
+        _call(base, "POST", "/api/v1/pods", batch)
+        ctrl.start()
+        # the whole victim population drains through the eviction funnel
+        _wait(lambda: server.pod_evictions >= len(victims),
+              timeout=120, msg="eviction wave")
+        # ...and every victim lands again, off the silenced fleet
+        _wait(lambda: all(
+            server.store.bindings.get(u) not in (None, "")
+            and server.store.bindings[u] not in silent for u in victims),
+            timeout=180, msg="re-placement off silenced nodes")
+        # stop the controller (no new evictions), let the watch drain,
+        # then hold the exactly-once ledger line
+        ctrl.stop()
+        _wait(lambda: sched.eviction_requeues == server.pod_evictions,
+              timeout=60, msg="requeue/eviction counters to converge")
+        assert sched.eviction_requeues == server.pod_evictions
+        assert server.pod_evictions >= len(victims)
+        # every victim's intent is ledgered exactly once, and each victim
+        # exists exactly once (dict-by-uid + unique names)
+        for uid, node in victims.items():
+            assert uid in server.evictions
+        names = [p.name for p in server.store.pods.values()
+                 if p.name.startswith("victim-")]
+        assert sorted(names) == sorted(set(names))
+        assert len(names) == len(victims)
+        # surviving fleet oracle-identical: any survivor whose node is
+        # still in the fleet (churn deletes are legitimate GC evictions)
+        # kept its original placement
+        kept = 0
+        for uid, node in survivors.items():
+            if node in server.store.nodes and node not in silent:
+                assert server.store.pods[uid].node_name == node, uid
+                kept += 1
+        assert kept >= len(survivors) // 2  # churn can't have eaten most
+        # the acceptance metrics are exposed and carry the wave
+        text = ctrl.metrics_text()
+        assert _metric(text, "node_lifecycle_evictions_total") >= len(victims)
+        assert _metric(text, "node_lifecycle_evictions_throttled_total") >= 0
+        assert _metric(text, "node_lifecycle_taints_noexecute_total") > 0
+        assert not driver.errors, f"scheduler crashed: {driver.errors!r}"
+        assert plane.stats()["silenced_beats"] > 0  # silence really held
+    finally:
+        ctrl.stop()
+        driver.stop()
+        plane.stop()
+        sched_cs.close()
+        ctrl_cs.close()
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (b) zone outage: FullDisruption throttles the dead zone, not the fleet
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_zone_outage_throttles_dead_zone_only():
+    """An entire zone goes dark (outage_zone): its unhealthy fraction is
+    1.0, so its eviction bucket drops to zero — a partitioned zone must
+    read as an infrastructure failure, not 20 simultaneous node deaths.
+    Pods in the dead zone stay bound (throttled, counted), while a lone
+    silent node in a HEALTHY zone is still drained at the primary rate."""
+    server = APIServer()
+    port = server.serve(0)
+    base = f"http://127.0.0.1:{port}"
+    prof = HollowProfile(
+        count=200, zones=10, heartbeat_s=0.5, drift=0.0, churn_per_s=0.0,
+        silence=0.05, silence_after_s=0.5,
+        outage_zone=3, outage_after_s=0.5, seed=11)
+    plane = HollowNodePlane(base, prof)
+    assert plane.register() == 200
+    ctrl_cs = HTTPClientset(base)
+    ctrl = NodeLifecycleController(
+        ctrl_cs, grace=1.5, noexec_after=0.4, tick=0.2,
+        primary_qps=50.0, eviction_burst=8.0)
+    try:
+        plane.start()
+        silent = set(plane.silent_nodes())
+        zone_of = {n: node.labels.get(ZONE_LABEL, "")
+                   for n, node in server.store.nodes.items()}
+        outage_nodes = sorted(n for n, z in zone_of.items()
+                              if z == "zone-3")
+        assert len(outage_nodes) == 20
+        lone_silent = sorted(n for n in silent
+                             if zone_of[n] != "zone-3")
+        assert lone_silent, "profile seed put every silent node in zone-3?"
+        # pods in the dead zone (must stay bound) + on the lone silent
+        # node in a healthy zone (must drain)
+        doomed_zone_pods, lone_pods, batch = {}, {}, []
+        for i, node in enumerate(outage_nodes[:6]):
+            p = make_pod().name(f"zonepod-{i}").req({"cpu": "50m"}).obj()
+            doomed_zone_pods[p.uid] = node
+            batch.append(_bind_wire(p, node))
+        for i in range(4):
+            p = make_pod().name(f"lone-{i}").req({"cpu": "50m"}).obj()
+            lone_pods[p.uid] = lone_silent[0]
+            batch.append(_bind_wire(p, lone_silent[0]))
+        _call(base, "POST", "/api/v1/pods", batch)
+        ctrl.start()
+        # the dead zone trips FullDisruption...
+        _wait(lambda: ctrl.evictor.zone_states.get("zone-3") == ZONE_FULL,
+              msg="zone-3 FullDisruption")
+        # ...while the lone silent node's pods drain at the primary rate
+        _wait(lambda: all(
+            server.store.pods[u].node_name == "" for u in lone_pods),
+            msg="healthy-zone eviction wave")
+        for uid in lone_pods:
+            assert EVICTED_ANNOTATION in server.store.pods[uid].annotations
+            assert server.evictions[uid] == intent_for(uid, lone_silent[0])
+        # the throttle was observed (the dead zone had work but no token)
+        _wait(lambda: ctrl.evictor.evictions_throttled_total >= 1,
+              msg="throttle observations")
+        # hold the line for a few reconciles: zone-3 pods never move
+        time.sleep(1.0)
+        for uid, node in doomed_zone_pods.items():
+            assert server.store.pods[uid].node_name == node, uid
+        assert server.pod_evictions == len(lone_pods)
+        text = ctrl.metrics_text()
+        assert _metric(text, "node_lifecycle_evictions_total") == len(
+            lone_pods)
+        assert _metric(text, "node_lifecycle_evictions_throttled_total") >= 1
+        assert 'node_lifecycle_zone_state{zone="zone-3"} 2' in text
+        # the lone silent node's zone stayed Normal
+        assert ctrl.evictor.zone_states[zone_of[lone_silent[0]]] \
+            == ZONE_NORMAL
+    finally:
+        ctrl.stop()
+        plane.stop()
+        ctrl_cs.close()
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (c) leader kill9 mid-wave: the wave resumes with zero double-evictions
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_leader_kill9_mid_eviction_wave_zero_double_evictions(tmp_path):
+    """SIGKILL the leader apiserver in the middle of an eviction wave.
+    The promoted follower recovers the eviction ledger from the replicated
+    WAL; the controller (whose clientset re-resolves the leader) first
+    lifts taints — the fresh leader's heartbeat map makes the fleet look
+    young, the designed post-failover posture — then re-degrades after one
+    grace period and finishes the wave. Deterministic intents make every
+    replay answer ``already=True``: each victim ends unbound-with-
+    annotation exactly once, and replaying the full wave against the new
+    leader mutates nothing."""
+    from kubernetes_tpu.testing.faults import ReplicaSet
+
+    LEASE = 1.5
+    rs = ReplicaSet(str(tmp_path / "replicas"), followers=2,
+                    repl_lease=LEASE, snapshot_every=100_000)
+    hb_stop = threading.Event()
+    ctrl = None
+    ctrl_cs = None
+    try:
+        wcs = HTTPClientset(rs.follower_urls[0],
+                            fallbacks=[rs.follower_urls[1]])
+        writer = RetryingClientset(wcs, retry=RetryConfig(
+            initial_backoff=0.05, max_backoff=0.5, max_attempts=40,
+            seed=23))
+        nodes = [make_node().name(f"n{i}")
+                 .capacity({"cpu": 16, "memory": "64Gi", "pods": 110})
+                 .zone(f"z{i % 2}").obj() for i in range(8)]
+        for n in nodes:
+            writer.create_node(n)
+        # victims: 10 pods bound across the two nodes that never heartbeat
+        victims = {}
+        for i in range(10):
+            node = f"n{6 + (i % 2)}"
+            p = make_pod().name(f"v{i}").req({"cpu": "100m"}).obj()
+            victims[p.uid] = node
+            _call(rs.leader_url, "POST", "/api/v1/pods",
+                  _bind_wire(p, node))
+        healthy = [f"n{i}" for i in range(6)]
+
+        def heartbeat():
+            # Beat every replica: followers answer 421 (swallowed), the
+            # current leader — whoever that is — stamps the ages. Silent
+            # nodes n6/n7 are never beaten on ANY leader.
+            while not hb_stop.is_set():
+                for r in list(rs.replicas):
+                    try:
+                        _call(r.url, "POST", "/api/v1/nodes/status",
+                              {"names": healthy}, timeout=2.0)
+                    except Exception:  # noqa: BLE001 - dead/following
+                        pass
+                hb_stop.wait(0.25)
+
+        hb = threading.Thread(target=heartbeat, daemon=True)
+        hb.start()
+        ctrl_cs = HTTPClientset(
+            rs.follower_urls[0],
+            fallbacks=[rs.follower_urls[1], rs.leader_url])
+        rcs = RetryingClientset(ctrl_cs, retry=RetryConfig(
+            initial_backoff=0.05, max_backoff=0.5, max_attempts=20,
+            seed=31))
+        # slow wave: ~1.5 evictions/s so the kill lands mid-wave
+        ctrl = NodeLifecycleController(
+            rcs, grace=1.2, noexec_after=0.4, tick=0.2,
+            primary_qps=1.5, eviction_burst=1.0)
+        ctrl.start()
+        _wait(lambda: ctrl.evictor.evictions_total >= 3,
+              msg="wave under way")
+        assert ctrl.evictor.evictions_total < len(victims)
+        rs.kill9_leader()  # SIGKILL mid-wave: no flush, no goodbye
+        new_leader = rs.wait_for_leader(timeout=LEASE * 6)
+        assert new_leader == rs.follower_urls[0], new_leader
+        # the wave RESUMES on the promoted leader: every victim ends
+        # unbound with the eviction annotation
+        def _all_drained():
+            try:
+                got = _call(new_leader, "GET", "/api/v1/pods", timeout=5)
+            except Exception:  # noqa: BLE001
+                return False
+            by_name = {p["name"]: p for p in got
+                       if p["name"].startswith("v")}
+            return (len(by_name) == len(victims)
+                    and all(not p["nodeName"] for p in by_name.values())
+                    and all(EVICTED_ANNOTATION in (p.get("annotations")
+                                                   or {})
+                            for p in by_name.values()))
+        _wait(_all_drained, timeout=90, msg="wave to resume and drain")
+        ctrl.stop()
+        # zero lost, zero duplicated pods
+        got = _call(new_leader, "GET", "/api/v1/pods")
+        names = [p["name"] for p in got if p["name"].startswith("v")]
+        assert sorted(names) == sorted(set(names))
+        assert len(names) == len(victims)
+        # zero double-evictions: replaying the ENTIRE wave against the
+        # promoted leader answers already=True for every victim and
+        # mutates nothing (the ledger rode the replicated WAL)
+        before = _get_text(new_leader, "/metrics")
+        evicted_before = _metric(before, "apiserver_pod_evictions_total")
+        for uid, node in victims.items():
+            got = _call(new_leader, "POST",
+                        f"/api/v1/pods/{uid}/eviction",
+                        {"intent": intent_for(uid, node), "node": node})
+            assert got.get("already") is True, (uid, got)
+        after = _get_text(new_leader, "/metrics")
+        assert _metric(after, "apiserver_pod_evictions_total") \
+            == evicted_before
+        assert _metric(after, "apiserver_pod_evictions_replayed_total") \
+            >= len(victims)
+        # the failover really interrupted the wave (post-promotion
+        # taint-lift/re-degrade posture is allowed; double mutation is not)
+        assert ctrl.evictor.evictions_total + \
+            ctrl.evictor.evictions_replayed >= len(victims)
+        st = rs.status(new_leader)
+        assert st["role"] == "leader" and st["replEpoch"] >= 2
+    finally:
+        hb_stop.set()
+        if ctrl is not None:
+            ctrl.stop()
+        if ctrl_cs is not None:
+            ctrl_cs.close()
+        try:
+            wcs.close()
+        except Exception:  # noqa: BLE001
+            pass
+        rs.stop()

@@ -588,3 +588,93 @@ class TestPagedSnapshotBootstrap:
             follower.shutdown()
             leader.shutdown()
         assert len(follower.store.pods) == 0
+
+
+# ---------------------------------------------------------------------------
+# incremental sorted-key index (PR-16 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestIncrementalSortedKeyIndex:
+    def _fill(self, wc, n, prefix="p"):
+        for i in range(1, n + 1):
+            w = pod_to_wire(_pod(f"{prefix}{i:03d}"))
+            event = {"type": "ADDED", "object": w, "rv": i}
+            wc.note_event(i, "ADDED", w,
+                          data=(json.dumps(event) + "\n").encode(),
+                          event=event)
+
+    def _note(self, wc, rv, typ, w):
+        event = {"type": typ, "object": w, "rv": rv}
+        wc.note_event(rv, typ, w,
+                      data=(json.dumps(event) + "\n").encode(),
+                      event=event)
+
+    def _walk(self, wc, limit):
+        out, last = [], ""
+        while True:
+            objs, next_key, _a, _rv = wc.list_page(limit, last_key=last)
+            out.extend(objs)
+            if not next_key:
+                return out
+            last = next_key
+
+    def test_churn_maintains_index_without_resort(self):
+        """The first page pays ONE lazy sort; every add/delete after that
+        maintains the index incrementally (insort / bisect-remove), so a
+        churning fleet pages forever on `key_resorts == 1` and every walk
+        still reassembles the exact sorted snapshot."""
+        wc = WatchCache("pods")
+        self._fill(wc, 40)
+        wc.list_page(7)
+        assert wc.key_resorts == 1
+        rv = 40
+        for i in range(60):
+            rv += 1
+            if i % 3 == 2:
+                # delete a currently-live pod
+                key = sorted(wc._objects)[i % len(wc._objects)]
+                self._note(wc, rv, "DELETED", dict(wc._objects[key]))
+            else:
+                self._note(wc, rv, "ADDED",
+                           pod_to_wire(_pod(f"churn{i:03d}")))
+            got = self._walk(wc, 9)
+            assert [o["uid"] for o in got] == sorted(wc._objects)
+        assert wc.key_resorts == 1  # never re-sorted under churn
+
+    def test_reinstall_rebuilds_lazily_exactly_once(self):
+        wc = WatchCache("pods")
+        self._fill(wc, 10)
+        wc.list_page(4)
+        assert wc.key_resorts == 1
+        wc.reinstall([pod_to_wire(_pod(f"z{i}")) for i in range(10)], 10)
+        self._walk(wc, 3)     # first page after install rebuilds...
+        self._walk(wc, 3)     # ...and later walks ride the same index
+        assert wc.key_resorts == 2
+
+    def test_http_churn_pages_stay_incremental(self, api):
+        """Over HTTP: page a churning cluster repeatedly; the server's pod
+        cache pays exactly one sort, paged==unpaged once quiesced, and the
+        `apiserver_watch_cache_key_resorts_total` series carries it."""
+        server, base = api
+        for i in range(150):
+            server.store.create_pod(_pod(f"seed{i:03d}"))
+        assert fetch_paged(base, "pods", limit=16)
+        assert server.watch_cache["pods"].key_resorts == 1
+        for i in range(40):
+            server.store.create_pod(_pod(f"late{i:03d}"))
+            if i % 2:
+                victim = next(iter(server.store.pods.values()))
+                server.store.delete_pod(victim)
+            got = fetch_paged(base, "pods", limit=11)
+            assert len({w["uid"] for w in got}) == len(got)
+        assert server.watch_cache["pods"].key_resorts == 1
+        with urlrequest.urlopen(base + "/api/v1/pods", timeout=30) as r:
+            oracle = {w["uid"] for w in json.loads(r.read())}
+        assert {w["uid"]
+                for w in fetch_paged(base, "pods", limit=13)} == oracle
+        with urlrequest.urlopen(base + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("apiserver_watch_cache_key_resorts_total ")]
+        assert line and float(line[0].split()[1]) >= 1

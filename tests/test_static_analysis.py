@@ -1015,8 +1015,8 @@ def test_tree_runs_clean():
 def test_every_checker_registered_and_described():
     checkers = all_checkers()
     ids = sorted(c.id for c in checkers)
-    assert ids == ["hint-freshness", "index-dtype", "jit-purity",
-                   "lock-discipline", "metrics-discipline",
+    assert ids == ["eviction-discipline", "hint-freshness", "index-dtype",
+                   "jit-purity", "lock-discipline", "metrics-discipline",
                    "sharding-discipline", "shed-discipline",
                    "span-discipline", "thread-hygiene", "wire-discipline"]
     assert all(c.description for c in checkers)
@@ -1150,3 +1150,108 @@ def test_cli_single_checker_and_listing():
     assert "lock-discipline" in proc.stdout
     proc = _run_cli("--checker", "thread-hygiene")
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class TestEvictionDisciplineFixtures:
+    """controllers/ pod delete/evict sites must sit on a call-graph slice
+    holding BOTH the rate-limiter grant and the idempotent intent record
+    (ISSUE 16: a naked eviction is unthrottled under zone disruption and
+    replayable after a controller restart)."""
+
+    def test_flags_naked_delete(self):
+        bad = textwrap.dedent("""
+            class Reaper:
+                def drain(self, node):
+                    for pod in self.cs.pods():
+                        if pod.node_name == node:
+                            self.cs.delete_pod(pod)
+        """)
+        fs = check_source(checker_by_id("eviction-discipline"), bad)
+        assert _rules(fs) == ["eviction-outside-funnel"]
+        assert len(fs) == 1
+
+    def test_flags_limiter_without_intent(self):
+        """A throttle with no ledger rate-limits the double-evictions —
+        it does not prevent them. Still a finding."""
+        bad = textwrap.dedent("""
+            class Reaper:
+                def drain(self, zone, pod):
+                    if self._buckets[zone].try_take():
+                        self.cs.evict_pod(pod.uid, pod.node_name, "x")
+        """)
+        fs = check_source(checker_by_id("eviction-discipline"), bad)
+        assert _rules(fs) == ["eviction-outside-funnel"]
+
+    def test_flags_intent_without_limiter(self):
+        bad = textwrap.dedent("""
+            class Reaper:
+                def drain(self, pod):
+                    intent = intent_for(pod.uid, pod.node_name)
+                    self.cs.evict_pod(pod.uid, pod.node_name, intent)
+        """)
+        fs = check_source(checker_by_id("eviction-discipline"), bad)
+        assert _rules(fs) == ["eviction-outside-funnel"]
+
+    def test_passes_full_funnel_in_one_def(self):
+        good = textwrap.dedent("""
+            class Evictor:
+                def drain(self, zone, pod):
+                    if not self._buckets[zone].try_take():
+                        return
+                    intent = intent_for(pod.uid, pod.node_name)
+                    self.cs.evict_pod(pod.uid, pod.node_name, intent)
+        """)
+        assert check_source(checker_by_id("eviction-discipline"), good) == []
+
+    def test_passes_run_once_shape(self):
+        """The real evictor's shape: the token is taken one frame above
+        the intent stamp — the caller's slice covers the call site."""
+        good = textwrap.dedent("""
+            class Evictor:
+                def run_once(self):
+                    for zone, q in self._queues.items():
+                        while q and self._buckets[zone].try_take():
+                            self._evict_one(q.popleft())
+                def _evict_one(self, item):
+                    intent = intent_for(item.uid, item.node)
+                    self.cs.evict_pod(item.uid, item.node, intent)
+        """)
+        assert check_source(checker_by_id("eviction-discipline"), good) == []
+
+    def test_scope_is_controllers_only(self):
+        ck = checker_by_id("eviction-discipline")
+        assert ck.applies_to("kubernetes_tpu/controllers/evictor.py")
+        assert ck.applies_to("controllers/node_lifecycle.py")
+        assert not ck.applies_to("kubernetes_tpu/core/scheduler.py")
+        assert not ck.applies_to("tests/test_node_lifecycle.py")
+
+    def test_real_evictor_module_is_clean(self):
+        import kubernetes_tpu.controllers.evictor as ev
+        import inspect
+        src = inspect.getsource(ev)
+        assert check_source(checker_by_id("eviction-discipline"), src,
+                            "kubernetes_tpu/controllers/evictor.py") == []
+
+    def test_lock_discipline_scope_covers_controllers(self):
+        """Satellite: the lock-discipline scan now walks controllers/ too —
+        a sleep under a held lock in a controller module must flag."""
+        ck = checker_by_id("lock-discipline")
+        assert ck.applies_to("kubernetes_tpu/controllers/node_lifecycle.py")
+
+
+def test_cli_seeded_naked_delete_exits_nonzero(tmp_path):
+    """Acceptance (ISSUE 16): `eviction-discipline` exits 1 on a seeded
+    naked-delete fixture under controllers/."""
+    ctl = tmp_path / "controllers"
+    ctl.mkdir()
+    (ctl / "reaper.py").write_text(
+        "class Reaper:\n"
+        "    def drain(self, node):\n"
+        "        for pod in self.cs.pods():\n"
+        "            self.cs.delete_pod(pod)\n")
+    proc = _run_cli("--root", str(tmp_path), "--checker",
+                    "eviction-discipline", "--json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    rules = {(f["checker"], f["rule"]) for f in report["findings"]}
+    assert ("eviction-discipline", "eviction-outside-funnel") in rules
