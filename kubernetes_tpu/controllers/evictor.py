@@ -21,11 +21,19 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 ZONE_NORMAL = "Normal"
 ZONE_PARTIAL = "PartialDisruption"
 ZONE_FULL = "FullDisruption"
+
+# Deleted-node pod GC drains through this reserved queue at the primary
+# rate, always: its source node no longer EXISTS, so no zone census can
+# legitimately brake it. The "/" makes the key impossible as a
+# topology.kubernetes.io/zone label VALUE (label values reject "/"), so a
+# health census can never collide with — and throttle — the GC funnel;
+# set_zone_state refuses the key outright as a second line of defense.
+GC_ZONE = "gc/deleted-node"
 
 
 class TokenBucket:
@@ -99,7 +107,10 @@ class RateLimitedEvictor:
         self._lock = threading.Lock()
         self._buckets: Dict[str, TokenBucket] = {}
         self._pending: Dict[str, deque] = {}   # zone -> deque[(node, uid)]
-        self._queued: Dict[str, str] = {}      # uid -> node (dedupe/cancel)
+        # uid -> (zone, node): dedupe/cancel, and the zone a transport-
+        # failure retry re-enqueues into (losing it would drain the retry
+        # through the wrong bucket, bypassing a disrupted zone's brake).
+        self._queued: Dict[str, Tuple[str, str]] = {}
         self.zone_states: Dict[str, str] = {}
         self.evictions_total = 0
         self.evictions_throttled_total = 0
@@ -111,7 +122,10 @@ class RateLimitedEvictor:
 
     def set_zone_state(self, zone: str, unhealthy: int, total: int) -> str:
         """Fold one zone's health census into its eviction rate. Returns
-        the state name (observability + tests)."""
+        the state name (observability + tests). The reserved GC queue is
+        not a zone: it never slows down, whatever a census claims."""
+        if zone == GC_ZONE:
+            return ZONE_NORMAL
         frac = (unhealthy / total) if total > 0 else 0.0
         if total > 0 and unhealthy >= total:
             state, qps = ZONE_FULL, 0.0
@@ -138,7 +152,7 @@ class RateLimitedEvictor:
         with self._lock:
             if uid in self._queued:
                 return False
-            self._queued[uid] = node
+            self._queued[uid] = (zone, node)
             if zone not in self._buckets:
                 self._buckets[zone] = TokenBucket(
                     self.primary_qps, burst=self._burst, now=self._now)
@@ -155,7 +169,8 @@ class RateLimitedEvictor:
                 kept = [(n, u) for (n, u) in q if n != node]
                 dropped += len(q) - len(kept)
                 self._pending[zone] = deque(kept)
-            for uid in [u for u, n in self._queued.items() if n == node]:
+            for uid in [u for u, (_z, n) in self._queued.items()
+                        if n == node]:
                 del self._queued[uid]
             self.evictions_cancelled += dropped
         return dropped
@@ -170,12 +185,16 @@ class RateLimitedEvictor:
         """Drain each zone's queue as far as its token bucket allows.
         Returns evictions committed this pass. A zone with work but no
         token counts one throttle observation (the `_throttled_total`
-        series the zone-outage chaos scenario asserts)."""
+        series the zone-outage chaos scenario asserts). Each zone's drain
+        is bounded to the items pending at pass start: a transport-failed
+        eviction re-enqueues at the tail and waits for the NEXT reconcile
+        (retrying inside the same pass would spin tokens against a dead
+        wire)."""
         done = 0
         with self._lock:
-            zones = [z for z, q in self._pending.items() if q]
-        for zone in zones:
-            while True:
+            budget = {z: len(q) for z, q in self._pending.items() if q}
+        for zone, n in budget.items():
+            for _ in range(n):
                 with self._lock:
                     q = self._pending.get(zone)
                     if not q:
@@ -185,15 +204,16 @@ class RateLimitedEvictor:
                         break
                     node, uid = q.popleft()
                     self._queued.pop(uid, None)
-                if self._evict_one(node, uid):
+                if self._evict_one(zone, node, uid):
                     done += 1
         return done
 
-    def _evict_one(self, node: str, uid: str) -> bool:
+    def _evict_one(self, zone: str, node: str, uid: str) -> bool:
         """One rate-limit-granted eviction: deterministic intent, then the
         idempotent subresource. Every terminal server answer (evicted /
         already / pending / mismatch / gone) resolves this pod's work;
-        only a transport failure re-queues it for the next reconcile."""
+        only a transport failure re-queues it — into its ORIGINAL zone,
+        so the retry still pays that zone's (possibly disrupted) rate."""
         from urllib.error import HTTPError
 
         intent = intent_for(uid, node)
@@ -212,7 +232,7 @@ class RateLimitedEvictor:
             return False
         except Exception:  # noqa: BLE001 - transport: retry next tick
             self.eviction_errors += 1
-            self.enqueue("", node, uid)
+            self.enqueue(zone, node, uid)
             return False
         if got.get("already"):
             self.evictions_replayed += 1

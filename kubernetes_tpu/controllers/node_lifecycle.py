@@ -28,10 +28,13 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..core.apiserver import UNREACHABLE_TAINT, node_from_wire, node_to_wire
-from .evictor import ZONE_FULL, ZONE_PARTIAL, RateLimitedEvictor
+from .evictor import GC_ZONE, ZONE_FULL, ZONE_PARTIAL, RateLimitedEvictor
 
 ZONE_LABEL = "topology.kubernetes.io/zone"
-GC_ZONE = ""  # deleted-node pod GC drains through this (always-Normal) queue
+# Deleted-node pod GC drains through the evictor's reserved GC_ZONE queue
+# (always primary-rate). Unlabeled nodes census under zone "" — a REAL
+# zone whose disruption states apply — which the reserved key can never
+# collide with ("/" is illegal in a label value).
 
 READY = "Ready"
 UNKNOWN = "Unknown"

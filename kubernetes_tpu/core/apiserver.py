@@ -514,8 +514,15 @@ class APIServer:
         # Eviction idempotency ledger (pod uid -> last eviction intent id):
         # rides the WAL as "evictions" records so a controller retry —
         # across its own restart or an apiserver failover — replays as a
-        # no-op instead of double-evicting. Mutated only under the write
-        # lock (the eviction subresource / frame apply / recovery).
+        # no-op instead of double-evicting. An entry lives only for the
+        # evicted-pending window: it is dropped when the pod re-binds or
+        # is deleted (derived from the pod's own WAL'd BOUND/DELETED
+        # records, so every replica and recovery prunes identically) —
+        # a pod that re-binds to a once-failed node can be evicted again
+        # under the same deterministic intent, and the ledger never grows
+        # with pods that no longer need replay protection. Mutated only
+        # under the write lock (eviction subresource / bind / delete /
+        # frame apply / recovery).
         self.evictions: Dict[str, str] = {}
         self.pod_evictions = 0           # evictions committed
         self.pod_evictions_replayed = 0  # idempotent replays answered
@@ -628,6 +635,12 @@ class APIServer:
             self._seq.update(snap.get("seq", {}))
             repl = snap.get("repl") or {}
             self._repl_seq = max(self._repl_seq, int(repl.get("seq", 0)))
+            # Ledger before pods: a bound pod's upsert prunes its entry,
+            # so the "entry => pod unbound" invariant self-heals even
+            # against a snapshot written before pruning existed.
+            for w in snap.get("evictions", ()):
+                if w.get("uid"):
+                    self.evictions[w["uid"]] = w.get("intent", "")
             for w in snap.get("pods", ()):
                 self._apply_recovered("pods", "ADDED", w)
             for w in snap.get("nodes", ()):
@@ -636,9 +649,6 @@ class APIServer:
                 self._apply_recovered("podgroups", "ADDED", w)
             for w in snap.get("leases", ()):
                 self._install_lease(w)
-            for w in snap.get("evictions", ()):
-                if w.get("uid"):
-                    self.evictions[w["uid"]] = w.get("intent", "")
         for rec in records:
             seq = rec.get("seq")
             if seq is not None and seq > self._repl_seq:
@@ -728,15 +738,21 @@ class APIServer:
                     pod.node_name = wire.get("nodeName", "")
                     if pod.node_name:
                         self.store.bindings[pod.uid] = pod.node_name
+                        # Re-bind resolves the evicted-pending window: the
+                        # ledger prunes here exactly as the leader's live
+                        # bind path did.
+                        self.evictions.pop(pod.uid, None)
                 return
             pod = pod_from_wire(wire)
             if typ == "DELETED":
                 self.store.pods.pop(pod.uid, None)
                 self.store.bindings.pop(pod.uid, None)
+                self.evictions.pop(pod.uid, None)
             else:
                 self.store.pods[pod.uid] = pod
                 if pod.node_name:
                     self.store.bindings[pod.uid] = pod.node_name
+                    self.evictions.pop(pod.uid, None)
                 else:
                     self.store.bindings.pop(pod.uid, None)
         elif kind == "podgroups":
@@ -929,6 +945,13 @@ class APIServer:
             return 409, {"error": "OutOfCapacity"}
         self.store.bind(pod, node)
         self._usage_apply(node, pod, +1)
+        # A successful (re-)bind closes the evicted-pending window: drop
+        # the idempotency ledger entry so a LATER failure of this pod's
+        # new home — including a re-bind onto a recovered node that
+        # failed before — mints a fresh evictable wave instead of being
+        # swallowed by a stale already=True. Replicas/recovery derive the
+        # same prune from this bind's own WAL'd BOUND record.
+        self.evictions.pop(uid, None)
         return 200, {"bound": True}
 
     # -- shard leases (PUT-CAS + server-side expiry) ------------------------
@@ -1125,6 +1148,11 @@ class APIServer:
                 self.leases.clear()
                 self.evictions.clear()
                 self._seq.update(snap.get("seq", {}))
+                # Ledger before pods (see _recover): bound-pod upserts
+                # prune their entries, keeping "entry => pod unbound".
+                for w in snap.get("evictions", ()):
+                    if w.get("uid"):
+                        self.evictions[w["uid"]] = w.get("intent", "")
                 for w in snap.get("pods", ()):
                     self._apply_recovered("pods", "ADDED", w)
                 for w in snap.get("nodes", ()):
@@ -1133,9 +1161,6 @@ class APIServer:
                     self._apply_recovered("podgroups", "ADDED", w)
                 for w in snap.get("leases", ()):
                     self._install_lease(w)
-                for w in snap.get("evictions", ()):
-                    if w.get("uid"):
-                        self.evictions[w["uid"]] = w.get("intent", "")
                 repl = snap.get("repl") or {}
                 self._repl_seq = int(repl.get("seq", 0))
                 self.repl_epoch = max(self.repl_epoch,
@@ -1575,10 +1600,14 @@ class APIServer:
         write lock. Idempotent by intent id: the (uid, intent) pair is
         ledgered in `self.evictions` and WAL'd, so any retry — controller
         restart, or replay against a promoted leader — answers
-        `already=True` without touching the pod. Mutation-before-ledger is
-        the crash-safe order: a crash between them leaves a pending pod
-        the retry sees as already-evicted work (no-op), whereas
-        ledger-first could ack an eviction that never happened."""
+        `already=True` without touching the pod. The entry lives only
+        until the pod re-binds (or is deleted): once re-placed, the same
+        uid@node intent names a NEW wave — a pod that returns to a
+        recovered node must be evictable again when that node fails a
+        second time. Mutation-before-ledger is the crash-safe order: a
+        crash between them leaves a pending pod the retry sees as
+        already-evicted work (no-op), whereas ledger-first could ack an
+        eviction that never happened."""
         intent = str(body.get("intent") or "")
         want_node = str(body.get("node") or "")
         if not intent:
@@ -2624,11 +2653,16 @@ class APIServer:
                     if pod is not None:
                         bound_to = pod.node_name
                         server.store.delete_pod(pod)
-                        if bound_to and uid not in server.store.pods:
+                        if uid not in server.store.pods:
                             # Finalizer-parked deletes keep the pod (and its
                             # committed usage); only a completed delete
-                            # releases the node's share.
-                            server._usage_apply(bound_to, pod, -1)
+                            # releases the node's share — and retires the
+                            # pod's eviction-ledger entry (a gone pod needs
+                            # no replay protection; the ledger must not
+                            # grow with every pod ever evicted).
+                            if bound_to:
+                                server._usage_apply(bound_to, pod, -1)
+                            server.evictions.pop(uid, None)
                     return 200, {}
                 if self.path.startswith("/api/v1/nodes/"):
                     name = self.path.split("/")[4]

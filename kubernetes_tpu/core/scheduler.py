@@ -436,7 +436,16 @@ class Scheduler:
         # server's recreate carries the eviction-intent annotation). One
         # eviction = one recreate event = exactly one bump — the chaos
         # acceptance diffs this against the controller's evictions_total.
+        # `_eviction_residue` (uid -> intent) mirrors the server's ledger
+        # lifecycle: the annotation stays on the recreated pod, so a
+        # re-list (watch Replace after an apiserver failover) replays the
+        # same pending pod as a fresh ADDED — a matching residue entry is
+        # that replay, not a new eviction. The entry dies when the pod is
+        # observed bound (or deleted), because any LATER eviction — even
+        # one re-minting the same uid@node intent after the pod returned
+        # to a recovered-then-refailed node — must count again.
         self.eviction_requeues = 0
+        self._eviction_residue: Dict[str, str] = {}
         # Per-cycle hook (run_until_idle): the shard member's ownership
         # refresh runs here so queue-mutating failover stays on the
         # scheduling thread even through long drains.
@@ -589,6 +598,11 @@ class Scheduler:
             self._note_own_bind_confirm(new)
         else:
             self._record_pod_event(kind, old, new)
+        if new.node_name or kind == "delete":
+            # Bound or gone closes the evicted-pending window — matching
+            # the apiserver's ledger prune — so this pod's NEXT eviction
+            # counts even if it re-mints the same uid@node intent.
+            self._eviction_residue.pop(new.uid, None)
         if kind == "add":
             if new.node_name:
                 self.cache.add_pod(new)
@@ -598,7 +612,9 @@ class Scheduler:
                     and not getattr(new, "wire_slim", False)):
                 # A still-slim pod (hydration failed) must never be
                 # SCHEDULED from its projection; the sweep retries it.
-                if EVICTED_ANNOTATION in new.annotations:
+                intent = new.annotations.get(EVICTED_ANNOTATION)
+                if intent and self._eviction_residue.get(new.uid) != intent:
+                    self._eviction_residue[new.uid] = intent
                     self.eviction_requeues += 1
                 self.queue.add(new)
         elif kind == "update":

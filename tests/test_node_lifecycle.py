@@ -14,8 +14,9 @@ import pytest
 
 from kubernetes_tpu.controllers import (NodeLifecycleController,
                                         RateLimitedEvictor, TokenBucket)
-from kubernetes_tpu.controllers.evictor import (ZONE_FULL, ZONE_NORMAL,
-                                                ZONE_PARTIAL, intent_for)
+from kubernetes_tpu.controllers.evictor import (GC_ZONE, ZONE_FULL,
+                                                ZONE_NORMAL, ZONE_PARTIAL,
+                                                intent_for)
 from kubernetes_tpu.controllers.node_lifecycle import UNKNOWN
 from kubernetes_tpu.core import FakeClientset, Scheduler
 from kubernetes_tpu.core.apiserver import (EVICTED_ANNOTATION,
@@ -97,9 +98,13 @@ class _StubClientset:
         self.calls = []
         self.ledger = {}
         self.gone = set()
+        self.fail_transport = 0   # next N calls die before reaching "the wire"
 
     def evict_pod(self, uid, node, intent):
         self.calls.append((uid, node, intent))
+        if self.fail_transport > 0:
+            self.fail_transport -= 1
+            raise OSError("connection refused")
         if uid in self.gone:
             raise HTTPError("http://stub", 404, "pod not found", None, None)
         if self.ledger.get(uid) == intent:
@@ -184,6 +189,35 @@ class TestRateLimitedEvictor:
         ev.enqueue("a", "n1", "u1")
         assert ev.run_once() == 0
         assert ev.evictions_cancelled == 1 and ev.eviction_errors == 0
+
+    def test_transport_retry_requeues_into_original_zone(self):
+        """A transport failure re-queues the pod into its ORIGINAL zone,
+        so the retry still pays that zone's (possibly disrupted) rate —
+        a zone-less retry would drain at primary QPS, bypassing the very
+        brake the disruption state machine exists to apply."""
+        ev, cs, clock = self._evictor(primary_qps=100.0, burst=10.0)
+        ev.set_zone_state("z", 0, 10)          # Normal while planned
+        ev.enqueue("z", "n1", "u1")
+        cs.fail_transport = 1
+        assert ev.run_once() == 0              # token spent, wire died
+        assert ev.eviction_errors == 1
+        assert ev._queued["u1"] == ("z", "n1")
+        # the zone collapses before the retry: its brake must govern it
+        ev.set_zone_state("z", 10, 10)
+        clock[0] = 1e6
+        assert ev.run_once() == 0
+        assert ev.evictions_throttled_total >= 1
+        assert len(cs.calls) == 1              # the retry never fired
+
+    def test_gc_zone_is_census_proof(self):
+        """The reserved GC key is not a zone: a census naming it (which a
+        real fleet cannot produce — "/" is illegal in a zone label value)
+        must not re-rate the always-primary GC funnel."""
+        ev, cs, _clock = self._evictor(primary_qps=100.0, burst=10.0)
+        assert ev.set_zone_state(GC_ZONE, 10, 10) == ZONE_NORMAL
+        ev.enqueue(GC_ZONE, "vanished-node", "u1")
+        assert ev.run_once() == 1
+        assert [c[0] for c in cs.calls] == ["u1"]
 
 
 # ---------------------------------------------------------------------------
@@ -317,6 +351,28 @@ class TestTaintLadder:
         assert cs.pods[ghost.uid].node_name == ""
         assert EVICTED_ANNOTATION in cs.pods[ghost.uid].annotations
 
+    def test_unlabeled_zone_outage_does_not_stall_gc(self):
+        """Nodes missing the zone label census under zone "" — a REAL
+        zone whose disruption brake applies to ITS evictions only:
+        deleted-node pod GC drains through the reserved GC_ZONE queue and
+        must keep moving even while the unlabeled zone is frozen."""
+        ctrl, cs, clock, ages = _ladder(primary_qps=100.0,
+                                        eviction_burst=10.0)
+        for i in range(2):   # no .zone(): census zone is ""
+            cs.create_node(make_node().name(f"u{i}")
+                           .capacity({"cpu": 8, "memory": "16Gi",
+                                      "pods": 110}).obj())
+        ghost = make_pod().name("ghost").req({"cpu": "100m"}).obj()
+        ghost.node_name = "vanished-node"
+        cs.create_pod(ghost)
+        ages.update({"u0": 99.0, "u1": 99.0})   # the whole "" zone silent
+        ctrl.reconcile_once()
+        clock[0] = 10.0
+        ctrl.reconcile_once()
+        assert ctrl.evictor.zone_states[""] == ZONE_FULL
+        assert ctrl.pods_gc == 1
+        assert cs.pods[ghost.uid].node_name == ""   # GC drained anyway
+
     def test_zone_census_throttles_before_evicting(self):
         """A fully-silent zone must never storm: every one of its nodes is
         Unknown, so its bucket is zero-rate BEFORE any eviction token is
@@ -431,6 +487,68 @@ class TestEvictionSubresource:
                     {"intent": "i", "node": "n0"})
         assert got == {"evicted": False, "pending": True}
 
+    def test_rebind_reopens_eviction_window(self, api):
+        """Taint lifts, the pod re-binds to the SAME once-failed node,
+        the node fails AGAIN: the re-bind pruned the ledger entry, so the
+        re-minted uid@node intent is a fresh wave — not swallowed by a
+        stale already=True that would pin the pod to a dead node."""
+        server, base = api
+        uid = self._bound_pod(base)
+        intent = intent_for(uid, "n0")
+        got = _call(base, "POST", f"/api/v1/pods/{uid}/eviction",
+                    {"intent": intent, "node": "n0"})
+        assert got["evicted"] is True
+        assert server.evictions[uid] == intent
+        _call(base, "POST", f"/api/v1/pods/{uid}/binding", {"node": "n0"})
+        assert uid not in server.evictions     # window closed on re-bind
+        got = _call(base, "POST", f"/api/v1/pods/{uid}/eviction",
+                    {"intent": intent, "node": "n0"})
+        assert got["evicted"] is True and "already" not in got
+        assert server.pod_evictions == 2
+        assert server.pod_evictions_replayed == 0
+        assert server.store.pods[uid].node_name == ""
+
+    def test_delete_prunes_ledger(self, api):
+        """A gone pod needs no replay protection: its ledger entry must
+        not outlive it (unbounded ledger/snapshot growth otherwise)."""
+        server, base = api
+        uid = self._bound_pod(base)
+        _call(base, "POST", f"/api/v1/pods/{uid}/eviction",
+              {"intent": intent_for(uid, "n0"), "node": "n0"})
+        assert uid in server.evictions
+        _call(base, "DELETE", f"/api/v1/pods/{uid}")
+        assert uid not in server.evictions
+
+    def test_ledger_prune_survives_restart(self, api, tmp_path):
+        """The prune is derived from the pod's own WAL'd BOUND record, so
+        recovery replays evict-then-rebind to an EMPTY entry: a
+        post-restart wave for the re-failed node evicts instead of
+        replaying."""
+        data = str(tmp_path / "state")
+        server = APIServer(data_dir=data)
+        port = server.serve(0)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            uid = self._bound_pod(base)
+            intent = intent_for(uid, "n0")
+            _call(base, "POST", f"/api/v1/pods/{uid}/eviction",
+                  {"intent": intent, "node": "n0"})
+            _call(base, "POST", f"/api/v1/pods/{uid}/binding",
+                  {"node": "n0"})
+        finally:
+            server.shutdown()
+        server2 = APIServer(data_dir=data)
+        port2 = server2.serve(0)
+        base2 = f"http://127.0.0.1:{port2}"
+        try:
+            assert uid not in server2.evictions
+            got = _call(base2, "POST", f"/api/v1/pods/{uid}/eviction",
+                        {"intent": intent, "node": "n0"})
+            assert got["evicted"] is True and "already" not in got
+            assert server2.pod_evictions == 1
+        finally:
+            server2.shutdown()
+
     def test_ledger_survives_restart(self, api, tmp_path):
         """Controller restart AND apiserver restart: the eviction ledger
         rides the WAL, so a replayed intent stays exactly-once across
@@ -491,6 +609,40 @@ class TestHeartbeatAges:
             assert "hb1" in ages
         finally:
             cs.close()
+
+
+# ---------------------------------------------------------------------------
+# Scheduler requeue accounting: replay-proof, re-eviction-aware
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerEvictionRequeueDedup:
+    def test_relist_replay_counts_once_and_rebind_reopens(self):
+        """The eviction annotation stays on the recreated pod, so a watch
+        Replace (apiserver failover re-list) replays the same pending pod
+        as a fresh ADDED — that replay must not re-count. But once the
+        pod is observed bound, the residue dies (mirroring the server's
+        ledger prune): a later eviction re-minting the SAME uid@node
+        intent is a new wave and counts again."""
+        cs = FakeClientset()
+        sched = Scheduler(clientset=cs, deterministic_ties=True)
+        p = make_pod().name("victim").req({"cpu": "100m"}).obj()
+        intent = intent_for(p.uid, "n1")
+        p.annotations[EVICTED_ANNOTATION] = intent
+        sched._on_pod_event("add", None, p)
+        assert sched.eviction_requeues == 1
+        # failover re-list replays the identical pending pod
+        sched._on_pod_event("add", None, p)
+        assert sched.eviction_requeues == 1    # replay, not a new eviction
+        # the pod re-binds; node n1 later fails again -> same intent id
+        bound = copy.deepcopy(p)
+        bound.node_name = "n1"
+        sched._on_pod_event("update", p, bound)
+        sched._on_pod_event("delete", bound, bound)   # eviction's DELETE
+        recreated = copy.deepcopy(p)
+        recreated.node_name = ""
+        sched._on_pod_event("add", None, recreated)   # ...and recreate
+        assert sched.eviction_requeues == 2    # a genuinely new wave
 
 
 # ---------------------------------------------------------------------------
@@ -567,10 +719,12 @@ def test_closed_loop_silence_taint_evict_reschedule(api):
             if uid not in victims:
                 assert final[uid] == node
         # exactly-once bookkeeping end to end: one server mutation and one
-        # scheduler requeue per victim, every intent in the ledger
+        # scheduler requeue per victim — and every re-bind closed its
+        # evicted-pending window, so the ledger drained back to empty
+        # (bounded: no entry outlives the pod's pending window)
         assert server.pod_evictions == len(victims)
         assert sched.eviction_requeues == len(victims)
-        assert len(server.evictions) == len(victims)
+        assert len(server.evictions) == 0
         assert ctrl.evictor.evictions_total == len(victims)
         # heartbeats return: the ladder unwinds
         hb_stop.set()

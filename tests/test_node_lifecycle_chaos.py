@@ -168,10 +168,16 @@ def test_hollow_5k_silence_evicts_and_reschedules_exactly_once(
               timeout=60, msg="requeue/eviction counters to converge")
         assert sched.eviction_requeues == server.pod_evictions
         assert server.pod_evictions >= len(victims)
-        # every victim's intent is ledgered exactly once, and each victim
-        # exists exactly once (dict-by-uid + unique names)
+        # the ledger holds only the evicted-pending window: every victim
+        # observed bound had its entry pruned by that re-bind (bounded
+        # ledger — and a victim landing on a node that later fails stays
+        # evictable). Each victim exists exactly once (dict-by-uid +
+        # unique names).
         for uid, node in victims.items():
-            assert uid in server.evictions
+            if server.store.bindings.get(uid):
+                assert uid not in server.evictions, uid
+        for uid in list(server.evictions):
+            assert uid in server.store.pods, uid
         names = [p.name for p in server.store.pods.values()
                  if p.name.startswith("victim-")]
         assert sorted(names) == sorted(set(names))
