@@ -92,7 +92,8 @@ def all_checkers() -> List[Checker]:
     # Import the checker modules for their registration side effect.
     from . import (eviction_discipline, hint_freshness,  # noqa: F401
                    index_dtype, jit_purity, lock_discipline,
-                   metrics_discipline, shed_discipline, sharding_discipline,
+                   metrics_discipline, reconcile_discipline,
+                   shed_discipline, sharding_discipline,
                    span_discipline, thread_hygiene, wire_discipline)
     return [cls() for _, cls in sorted(_REGISTRY.items())]
 

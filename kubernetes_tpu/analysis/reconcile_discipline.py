@@ -1,0 +1,167 @@
+"""reconcile-discipline checker: controller pod creates stay exactly-once.
+
+Incident class (ISSUE 17): the workload controllers are HA — two
+controller-manager processes race a lease, and the loser's informers are
+WARM, one kill9 away from running the same reconcile against the same
+desired state. The construction that keeps their creates exactly-once is
+source-visible and this rule pins it: every pod a controller mints is
+named by a pure function of desired state (``replica_name`` /
+``gang_member_name``), and every create flows through a seam that treats
+HTTP 409 AlreadyExists as success ("the other actor — or my own previous
+incarnation — already did this"). A create site missing either half is
+the duplicate-pod storm waiting for a failover: random or clock-derived
+names make the races semantic collisions invisible (two actors mint
+DIFFERENT pods for the same ordinal), and a 409-is-error create turns
+the benign collision into a crash-looping reconciler.
+
+Rule ``create-outside-seam``: in ``controllers/``, every function that
+calls a pod-create verb (``.create_pod(...)``) must sit on a same-module
+call-graph slice that contains BOTH
+
+- a deterministic-name source (``replica_name(...)`` /
+  ``gang_member_name(...)``), and
+- a create-409-is-success handler (an ``except`` arm comparing
+  ``.code`` against 409).
+
+"Slice" follows eviction_discipline's shape: the sinks may live in the
+calling function itself, in its same-module callee closure, or in a
+caller whose callee closure contains both the call site and the sinks
+(the ``_mint → _create_pod`` shape, where the name is derived one frame
+above the 409 handling). Both must appear in ONE slice — deterministic
+names without 409-tolerance still crash the second actor, and
+409-tolerance over random names still duplicates pods.
+
+(Voluntary pod REMOVAL in controllers/ is covered separately: the
+server-side PDB precondition guards ``delete_pod_voluntary``, and the
+eviction funnel rule guards ``delete_pod``/``evict_pod``.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .base import Checker, Finding, ModuleSource, attr_chain, register
+
+SCOPE_DIR = "controllers/"
+
+CREATE_VERBS = {"create_pod"}
+NAME_SINKS = {"replica_name", "gang_member_name"}
+
+
+def _has_409_handler(fn: ast.AST) -> bool:
+    """True when the def contains, inside an except arm, a comparison of
+    some ``<e>.code`` against 409 — the create-409-is-success seam."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Compare):
+                continue
+            sides = [sub.left, *sub.comparators]
+            has_code = any(isinstance(s, ast.Attribute) and s.attr == "code"
+                           for s in sides)
+            has_409 = any(isinstance(s, ast.Constant) and s.value == 409
+                          for s in sides)
+            if has_code and has_409:
+                return True
+    return False
+
+
+def _fn_facts(fn: ast.AST) -> Tuple[List[int], bool, bool, Set[str]]:
+    """(create-call linenos, has_name_sink, has_409, same-module callee
+    names) for one def."""
+    creates: List[int] = []
+    has_name = False
+    calls: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in CREATE_VERBS:
+                creates.append(node.lineno)
+            if func.attr in NAME_SINKS:
+                has_name = True
+        elif isinstance(func, ast.Name) and func.id in NAME_SINKS:
+            has_name = True
+        chain = attr_chain(func)
+        if chain and (len(chain) == 1
+                      or (len(chain) == 2 and chain[0] == "self")):
+            calls.add(chain[-1])
+    return creates, has_name, _has_409_handler(fn), calls
+
+
+@register
+class ReconcileDisciplineChecker(Checker):
+    id = "reconcile-discipline"
+    description = ("controllers/ pod create call sites stay on a "
+                   "call-graph slice containing both a deterministic "
+                   "name source (replica_name/gang_member_name) and a "
+                   "create-409-is-success handler")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(SCOPE_DIR) or ("/" + SCOPE_DIR) in relpath
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        tree = mod.tree
+        if tree is None:
+            return []
+        defs: List[Tuple[str, List[int], bool, bool, Set[str]]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.append((node.name, *_fn_facts(node)))
+        name_det: Dict[str, bool] = {}
+        name_409: Dict[str, bool] = {}
+        name_calls: Dict[str, Set[str]] = {}
+        for name, _c, det, tol, calls in defs:
+            name_det[name] = name_det.get(name, False) or det
+            name_409[name] = name_409.get(name, False) or tol
+            name_calls.setdefault(name, set()).update(calls)
+        reach_memo: Dict[str, Set[str]] = {}
+
+        def reach(name: str) -> Set[str]:
+            got = reach_memo.get(name)
+            if got is not None:
+                return got
+            reach_memo[name] = out = set()
+            stack = [name]
+            while stack:
+                for callee in name_calls.get(stack.pop(), ()):
+                    if callee not in out and callee in name_calls:
+                        out.add(callee)
+                        stack.append(callee)
+            return out
+
+        def slice_ok(names: Set[str]) -> bool:
+            return (any(name_det.get(n, False) for n in names)
+                    and any(name_409.get(n, False) for n in names))
+
+        def def_covered(name: str, calls: Set[str]) -> bool:
+            down = {name}
+            for c in calls:
+                if c in name_calls:
+                    down.add(c)
+                    down |= reach(c)
+            if slice_ok(down):
+                return True
+            for g, _c, _d, _t, _cl in defs:
+                gr = reach(g)
+                if name in gr and slice_ok(gr | {g}):
+                    return True
+            return False
+
+        out: List[Finding] = []
+        for name, creates, _det, _tol, calls in defs:
+            if not creates or def_covered(name, calls):
+                continue
+            for line in creates:
+                out.append(Finding(
+                    self.id, "create-outside-seam", mod.path, line,
+                    f"{name}() creates a pod but no call-graph slice "
+                    "through it derives a deterministic name "
+                    "(replica_name/gang_member_name) AND treats create-"
+                    "409 as success — a racy create: two HA reconcilers "
+                    "(or one across a kill9 failover) duplicate pods "
+                    "instead of colliding benignly"))
+        return out

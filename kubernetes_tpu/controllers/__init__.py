@@ -1,13 +1,43 @@
 """Native controller plane (kube-controller-manager analogue, PAPER.md L4).
 
-First resident: the node-lifecycle controller — lease/heartbeat-driven
-health monitoring, taint-on-unready (NoSchedule -> NoExecute ladder),
-rate-limited zone-aware eviction, and pod GC — run as its own process
-(`python -m kubernetes_tpu.controllers --api-url ...`) against the real
-apiserver via HTTPClientset. docs/RESILIENCE.md § node lifecycle.
+Residents:
+
+- node-lifecycle controller — lease/heartbeat-driven health, the
+  taint-on-unready ladder, rate-limited zone-aware eviction, pod GC
+  (docs/RESILIENCE.md § node lifecycle);
+- workload controller-manager — ReplicaSet/Deployment reconcile +
+  rolling updates, gang lifecycle over PodGroups, cluster autoscaler,
+  Borg-style trace-profile feed, all behind one HA PUT-CAS lease
+  (docs/RESILIENCE.md § workload controllers).
+
+Both run as their own processes: ``python -m kubernetes_tpu.controllers
+--mode {node-lifecycle,workload} --api-url ...`` against the real
+apiserver via HTTPClientset.
 """
 
+from .autoscaler import ClusterAutoscaler
 from .evictor import RateLimitedEvictor, TokenBucket
 from .node_lifecycle import NodeLifecycleController
+from .traceprofile import WorkloadProfile
+from .workload import (
+    DeploymentController,
+    GangController,
+    ReplicaSetController,
+    WorkloadControllerManager,
+    gang_member_name,
+    replica_name,
+)
 
-__all__ = ["NodeLifecycleController", "RateLimitedEvictor", "TokenBucket"]
+__all__ = [
+    "ClusterAutoscaler",
+    "DeploymentController",
+    "GangController",
+    "NodeLifecycleController",
+    "RateLimitedEvictor",
+    "ReplicaSetController",
+    "TokenBucket",
+    "WorkloadControllerManager",
+    "WorkloadProfile",
+    "gang_member_name",
+    "replica_name",
+]

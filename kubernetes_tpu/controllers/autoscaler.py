@@ -1,0 +1,130 @@
+"""Cluster-autoscaler loop (controller-family member).
+
+Watches the PENDING-POD BACKLOG AGE off the informer cache — the signal
+the reference autoscaler derives from unschedulable pod events — and
+adds hollow nodes in waves when the oldest pending pod has waited past
+`pending_age_s`, bounded by `max_nodes` and a scale cooldown. The
+inverse direction removes ONLY nodes this loop itself added
+(`<prefix>-N` names) and only while they hold no bound pods and the
+backlog is empty, never shrinking the cluster below `min_nodes`.
+Node adds/removes ride the public REST surface (bulk node POST /
+DELETE — the hollow plane's own register/delete verbs), so WAL,
+replication, and watch fanout see autoscaled capacity exactly as
+registered kubelets.
+
+Ages are tracked against THIS controller's clock from first sight of
+each pending pod: after a takeover the new ACTIVE manager re-ages the
+backlog from zero — one full `pending_age_s` of grace before it scales,
+the same failover posture as the node-lifecycle controller's heartbeat
+ages.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from ..core.apiserver import node_from_wire
+
+
+def _default_node_wire(name: str) -> dict:
+    # A generously-sized hollow shape: autoscaled capacity must actually
+    # absorb the backlog that triggered it.
+    return {"name": name, "uid": f"node/{name}",
+            "labels": {"autoscaler.kubernetes.io/managed": "true"},
+            "allocatable": {"cpu": 16000, "memory": 64 << 30,
+                            "ephemeral": 0, "pods": 110, "scalar": {}},
+            "taints": [], "unschedulable": False}
+
+
+class ClusterAutoscaler:
+    def __init__(self, clientset, min_nodes: int = 0,
+                 max_nodes: int = 100, wave: int = 2,
+                 pending_age_s: float = 2.0, cooldown_s: float = 5.0,
+                 prefix: str = "autoscale",
+                 node_wire_fn: Optional[Callable[[str, int], dict]] = None,
+                 now: Callable[[], float] = time.monotonic):
+        self.cs = clientset
+        self.min_nodes = int(min_nodes)
+        self.max_nodes = int(max_nodes)
+        self.wave = max(1, int(wave))
+        self.pending_age_s = float(pending_age_s)
+        self.cooldown_s = float(cooldown_s)
+        self.prefix = prefix
+        self._node_wire = node_wire_fn or (
+            lambda name, _seq: _default_node_wire(name))
+        self._now = now
+        self._pending_since: Dict[str, float] = {}
+        self._last_scale = -float("inf")
+        self._seq = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.nodes_added = 0
+        self.nodes_removed = 0
+        self.errors = 0
+
+    def reconcile_once(self) -> None:
+        now = self._now()
+        pending = {p.uid for p in self.cs.pods.values()
+                   if not p.node_name and p.deletion_ts is None}
+        for uid in [u for u in self._pending_since if u not in pending]:
+            del self._pending_since[uid]
+        oldest = 0.0
+        for uid in pending:
+            oldest = max(oldest,
+                         now - self._pending_since.setdefault(uid, now))
+        if now - self._last_scale < self.cooldown_s:
+            return
+        total = len(self.cs.nodes)
+        if pending and oldest >= self.pending_age_s:
+            if total < self.max_nodes:
+                self._scale_up(min(self.wave, self.max_nodes - total), now)
+            return
+        if not pending:
+            self._scale_down(now)
+
+    def _scale_up(self, k: int, now: float) -> None:
+        added = 0
+        for _ in range(k):
+            name = f"{self.prefix}-{self._seq}"
+            self._seq += 1
+            try:
+                self.cs.create_node(
+                    node_from_wire(self._node_wire(name, self._seq - 1)))
+                added += 1
+            except Exception:  # noqa: BLE001 - 409/transport: retry later
+                self.errors += 1
+        if added:
+            self.nodes_added += added
+            self.scale_ups += 1
+            self._last_scale = now
+
+    def _scale_down(self, now: float) -> None:
+        total = len(self.cs.nodes)
+        removable = total - self.min_nodes
+        if removable <= 0:
+            return
+        occupied = {p.node_name for p in self.cs.pods.values()
+                    if p.node_name}
+        empties = sorted(n for n in self.cs.nodes
+                         if n.startswith(self.prefix + "-")
+                         and n not in occupied)
+        removed = 0
+        for name in empties[:min(self.wave, removable)]:
+            try:
+                self.cs.delete_node(name)
+                removed += 1
+            except Exception:  # noqa: BLE001
+                self.errors += 1
+        if removed:
+            self.nodes_removed += removed
+            self.scale_downs += 1
+            self._last_scale = now
+
+    def stats(self) -> dict:
+        return {"scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "nodes_added": self.nodes_added,
+                "nodes_removed": self.nodes_removed,
+                "pending_tracked": len(self._pending_since),
+                "errors": self.errors}

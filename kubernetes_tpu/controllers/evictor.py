@@ -117,6 +117,7 @@ class RateLimitedEvictor:
         self.evictions_replayed = 0   # server answered already=True
         self.evictions_cancelled = 0  # taint lift / pod moved / pod gone
         self.eviction_errors = 0      # transient failures (retried next tick)
+        self.evictions_budget_blocked = 0  # PDB 429s (requeued, retried)
 
     # -- zone disruption state machine --------------------------------------
 
@@ -227,6 +228,14 @@ class RateLimitedEvictor:
                 # NodeMismatch (pod moved since the plan) or finalizer
                 # parked — either way this plan is stale, not retryable.
                 self.evictions_cancelled += 1
+                return False
+            if e.code == 429:
+                # DisruptionBudget: committing this eviction would take a
+                # workload below its PDB's minAvailable. NOT stale and NOT
+                # an error — re-queue into the ORIGINAL zone and retry
+                # after the workload controller has healed the slack.
+                self.evictions_budget_blocked += 1
+                self.enqueue(zone, node, uid)
                 return False
             self.eviction_errors += 1
             return False

@@ -217,6 +217,7 @@ class NodeLifecycleController:
             "evictions_throttled": ev.evictions_throttled_total,
             "evictions_replayed": ev.evictions_replayed,
             "evictions_cancelled": ev.evictions_cancelled,
+            "evictions_budget_blocked": ev.evictions_budget_blocked,
             "eviction_errors": ev.eviction_errors,
             "zone_states": dict(ev.zone_states),
         }
@@ -235,6 +236,8 @@ class NodeLifecycleController:
                  ev.evictions_replayed),
                 ("node_lifecycle_evictions_cancelled_total",
                  ev.evictions_cancelled),
+                ("node_lifecycle_evictions_budget_blocked_total",
+                 ev.evictions_budget_blocked),
                 ("node_lifecycle_eviction_errors_total", ev.eviction_errors),
                 ("node_lifecycle_taints_noschedule_total",
                  self.taints_noschedule),

@@ -1,0 +1,604 @@
+"""Self-healing workload plane: ReplicaSet/Deployment + gang controllers
+with HA leader election (replicaset.go / deployment_controller.go /
+the fork's gang admission collapsed into native reconcile loops).
+
+Three invariants carry the whole module, and the analyzer's
+`reconcile-discipline` rule pins the first two in source:
+
+1. **Deterministic pod names.** Every pod a controller mints is named by
+   a pure function of (owner, revision, ordinal) — `replica_name` /
+   `gang_member_name` — and its uid IS its name. Two controller-manager
+   processes racing the same desired state therefore race toward the
+   SAME creates.
+2. **Create-409-is-success.** All pod creates leave through one seam,
+   `_create_pod`, which treats 409 AlreadyExists as "the other actor (or
+   my previous incarnation) already did this" — not an error. (1) + (2)
+   together give exactly-once creates across kill9 failover with zero
+   controller-local persistence, the same construction the eviction
+   plane gets from deterministic intent ids + the WAL'd ledger.
+3. **Voluntary deletes pay the PDB toll.** Scale-downs and rolling-
+   update drains leave through `delete_pod_voluntary`, whose server-side
+   precondition (429 DisruptionBudget) refuses any delete that would
+   take a selector's BOUND count below minAvailable. A blocked delete is
+   simply retried next tick, after self-healing has restored slack.
+
+Leader election: both manager processes PUT-CAS the shared
+`workload-controller-manager` lease every tick; the CAS loser runs
+STANDBY (informers warm, reconcilers idle) and takes over inside the
+lease TTL when the ACTIVE holder dies.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+from urllib.error import HTTPError
+
+from ..api.resource import Resource
+from ..api.types import Container, Pod, PodGroup
+from .autoscaler import ClusterAutoscaler
+from .traceprofile import WorkloadProfile
+
+# Ownership travels as labels (ownerReferences flattened): a pod's
+# controlling ReplicaSet, and — transitively — its Deployment.
+OWNER_LABEL = "replicaset.kubernetes.io/name"
+DEPLOY_LABEL = "deployment.kubernetes.io/name"
+GANG_LABEL = "gang.kubernetes.io/name"
+MANAGER_LEASE = "workload-controller-manager"
+
+_MEMBER_RE = re.compile(r"^(?P<gang>.+)-r(?P<r>\d+)-m(?P<i>\d+)$")
+
+
+def replica_name(rs: str, revision: int, ordinal: int) -> str:
+    """Deterministic replica pod name: <rs>-<sha1(revision:ordinal)[:10]>.
+    Pure in (rs, revision, ordinal), so any two reconcilers — or one
+    reconciler before and after a kill9 — mint identical names for
+    identical desired state; the create-409-is-success seam then
+    collapses their races into exactly-once creates."""
+    h = hashlib.sha1(f"{revision}:{ordinal}".encode()).hexdigest()[:10]
+    return f"{rs}-{h}"
+
+
+def gang_member_name(gang: str, incarnation: int, ordinal: int) -> str:
+    """Deterministic gang member name (incarnation = whole-gang restart
+    counter; parseable so a takeover re-derives the census from live
+    pods alone)."""
+    return f"{gang}-r{incarnation}-m{ordinal}"
+
+
+def _create_pod(cs, pod: Pod) -> bool:
+    """THE create seam: every controller pod create funnels through here.
+    409 AlreadyExists means some actor already made this exact pod
+    (deterministic names make the collision semantic, not accidental) —
+    success, not error. Returns True only for a fresh create."""
+    try:
+        cs.create_pod(pod)
+        return True
+    except HTTPError as e:
+        if e.code == 409:
+            return False
+        raise
+
+
+def _template_pod(name: str, namespace: str, labels: Dict[str, str],
+                  cpu_milli: int, pod_group: str = "") -> Pod:
+    containers = []
+    if cpu_milli:
+        containers.append(Container(
+            name="main", requests=Resource(milli_cpu=int(cpu_milli))))
+    return Pod(name=name, namespace=namespace or "default", uid=name,
+               labels=dict(labels), containers=containers,
+               pod_group=pod_group)
+
+
+class ReplicaSetController:
+    """Reconcile `replicasets` wire objects against live pods.
+
+    Desired: the replica_name set for (name, revision, replicas).
+    Missing members are created (self-healing: a chaos-killed pod's name
+    reappears in the want-set and is re-minted next tick); surplus
+    members — revision skew after a rolling step, or a scale-down —
+    drain via voluntary deletes, each subject to the server's PDB
+    precondition."""
+
+    def __init__(self, clientset):
+        self.cs = clientset
+        self.pods_created = 0
+        self.creates_409 = 0
+        self.pods_deleted = 0
+        self.deletes_blocked = 0
+        self.errors = 0
+
+    def reconcile_once(self) -> None:
+        for rs in list(self.cs.workloads["replicasets"].values()):
+            try:
+                self._reconcile_rs(rs)
+            except Exception:  # noqa: BLE001 - transient: retry next tick
+                self.errors += 1
+
+    def _owned(self, name: str) -> Dict[str, Pod]:
+        return {p.uid: p for p in self.cs.pods.values()
+                if p.labels.get(OWNER_LABEL) == name
+                and p.deletion_ts is None}
+
+    def _reconcile_rs(self, rs: dict) -> None:
+        name = rs["name"]
+        ns = rs.get("namespace") or "default"
+        revision = int(rs.get("revision") or 0)
+        replicas = max(0, int(rs.get("replicas") or 0))
+        owned = self._owned(name)
+        want = {replica_name(name, revision, i) for i in range(replicas)}
+        template = rs.get("template") or {}
+        labels = dict(template.get("labels") or {})
+        labels[OWNER_LABEL] = name
+        if rs.get("deployment"):
+            labels[DEPLOY_LABEL] = rs["deployment"]
+        for pod_name in sorted(want - owned.keys()):
+            pod = _template_pod(pod_name, ns, labels,
+                                int(template.get("cpuMilli") or 0))
+            if _create_pod(self.cs, pod):
+                self.pods_created += 1
+            else:
+                self.creates_409 += 1
+        for uid in sorted(owned.keys() - want):
+            try:
+                self.cs.delete_pod_voluntary(uid)
+                self.pods_deleted += 1
+            except HTTPError as e:
+                if e.code == 429:
+                    self.deletes_blocked += 1  # PDB: retry next tick
+                elif e.code != 404:
+                    raise
+
+    def stats(self) -> dict:
+        return {"pods_created": self.pods_created,
+                "creates_409": self.creates_409,
+                "pods_deleted": self.pods_deleted,
+                "deletes_blocked": self.deletes_blocked,
+                "errors": self.errors}
+
+
+class DeploymentController:
+    """Rolling updates: one ReplicaSet per (deployment, revision), scaled
+    against each other under maxSurge/maxUnavailable.
+
+    Per pass: the new-revision RS may grow to desired+maxSurge minus
+    what older revisions still hold; older RSes shrink by at most the
+    availability budget — BOUND pods above desired-maxUnavailable —
+    so a rollout never dips a workload below its floor even before any
+    PDB is consulted. Old RSes that reach zero with no owned pods are
+    garbage-collected through the workload DELETE verb."""
+
+    def __init__(self, clientset):
+        self.cs = clientset
+        self.rs_puts = 0
+        self.rs_deleted = 0
+        self.rollouts_completed = 0
+        self.errors = 0
+        self._done_revision: Dict[str, int] = {}
+
+    def reconcile_once(self) -> None:
+        deps = {d["name"] for d in
+                self.cs.workloads["deployments"].values()}
+        for rs in list(self.cs.workloads["replicasets"].values()):
+            # Cascade: an RS whose owning deployment is gone (two-phase
+            # expiry, or a reflector-lag re-PUT right after the delete)
+            # drains to zero and is collected here — nothing else
+            # iterates it anymore.
+            if rs.get("deployment") and rs["deployment"] not in deps:
+                try:
+                    self._gc_orphan(rs)
+                except Exception:  # noqa: BLE001 - retry next tick
+                    self.errors += 1
+        for dep in list(self.cs.workloads["deployments"].values()):
+            try:
+                self._reconcile_dep(dep)
+            except Exception:  # noqa: BLE001 - transient: retry next tick
+                self.errors += 1
+
+    def _gc_orphan(self, rs: dict) -> None:
+        if int(rs.get("replicas") or 0) != 0:
+            self._put_rs(dict(rs, replicas=0))
+        elif not any(p.labels.get(OWNER_LABEL) == rs["name"]
+                     for p in self.cs.pods.values()):
+            self.cs.delete_workload(
+                "replicasets", rs.get("namespace") or "default",
+                rs["name"])
+            self.rs_deleted += 1
+
+    def _rs_for(self, dep_name: str) -> List[dict]:
+        return [rs for rs in self.cs.workloads["replicasets"].values()
+                if rs.get("deployment") == dep_name]
+
+    def _put_rs(self, rs: dict) -> None:
+        self.cs.put_workload("replicasets", rs)
+        self.rs_puts += 1
+
+    def _reconcile_dep(self, dep: dict) -> None:
+        name = dep["name"]
+        ns = dep.get("namespace") or "default"
+        desired = max(0, int(dep.get("replicas") or 0))
+        revision = int(dep.get("revision") or 0)
+        surge = max(0, int(dep.get("maxSurge", 1)))
+        max_unavail = max(0, int(dep.get("maxUnavailable", 1)))
+        new_name = f"{name}-{revision}"
+        all_rs = self._rs_for(name)
+        new_rs = next((r for r in all_rs if r["name"] == new_name), None)
+        old_rs = [r for r in all_rs if r["name"] != new_name]
+        old_total = sum(int(r.get("replicas") or 0) for r in old_rs)
+
+        # Grow the new revision under the surge ceiling.
+        allowed = desired + surge
+        new_target = max(0, min(desired, allowed - old_total))
+        if new_rs is None or int(new_rs.get("replicas") or 0) != new_target:
+            self._put_rs({"name": new_name, "namespace": ns,
+                          "deployment": name, "revision": revision,
+                          "replicas": new_target,
+                          "template": dict(dep.get("template") or {})})
+
+        # Shrink old revisions by the availability budget: BOUND pods of
+        # this deployment above the desired-maxUnavailable floor.
+        available = sum(1 for p in self.cs.pods.values()
+                        if p.labels.get(DEPLOY_LABEL) == name
+                        and p.node_name and p.deletion_ts is None)
+        budget = available - max(0, desired - max_unavail)
+        for rs in sorted(old_rs, key=lambda r: r["name"]):
+            cur = int(rs.get("replicas") or 0)
+            if cur > 0 and budget > 0:
+                step = min(cur, budget)
+                budget -= step
+                self._put_rs(dict(rs, replicas=cur - step))
+            elif cur == 0 and not any(
+                    p.labels.get(OWNER_LABEL) == rs["name"]
+                    for p in self.cs.pods.values()):
+                self.cs.delete_workload(
+                    "replicasets", rs.get("namespace") or ns, rs["name"])
+                self.rs_deleted += 1
+        if (not old_rs and new_rs is not None
+                and int(new_rs.get("replicas") or 0) == desired
+                and self._done_revision.get(name) != revision):
+            self._done_revision[name] = revision
+            self.rollouts_completed += 1
+
+    def stats(self) -> dict:
+        return {"rs_puts": self.rs_puts, "rs_deleted": self.rs_deleted,
+                "rollouts_completed": self.rollouts_completed,
+                "errors": self.errors}
+
+
+class GangController:
+    """All-or-nothing gang lifecycle over the PodGroup surface.
+
+    Each gang runs as incarnation `r`: members named
+    `<gang>-r<r>-m<i>` with pod_group membership, minted through the
+    same deterministic-name/409 seam as replicas. The protocol:
+
+    - incomplete and never-seen-complete → still LAUNCHING: re-create
+      missing members of the live incarnation (idempotent catch-up, the
+      takeover path).
+    - complete → record it; older-incarnation stragglers drain.
+    - incomplete after having been observed complete → a member died:
+      partial progress is worthless to a gang, so restart the WHOLE gang
+      as incarnation r+1.
+
+    The observed-complete damping (`_completed`) is what keeps reflector
+    lag from spinning incarnations: a freshly-minted cohort that hasn't
+    echoed back through the watch yet is "still launching", never
+    "failed". Lost on failover, the new ACTIVE conservatively treats an
+    incomplete gang as launching and converges by catch-up creates —
+    exactly-once still holds because the names do not change.
+    """
+
+    def __init__(self, clientset):
+        self.cs = clientset
+        self.gangs: Dict[str, dict] = {}
+        self._completed: Dict[str, int] = {}  # highest r SEEN complete
+        self.pods_created = 0
+        self.creates_409 = 0
+        self.restarts = 0
+        self.stragglers_deleted = 0
+        self.errors = 0
+
+    def set_gang(self, spec: dict) -> None:
+        """Register/replace one gang spec: {name, size, minCount?,
+        namespace?, cpuMilli?}."""
+        self.gangs[spec["name"]] = dict(spec)
+
+    def remove_gang(self, name: str) -> None:
+        self.gangs.pop(name, None)
+        self._completed.pop(name, None)
+
+    def reconcile_once(self) -> None:
+        for spec in list(self.gangs.values()):
+            try:
+                self._reconcile_gang(spec)
+            except Exception:  # noqa: BLE001 - transient: retry next tick
+                self.errors += 1
+
+    def _ensure_group(self, spec: dict) -> None:
+        ns = spec.get("namespace") or "default"
+        if f"{ns}/{spec['name']}" in self.cs.pod_groups:
+            return
+        group = PodGroup(name=spec["name"], namespace=ns,
+                         uid=f"pg-{spec['name']}",
+                         min_count=int(spec.get("minCount")
+                                       or spec.get("size") or 0))
+        try:
+            self.cs.create_pod_group(group)
+        except HTTPError as e:
+            if e.code != 409:  # someone (or my past self) won the race
+                raise
+
+    def _census(self, name: str) -> Dict[int, Dict[int, Pod]]:
+        """Live members by incarnation -> ordinal, derived purely from
+        deterministic names — survives any controller restart."""
+        out: Dict[int, Dict[int, Pod]] = {}
+        for p in self.cs.pods.values():
+            if p.pod_group != name or p.deletion_ts is not None:
+                continue
+            m = _MEMBER_RE.match(p.name)
+            if m is None or m.group("gang") != name:
+                continue
+            out.setdefault(int(m.group("r")), {})[int(m.group("i"))] = p
+        return out
+
+    def _mint(self, spec: dict, incarnation: int, ordinals) -> None:
+        labels = {GANG_LABEL: spec["name"]}
+        for i in sorted(ordinals):
+            pod = _template_pod(
+                gang_member_name(spec["name"], incarnation, i),
+                spec.get("namespace") or "default", labels,
+                int(spec.get("cpuMilli") or 0), pod_group=spec["name"])
+            if _create_pod(self.cs, pod):
+                self.pods_created += 1
+            else:
+                self.creates_409 += 1
+
+    def _reconcile_gang(self, spec: dict) -> None:
+        name, size = spec["name"], int(spec["size"])
+        self._ensure_group(spec)
+        cohorts = self._census(name)
+        r_live = max(cohorts) if cohorts else 0
+        live = cohorts.get(r_live, {})
+        if len(live) >= size:
+            self._completed[name] = max(self._completed.get(name, -1),
+                                        r_live)
+            # Stragglers of superseded incarnations drain voluntarily
+            # (gangs carry no PDB; the verb stays uniform regardless).
+            for r, members in cohorts.items():
+                if r == r_live:
+                    continue
+                for p in members.values():
+                    try:
+                        self.cs.delete_pod_voluntary(p.uid)
+                        self.stragglers_deleted += 1
+                    except HTTPError as e:
+                        if e.code not in (404, 429):
+                            raise
+            return
+        if self._completed.get(name, -1) >= r_live:
+            # Was whole at this (or a later) incarnation and now is not:
+            # a member died. Partial gangs are worthless — restart whole.
+            target = r_live + 1
+            self.restarts += 1
+            self._completed[name] = target - 1  # don't re-trip next tick
+            self._mint(spec, target, range(size))
+            return
+        # Still launching r_live (or brand-new): catch-up creates only.
+        self._mint(spec, r_live, set(range(size)) - live.keys())
+
+    def stats(self) -> dict:
+        return {"pods_created": self.pods_created,
+                "creates_409": self.creates_409,
+                "restarts": self.restarts,
+                "stragglers_deleted": self.stragglers_deleted,
+                "gangs": len(self.gangs), "errors": self.errors}
+
+
+class WorkloadControllerManager:
+    """Composes the workload reconcilers behind ONE HA lease.
+
+    Every tick races `PUT-CAS /api/v1/leases/workload-controller-manager`;
+    the winner runs ACTIVE (profile feed → deployments → replicasets →
+    gangs → autoscaler), the loser idles STANDBY with warm informers.
+    kill9 the ACTIVE and the standby's next CAS succeeds once the TTL
+    lapses — takeover inside the lease TTL, and the deterministic-name
+    construction makes its first ACTIVE pass converge exactly-once on
+    whatever the dead incumbent half-finished."""
+
+    def __init__(self, clientset, identity: str,
+                 lease_ttl: float = 2.0, tick: float = 0.25,
+                 autoscaler: Optional[ClusterAutoscaler] = None,
+                 profile: Optional[WorkloadProfile] = None,
+                 now: Callable[[], float] = time.monotonic):
+        self.cs = clientset
+        self.identity = identity
+        self.lease_ttl = float(lease_ttl)
+        self.tick = float(tick)
+        self._now = now
+        self.replicasets = ReplicaSetController(clientset)
+        self.deployments = DeploymentController(clientset)
+        self.gangs = GangController(clientset)
+        self.autoscaler = autoscaler
+        self.profile = profile
+        self._specs = list(profile.specs()) if profile else []
+        self._fed: Dict[str, dict] = {}
+        self._expired: set = set()
+        self._t0: Optional[float] = None
+        self.active = False
+        self.ticks = 0
+        self.active_ticks = 0
+        self.standby_ticks = 0
+        self.takeovers = 0
+        self.lease_errors = 0
+        self.profile_fed = 0
+        self.profile_expired = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- the HA tick ---------------------------------------------------------
+
+    def tick_once(self) -> None:
+        self.ticks += 1
+        try:
+            got = self.cs.upsert_lease(MANAGER_LEASE, self.identity,
+                                       self.lease_ttl)
+        except Exception:  # noqa: BLE001 - leader churn mid-failover
+            self.lease_errors += 1
+            got = None
+        if got is None:
+            self.active = False
+            self.standby_ticks += 1
+            return
+        if not self.active:
+            self.takeovers += 1
+            self.active = True
+        self.active_ticks += 1
+        self._feed_profile()
+        self.deployments.reconcile_once()
+        self.replicasets.reconcile_once()
+        self.gangs.reconcile_once()
+        if self.autoscaler is not None:
+            self.autoscaler.reconcile_once()
+
+    # -- trace-profile feed --------------------------------------------------
+
+    def _feed_profile(self) -> None:
+        if not self._specs:
+            return
+        if self._t0 is None:
+            self._t0 = self._now()
+        elapsed = self._now() - self._t0
+        for spec in self._specs:
+            name = spec["name"]
+            if name not in self._fed and spec["arrival"] <= elapsed:
+                self._admit(spec)
+            elif (name in self._fed and name not in self._expired
+                  and spec["arrival"] + spec["lifetime"] <= elapsed):
+                self._retire(spec)
+
+    def _admit(self, spec: dict) -> None:
+        if spec["kind"] == "deployment":
+            self.cs.put_workload("deployments", {
+                "name": spec["name"], "namespace": "default",
+                "replicas": spec["replicas"], "revision": 0,
+                "maxSurge": spec["maxSurge"],
+                "maxUnavailable": spec["maxUnavailable"],
+                "template": {"labels": {"app": spec["name"]},
+                             "cpuMilli": spec["cpuMilli"]}})
+        else:
+            self.gangs.set_gang({"name": spec["name"], "size": spec["size"],
+                                 "cpuMilli": spec["cpuMilli"]})
+        self._fed[spec["name"]] = spec
+        self.profile_fed += 1
+
+    def _retire(self, spec: dict) -> None:
+        """Two-phase expiry. Deployments: scale to zero first (the
+        reconcilers drain pods through the voluntary/PDB path), then
+        delete the deployment + its ReplicaSets once nothing is owned.
+        Gangs: members drain voluntarily, then the spec deregisters (the
+        PodGroup record stays — the server has no delete verb for it,
+        and an empty group schedules nothing)."""
+        name = spec["name"]
+        if spec["kind"] == "deployment":
+            dep = self.cs.workloads["deployments"].get(f"default/{name}")
+            if dep is None:
+                self._expired.add(name)
+                return
+            if int(dep.get("replicas") or 0) != 0:
+                self.cs.put_workload("deployments", dict(dep, replicas=0))
+                return
+            if any(p.labels.get(DEPLOY_LABEL) == name
+                   for p in self.cs.pods.values()):
+                return  # still draining
+            for rs in self.deployments._rs_for(name):
+                self.cs.delete_workload(
+                    "replicasets", rs.get("namespace") or "default",
+                    rs["name"])
+            self.cs.delete_workload("deployments", "default", name)
+        else:
+            members = [p for p in self.cs.pods.values()
+                       if p.pod_group == name and p.deletion_ts is None]
+            if members:
+                self.gangs.remove_gang(name)  # stop re-minting first
+                for p in members:
+                    try:
+                        self.cs.delete_pod_voluntary(p.uid)
+                    except HTTPError as e:
+                        if e.code not in (404, 429):
+                            raise
+                return
+            self.gangs.remove_gang(name)
+        self._expired.add(name)
+        self.profile_expired += 1
+
+    # -- standing loop -------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="workload-manager", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.tick_once()
+            if self._stop.wait(self.tick):
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        out = {"identity": self.identity, "active": self.active,
+               "ticks": self.ticks, "active_ticks": self.active_ticks,
+               "standby_ticks": self.standby_ticks,
+               "takeovers": self.takeovers,
+               "lease_errors": self.lease_errors,
+               "profile_fed": self.profile_fed,
+               "profile_expired": self.profile_expired,
+               "replicasets": self.replicasets.stats(),
+               "deployments": self.deployments.stats(),
+               "gangs": self.gangs.stats()}
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.stats()
+        return out
+
+    def metrics_text(self) -> str:
+        rs, dep, g = (self.replicasets, self.deployments, self.gangs)
+        series = [
+            ("workload_manager_ticks_total", self.ticks),
+            ("workload_manager_takeovers_total", self.takeovers),
+            ("workload_manager_lease_errors_total", self.lease_errors),
+            ("workload_replicaset_pods_created_total", rs.pods_created),
+            ("workload_replicaset_creates_409_total", rs.creates_409),
+            ("workload_replicaset_pods_deleted_total", rs.pods_deleted),
+            ("workload_replicaset_deletes_blocked_total",
+             rs.deletes_blocked),
+            ("workload_deployment_rs_puts_total", dep.rs_puts),
+            ("workload_deployment_rollouts_completed_total",
+             dep.rollouts_completed),
+            ("workload_gang_pods_created_total", g.pods_created),
+            ("workload_gang_restarts_total", g.restarts),
+        ]
+        if self.autoscaler is not None:
+            a = self.autoscaler
+            series += [("workload_autoscaler_nodes_added_total",
+                        a.nodes_added),
+                       ("workload_autoscaler_nodes_removed_total",
+                        a.nodes_removed)]
+        out = []
+        for name, v in series:
+            out.append(f"# TYPE {name} counter")
+            out.append(f"{name} {v}")
+        out.append("# TYPE workload_manager_active gauge")
+        out.append(f"workload_manager_active {int(self.active)}")
+        return "\n".join(out) + "\n"

@@ -356,6 +356,13 @@ def pod_group_from_wire(d: dict):
 UNREACHABLE_TAINT = "node.kubernetes.io/unreachable"
 EVICTED_ANNOTATION = "node-lifecycle.kubernetes.io/evicted"
 
+# Workload-plane kinds (controllers/workload.py): server-owned wire-dict
+# maps keyed "ns/name" — no store-dict twin, the HTTP verb is the only
+# writer and the broadcast (WAL -> watch cache -> fanout) IS the commit.
+# They ride every durability/replication surface the store kinds do: WAL
+# records, apply_frame, snapshots, watch/list/paged-list.
+WORKLOAD_KINDS = ("replicasets", "deployments", "pdbs")
+
 
 # ---------------------------------------------------------------------------
 # The apiserver
@@ -438,7 +445,8 @@ class APIServer:
                  fsync: bool = False, snapshot_every: int = 2048):
         self.store = store or FakeClientset()
         self._watchers: Dict[str, List[_WatchStream]] = {
-            "pods": [], "nodes": [], "podgroups": []}
+            "pods": [], "nodes": [], "podgroups": [],
+            **{k: [] for k in WORKLOAD_KINDS}}
         self._lock = threading.Lock()
         # Shard-plane coordination (shard/leases.py): named lease records,
         # renewed through PUT /api/v1/leases/<name> with holder-CAS semantics
@@ -462,7 +470,8 @@ class APIServer:
         self._write_lock = threading.Lock()
         from collections import deque
         import uuid
-        self._seq: Dict[str, int] = {"pods": 0, "nodes": 0, "podgroups": 0}
+        self._seq: Dict[str, int] = {"pods": 0, "nodes": 0, "podgroups": 0,
+                                     **{k: 0 for k in WORKLOAD_KINDS}}
         # Watch-cache read plane (core/watchcache.py): per-kind rv-indexed
         # event ring (the RESUME window — what the old `_backlog` deques
         # held, now carrying the decoded event too so filtered streams can
@@ -472,7 +481,8 @@ class APIServer:
         self.watch_cache: Dict[str, WatchCache] = {
             "pods": WatchCache("pods", capacity=backlog),
             "nodes": WatchCache("nodes", capacity=backlog),
-            "podgroups": WatchCache("podgroups", capacity=backlog)}
+            "podgroups": WatchCache("podgroups", capacity=backlog),
+            **{k: WatchCache(k, capacity=backlog) for k in WORKLOAD_KINDS}}
         self.watch_slim_events = 0       # events delivered as slim wire
         self.watch_filtered_events = 0   # events dropped entirely
         # Wire-plane accounting (core/wire.py): bytes served/consumed per
@@ -526,6 +536,18 @@ class APIServer:
         self.evictions: Dict[str, str] = {}
         self.pod_evictions = 0           # evictions committed
         self.pod_evictions_replayed = 0  # idempotent replays answered
+        # Workload plane (WORKLOAD_KINDS): server-owned wire-dict maps
+        # keyed "ns/name". The HTTP verbs are the only writers (under the
+        # write lock) and the broadcast is the commit — there is no
+        # FakeClientset twin for these kinds.
+        self.workloads: Dict[str, Dict[str, dict]] = {
+            k: {} for k in WORKLOAD_KINDS}
+        # PodDisruptionBudget precondition on voluntary disruptions
+        # (eviction subresource + ?voluntary=true deletes): denials
+        # answered 429 so the caller backs off and retries after the
+        # workload heals. Involuntary paths (zone Full, node delete) are
+        # never budget-checked.
+        self.evictions_budget_denied = 0
         # Overload protection (core/flowcontrol.py, docs/RESILIENCE.md
         # § overload & fairness): every mutating request is classified into
         # a flow and admitted through per-priority-level bounded-concurrency
@@ -615,7 +637,8 @@ class APIServer:
         reflectors reconnecting with their last rv get RESUME, not Replace."""
         import itertools
 
-        rings: Dict[str, list] = {"pods": [], "nodes": [], "podgroups": []}
+        rings: Dict[str, list] = {"pods": [], "nodes": [], "podgroups": [],
+                                  **{k: [] for k in WORKLOAD_KINDS}}
         snap, records = self.persistence.load()
         if self.persistence.epoch is not None:
             self.epoch = self.persistence.epoch
@@ -647,6 +670,9 @@ class APIServer:
                 self._apply_recovered("nodes", "ADDED", w)
             for w in snap.get("podgroups", ()):
                 self._apply_recovered("podgroups", "ADDED", w)
+            for k in WORKLOAD_KINDS:
+                for w in snap.get(k, ()):
+                    self._apply_recovered(k, "ADDED", w)
             for w in snap.get("leases", ()):
                 self._install_lease(w)
         for rec in records:
@@ -672,7 +698,7 @@ class APIServer:
                 if obj.get("uid"):
                     self.evictions[obj["uid"]] = obj.get("intent", "")
                 continue
-            if kind not in ("pods", "nodes", "podgroups"):
+            if kind not in ("pods", "nodes", "podgroups") + WORKLOAD_KINDS:
                 continue
             self._apply_recovered(kind, rec.get("type", ""), rec.get("object"))
             rv = rec.get("rv")
@@ -706,6 +732,10 @@ class APIServer:
                  list(self.store.pod_groups.values())
                  + list(self.store.composite_pod_groups.values())],
                 self._seq["podgroups"], ring=rings["podgroups"][-cap:])
+            for k in WORKLOAD_KINDS:
+                self.watch_cache[k].reinstall(
+                    list(self.workloads[k].values()),
+                    self._seq[k], ring=rings[k][-cap:])
         self.recovered_objects = len(self.store.pods) + len(self.store.nodes)
         # Recovered nodes heartbeat-age from NOW: clocks never cross a
         # process boundary (same contract as lease renew stamps) — a live
@@ -727,6 +757,15 @@ class APIServer:
         handler fanout (there are no watchers yet) and idempotent upserts
         (a compaction snapshot may slightly lead the WAL it truncated)."""
         if wire is None:
+            return
+        if kind in WORKLOAD_KINDS:
+            # Workload kinds have no store twin: the server-owned wire-dict
+            # map IS the state. Same idempotent-upsert posture as the rest.
+            key = f'{wire.get("namespace") or "default"}/{wire.get("name")}'
+            if typ == "DELETED":
+                self.workloads[kind].pop(key, None)
+            else:
+                self.workloads[kind][key] = wire
             return
         if kind == "pods":
             if typ == "BOUND":
@@ -862,6 +901,8 @@ class APIServer:
                        for name, rec in list(self.leases.items())],
             "evictions": [{"uid": u, "intent": i}
                           for u, i in list(self.evictions.items())],
+            **{k: list(self.workloads[k].values())
+               for k in WORKLOAD_KINDS},
         }
 
     # -- Omega commit validation (per-node committed usage) -----------------
@@ -1097,7 +1138,7 @@ class APIServer:
                     obj = rec.get("object") or {}
                     if obj.get("uid"):
                         self.evictions[obj["uid"]] = obj.get("intent", "")
-                elif kind in ("pods", "nodes", "podgroups"):
+                elif kind in ("pods", "nodes", "podgroups") + WORKLOAD_KINDS:
                     self._apply_recovered(kind, rec.get("type", ""),
                                           rec.get("object"))
                     rv = rec.get("rv")
@@ -1147,6 +1188,8 @@ class APIServer:
                 self.store.composite_pod_groups.clear()
                 self.leases.clear()
                 self.evictions.clear()
+                for k in WORKLOAD_KINDS:
+                    self.workloads[k].clear()
                 self._seq.update(snap.get("seq", {}))
                 # Ledger before pods (see _recover): bound-pod upserts
                 # prune their entries, keeping "entry => pod unbound".
@@ -1159,6 +1202,9 @@ class APIServer:
                     self._apply_recovered("nodes", "ADDED", w)
                 for w in snap.get("podgroups", ()):
                     self._apply_recovered("podgroups", "ADDED", w)
+                for k in WORKLOAD_KINDS:
+                    for w in snap.get(k, ()):
+                        self._apply_recovered(k, "ADDED", w)
                 for w in snap.get("leases", ()):
                     self._install_lease(w)
                 repl = snap.get("repl") or {}
@@ -1181,6 +1227,9 @@ class APIServer:
                 self.watch_cache["podgroups"].reinstall(
                     list(snap.get("podgroups", ())),
                     self._seq.get("podgroups", 0))
+                for k in WORKLOAD_KINDS:
+                    self.watch_cache[k].reinstall(
+                        list(snap.get(k, ())), self._seq.get(k, 0))
                 for kind in self._watchers:
                     for w in self._watchers[kind]:
                         w.q.put(None)
@@ -1409,7 +1458,16 @@ class APIServer:
                 # exactly-once across controller restart and failover.
                 ("apiserver_pod_evictions_total", self.pod_evictions),
                 ("apiserver_pod_evictions_replayed_total",
-                 self.pod_evictions_replayed)):
+                 self.pod_evictions_replayed),
+                # PDB precondition: voluntary disruptions denied because
+                # committing them would take a workload below minAvailable.
+                ("apiserver_pod_evictions_budget_denied_total",
+                 self.evictions_budget_denied),
+                # WAL CRC plane (core/wal.py): complete-but-corrupt middle
+                # records detected at recovery (each one quarantined boot).
+                ("apiserver_wal_crc_failures_total",
+                 self.persistence.crc_failures
+                 if self.persistence is not None else 0)):
             out.append(f"# TYPE {name} counter")
             out.append(f"{name} {v}")
         # Flow-control plane (core/flowcontrol.py): per-priority-level
@@ -1632,6 +1690,10 @@ class APIServer:
             return 409, {"error": "NodeMismatch", "node": pod.node_name}
         if pod.finalizers:
             return 409, {"error": "FinalizerParked"}
+        denied = self._pdb_blocks_eviction(pod)
+        if denied is not None:
+            self.evictions_budget_denied += 1
+            return 429, denied
         bound_to = pod.node_name
         self.store.delete_pod(pod)
         if uid in self.store.pods:
@@ -1651,6 +1713,74 @@ class APIServer:
         self.evictions[uid] = intent
         self.pod_evictions += 1
         return 200, {"evicted": True, "node": bound_to}
+
+    def _pdb_blocks_eviction(self, pod) -> Optional[dict]:
+        """PodDisruptionBudget precondition for VOLUNTARY disruptions
+        (eviction subresource, ?voluntary=true deletes). Caller holds the
+        write lock. Returns a 429 payload when committing the disruption
+        would take a selected workload below minAvailable, else None.
+
+        ``available`` counts BOUND pods (node_name set) in the PDB's
+        namespace matching its selector — the same census the chaos suite
+        polls. An empty matchLabels selector matches NOTHING (a typo'd
+        PDB must not accidentally freeze the whole cluster). Involuntary
+        paths (zone Full, node delete) never call this — exactly the
+        reference's split (disruption.go guards the Eviction subresource,
+        not the node controller's deletes)."""
+        labels = pod.labels or {}
+        ns = getattr(pod, "namespace", "") or "default"
+        for key, pdb in self.workloads["pdbs"].items():
+            if (pdb.get("namespace") or "default") != ns:
+                continue
+            sel = pdb.get("matchLabels") or {}
+            if not sel:
+                continue
+            if any(labels.get(k) != v for k, v in sel.items()):
+                continue
+            available = sum(
+                1 for p in self.store.pods.values()
+                if p.node_name
+                and (getattr(p, "namespace", "") or "default") == ns
+                and all((p.labels or {}).get(k) == v
+                        for k, v in sel.items()))
+            min_avail = int(pdb.get("minAvailable", 0))
+            if available - 1 < min_avail:
+                return {"error": "DisruptionBudget",
+                        "pdb": pdb.get("name", key),
+                        "available": available,
+                        "minAvailable": min_avail}
+        return None
+
+    def _workload_upsert_locked(self, kind: str, body,
+                                create: bool = False):
+        """Create/upsert one workload object (WORKLOAD_KINDS). Caller
+        holds the write lock. The broadcast IS the commit: WAL record,
+        watch-cache upsert, stream fanout — same ordering as every store
+        kind, with the server-owned wire dict standing in for the store.
+        Create answers 409 AlreadyExists on a duplicate name — the
+        retry-safe half of the controllers' exactly-once contract."""
+        if not isinstance(body, dict) or not body.get("name"):
+            return 400, {"error": "name required"}
+        w = dict(body)
+        ns = w.get("namespace") or "default"
+        w["namespace"] = ns
+        w.setdefault("uid", f"{kind}/{ns}/{w['name']}")
+        key = f"{ns}/{w['name']}"
+        exists = key in self.workloads[kind]
+        if create and exists:
+            return 409, {"error": "AlreadyExists"}
+        self.workloads[kind][key] = w
+        self._broadcast(kind, {"type": "MODIFIED" if exists else "ADDED",
+                               "object": w})
+        return (201 if create else 200), w
+
+    def _workload_delete_locked(self, kind: str, ns: str, name: str):
+        key = f"{ns or 'default'}/{name}"
+        w = self.workloads[kind].pop(key, None)
+        if w is None:
+            return 404, {"error": "not found"}
+        self._broadcast(kind, {"type": "DELETED", "object": w})
+        return 200, {}
 
     def _attach_watch(self, kind: str, since: Optional[int] = None,
                       epoch: Optional[str] = None,
@@ -1843,7 +1973,9 @@ class APIServer:
                 GIL-atomic get — no lock, a racing delete just falls back
                 to the default flow)."""
                 path, body = self.path, self._body_cache
-                if path in ("/api/v1/pods", "/api/v1/podgroups"):
+                if path in ("/api/v1/pods", "/api/v1/podgroups") \
+                        or path.split("?")[0] in tuple(
+                            f"/api/v1/{k}" for k in WORKLOAD_KINDS):
                     if isinstance(body, list):
                         return (body[0].get("namespace", "")
                                 if body else "")
@@ -1978,6 +2110,16 @@ class APIServer:
                     server.list_unpaged += 1
                     return self._json(
                         200, server.watch_cache["podgroups"].list_wire())
+                for wk in WORKLOAD_KINDS:
+                    if path == f"/api/v1/{wk}":
+                        if watch:
+                            return self._stream(wk, since, epoch,
+                                                paged=paged, fresh=fresh)
+                        if limit:
+                            return self._list_paged(wk, limit, cont)
+                        server.list_unpaged += 1
+                        return self._json(
+                            200, server.watch_cache[wk].list_wire())
                 if path == "/flow":
                     # APF admin surface: current per-level weights + live
                     # admission counters (the POST half re-weights).
@@ -2132,6 +2274,13 @@ class APIServer:
                             "leases": [dict(rec, name=name, renew=None)
                                        for name, rec in
                                        list(server.leases.items())],
+                            # Intent ledger rides the meta cut (small,
+                            # bounded): a bootstrapping replica must
+                            # answer an in-flight wave's retries
+                            # idempotently from its very first frame.
+                            "evictions": [
+                                {"uid": u, "intent": i} for u, i in
+                                list(server.evictions.items())],
                             "role": server.role,
                         }
                 codec = self._accept()
@@ -2144,7 +2293,8 @@ class APIServer:
                     data = wire.encode({"type": "SNAP_META", **meta}, codec)
                     sent += len(data)
                     self._write_chunk(data)
-                    for kind in ("pods", "nodes"):
+                    for kind in ("pods", "nodes", "podgroups") \
+                            + WORKLOAD_KINDS:
                         last = ""
                         while True:
                             objs, next_key, _a, _rv = (
@@ -2522,6 +2672,10 @@ class APIServer:
                     else:
                         server.store.create_pod_group(g)
                     return 201, pod_group_to_wire(g)
+                for wk in WORKLOAD_KINDS:
+                    if self.path.split("?")[0] == f"/api/v1/{wk}":
+                        return server._workload_upsert_locked(
+                            wk, self._body(), create=True)
                 if self.path == "/api/v1/bindings":
                     # Bulk binding commits: one request, one write-lock
                     # acquisition for a whole drained dispatcher queue
@@ -2622,6 +2776,16 @@ class APIServer:
                         return 400, {"error": "name mismatch"}
                     server.store.update_node(node)
                     return 200, node_to_wire(node)
+                # Workload upsert: PUT /api/v1/{kind}/{ns}/{name} — the
+                # path names the object (idempotent spec writes: scale,
+                # rolling-update template flips, PDB edits).
+                parts = self.path.split("?")[0].split("/")
+                if len(parts) >= 6 and parts[3] in WORKLOAD_KINDS:
+                    body = self._body()
+                    if isinstance(body, dict):
+                        body = dict(body, namespace=parts[4] or "default",
+                                    name=parts[5])
+                    return server._workload_upsert_locked(parts[3], body)
                 return 404, {"error": "not found"}
 
             def do_DELETE(self):
@@ -2647,10 +2811,20 @@ class APIServer:
                 self._json(code, obj)
 
             def _delete_locked(self):
-                if self.path.startswith("/api/v1/pods/"):
-                    uid = self.path.split("/")[4]
+                path, _, query = self.path.partition("?")
+                if path.startswith("/api/v1/pods/"):
+                    uid = path.split("/")[4]
                     pod = server.store.pods.get(uid)
                     if pod is not None:
+                        if "voluntary=true" in query and pod.node_name:
+                            # Voluntary disruption (rolling-update scale-
+                            # down): same PDB precondition as the eviction
+                            # subresource — a deliberate delete must not
+                            # take a workload below minAvailable either.
+                            denied = server._pdb_blocks_eviction(pod)
+                            if denied is not None:
+                                server.evictions_budget_denied += 1
+                                return 429, denied
                         bound_to = pod.node_name
                         server.store.delete_pod(pod)
                         if uid not in server.store.pods:
@@ -2664,11 +2838,15 @@ class APIServer:
                                 server._usage_apply(bound_to, pod, -1)
                             server.evictions.pop(uid, None)
                     return 200, {}
-                if self.path.startswith("/api/v1/nodes/"):
-                    name = self.path.split("/")[4]
+                if path.startswith("/api/v1/nodes/"):
+                    name = path.split("/")[4]
                     server.store.delete_node(name)
                     server._drop_heartbeat(name)
                     return 200, {}
+                parts = path.split("/")
+                if len(parts) >= 6 and parts[3] in WORKLOAD_KINDS:
+                    return server._workload_delete_locked(
+                        parts[3], parts[4], parts[5])
                 return 404, {"error": "not found"}
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
@@ -2958,8 +3136,14 @@ class HTTPClientset:
     validates_bind_capacity = True
 
     def __init__(self, base_url: str, sync_timeout: float = 30.0,
-                 fallbacks=(), shard=None):
+                 fallbacks=(), shard=None, extra_kinds=()):
         self.base = base_url.rstrip("/")
+        # Opt-in workload-kind reflection (WORKLOAD_KINDS): controllers
+        # pass extra_kinds=("replicasets", ...) and get a reflector thread
+        # + raw wire-dict cache per kind; the default constructor stays at
+        # the three store kinds so existing clients pay nothing new.
+        self.extra_kinds = tuple(k for k in extra_kinds
+                                 if k in WORKLOAD_KINDS)
         # Server-side shard filtering (core/watchcache.py): with
         # shard=(index, count), the pod watch opens `?shard=i/n` and the
         # server delivers full pod wire only for owned + wire-relevant
@@ -3002,6 +3186,12 @@ class HTTPClientset:
         # shard members see one gang truth.
         self.pod_groups: Dict[str, object] = {}
         self.composite_pod_groups: Dict[str, object] = {}
+        # Workload-kind caches ("ns/name" -> raw wire dict): controllers
+        # read desired state straight from these — no typed twin.
+        self.workloads: Dict[str, Dict[str, dict]] = {
+            k: {} for k in self.extra_kinds}
+        self._workload_handlers: Dict[str, List] = {
+            k: [] for k in self.extra_kinds}
         # unused-surface listers (volume/DRA plugins see empty cluster state)
         self.namespaces: Dict[str, object] = {}
         self.pvs: Dict[str, object] = {}
@@ -3017,29 +3207,27 @@ class HTTPClientset:
         self._dispatch_lock = threading.Lock()
         self._stop = threading.Event()
         self._responses: List = []
-        self._synced = {"pods": threading.Event(), "nodes": threading.Event(),
-                        "podgroups": threading.Event()}
+        kinds = ("pods", "nodes", "podgroups") + self.extra_kinds
+        self._synced = {k: threading.Event() for k in kinds}
         self._fatal: Dict[str, Exception] = {}
         self.last_sync: Dict[str, float] = {}
         # resourceVersion resume (reflector.go lastSyncResourceVersion):
         # the rv of the last event (or SYNC snapshot) each stream consumed;
         # reconnects ask the server to replay from here instead of
         # re-listing. relists/resumes count how each reconnect was served.
-        self._last_rv: Dict[str, Optional[int]] = {
-            "pods": None, "nodes": None, "podgroups": None}
+        self._last_rv: Dict[str, Optional[int]] = {k: None for k in kinds}
         # Server boot epoch (from SYNC/RESUME): sent with the rv so a
         # restarted server (fresh counters) re-lists instead of resuming.
-        self._epoch: Dict[str, Optional[str]] = {
-            "pods": None, "nodes": None, "podgroups": None}
-        self.relists: Dict[str, int] = {"pods": 0, "nodes": 0, "podgroups": 0}
-        self.resumes: Dict[str, int] = {"pods": 0, "nodes": 0, "podgroups": 0}
+        self._epoch: Dict[str, Optional[str]] = {k: None for k in kinds}
+        self.relists: Dict[str, int] = {k: 0 for k in kinds}
+        self.resumes: Dict[str, int] = {k: 0 for k in kinds}
         self._threads: List[threading.Thread] = []
-        for kind in ("pods", "nodes", "podgroups"):
+        for kind in kinds:
             t = threading.Thread(target=self._watch_loop, args=(kind,),
                                  name=f"reflector-{kind}", daemon=True)
             t.start()
             self._threads.append(t)
-        for kind in ("pods", "nodes", "podgroups"):
+        for kind in kinds:
             if not self._synced[kind].wait(sync_timeout):
                 self.close()  # stop the reflector threads before raising
                 raise TimeoutError(f"reflector {kind} never synced")
@@ -3231,6 +3419,33 @@ class HTTPClientset:
     def create_composite_pod_group(self, cpg):
         self._call("POST", "/api/v1/podgroups", pod_group_to_wire(cpg))
         return cpg
+
+    # -- workload kinds (WORKLOAD_KINDS: raw wire dicts over the wire) ------
+
+    def create_workload(self, kind: str, w: dict) -> dict:
+        """POST — 409 AlreadyExists on a duplicate name (the caller's
+        create-409-is-success seam handles retries)."""
+        return self._call("POST", f"/api/v1/{kind}", dict(w)) or {}
+
+    def put_workload(self, kind: str, w: dict) -> dict:
+        """Idempotent named upsert: PUT /api/v1/{kind}/{ns}/{name}."""
+        ns = w.get("namespace") or "default"
+        return self._call(
+            "PUT", f"/api/v1/{kind}/{ns}/{w['name']}", dict(w)) or {}
+
+    def delete_workload(self, kind: str, ns: str, name: str) -> None:
+        self._call("DELETE", f"/api/v1/{kind}/{ns or 'default'}/{name}")
+
+    def delete_pod_voluntary(self, uid: str) -> None:
+        """Voluntary pod delete (rolling-update scale-down): the server
+        runs the PDB precondition and answers 429 DisruptionBudget when
+        committing it would breach minAvailable."""
+        self._call("DELETE", f"/api/v1/pods/{uid}?voluntary=true")
+
+    def on_workload_event(self, kind: str, handler) -> None:
+        """Register (action, old, new_wire_dict) fanout for one reflected
+        workload kind (must have been named in extra_kinds)."""
+        self._workload_handlers[kind].append(handler)
 
     def node_heartbeat_ages(self) -> Dict[str, float]:
         """Seconds-since-last-heartbeat per node, leader-routed (the ages
@@ -3672,6 +3887,10 @@ class HTTPClientset:
                 self._dispatch(
                     kind, "DELETED",
                     pod_group_to_wire(self.composite_pod_groups[key]))
+        elif kind in self.workloads:
+            cache = self.workloads[kind]
+            for key in [k for k in cache if k not in seen]:
+                self._dispatch(kind, "DELETED", cache[key])
         else:
             for name in [n for n in self.nodes if n not in seen]:
                 self._dispatch(kind, "DELETED", node_to_wire(self.nodes[name]))
@@ -3758,6 +3977,20 @@ class HTTPClientset:
                 # must not re-register it with the gang queue.
                 for h in self._pod_group_handlers:
                     h(g)
+        elif kind in self.workloads:
+            # Workload kinds cache RAW wire dicts — controllers consume
+            # desired state fields directly; no typed object exists.
+            cache = self.workloads[kind]
+            key = f'{obj.get("namespace") or "default"}/{obj.get("name")}'
+            old = cache.get(key)
+            if action == "add" and old is not None:
+                action = "update"
+            if action == "delete":
+                cache.pop(key, None)
+            else:
+                cache[key] = obj
+            for h in self._workload_handlers.get(kind, ()):
+                h(action, old, obj)
         else:
             node = node_from_wire(obj)
             old = self.nodes.get(node.name)

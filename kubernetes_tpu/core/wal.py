@@ -49,6 +49,12 @@ so the client's retry layer replays it against the recovered server (the
 binding subresource is idempotent for same-node replays). The torn-tail
 semantics are byte-for-byte identical across codecs (tests/test_wire.py
 truncation fuzz).
+
+Corruption in the MIDDLE of the log is a different failure class: the
+binary WAL's version-2 frames carry a per-record CRC32 trailer, and a
+complete record whose CRC mismatches quarantines recovery
+(:class:`WALQuarantineError`) instead of truncating — every record after
+the damage is an acked write that silent truncation would destroy.
 """
 
 from __future__ import annotations
@@ -57,6 +63,22 @@ import os
 from typing import List, Optional, Tuple
 
 from . import wire
+
+
+class WALQuarantineError(RuntimeError):
+    """Recovery refused: a record in the MIDDLE of the WAL failed its
+    CRC32 (wire.CorruptFrameError) — bit rot, a bad disk, or a hostile
+    edit. Unlike a torn tail (one unacked final write, safely truncated),
+    silently truncating here would drop every intact record AFTER the
+    damage: acked writes. The WAL file is left untouched as evidence;
+    the operator repairs or restores from a replica/snapshot."""
+
+    def __init__(self, path: str, offset: int, cause: Exception):
+        super().__init__(
+            f"WAL quarantined: corrupt record in {path} at byte offset "
+            f"{offset} ({cause}); file left intact for inspection")
+        self.path = path
+        self.offset = offset
 
 
 class DurableStore:
@@ -73,8 +95,11 @@ class DurableStore:
         self.fsync = fsync
         self.snapshot_every = snapshot_every
         # WAL record codec for NEW appends (replay always sniffs, so a
-        # data dir written by either codec recovers under either default).
-        self.codec = codec or (wire.BINARY if wire.wire_enabled()
+        # data dir written by any codec recovers under any default). The
+        # binary default carries a per-record CRC32 trailer (version-2
+        # frames, core/wire.py): a corrupt MIDDLE record quarantines
+        # recovery instead of silently truncating acked writes away.
+        self.codec = codec or (wire.BINARY_CRC if wire.wire_enabled()
                                else wire.JSON)
         os.makedirs(data_dir, exist_ok=True)
         self._wal_path = os.path.join(data_dir, self.WAL)
@@ -83,6 +108,7 @@ class DurableStore:
         # observability (surfaced by the apiserver's recovery log line)
         self.replayed_records = 0
         self.torn_records_discarded = 0
+        self.crc_failures = 0  # corrupt middle records (quarantined boot)
         self.compactions = 0
         meta = self._read_json(self.META, {})
         self.epoch: Optional[str] = meta.get("epoch")
@@ -150,7 +176,11 @@ class DurableStore:
     def load(self) -> Tuple[Optional[dict], List[dict]]:
         """Read (snapshot, wal_records) for recovery. Discards a torn final
         WAL record (truncating the file back to the last good frame) and
-        opens the WAL for append."""
+        opens the WAL for append. A record failing its CRC32 mid-log
+        raises :class:`WALQuarantineError` — the file is left byte-for-
+        byte intact (no truncation, no append handle) so the damage can
+        be inspected or repaired; ``crc_failures`` is incremented first
+        so repeated boots report deterministically."""
         snap = self._read_json(self.SNAP, None)
         records: List[dict] = []
         good_offset = 0
@@ -165,7 +195,11 @@ class DurableStore:
             # JSON line from an old (or mixed) WAL. None = the tail from
             # here on is torn — an incomplete length-prefixed frame, an
             # undecodable payload, a missing newline — and untrusted.
-            got = wire.scan(buf, pos)
+            try:
+                got = wire.scan(buf, pos)
+            except wire.CorruptFrameError as e:
+                self.crc_failures += 1
+                raise WALQuarantineError(self._wal_path, pos, e) from e
             if got is None:
                 self.torn_records_discarded += 1
                 break

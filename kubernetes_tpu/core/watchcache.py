@@ -71,9 +71,9 @@ from . import wire
 def wire_key(kind: str, obj: dict) -> str:
     if kind == "pods":
         return obj["uid"]
-    if kind == "podgroups":
-        # Pod groups are namespaced; "ns/name" matches the store/clientset
-        # keying so one key space spans the wire and both local maps.
+    if kind in ("podgroups", "replicasets", "deployments", "pdbs"):
+        # Namespaced kinds; "ns/name" matches the store/clientset keying
+        # so one key space spans the wire and both local maps.
         return f'{obj.get("namespace") or "default"}/{obj["name"]}'
     return obj["name"]
 

@@ -54,6 +54,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 WIRE_MIME = "application/x-tpu-wire"
@@ -61,8 +62,19 @@ JSON_MIME = "application/json"
 
 MAGIC = 0xBF
 VERSION = 1
+# Version-2 frame: identical payload encoding, but the frame carries a
+# trailing 4-byte big-endian CRC32 over the payload — the WAL's
+# at-rest plane. A COMPLETE frame whose CRC mismatches is corruption in
+# the middle of the log (CorruptFrameError), distinct from a torn tail
+# (scan returns None and the recovery truncates). Streams keep VERSION
+# (the transport already detects torn frames by framing alone).
+VERSION_CRC = 2
 
 BINARY = "binary"
+# WAL at-rest codec: version-2 CRC frames. Same payload bytes as BINARY,
+# so WireItem caches the two independently and a binary ship stream
+# never sees a CRC frame.
+BINARY_CRC = "binary+crc"
 JSON = "json"
 
 # Well-known strings, seeded into every frame's intern table (indexes
@@ -115,6 +127,13 @@ _SMALL_INT_MAX = 0xBE  # 0x00..0xBE inline; 0xBF is the frame MAGIC
 
 class WireError(ValueError):
     """Corrupt or truncated binary frame (the torn-record signal)."""
+
+
+class CorruptFrameError(WireError):
+    """A COMPLETE version-2 frame whose payload fails its CRC32 — bit
+    rot (or a hostile edit) in the MIDDLE of a WAL, not a torn tail.
+    Recovery must quarantine, never silently truncate: every record
+    after the corrupt one is intact and would be lost."""
 
 
 # ---------------------------------------------------------------------------
@@ -211,13 +230,17 @@ def _encode_str(buf: bytearray, s: str, interns: Dict[str, int]) -> None:
     buf += raw
 
 
-def encode_binary(obj: Any) -> bytes:
-    """One framed binary record: MAGIC VERSION varint(len) payload."""
+def encode_binary(obj: Any, crc: bool = False) -> bytes:
+    """One framed binary record: MAGIC VERSION varint(len) payload.
+    With ``crc`` the frame is version 2 and a 4-byte big-endian CRC32
+    over the payload trails it (the WAL at-rest format)."""
     payload = bytearray()
     _encode_value(payload, obj, {})
-    frame = bytearray((MAGIC, VERSION))
+    frame = bytearray((MAGIC, VERSION_CRC if crc else VERSION))
     _append_varint(frame, len(payload))
     frame += payload
+    if crc:
+        frame += zlib.crc32(payload).to_bytes(4, "big")
     return bytes(frame)
 
 
@@ -355,17 +378,21 @@ def scan(buf, pos: int) -> Optional[Tuple[Any, int]]:
     """Parse one record (binary frame OR ``{...}\\n`` JSON line) at ``pos``
     in ``buf``. Returns ``(obj, next_pos)``, or None when everything from
     ``pos`` on is torn — incomplete or undecodable — and must be truncated
-    away (the WAL replay contract, identical for both codecs)."""
+    away (the WAL replay contract, identical for both codecs). A COMPLETE
+    version-2 frame failing its CRC raises :class:`CorruptFrameError`
+    instead: that is damage in the middle of the log, not a torn tail."""
     ln = len(buf)
     if pos >= ln:
         return None
     first = buf[pos]
     if first == MAGIC:
+        if pos + 2 > ln:
+            return None
+        if buf[pos + 1] == VERSION_CRC:
+            return _scan_crc(buf, pos, ln)
         try:
-            if pos + 2 > ln:
-                return None
-            # version byte reserved: today only VERSION is ever written,
-            # and an unknown version in a terminated frame is torn data
+            # version byte reserved: an unknown version in a terminated
+            # frame is torn data
             if buf[pos + 1] != VERSION:
                 return None
             n, p = _read_varint(buf, pos + 2)
@@ -387,6 +414,41 @@ def scan(buf, pos: int) -> Optional[Tuple[Any, int]]:
         return None
 
 
+def _scan_crc(buf, pos: int, ln: int) -> Optional[Tuple[Any, int]]:
+    """One version-2 (CRC-trailed) frame at ``pos``. Incomplete bytes —
+    length varint, payload, or the CRC trailer itself running past the
+    buffer — are a torn tail (None, truncate). A complete frame is
+    integrity-checked BEFORE any payload decode; a mismatch (or a decode
+    failure inside a CRC-verified payload, which can only mean writer
+    corruption) raises CorruptFrameError. Header bytes ride outside the
+    CRC: damage there is caught by framing (bad magic/version/varint)
+    and resolves as torn data, the one case this plane cannot tell from
+    a genuine tail."""
+    try:
+        n, p = _read_varint(buf, pos + 2)
+    except WireError:
+        return None  # length varint runs past the buffer: torn tail
+    end = p + n + 4
+    if end > ln:
+        return None  # payload or CRC trailer incomplete: torn tail
+    payload = bytes(buf[p:p + n])
+    want = int.from_bytes(bytes(buf[p + n:end]), "big")
+    got = zlib.crc32(payload)
+    if got != want:
+        raise CorruptFrameError(
+            f"crc mismatch in frame at offset {pos}: "
+            f"stored 0x{want:08x}, computed 0x{got:08x}")
+    try:
+        obj, used = _decode_value(payload, 0, [])
+        if used != n:
+            raise WireError("trailing bytes in frame")
+    except (WireError, IndexError) as e:
+        raise CorruptFrameError(
+            f"undecodable payload in crc-verified frame at offset "
+            f"{pos}: {e}") from e
+    return obj, end
+
+
 def decode(data) -> Any:
     """Sniff-decode one complete record, either codec (bodies, frames)."""
     if data and data[0] == MAGIC:
@@ -400,10 +462,13 @@ def decode(data) -> Any:
 
 
 def encode(obj: Any, codec: str = JSON) -> bytes:
-    """One wire record in the given codec: a binary frame, or the JSON
-    plane's ``{...}\\n`` line."""
+    """One wire record in the given codec: a binary frame (optionally
+    CRC-trailed — the WAL at-rest form), or the JSON plane's
+    ``{...}\\n`` line."""
     if codec == BINARY:
         return encode_binary(obj)
+    if codec == BINARY_CRC:
+        return encode_binary(obj, crc=True)
     return (jdumps(obj) + "\n").encode()
 
 
@@ -457,8 +522,9 @@ def read_event(fp) -> Optional[Tuple[Any, int, str]]:
         head = fp.read(1)
         if not head:
             raise WireError("stream torn in frame header")
-        if head[0] != VERSION:
+        if head[0] not in (VERSION, VERSION_CRC):
             raise WireError(f"unknown wire version {head[0]}")
+        crc_trailer = head[0] == VERSION_CRC
         n = 0
         shift = 0
         nbytes = 2
@@ -479,6 +545,18 @@ def read_event(fp) -> Optional[Tuple[Any, int, str]]:
             if not more:
                 raise WireError("stream torn in frame payload")
             payload += more
+        if crc_trailer:
+            # A v2 frame on a stream (a peer relaying WAL bytes as-is):
+            # verify, then decode — same contract as at rest.
+            trailer = fp.read(4)
+            while len(trailer) < 4:
+                more = fp.read(4 - len(trailer))
+                if not more:
+                    raise WireError("stream torn in frame crc")
+                trailer += more
+            nbytes += 4
+            if zlib.crc32(payload) != int.from_bytes(trailer, "big"):
+                raise CorruptFrameError("crc mismatch in streamed frame")
         try:
             obj, end = _decode_value(payload, 0, [])
         except IndexError:

@@ -208,7 +208,9 @@ class ReplicationTail:
                 raise RuntimeError(
                     f"snapshot stream: HTTP {resp.status}")
             snap: Optional[dict] = None
-            objs: Dict[str, list] = {"pods": [], "nodes": []}
+            objs: Dict[str, list] = {
+                "pods": [], "nodes": [], "podgroups": [],
+                "replicasets": [], "deployments": [], "pdbs": []}
             complete = False
             while True:
                 got = wire.read_event(resp)
@@ -221,7 +223,8 @@ class ReplicationTail:
                         raise RuntimeError(
                             "snapshot source demoted mid-stream")
                     snap = {k: d[k] for k in
-                            ("epoch", "seq", "repl", "leases") if k in d}
+                            ("epoch", "seq", "repl", "leases", "evictions")
+                            if k in d}
                 elif typ == "SNAP_END":
                     complete = True
                     break
@@ -229,8 +232,8 @@ class ReplicationTail:
                     objs[d["kind"]].append(d["object"])
             if snap is None or not complete:
                 raise RuntimeError("snapshot stream torn before SNAP_END")
-            snap["pods"] = objs["pods"]
-            snap["nodes"] = objs["nodes"]
+            for kind, got_objs in objs.items():
+                snap[kind] = got_objs
             return snap
         finally:
             conn.close()

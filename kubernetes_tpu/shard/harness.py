@@ -457,6 +457,45 @@ def start_controller(base: str, cwd: str, env: dict,
     return proc, f"http://{m.group(1)}"
 
 
+def start_workload_manager(base: str, cwd: str, env: dict,
+                           identity: str = "workload-manager-0",
+                           fallbacks=(), lease_ttl: float = 2.0,
+                           tick: float = 0.25, autoscale=None, trace=None,
+                           timeout: float = 120.0):
+    """Spawn one workload controller-manager process (`python -m
+    kubernetes_tpu.controllers --mode workload`) against `base` and block
+    until its ready line. Spawn TWO with distinct identities for the HA
+    pair — they race the shared lease, one ACTIVE, one STANDBY.
+    `autoscale` is an optional dict of ClusterAutoscaler bounds
+    (min/max/wave/pending_age/cooldown); `trace` an optional dict of
+    WorkloadProfile marginals (deployments/gangs/rate/lifetime/seed).
+    Returns (proc, metrics_url)."""
+    from ..testing.faults import spawn_ready
+
+    cmd = [sys.executable, "-m", "kubernetes_tpu.controllers",
+           "--mode", "workload", "--api-url", base,
+           "--identity", identity, "--lease-ttl", str(lease_ttl),
+           "--tick", str(tick)]
+    for url in fallbacks:
+        cmd += ["--fallback", url]
+    if autoscale is not None:
+        cmd += ["--autoscale",
+                "--min-nodes", str(autoscale.get("min", 0)),
+                "--max-nodes", str(autoscale.get("max", 100)),
+                "--scale-wave", str(autoscale.get("wave", 2)),
+                "--pending-age", str(autoscale.get("pending_age", 2.0)),
+                "--scale-cooldown", str(autoscale.get("cooldown", 5.0))]
+    if trace is not None:
+        cmd += ["--trace-deployments", str(trace.get("deployments", 0)),
+                "--trace-gangs", str(trace.get("gangs", 0)),
+                "--trace-rate", str(trace.get("rate", 2.0)),
+                "--trace-lifetime", str(trace.get("lifetime", 0.0)),
+                "--trace-seed", str(trace.get("seed", 0))]
+    proc, m = spawn_ready(cmd, r"metrics on (127\.0\.0\.1:\d+)", cwd=cwd,
+                          env=env, timeout=timeout)
+    return proc, f"http://{m.group(1)}"
+
+
 def stop_controller(proc, tail=None):
     """SIGTERM the controller and collect its final stats line
     (`{"controller_stats": ...}`) from a drained tail, if one was kept."""
@@ -496,6 +535,7 @@ def run_sharded_cluster(
     repl_lease: float = 2.0,
     hollow=None,
     flood=None,
+    workload=None,
 ) -> dict:
     """The sharded SchedulingBasic shape end to end: create `n_nodes`,
     warm the shards with `warm_pods` (XLA compilation + first sessions land
@@ -517,6 +557,14 @@ def run_sharded_cluster(
     result carries ``flood`` stats (posted / shed-at-429 / errors) next
     to the apiserver's flowcontrol counters (docs/RESILIENCE.md
     § overload & fairness), and every shard runs per-tenant fair dequeue.
+
+    With ``workload`` set (``{"managers": 2, "lease_ttl": s, "tick": s,
+    "autoscale": {...}, "trace": {...}}``), that many workload
+    controller-manager processes run for the whole window as an HA pair
+    racing the shared lease — ReplicaSet/Deployment/gang reconcile,
+    optional cluster autoscaler and Borg-style trace feed — and the
+    result carries each process's final stats (docs/RESILIENCE.md
+    § workload controllers).
 
     Returns the one-line-JSON-able result dict: pods/s, per-shard metric
     scrapes, apiserver conflict counters, peak per-process RSS, and a
@@ -543,7 +591,25 @@ def run_sharded_cluster(
         apf_workload=(flood or {}).get("apf_workload", "4,8,4,2,0.5")
         if flood is not None else "")
     base = cluster.base
+    workload_procs: List = []
+    workload_tails: List = []
     try:
+        if workload is not None:
+            # HA workload controller-manager pair (or singleton): both
+            # race the shared PUT-CAS lease; drained tails keep their
+            # SIGTERM stats lines collectable at teardown.
+            from ..testing.faults import drain_pipe
+            for i in range(int(workload.get("managers", 2))):
+                wproc, _wurl = start_workload_manager(
+                    base, _repo_root(), _env(), identity=f"wm-{i}",
+                    fallbacks=cluster.follower_urls,
+                    lease_ttl=float(workload.get("lease_ttl", 2.0)),
+                    tick=float(workload.get("tick", 0.25)),
+                    autoscale=workload.get("autoscale"),
+                    trace=workload.get("trace"), timeout=timeout)
+                workload_procs.append(wproc)
+                workload_tails.append(drain_pipe(wproc))
+
         def post_many(path: str, wires: List[dict], chunk: int = 200) -> None:
             """Bulk creates (JSON-array POST): one HTTP turnaround per
             chunk instead of per object. Chunks stay modest so each bulk
@@ -751,6 +817,10 @@ def run_sharded_cluster(
         pods = fetch_paged(base, "pods", limit=2000)
         bound = {p["uid"]: p["nodeName"] for p in pods if p["nodeName"]}
         hollow_stats = cluster.stop_hollow() if hollow is not None else None
+        workload_stats = None
+        if workload is not None:
+            workload_stats = [stop_controller(p, t) for p, t in
+                              zip(workload_procs, workload_tails)]
         shard_metrics = []
         e2e_hists = []
         watch_decode = []
@@ -887,6 +957,10 @@ def run_sharded_cluster(
             # the bounded-memory claim as a number.
             "rss_mb": cluster.sample_rss(),
             "hollow": hollow_stats,
+            # Workload controller-manager stats (HA pair): per-process
+            # final stats lines — active/standby split, takeovers,
+            # reconcile counters, autoscaler adds/removes.
+            "workload": workload_stats,
             # Where the progress/summary reads landed (follower-served read
             # plane) + one follower /metrics/resources scrape's series count.
             "read_plane": dict(read_counts,
@@ -918,4 +992,11 @@ def run_sharded_cluster(
                 for sm in shard_metrics],
         }
     finally:
+        for wproc in workload_procs:
+            if wproc.poll() is None:
+                wproc.terminate()
+                try:
+                    wproc.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    wproc.kill()
         cluster.stop()

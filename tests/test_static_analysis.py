@@ -1017,8 +1017,9 @@ def test_every_checker_registered_and_described():
     ids = sorted(c.id for c in checkers)
     assert ids == ["eviction-discipline", "hint-freshness", "index-dtype",
                    "jit-purity", "lock-discipline", "metrics-discipline",
-                   "sharding-discipline", "shed-discipline",
-                   "span-discipline", "thread-hygiene", "wire-discipline"]
+                   "reconcile-discipline", "sharding-discipline",
+                   "shed-discipline", "span-discipline", "thread-hygiene",
+                   "wire-discipline"]
     assert all(c.description for c in checkers)
 
 
@@ -1237,6 +1238,123 @@ class TestEvictionDisciplineFixtures:
         a sleep under a held lock in a controller module must flag."""
         ck = checker_by_id("lock-discipline")
         assert ck.applies_to("kubernetes_tpu/controllers/node_lifecycle.py")
+
+
+class TestReconcileDisciplineFixtures:
+    """controllers/ pod create sites must sit on a call-graph slice
+    holding BOTH a deterministic-name source and a create-409-is-success
+    handler (ISSUE 17: HA reconcilers racing a lease — or one reconciler
+    across a kill9 — must collide benignly, never duplicate pods)."""
+
+    def test_flags_random_named_create(self):
+        bad = textwrap.dedent("""
+            import uuid
+            class Reconciler:
+                def heal(self, rs):
+                    for _ in range(rs.missing):
+                        self.cs.create_pod(self.pod(uuid.uuid4().hex))
+        """)
+        fs = check_source(checker_by_id("reconcile-discipline"), bad)
+        assert _rules(fs) == ["create-outside-seam"]
+        assert len(fs) == 1
+
+    def test_flags_deterministic_name_without_409_seam(self):
+        """Deterministic names alone still crash the CAS loser: the
+        second actor's create raises 409 and the reconciler error-loops.
+        Still a finding."""
+        bad = textwrap.dedent("""
+            class Reconciler:
+                def heal(self, rs):
+                    for i in range(rs.replicas):
+                        name = replica_name(rs.name, rs.revision, i)
+                        self.cs.create_pod(self.pod(name))
+        """)
+        fs = check_source(checker_by_id("reconcile-discipline"), bad)
+        assert _rules(fs) == ["create-outside-seam"]
+
+    def test_flags_409_seam_without_deterministic_name(self):
+        """409-tolerance over random names never fires — the duplicates
+        don't collide, they coexist. Still a finding."""
+        bad = textwrap.dedent("""
+            import uuid
+            class Reconciler:
+                def heal(self, rs):
+                    try:
+                        self.cs.create_pod(self.pod(uuid.uuid4().hex))
+                    except HTTPError as e:
+                        if e.code != 409:
+                            raise
+        """)
+        fs = check_source(checker_by_id("reconcile-discipline"), bad)
+        assert _rules(fs) == ["create-outside-seam"]
+
+    def test_passes_full_seam_in_one_def(self):
+        good = textwrap.dedent("""
+            class Reconciler:
+                def heal(self, rs, i):
+                    name = replica_name(rs.name, rs.revision, i)
+                    try:
+                        self.cs.create_pod(self.pod(name))
+                    except HTTPError as e:
+                        if e.code != 409:
+                            raise
+        """)
+        assert check_source(
+            checker_by_id("reconcile-discipline"), good) == []
+
+    def test_passes_mint_seam_shape(self):
+        """The real controllers' shape: the name is derived one frame
+        above the create seam — the caller's slice covers the site."""
+        good = textwrap.dedent("""
+            def _create_pod(cs, pod):
+                try:
+                    cs.create_pod(pod)
+                    return True
+                except HTTPError as e:
+                    if e.code == 409:
+                        return False
+                    raise
+            class Reconciler:
+                def heal(self, rs):
+                    for i in range(rs.replicas):
+                        name = replica_name(rs.name, rs.revision, i)
+                        _create_pod(self.cs, self.pod(name))
+        """)
+        assert check_source(
+            checker_by_id("reconcile-discipline"), good) == []
+
+    def test_scope_is_controllers_only(self):
+        ck = checker_by_id("reconcile-discipline")
+        assert ck.applies_to("kubernetes_tpu/controllers/workload.py")
+        assert ck.applies_to("controllers/autoscaler.py")
+        assert not ck.applies_to("kubernetes_tpu/core/scheduler.py")
+        assert not ck.applies_to("tests/test_node_lifecycle.py")
+
+    def test_real_workload_module_is_clean(self):
+        import inspect
+
+        import kubernetes_tpu.controllers.workload as wk
+        src = inspect.getsource(wk)
+        assert check_source(checker_by_id("reconcile-discipline"), src,
+                            "kubernetes_tpu/controllers/workload.py") == []
+
+
+def test_cli_seeded_racy_create_exits_nonzero(tmp_path):
+    """Acceptance (ISSUE 17): `reconcile-discipline` exits 1 on a seeded
+    racy-create fixture under controllers/."""
+    ctl = tmp_path / "controllers"
+    ctl.mkdir()
+    (ctl / "healer.py").write_text(
+        "import uuid\n"
+        "class Healer:\n"
+        "    def heal(self, rs):\n"
+        "        self.cs.create_pod(self.pod(uuid.uuid4().hex))\n")
+    proc = _run_cli("--root", str(tmp_path), "--checker",
+                    "reconcile-discipline", "--json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    rules = {(f["checker"], f["rule"]) for f in report["findings"]}
+    assert ("reconcile-discipline", "create-outside-seam") in rules
 
 
 def test_cli_seeded_naked_delete_exits_nonzero(tmp_path):

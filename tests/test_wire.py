@@ -248,8 +248,8 @@ class TestTruncationFuzz:
         for r in recs[:3]:
             ds.append(r)
         ds.close()
-        ds2 = DurableStore(d)  # binary default
-        assert ds2.codec == wire.BINARY
+        ds2 = DurableStore(d)  # binary default (CRC frames since PR 17)
+        assert ds2.codec == wire.BINARY_CRC
         _snap, replayed = ds2.load()
         assert replayed == recs[:3]
         for r in recs[3:]:
@@ -462,7 +462,7 @@ class TestReplicationInterop:
         api.shutdown()
         monkeypatch.delenv("TPU_SCHED_WIRE")
         api2 = APIServer(data_dir=d)
-        assert api2.persistence.codec == wire.BINARY
+        assert api2.persistence.codec == wire.BINARY_CRC
         assert api2.epoch == epoch
         assert len(api2.store.pods) == 6
         assert api2.persistence.torn_records_discarded == 0

@@ -1,0 +1,522 @@
+"""Self-healing workload plane (kubernetes_tpu/controllers/workload.py +
+the workload API kinds): ReplicaSet/Deployment reconcile, rolling updates
+under maxSurge/maxUnavailable, gang lifecycle, PDB-guarded voluntary
+disruption, the cluster autoscaler, trace-profile determinism, and HA
+leader election (docs/RESILIENCE.md § workload controllers)."""
+
+import time
+from urllib.error import HTTPError
+
+import pytest
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.controllers import (ClusterAutoscaler,
+                                        WorkloadControllerManager,
+                                        WorkloadProfile, gang_member_name,
+                                        replica_name)
+from kubernetes_tpu.controllers.evictor import RateLimitedEvictor
+from kubernetes_tpu.controllers.workload import (DEPLOY_LABEL, OWNER_LABEL,
+                                                 _create_pod)
+from kubernetes_tpu.core import FakeClientset
+from kubernetes_tpu.core.apiserver import (WORKLOAD_KINDS, APIServer,
+                                           HTTPClientset)
+from kubernetes_tpu.testing.wrappers import make_node
+
+
+def _wait(pred, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    """Durable apiserver + a workload-kind-reflecting clientset."""
+    api = APIServer(data_dir=str(tmp_path / "wal"))
+    port = api.serve(0)
+    cs = HTTPClientset(f"http://127.0.0.1:{port}",
+                       extra_kinds=WORKLOAD_KINDS)
+    try:
+        yield api, cs
+    finally:
+        cs.close()
+        api.shutdown()
+
+
+def _add_node(cs, name="n1", cpu=64):
+    cs.create_node(make_node().name(name)
+                   .capacity({"cpu": cpu, "memory": "256Gi", "pods": 500})
+                   .obj())
+
+
+def _bind_all(cs, node="n1"):
+    for p in list(cs.pods.values()):
+        if not p.node_name and p.deletion_ts is None:
+            try:
+                cs.bind(p, node)
+            except Exception:  # noqa: BLE001 - already bound / deleted
+                pass
+
+
+# ---------------------------------------------------------------------------
+# workload API kinds (replicasets/deployments/pdbs over the real wire)
+# ---------------------------------------------------------------------------
+
+
+class TestWorkloadKinds:
+    def test_create_409_put_delete_and_reflection(self, plane):
+        _api, cs = plane
+        rs = {"name": "web", "replicas": 3, "labels": {"app": "web"}}
+        got = cs.create_workload("replicasets", rs)
+        assert got["uid"] == "replicasets/default/web"
+        with pytest.raises(HTTPError) as ei:
+            cs.create_workload("replicasets", rs)
+        assert ei.value.code == 409
+        cs.put_workload("replicasets", dict(rs, replicas=5))
+        _wait(lambda: (cs.workloads["replicasets"].get("default/web") or {})
+              .get("replicas") == 5, msg="reflector convergence")
+        cs.delete_workload("replicasets", "default", "web")
+        _wait(lambda: "default/web" not in cs.workloads["replicasets"],
+              msg="delete reflected")
+
+    def test_workloads_survive_recovery(self, plane, tmp_path):
+        api, cs = plane
+        cs.put_workload("deployments", {"name": "d1", "replicas": 2})
+        cs.put_workload("pdbs", {"name": "b1", "minAvailable": 1,
+                                 "matchLabels": {"app": "x"}})
+        time.sleep(0.1)
+        cs.close()
+        api.shutdown()
+        api2 = APIServer(data_dir=str(tmp_path / "wal"))
+        try:
+            assert api2.workloads["deployments"]["default/d1"][
+                "replicas"] == 2
+            assert api2.workloads["pdbs"]["default/b1"][
+                "minAvailable"] == 1
+        finally:
+            api2.shutdown()
+
+    def test_workload_event_handler_fires(self, plane):
+        _api, cs = plane
+        seen = []
+        cs.on_workload_event("pdbs",
+                             lambda act, old, w: seen.append((act,
+                                                              w["name"])))
+        cs.create_workload("pdbs", {"name": "b2", "minAvailable": 1,
+                                    "matchLabels": {"app": "y"}})
+        _wait(lambda: ("add", "b2") in seen, msg="workload fanout")
+
+
+# ---------------------------------------------------------------------------
+# PDB precondition (eviction subresource + voluntary delete)
+# ---------------------------------------------------------------------------
+
+
+class TestPDBPrecondition:
+    def _seed(self, cs, n=3, bound=True):
+        for i in range(n):
+            p = Pod(name=f"w{i}", uid=f"w{i}", labels={"app": "web"})
+            cs.create_pod(p)
+            if bound:
+                cs.bind(p, "n1")
+
+    def test_eviction_denied_at_min_available(self, plane):
+        api, cs = plane
+        _add_node(cs)
+        self._seed(cs)
+        cs.create_workload("pdbs", {"name": "web-pdb", "minAvailable": 3,
+                                    "matchLabels": {"app": "web"}})
+        time.sleep(0.1)
+        with pytest.raises(HTTPError) as ei:
+            cs.evict_pod("w0", "n1", "i-1")
+        assert ei.value.code == 429
+        with pytest.raises(HTTPError) as ei:
+            cs.delete_pod_voluntary("w1")
+        assert ei.value.code == 429
+        # involuntary disruption (node death / chaos) is never budgeted
+        cs.delete_pod(cs.pods["w2"])
+        m = api.expose_metrics()
+        assert "apiserver_pod_evictions_budget_denied_total 2" in m
+
+    def test_eviction_allowed_above_floor(self, plane):
+        _api, cs = plane
+        _add_node(cs)
+        self._seed(cs)
+        cs.create_workload("pdbs", {"name": "web-pdb", "minAvailable": 2,
+                                    "matchLabels": {"app": "web"}})
+        time.sleep(0.1)
+        got = cs.evict_pod("w0", "n1", "i-1")
+        assert got.get("evicted") is True
+        # the next one would cross the floor (2 bound remain, -1 < 2)
+        with pytest.raises(HTTPError) as ei:
+            cs.evict_pod("w1", "n1", "i-2")
+        assert ei.value.code == 429
+
+    def test_empty_selector_matches_nothing(self, plane):
+        _api, cs = plane
+        _add_node(cs)
+        self._seed(cs)
+        cs.create_workload("pdbs", {"name": "null-pdb", "minAvailable": 9,
+                                    "matchLabels": {}})
+        time.sleep(0.1)
+        assert cs.evict_pod("w0", "n1", "i-1").get("evicted") is True
+
+    def test_evictor_requeues_budget_blocked(self, plane):
+        """The PR 16 evictor treats 429 as retry-later, not terminal:
+        the pod re-queues into its ORIGINAL zone and the counter rises."""
+        _api, cs = plane
+        _add_node(cs)
+        self._seed(cs)
+        cs.create_workload("pdbs", {"name": "web-pdb", "minAvailable": 3,
+                                    "matchLabels": {"app": "web"}})
+        time.sleep(0.1)
+        ev = RateLimitedEvictor(cs, primary_qps=100.0, burst=10.0)
+        ev.enqueue("z0", "n1", "w0")
+        assert ev.run_once() == 0
+        assert ev.evictions_budget_blocked == 1
+        assert ev.pending_count() == 1  # requeued, not dropped
+        # free the budget: the SAME queued intent now commits
+        cs.delete_workload("pdbs", "default", "web-pdb")
+        time.sleep(0.1)
+        assert ev.run_once() == 1
+        assert ev.evictions_total == 1
+
+
+# ---------------------------------------------------------------------------
+# ReplicaSet / Deployment reconcile (single ACTIVE manager, in-process)
+# ---------------------------------------------------------------------------
+
+
+def _manager(cs, ident="m0", **kw):
+    return WorkloadControllerManager(cs, ident, lease_ttl=1.0, tick=0.03,
+                                     **kw)
+
+
+class TestReplicaSetReconcile:
+    def test_creates_deterministic_replicas_and_self_heals(self, plane):
+        _api, cs = plane
+        _add_node(cs)
+        m = _manager(cs)
+        cs.put_workload("replicasets", {
+            "name": "web", "replicas": 3, "revision": 0,
+            "template": {"labels": {"app": "web"}, "cpuMilli": 100}})
+        m.start()
+        try:
+            want = {replica_name("web", 0, i) for i in range(3)}
+            _wait(lambda: set(cs.pods) >= want, msg="replicas created")
+            # chaos-kill one replica: the SAME name must come back
+            victim = sorted(want)[0]
+            created_before = m.replicasets.pods_created
+            cs.delete_pod(cs.pods[victim])
+            _wait(lambda: m.replicasets.pods_created > created_before,
+                  msg="self-heal create")
+            _wait(lambda: victim in cs.pods, msg="victim recreated")
+            live = [p.name for p in cs.pods.values()
+                    if p.deletion_ts is None]
+            assert sorted(live) == sorted(set(live))  # zero duplicates
+        finally:
+            m.stop()
+
+    def test_scale_down_is_voluntary_and_pdb_guarded(self, plane):
+        _api, cs = plane
+        _add_node(cs)
+        m = _manager(cs)
+        cs.put_workload("replicasets", {
+            "name": "web", "replicas": 3, "revision": 0,
+            "template": {"labels": {"app": "web"}, "cpuMilli": 100}})
+        cs.create_workload("pdbs", {"name": "web-pdb", "minAvailable": 3,
+                                    "matchLabels": {"app": "web"}})
+        m.start()
+        try:
+            _wait(lambda: sum(1 for p in cs.pods.values()
+                              if p.labels.get(OWNER_LABEL) == "web") == 3,
+                  msg="replicas created")
+            _bind_all(cs)
+            cs.put_workload("replicasets", {
+                "name": "web", "replicas": 2, "revision": 0,
+                "template": {"labels": {"app": "web"}, "cpuMilli": 100}})
+            # the PDB floor (3) blocks the scale-down delete: blocked
+            # counter rises, all 3 stay live
+            _wait(lambda: m.replicasets.deletes_blocked > 0,
+                  msg="delete blocked by PDB")
+            assert sum(1 for p in cs.pods.values()
+                       if p.labels.get(OWNER_LABEL) == "web"
+                       and p.deletion_ts is None) == 3
+            # lower the floor: the drain goes through
+            cs.put_workload("pdbs", {"name": "web-pdb", "minAvailable": 1,
+                                     "matchLabels": {"app": "web"}})
+            _wait(lambda: sum(1 for p in cs.pods.values()
+                              if p.labels.get(OWNER_LABEL) == "web"
+                              and p.deletion_ts is None) == 2,
+                  msg="scale-down drained")
+        finally:
+            m.stop()
+
+
+class TestRollingUpdate:
+    def test_rollout_respects_surge_and_floor(self, plane):
+        _api, cs = plane
+        _add_node(cs)
+        m = _manager(cs)
+        dep = {"name": "api", "replicas": 3, "revision": 0,
+               "maxSurge": 1, "maxUnavailable": 1,
+               "template": {"labels": {"app": "api"}, "cpuMilli": 100}}
+        cs.put_workload("deployments", dep)
+        # The HARD availability floor is the server-side PDB precondition
+        # (the controller's own budget pacing reads a reflector cache
+        # that can lag one event behind): a wave never takes the
+        # workload below minAvailable = replicas - maxUnavailable.
+        cs.create_workload("pdbs", {"name": "api-pdb", "minAvailable": 2,
+                                    "matchLabels": {"app": "api"}})
+        m.start()
+        try:
+            _wait(lambda: sum(1 for p in cs.pods.values()
+                              if p.labels.get(DEPLOY_LABEL) == "api") == 3,
+                  msg="initial rollout")
+            _bind_all(cs)
+            _wait(lambda: m.deployments.rollouts_completed >= 1,
+                  msg="revision 0 complete")
+            cs.put_workload("deployments", dict(dep, revision=1))
+            ceiling = dep["replicas"] + dep["maxSurge"]
+            floor = dep["replicas"] - dep["maxUnavailable"]
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                pods = [p for p in cs.pods.values()
+                        if p.labels.get(DEPLOY_LABEL) == "api"
+                        and p.deletion_ts is None]
+                assert len(pods) <= ceiling, \
+                    f"surge ceiling broken: {len(pods)} > {ceiling}"
+                bound = sum(1 for p in pods if p.node_name)
+                assert bound >= floor, \
+                    f"availability floor broken: {bound} < {floor}"
+                _bind_all(cs)
+                if (len(pods) == 3
+                        and all(p.labels[OWNER_LABEL] == "api-1"
+                                for p in pods)
+                        and "default/api-0"
+                        not in cs.workloads["replicasets"]):
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError("rollout never completed")
+            want = {replica_name("api-1", 1, i) for i in range(3)}
+            assert {p.name for p in cs.pods.values()
+                    if p.labels.get(DEPLOY_LABEL) == "api"} == want
+            assert m.deployments.rs_deleted >= 1  # old RS GC'd
+        finally:
+            m.stop()
+
+
+# ---------------------------------------------------------------------------
+# gang controller (PodGroups + members, whole-gang restart)
+# ---------------------------------------------------------------------------
+
+
+class TestGangController:
+    def test_launch_then_whole_restart_on_member_loss(self, plane):
+        _api, cs = plane
+        _add_node(cs)
+        m = _manager(cs)
+        m.gangs.set_gang({"name": "train", "size": 3, "cpuMilli": 50})
+        m.start()
+        try:
+            want0 = {gang_member_name("train", 0, i) for i in range(3)}
+            _wait(lambda: set(cs.pods) >= want0, msg="gang launched")
+            assert "default/train" in cs.pod_groups  # minted over HTTP
+            # only restart once the controller OBSERVED completeness —
+            # otherwise the loss is launch-lag and heals by catch-up
+            _wait(lambda: m.gangs._completed.get("train", -1) >= 0,
+                  msg="observed complete")
+            cs.delete_pod(cs.pods[gang_member_name("train", 0, 1)])
+            want1 = {gang_member_name("train", 1, i) for i in range(3)}
+            _wait(lambda: want1 <= {p.name for p in cs.pods.values()},
+                  msg="whole-gang restart at r1")
+            assert m.gangs.restarts == 1
+            # r0 stragglers drain; exactly one live cohort at quiesce
+            _wait(lambda: not any(
+                p.name in want0 for p in cs.pods.values()
+                if p.deletion_ts is None), msg="r0 drained")
+        finally:
+            m.stop()
+
+    def test_catchup_heals_launch_loss_without_restart(self, plane):
+        _api, cs = plane
+        _add_node(cs)
+        m = _manager(cs)
+        m.gangs.set_gang({"name": "fresh", "size": 2, "cpuMilli": 50})
+        # First reconcile mints r0; a takeover (fresh controller, empty
+        # _completed) with a missing member must catch up, not restart.
+        m.tick_once()
+        _wait(lambda: len([p for p in cs.pods.values()
+                           if p.pod_group == "fresh"]) == 2,
+              msg="gang minted")
+        cs.delete_pod(cs.pods[gang_member_name("fresh", 0, 0)])
+        _wait(lambda: gang_member_name("fresh", 0, 0) not in cs.pods,
+              msg="member gone")
+        m2 = _manager(cs, "m-takeover")
+        m2.gangs.set_gang({"name": "fresh", "size": 2, "cpuMilli": 50})
+        # m2 first has to WIN the lease (m0's grant outlives it by up to
+        # one TTL); its first ACTIVE tick then catches up — m0 absent but
+        # never seen complete means launch-lag, not member death.
+        _wait(lambda: (m2.tick_once(), m2.active)[1], msg="m2 takeover")
+        _wait(lambda: gang_member_name("fresh", 0, 0) in cs.pods,
+              msg="catch-up create")
+        assert m2.gangs.restarts == 0
+        assert m2.gangs.pods_created + m2.gangs.creates_409 >= 1
+
+
+# ---------------------------------------------------------------------------
+# cluster autoscaler (injected clock, FakeClientset — no sleeps)
+# ---------------------------------------------------------------------------
+
+
+class TestClusterAutoscaler:
+    def _pending(self, cs, n):
+        for i in range(n):
+            cs.create_pod(Pod(name=f"q{i}", uid=f"q{i}"))
+
+    def test_scales_up_on_backlog_age_with_cooldown(self):
+        cs = FakeClientset()
+        clock = [0.0]
+        a = ClusterAutoscaler(cs, max_nodes=3, wave=2, pending_age_s=2.0,
+                              cooldown_s=5.0, now=lambda: clock[0])
+        self._pending(cs, 4)
+        a.reconcile_once()
+        assert a.nodes_added == 0  # backlog too young
+        clock[0] = 2.5
+        a.reconcile_once()
+        assert a.nodes_added == 2 and len(cs.nodes) == 2
+        clock[0] = 3.0
+        a.reconcile_once()
+        assert a.nodes_added == 2  # cooldown holds the second wave
+        clock[0] = 8.0
+        a.reconcile_once()
+        assert a.nodes_added == 3 and len(cs.nodes) == 3  # max bound
+
+    def test_scales_down_own_empty_nodes_only(self):
+        cs = FakeClientset()
+        clock = [0.0]
+        a = ClusterAutoscaler(cs, min_nodes=1, wave=2, pending_age_s=1.0,
+                              cooldown_s=0.0, now=lambda: clock[0])
+        cs.create_node(make_node().name("static-0")
+                       .capacity({"cpu": 8, "memory": "32Gi",
+                                  "pods": 110}).obj())
+        self._pending(cs, 2)
+        a.reconcile_once()  # seeds the backlog ages at first sight
+        clock[0] = 2.0
+        a.reconcile_once()
+        assert a.nodes_added == 2
+        # occupy one autoscaled node; drain the backlog
+        cs.bind(cs.pods["q0"], "autoscale-0")
+        cs.delete_pod(cs.pods["q1"])
+        clock[0] = 4.0
+        a.reconcile_once()
+        # occupied autoscale-0 and foreign static-0 survive
+        assert set(cs.nodes) == {"static-0", "autoscale-0"}
+        assert a.nodes_removed == 1
+
+    def test_reaged_backlog_after_takeover_gets_grace(self):
+        """A fresh controller re-ages the backlog from ITS first sight:
+        one full pending_age_s of grace after failover, no scale storm."""
+        cs = FakeClientset()
+        clock = [100.0]
+        self._pending(cs, 1)
+        a = ClusterAutoscaler(cs, pending_age_s=2.0, cooldown_s=0.0,
+                              now=lambda: clock[0])
+        a.reconcile_once()
+        assert a.nodes_added == 0  # aged from first sight, not pod birth
+        clock[0] = 102.5
+        a.reconcile_once()
+        assert a.nodes_added > 0
+
+
+# ---------------------------------------------------------------------------
+# trace-profile marginals
+# ---------------------------------------------------------------------------
+
+
+class TestWorkloadProfile:
+    def test_specs_deterministic_and_sorted(self):
+        a = WorkloadProfile(deployments=6, gangs=3, seed=7).specs()
+        b = WorkloadProfile(deployments=6, gangs=3, seed=7).specs()
+        assert a == b
+        assert [s["arrival"] for s in a] == sorted(s["arrival"] for s in a)
+        assert WorkloadProfile(deployments=6, gangs=3, seed=8).specs() != a
+
+    def test_marginals_respect_declared_support(self):
+        prof = WorkloadProfile(deployments=20, gangs=10, seed=3,
+                               mean_lifetime_s=30.0, min_lifetime_s=5.0)
+        specs = prof.specs()
+        assert sum(1 for s in specs if s["kind"] == "deployment") == 20
+        assert sum(1 for s in specs if s["kind"] == "gang") == 10
+        for s in specs:
+            assert s["lifetime"] >= 5.0
+            assert s["cpuMilli"] in prof.cpu_milli_choices
+            if s["kind"] == "deployment":
+                assert s["replicas"] in prof.replica_choices
+            else:
+                assert s["size"] in prof.gang_sizes
+
+    def test_immortal_default(self):
+        import math
+        for s in WorkloadProfile(deployments=2, gangs=1).specs():
+            assert s["lifetime"] == math.inf
+
+
+# ---------------------------------------------------------------------------
+# HA manager (lease CAS, in-process pair) + profile feed/expiry
+# ---------------------------------------------------------------------------
+
+
+class TestManagerHA:
+    def test_single_active_and_takeover(self, plane):
+        _api, cs = plane
+        m1 = _manager(cs, "m1")
+        m2 = _manager(cs, "m2")
+        m1.start()
+        m2.start()
+        try:
+            _wait(lambda: m1.active or m2.active, msg="one ACTIVE")
+            time.sleep(0.2)
+            assert not (m1.active and m2.active), "split brain"
+            active, standby = (m1, m2) if m1.active else (m2, m1)
+            active.stop()
+            _wait(lambda: standby.active, timeout=10.0, msg="takeover")
+            assert standby.takeovers >= 1
+        finally:
+            m1.stop()
+            m2.stop()
+
+    def test_profile_feed_and_two_phase_expiry(self, plane):
+        _api, cs = plane
+        _add_node(cs)
+        prof = WorkloadProfile(deployments=1, gangs=1, arrival_rate=50.0,
+                               mean_lifetime_s=0.9, min_lifetime_s=0.9,
+                               seed=5, name_prefix="tp")
+        m = _manager(cs, profile=prof)
+        m.start()
+        try:
+            _wait(lambda: m.profile_fed == 2, msg="profile admitted")
+            _wait(lambda: m.profile_expired == 2, timeout=30.0,
+                  msg="two-phase expiry")
+            _wait(lambda: not [p for p in cs.pods.values()
+                               if p.deletion_ts is None],
+                  msg="all workload pods drained")
+            _wait(lambda: not cs.workloads["deployments"],
+                  msg="deployments deleted")
+            # orphaned-RS cascade GC may trail by a tick (reflector-lag
+            # re-PUT right after the deployment delete)
+            _wait(lambda: not cs.workloads["replicasets"],
+                  msg="replicasets cascaded")
+        finally:
+            m.stop()
+
+
+def test_create_seam_treats_409_as_success(plane):
+    _api, cs = plane
+    p = Pod(name="dup", uid="dup")
+    assert _create_pod(cs, p) is True
+    assert _create_pod(cs, p) is False  # 409 collapses to not-created

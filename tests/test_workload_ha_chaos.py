@@ -1,0 +1,434 @@
+"""Workload controller-manager HA chaos (ISSUE PR-17, docs/RESILIENCE.md §
+workload controllers): two manager PROCESSES race the shared
+`workload-controller-manager` lease over a REPLICATED control plane, and we
+``kill -9`` the ACTIVE one (a) mid-rolling-update and (b) mid-eviction-wave.
+The standby must take over inside the lease TTL and converge exactly-once:
+deterministic pod names + create-409-is-success mean the takeover's first
+ACTIVE pass finishes whatever the dead incumbent half-did without
+double-creating or stranding a replica, and the server-side PDB
+precondition keeps the workload's BOUND count at or above minAvailable at
+every single poll of the wave."""
+
+import json
+import threading
+import time
+from urllib import request as urlrequest
+from urllib.error import HTTPError, URLError
+
+import pytest
+
+from kubernetes_tpu.controllers.evictor import intent_for
+from kubernetes_tpu.controllers.workload import replica_name
+from kubernetes_tpu.core.apiserver import node_to_wire
+from kubernetes_tpu.shard.harness import (_env, _repo_root,
+                                          start_workload_manager,
+                                          stop_controller)
+from kubernetes_tpu.testing.faults import ReplicaSet, drain_pipe
+from kubernetes_tpu.testing.wrappers import make_node
+
+APP = "app"
+
+
+def _call(base, method, path, body=None, timeout=30.0):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urlrequest.Request(base + path, data=data, method=method,
+                            headers={"Content-Type": "application/json"})
+    with urlrequest.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw else None
+
+
+def _any(urls, method, path, body=None, timeout=10.0):
+    """Leader-seeking raw call: try every replica, follow whoever answers
+    (followers bounce writes with 421; a freshly-killed process refuses).
+    Raises the last error if nobody serves the verb."""
+    last = None
+    for url in urls:
+        try:
+            return _call(url, method, path, body, timeout=timeout)
+        except HTTPError as e:
+            if e.code in (421, 503):
+                last = e
+                continue
+            raise
+        except URLError as e:
+            last = e
+            continue
+    raise last if last is not None else AssertionError("no replicas")
+
+
+def _get_text(base, path, timeout=10.0):
+    with urlrequest.urlopen(base + path, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _metric(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    raise AssertionError(f"series {name} not exposed")
+
+
+def _wait(pred, timeout=60.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _pods(urls, app):
+    got = _any(urls, "GET", "/api/v1/pods") or []
+    return [p for p in got if (p.get("labels") or {}).get(APP) == app
+            and not p.get("deletionTs")]
+
+
+def _active_manager(managers):
+    """(proc, metrics_url) of the manager whose gauge reads ACTIVE, or
+    None while the lease race is still unsettled."""
+    for proc, url in managers:
+        if proc.poll() is not None:
+            continue
+        try:
+            text = _get_text(url, "/metrics", timeout=5.0)
+        except Exception:  # noqa: BLE001 - scrape raced a death
+            continue
+        if _metric(text, "workload_manager_active") == 1:
+            return proc, url
+    return None
+
+
+class _Binder:
+    """Paced binder thread: binds pending pods of one app label onto a
+    rotating target list, one pod per beat. Swapping `targets` re-aims
+    rescheduling (the doomed→healthy flip in the eviction-wave test);
+    setting it empty pauses binding entirely."""
+
+    def __init__(self, urls, app, targets, beat=0.2):
+        self.urls = urls
+        self.app = app
+        self.targets = list(targets)
+        self.beat = beat
+        self.binds = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        i = 0
+        while not self._stop.is_set():
+            targets = list(self.targets)
+            try:
+                pending = [p for p in _pods(self.urls, self.app)
+                           if not p.get("nodeName")]
+            except Exception:  # noqa: BLE001 - leader churn mid-poll
+                pending = []
+            if pending and targets:
+                p = sorted(pending, key=lambda q: q["name"])[0]
+                node = targets[i % len(targets)]
+                i += 1
+                try:
+                    _any(self.urls, "POST",
+                         f"/api/v1/pods/{p['uid']}/binding",
+                         {"node": node})
+                    self.binds += 1
+                except HTTPError as e:
+                    if e.code not in (404, 409):  # gone / already bound
+                        raise
+            self._stop.wait(self.beat)
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(timeout=5)
+
+
+class _FloorWatch:
+    """Polls the live pod census and records every observation where the
+    BOUND count of the guarded app dips below the PDB's minAvailable —
+    the 'never observed violated at any poll' assertion is `violations ==
+    []` at the end."""
+
+    def __init__(self, urls, app, min_available, legal_names):
+        self.urls = urls
+        self.app = app
+        self.min_available = min_available
+        self.legal_names = set(legal_names)
+        self.violations = []
+        self.aliens = []
+        self.polls = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                live = _pods(self.urls, self.app)
+            except Exception:  # noqa: BLE001 - leader churn mid-poll
+                self._stop.wait(0.05)
+                continue
+            self.polls += 1
+            bound = sum(1 for p in live if p.get("nodeName"))
+            if bound < self.min_available:
+                self.violations.append(bound)
+            for p in live:
+                if p["name"] not in self.legal_names:
+                    self.aliens.append(p["name"])
+            self._stop.wait(0.05)
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(timeout=5)
+
+
+def _mk_nodes(urls, names, cpu=16, pods=110):
+    for n in names:
+        node = (make_node().name(n)
+                .capacity({"cpu": cpu, "memory": "64Gi", "pods": pods})
+                .obj())
+        _any(urls, "POST", "/api/v1/nodes", node_to_wire(node))
+
+
+def _spawn_pair(rs, lease_ttl):
+    repo, env = _repo_root(), _env()
+    managers, tails = [], []
+    for i in range(2):
+        proc, murl = start_workload_manager(
+            rs.follower_urls[0], repo, env, identity=f"wm-{i}",
+            fallbacks=[rs.follower_urls[1], rs.leader_url],
+            lease_ttl=lease_ttl, tick=0.1)
+        managers.append((proc, murl))
+        tails.append(drain_pipe(proc))
+    return managers, tails
+
+
+@pytest.mark.chaos
+def test_active_kill9_mid_rolling_update_exactly_once(tmp_path):
+    """SIGKILL the ACTIVE manager in the middle of a rolling update. The
+    standby CASes the lease inside the TTL and finishes the rollout:
+    every rev-1 name the dead incumbent already minted answers 409
+    (success), every missing one is created exactly once, the old
+    ReplicaSet drains through the PDB-guarded voluntary path, and the
+    final census is EXACTLY the rev-1 want-set — no duplicates, no
+    strays, and the `api` workload's bound count never observed below
+    minAvailable=2 at any poll."""
+    LEASE = 1.2
+    rs = ReplicaSet(str(tmp_path / "replicas"), followers=2,
+                    repl_lease=1.5, snapshot_every=100_000)
+    urls = [rs.leader_url] + list(rs.follower_urls)
+    managers, tails = [], []
+    binder = watch = None
+    try:
+        _mk_nodes(urls, ["n0", "n1"])
+        _any(urls, "POST", "/api/v1/pdbs",
+             {"name": "api-pdb", "namespace": "default",
+              "minAvailable": 2, "matchLabels": {APP: "api"}})
+        dep = {"name": "api", "namespace": "default", "replicas": 4,
+               "revision": 0, "maxSurge": 1, "maxUnavailable": 1,
+               "template": {"labels": {APP: "api"}, "cpuMilli": 100}}
+        _any(urls, "POST", "/api/v1/deployments", dep)
+        managers, tails = _spawn_pair(rs, LEASE)
+
+        want0 = {replica_name("api-0", 0, i) for i in range(4)}
+        want1 = {replica_name("api-1", 1, i) for i in range(4)}
+        binder = _Binder(urls, "api", ["n0", "n1"], beat=0.25)
+
+        def _rev0_settled():
+            live = _pods(urls, "api")
+            return ({p["name"] for p in live} == want0
+                    and all(p.get("nodeName") for p in live))
+        _wait(_rev0_settled, timeout=60, msg="revision-0 rollout")
+        _wait(lambda: _active_manager(managers) is not None,
+              timeout=30, msg="an ACTIVE manager")
+        active_proc, _ = _active_manager(managers)
+
+        # From here to quiesce the PDB floor must hold at EVERY poll, and
+        # no pod outside want0|want1 may ever exist.
+        watch = _FloorWatch(urls, "api", 2, want0 | want1)
+        _any(urls, "PUT", "/api/v1/deployments/default/api",
+             dict(dep, revision=1))
+
+        def _mid_rollout():
+            names = {p["name"] for p in _pods(urls, "api")}
+            return bool(names & want1) and bool(names & want0)
+        _wait(_mid_rollout, timeout=30, msg="rollout under way")
+        active_proc.kill()  # SIGKILL: no lease release, no goodbye
+        t_kill = time.monotonic()
+        survivor = next((p, u) for p, u in managers if p is not active_proc)
+
+        _wait(lambda: _active_manager(managers) == survivor,
+              timeout=LEASE * 8, msg="standby takeover")
+        assert time.monotonic() - t_kill <= LEASE * 6  # inside TTL window
+
+        def _rev1_settled():
+            live = _pods(urls, "api")
+            return ({p["name"] for p in live} == want1
+                    and all(p.get("nodeName") for p in live))
+        _wait(_rev1_settled, timeout=90, msg="takeover finishes rollout")
+        # old ReplicaSet garbage-collected, only api-1 remains
+        _wait(lambda: {w["name"] for w in
+                       (_any(urls, "GET", "/api/v1/replicasets") or [])
+                       if w.get("deployment") == "api"} == {"api-1"},
+              timeout=30, msg="old RS GC")
+        watch.stop()
+        assert watch.polls > 0
+        assert watch.violations == [], watch.violations
+        assert watch.aliens == [], watch.aliens
+        # zero duplicate live pods at quiesce (names are the uids)
+        final = [p["name"] for p in _pods(urls, "api")]
+        assert sorted(final) == sorted(set(final)) and len(final) == 4
+
+        stats = stop_controller(survivor[0],
+                                tails[managers.index(survivor)])
+        assert stats is not None
+        # the survivor really was a STANDBY that took over, and the seam
+        # swallowed whatever the incumbent had already minted
+        assert stats["takeovers"] == 1 and stats["standby_ticks"] > 0
+        rs_stats = stats["replicasets"]
+        assert rs_stats["pods_created"] + rs_stats["creates_409"] >= 1
+    finally:
+        if binder is not None:
+            binder.stop()
+        if watch is not None:
+            watch.stop()
+        for proc, _ in managers:
+            if proc.poll() is None:
+                proc.kill()
+        rs.stop()
+
+
+@pytest.mark.chaos
+def test_active_kill9_mid_eviction_wave_pdb_floor_holds(tmp_path):
+    """A PDB-guarded eviction wave drains a doomed node pair while the
+    ACTIVE manager is SIGKILLed mid-wave. The first eviction burst lands
+    with rebinding paused, so the server's precondition arithmetic is
+    exact: with 8 bound and minAvailable=5, exactly 3 evictions commit
+    and the rest answer 429 DisruptionBudget. Then rebinding aims at the
+    healthy pair, the blocked evictions retry, a chaos delete kills one
+    evicted replica outright — and the surviving manager re-mints it
+    under the SAME deterministic name while the wave finishes. Quiesce:
+    all 8 replicas bound on healthy nodes, zero duplicates, bound count
+    never observed below the floor."""
+    LEASE = 1.2
+    rs = ReplicaSet(str(tmp_path / "replicas"), followers=2,
+                    repl_lease=1.5, snapshot_every=100_000)
+    urls = [rs.leader_url] + list(rs.follower_urls)
+    managers, tails = [], []
+    binder = watch = None
+    doomed, healthy = ["d0", "d1"], ["h0", "h1"]
+    try:
+        _mk_nodes(urls, doomed)  # healthy pair arrives later
+        _any(urls, "POST", "/api/v1/pdbs",
+             {"name": "web-pdb", "namespace": "default",
+              "minAvailable": 5, "matchLabels": {APP: "web"}})
+        _any(urls, "POST", "/api/v1/deployments",
+             {"name": "web", "namespace": "default", "replicas": 8,
+              "revision": 0, "maxSurge": 1, "maxUnavailable": 1,
+              "template": {"labels": {APP: "web"}, "cpuMilli": 100}})
+        managers, tails = _spawn_pair(rs, LEASE)
+
+        want = {replica_name("web-0", 0, i) for i in range(8)}
+        binder = _Binder(urls, "web", doomed, beat=0.1)
+        _wait(lambda: ({p["name"] for p in _pods(urls, "web")} == want
+                       and all(p.get("nodeName") in doomed
+                               for p in _pods(urls, "web"))),
+              timeout=60, msg="initial placement on doomed pair")
+        _mk_nodes(urls, healthy)
+        binder.targets = []  # pause rebinding: burst arithmetic is exact
+        time.sleep(0.3)  # let an in-flight bind beat drain
+
+        watch = _FloorWatch(urls, "web", 5, want)
+        before = _get_text(rs.leader_url, "/metrics")
+        victims = [(p["uid"], p["nodeName"])
+                   for p in sorted(_pods(urls, "web"),
+                                   key=lambda p: p["name"])]
+        assert len(victims) == 8
+        committed, blocked = [], []
+        for uid, node in victims:
+            try:
+                _any(urls, "POST", f"/api/v1/pods/{uid}/eviction",
+                     {"intent": intent_for(uid, node), "node": node})
+                committed.append((uid, node))
+            except HTTPError as e:
+                assert e.code == 429, e.code
+                assert "DisruptionBudget" in e.read().decode()
+                blocked.append((uid, node))
+        # exact precondition arithmetic: 8 bound, floor 5 → 3 commits
+        assert len(committed) == 3 and len(blocked) == 5
+        after = _get_text(rs.leader_url, "/metrics")
+        assert (_metric(after, "apiserver_pod_evictions_total")
+                - _metric(before, "apiserver_pod_evictions_total")) == 3
+        assert (_metric(after,
+                        "apiserver_pod_evictions_budget_denied_total")
+                - _metric(before,
+                          "apiserver_pod_evictions_budget_denied_total")
+                ) == 5
+
+        _wait(lambda: _active_manager(managers) is not None,
+              timeout=30, msg="an ACTIVE manager")
+        active_proc, _ = _active_manager(managers)
+        # chaos: one already-evicted (pending) replica dies outright —
+        # an involuntary delete, invisible to the PDB's BOUND arithmetic
+        dead_uid = committed[0][0]
+        _any(urls, "DELETE", f"/api/v1/pods/{dead_uid}")
+        active_proc.kill()  # SIGKILL the ACTIVE mid-wave
+        t_kill = time.monotonic()
+        survivor = next((p, u) for p, u in managers if p is not active_proc)
+
+        binder.targets = healthy  # rebinding resumes, aimed off the wreck
+        retry_stop = threading.Event()
+
+        def _retry_wave():
+            queue = list(blocked)
+            while queue and not retry_stop.is_set():
+                uid, node = queue.pop(0)
+                try:
+                    _any(urls, "POST", f"/api/v1/pods/{uid}/eviction",
+                         {"intent": intent_for(uid, node), "node": node})
+                except HTTPError as e:
+                    if e.code == 429:
+                        queue.append((uid, node))  # still at the floor
+                    elif e.code not in (404, 409):
+                        raise
+                retry_stop.wait(0.2)
+        retrier = threading.Thread(target=_retry_wave, daemon=True)
+        retrier.start()
+
+        _wait(lambda: _active_manager(managers) == survivor,
+              timeout=LEASE * 8, msg="standby takeover")
+        assert time.monotonic() - t_kill <= LEASE * 6
+
+        def _settled():
+            live = _pods(urls, "web")
+            return ({p["name"] for p in live} == want
+                    and all(p.get("nodeName") in healthy for p in live))
+        _wait(_settled, timeout=90, msg="wave drained, fleet rebound")
+        retry_stop.set()
+        retrier.join(timeout=10)
+        watch.stop()
+        assert watch.polls > 0
+        assert watch.violations == [], watch.violations
+        assert watch.aliens == [], watch.aliens
+        final = [p["name"] for p in _pods(urls, "web")]
+        assert sorted(final) == sorted(set(final)) and len(final) == 8
+        # every victim evicted exactly once: 3 burst + 5 retried commits
+        end = _get_text(rs.leader_url, "/metrics")
+        assert (_metric(end, "apiserver_pod_evictions_total")
+                - _metric(before, "apiserver_pod_evictions_total")) == 8
+
+        stats = stop_controller(survivor[0],
+                                tails[managers.index(survivor)])
+        assert stats is not None
+        assert stats["takeovers"] == 1 and stats["standby_ticks"] > 0
+        # the chaos-killed replica came back through the takeover's seam
+        assert stats["replicasets"]["pods_created"] >= 1
+    finally:
+        if binder is not None:
+            binder.stop()
+        if watch is not None:
+            watch.stop()
+        for proc, _ in managers:
+            if proc.poll() is None:
+                proc.kill()
+        rs.stop()
