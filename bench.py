@@ -157,10 +157,12 @@ def main_sharded(n_shards: int, trace: bool = False,
     # remainder arriving slim; 'read_plane' shows where the progress polls
     # landed (followers when --replicas > 0).
     detail["watch_decode"] = out.get("watch_decode")
-    # Wire-plane summary (core/wire.py): server bytes by codec/surface +
-    # per-shard decoded bytes by codec — the proof of WHICH plane ran and
-    # the decoded-bytes delta vs the JSON baseline (PR-10: 4.87MB full /
-    # 1.71MB slim per shard on this workload).
+    # Wire-plane summary (core/wire.py): server bytes by codec/surface,
+    # server encode-µs by surface + delta mint/apply counters (PR 18 —
+    # attributes any shard-scaling gap to encode CPU), and per-shard
+    # decoded bytes by codec — the proof of WHICH plane ran and the
+    # decoded-bytes delta vs the JSON baseline (PR-10: 4.87MB full /
+    # 1.71MB slim per shard on this workload; PR-13: 2.06MB binary).
     detail["wire"] = out.get("wire")
     detail["read_plane"] = out.get("read_plane")
     if replicas:

@@ -19,6 +19,23 @@ through :mod:`kubernetes_tpu.core.wire` (``wire.encode`` / ``wire.decode``
 deliberate ones are grep-able and reviewed at the seam). Import aliases
 (``import json as _json``, ``from json import dumps``) are resolved;
 core/wire.py itself is the seam and exempt.
+
+Rule (``delta-base-under-cache-lock``, PR 18): the delta plane's two
+thread-discipline invariants, both of which fail SILENTLY at runtime
+(a torn base read mints a patch against a state no receiver holds; a
+session intern table touched from the broadcast path corrupts every
+frame after it on that stream):
+
+- in core/watchcache.py, ``mint_delta`` / ``materialize_delta`` may read
+  ``self._objects`` / ``self._obj_rv`` only lexically inside a
+  ``with self._lock:`` block — the base handed to ``diff_obj`` /
+  ``apply_patch`` must be the snapshot's state at one instant;
+- in every hot module, fanout-path functions (``_broadcast``,
+  ``_fan_event``, ``_repl_append``, ``_ship_fanout``, ``_route_to``,
+  ``note_event``, ``route``) must not construct a
+  ``wire.SessionEncoder`` or call ``.session_bytes(...)`` — per-stream
+  encoder state belongs to the stream's consumer thread, where
+  ``encode_stream_item`` runs, never under the broadcast lock.
 """
 
 from __future__ import annotations
@@ -26,7 +43,8 @@ from __future__ import annotations
 import ast
 from typing import List, Set, Tuple
 
-from .base import Checker, Finding, ModuleSource, attr_chain, register
+from .base import (Checker, Finding, ModuleSource, attr_chain,
+                   build_parents, register)
 
 HOT_MODULES: Tuple[str, ...] = (
     "core/apiserver.py",
@@ -36,6 +54,28 @@ HOT_MODULES: Tuple[str, ...] = (
 )
 SEAM = "core/wire.py"
 VERBS = frozenset({"dumps", "loads", "dump", "load"})
+# Delta minting/materialization: the snapshot reads that must happen
+# under the watch cache's own lock.
+DELTA_FUNCS = frozenset({"mint_delta", "materialize_delta"})
+DELTA_BASES = frozenset({"_objects", "_obj_rv"})
+# Fanout-path functions (run under, or called from under, the broadcast
+# lock): per-stream session encoder state is off limits here.
+FANOUT_FUNCS = frozenset({"_broadcast", "_fan_event", "_repl_append",
+                          "_ship_fanout", "_route_to", "note_event",
+                          "route"})
+
+
+def _under_self_lock(parents, node: ast.AST, fn: ast.AST) -> bool:
+    """True when ``node`` sits lexically inside a ``with self._lock:``
+    block within ``fn`` (ancestor walk stops at the function boundary)."""
+    cur = parents.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                if attr_chain(item.context_expr) == ["self", "_lock"]:
+                    return True
+        cur = parents.get(cur)
+    return False
 
 
 def _json_aliases(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
@@ -95,4 +135,57 @@ class WireDisciplineChecker(Checker):
                 "negotiated plane, wire.jdumps/jloads for deliberate "
                 "JSON debug surfaces) so the binary plane cannot "
                 "silently regress on this path"))
+        out.extend(self._check_delta_discipline(mod))
+        return out
+
+    def _check_delta_discipline(self, mod: ModuleSource) -> List[Finding]:
+        """The ``delta-base-under-cache-lock`` sub-rule (module docstring):
+        snapshot reads in mint/materialize stay under ``self._lock``;
+        session encoder state never appears in fanout-path functions."""
+        out: List[Finding] = []
+        parents = build_parents(mod.tree)
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name in DELTA_FUNCS:
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Attribute):
+                        continue
+                    chain = attr_chain(node)
+                    if (len(chain) == 2 and chain[0] == "self"
+                            and chain[1] in DELTA_BASES
+                            and not _under_self_lock(parents, node, fn)):
+                        out.append(Finding(
+                            self.id, "delta-base-under-cache-lock",
+                            mod.path, node.lineno,
+                            f"self.{chain[1]} read in {fn.name}() outside "
+                            "`with self._lock:` — the delta base must be "
+                            "the snapshot's state at one instant; a torn "
+                            "read mints a patch no receiver's cache "
+                            "matches (silent divergence)"))
+            if fn.name in FANOUT_FUNCS:
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    chain = attr_chain(node.func)
+                    if not chain:
+                        continue
+                    if chain[-1] == "SessionEncoder":
+                        out.append(Finding(
+                            self.id, "delta-base-under-cache-lock",
+                            mod.path, node.lineno,
+                            f"SessionEncoder constructed in {fn.name}() — "
+                            "per-stream intern state belongs to the "
+                            "stream's consumer thread (where "
+                            "encode_stream_item runs), never the "
+                            "broadcast/fanout path"))
+                    elif chain[-1] == "session_bytes":
+                        out.append(Finding(
+                            self.id, "delta-base-under-cache-lock",
+                            mod.path, node.lineno,
+                            f"session_bytes(...) called in {fn.name}() — "
+                            "session frames mutate the per-connection "
+                            "intern table and may only be encoded on the "
+                            "stream's consumer thread, never the "
+                            "broadcast/fanout path"))
         return out

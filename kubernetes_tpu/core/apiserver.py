@@ -497,7 +497,18 @@ class APIServer:
         self.wire_bytes: Dict[tuple, int] = {
             (codec, surface): 0
             for codec in (wire.JSON, wire.BINARY)
-            for surface in ("watch", "ship", "list", "snapshot", "bindings")}
+            for surface in ("watch", "ship", "list", "snapshot", "bindings",
+                            "status")}
+        # Encode-CPU accounting (PR 18): µs spent building wire bytes per
+        # surface, accumulated on the stream/handler threads that pay it.
+        # PRE-SEEDED like wire_bytes (never grows after init); guarded by
+        # its own tiny lock — a float += is a read-modify-write, and
+        # unlike a lost count a lost TIME sample would skew the
+        # encode-µs/event ratios the bench detail line divides out.
+        self.wire_encode_us: Dict[str, float] = {
+            s: 0.0 for s in ("watch", "ship", "list", "snapshot",
+                             "bindings", "status")}
+        self._enc_us_lock = threading.Lock()
         # Per-SERVER negotiation override: True = answer every Accept
         # offer with JSON (a pre-wire server, for interop tests/mixed
         # fleets, without pinning the whole process the way
@@ -637,8 +648,17 @@ class APIServer:
         reflectors reconnecting with their last rv get RESUME, not Replace."""
         import itertools
 
+        from .wal import WALQuarantineError
+
         rings: Dict[str, list] = {"pods": [], "nodes": [], "podgroups": [],
                                   **{k: [] for k in WORKLOAD_KINDS}}
+        # Recovery-time wire state: key -> the object's CURRENT wire dict,
+        # seeded from the snapshot and advanced record by record — the
+        # base a WAL'd DELTA record materializes against. Tracking the
+        # exact wire dicts (not store round-trips) keeps a materialized
+        # object byte-identical to the one the leader broadcast.
+        wire_state: Dict[str, Dict[str, dict]] = {
+            k: {} for k in ("pods", "nodes", "podgroups") + WORKLOAD_KINDS}
         snap, records = self.persistence.load()
         if self.persistence.epoch is not None:
             self.epoch = self.persistence.epoch
@@ -666,24 +686,49 @@ class APIServer:
                     self.evictions[w["uid"]] = w.get("intent", "")
             for w in snap.get("pods", ()):
                 self._apply_recovered("pods", "ADDED", w)
+                wire_state["pods"][wire_key("pods", w)] = w
             for w in snap.get("nodes", ()):
                 self._apply_recovered("nodes", "ADDED", w)
+                wire_state["nodes"][wire_key("nodes", w)] = w
             for w in snap.get("podgroups", ()):
                 self._apply_recovered("podgroups", "ADDED", w)
+                wire_state["podgroups"][wire_key("podgroups", w)] = w
             for k in WORKLOAD_KINDS:
                 for w in snap.get(k, ()):
                     self._apply_recovered(k, "ADDED", w)
+                    wire_state[k][wire_key(k, w)] = w
             for w in snap.get("leases", ()):
                 self._install_lease(w)
         for rec in records:
+            kind = rec.get("kind")
+            if rec.get("type") == "DELTA" and kind in wire_state:
+                # Materialize the WAL'd DELTA against the tracked base —
+                # a missing/mismatched base in a CRC-verified log is the
+                # same failure class as a CRC miss: damage in the middle
+                # of acked history, so quarantine, never guess.
+                base = wire_state[kind].get(rec.get("key"))
+                if base is None:
+                    raise WALQuarantineError(
+                        self.persistence._wal_path, -1,
+                        wire.DeltaBaseMismatch(
+                            f"WAL DELTA for {kind}/{rec.get('key')} "
+                            f"has no recovered base"))
+                full = wire.apply_patch(base, rec.get("patch") or [])
+                delta_rec = rec
+                rec = {"kind": kind, "type": "MODIFIED", "object": full,
+                       "rv": delta_rec.get("rv"),
+                       "seq": delta_rec.get("seq"),
+                       "epoch": delta_rec.get("epoch")}
+            else:
+                delta_rec = None
             seq = rec.get("seq")
             if seq is not None and seq > self._repl_seq:
                 self._repl_seq = seq
                 # Rebuild the replication ship window too, so followers that
                 # resume against a restarted leader ride frames, not a
-                # snapshot bootstrap.
-                self._repl_backlog.append((seq, wire.WireItem(rec)))
-            kind = rec.get("kind")
+                # snapshot bootstrap (session streams re-ship the delta).
+                self._repl_backlog.append(
+                    (seq, wire.WireItem(rec, delta=delta_rec)))
             if kind == "leases":
                 # Lease holders survive the restart but their clocks do not
                 # (renew stamps are this process's monotonic clock): restore
@@ -701,6 +746,8 @@ class APIServer:
             if kind not in ("pods", "nodes", "podgroups") + WORKLOAD_KINDS:
                 continue
             self._apply_recovered(kind, rec.get("type", ""), rec.get("object"))
+            self._track_wire_state(wire_state[kind], kind,
+                                   rec.get("type", ""), rec.get("object"))
             rv = rec.get("rv")
             if rv is not None and rv > self._seq[kind]:
                 self._seq[kind] = rv
@@ -709,7 +756,11 @@ class APIServer:
             if rv is not None:
                 event = {k: v for k, v in rec.items()
                          if k not in ("kind", "seq", "epoch")}
-                rings[kind].append((rv, event, wire.WireItem(event)))
+                delta_ev = (None if delta_rec is None else
+                            {k: v for k, v in delta_rec.items()
+                             if k not in ("kind", "seq", "epoch")})
+                rings[kind].append(
+                    (rv, event, wire.WireItem(event, delta=delta_ev)))
         # Object resource_versions were not persisted; fast-forward the
         # store's counter past everything ever minted so recovered and new
         # objects never share a version.
@@ -751,6 +802,30 @@ class APIServer:
         for pod in self.store.pods.values():
             if pod.node_name:
                 self._usage_apply(pod.node_name, pod, +1)
+
+    @staticmethod
+    def _track_wire_state(state: Dict[str, dict], kind: str, typ: str,
+                          obj: Optional[dict]) -> None:
+        """Advance the recovery-time wire-dict map by one WAL record — the
+        exact base the NEXT DELTA record in the log materializes against
+        (mirrors WatchCache._apply_object, including BOUND's
+        copy-on-write nodeName patch)."""
+        if type(obj) is not dict:
+            return
+        if typ == "BOUND":
+            cur = state.get(obj.get("uid", ""))
+            if cur is not None:
+                state[obj["uid"]] = dict(cur,
+                                         nodeName=obj.get("nodeName", ""))
+            return
+        try:
+            key = wire_key(kind, obj)
+        except KeyError:
+            return
+        if typ == "DELETED":
+            state.pop(key, None)
+        else:
+            state[key] = obj
 
     def _apply_recovered(self, kind: str, typ: str, wire: Optional[dict]) -> None:
         """Apply one recovered object directly to the store dicts — no
@@ -838,12 +913,19 @@ class APIServer:
             # non-evented live fanout.
             self.watch_cache["pods"].note_event(None, "STATUS", wire)
 
-    def _repl_append(self, rec: dict, stamped: bool = False) -> int:
+    def _repl_append(self, rec: dict, stamped: bool = False,
+                     delta: Optional[dict] = None) -> int:
         """Commit one WAL frame — the ONE persist→backlog→ship sequence
         both write paths share: the leader stamps a fresh seq + fencing
         epoch; a follower replaying a SHIPPED frame (`stamped=True`,
         apply_frame) keeps the leader's stamps and adopts its seq. Caller
-        holds the broadcast lock (`_lock`) — seq order IS commit order."""
+        holds the broadcast lock (`_lock`) — seq order IS commit order.
+
+        ``delta`` is the record's DELTA twin (minted in the watch cache
+        before the event installed): the WAL stores IT (recovery
+        materializes against the recovered base) and session ship
+        streams forward it; plain binary and JSON followers still get
+        the full record off the same WireItem."""
         if stamped:
             seq = int(rec["seq"])
             self._repl_seq = seq
@@ -851,10 +933,12 @@ class APIServer:
             self._repl_seq += 1
             seq = self._repl_seq
             rec = dict(rec, seq=seq, epoch=self.repl_epoch)
+            if delta is not None:
+                delta = dict(delta, seq=seq, epoch=rec["epoch"])
         # ONE WireItem per frame: the WAL append and every attached ship
         # stream share its per-codec encodings (a binary WAL + N binary
         # followers = one binary encode, total).
-        item = wire.WireItem(rec)
+        item = wire.WireItem(rec, delta=delta)
         if self.persistence is not None:
             self.persistence.append(item)
         self._repl_backlog.append((seq, item))
@@ -882,6 +966,12 @@ class APIServer:
         """Attribute `n` served/consumed wire bytes to (codec, surface)."""
         key = (codec, surface)
         self.wire_bytes[key] = self.wire_bytes.get(key, 0) + n
+
+    def _count_encode_us(self, surface: str, seconds: float) -> None:
+        """Attribute encode wall time to a wire surface (stream/handler
+        threads; never under the broadcast lock)."""
+        with self._enc_us_lock:
+            self.wire_encode_us[surface] += seconds * 1e6
 
     def _snapshot_state(self) -> dict:
         """Full-state compaction snapshot. The calling thread holds BOTH the
@@ -1126,7 +1216,24 @@ class APIServer:
                     self.repl_epoch = ep
                     if self.persistence is not None:
                         self.persistence.set_repl_epoch(ep)
-                self._repl_append(rec, stamped=True)
+                delta_rec = None
+                if rec.get("type") == "DELTA":
+                    # Shipped field-path patch: materialize the full
+                    # object against OUR watch-cache base BEFORE anything
+                    # installs this frame's state. A base-rv mismatch
+                    # raises DeltaBaseMismatch out of apply_frame — the
+                    # tail catches it and snapshot-resyncs; a patch is
+                    # never applied onto a divergent base.
+                    full = self.watch_cache[rec["kind"]] \
+                        .materialize_delta(rec)
+                    delta_rec = rec
+                    rec = {"kind": rec["kind"], "type": "MODIFIED",
+                           "object": full, "rv": rec.get("rv"),
+                           "seq": seq, "epoch": ep}
+                # The local WAL + our own ship fanout carry the delta
+                # twin (same WireItem routing the leader used), while
+                # the full record serves JSON/plain-binary peers.
+                self._repl_append(rec, stamped=True, delta=delta_rec)
                 self.repl_frames_applied += 1
                 kind = rec.get("kind")
                 if kind == "leases":
@@ -1147,11 +1254,18 @@ class APIServer:
                             self._seq[kind] = rv
                         event = {k: v for k, v in rec.items()
                                  if k not in ("kind", "seq", "epoch")}
+                        delta_ev = None
+                        if delta_rec is not None:
+                            delta_ev = {k: v for k, v in delta_rec.items()
+                                        if k not in ("kind", "seq",
+                                                     "epoch")}
                         # Same fanout as the leader's broadcast: this
                         # follower's watch cache + its own (possibly
                         # filtered) streams stay converged in the shared
                         # rv space — clients RESUME against any replica.
-                        self._fan_event(kind, event, wire.WireItem(event))
+                        self._fan_event(kind, event,
+                                        wire.WireItem(event,
+                                                      delta=delta_ev))
                     else:
                         # rv-less STATUS: snapshot upsert, no ring entry
                         # (parity with its non-evented live fanout).
@@ -1500,6 +1614,21 @@ class APIServer:
         for (codec, surface), v in sorted(self.wire_bytes.items()):
             out.append('apiserver_wire_bytes_total{codec="%s",surface="%s"}'
                        ' %d' % (codec, surface, v))
+        # Encode CPU per surface (µs) and the delta plane's mint/apply
+        # counters — the bench detail line divides micros by events to
+        # attribute shard-scaling gaps to encode cost.
+        out.append("# TYPE apiserver_wire_encode_micros_total counter")
+        with self._enc_us_lock:
+            enc_us = dict(self.wire_encode_us)
+        for surface, us in sorted(enc_us.items()):
+            out.append('apiserver_wire_encode_micros_total{surface="%s"}'
+                       ' %d' % (surface, int(us)))
+        minted = sum(wc.deltas_minted for wc in self.watch_cache.values())
+        applied = sum(wc.deltas_applied for wc in self.watch_cache.values())
+        out.append("# TYPE apiserver_wire_deltas_minted_total counter")
+        out.append("apiserver_wire_deltas_minted_total %d" % minted)
+        out.append("# TYPE apiserver_wire_deltas_applied_total counter")
+        out.append("apiserver_wire_deltas_applied_total %d" % applied)
         # Gauges: current role (1 = leader) and replication lag. On the
         # leader, lag is its head minus the slowest attached ship stream;
         # on a follower, the head the tail last heard minus what it applied.
@@ -1526,13 +1655,22 @@ class APIServer:
             # event class): times the WAL append and the watcher fanout
             # into the binder's trace (stages wal.append / bound.fanout).
             ctx = self._bind_ctx
+            # Mint the event's DELTA twin FIRST — before the WAL append
+            # or the fanout installs the new object, while the watch
+            # cache's snapshot still holds the exact base every attached
+            # receiver (and the WAL's recovered state) already has. The
+            # prior wire object is read under the cache's own lock
+            # (mint_delta; the delta-base-under-cache-lock rule).
+            delta = self.watch_cache[kind].mint_delta(event)
             # WAL append BEFORE fanout: an event a watcher saw is always
             # recoverable. The record is the event itself plus the kind
             # (and the replication seq/epoch stamp), so recovery — and a
             # tailing follower — rebuilds both the store and the watch
             # backlog from one stream.
             _tw = time.perf_counter() if ctx is not None else 0.0
-            self._repl_append({"kind": kind, **event})
+            self._repl_append(
+                {"kind": kind, **event},
+                delta=None if delta is None else {"kind": kind, **delta})
             if ctx is not None:
                 self.tracer.record("wal.append", ctx,
                                    time.perf_counter() - _tw,
@@ -1550,7 +1688,7 @@ class APIServer:
                     self.persistence.write_snapshot(self._snapshot_state())
                 except Exception:  # noqa: BLE001
                     self.compaction_failures += 1
-            item = wire.WireItem(event)
+            item = wire.WireItem(event, delta=delta)
             _tf = time.perf_counter() if ctx is not None else 0.0
             self._fan_event(kind, event, item)
             if ctx is not None:
@@ -1950,8 +2088,11 @@ class APIServer:
                       surface: Optional[str] = None,
                       retry_after: Optional[int] = None) -> None:
                 codec = self._accept() if code < 400 else wire.JSON
+                _t0 = time.perf_counter()
                 data = wire.encode(obj, codec)
                 if surface is not None:
+                    server._count_encode_us(surface,
+                                            time.perf_counter() - _t0)
                     server._count_wire(codec, surface, len(data))
                 self.send_response(code)
                 self.send_header("Content-Type", wire.mime_for(codec))
@@ -2230,14 +2371,17 @@ class APIServer:
                     self.end_headers()
                     buf = bytearray()
                     sent = 0
+                    enc_s = 0.0
                     for obj in objs:
                         if (slim_ok and wire_plain(obj)
                                 and shard_of_wire(obj, flt.count)
                                 != flt.index):
                             obj = slim_object(obj)
                             server.watch_slim_events += 1
+                        _t0 = time.perf_counter()
                         buf += wire.encode({"type": "ADDED", "object": obj},
                                            codec)
+                        enc_s += time.perf_counter() - _t0
                         if len(buf) >= 65536:
                             sent += len(buf)
                             self._write_chunk(bytes(buf))
@@ -2247,7 +2391,10 @@ class APIServer:
                     if next_key:
                         trailer["continue"] = mint_continue(
                             anchor, next_key, server.epoch)
+                    _t0 = time.perf_counter()
                     buf += wire.encode(trailer, codec)
+                    server._count_encode_us(
+                        "list", enc_s + time.perf_counter() - _t0)
                     server._count_wire(codec, "list", sent + len(buf))
                     self._write_chunk(bytes(buf))
                     self.wfile.write(b"0\r\n\r\n")
@@ -2302,13 +2449,17 @@ class APIServer:
                                     limit, last_key=last))
                             server.snapshot_bootstrap_pages += 1
                             buf = bytearray()
+                            enc_s = 0.0
                             for obj in objs:
+                                _t0 = time.perf_counter()
                                 buf += wire.encode(
                                     {"kind": kind, "object": obj}, codec)
+                                enc_s += time.perf_counter() - _t0
                                 if len(buf) >= 65536:
                                     sent += len(buf)
                                     self._write_chunk(bytes(buf))
                                     buf.clear()
+                            server._count_encode_us("snapshot", enc_s)
                             if buf:
                                 sent += len(buf)
                                 self._write_chunk(bytes(buf))
@@ -2324,7 +2475,8 @@ class APIServer:
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     self.close_connection = True
 
-            def _replay_lazy(self, kind: str, st, codec: str) -> None:
+            def _replay_lazy(self, kind: str, st, codec: str,
+                             enc=None) -> None:
                 """The attach-time replay as a lazy cursor into the watch
                 cache's snapshot: bounded pages in sorted-key order
                 (list_page — the cache's own lock, never the broadcast or
@@ -2350,8 +2502,15 @@ class APIServer:
                                 != flt.index):
                             obj = slim_object(obj)
                             server.watch_slim_events += 1
-                        data = wire.encode(
-                            {"type": "ADDED", "object": obj}, codec)
+                        ev = {"type": "ADDED", "object": obj}
+                        _t0 = time.perf_counter()
+                        # Replay frames ride the session table too — the
+                        # whole cluster's names intern once, so the live
+                        # tail that follows ships refs from frame one.
+                        data = (enc.encode(ev) if enc is not None
+                                else wire.encode(ev, codec))
+                        server._count_encode_us(
+                            "watch", time.perf_counter() - _t0)
                         sent += len(data)
                         buf += f"{len(data):x}\r\n".encode() + data + b"\r\n"
                         if len(buf) >= 65536:
@@ -2376,8 +2535,20 @@ class APIServer:
                 # watch (the reference's watch bookmarks serve the same
                 # liveness role).
                 codec = self._accept()
+                enc = None
+                if codec == wire.BINARY and wire.accept_session(
+                        self.headers.get("Accept")):
+                    # Session intern table: per-connection, constructed
+                    # and touched ONLY on this consumer thread (never the
+                    # broadcast lock) — the second half of the analyzer's
+                    # delta-base-under-cache-lock rule. Its MIME also
+                    # signals delta capability: WireItems queued here may
+                    # encode as DELTA records against the client's cache.
+                    enc = wire.SessionEncoder()
                 self.send_response(200)
-                self.send_header("Content-Type", wire.mime_for(codec))
+                self.send_header("Content-Type",
+                                 wire.mime_for(codec,
+                                               session=enc is not None))
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 st = server._attach_watch(kind, since, epoch, flt,
@@ -2390,7 +2561,7 @@ class APIServer:
                         # bounded page at a time — the full cluster never
                         # materializes in the stream queue or under the
                         # broadcast lock), then SYNC at the attach rv.
-                        self._replay_lazy(kind, st, codec)
+                        self._replay_lazy(kind, st, codec, enc)
                         data = wire.encode(
                             {"type": "SYNC", "rv": st.replay_rv,
                              "epoch": st.replay_epoch}, codec)
@@ -2415,8 +2586,12 @@ class APIServer:
                         # THIS stream's codec — never under the broadcast
                         # lock the fanout path holds; WireItems cache the
                         # result so it happens once per codec, not per
-                        # stream.
-                        data = encode_stream_item(data, codec)
+                        # stream (session frames are per-connection and
+                        # never cached).
+                        _t0 = time.perf_counter()
+                        data = encode_stream_item(data, codec, enc)
+                        server._count_encode_us(
+                            "watch", time.perf_counter() - _t0)
                         server._count_wire(codec, "watch", len(data))
                         self.wfile.write(
                             f"{len(data):x}\r\n".encode() + data + b"\r\n")
@@ -2461,27 +2636,45 @@ class APIServer:
                     return self._json(410, {"error": "ResyncRequired",
                                             "seq": server._repl_seq})
                 codec = self._accept()
+                enc = None
+                if codec == wire.BINARY and wire.accept_session(
+                        self.headers.get("Accept")):
+                    # Session ship stream: per-connection intern table on
+                    # THIS handler thread, and the delta-capability
+                    # signal — DELTA twins ship as-is; the follower
+                    # materializes against its own watch-cache base.
+                    enc = wire.SessionEncoder()
                 self.send_response(200)
-                self.send_header("Content-Type", wire.mime_for(codec))
+                self.send_header("Content-Type",
+                                 wire.mime_for(codec,
+                                               session=enc is not None))
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 try:
                     while server._httpd is not None and not st.dead:
                         try:
                             seq, item = st.q.get(timeout=hb)
-                            # Shared frame WireItem: the encode is cached
-                            # per codec, so N binary followers reuse the
-                            # WAL append's bytes.
-                            data = item.bytes(codec)
+                            # Shared frame WireItem: the plain encode is
+                            # cached per codec, so N binary followers
+                            # reuse the WAL append's bytes; session
+                            # followers get the delta twin when one was
+                            # minted.
+                            _t0 = time.perf_counter()
+                            data = (item.session_bytes(enc)
+                                    if enc is not None
+                                    else item.bytes(codec))
+                            server._count_encode_us(
+                                "ship", time.perf_counter() - _t0)
                         except queue.Empty:
                             seq = None
                             # HBs carry this replica's ROLE: a follower
                             # tailing a stream whose server was deposed
                             # must not count these as leader liveness.
-                            data = wire.encode(
-                                {"type": "HB", "seq": server._repl_seq,
-                                 "epoch": server.repl_epoch,
-                                 "role": server.role}, codec)
+                            hb_ev = {"type": "HB", "seq": server._repl_seq,
+                                     "epoch": server.repl_epoch,
+                                     "role": server.role}
+                            data = (enc.encode(hb_ev) if enc is not None
+                                    else wire.encode(hb_ev, codec))
                         server._count_wire(codec, "ship", len(data))
                         self.wfile.write(
                             f"{len(data):x}\r\n".encode() + data + b"\r\n")
@@ -2656,6 +2849,12 @@ class APIServer:
                     if not names:
                         nm = self.path.split("/")[4]
                         names = (nm,) if nm != "status" else ()
+                    # The bulk form is the largest client->server stream
+                    # at hollow scale: attribute its request bytes to the
+                    # "status" surface so the bench proves which codec
+                    # actually carried it.
+                    server._count_wire(self._body_codec, "status",
+                                       self._body_len)
                     server.node_heartbeats += max(1, len(names))
                     server._note_heartbeats(names)
                     return 200, {}
@@ -3160,10 +3359,19 @@ class HTTPClientset:
         # on — scheduler_watch_decoded_*{form,codec} reads these.
         self.wire_decode_events: Dict[tuple, int] = {
             ("full", wire.JSON): 0, ("full", wire.BINARY): 0,
-            ("slim", wire.JSON): 0, ("slim", wire.BINARY): 0}
+            ("slim", wire.JSON): 0, ("slim", wire.BINARY): 0,
+            ("delta", wire.JSON): 0, ("delta", wire.BINARY): 0}
         self.wire_decode_bytes: Dict[tuple, int] = {
             ("full", wire.JSON): 0, ("full", wire.BINARY): 0,
-            ("slim", wire.JSON): 0, ("slim", wire.BINARY): 0}
+            ("slim", wire.JSON): 0, ("slim", wire.BINARY): 0,
+            ("delta", wire.JSON): 0, ("delta", wire.BINARY): 0}
+        # Delta plane (PR 18): per-kind wire-object caches — the base a
+        # DELTA patch applies onto. Each kind's maps are touched ONLY by
+        # that kind's reflector thread (lock-free by construction).
+        # delta_fallbacks counts base-rv mismatches that forced a re-list.
+        self._wire: Dict[str, Dict[str, dict]] = {}
+        self._wire_rv: Dict[str, Dict[str, Optional[int]]] = {}
+        self.delta_fallbacks = 0
         # Read plane: the base plus sibling replicas the reflector may
         # rotate to when the base dies (shared rv/epoch space -> RESUME).
         self._bases: List[str] = [self.base] + [
@@ -3216,6 +3424,9 @@ class HTTPClientset:
         # reconnects ask the server to replay from here instead of
         # re-listing. relists/resumes count how each reconnect was served.
         self._last_rv: Dict[str, Optional[int]] = {k: None for k in kinds}
+        for k in kinds:  # delta bases: one map pair per reflector thread
+            self._wire[k] = {}
+            self._wire_rv[k] = {}
         # Server boot epoch (from SYNC/RESUME): sent with the rv so a
         # restarted server (fresh counters) re-lists instead of resuming.
         self._epoch: Dict[str, Optional[str]] = {k: None for k in kinds}
@@ -3640,6 +3851,7 @@ class HTTPClientset:
         try:
             seen: set = set()
             trailer: dict = {}
+            nwire: Dict[str, dict] = {}
             for what, payload, line in iter_paged(conn, kind, limit,
                                                   shard=shard):
                 if what == "restart":
@@ -3647,6 +3859,7 @@ class HTTPClientset:
                     # the list; objects already dispatched simply upsert
                     # again, but the Replace seen-set must reset.
                     seen = set()
+                    nwire = {}
                     continue
                 if what == "done":
                     trailer = payload
@@ -3658,11 +3871,19 @@ class HTTPClientset:
                 self._note_decode(
                     "slim" if obj.get("slim") else "full",
                     line[1], line[0])
+                if not obj.get("slim"):
+                    nwire[wire_key(kind, obj)] = obj
                 with self._dispatch_lock:
                     seen.add(wire_key(kind, obj))
                     self._dispatch(kind, "ADDED", obj)
             with self._dispatch_lock:
                 self._replace_barrier(kind, seen)
+            # Replace semantics for the delta bases too: the listed set
+            # IS the new base map, every rv unknown (accept-if-unknown —
+            # replay ordering guarantees the held state is the minter's
+            # base or a convergent ahead-state). Reflector thread only.
+            self._wire[kind] = nwire
+            self._wire_rv[kind] = {}
             self.relists[kind] += 1
             anchor = trailer.get("listRv")
             return ((int(anchor) if anchor is not None else None),
@@ -3685,6 +3906,54 @@ class HTTPClientset:
         self.wire_decode_events[key] = self.wire_decode_events.get(key, 0) + 1
         self.wire_decode_bytes[key] = (
             self.wire_decode_bytes.get(key, 0) + nbytes)
+
+    def _track_wire(self, kind: str, typ: str, obj,
+                    rv: Optional[int]) -> None:
+        """Advance this kind's delta-base cache exactly the way the
+        server's watch cache advanced its snapshot (core/watchcache.py
+        `_apply_object` + the `_obj_rv` contract) — bases must be
+        bit-identical whenever the recorded rv matches a DELTA's baseRv.
+        Reflector-thread only (one thread per kind), so no lock. Slim
+        projections and rv-less events POP the base: a stale base
+        surviving into the accept-if-unknown path would be a SILENT
+        divergence, the one failure mode the delta plane must not have."""
+        if type(obj) is not dict:
+            return
+        try:
+            key = wire_key(kind, obj)
+        except KeyError:
+            return
+        w, wrv = self._wire[kind], self._wire_rv[kind]
+        if typ == "DELETED" or obj.get("slim"):
+            w.pop(key, None)
+            wrv.pop(key, None)
+            return
+        if typ == "BOUND":
+            cur = w.get(key)
+            if cur is None:
+                wrv.pop(key, None)
+                return
+            obj = dict(cur, nodeName=obj.get("nodeName", ""))
+        w[key] = obj
+        if rv is not None:
+            wrv[key] = rv
+        else:
+            wrv.pop(key, None)
+
+    def _delta_materialize(self, kind: str, event: dict):
+        """Apply a DELTA event onto the cached base. Accept when the base
+        exists and its recorded rv is unknown (fresh from a paged list —
+        replay ordering makes the held state the minter's base or a
+        convergent ahead-state) or equals the event's baseRv; anything
+        else returns None and the caller falls back to a full re-list
+        (never a silent patch onto a divergent base)."""
+        key = event.get("key")
+        base = self._wire[kind].get(key)
+        have = self._wire_rv[kind].get(key)
+        if base is None or (have is not None
+                            and have != event.get("baseRv")):
+            return None
+        return wire.apply_patch(base, event.get("patch") or [])
 
     def _watch_loop(self, kind: str) -> None:
         """client-go reflector behavior (tools/cache/reflector.go:470): on
@@ -3752,8 +4021,15 @@ class HTTPClientset:
                         and self._epoch[kind] is not None):
                     path += (f"&resourceVersion={self._last_rv[kind]}"
                              f"&epoch={self._epoch[kind]}")
-                conn.request("GET", path, headers=wire.client_headers())
+                # stream_headers offers the session plane on top of the
+                # plain binary offer (and nothing when the process is
+                # JSON-pinned) — the server replying with the session
+                # MIME is also its promise to ship DELTA frames.
+                conn.request("GET", path, headers=wire.stream_headers())
                 resp = conn.getresponse()
+                session = (wire.SessionDecoder()
+                           if wire.session_of_mime(
+                               resp.getheader("Content-Type")) else None)
                 conn_fails = 0
             except Exception as e:  # noqa: BLE001 - connect failure
                 if not self._synced[kind].is_set():
@@ -3779,12 +4055,27 @@ class HTTPClientset:
             resync_seen: Optional[set] = set()  # keys replayed pre-SYNC
             try:
                 while not self._stop.is_set():
-                    got = wire.read_event(resp)
+                    got = wire.read_event(resp, session=session)
                     if got is None:
                         break  # EOF: server went away — re-list + re-watch
                     event, nbytes, codec = got
                     typ = event["type"]
-                    if typ in ("ADDED", "MODIFIED", "DELETED"):
+                    if typ == "DELTA":
+                        obj = self._delta_materialize(kind, event)
+                        if obj is None:
+                            # Base-rv mismatch: the one legal answer is a
+                            # full re-list — clear the watermark and
+                            # reconnect fresh. Never patch a divergent
+                            # base.
+                            self.delta_fallbacks += 1
+                            self._last_rv[kind] = None
+                            got_sync = True  # progress, not a dead stream
+                            break
+                        self._note_decode("delta", codec, nbytes)
+                        event = {"type": "MODIFIED", "object": obj,
+                                 "rv": event.get("rv")}
+                        typ = "MODIFIED"
+                    elif typ in ("ADDED", "MODIFIED", "DELETED"):
                         # Decode-cost accounting (the 1/N the shard filter
                         # buys, times the codec's bytes-per-event): slim
                         # projections vs full object wire, binary vs JSON.
@@ -3845,6 +4136,11 @@ class HTTPClientset:
                         self._synced[kind].set()
                         self.last_sync[kind] = _time.monotonic()
                         continue
+                    # Delta-base upkeep BEFORE dispatch (this thread owns
+                    # the kind's maps; handlers must never see a base the
+                    # server no longer diffs against).
+                    self._track_wire(kind, typ, event.get("object"),
+                                     event.get("rv"))
                     with self._dispatch_lock:
                         if resync_seen is not None:
                             resync_seen.add(wire_key(kind, event["object"]))

@@ -35,7 +35,15 @@ Layout of ``data_dir``:
   ~3x smaller than the JSON lines PR 9 shipped); replay sniffs each
   record's first byte, so an old JSON WAL — or a mixed file where a
   binary-default server appended to a JSON history — replays
-  transparently, record by record.
+  transparently, record by record. A binary WAL may persist a MODIFIED
+  write as its **DELTA twin** (PR 18, docs/WIRE.md §DELTA: a field-path
+  patch against the previous record's object state); recovery
+  materializes each patch against the wire state it has replayed so far
+  and QUARANTINES on a missing/mismatched base — a patch is never
+  applied onto a divergent history. JSON WAL mode always stores full
+  records (the compat plane is delta-free by construction). Session
+  frames (VERSION_SESSION) never appear at rest: their intern table
+  lives on one connection, so scan() treats one as a torn record.
 
 Crash contract: records are framed (binary: magic + version + varint
 length; JSON compat: ``json\\n`` lines) with a flush per record

@@ -146,20 +146,37 @@ def resource_request_lines(namespace: str, pod_name: str, node: str,
     return lines
 
 
-def encode_stream_item(item, codec: str = wire.JSON) -> bytes:
+def encode_stream_item(item, codec: str = wire.JSON,
+                       enc: Optional[wire.SessionEncoder] = None) -> bytes:
     """Resolve one watch-queue item to wire bytes in the STREAM's
     negotiated codec: :class:`~.wire.WireItem` events encode once per
     codec (cached — every stream of that codec reuses the bytes);
     pre-encoded bytes pass through; lazy ("MODIFIED", wire_obj) upgrade
     markers (ShardFilter's selector-transition burst) encode HERE, on the
     stream's consumer thread, so the fanout path never pays an encode per
-    slimmed pod under the broadcast lock."""
+    slimmed pod under the broadcast lock.
+
+    ``enc`` is the stream's :class:`~.wire.SessionEncoder` when it
+    negotiated session frames: a WireItem's DELTA twin then encodes
+    per-stream on the session table (the session offer IS the delta
+    capability) while twin-less items keep returning the shared cached
+    v1 frame — fan-out must never pay a per-stream re-encode for bytes
+    the cache already holds. Lazy markers ride the session table (they
+    are per-stream by construction) and pre-encoded bytes pass through
+    as their self-contained v1 frames. Session state is touched HERE
+    only — the consumer thread — never on the fanout path (the
+    analyzer's delta-base-under-cache-lock rule)."""
     if isinstance(item, wire.WireItem):
+        if enc is not None:
+            return item.session_bytes(enc)
         return item.bytes(codec)
     if isinstance(item, bytes):
         return item
     typ, obj = item
-    return wire.encode({"type": typ, "object": obj}, codec)
+    ev = {"type": typ, "object": obj}
+    if enc is not None:
+        return enc.encode(ev)
+    return wire.encode(ev, codec)
 
 
 def shard_key_from_wire(obj: dict) -> str:
@@ -274,12 +291,20 @@ class WatchCache:
         self._lock = threading.Lock()
         self._ring: "deque" = deque(maxlen=capacity)  # (rv, event, data)
         self._objects: Dict[str, dict] = {}
+        # key -> rv of the last rv-STAMPED event that touched the key:
+        # the base a DELTA record may be minted against. An rv-LESS
+        # touch (STATUS nominations — never fanned to watchers) POPS the
+        # entry: clients didn't see that change, so the next MODIFIED
+        # must ship full or their patched copy would silently diverge.
+        self._obj_rv: Dict[str, int] = {}
         self._bound = 0          # pods with a nodeName (summary read)
         self.selector_refs = 0   # live pods with affinity/spread terms
         self.rv = 0
         self.hits = 0       # list/summary/uids/resource reads served
         self.resumes = 0    # interval replays served from the ring
         self.too_old = 0    # resume rvs that fell off the window (410)
+        self.deltas_minted = 0    # MODIFIEDs that shipped a DELTA twin
+        self.deltas_applied = 0   # DELTA records materialized here
         # Sorted-key index for paged lists: pages iterate the snapshot in
         # sorted-key order so a continuation token names a stable
         # position. Built lazily by the FIRST page served, then maintained
@@ -302,6 +327,18 @@ class WatchCache:
         with self._lock:
             if obj is not None:
                 self._apply_object(typ, obj)
+                try:
+                    key = wire_key(self.kind, obj)
+                except KeyError:
+                    key = None
+                if key is not None:
+                    # Delta-base bookkeeping: only an rv-stamped touch of
+                    # a LIVE snapshot entry leaves a mintable base behind.
+                    if (typ == "DELETED" or rv is None
+                            or key not in self._objects):
+                        self._obj_rv.pop(key, None)
+                    else:
+                        self._obj_rv[key] = rv
             if rv is not None:
                 self.rv = max(self.rv, rv)
                 self._ring.append((rv, event or {"type": typ, "object": obj},
@@ -342,6 +379,64 @@ class WatchCache:
             if refs != had:
                 self.selector_refs += 1 if refs else -1
 
+    # -- delta plane (PR 18, docs/WIRE.md §DELTA) ---------------------------
+
+    def mint_delta(self, event: dict) -> Optional[dict]:
+        """Mint the DELTA twin of a MODIFIED event against the snapshot's
+        CURRENT copy of the object — called on the apiserver's write path
+        BEFORE the event installs (so "current" is the state every
+        attached receiver already holds), with the prior wire object read
+        under this cache's lock (the analyzer's delta-base-under-cache-lock
+        rule pins that read). Returns ``{"type": "DELTA", "rv", "key",
+        "baseRv", "patch"}`` — or None when there is no rv-stamped base
+        (fresh object, post-STATUS, post-reinstall) or the diff isn't
+        worth shipping; the caller then fans the full event as ever."""
+        if event.get("type") != "MODIFIED":
+            return None
+        obj = event.get("object")
+        rv = event.get("rv")
+        if type(obj) is not dict or rv is None:
+            return None
+        try:
+            key = wire_key(self.kind, obj)
+        except KeyError:
+            return None
+        with self._lock:
+            base_rv = self._obj_rv.get(key)
+            base = self._objects.get(key) if base_rv is not None else None
+        if base is None:
+            return None
+        # The diff runs outside the lock on purpose: `base` is frozen by
+        # the copy-on-write contract, and diffing a large node object
+        # under the cache lock would stall every read.
+        patch = wire.diff_obj(base, obj)
+        if patch is None:
+            return None
+        self.deltas_minted += 1
+        return {"type": "DELTA", "rv": rv, "key": key,
+                "baseRv": base_rv, "patch": patch}
+
+    def materialize_delta(self, rec: dict) -> dict:
+        """Rebuild the full object a DELTA record describes from this
+        cache's own base (a follower applying a shipped frame, with the
+        prior wire object read under the cache lock — the same
+        delta-base-under-cache-lock contract as minting). Base-unknown is
+        ACCEPTED when this cache has no rv on file for the key (fresh
+        snapshot install: the installed state is exactly the minter's
+        base by the replication ordering); a base at a DIFFERENT rv
+        raises :class:`~.wire.DeltaBaseMismatch` — the caller resyncs a
+        full copy, never applies onto the wrong base."""
+        key = rec.get("key")
+        with self._lock:
+            base = self._objects.get(key)
+            have = self._obj_rv.get(key)
+        if base is None or (have is not None and have != rec.get("baseRv")):
+            raise wire.DeltaBaseMismatch(
+                f"{self.kind}/{key}: base rv {have!r} != "
+                f"delta base rv {rec.get('baseRv')!r}")
+        self.deltas_applied += 1
+        return wire.apply_patch(base, rec.get("patch") or [])
+
     def _skeys_remove(self, key: str) -> None:
         """Drop one key from the incremental sorted index (caller holds
         this cache's lock and has already popped it from the snapshot)."""
@@ -365,6 +460,10 @@ class WatchCache:
             # page rebuilds it lazily from the installed snapshot.
             self._skeys = None
             self._objects = {}
+            # No per-key rvs survive a reinstall: the next MODIFIED per
+            # key ships full once (mint_delta finds no base), then deltas
+            # resume — cheap, and never wrong.
+            self._obj_rv = {}
             self._bound = 0
             self.selector_refs = 0
             for obj in objects:

@@ -47,6 +47,22 @@ Negotiation (Accept:-style): a client that speaks binary sends
 streams — error bodies stay JSON always (the debug plane). Anything else
 falls back to JSON on both sides. ``TPU_SCHED_WIRE=json`` pins a process
 (client offers and server answers) to JSON — the A/B and interop lever.
+
+PR 18 — the delta wire plane (docs/WIRE.md §DELTA):
+
+- DELTA records: a MODIFIED event whose receiver holds the object's
+  prior wire copy ships as ``{"type": "DELTA", "rv", "key", "baseRv",
+  "patch"}`` — a field-path patch (:func:`diff_obj` / :func:`apply_patch`)
+  against that cached base. Any base/rv mismatch falls back to a full
+  object (re-list client-side, snapshot resync follower-side,
+  :class:`DeltaBaseMismatch`) — never a silent divergence.
+- Session streams: a watch/ship stream may negotiate
+  ``application/x-tpu-wire+session`` — version-3 frames whose intern
+  table PERSISTS across frames for the life of the response body
+  (:class:`SessionEncoder` / :class:`SessionDecoder`), so node names,
+  label keys and zone strings are sent once per connection. Session
+  frames never touch disk: the WAL stays self-contained v2 frames, and
+  ``scan`` treats a v3 frame at rest as torn data.
 """
 
 from __future__ import annotations
@@ -54,10 +70,16 @@ from __future__ import annotations
 import json
 import os
 import struct
+import threading
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 WIRE_MIME = "application/x-tpu-wire"
+# Session-stream offer/answer: same payload grammar, but the stream's
+# intern table persists across frames (version-3 frames). WIRE_MIME is a
+# prefix, so every existing `WIRE_MIME in header` negotiation/learning
+# site sees a session peer as a binary peer — exactly right.
+SESSION_MIME = "application/x-tpu-wire+session"
 JSON_MIME = "application/json"
 
 MAGIC = 0xBF
@@ -69,6 +91,12 @@ VERSION = 1
 # (scan returns None and the recovery truncates). Streams keep VERSION
 # (the transport already detects torn frames by framing alone).
 VERSION_CRC = 2
+# Version-3 frame: identical payload grammar, but the intern table is
+# the STREAM's, not the frame's — defines accumulate across frames for
+# the life of one negotiated response body (SessionEncoder/Decoder).
+# Never written at rest: scan() treats a v3 frame in a WAL as torn data,
+# and read_event() refuses one on a stream that didn't negotiate it.
+VERSION_SESSION = 3
 
 BINARY = "binary"
 # WAL at-rest codec: version-2 CRC frames. Same payload bytes as BINARY,
@@ -136,6 +164,14 @@ class CorruptFrameError(WireError):
     after the corrupt one is intact and would be lost."""
 
 
+class DeltaBaseMismatch(WireError):
+    """A DELTA record named a base (key@baseRv) the receiver does not
+    hold — the full-object fallback signal, NEVER a silent apply onto
+    the wrong base. A client re-lists; a follower snapshot-resyncs; an
+    unhandled site inherits WireError's torn-stream handling (reconnect),
+    which also converges on a full copy."""
+
+
 # ---------------------------------------------------------------------------
 # JSON compat plane — the module-local seam the analyzer rule points at
 # ---------------------------------------------------------------------------
@@ -164,16 +200,36 @@ def _append_varint(buf: bytearray, n: int) -> None:
     buf.append(n)
 
 
-def _encode_value(buf: bytearray, obj: Any, interns: Dict[str, int],
-                  pack_double=struct.Struct(">d").pack) -> None:
-    # bool before int: bool is an int subclass but must round-trip as bool
-    if obj is None:
-        buf.append(_TAG_NONE)
-    elif obj is True:
-        buf.append(_TAG_TRUE)
-    elif obj is False:
-        buf.append(_TAG_FALSE)
-    elif type(obj) is int:
+def _ref_bytes(idx: int) -> bytes:
+    b = bytearray((_TAG_STR_REF,))
+    _append_varint(b, idx)
+    return bytes(b)
+
+
+# Vectorized fast path (PR 18): every WELL_KNOWN string's ref encoding is
+# precomputed ONCE at import — the hot dict-key case is a single dict get
+# + one buffer extend, no varint loop, no second lookup. Intern tables
+# (per frame, or per session stream) hold ready ref BYTES the same way:
+# the define pays the varint once, every later occurrence is an extend.
+_WK_REF: Dict[str, bytes] = {s: _ref_bytes(i) for i, s in
+                             enumerate(WELL_KNOWN)}
+
+
+def _encode_value(buf: bytearray, obj: Any, interns: Dict[str, bytes],
+                  pack_double=struct.Struct(">d").pack,
+                  wk_ref=_WK_REF) -> None:
+    # Dispatch ordered by wire frequency: strings (dict keys dominate
+    # every surface), ints (rv/seq/milli-values), dicts, lists — the
+    # exact `type is` checks also keep bool (an int subclass) falling
+    # through to its own branch below.
+    t = type(obj)
+    if t is str:
+        r = wk_ref.get(obj) or interns.get(obj)
+        if r is not None:
+            buf += r
+        else:
+            _intern_define(buf, obj, interns)
+    elif t is int:
         if 0 <= obj <= _SMALL_INT_MAX:
             buf.append(obj)
         else:
@@ -181,25 +237,35 @@ def _encode_value(buf: bytearray, obj: Any, interns: Dict[str, int],
             # zigzag over arbitrary-precision ints (Python has no 64-bit
             # wrap to lean on): non-negatives go even, negatives odd
             _append_varint(buf, (obj << 1) if obj >= 0 else ((-obj) << 1) - 1)
-    elif type(obj) is str:
-        _encode_str(buf, obj, interns)
-    elif type(obj) is dict:
+    elif t is dict:
         buf.append(_TAG_DICT)
         _append_varint(buf, len(obj))
+        enc = _encode_value
         for k, v in obj.items():
             if type(k) is not str:
                 raise TypeError(f"wire dict keys must be str, got {type(k)}")
-            _encode_str(buf, k, interns)
-            _encode_value(buf, v, interns)
-    elif type(obj) is list or type(obj) is tuple:
+            r = wk_ref.get(k) or interns.get(k)
+            if r is not None:
+                buf += r
+            else:
+                _intern_define(buf, k, interns)
+            enc(buf, v, interns)
+    elif t is list or t is tuple:
         buf.append(_TAG_LIST)
         _append_varint(buf, len(obj))
+        enc = _encode_value
         for item in obj:
-            _encode_value(buf, item, interns)
-    elif type(obj) is float:
+            enc(buf, item, interns)
+    elif obj is None:
+        buf.append(_TAG_NONE)
+    elif obj is True:
+        buf.append(_TAG_TRUE)
+    elif obj is False:
+        buf.append(_TAG_FALSE)
+    elif t is float:
         buf.append(_TAG_FLOAT)
         buf += pack_double(obj)
-    elif type(obj) is bytes:
+    elif t is bytes:
         buf.append(_TAG_BYTES)
         _append_varint(buf, len(obj))
         buf += obj
@@ -215,19 +281,24 @@ def _encode_value(buf: bytearray, obj: Any, interns: Dict[str, int],
         raise TypeError(f"not wire-encodable: {type(obj)}")
 
 
-def _encode_str(buf: bytearray, s: str, interns: Dict[str, int]) -> None:
-    idx = _WK_INDEX.get(s)
-    if idx is None:
-        idx = interns.get(s)
-    if idx is not None:
-        buf.append(_TAG_STR_REF)
-        _append_varint(buf, idx)
-        return
-    interns[s] = _WK_N + len(interns)
+def _intern_define(buf: bytearray, s: str,
+                   interns: Dict[str, bytes]) -> None:
+    """First occurrence of a non-well-known string: define it, and record
+    its READY ref bytes for every later occurrence in this table's scope
+    (one frame, or one session stream)."""
+    interns[s] = _ref_bytes(_WK_N + len(interns))
     raw = s.encode()
     buf.append(_TAG_STR_DEF)
     _append_varint(buf, len(raw))
     buf += raw
+
+
+def _encode_str(buf: bytearray, s: str, interns: Dict[str, bytes]) -> None:
+    r = _WK_REF.get(s) or interns.get(s)
+    if r is not None:
+        buf += r
+        return
+    _intern_define(buf, s, interns)
 
 
 def encode_binary(obj: Any, crc: bool = False) -> bytes:
@@ -236,17 +307,147 @@ def encode_binary(obj: Any, crc: bool = False) -> bytes:
     over the payload trails it (the WAL at-rest format)."""
     payload = bytearray()
     _encode_value(payload, obj, {})
-    frame = bytearray((MAGIC, VERSION_CRC if crc else VERSION))
-    _append_varint(frame, len(payload))
-    frame += payload
+    head = bytearray((MAGIC, VERSION_CRC if crc else VERSION))
+    _append_varint(head, len(payload))
     if crc:
-        frame += zlib.crc32(payload).to_bytes(4, "big")
-    return bytes(frame)
+        # one join per frame — no payload recopy into the header buffer
+        return b"".join((head, payload,
+                         zlib.crc32(payload).to_bytes(4, "big")))
+    return b"".join((head, payload))
 
 
 # ---------------------------------------------------------------------------
-# binary decode
+# delta patches (DELTA records, docs/WIRE.md §DELTA)
 # ---------------------------------------------------------------------------
+
+# A patch is a list of ops over string field paths:
+#   [[path..., ], value]  — set (missing intermediate dicts are created)
+#   [[path...]]           — delete (a missing key/path is a no-op)
+# Paths are lists of str keys; non-dict values (lists included) replace
+# wholesale. Ops are idempotent, so a replay across a list/watch overlap
+# converges instead of corrupting the base.
+
+_DIFF_MAX_OPS = 12
+
+
+def diff_obj(old: Any, new: Any,
+             max_ops: int = _DIFF_MAX_OPS) -> Optional[list]:
+    """Field-path patch turning ``old`` into ``new``, or None when a
+    delta is not worth shipping (no dict base, or more than ``max_ops``
+    leaf changes — at that point the full object is cheaper and
+    self-describing). ``apply_patch(old, diff_obj(old, new)) == new``
+    holds value- and type-exactly (bool vs int never conflated)."""
+    if type(old) is not dict or type(new) is not dict:
+        return None
+    ops: list = []
+    if not _diff_into(ops, [], old, new, max_ops):
+        return None
+    return ops
+
+
+def _diff_into(ops: list, path: list, old: dict, new: dict,
+               max_ops: int) -> bool:
+    for k in old:
+        if k not in new:
+            if type(k) is not str or len(ops) >= max_ops:
+                return False
+            ops.append([path + [k]])
+    for k, nv in new.items():
+        if type(k) is not str:
+            return False
+        ov = old.get(k, _MISSING)
+        if ov is nv:
+            continue
+        if type(ov) is dict and type(nv) is dict:
+            if not _diff_into(ops, path + [k], ov, nv, max_ops):
+                return False
+            continue
+        # type-exact equality: True == 1 (bool ⊂ int) must still diff
+        if type(ov) is type(nv) and ov == nv:
+            continue
+        if len(ops) >= max_ops:
+            return False
+        ops.append([path + [k], nv])
+    return True
+
+
+_MISSING = object()
+
+
+def apply_patch(base: dict, patch: list) -> dict:
+    """Apply a DELTA patch COPY-ON-WRITE: returns a new object tree and
+    never mutates ``base`` — watch caches and clientsets hand the same
+    dict to many readers, so an in-place apply would be a data race.
+    Only the dicts along each op's path are copied."""
+    if type(base) is not dict:
+        raise WireError("delta base is not a dict")
+    out = dict(base)
+    for op in patch:
+        path = op[0]
+        if not path:
+            raise WireError("empty delta path")
+        node = out
+        dead = False
+        for k in path[:-1]:
+            child = node.get(k)
+            if type(child) is not dict:
+                if len(op) == 1:
+                    dead = True  # delete under a vanished path: no-op
+                    break
+                child = {}
+            else:
+                child = dict(child)
+            node[k] = child
+            node = child
+        if dead:
+            continue
+        if len(op) == 1:
+            node.pop(path[-1], None)
+        else:
+            node[path[-1]] = op[1]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# session streams (version-3 frames, per-connection intern state)
+# ---------------------------------------------------------------------------
+
+
+class SessionEncoder:
+    """Per-stream encoder state: ONE intern table for the life of a
+    negotiated watch/ship response body. Lives on the stream's consumer
+    thread (where encode_stream_item runs) and must NEVER be touched
+    under the broadcast lock — the analyzer's delta-base-under-cache-lock
+    rule pins that. Any encode exception poisons the stream (the caller
+    drops the connection); both sides then start over with fresh state,
+    which is the session reset contract."""
+
+    __slots__ = ("interns", "frames")
+
+    def __init__(self):
+        self.interns: Dict[str, bytes] = {}
+        self.frames = 0
+
+    def encode(self, obj: Any) -> bytes:
+        payload = bytearray()
+        _encode_value(payload, obj, self.interns)
+        head = bytearray((MAGIC, VERSION_SESSION))
+        _append_varint(head, len(payload))
+        self.frames += 1
+        return b"".join((head, payload))
+
+
+class SessionDecoder:
+    """Receiver half: the dynamic intern list persists across version-3
+    frames. A ref into state this decoder never saw (a stream spliced
+    across reconnects, a stale decoder reused after promotion) raises
+    WireError — the stream is torn, the client reconnects with fresh
+    state and the server re-defines everything: no silent misreads."""
+
+    __slots__ = ("dyn",)
+
+    def __init__(self):
+        self.dyn: List[str] = []
 
 
 def _read_varint(buf, pos: int) -> Tuple[int, int]:
@@ -494,12 +695,40 @@ def client_headers() -> Dict[str, str]:
     return {}
 
 
-def mime_for(codec: str) -> str:
-    return WIRE_MIME if codec == BINARY else JSON_MIME
+def stream_headers() -> Dict[str, str]:
+    """Accept offer for long-lived streams (watch, replication tail):
+    session frames preferred, plain binary as the fallback. Builds on
+    client_headers so a JSON-pinned process (env var, or a test
+    monkeypatching client_headers) offers neither."""
+    h = client_headers()
+    if h.get("Accept") == WIRE_MIME:
+        return {"Accept": f"{SESSION_MIME}, {WIRE_MIME}"}
+    return h
+
+
+def accept_session(accept_header: Optional[str]) -> bool:
+    """Server side of the session negotiation: True iff the client
+    offered session frames and this server is willing. A True answer
+    also implies the peer applies DELTA records (the session offer is
+    the delta-capability signal — one negotiation, one capability set)."""
+    return bool(accept_header and SESSION_MIME in accept_header
+                and wire_enabled())
+
+
+def mime_for(codec: str, session: bool = False) -> str:
+    if codec != BINARY:
+        return JSON_MIME
+    return SESSION_MIME if session else WIRE_MIME
 
 
 def codec_of_mime(content_type: Optional[str]) -> str:
     return BINARY if (content_type and WIRE_MIME in content_type) else JSON
+
+
+def session_of_mime(content_type: Optional[str]) -> bool:
+    """Client side of the session answer: did the server commit to
+    session frames on this response body?"""
+    return bool(content_type and SESSION_MIME in content_type)
 
 
 # ---------------------------------------------------------------------------
@@ -507,11 +736,14 @@ def codec_of_mime(content_type: Optional[str]) -> str:
 # ---------------------------------------------------------------------------
 
 
-def read_event(fp) -> Optional[Tuple[Any, int, str]]:
+def read_event(fp, session: Optional[SessionDecoder] = None
+               ) -> Optional[Tuple[Any, int, str]]:
     """Read one record off a stream (file-like, e.g. an HTTPResponse):
     ``(obj, wire_bytes, codec)``, or None at EOF. Sniffs PER RECORD, so a
     stream whose peer switches codec mid-flight (a binary follower tailing
-    through a JSON leader's promotion) keeps decoding. Raises
+    through a JSON leader's promotion) keeps decoding. A version-3 frame
+    decodes against ``session`` (the stream's SessionDecoder) and is
+    refused when the stream never negotiated one. Raises
     :class:`WireError` on a frame torn mid-stream — the caller's
     reconnect/re-list handling owns what happens next (exactly what a torn
     JSON line did via json.JSONDecodeError)."""
@@ -522,9 +754,15 @@ def read_event(fp) -> Optional[Tuple[Any, int, str]]:
         head = fp.read(1)
         if not head:
             raise WireError("stream torn in frame header")
-        if head[0] not in (VERSION, VERSION_CRC):
+        if head[0] not in (VERSION, VERSION_CRC, VERSION_SESSION):
             raise WireError(f"unknown wire version {head[0]}")
         crc_trailer = head[0] == VERSION_CRC
+        if head[0] == VERSION_SESSION:
+            if session is None:
+                raise WireError("session frame on a non-session stream")
+            dyn = session.dyn
+        else:
+            dyn = []
         n = 0
         shift = 0
         nbytes = 2
@@ -558,7 +796,7 @@ def read_event(fp) -> Optional[Tuple[Any, int, str]]:
             if zlib.crc32(payload) != int.from_bytes(trailer, "big"):
                 raise CorruptFrameError("crc mismatch in streamed frame")
         try:
-            obj, end = _decode_value(payload, 0, [])
+            obj, end = _decode_value(payload, 0, dyn)
         except IndexError:
             raise WireError("frame truncated") from None
         if end != n:
@@ -573,22 +811,91 @@ def read_event(fp) -> Optional[Tuple[Any, int, str]]:
 # ---------------------------------------------------------------------------
 
 
+# One process-wide lock for first-encode misses: encodes are
+# GIL-serialized anyway, so serializing the misses costs nothing — but
+# it turns N racing encodes of one shared item into one encode + N-1
+# cache hits. Never taken on a hit.
+_first_encode_lock = threading.Lock()
+
+
 class WireItem:
     """One wire record with its encodings cached per codec: the watch
     fanout, the resume ring, and the replication backlog hold WireItems so
     an event is encoded ONCE per codec — not once per attached stream, and
     the WAL append shares the binary bytes with every binary follower.
-    Benignly racy: two stream threads may both encode the first time; the
-    encodes are identical and one wins."""
+    First-encode misses take a module-level lock (double-checked): N
+    consumer threads draining fan-out queues in lock-step used to all
+    miss together and each pay the full encode — pure duplicated work,
+    since the encodes are GIL-serialized anyway. Cache hits never touch
+    the lock.
 
-    __slots__ = ("obj", "_enc")
+    ``delta`` (PR 18) is the record's DELTA twin — the same event as a
+    field-path patch against the receiver's cached base, minted once in
+    the watch cache where the prior wire object was already in hand. It
+    rides only to receivers that negotiated the capability: the WAL
+    (``BINARY_CRC`` — recovery materializes it) and session streams
+    (``session_bytes``). Plain binary and JSON peers always get the full
+    object — an unknown peer can never be handed a patch it cannot
+    apply."""
 
-    def __init__(self, obj: Any, enc: Optional[Dict[str, bytes]] = None):
+    __slots__ = ("obj", "_enc", "delta")
+
+    def __init__(self, obj: Any, enc: Optional[Dict[str, bytes]] = None,
+                 delta: Any = None):
         self.obj = obj
         self._enc = enc if enc is not None else {}
+        self.delta = delta
 
     def bytes(self, codec: str = JSON) -> bytes:
         b = self._enc.get(codec)
         if b is None:
-            b = self._enc[codec] = encode(self.obj, codec)
+            with _first_encode_lock:
+                return self._encode_miss(codec)
         return b
+
+    def _encode_miss(self, codec: str) -> bytes:
+        b = self._enc.get(codec)
+        if b is not None:  # lost the race: the winner already cached it
+            return b
+        if self.delta is None:
+            # v1 and v2 frames carry the IDENTICAL payload — v2 just
+            # swaps the version byte and appends a CRC32 trailer. A WAL
+            # frame is encoded as BINARY_CRC under the commit lock
+            # before any ship stream asks for BINARY, so derive the
+            # sibling by re-framing the cached payload instead of
+            # re-encoding it: a slice (+ a C-speed crc32 in the other
+            # direction) versus a full tree walk. (With a delta twin
+            # the v2 bytes hold the PATCH, not the object: no
+            # derivation.)
+            if codec == BINARY and BINARY_CRC in self._enc:
+                twin = self._enc[BINARY_CRC]
+                b = self._enc[BINARY] = (
+                    bytes((MAGIC, VERSION)) + twin[2:-4])
+                return b
+            if codec == BINARY_CRC and BINARY in self._enc:
+                twin = self._enc[BINARY]
+                p = 2
+                while twin[p] & 0x80:
+                    p += 1
+                payload = twin[p + 1:]
+                b = self._enc[BINARY_CRC] = (
+                    bytes((MAGIC, VERSION_CRC)) + twin[2:]
+                    + zlib.crc32(payload).to_bytes(4, "big"))
+                return b
+        obj = (self.delta if (self.delta is not None
+                              and codec == BINARY_CRC) else self.obj)
+        b = self._enc[codec] = encode(obj, codec)
+        return b
+
+    def session_bytes(self, enc: SessionEncoder) -> bytes:
+        """Per-stream encode (consumer thread only) of the DELTA twin in
+        this stream's session frames; never cached — session bytes are
+        valid on exactly one connection. An item with NO twin returns the
+        CACHED plain v1 frame instead (v1 and v3 frames legally
+        interleave on a session stream): at fan-out, a per-stream
+        session re-encode of a full record costs N× the encode the
+        shared `WireItem` bytes already paid for — exactly the
+        regression the once-per-codec cache exists to prevent."""
+        if self.delta is None:
+            return self.bytes(BINARY)
+        return enc.encode(self.delta)

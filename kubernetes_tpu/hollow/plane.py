@@ -86,6 +86,13 @@ class HollowNodePlane:
         self.reregisters = 0
         self.silenced_beats = 0
         self.errors = 0
+        # Bulk heartbeat POSTs whose body went out on the negotiated
+        # binary codec (PR 18): at 50k nodes this is the largest
+        # client->server stream, and the server's "status" wire surface
+        # (apiserver_wire_bytes_total{surface="status"}) is the other
+        # half of the proof that it left JSON.
+        self.hb_wire_posts = 0
+        self.hb_json_posts = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -146,6 +153,8 @@ class HollowNodePlane:
                 "silenced": len(self._silent),
                 "flapping": len(self._flappers),
                 "silenced_beats": self.silenced_beats,
+                "hb_wire_posts": self.hb_wire_posts,
+                "hb_json_posts": self.hb_json_posts,
                 "errors": self.errors}
 
     # -- failure injection (silence / flap / zone outage) -------------------
@@ -225,9 +234,18 @@ class HollowNodePlane:
                 continue
             try:
                 # One bulk POST to the heartbeat sink for the whole slice:
-                # the write plane sees one request, not len(names).
+                # the write plane sees one request, not len(names). The
+                # body rides the KeepAliveClient's negotiated codec —
+                # binary after register()'s first reply proved the server
+                # speaks it, so the fleet's biggest upstream never pays
+                # JSON framing (the server bills it to the "status" wire
+                # surface).
                 self._client.call("POST", "/api/v1/nodes/status",
                                   {"names": names})
+                if self._client._server_wire:
+                    self.hb_wire_posts += 1
+                else:
+                    self.hb_json_posts += 1
                 self.heartbeats += len(names)
             except Exception:  # noqa: BLE001 - transient; next sweep retries
                 self.errors += 1

@@ -139,6 +139,9 @@ class ReplicationTail:
         self.elections = 0
         self.deferrals = 0
         self.fenced_streams = 0
+        # Shipped DELTA frames whose base rv didn't match our cache —
+        # each one forced a full snapshot resync (the fallback contract).
+        self.delta_resyncs = 0
 
     # -- bootstrap ----------------------------------------------------------
 
@@ -308,7 +311,10 @@ class ReplicationTail:
                 f"&epoch={api.repl_epoch}&hb={self.hb}"
                 f"&leader={quote(self.leader_url, safe='')}")
         try:
-            conn.request("GET", path, headers=wire.client_headers())
+            # stream_headers adds the session offer: the leader replying
+            # with the session MIME ships DELTA twins this follower
+            # materializes against its own watch-cache base.
+            conn.request("GET", path, headers=wire.stream_headers())
             resp = conn.getresponse()
         except Exception:  # noqa: BLE001 - leader unreachable
             conn.close()
@@ -331,13 +337,16 @@ class ReplicationTail:
         self._conn = conn
         self.reconnects += 1
         made_contact = False
+        session = (wire.SessionDecoder()
+                   if wire.session_of_mime(resp.getheader("Content-Type"))
+                   else None)
         try:
             while not self._stop.is_set():
                 # Sniff-decoded per frame (core/wire.py): a binary
                 # follower keeps tailing through a JSON peer's frames —
                 # codec continuity is NOT part of the stream contract,
                 # which is what lets mixed fleets promote across planes.
-                got = wire.read_event(resp)
+                got = wire.read_event(resp, session=session)
                 if got is None:
                     return made_contact  # EOF: leader went away
                 rec, _nbytes, _codec = got
@@ -359,7 +368,18 @@ class ReplicationTail:
                     continue
                 self.last_contact = time.monotonic()
                 made_contact = True
-                if not api.apply_frame(rec, stream_epoch=self.leader_epoch):
+                try:
+                    applied = api.apply_frame(
+                        rec, stream_epoch=self.leader_epoch)
+                except wire.DeltaBaseMismatch:
+                    # A shipped DELTA didn't match our watch-cache base
+                    # (diverged history, promotion gap): the contract is
+                    # full-object resync, never a silent patch. Snapshot
+                    # bootstrap re-tails from the installed cut.
+                    self.delta_resyncs += 1
+                    self._bootstrap_snapshot()
+                    return True
+                if not applied:
                     # Stale-epoch frame (a deposed leader's append): drop
                     # the stream; the election will find the real leader.
                     self.fenced_streams += 1

@@ -861,6 +861,8 @@ def run_sharded_cluster(
         # that proves WHICH plane (binary vs JSON) ran end-to-end.
         wire_by_codec: Dict[str, float] = {}
         wire_by_surface: Dict[str, float] = {}
+        enc_us_by_surface: Dict[str, float] = {}
+        deltas = {"minted": 0.0, "applied": 0.0}
         for url in [base] + list(cluster.follower_urls):
             try:
                 text = api_text if url == base else _fetch_metrics(url)
@@ -872,11 +874,26 @@ def run_sharded_cluster(
                         url, "apiserver_wire_bytes_total", "surface",
                         text=text).items():
                     wire_by_surface[k] = wire_by_surface.get(k, 0.0) + v
+                # Encode CPU per surface (PR 18): µs the server spent
+                # building frames — divided by events it attributes any
+                # shard-scaling gap to encode cost.
+                for k, v in scrape_labeled(
+                        url, "apiserver_wire_encode_micros_total",
+                        "surface", text=text).items():
+                    enc_us_by_surface[k] = enc_us_by_surface.get(k, 0.0) + v
+                m = scrape_metrics(url, text=text)
+                deltas["minted"] += m.get(
+                    "apiserver_wire_deltas_minted_total", 0.0)
+                deltas["applied"] += m.get(
+                    "apiserver_wire_deltas_applied_total", 0.0)
             except Exception:  # noqa: BLE001 - replica down mid-teardown
                 continue
         wire_summary = {
             "server_bytes_by_codec": wire_by_codec,
             "server_bytes_by_surface": wire_by_surface,
+            "server_encode_us_by_surface": {
+                k: round(v, 1) for k, v in enc_us_by_surface.items()},
+            "deltas": {k: int(v) for k, v in deltas.items()},
             "shard_decoded_bytes_by_codec": [
                 wd.get("bytes_by_codec", {}) for wd in watch_decode],
         }

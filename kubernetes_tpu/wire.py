@@ -18,21 +18,30 @@ from .core.wire import (  # noqa: F401 - re-exported seam
     BINARY,
     JSON,
     MAGIC,
+    SESSION_MIME,
     VERSION,
+    VERSION_SESSION,
     WELL_KNOWN,
     WIRE_MIME,
+    DeltaBaseMismatch,
+    SessionDecoder,
+    SessionEncoder,
     WireError,
     WireItem,
     accept_codec,
+    accept_session,
+    apply_patch,
     client_headers,
     decode,
     decode_binary,
+    diff_obj,
     encode,
     encode_binary,
     jdumps,
     jloads,
     read_event,
     scan,
+    stream_headers,
     wire_enabled,
 )
 
@@ -91,6 +100,101 @@ def bench(n: int = 20000) -> dict:
     return out
 
 
+def _delta_corpora():
+    """The three event classes that dominate MODIFIED churn at hollow
+    scale: a node heartbeat touch, a capacity drift (hollow/plane.py
+    `_drift_one` — allocatable.cpu step), and a BOUND commit. Each row is
+    ``(name, base_wire_or_None, event)``; base None means the event has
+    no delta twin (BOUND ships full — small already). The node is the
+    hollow-profile wire shape (labels/taints/scalars — what
+    hollow/profile.py node_wire actually registers at 50k-node scale),
+    not a minimal fixture: the whole point of the delta plane is that
+    frame size tracks the CHANGED fields, not the object, so the corpus
+    must carry a realistically sized object."""
+    from .core.apiserver import pod_to_wire
+    from .testing.wrappers import make_pod
+
+    nw = {
+        "name": "node-0123", "uid": "node-0123",
+        "labels": {
+            "kubernetes.io/hostname": "node-0123",
+            "topology.kubernetes.io/zone": "zone-7",
+            "node.kubernetes.io/instance-type": "tpu-v4-8",
+            "cloud.google.com/gke-nodepool": "tpu-pool-a",
+        },
+        "unschedulable": False,
+        "allocatable": {"cpu": 32000, "memory": 274877906944,
+                        "ephemeral": 107374182400, "pods": 110,
+                        "scalar": {"tpu.google.com/v4": 4}},
+        "taints": [{"key": "google.com/tpu", "value": "present",
+                    "effect": "NoSchedule"}],
+        "declaredFeatures": {},
+    }
+    pod = (make_pod().name("wire-bench-000123")
+           .req({"cpu": "100m", "memory": "128Mi"})
+           .labels({"app": "wire-bench"}).obj())
+    pw = pod_to_wire(pod)
+    hb = dict(nw, heartbeat=1723012345.25)
+    drift = dict(nw, allocatable=dict(nw["allocatable"], cpu=31000))
+    return (
+        ("heartbeat", nw,
+         {"type": "MODIFIED", "object": hb, "rv": 1001}),
+        ("drift", nw,
+         {"type": "MODIFIED", "object": drift, "rv": 1002}),
+        ("bound", None,
+         {"type": "BOUND",
+          "object": {"uid": pw["uid"], "nodeName": "node-0123"},
+          "rv": 1003}),
+    )
+
+
+def encode_ab(n: int = 20000) -> dict:
+    """The PR-18 encode-path A/B: full-binary vs DELTA-on-a-session
+    stream vs C-json, µs/event + bytes/event per corpus. Session numbers
+    are steady-state (the table is primed with one frame first — per
+    connection that cost is paid once). ``mint_us`` is the server-side
+    diff cost, paid once per event and shared by every attached stream
+    and the WAL; ``encode_us`` is the per-stream frame cost the guard
+    test compares against full binary."""
+    out = {"bench": "wire-delta-ab", "events_per_corpus": n, "corpora": {}}
+    for name, base, event in _delta_corpora():
+        row = {}
+        for label, codec in (("json_full", JSON), ("binary_full", BINARY)):
+            data = encode(event, codec)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                encode(event, codec)
+            dt = time.perf_counter() - t0
+            row[label] = {"bytes_per_event": len(data),
+                          "encode_us": round(1e6 * dt / n, 2)}
+        if base is not None:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                diff_obj(base, event["object"])
+            mint = time.perf_counter() - t0
+            wire_ev = {"type": "DELTA", "rv": event["rv"],
+                       "key": "node-0123", "baseRv": event["rv"] - 1,
+                       "patch": diff_obj(base, event["object"])}
+        else:
+            mint = 0.0
+            wire_ev = event  # no delta twin: session full frame
+        enc = SessionEncoder()
+        enc.encode(wire_ev)  # prime: defines go out once per connection
+        data = enc.encode(wire_ev)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            enc.encode(wire_ev)
+        dt = time.perf_counter() - t0
+        row["binary_delta"] = {
+            "bytes_per_event": len(data),
+            "encode_us": round(1e6 * dt / n, 2),
+            "mint_us": round(1e6 * mint / n, 2)}
+        row["delta_vs_full_bytes"] = round(
+            row["binary_full"]["bytes_per_event"] / max(1, len(data)), 1)
+        out["corpora"][name] = row
+    return out
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--bench" in argv:
@@ -98,6 +202,8 @@ def main(argv=None) -> int:
         if "--n" in argv:
             n = int(argv[argv.index("--n") + 1])
         print(json.dumps(bench(n), indent=2))
+        # The delta A/B emits ONE JSON line (CI parses it as a record).
+        print(json.dumps(encode_ab(n), separators=(",", ":")))
         return 0
     print("usage: python -m kubernetes_tpu.wire --bench [--n N]",
           file=sys.stderr)

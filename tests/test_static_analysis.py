@@ -1105,6 +1105,87 @@ class TestWireDiscipline:
 
 
 # ---------------------------------------------------------------------------
+# fixture corpus: delta-base-under-cache-lock (PR 18)
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaBaseUnderCacheLock:
+    def test_unlocked_base_read_in_mint_flagged(self):
+        bad = (
+            "class WatchCache:\n"
+            "    def mint_delta(self, event):\n"
+            "        base = self._objects.get(event['key'])\n"
+            "        with self._lock:\n"
+            "            rv = self._obj_rv.get(event['key'])\n"
+            "        return base, rv\n")
+        fs = check_source(checker_by_id("wire-discipline"),
+                          bad, path="core/watchcache.py")
+        assert {(f.rule, f.line) for f in fs} == {
+            ("delta-base-under-cache-lock", 3)}
+
+    def test_unlocked_rv_read_in_materialize_flagged(self):
+        bad = (
+            "class WatchCache:\n"
+            "    def materialize_delta(self, rec):\n"
+            "        have = self._obj_rv.get(rec['key'])\n"
+            "        return have\n")
+        fs = check_source(checker_by_id("wire-discipline"),
+                          bad, path="core/watchcache.py")
+        assert [f.rule for f in fs] == ["delta-base-under-cache-lock"]
+
+    def test_locked_reads_are_clean(self):
+        good = (
+            "class WatchCache:\n"
+            "    def mint_delta(self, event):\n"
+            "        with self._lock:\n"
+            "            base = self._objects.get(event['key'])\n"
+            "            rv = self._obj_rv.get(event['key'])\n"
+            "        return base, rv\n"
+            "    def materialize_delta(self, rec):\n"
+            "        with self._lock:\n"
+            "            return dict(self._objects.get(rec['key']) or {})\n")
+        assert check_source(checker_by_id("wire-discipline"),
+                            good, path="core/watchcache.py") == []
+
+    def test_session_state_in_fanout_path_flagged(self):
+        bad = (
+            "from . import wire\n"
+            "class S:\n"
+            "    def _broadcast(self, event):\n"
+            "        enc = wire.SessionEncoder()\n"
+            "        self.fan(enc.encode(event))\n"
+            "    def _route_to(self, st, item):\n"
+            "        st.q.put(item.session_bytes(st.enc))\n")
+        fs = check_source(checker_by_id("wire-discipline"),
+                          bad, path="core/apiserver.py")
+        assert {(f.rule, f.line) for f in fs} == {
+            ("delta-base-under-cache-lock", 4),
+            ("delta-base-under-cache-lock", 7)}
+
+    def test_session_state_on_consumer_thread_is_clean(self):
+        good = (
+            "from . import wire\n"
+            "class Handler:\n"
+            "    def _stream(self, kind):\n"
+            "        enc = wire.SessionEncoder()\n"
+            "        while True:\n"
+            "            item = self.q.get()\n"
+            "            self.wfile.write(item.session_bytes(enc))\n")
+        assert check_source(checker_by_id("wire-discipline"),
+                            good, path="core/apiserver.py") == []
+
+    def test_non_delta_functions_out_of_scope(self):
+        # snapshot reads elsewhere in the cache (own-lock discipline is
+        # the module's business) don't trip the delta rule
+        src = (
+            "class WatchCache:\n"
+            "    def read_summary(self):\n"
+            "        return len(self._objects)\n")
+        assert check_source(checker_by_id("wire-discipline"),
+                            src, path="core/watchcache.py") == []
+
+
+# ---------------------------------------------------------------------------
 # CLI contract
 # ---------------------------------------------------------------------------
 

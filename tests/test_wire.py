@@ -472,3 +472,461 @@ class TestReplicationInterop:
         assert len(api3.store.pods) == 7
         assert api3.persistence.torn_records_discarded == 0
         api3.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# delta plane (PR 18): diff/patch fuzz vs the JSON oracle
+# ---------------------------------------------------------------------------
+
+
+def _rand_dict(rng: random.Random):
+    return {"k%d" % i: _rand_obj(rng, 1) for i in range(rng.randrange(1, 7))}
+
+
+def _mutate(rng: random.Random, obj: dict) -> dict:
+    """A handful of field-level edits — set / delete / replace, sometimes
+    inside a nested dict — the churn shape DELTA records exist for."""
+    new = json.loads(json.dumps(obj))   # deep copy via the oracle
+    for _ in range(rng.randrange(1, 4)):
+        target = new
+        while isinstance(target, dict) and target and rng.random() < 0.5:
+            v = target[rng.choice(sorted(target))]
+            if isinstance(v, dict) and v:
+                target = v
+            else:
+                break
+        if not isinstance(target, dict):
+            continue
+        action = rng.randrange(3)
+        if action == 0 or not target:
+            target["m%d" % rng.randrange(5)] = _rand_scalar(rng)
+        elif action == 1:
+            del target[rng.choice(sorted(target))]
+        else:
+            target[rng.choice(sorted(target))] = _rand_obj(rng, 2)
+    return new
+
+
+class TestDeltaDiffPatch:
+    def test_randomized_diff_apply_vs_json_oracle(self):
+        rng = random.Random(0xDE17A)
+        hits = 0
+        for i in range(400):
+            old = _rand_dict(rng)
+            new = _mutate(rng, old)
+            before = json.loads(json.dumps(old))
+            patch = wire.diff_obj(old, new)
+            if patch is None:
+                continue     # too many ops: the full-frame path
+            hits += 1
+            got = wire.apply_patch(old, patch)
+            oracle = json.loads(json.dumps(new))
+            assert got == oracle == new, (i, old, new, patch)
+            # copy-on-write: the base the diff was minted against is
+            # untouched — every attached stream and the WAL share it
+            assert old == before, i
+            # the patch itself survives the binary frame bit-exactly
+            assert wire.decode_binary(wire.encode_binary(patch)) == patch
+        assert hits > 300
+
+    def test_identical_objects_diff_to_empty_patch(self):
+        obj = {"a": 1, "b": {"c": [1, 2]}}
+        patch = wire.diff_obj(obj, json.loads(json.dumps(obj)))
+        assert patch == []
+        assert wire.apply_patch(obj, patch) == obj
+
+    def test_type_exact_not_value_equal(self):
+        # True == 1 in Python; the wire must still ship the change
+        patch = wire.diff_obj({"a": True}, {"a": 1})
+        assert patch == [[["a"], 1]]
+        assert type(wire.apply_patch({"a": True}, patch)["a"]) is int
+
+    def test_wide_rewrites_fall_back_to_full_frames(self):
+        old = {"k%d" % i: i for i in range(40)}
+        new = {"k%d" % i: i + 1 for i in range(40)}
+        assert wire.diff_obj(old, new) is None
+        assert wire.diff_obj(["not"], {"a": 1}) is None
+
+    def test_apply_patch_tolerates_vanished_paths(self):
+        # deletes under vanished subtrees are no-ops and sets create the
+        # intermediate dicts — structural drift detection is baseRv's
+        # job, the patch applier must never crash mid-stream
+        base = {"a": {"b": 1}}
+        out = wire.apply_patch(base, [[["x", "y"]], [["a", "z"], 5]])
+        assert out == {"a": {"b": 1, "z": 5}}
+        assert base == {"a": {"b": 1}}   # untouched
+
+
+# ---------------------------------------------------------------------------
+# session frames (version 3: per-stream intern state)
+# ---------------------------------------------------------------------------
+
+
+class TestSessionFrames:
+    def test_interns_persist_across_frames(self):
+        enc = wire.SessionEncoder()
+        ev = {"type": "MODIFIED", "rv": 9,
+              "object": {"nodeName": "node-00123", "phase": "Running"}}
+        f1, f2 = enc.encode(ev), enc.encode(ev)
+        assert len(f2) < len(f1)          # defs went out once, refs after
+        assert f2 == enc.encode(ev)       # steady state is stable
+        dec = wire.SessionDecoder()
+        fp = io.BytesIO(f1 + f2)
+        assert wire.read_event(fp, session=dec)[0] == ev
+        assert wire.read_event(fp, session=dec)[0] == ev
+        # v1 full frames interleave on the same stream (cached WireItem
+        # bytes pass through untouched between session frames)
+        fp = io.BytesIO(f1 + wire.encode_binary(ev) + enc.encode(ev))
+        dec = wire.SessionDecoder()
+        got = [wire.read_event(fp, session=dec)[0] for _ in range(3)]
+        assert got == [ev, ev, ev]
+
+    def test_session_frame_without_session_is_refused(self):
+        frame = wire.SessionEncoder().encode({"a": 1})
+        with pytest.raises(wire.WireError):
+            wire.read_event(io.BytesIO(frame))
+        # and scan() — the WAL replay reader — treats it as torn data,
+        # never as a record: session state must NEVER live at rest
+        assert wire.scan(frame, 0) is None
+
+    def test_stale_ref_is_an_error_not_garbage(self):
+        enc = wire.SessionEncoder()
+        enc.encode({"x": "novel-string-abc"})
+        f2 = enc.encode({"x": "novel-string-abc"})   # pure refs
+        with pytest.raises(wire.WireError):
+            wire.read_event(io.BytesIO(f2), session=wire.SessionDecoder())
+
+    def test_negotiation_helpers(self, monkeypatch):
+        h = wire.stream_headers()
+        assert wire.accept_session(h.get("Accept"))
+        assert wire.accept_codec(h.get("Accept")) == wire.BINARY
+        assert wire.mime_for(wire.BINARY, session=True) == wire.SESSION_MIME
+        assert wire.mime_for(wire.BINARY) == wire.WIRE_MIME
+        assert wire.session_of_mime(wire.SESSION_MIME)
+        assert not wire.session_of_mime(wire.WIRE_MIME)
+        assert not wire.session_of_mime("application/json")
+        # a JSON-pinned process offers neither plane on streams
+        monkeypatch.setattr(wire, "client_headers", lambda: {})
+        assert wire.stream_headers() == {}
+
+
+# ---------------------------------------------------------------------------
+# DELTA records at rest: WAL corruption per the PR-17 CRC contract
+# ---------------------------------------------------------------------------
+
+
+def _delta_wal(tmp_path, n_updates=5):
+    """A real server WAL containing DELTA twins: node-update churn where
+    each MODIFIED diffs to one small patch. Returns (dir, decoded recs,
+    record byte bounds, wal bytes, cpu values per update)."""
+    d = str(tmp_path / "state")
+    cpus = [4 + i for i in range(n_updates)]
+    api = APIServer(data_dir=d)
+    api.store.create_node(_node("n0"))
+    for c in cpus:
+        api.store.update_node(_node("n0", cpu=c))
+    api.shutdown()
+    buf = (tmp_path / "state" / DurableStore.WAL).read_bytes()
+    recs, bounds, pos = [], [0], 0
+    while True:
+        got = wire.scan(buf, pos)
+        if got is None:
+            break
+        rec, pos = got
+        recs.append(rec)
+        bounds.append(pos)
+    return d, recs, bounds, buf, cpus
+
+
+class TestDeltaWAL:
+    def test_node_churn_lands_as_delta_twins_and_recovers(self, tmp_path):
+        d, recs, _bounds, _buf, cpus = _delta_wal(tmp_path)
+        deltas = [r for r in recs if r.get("type") == "DELTA"]
+        assert len(deltas) >= len(cpus) - 1, [r.get("type") for r in recs]
+        for r in deltas:
+            assert r["kind"] == "nodes" and r["key"] == "n0"
+            assert r["baseRv"] is not None and r["rv"] > r["baseRv"]
+            # the at-rest twin is the PATCH, not the object
+            assert "object" not in r and r["patch"]
+        api2 = APIServer(data_dir=d)
+        try:
+            assert api2.persistence.torn_records_discarded == 0
+            node = api2.store.nodes["n0"]
+            assert node.allocatable.milli_cpu == cpus[-1] * 1000
+        finally:
+            api2.shutdown()
+
+    def test_truncation_mid_delta_record_recovers_clean_prefix(
+            self, tmp_path):
+        d, recs, bounds, buf, cpus = _delta_wal(tmp_path)
+        assert recs[-1].get("type") == "DELTA"
+        # cut INSIDE the last record: recovery must land on the previous
+        # update's state, with exactly one torn record discarded
+        cut = bounds[-2] + 3
+        (tmp_path / "state" / DurableStore.WAL).write_bytes(buf[:cut])
+        api2 = APIServer(data_dir=d)
+        try:
+            assert api2.persistence.torn_records_discarded == 1
+            node = api2.store.nodes["n0"]
+            assert node.allocatable.milli_cpu == cpus[-2] * 1000
+        finally:
+            api2.shutdown()
+
+    def test_bit_flip_inside_delta_record_quarantines(self, tmp_path):
+        from kubernetes_tpu.core.wal import WALQuarantineError
+        _d, recs, bounds, buf, _cpus = _delta_wal(tmp_path)
+        # pick a MIDDLE record that is a DELTA (never the tail — a
+        # damaged tail is legitimately torn, not quarantined)
+        idx = next(i for i, r in enumerate(recs[:-1])
+                   if r.get("type") == "DELTA")
+        start, end = bounds[idx], bounds[idx + 1]
+        rng = random.Random(0xF11B)
+        for off in sorted(rng.sample(range(start + 4, end), 5)):
+            for bit in (1, 0x40):
+                damaged = bytearray(buf)
+                damaged[off] ^= bit
+                d2 = tmp_path / f"flip-{off}-{bit}"
+                d2.mkdir()
+                (d2 / DurableStore.WAL).write_bytes(bytes(damaged))
+                ds = DurableStore(str(d2))
+                try:
+                    with pytest.raises(WALQuarantineError):
+                        ds.load()
+                finally:
+                    ds.close()
+
+    def test_delta_with_no_recovered_base_quarantines(self, tmp_path):
+        """A DELTA whose base never existed in the recovered history is
+        damage in the middle of acked state — same class as a CRC miss:
+        quarantine, never guess."""
+        from kubernetes_tpu.core.wal import WALQuarantineError
+        d = str(tmp_path / "ghost")
+        ds = DurableStore(d)
+        ds.load()
+        ds.append({"kind": "nodes", "type": "DELTA", "key": "ghost",
+                   "rv": 5, "baseRv": 4, "patch": [[["unschedulable"],
+                                                    True]],
+                   "seq": 1, "epoch": 1})
+        ds.close()
+        with pytest.raises(WALQuarantineError):
+            APIServer(data_dir=d)
+
+
+# ---------------------------------------------------------------------------
+# delta plane end-to-end: watch streams, fallback, replication, hollow
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaEndToEnd:
+    def test_node_churn_rides_delta_frames_to_the_client(self):
+        api = APIServer()
+        port = api.serve(0)
+        cs = None
+        try:
+            api.store.create_node(_node("n0"))
+            cs = HTTPClientset(f"http://127.0.0.1:{port}")
+            _wait(lambda: len(cs.nodes) == 1, msg="node sync")
+            for c in range(9, 19):
+                api.store.update_node(_node("n0", cpu=c))
+            _wait(lambda: cs.nodes["n0"].allocatable.milli_cpu == 18000,
+                  msg="delta convergence")
+            assert cs.delta_fallbacks == 0
+            assert cs.wire_decode_events[("delta", wire.BINARY)] >= 8
+            # delta frames are the small ones: mean delta bytes under
+            # mean full bytes even though the FIRST session frame pays
+            # the intern defines (steady-state frames are far smaller)
+            db = cs.wire_decode_bytes[("delta", wire.BINARY)]
+            de = cs.wire_decode_events[("delta", wire.BINARY)]
+            fb = cs.wire_decode_bytes[("full", wire.BINARY)]
+            fe = cs.wire_decode_events[("full", wire.BINARY)]
+            assert db / de < fb / fe, (cs.wire_decode_bytes,
+                                       cs.wire_decode_events)
+            # server-side attribution
+            minted = sum(wc.deltas_minted
+                         for wc in api.watch_cache.values())
+            assert minted >= 8
+            assert "apiserver_wire_deltas_minted_total" in \
+                api.expose_metrics()
+        finally:
+            if cs is not None:
+                cs.close()
+            api.shutdown()
+
+    def test_base_rv_mismatch_falls_back_to_relist_not_divergence(self):
+        api = APIServer()
+        port = api.serve(0)
+        cs = None
+        try:
+            api.store.create_node(_node("n0"))
+            cs = HTTPClientset(f"http://127.0.0.1:{port}")
+            _wait(lambda: len(cs.nodes) == 1, msg="node sync")
+            api.store.update_node(_node("n0", cpu=9))
+            _wait(lambda: cs.nodes["n0"].allocatable.milli_cpu == 9000,
+                  msg="first delta")
+            # sabotage the client's recorded base rv: the NEXT delta's
+            # baseRv cannot match, so the one legal answer is a re-list
+            for k in list(cs._wire_rv["nodes"]):
+                cs._wire_rv["nodes"][k] = 999_999_999
+            api.store.update_node(_node("n0", cpu=11))
+            _wait(lambda: cs.delta_fallbacks >= 1, msg="fallback")
+            _wait(lambda: cs.nodes["n0"].allocatable.milli_cpu == 11000,
+                  msg="relist convergence")
+            # and the stream keeps working afterwards — deltas resume
+            # against the fresh base
+            api.store.update_node(_node("n0", cpu=13))
+            _wait(lambda: cs.nodes["n0"].allocatable.milli_cpu == 13000,
+                  msg="post-fallback delta")
+        finally:
+            if cs is not None:
+                cs.close()
+            api.shutdown()
+
+    def test_json_pinned_client_never_sees_delta_frames(self, monkeypatch):
+        monkeypatch.setattr(wire, "client_headers", lambda: {})
+        api = APIServer()
+        port = api.serve(0)
+        cs = None
+        try:
+            api.store.create_node(_node("n0"))
+            cs = HTTPClientset(f"http://127.0.0.1:{port}")
+            _wait(lambda: len(cs.nodes) == 1, msg="node sync")
+            for c in range(9, 14):
+                api.store.update_node(_node("n0", cpu=c))
+            _wait(lambda: cs.nodes["n0"].allocatable.milli_cpu == 13000,
+                  msg="json convergence")
+            assert cs.wire_decode_events[("delta", wire.JSON)] == 0
+            assert cs.wire_decode_events[("delta", wire.BINARY)] == 0
+            assert cs.delta_fallbacks == 0
+        finally:
+            if cs is not None:
+                cs.close()
+            api.shutdown()
+
+
+class TestDeltaReplication:
+    def test_follower_materializes_shipped_deltas(self):
+        leader = APIServer()
+        lport = leader.serve(0)
+        follower = APIServer()
+        tail = ReplicationTail(follower, f"http://127.0.0.1:{lport}",
+                               rank=1, lease_duration=5.0)
+        try:
+            leader.store.create_node(_node("n0"))
+            tail.bootstrap()
+            tail.start()
+            _wait(lambda: len(follower.store.nodes) == 1, msg="bootstrap")
+            for c in range(9, 19):
+                leader.store.update_node(_node("n0", cpu=c))
+            _wait(lambda: follower.store.nodes["n0"]
+                  .allocatable.milli_cpu == 18000, msg="delta tail")
+            assert tail.delta_resyncs == 0
+            applied = sum(wc.deltas_applied
+                          for wc in follower.watch_cache.values())
+            assert applied >= 8
+            # zero divergence: the follower's wire object for the node is
+            # bit-identical to the leader's (the invariant every DELTA
+            # materialization depends on)
+            lw = leader.watch_cache["nodes"]._objects["n0"]
+            fw = follower.watch_cache["nodes"]._objects["n0"]
+            assert lw == fw
+        finally:
+            tail.stop()
+            follower.shutdown()
+            leader.shutdown()
+
+    def test_base_mismatch_snapshot_resyncs_and_promotes_clean(self):
+        leader = APIServer()
+        lport = leader.serve(0)
+        follower = APIServer()
+        tail = ReplicationTail(follower, f"http://127.0.0.1:{lport}",
+                               rank=1, lease_duration=0.5)
+        fport = follower.serve(0)
+        follower.repl_peers.update(
+            {0: f"http://127.0.0.1:{lport}", 1: f"http://127.0.0.1:{fport}"})
+        try:
+            leader.store.create_node(_node("n0"))
+            leader.store.create_pod(_pod("p0"))
+            tail.bootstrap()
+            tail.start()
+            _wait(lambda: len(follower.store.nodes) == 1
+                  and len(follower.store.pods) == 1, msg="bootstrap")
+            # sabotage the follower's recorded base rv: the next shipped
+            # DELTA raises DeltaBaseMismatch out of apply_frame and the
+            # tail answers with a full snapshot resync — a patch is never
+            # applied onto a divergent base
+            # (keyed off _objects: after a snapshot bootstrap _obj_rv is
+            # empty by design — unknown rvs take the accept-if-unknown
+            # path, so a poisoned rv must be INSTALLED, not overwritten)
+            wc = follower.watch_cache["nodes"]
+            with wc._lock:
+                for k in list(wc._objects):
+                    wc._obj_rv[k] = 999_999_999
+            leader.store.update_node(_node("n0", cpu=9))
+            _wait(lambda: tail.delta_resyncs >= 1, msg="resync")
+            _wait(lambda: follower.store.nodes["n0"]
+                  .allocatable.milli_cpu == 9000, msg="resync converged")
+            # stream stays live after the resync, deltas included
+            leader.store.update_node(_node("n0", cpu=12))
+            _wait(lambda: follower.store.nodes["n0"]
+                  .allocatable.milli_cpu == 12000, msg="post-resync tail")
+            assert leader.watch_cache["nodes"]._objects["n0"] == \
+                follower.watch_cache["nodes"]._objects["n0"]
+            # and promotion carries the materialized state forward
+            old_epoch = follower.repl_epoch
+            leader.shutdown()
+            _wait(lambda: follower.role == "leader", timeout=20.0,
+                  msg="promotion")
+            assert follower.repl_epoch > old_epoch
+            assert follower.store.nodes["n0"].allocatable.milli_cpu == 12000
+            follower.store.create_pod(_pod("p-after"))
+            assert len(follower.store.pods) == 2
+        finally:
+            tail.stop()
+            follower.shutdown()
+            leader.shutdown()
+
+
+class TestHollowHeartbeatBody:
+    def test_bulk_heartbeats_ride_the_negotiated_binary_codec(self):
+        from kubernetes_tpu.hollow import HollowNodePlane, HollowProfile
+        api = APIServer()
+        port = api.serve(0)
+        plane = None
+        try:
+            prof = HollowProfile(count=40, zones=4, heartbeat_s=0.3,
+                                 drift=0.0, churn_per_s=0.0,
+                                 register_chunk=20)
+            plane = HollowNodePlane(f"http://127.0.0.1:{port}", prof)
+            assert plane.register() == 40
+            plane.start()
+            _wait(lambda: plane.heartbeats >= 80,
+                  msg="two heartbeat sweeps")
+            # the POST bodies were counted on the server's status surface,
+            # on the binary plane, and the plane saw a wire-speaking server
+            assert api.wire_bytes[("binary", "status")] > 0, api.wire_bytes
+            assert plane.hb_wire_posts > 0
+            assert plane.stats()["hb_wire_posts"] == plane.hb_wire_posts
+        finally:
+            if plane is not None:
+                plane.stop()
+            api.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 encode-path guard: delta must beat full binary (and ride
+# below the C-json baseline measured in the SAME run)
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaEncodeGuard:
+    def test_delta_encode_beats_full_binary_on_heartbeat_corpus(self):
+        from kubernetes_tpu.wire import encode_ab
+        ab = encode_ab(1500)
+        hb = ab["corpora"]["heartbeat"]
+        assert hb["binary_delta"]["encode_us"] <= \
+            hb["binary_full"]["encode_us"], ab
+        # the frames themselves: ≥5× smaller than the full binary frame
+        # on both churn corpora (the size win is deterministic)
+        for name in ("heartbeat", "drift"):
+            row = ab["corpora"][name]
+            assert row["delta_vs_full_bytes"] >= 5.0, ab
