@@ -94,7 +94,8 @@ def all_checkers() -> List[Checker]:
                    index_dtype, jit_purity, lock_discipline,
                    metrics_discipline, reconcile_discipline,
                    shed_discipline, sharding_discipline,
-                   span_discipline, thread_hygiene, wire_discipline)
+                   span_discipline, supervision_discipline,
+                   thread_hygiene, wire_discipline)
     return [cls() for _, cls in sorted(_REGISTRY.items())]
 
 

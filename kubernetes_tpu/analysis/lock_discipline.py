@@ -200,7 +200,7 @@ class LockDisciplineChecker(Checker):
                    "fanout, no blocking reads under a held lock")
 
     SCOPE = ("core/apiserver.py", "core/wal.py", "core/watchcache.py")
-    SCOPE_DIRS = ("replication/", "hollow/", "controllers/")
+    SCOPE_DIRS = ("replication/", "hollow/", "controllers/", "fleet/")
 
     def applies_to(self, relpath: str) -> bool:
         if any(relpath == p or relpath.endswith("/" + p)

@@ -1,0 +1,161 @@
+"""supervision-discipline checker: every fleet/ child spawn rides the
+readiness-barrier + pipe-drain discipline.
+
+Incident class (ISSUE 19, carried from PR 8): a conductor that spawns a
+child process without (a) blocking on the child's ready line and (b)
+wiring a stdout drain leaves two latent stalls — a follower that starts
+"tailing" before the leader serves races the whole bring-up, and an
+undrained 64KB pipe buffer wedges any chatty child mid-run (the exact
+hang tests/test_faults.py PR-8 chased). Both failure modes look fine in
+review because the spawn itself is one innocent line; the discipline
+lives in the surrounding call graph.
+
+Rules, over every spawn site (a ``spawn_ready(...)`` or ``Popen(...)``
+call) in ``fleet/`` modules:
+
+- ``spawn-no-barrier``: some call-graph slice through the spawning
+  function must contain a readiness barrier — a ``spawn_ready`` call (it
+  IS the barrier: it blocks until the child's first ready line matches)
+  or a call to a wait/ready/barrier-named function (the staged bring-up's
+  explicit barriers, e.g. ``_wait_shards_leased``).
+- ``spawn-no-drain``: some slice must wire ``drain_pipe`` — the reader
+  thread that keeps the child's stdout from filling the pipe.
+
+"Slice" is the hint-freshness checker's notion verbatim: the module's
+name-level call graph (bare/self calls) walked in both directions, so the
+barrier/drain may live in the spawning function, a transitive callee, or
+a caller whose callee closure contains both the spawn and the sink (the
+``start → _start_shards → _spawn`` shape, where the lease barrier sits
+one frame above the spawn loop).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .base import Checker, Finding, ModuleSource, attr_chain, register
+
+SPAWN_CALLS = {"spawn_ready", "Popen"}
+DRAIN_CALLS = {"drain_pipe"}
+BARRIER_NAME_HINTS = ("wait", "ready", "barrier")
+
+
+def _is_barrier_name(name: str) -> bool:
+    low = name.lower()
+    return any(h in low for h in BARRIER_NAME_HINTS)
+
+
+def _fn_facts(fn: ast.AST):
+    """(spawn sites, has_barrier, has_drain, called same-module names)
+    for one def. A spawn site is (lineno, callee name)."""
+    spawns: List[Tuple[int, str]] = []
+    has_barrier = False
+    has_drain = False
+    calls: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if not chain:
+            continue
+        name = chain[-1]
+        if name in SPAWN_CALLS:
+            spawns.append((node.lineno, name))
+        if name in DRAIN_CALLS:
+            has_drain = True
+        if name == "spawn_ready" or _is_barrier_name(name):
+            has_barrier = True
+        if len(chain) == 1 or (len(chain) == 2 and chain[0] == "self"):
+            calls.add(name)
+    return spawns, has_barrier, has_drain, calls
+
+
+@register
+class SupervisionDisciplineChecker(Checker):
+    id = "supervision-discipline"
+    description = ("fleet/ child spawn sites stay on a call-graph slice "
+                   "containing a readiness-barrier wait (spawn_ready or a "
+                   "wait/ready/barrier-named call) AND drain_pipe wiring")
+
+    SCOPE_DIRS = ("fleet/",)
+
+    def applies_to(self, relpath: str) -> bool:
+        return any(("/" + d) in relpath or relpath.startswith(d)
+                   for d in self.SCOPE_DIRS)
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        tree = mod.tree
+        if tree is None:
+            return []
+        # Per-DEF scan, name-level call graph (hint-freshness's shape:
+        # duplicate method names merge calls-union / sink-OR).
+        defs: List = []  # (name, spawns, has_barrier, has_drain, calls)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.append((node.name, *_fn_facts(node)))
+        name_barrier: Dict[str, bool] = {}
+        name_drain: Dict[str, bool] = {}
+        name_calls: Dict[str, Set[str]] = {}
+        for name, _s, barrier, drain, calls in defs:
+            name_barrier[name] = name_barrier.get(name, False) or barrier
+            name_drain[name] = name_drain.get(name, False) or drain
+            name_calls.setdefault(name, set()).update(calls)
+        reach_memo: Dict[str, Set[str]] = {}
+
+        def reach(name: str) -> Set[str]:
+            got = reach_memo.get(name)
+            if got is not None:
+                return got
+            reach_memo[name] = out = set()
+            stack = [name]
+            while stack:
+                for callee in name_calls.get(stack.pop(), ()):
+                    if callee not in out and callee in name_calls:
+                        out.add(callee)
+                        stack.append(callee)
+            return out
+
+        def slice_has(name: str, own: bool, calls: Set[str],
+                      table: Dict[str, bool]) -> bool:
+            if own:
+                return True
+            down: Set[str] = set()
+            for c in calls:
+                if c in name_calls:
+                    down.add(c)
+                    down |= reach(c)
+            if any(table.get(n, False) for n in down):
+                return True
+            for g, _s, g_barrier, g_drain, _c in defs:
+                gr = reach(g)
+                if name in gr:
+                    g_own = (g_barrier if table is name_barrier
+                             else g_drain)
+                    if g_own or any(table.get(n, False) for n in gr):
+                        return True
+            return False
+
+        out: List[Finding] = []
+        for name, spawns, own_barrier, own_drain, calls in defs:
+            if not spawns:
+                continue
+            barrier_ok = slice_has(name, own_barrier, calls, name_barrier)
+            drain_ok = slice_has(name, own_drain, calls, name_drain)
+            for line, callee in spawns:
+                if not barrier_ok:
+                    out.append(Finding(
+                        self.id, "spawn-no-barrier", mod.path, line,
+                        f"{name}() spawns a child via {callee} but no "
+                        "call-graph slice through it waits on a readiness "
+                        "barrier (spawn_ready / a wait|ready|barrier-named "
+                        "call) — the staged bring-up can race a child that "
+                        "is not serving yet"))
+                if not drain_ok:
+                    out.append(Finding(
+                        self.id, "spawn-no-drain", mod.path, line,
+                        f"{name}() spawns a child via {callee} but no "
+                        "call-graph slice through it wires drain_pipe — an "
+                        "undrained 64KB stdout pipe wedges a chatty child "
+                        "mid-run (the PR-8 stall class)"))
+        return out

@@ -1852,19 +1852,38 @@ class APIServer:
         self.pod_evictions += 1
         return 200, {"evicted": True, "node": bound_to}
 
+    @staticmethod
+    def _pdb_threshold(value, total: int, round_up: bool) -> int:
+        """One PDB field — an int or an ``"N%"`` string — resolved against
+        the budget's matched-pod census (the reference's
+        GetScaledValueFromIntOrPercent split): minAvailable percentages
+        round UP (protect at least that share), maxUnavailable percentages
+        round DOWN (never disrupt more than that share)."""
+        if isinstance(value, str) and value.rstrip().endswith("%"):
+            pct = int(value.rstrip()[:-1] or 0)
+            scaled = pct * total
+            return -(-scaled // 100) if round_up else scaled // 100
+        return int(value or 0)
+
     def _pdb_blocks_eviction(self, pod) -> Optional[dict]:
         """PodDisruptionBudget precondition for VOLUNTARY disruptions
         (eviction subresource, ?voluntary=true deletes). Caller holds the
         write lock. Returns a 429 payload when committing the disruption
-        would take a selected workload below minAvailable, else None.
+        would take a selected workload below its budget floor, else None.
 
         ``available`` counts BOUND pods (node_name set) in the PDB's
         namespace matching its selector — the same census the chaos suite
-        polls. An empty matchLabels selector matches NOTHING (a typo'd
-        PDB must not accidentally freeze the whole cluster). Involuntary
-        paths (zone Full, node delete) never call this — exactly the
-        reference's split (disruption.go guards the Eviction subresource,
-        not the node controller's deletes)."""
+        polls; ``matched`` counts every selected pod bound or not (the
+        workload-size base percentages and maxUnavailable scale against —
+        disruption.go's expectedCount stand-in). Either budget form gates:
+        minAvailable blocks when the post-eviction bound count would dip
+        below the floor; maxUnavailable blocks when it would dip below
+        ``matched - maxUnavailable``. Both present ⇒ both must pass. An
+        empty matchLabels selector matches NOTHING (a typo'd PDB must not
+        accidentally freeze the whole cluster). Involuntary paths (zone
+        Full, node delete) never call this — exactly the reference's
+        split (disruption.go guards the Eviction subresource, not the
+        node controller's deletes)."""
         labels = pod.labels or {}
         ns = getattr(pod, "namespace", "") or "default"
         for key, pdb in self.workloads["pdbs"].items():
@@ -1875,18 +1894,30 @@ class APIServer:
                 continue
             if any(labels.get(k) != v for k, v in sel.items()):
                 continue
-            available = sum(
-                1 for p in self.store.pods.values()
-                if p.node_name
-                and (getattr(p, "namespace", "") or "default") == ns
+            matched = [
+                p for p in self.store.pods.values()
+                if (getattr(p, "namespace", "") or "default") == ns
                 and all((p.labels or {}).get(k) == v
-                        for k, v in sel.items()))
-            min_avail = int(pdb.get("minAvailable", 0))
+                        for k, v in sel.items())]
+            available = sum(1 for p in matched if p.node_name)
+            total = len(matched)
+            min_avail = self._pdb_threshold(
+                pdb.get("minAvailable", 0), total, round_up=True)
             if available - 1 < min_avail:
                 return {"error": "DisruptionBudget",
                         "pdb": pdb.get("name", key),
                         "available": available,
+                        "matched": total,
                         "minAvailable": min_avail}
+            if pdb.get("maxUnavailable") is not None:
+                max_unavail = self._pdb_threshold(
+                    pdb["maxUnavailable"], total, round_up=False)
+                if available - 1 < total - max_unavail:
+                    return {"error": "DisruptionBudget",
+                            "pdb": pdb.get("name", key),
+                            "available": available,
+                            "matched": total,
+                            "maxUnavailable": max_unavail}
         return None
 
     def _workload_upsert_locked(self, kind: str, body,

@@ -1,0 +1,18 @@
+"""Declarative fleet conductor (docs/SCALE.md § fleet conductor).
+
+One ``FleetSpec`` describes a whole many-process cluster — apiserver
+replicas, shard schedulers (optionally on a virtual device mesh), N
+hollow kubelet planes splitting one profile by name-prefix range,
+controller managers — and one ``FleetConductor`` runs it as a unit:
+staged bring-up with readiness barriers, per-role crash supervision,
+periodic RSS/throughput sampling, SIGUSR2 flight-record fan-out, and
+reverse-stage teardown. ``python -m kubernetes_tpu.fleet --spec
+fleet.json --pods N`` is the CLI face; ``shard/harness.py`` and
+``perf/harness.py`` drive the same conductor.
+"""
+
+from .conductor import FleetConductor, FleetMember
+from .spec import DEFAULT_RESTART, RESTART_POLICIES, FleetSpec
+
+__all__ = ["FleetConductor", "FleetMember", "FleetSpec",
+           "DEFAULT_RESTART", "RESTART_POLICIES"]

@@ -1,0 +1,74 @@
+"""The fleet entrypoint:
+
+    python -m kubernetes_tpu.fleet --spec fleet.json --pods N \
+        [--nodes M] [--warm W] [--timeout S] [--measure-only]
+
+Loads a FleetSpec, conducts the staged bring-up, drives N measured pods
+through the plane (the shard harness's measured window — exactly-once
+oracle, per-replica paged-plane counters, RSS peaks), and prints ONE
+consolidated JSON detail line. Without ``--pods`` it brings the fleet up
+and holds it until SIGTERM/SIGINT (a standing cluster to poke at),
+printing the conductor detail line on teardown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+from .spec import FleetSpec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubernetes-tpu-fleet")
+    ap.add_argument("--spec", required=True,
+                    help="FleetSpec JSON file (docs/SCALE.md format)")
+    ap.add_argument("--pods", type=int, default=0,
+                    help="measured pods to drive through the fleet "
+                         "(0 = bring up and hold until SIGTERM)")
+    ap.add_argument("--nodes", type=int, default=0,
+                    help="node count override (defaults to the spec's "
+                         "hollow count; required for a hollow-less spec "
+                         "with --pods)")
+    ap.add_argument("--warm", type=int, default=128,
+                    help="warm-up pods outside the measured window")
+    ap.add_argument("--timeout", type=float, default=900.0)
+    args = ap.parse_args(argv)
+
+    spec = FleetSpec.load(args.spec).validate()
+    if not args.pods:
+        return _hold(spec)
+
+    from ..shard.harness import run_sharded_cluster
+    n_nodes = args.nodes or int((spec.hollow or {}).get("count", 0))
+    if n_nodes <= 0:
+        ap.error("--nodes is required when the spec has no hollow plane")
+    out = run_sharded_cluster(
+        spec.shards, n_nodes, args.pods, warm_pods=args.warm,
+        timeout=args.timeout, spec=spec)
+    print(json.dumps(out), flush=True)
+    return 0 if out.get("all_bound") else 1
+
+
+def _hold(spec: FleetSpec) -> int:
+    from .conductor import FleetConductor
+
+    conductor = FleetConductor(spec).start()
+    # The ready line FIRST (spawn harnesses select()+readline on it).
+    print(f"fleet up: {len(conductor.members)} members, leader "
+          f"{conductor.base}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    detail = conductor.detail()
+    conductor.stop()
+    print(json.dumps({"fleet": detail}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,17 @@ def main(argv=None) -> int:
                     help="cordon/delete/re-register waves per second")
     ap.add_argument("--zones", type=int, default=-1)
     ap.add_argument("--prefix", default="")
+    ap.add_argument("--name-prefix-range", default="",
+                    help="START:END — own absolute node indices "
+                         "[START, END) of a split fleet (the conductor's "
+                         "multi-process seam; sets offset/count)")
+    ap.add_argument("--total", type=int, default=0,
+                    help="parent fleet size when this plane is one split "
+                         "member (defaults to END of --name-prefix-range)")
+    ap.add_argument("--adopt", action="store_true",
+                    help="supervised restart: paged-LIST survivors of this "
+                         "plane's range, adopt them, create only missing "
+                         "slots (zero duplicate nodes)")
     ap.add_argument("--silence", type=float, default=-1.0,
                     help="fraction of the fleet that goes permanently "
                          "silent (dead kubelets)")
@@ -77,9 +88,18 @@ def main(argv=None) -> int:
         profile.outage_zone = args.outage_zone
     if args.outage_after >= 0:
         profile.outage_after_s = args.outage_after
+    if args.name_prefix_range:
+        start, _, end = args.name_prefix_range.partition(":")
+        start, end = int(start), int(end)
+        if end <= start:
+            ap.error("--name-prefix-range END must be > START")
+        profile.offset, profile.count = start, end - start
+        profile.total = args.total or end
+    elif args.total:
+        profile.total = args.total
 
     plane = HollowNodePlane(args.api_url, profile)
-    n = plane.register()
+    n = plane.register(adopt=args.adopt)
     plane.start()
     # The ready line FIRST (spawn harnesses select()+readline on it).
     print(f"hollow-node plane: registered {n} nodes against "
@@ -87,6 +107,10 @@ def main(argv=None) -> int:
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
+    # Flight-record fan-out seam (fleet conductor SIGUSR2): dump the live
+    # stats line without dying — the drained tail picks it up.
+    signal.signal(signal.SIGUSR2, lambda *_: print(
+        json.dumps({"hollow_stats": plane.stats()}), flush=True))
     stop.wait()
     plane.stop()
     print(json.dumps({"hollow_stats": plane.stats()}), flush=True)

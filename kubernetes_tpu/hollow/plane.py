@@ -66,19 +66,26 @@ class HollowNodePlane:
         self._order: List[str] = []             # heartbeat round-robin
         self._hb_pos = 0
         self._cordoned: Deque[Tuple[float, str]] = deque()
-        self._seq = profile.count               # replacement name sequence
-        self._rng = random.Random(profile.seed or 0x5ca1e)
+        self._seq = profile.count               # legacy replacement sequence
+        self._gen = 1                           # split replacement generation
+        # Split members (profile.total > 0) decorrelate their rng streams
+        # by offset so two members don't churn lock-step victim indices;
+        # a standalone plane (offset 0) keeps the historical stream.
+        mix = profile.offset * 0x9E3779B1 if profile.total else 0
+        self._rng = random.Random((profile.seed or 0x5ca1e) ^ mix)
         # Failure-injection victims get their OWN rng stream so enabling
         # silence/flap never perturbs the drift/churn sequences of an
         # otherwise-identical profile (scenario diffing stays apples-to-
         # apples). Victims are picked at start(); replacements for churned
         # victims are new names and therefore healthy — like real fleets.
-        self._fault_rng = random.Random((profile.seed or 0x5ca1e) ^ 0xFA11)
+        self._fault_rng = random.Random(
+            (profile.seed or 0x5ca1e) ^ 0xFA11 ^ mix)
         self._silent: set = set()
         self._flappers: set = set()
         self._started_at: float = float("inf")
         # Counters (stats()): what the plane actually did to the cluster.
         self.registered = 0
+        self.adopted = 0
         self.heartbeats = 0
         self.drifts = 0
         self.cordons = 0
@@ -96,17 +103,30 @@ class HollowNodePlane:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def register(self) -> int:
-        """Bulk-register the whole fleet. Returns the node count the
-        server acknowledged (duplicates from a retried chunk are fine —
-        the bulk create skips and reports them)."""
+    def register(self, adopt: bool = False) -> int:
+        """Bulk-register this plane's index range. Returns the node count
+        the server acknowledged (duplicates from a retried chunk are fine
+        — the bulk create skips and reports them).
+
+        With ``adopt=True`` (a supervised restart of a fleet member), the
+        plane first paged-LISTs the cluster, adopts the survivors of its
+        own range — slot names and its slot-encoded replacements — and
+        creates only the slots with no live node, so a kill9'd member
+        comes back to exactly its spec range with zero duplicates."""
         prof = self.profile
-        wires = [prof.node_wire(i) for i in range(prof.count)]
+        adopted: Dict[int, dict] = {}
+        if adopt:
+            adopted = self._adopt_existing()
+        wires = [prof.node_wire(i) for i in prof.index_range()
+                 if i not in adopted]
         with self._lock:
-            for i, w in enumerate(wires):
+            for w, i in sorted(
+                    [(w, self._slot_of(w["name"])) for w in wires]
+                    + [(w, i) for i, w in adopted.items()],
+                    key=lambda t: t[1]):
                 self._nodes[w["name"]] = w
                 self._shape_ix[w["name"]] = i
-            self._order = [w["name"] for w in wires]
+                self._order.append(w["name"])
         chunks = [wires[i:i + prof.register_chunk]
                   for i in range(0, len(wires), prof.register_chunk)]
 
@@ -118,7 +138,76 @@ class HollowNodePlane:
             for res in ex.map(post, chunks):
                 self.registered += int((res or {}).get("created", 0))
                 self.registered += int((res or {}).get("alreadyExists", 0))
-        return self.registered
+        self.adopted = len(adopted)
+        return self.registered + self.adopted
+
+    # -- sub-range ownership (the conductor's restart-with-adoption seam) ---
+
+    def _slot_of(self, name: str):
+        """The absolute slot index a node name belongs to, or None if the
+        name is not one this plane's range owns. Slot names are
+        ``{prefix}-{i}``; split replacements encode their slot as
+        ``{prefix}-{i}r{gen}``; legacy replacements (``{prefix}-r{seq}``,
+        the standalone plane's scheme) belong to the sole plane."""
+        prof = self.profile
+        head = prof.name_prefix + "-"
+        if not name.startswith(head):
+            return None
+        tail = name[len(head):]
+        if tail.isdigit():
+            i = int(tail)
+            return i if i in prof.index_range() else None
+        slot, _r, gen = tail.partition("r")
+        if _r and gen.isdigit():
+            if slot.isdigit():                   # split scheme: {i}r{gen}
+                i = int(slot)
+                return i if i in prof.index_range() else None
+            if not slot and not prof.total:      # legacy: r{seq}, standalone
+                return prof.offset + int(gen) % max(1, prof.count)
+        return None
+
+    def _replacement_name(self, ix: int) -> str:
+        prof = self.profile
+        if prof.total:                           # split member: slot-encoded
+            name = f"{prof.name_prefix}-{ix}r{self._gen}"
+            self._gen += 1
+            return name
+        name = f"{prof.name_prefix}-r{self._seq}"
+        self._seq += 1
+        return name
+
+    def _adopt_existing(self) -> Dict[int, dict]:
+        """Paged-LIST the cluster and claim the live nodes of this
+        plane's range (slot -> wire). Cordoned survivors (a churn wave
+        interrupted by the crash) are uncordoned so the adopted fleet
+        returns to spec. Never raises — adoption errors mean the node is
+        re-created instead."""
+        from ..core.apiserver import fetch_paged
+        out: Dict[int, dict] = {}
+        try:
+            listed = fetch_paged(self.base, "nodes", limit=2000)
+        except Exception:  # noqa: BLE001 - fall back to plain re-register
+            self.errors += 1
+            return out
+        for wire in listed:
+            name = wire.get("name", "")
+            ix = self._slot_of(name)
+            if ix is None:
+                continue
+            tail = name.rsplit("r", 1)
+            if len(tail) == 2 and tail[1].isdigit():
+                self._gen = max(self._gen, int(tail[1]) + 1)
+                self._seq = max(self._seq, int(tail[1]) + 1)
+            if ix in out:                        # duplicate for one slot:
+                continue                         # keep the first, leave the
+            if wire.get("unschedulable"):        # rest to churn/lifecycle
+                wire = dict(wire, unschedulable=False)
+                try:
+                    self._client.call("PUT", f"/api/v1/nodes/{name}", wire)
+                except Exception:  # noqa: BLE001
+                    self.errors += 1
+            out[ix] = wire
+        return out
 
     def start(self) -> "HollowNodePlane":
         if self._threads:
@@ -146,7 +235,8 @@ class HollowNodePlane:
         with self._lock:
             live = len(self._nodes)
         return {"count": self.profile.count, "live": live,
-                "registered": self.registered,
+                "offset": self.profile.offset,
+                "registered": self.registered, "adopted": self.adopted,
                 "heartbeats": self.heartbeats, "drifts": self.drifts,
                 "cordons": self.cordons, "deletes": self.deletes,
                 "reregisters": self.reregisters,
@@ -330,10 +420,7 @@ class HollowNodePlane:
         with self._lock:
             self._nodes.pop(name, None)
             ix = self._shape_ix.pop(name, 0)
-            new_ix = self._seq
-            self._seq += 1
-            wire = self.profile.node_wire(
-                ix, name=f"{self.profile.name_prefix}-r{new_ix}")
+            wire = self.profile.node_wire(ix, name=self._replacement_name(ix))
             self._nodes[wire["name"]] = wire
             self._shape_ix[wire["name"]] = ix
             try:

@@ -67,6 +67,14 @@ class NodeShape:
 @dataclass
 class HollowProfile:
     count: int = 1000
+    # Sub-range seam (the fleet conductor's multi-process split): this
+    # plane owns absolute node indices [offset, offset+count) of a parent
+    # fleet of `total` nodes. offset/total default to standalone (one
+    # plane owns the whole fleet, total == 0 means "not a split member").
+    # shape_for / zones / names all key off the ABSOLUTE index, so a
+    # split fleet is bit-identical to the same profile run unsplit.
+    offset: int = 0
+    total: int = 0
     shapes: List[NodeShape] = field(default_factory=lambda: [NodeShape()])
     zones: int = 50
     name_prefix: str = "hollow"
@@ -96,6 +104,8 @@ class HollowProfile:
     def from_dict(cls, d: dict) -> "HollowProfile":
         shapes = [NodeShape.from_dict(s) for s in d.get("shapes", ())]
         return cls(count=int(d.get("count", 1000)),
+                   offset=int(d.get("offset", 0)),
+                   total=int(d.get("total", 0)),
                    shapes=shapes or [NodeShape()],
                    zones=int(d.get("zones", 50)),
                    name_prefix=str(d.get("name_prefix", "hollow")),
@@ -115,6 +125,7 @@ class HollowProfile:
 
     def to_dict(self) -> dict:
         return {"count": self.count,
+                "offset": self.offset, "total": self.total,
                 "shapes": [s.to_dict() for s in self.shapes],
                 "zones": self.zones, "name_prefix": self.name_prefix,
                 "heartbeat_s": self.heartbeat_s, "drift": self.drift,
@@ -132,6 +143,37 @@ class HollowProfile:
     def load(cls, path: str) -> "HollowProfile":
         with open(path) as fh:
             return cls.from_dict(json.load(fh))
+
+    def split(self, n: int) -> List["HollowProfile"]:
+        """Partition this profile into ``n`` contiguous sub-range members
+        for N hollow-plane processes. The sub-ranges are disjoint and
+        complete (they tile [offset, offset+count) exactly); every member
+        keeps the parent's shapes/zones/prefix/seed and indexes nodes by
+        ABSOLUTE position, so shape interleave, zone assignment, and node
+        names are identical to the unsplit plane. Churn rates divide by
+        fleet share so the aggregate wave rate matches the parent's."""
+        n = max(1, int(n))
+        base, extra = divmod(self.count, n)
+        total = self.total or self.count
+        out: List["HollowProfile"] = []
+        start = self.offset
+        for k in range(n):
+            cnt = base + (1 if k < extra else 0)
+            if cnt <= 0:
+                continue
+            share = cnt / max(1, self.count)
+            sub = HollowProfile.from_dict(self.to_dict())
+            sub.offset = start
+            sub.count = cnt
+            sub.total = total
+            sub.churn_per_s = self.churn_per_s * share
+            out.append(sub)
+            start += cnt
+        return out
+
+    def index_range(self) -> range:
+        """The absolute node indices this plane owns."""
+        return range(self.offset, self.offset + self.count)
 
     # Conjugate golden ratio: frac(i*φ') is a low-discrepancy sequence —
     # every shape's share of any index range is within O(1) of its weight

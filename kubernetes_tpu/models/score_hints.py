@@ -416,7 +416,8 @@ class HintEntry:
                 out[slot] = amount
         return out
 
-    def _reencode_pod_row(self, cache, key: str) -> Optional[str]:
+    def _reencode_pod_row(self, cache, key: str,
+                          unblock: bool = True) -> Optional[str]:
         row = self.row_of.get(key)
         ni = cache.nodes.get(key)
         if row is None or ni is None or ni.node is None:
@@ -425,9 +426,28 @@ class HintEntry:
         self.nonzero[row, 0] = ni.non_zero_requested.milli_cpu
         self.nonzero[row, 1] = ni.non_zero_requested.memory
         self.pod_count[row] = len(ni.pods)
-        self.blocked[row] = False  # post-conflict truth re-read
+        if unblock:
+            # Journal truth (the 409 winner's commit arrives as exactly
+            # this event) releases a conflict block. A SIBLING entry's own
+            # bind (note_own_attempt cross-feed) must NOT: the winner's
+            # watch copy may not have landed in the cache yet, so the row
+            # would understate committed usage all over again.
+            self.blocked[row] = False
         self._reval_row(row)
         self._pending = []  # a row moved outside the walk: re-segment
+        return None
+
+    def resync_rows(self, cache) -> Optional[str]:
+        """Re-encode EVERY row's dynamic pod state from cache truth: a
+        device session this entry did not watch just committed placements
+        (own binds are journal-benign, so there is no event stream to
+        replay). One full pass of the journal pod re-encode, blocked rows
+        kept blocked. O(rows) host work — paid once per install, only when
+        a sibling entry survives, never on the single-shape steady state."""
+        for name in self.node_names:
+            reason = self._reencode_pod_row(cache, name, unblock=False)
+            if reason:
+                return reason
         return None
 
     def _revalidate_node_row(self, cache, key: str) -> Optional[str]:
@@ -488,14 +508,39 @@ class HintEntry:
 
 
 class ScoreHintCache:
-    """The scheduler's single live hint + serve/install/invalidate
-    protocol. Counters live on the scheduler (WINDOW_COUNTERS surface);
-    labeled series on its SchedulerMetrics."""
+    """The scheduler's live hints + serve/install/invalidate protocol.
+    Counters live on the scheduler (WINDOW_COUNTERS surface); labeled
+    series on its SchedulerMetrics.
+
+    The cache is a small signature-keyed LRU (``TPU_SCHED_HINT_LRU``
+    slots, default 2, MRU first): alternating deployment waves — two
+    replica shapes interleaving through one queue — keep BOTH shapes on
+    the host path instead of thrashing a single slot. ``=1`` is the A/B
+    seam back to the historical single-entry behavior. Coherence across
+    entries is push-based, not journal-based, because own binds are
+    deliberately journal-benign: every own attempt bumps EVERY live
+    entry's attempt watermark, and a committed bind re-encodes the landed
+    node's row on the non-serving entries from cache truth
+    (``note_own_attempt``), so a sibling's placements can never make an
+    entry serve a stale row."""
 
     def __init__(self, sched, enabled: bool = True):
+        import os
         self.sched = sched
         self.enabled = enabled
-        self.entry: Optional[HintEntry] = None
+        self.capacity = max(1, int(os.environ.get("TPU_SCHED_HINT_LRU",
+                                                  "2") or 2))
+        self.entries: list = []  # HintEntry, MRU first
+
+    @property
+    def entry(self) -> Optional[HintEntry]:
+        """The MRU entry or None — the 'is a hint live at all' view the
+        scheduler's fast-path gates read."""
+        return self.entries[0] if self.entries else None
+
+    @entry.setter
+    def entry(self, value: Optional[HintEntry]) -> None:
+        self.entries = [] if value is None else [value]
 
     # -- counters -----------------------------------------------------------
 
@@ -507,12 +552,14 @@ class ScoreHintCache:
         self.sched.hint_hits += 1
         self.sched.metrics.hint_cache_hits.inc(kind)
 
-    def invalidate(self, reason: str) -> None:
-        if self.entry is None:
-            return
-        self.entry = None
+    def _drop(self, e: HintEntry, reason: str) -> None:
+        self.entries.remove(e)
         self.sched.hint_invalidations += 1
         self.sched.metrics.hint_cache_invalidations.inc(reason)
+
+    def invalidate(self, reason: str) -> None:
+        while self.entries:
+            self._drop(self.entries[-1], reason)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -520,46 +567,85 @@ class ScoreHintCache:
                 carry) -> None:
         if not self.enabled:
             return
-        self.entry = HintEntry.from_session(
+        e = HintEntry.from_session(
             self.sched, fw, head_pod, sig, nsig, plan, node_names, carry)
+        # Same-signature slots are superseded in place (the fresh carry IS
+        # the newer truth for that shape); a genuinely new shape pushes the
+        # coldest entry out. Surviving siblings ABSORB the device session
+        # that just ended — its attempts bump and its committed placements
+        # (re-encoded from cache truth) — or the attempts fence would read
+        # every sibling as foreign next serve and alternating shapes would
+        # thrash the cache one install per pod. unwinds/nomination fences
+        # are deliberately NOT absorbed: a session that moved those leaves
+        # the sibling stale, and the fence catches it.
+        kept = []
+        for x in self.entries:
+            if x.keys & e.keys:
+                continue
+            x.attempts = self.sched.attempts
+            if x.resync_rows(self.sched.cache) is None:
+                kept.append(x)
+            else:
+                self.sched.hint_invalidations += 1
+                self.sched.metrics.hint_cache_invalidations.inc(
+                    "cross_reencode")
+        self.entries = [e] + kept
+        while len(self.entries) > self.capacity:
+            self._drop(self.entries[-1], "lru_evict")
 
     def note_conflict(self, node: str) -> None:
-        """Bind-409 on `node`: invalidate the hint for that node ONLY. The
-        conflict's unwind (forget_pod) is absorbed — its entire effect is
-        on the blocked row, which re-encodes from cache truth when the
-        winner's commit lands through the journal."""
-        e = self.entry
-        if e is None:
-            return
-        if e.block_row(node):
-            e.unwinds += 1
-            self.sched.hint_invalidations += 1
-            self.sched.metrics.hint_cache_invalidations.inc("bind_conflict")
-        else:
-            self.invalidate("bind_conflict")
+        """Bind-409 on `node`: invalidate EVERY entry's view of that node
+        ONLY. The conflict's unwind (forget_pod) is absorbed — its entire
+        effect is on the blocked rows, which re-encode from cache truth
+        when the winner's commit lands through the journal. An entry whose
+        row set does not cover the node cannot absorb and is dropped."""
+        for e in list(self.entries):
+            if e.block_row(node):
+                e.unwinds += 1
+                self.sched.hint_invalidations += 1
+                self.sched.metrics.hint_cache_invalidations.inc(
+                    "bind_conflict")
+            else:
+                self._drop(e, "bind_conflict")
 
-    def note_own_attempt(self) -> None:
-        e = self.entry
-        if e is not None:
+    def note_own_attempt(self, node: str = "",
+                         served: Optional[HintEntry] = None) -> None:
+        """One walker attempt just ran: absorb the scheduler attempt-
+        counter bump on EVERY live entry (all watermarks stay current —
+        without this, one entry serving would read as a foreign attempt to
+        its siblings and evict them). A committed bind passes the landed
+        `node`: non-serving entries re-encode that row from cache truth
+        (the assumed pod is already in it) WITHOUT unblocking — a 409
+        block must outlive a sibling's bind. A failed attempt passes
+        node="" (the 409 path already blocked the row via note_conflict)."""
+        if not self.entries:
+            return
+        cache = self.sched.cache
+        for e in list(self.entries):
             e.attempts += 1
+            if e is served or not node:
+                continue
+            if e._reencode_pod_row(cache, node, unblock=False) is not None:
+                # The sibling's row set does not cover the landed node —
+                # its world no longer matches the cluster's shape.
+                self._drop(e, "cross_reencode")
 
     # -- serve --------------------------------------------------------------
 
     def serve(self, fw, pod) -> Optional[Tuple[HintEntry, str]]:
-        """Validate the live entry against `pod` and the world; returns
-        (entry, hit kind) when the hint path may bind this pod, else None
-        (counted as a miss; stale entries are dropped + counted as
-        invalidations)."""
+        """Validate the signature-matched entry against `pod` and the
+        world; returns (entry, hit kind) when the hint path may bind this
+        pod, else None (counted as a miss; stale entries are dropped +
+        counted as invalidations). A served entry moves to the LRU head."""
         if not self.enabled:
             # The A/B seam (`_hints.enabled = False` /
             # TPU_SCHED_SCORE_HINTS=0) must hold on a WARM scheduler too:
-            # a live entry installed before the flip may not keep serving,
+            # live entries installed before the flip may not keep serving,
             # or the dispatch-only baseline is silently invalid.
-            self.entry = None
+            self.entries = []
             return None
-        e = self.entry
         s = self.sched
-        if e is None:
+        if not self.entries:
             self._miss("empty")
             return None
         if s.cache.affinity_pod_refs:
@@ -572,17 +658,27 @@ class ScoreHintCache:
         if sig is None:
             self._miss("unsignable")
             return None
-        if id(fw) != e.fw_id:
+        same_fw = [x for x in self.entries if id(fw) == x.fw_id]
+        if not same_fw:
             self._miss("profile")
             return None
-        if ("exact", sig) in e.keys:
-            kind = "exact"
-        else:
+        # Exact key beats neutral ACROSS entries (single-entry semantics —
+        # both keys lived on one entry — carried to the LRU); MRU order
+        # breaks ties within a kind.
+        e = kind = None
+        for x in same_fw:
+            if ("exact", sig) in x.keys:
+                e, kind = x, "exact"
+                break
+        if e is None:
             nsig = s._neutral_sig(fw, pod, sig)
-            if nsig is None or ("neutral", nsig) not in e.keys:
-                self._miss("signature")
-                return None
-            kind = "neutral"
+            for x in same_fw:
+                if nsig is not None and ("neutral", nsig) in x.keys:
+                    e, kind = x, "neutral"
+                    break
+        if e is None:
+            self._miss("signature")
+            return None
         if pod.volumes or getattr(pod, "resource_claims", None):
             self._miss("claims")
             return None
@@ -593,30 +689,35 @@ class ScoreHintCache:
             self._miss("extender")
             return None
         if s.queue.nominator.version != e.nom_version:
-            self.invalidate("nomination")
+            self._drop(e, "nomination")
             self._miss("stale")
             return None
         if s.attempts != e.attempts:
             # A scheduling attempt the walker did not make (host path,
             # device session, fall-through) moved cache state the journal
-            # does not record (own binds are deliberately benign there).
-            self.invalidate("foreign_attempt")
+            # does not record (own binds are deliberately benign there —
+            # sibling-entry serves are absorbed by note_own_attempt, so
+            # only a genuinely foreign attempt lands here).
+            self._drop(e, "foreign_attempt")
             self._miss("stale")
             return None
         if s.state_unwinds != e.unwinds:
-            self.invalidate("state_unwind")
+            self._drop(e, "state_unwind")
             self._miss("stale")
             return None
         if s.cluster_event_seq != e.seq:
             events = s.journal.since(e.seq)
             if events is None:
-                self.invalidate("journal_gap")
+                self._drop(e, "journal_gap")
                 self._miss("stale")
                 return None
             reason = e.consume(s, events)
             if reason is not None:
-                self.invalidate(reason)
+                self._drop(e, reason)
                 self._miss("stale")
                 return None
             e.seq = s.cluster_event_seq
+        if self.entries[0] is not e:
+            self.entries.remove(e)
+            self.entries.insert(0, e)
         return e, kind

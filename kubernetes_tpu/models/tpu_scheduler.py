@@ -2261,7 +2261,7 @@ class TPUScheduler(Scheduler):
                 break
             node = entry.node_names[row]
             committed = self._commit(fw, qpi, node)
-            hints.note_own_attempt()
+            hints.note_own_attempt(node if committed else "", entry)
             handled += 1
             if not committed:
                 # A sync 409 already blocked the row via _note_bind_conflict

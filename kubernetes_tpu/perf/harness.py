@@ -570,6 +570,14 @@ def run_hollow_workload(wl: Workload) -> PerfResult:
     out = run_sharded_cluster(
         int(params.get("shards", 1)), n_nodes, n_pods,
         hollow=profile,
+        # Fleet-conductor seams (docs/SCALE.md § fleet conductor): split
+        # the hollow fleet across N plane processes by name-prefix range,
+        # and give every shard a virtual device mesh so row-local plans
+        # dispatch mesh-SPMD (the 100k fusion row runs both).
+        hollow_procs=int(params.get("hollowProcs", 1)),
+        mesh_devices=int(params.get("meshDevices", 0)),
+        child_env=({"TPU_SCHED_HINT_LRU": str(params["hintLru"])}
+                   if params.get("hintLru") else None),
         replicas=int(params.get("replicas", 0)),
         lease_duration=float(params.get("leaseDuration", 15.0)),
         warm_pods=int(params.get("warmPods", min(256, max(1, n_pods // 8)))),
@@ -588,16 +596,27 @@ def run_hollow_workload(wl: Workload) -> PerfResult:
         [rss.get("apiserver", 0.0)] + list(rss.get("followers", ())))}
     result.metrics["MaxShardRssMb"] = {"Average": max(
         list(rss.get("shards", ())) or [0.0])}
+    # Peak RSS of the hollow plane processes themselves: at 100k nodes
+    # split across members, the impersonation layer's memory is part of
+    # the bounded-memory claim too.
+    result.metrics["MaxHollowRssMb"] = {"Average": float(
+        rss.get("hollow", 0.0) or 0.0)}
     # Zero-unpaged must hold on EVERY replica (the shards list from
     # followers): the replication detail scrapes each one, leader
     # included; without replicas, fall back to the leader's counter.
     reps = out.get("replication")
     if reps:
         unpaged = sum(float(rep.get("listUnpaged", 0)) for rep in reps)
+        relisted = sum(float(rep.get("relistedWatches", 0)) for rep in reps)
     else:
-        unpaged = float(
-            (out.get("api") or {}).get("apiserver_list_unpaged_total", 0.0))
+        api = out.get("api") or {}
+        unpaged = float(api.get("apiserver_list_unpaged_total", 0.0))
+        relisted = float(api.get("apiserver_relisted_watches_total", 0.0))
     result.metrics["MaxUnpagedLists"] = {"Average": unpaged}
+    # Watch-plane health ceiling: a relisted watch means a watcher fell
+    # off the cache ring and re-LISTed — at 100k nodes that is a paged
+    # but still fleet-sized read. The fusion row pins it to zero.
+    result.metrics["MaxRelistedWatches"] = {"Average": relisted}
     result.detail = dict(out)
     return result
 

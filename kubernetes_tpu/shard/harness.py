@@ -11,7 +11,6 @@ from __future__ import annotations
 import json
 import os
 import re
-import shutil
 import sys
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -184,95 +183,77 @@ def scrape_metrics(base: str, text: Optional[str] = None) -> Dict[str, float]:
 
 
 class ShardedCluster:
-    """Handles to a running sharded cluster (context for progress_cb)."""
+    """Handles to a running sharded cluster (context for progress_cb).
 
-    def __init__(self, base: str, api_proc, shard_procs: List,
-                 shard_urls: List[str], follower_procs: Optional[List] = None,
-                 follower_urls: Optional[List[str]] = None):
-        from ..testing.faults import drain_pipe
+    Since the fleet conductor landed (kubernetes_tpu/fleet/), this is a
+    compatibility VIEW over a FleetConductor: the conductor owns the
+    process tree (staged bring-up, drained pipes, supervision, RSS
+    sampling, teardown); this class keeps the attribute surface the
+    chaos tests and bench drivers always had."""
 
-        self.base = base
-        self.api_proc = api_proc
-        self.shard_procs = shard_procs
-        self.shard_urls = shard_urls
-        # Replicated control plane (kubernetes_tpu/replication/): follower
-        # apiserver processes the shards read from (writes redirect).
-        self.follower_procs = list(follower_procs or ())
-        self.follower_urls = list(follower_urls or ())
-        # Hollow-node plane process (kubernetes_tpu/hollow/), when the run
-        # impersonates its nodes instead of bulk-creating them.
-        self.hollow_proc = None
-        self.hollow_tail = None
+    def __init__(self, conductor):
+        self.conductor = conductor
         self.killed: List[int] = []
-        # Peak RSS (MiB) per process role, sampled by the progress poll
-        # loop (sample_rss) — the bounded-memory claim of the paged read
-        # plane as a measured number.
-        self.rss_peaks: Dict[str, object] = {
-            "apiserver": 0.0,
-            "shards": [0.0] * len(shard_procs),
-            "followers": [0.0] * len(self.follower_procs),
-        }
-        # Keep every child's stdout pipe DRAINED for the cluster's whole
-        # life: a logging burst (slow-step warnings after a fallback) into
-        # an unread pipe blocks the child on write mid-cycle — measured as
-        # a ~2x pods/s collapse that looks like scheduler regression.
-        self.log_tails = [drain_pipe(p)
-                          for p in [api_proc] + list(shard_procs)
-                          + self.follower_procs]
 
-    def attach_hollow(self, proc) -> None:
-        from ..testing.faults import drain_pipe
-        self.hollow_proc = proc
-        self.hollow_tail = drain_pipe(proc)
-        self.log_tails.append(self.hollow_tail)
-        self.rss_peaks["hollow"] = 0.0
+    # -- conductor-derived handles -----------------------------------------
+
+    @property
+    def base(self) -> str:
+        return self.conductor.base
+
+    @property
+    def api_proc(self):
+        leaders = self.conductor.members_of("apiserver")
+        return leaders[0].proc if leaders else None
+
+    @property
+    def shard_procs(self) -> List:
+        return [m.proc for m in self.conductor.members_of("shard")]
+
+    @property
+    def shard_urls(self) -> List[str]:
+        return list(self.conductor.shard_urls)
+
+    @property
+    def follower_procs(self) -> List:
+        return [m.proc for m in self.conductor.members_of("follower")]
+
+    @property
+    def follower_urls(self) -> List[str]:
+        return list(self.conductor.follower_urls)
+
+    @property
+    def hollow_proc(self):
+        hollows = self.conductor.members_of("hollow")
+        return hollows[0].proc if hollows else None
+
+    @property
+    def log_tails(self) -> List:
+        return [m.tail for m in self.conductor.members if m.tail is not None]
+
+    @property
+    def rss_peaks(self) -> Dict[str, object]:
+        return self.conductor.rss_peaks()
 
     def sample_rss(self) -> Dict[str, object]:
-        """Fold the current per-process VmRSS into the peaks. Called from
-        the progress poll loop (one /proc read per process per poll)."""
-        peaks = self.rss_peaks
-        peaks["apiserver"] = max(peaks["apiserver"],
-                                 rss_mb(self.api_proc.pid))
-        for i, p in enumerate(self.shard_procs):
-            if p.poll() is None:
-                peaks["shards"][i] = max(peaks["shards"][i], rss_mb(p.pid))
-        for i, p in enumerate(self.follower_procs):
-            peaks["followers"][i] = max(peaks["followers"][i],
-                                        rss_mb(p.pid))
-        if self.hollow_proc is not None:
-            peaks["hollow"] = max(peaks["hollow"],
-                                  rss_mb(self.hollow_proc.pid))
-        return peaks
+        """Fold the current per-process VmRSS into the peaks (the
+        conductor's supervisor also samples on its own cadence)."""
+        return self.conductor.rss_peaks()
 
     def stop_hollow(self) -> Optional[dict]:
-        """SIGTERM the hollow plane and collect its final stats line
-        (`{"hollow_stats": ...}`) from the drained tail."""
-        proc = self.hollow_proc
-        if proc is None:
-            return None
-        if proc.poll() is None:
-            proc.terminate()
-            try:
-                proc.wait(timeout=15)
-            except Exception:  # noqa: BLE001
-                proc.kill()
-        self.hollow_proc = None
-        time.sleep(0.1)  # let the drain thread swallow the stats line
-        for line in reversed(list(self.hollow_tail or ())):
-            if "hollow_stats" in line:
-                try:
-                    return json.loads(line)["hollow_stats"]
-                except (ValueError, KeyError):
-                    return None
-        return None
+        """SIGTERM the hollow members and merge their final stats lines
+        (`{"hollow_stats": ...}`) from the drained tails."""
+        return self.conductor.stop_hollow()
 
     def kill(self, index: int) -> None:
-        """SIGKILL one shard scheduler process — no goodbye, no flush."""
-        import signal
-        proc = self.shard_procs[index]
-        if proc.poll() is None:
-            proc.send_signal(signal.SIGKILL)
-            proc.wait(timeout=30)
+        """SIGKILL one shard scheduler process — no goodbye, no flush.
+        The conductor's shard policy is `adopt`: the kill is LEDGERED but
+        never respawned — the dead range drains through lease adoption."""
+        import signal as _signal
+        member = self.conductor.members_of("shard")[index]
+        if member.alive():
+            member.proc.send_signal(_signal.SIGKILL)
+            member.proc.wait(timeout=30)
         self.killed.append(index)
 
     def alive_shard_urls(self) -> List[str]:
@@ -280,15 +261,7 @@ class ShardedCluster:
                 if i not in self.killed]
 
     def stop(self) -> None:
-        extra = [self.hollow_proc] if self.hollow_proc is not None else []
-        for p in self.shard_procs + self.follower_procs + extra \
-                + [self.api_proc]:
-            if p is not None and p.poll() is None:
-                p.terminate()
-                try:
-                    p.wait(timeout=10)
-                except Exception:  # noqa: BLE001
-                    p.kill()
+        self.conductor.stop()
 
 
 def start_sharded_cluster(n_shards: int, lease_duration: float = 15.0,
@@ -298,12 +271,15 @@ def start_sharded_cluster(n_shards: int, lease_duration: float = 15.0,
                           replicas: int = 0,
                           repl_lease: float = 2.0,
                           fair_tenants: bool = False,
-                          apf_workload: str = "") -> ShardedCluster:
-    """Spawn the apiserver + N shard scheduler processes; blocks until every
-    process prints its ready line (shards spawn in parallel — each pays the
-    JAX import). ``flightrec_dir`` installs the flight recorder in every
-    process (TPU_SCHED_FLIGHTREC_DIR): periodic + exit dumps land there, so
-    even a SIGKILLed member leaves a recent forensic artifact.
+                          apf_workload: str = "",
+                          spec=None) -> ShardedCluster:
+    """Bring up the apiserver + N shard scheduler processes through the
+    fleet conductor (kubernetes_tpu/fleet/): staged readiness barriers
+    (leader → followers tailing → shards leased), every child's stdout
+    drained, per-role supervision. ``flightrec_dir`` installs the flight
+    recorder in every process (TPU_SCHED_FLIGHTREC_DIR): periodic + exit
+    dumps land there, so even a SIGKILLed member leaves a recent forensic
+    artifact.
 
     ``replicas`` > 0 builds the REPLICATED control plane
     (kubernetes_tpu/replication/): that many follower apiservers tail the
@@ -311,96 +287,20 @@ def start_sharded_cluster(n_shards: int, lease_duration: float = 15.0,
     ``i % replicas`` — with the siblings + leader as reflector fallbacks —
     while its writes redirect to the leader. One apiserver process stops
     being both the durability point and the availability ceiling for
-    N shards x M watch streams."""
-    from ..testing.faults import spawn_ready
+    N shards x M watch streams.
 
-    repo, env = _repo_root(), _env()
-    if flightrec_dir:
-        os.makedirs(flightrec_dir, exist_ok=True)
-        env["TPU_SCHED_FLIGHTREC_DIR"] = flightrec_dir
-    if fair_tenants:
-        # Per-tenant weighted fair dequeue in every shard scheduler
-        # (core/queue.py _FairTenantHeap) — the flood/fairness scenarios
-        # switch it on uniformly across the plane's OS processes.
-        env["TPU_SCHED_FAIR_TENANTS"] = "1"
-    if apf_workload:
-        # Workload-lane sizing override for the spawned apiserver
-        # (core/flowcontrol.py env seam: "seats,queues,qlen,hand,wait") —
-        # flood scenarios tighten it so shedding is demonstrable at
-        # test-box scale; the exempt lane has no knob by design.
-        env["TPU_SCHED_APF_WORKLOAD"] = apf_workload
-    cmd = [sys.executable, "-m", "kubernetes_tpu.core.apiserver",
-           "--port", "0"]
-    if data_dir:
-        cmd += ["--data-dir", data_dir]
-    if replicas:
-        cmd += ["--repl-lease-duration", str(repl_lease)]
-    api_proc, m = spawn_ready(cmd, _READY, cwd=repo, env=env,
-                              timeout=startup_timeout)
-    base = f"http://127.0.0.1:{m.group(1)}"
+    ``spec`` (a fleet.FleetSpec) overrides the argument-built spec
+    entirely — the seam `python -m kubernetes_tpu.fleet` drives."""
+    from ..fleet import FleetConductor, FleetSpec
 
-    follower_procs: List = []
-    follower_urls: List[str] = []
-    try:
-        for rank in range(1, replicas + 1):
-            fcmd = [sys.executable, "-m", "kubernetes_tpu.core.apiserver",
-                    "--port", "0", "--replicate-from", base,
-                    "--replica-rank", str(rank),
-                    "--repl-lease-duration", str(repl_lease)]
-            if data_dir:
-                fcmd += ["--data-dir", f"{data_dir}-follower-{rank}"]
-            p, fm = spawn_ready(fcmd, _READY, cwd=repo, env=env,
-                                timeout=startup_timeout)
-            follower_procs.append(p)
-            follower_urls.append(f"http://127.0.0.1:{fm.group(1)}")
-        if replicas:
-            # Ephemeral ports: inject the full election topology post-spawn.
-            peers = {"0": base}
-            peers.update({str(r + 1): u
-                          for r, u in enumerate(follower_urls)})
-            for url in [base] + follower_urls:
-                _call(url, "POST", "/replication/peers", {"peers": peers})
-
-        def spawn_shard(i: int):
-            # Shard-per-core placement (n>1 only; a single shard keeps the
-            # whole box): without pinning, each shard's XLA pool spans every
-            # core, so one shard's device dispatch evicts its peers' GIL
-            # threads and the plane ping-pongs instead of overlapping —
-            # measured ~20% pods/s on a 2-core host. The apiserver stays
-            # unpinned (it is I/O-bound).
-            pin: List[str] = []
-            if n_shards > 1 and shutil.which("taskset"):
-                pin = ["taskset", "-c", str(i % max(1, os.cpu_count() or 1))]
-            api_url = base
-            extra: List[str] = []
-            if follower_urls:
-                # Reads from this shard's follower; siblings + the leader
-                # are reflector fallbacks (writes redirect regardless).
-                api_url = follower_urls[i % len(follower_urls)]
-                others = [u for u in follower_urls if u != api_url] + [base]
-                extra = ["--api-fallbacks", ",".join(others)]
-            return spawn_ready(
-                pin + [sys.executable, "-m", "kubernetes_tpu",
-                       "--api-url", api_url, "--platform", "cpu",
-                       "--port", "0",
-                       "--shard-index", str(i),
-                       "--shard-count", str(n_shards),
-                       "--shard-lease-duration", str(lease_duration)]
-                + extra,
-                _READY, cwd=repo, env=env, timeout=startup_timeout)
-
-        with ThreadPoolExecutor(max_workers=max(1, n_shards)) as ex:
-            spawned = list(ex.map(spawn_shard, range(n_shards)))
-    except BaseException:
-        for p in follower_procs:
-            p.terminate()
-        api_proc.terminate()
-        raise
-    procs = [p for p, _m in spawned]
-    urls = [f"http://127.0.0.1:{_m.group(1)}" for _p, _m in spawned]
-    return ShardedCluster(base, api_proc, procs, urls,
-                          follower_procs=follower_procs,
-                          follower_urls=follower_urls)
+    if spec is None:
+        spec = FleetSpec(shards=n_shards, shard_lease_s=lease_duration,
+                         data_dir=data_dir, flightrec_dir=flightrec_dir,
+                         startup_timeout_s=startup_timeout,
+                         replicas=replicas, repl_lease_s=repl_lease,
+                         fair_tenants=fair_tenants,
+                         apf_workload=apf_workload)
+    return ShardedCluster(FleetConductor(spec).start())
 
 
 def start_hollow_plane(base: str, profile, cwd: str, env: dict,
@@ -534,8 +434,13 @@ def run_sharded_cluster(
     replicas: int = 0,
     repl_lease: float = 2.0,
     hollow=None,
+    hollow_procs: int = 1,
+    mesh_devices: int = 0,
+    child_env: Optional[dict] = None,
+    node_lifecycle=None,
     flood=None,
     workload=None,
+    spec=None,
 ) -> dict:
     """The sharded SchedulingBasic shape end to end: create `n_nodes`,
     warm the shards with `warm_pods` (XLA compilation + first sessions land
@@ -578,37 +483,52 @@ def run_sharded_cluster(
 
     cap = node_capacity or {"cpu": 32, "memory": "256Gi", "pods": 110}
     req = pod_request or {"cpu": "100m", "memory": "128Mi"}
-    cluster = start_sharded_cluster(
-        n_shards, lease_duration=lease_duration,
-        flightrec_dir=flightrec_dir,
-        replicas=replicas, repl_lease=repl_lease,
-        fair_tenants=flood is not None,
-        # A tightened workload lane makes shedding demonstrable at
-        # test-box scale (stock lanes mostly ADMIT a paced flood — APF
-        # bounds concurrency, not rate) while leaving enough seats for
-        # the measured tenant's create/bind traffic; override via
-        # flood["apf_workload"].
-        apf_workload=(flood or {}).get("apf_workload", "4,8,4,2,0.5")
-        if flood is not None else "")
-    base = cluster.base
-    workload_procs: List = []
-    workload_tails: List = []
-    try:
-        if workload is not None:
+    if spec is None:
+        from ..fleet import FleetSpec
+        # One declarative spec for the whole process tree — the conductor
+        # owns bring-up order, readiness barriers, drained pipes, and
+        # per-role supervision (docs/SCALE.md § fleet conductor).
+        hollow_dict = None
+        if hollow is not None:
+            from ..hollow import HollowProfile
+            prof = (hollow if isinstance(hollow, HollowProfile)
+                    else HollowProfile.from_dict(dict(hollow)))
+            prof.count = n_nodes
+            if not prof.zones:
+                prof.zones = zones
+            hollow_dict = prof.to_dict()
+        spec = FleetSpec(
+            shards=n_shards, shard_lease_s=lease_duration,
+            mesh_devices=mesh_devices,
+            flightrec_dir=flightrec_dir,
+            replicas=replicas, repl_lease_s=repl_lease,
+            hollow=hollow_dict, hollow_procs=hollow_procs,
+            node_lifecycle=node_lifecycle,
             # HA workload controller-manager pair (or singleton): both
             # race the shared PUT-CAS lease; drained tails keep their
             # SIGTERM stats lines collectable at teardown.
-            from ..testing.faults import drain_pipe
-            for i in range(int(workload.get("managers", 2))):
-                wproc, _wurl = start_workload_manager(
-                    base, _repo_root(), _env(), identity=f"wm-{i}",
-                    fallbacks=cluster.follower_urls,
-                    lease_ttl=float(workload.get("lease_ttl", 2.0)),
-                    tick=float(workload.get("tick", 0.25)),
-                    autoscale=workload.get("autoscale"),
-                    trace=workload.get("trace"), timeout=timeout)
-                workload_procs.append(wproc)
-                workload_tails.append(drain_pipe(wproc))
+            workload=workload,
+            env=dict(child_env or {}),
+            fair_tenants=flood is not None,
+            # A tightened workload lane makes shedding demonstrable at
+            # test-box scale (stock lanes mostly ADMIT a paced flood — APF
+            # bounds concurrency, not rate) while leaving enough seats for
+            # the measured tenant's create/bind traffic; override via
+            # flood["apf_workload"].
+            apf_workload=(flood or {}).get("apf_workload", "4,8,4,2,0.5")
+            if flood is not None else "",
+            startup_timeout_s=max(timeout, 300.0))
+    else:
+        hollow = spec.hollow if spec.hollow is not None else hollow
+        workload = spec.workload
+        n_shards = spec.shards
+        replicas = spec.replicas
+        flightrec_dir = spec.flightrec_dir
+        if hollow is not None:
+            n_nodes = int(spec.hollow["count"])
+    cluster = start_sharded_cluster(n_shards, spec=spec)
+    base = cluster.base
+    try:
 
         def post_many(path: str, wires: List[dict], chunk: int = 200) -> None:
             """Bulk creates (JSON-array POST): one HTTP turnaround per
@@ -630,20 +550,10 @@ def run_sharded_cluster(
                                           timeout=120), cfg),
                     parts))
 
-        if hollow is not None:
-            # Hollow-node plane: the fleet is impersonated (registered +
-            # heartbeated + churned) by its own process for the whole
-            # run, not bulk-created inert.
-            from ..hollow import HollowProfile
-            prof = (hollow if isinstance(hollow, HollowProfile)
-                    else HollowProfile.from_dict(dict(hollow)))
-            prof.count = n_nodes
-            if not prof.zones:
-                prof.zones = zones
-            hproc, _registered = start_hollow_plane(
-                base, prof, _repo_root(), _env(), timeout=timeout)
-            cluster.attach_hollow(hproc)
-        else:
+        # Hollow fleets were registered during the conductor's bring-up
+        # (its hollow stage barrier: every member acknowledged its exact
+        # sub-range); inert fleets are bulk-created here.
+        if hollow is None:
             nodes = []
             for i in range(n_nodes):
                 b = make_node().name(f"node-{i}").capacity(dict(cap))
@@ -695,8 +605,10 @@ def run_sharded_cluster(
                 bound = poll_summary()["bound"]
                 # Peak-RSS sampling rides the existing poll cadence: the
                 # bounded-memory claim of the paged read plane is a
-                # sampled number in every detail line.
+                # sampled number in every detail line. The bound count
+                # feeds the conductor's throughput samples too.
                 cluster.sample_rss()
+                cluster.conductor.note_bound(bound)
                 if cb is not None:
                     cb(bound)
                 if bound >= target:
@@ -817,10 +729,8 @@ def run_sharded_cluster(
         pods = fetch_paged(base, "pods", limit=2000)
         bound = {p["uid"]: p["nodeName"] for p in pods if p["nodeName"]}
         hollow_stats = cluster.stop_hollow() if hollow is not None else None
-        workload_stats = None
-        if workload is not None:
-            workload_stats = [stop_controller(p, t) for p, t in
-                              zip(workload_procs, workload_tails)]
+        workload_stats = (cluster.conductor.stop_workload()
+                          if workload is not None else None)
         shard_metrics = []
         e2e_hists = []
         watch_decode = []
@@ -947,12 +857,21 @@ def run_sharded_cluster(
                             "apiserver_list_pages_total", 0)),
                         "listUnpaged": int(rm.get(
                             "apiserver_list_unpaged_total", 0)),
+                        # watch-plane health per replica: a relist means a
+                        # watcher fell off the cache ring and re-LISTed —
+                        # the 100k fusion row pins this to zero everywhere
+                        "relistedWatches": int(rm.get(
+                            "apiserver_relisted_watches_total", 0)),
                     })
                 except Exception:  # noqa: BLE001 - replica down
                     replication.append({"url": url, "role": -1})
         return {
             "shards": n_shards,
             "replicas": replicas,
+            # The conductor's consolidated line: stage timeline,
+            # per-member supervision state (restarts are NEVER silent),
+            # per-role RSS peaks, throughput window, artifact count.
+            "fleet": cluster.conductor.detail(),
             "replication": replication,
             "nodes": n_nodes,
             "pods": n_pods,
@@ -1009,11 +928,4 @@ def run_sharded_cluster(
                 for sm in shard_metrics],
         }
     finally:
-        for wproc in workload_procs:
-            if wproc.poll() is None:
-                wproc.terminate()
-                try:
-                    wproc.wait(timeout=10)
-                except Exception:  # noqa: BLE001
-                    wproc.kill()
         cluster.stop()

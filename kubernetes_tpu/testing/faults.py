@@ -230,24 +230,34 @@ def spawn_ready(cmd, pattern, cwd=None, env=None, timeout=120.0):
     line printed BEFORE the ready line that arrives in the same chunk
     would leave select() waiting on a drained fd."""
     import select
+    from collections import deque
 
     proc = subprocess.Popen(cmd, cwd=cwd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
     deadline = time.monotonic() + timeout
-    line = ""
+    # Keep the tail of everything read pre-ready: a child that dies before
+    # its ready line usually printed WHY (a traceback) — surfacing it here
+    # turns "exited rc=1" into an actionable failure.
+    tail: "deque" = deque(maxlen=40)
     while time.monotonic() < deadline:
         ready, _, _ = select.select([proc.stdout], [], [],
                                     max(0.0, deadline - time.monotonic()))
         if not ready:
             break
         line = proc.stdout.readline()
+        if line:
+            tail.append(line)
         if not line and proc.poll() is not None:
-            raise RuntimeError(f"{cmd[:3]} exited rc={proc.returncode}")
+            raise RuntimeError(
+                f"{cmd[:3]} exited rc={proc.returncode}; "
+                f"output tail:\n{''.join(tail)}")
         m = re.search(pattern, line)
         if m:
             return proc, m
     proc.kill()
-    raise TimeoutError(f"{cmd[:3]} never printed {pattern!r}: last={line!r}")
+    raise TimeoutError(
+        f"{cmd[:3]} never printed {pattern!r}; "
+        f"output tail:\n{''.join(tail)}")
 
 
 class ApiServerProcess:

@@ -1,0 +1,351 @@
+"""Fleet conductor (kubernetes_tpu/fleet/): the declarative many-process
+cluster (ISSUE 19).
+
+Units: FleetSpec roundtrip + validation; HollowProfile.split(n) is
+disjoint-and-complete over the absolute index space (the name-prefix
+ranges N hollow processes divide one profile by); the --name-prefix-range
+CLI flag registers exactly its sub-range.
+
+Integration (ONE amortized fleet: 1 leader + 1 follower + 2 shards + 2
+hollow members over a 40-node split profile, short shard lease): staged
+bring-up barriers, every pod bound exactly once, hollow kill9 → the
+supervisor respawns the member with --adopt and its exact range recovers
+with ZERO duplicate nodes, shard kill9 → left-to-adoption (the ring
+successor adopts the lease; the conductor must NOT respawn — that would
+race the adoption), the consolidated detail line, SIGUSR2 flight-record
+fan-out. Then the ``python -m kubernetes_tpu.fleet`` entrypoint drives a
+small fleet through the measured-pod path in-process.
+
+Tests in the integration class are ORDERED (chaos builds on the smoke
+state) — the module fixture is the amortization seam.
+"""
+
+import json
+import signal
+import sys
+import time
+
+import pytest
+
+from kubernetes_tpu.fleet import DEFAULT_RESTART, FleetConductor, FleetSpec
+from kubernetes_tpu.fleet.conductor import SIGUSR2_ROLES
+from kubernetes_tpu.hollow import HollowProfile
+from kubernetes_tpu.shard.harness import (_call, _env, _repo_root,
+                                          scrape_metrics)
+
+
+def _wait_true(cond, timeout=60.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# FleetSpec: roundtrip + validation
+# ---------------------------------------------------------------------------
+
+
+class TestFleetSpec:
+    def test_roundtrip_and_load(self, tmp_path):
+        spec = FleetSpec(name="rt", shards=3, shard_lease_s=4.0,
+                         mesh_devices=8, replicas=2,
+                         hollow={"count": 100, "zones": 4},
+                         hollow_procs=4,
+                         workload={"managers": 2},
+                         env={"X": "1"}, shard_env={"Y": "2"},
+                         restart=dict(DEFAULT_RESTART, hollow="never"))
+        path = tmp_path / "fleet.json"
+        path.write_text(json.dumps(spec.to_dict()))
+        got = FleetSpec.load(str(path)).validate()
+        assert got.to_dict() == spec.to_dict()
+        assert got.shards == 3 and got.hollow_procs == 4
+        assert got.restart["hollow"] == "never"
+        # unspecified roles keep their defaults through the merge
+        assert got.restart["shard"] == "adopt"
+        assert got.restart["apiserver"] == "never"
+
+    def test_from_dict_merges_restart_over_defaults(self):
+        got = FleetSpec.from_dict({"restart": {"hollow": "never"}})
+        assert got.restart["hollow"] == "never"
+        assert got.restart["controller"] == "restart"
+
+    @pytest.mark.parametrize("patch", [
+        {"shards": 0},
+        {"replicas": -1},
+        {"hollow_procs": 0},
+        {"mesh_devices": -2},
+        {"max_restarts": -1},
+        {"supervise_interval_s": 0.0},
+        {"restart": {"hollow": "pray"}},
+        {"hollow": {"count": 0}},
+        {"hollow": {"count": 4}, "hollow_procs": 8},
+        {"workload": {"managers": 0}},
+    ])
+    def test_validate_rejects(self, patch):
+        base = {"hollow": {"count": 16}}
+        base.update(patch)
+        with pytest.raises(ValueError):
+            FleetSpec.from_dict(base).validate()
+
+
+# ---------------------------------------------------------------------------
+# HollowProfile.split(n): disjoint and complete
+# ---------------------------------------------------------------------------
+
+
+class TestProfileSplit:
+    @pytest.mark.parametrize("count,n", [
+        (40, 2), (41, 3), (5, 5), (10, 1), (7, 16), (100, 8)])
+    def test_split_is_disjoint_and_complete(self, count, n):
+        prof = HollowProfile.from_dict(
+            {"count": count, "zones": 4, "churn_per_s": 2.0})
+        subs = prof.split(n)
+        assert len(subs) == min(n, count)
+        covered = []
+        for sub in subs:
+            assert sub.total == count          # absolute-space marker
+            assert sub.count == len(sub.index_range())
+            covered.extend(sub.index_range())
+        # disjoint AND complete: the concatenated ranges ARE 0..count-1
+        assert covered == list(range(count))
+        # churn divides proportionally — the fleet's aggregate rate is
+        # the profile's rate regardless of member count
+        assert sum(s.churn_per_s for s in subs) == pytest.approx(2.0)
+
+    def test_resplit_preserves_absolute_indices(self):
+        prof = HollowProfile.from_dict({"count": 40, "zones": 4})
+        right = prof.split(2)[1]            # offsets 20..39
+        nested = right.split(2)
+        assert [list(s.index_range()) for s in nested] == [
+            list(range(20, 30)), list(range(30, 40))]
+        assert all(s.total == 40 for s in nested)
+
+    def test_name_prefix_range_flag_registers_exact_subrange(self, tmp_path):
+        """--name-prefix-range START:END on the hollow CLI: the plane
+        registers exactly nodes prefix-START..prefix-(END-1), announcing
+        the sub-range count on its ready line."""
+        from kubernetes_tpu.core.apiserver import APIServer
+        from kubernetes_tpu.testing.faults import drain_pipe, spawn_ready
+
+        api = APIServer()
+        port = api.serve(0)
+        base = f"http://127.0.0.1:{port}"
+        prof = tmp_path / "prof.json"
+        prof.write_text(json.dumps(
+            {"count": 30, "name_prefix": "hx", "zones": 3,
+             "heartbeat_s": 60.0}))
+        proc = None
+        try:
+            proc, m = spawn_ready(
+                [sys.executable, "-m", "kubernetes_tpu.hollow",
+                 "--api-url", base, "--profile", str(prof),
+                 "--name-prefix-range", "10:20"],
+                r"registered (\d+) nodes", cwd=_repo_root(), env=_env(),
+                timeout=120)
+            drain_pipe(proc)
+            assert int(m.group(1)) == 10
+            from kubernetes_tpu.core.apiserver import fetch_paged
+            names = {w["name"] for w in fetch_paged(base, "nodes")}
+            assert names == {f"hx-{i}" for i in range(10, 20)}
+        finally:
+            if proc is not None:
+                proc.terminate()
+                proc.wait(timeout=15)
+            api.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the amortized fleet: smoke + chaos + detail
+# ---------------------------------------------------------------------------
+
+
+N_NODES = 40
+N_PODS = 60
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    flight = tmp_path_factory.mktemp("flightrec")
+    spec = FleetSpec(
+        name="t1-smoke", shards=2, shard_lease_s=2.0, replicas=1,
+        hollow={"count": N_NODES, "zones": 4, "heartbeat_s": 30.0,
+                "churn_per_s": 1.0, "churn_cordon_s": 0.2},
+        hollow_procs=2, supervise_interval_s=0.25,
+        flightrec_dir=str(flight), startup_timeout_s=300.0)
+    conductor = FleetConductor(spec).start()
+    yield conductor
+    conductor.stop()
+
+
+def _slot(name: str):
+    """Absolute slot index of a hollow node name: 'hollow-7' and its
+    replacement generations 'hollow-7r2' both map to 7."""
+    tail = name.split("-", 1)[1]
+    if tail.isdigit():
+        return int(tail)
+    slot, _, gen = tail.partition("r")
+    return int(slot) if slot.isdigit() and gen.isdigit() else None
+
+
+class TestFleetIntegration:
+    def test_staged_bringup_barriers(self, fleet):
+        assert [s["stage"] for s in fleet.stages] == [
+            "leader", "followers", "shards", "hollow"]
+        assert len(fleet.members_of("apiserver")) == 1
+        assert len(fleet.members_of("follower")) == 1
+        assert len(fleet.members_of("shard")) == 2
+        assert len(fleet.members_of("hollow")) == 2
+        assert all(m.alive() for m in fleet.members)
+        # the hollow barrier: members acknowledged their EXACT sub-ranges
+        assert [m.registered
+                for m in fleet.members_of("hollow")] == [20, 20]
+        # the shards-leased barrier held: every slot owned at stage exit
+        owned = sum(scrape_metrics(u).get("scheduler_shard_owned_shards",
+                                          0.0) for u in fleet.shard_urls)
+        assert owned >= 2
+
+    def test_all_pods_bind_exactly_once(self, fleet):
+        from kubernetes_tpu.core.apiserver import fetch_paged, pod_to_wire
+        from kubernetes_tpu.testing.wrappers import make_pod
+
+        proto = make_pod().name("proto").req(
+            {"cpu": "100m", "memory": "64Mi"}).labels(
+            {"app": "fleet-smoke"}).obj()
+        wires = [pod_to_wire(proto.clone_from_template(f"smoke-{i}"))
+                 for i in range(N_PODS)]
+        _call(fleet.base, "POST", "/api/v1/pods", wires, timeout=120)
+
+        def bound():
+            s = _call(fleet.base, "GET", "/api/v1/pods?summary=true")
+            fleet.note_bound(int(s["bound"]))
+            return s["bound"] >= N_PODS
+        assert _wait_true(bound, timeout=120), "pods never all bound"
+        # exactly-once: one store object per pod name, each bound once.
+        # Paged sweep — a full-list GET would itself trip the unpaged
+        # counter asserted below.
+        pods = [w for w in fetch_paged(fleet.base, "pods")
+                if w["name"].startswith("smoke-")]
+        assert len(pods) == N_PODS
+        assert len({w["name"] for w in pods}) == N_PODS
+        assert all(w.get("nodeName") for w in pods)
+        # the paged-plane contract holds on leader AND follower
+        for url in [fleet.base] + fleet.follower_urls:
+            m = scrape_metrics(url)
+            assert m.get("apiserver_list_unpaged_total", 0.0) == 0.0, url
+            assert m.get("apiserver_relisted_watches_total", 0.0) == 0.0, url
+
+    def test_hollow_kill9_supervised_restart_same_range(self, fleet):
+        victim = fleet.members_of("hollow")[1]
+        victim.proc.send_signal(signal.SIGKILL)
+        victim.proc.wait()
+        assert _wait_true(lambda: victim.restarts >= 1 and victim.alive(),
+                          timeout=90), "supervisor never respawned member"
+        assert any(e["member"] == victim.name
+                   and e["action"] == "restarted"
+                   for e in fleet.events)
+        time.sleep(2.0)  # churn keeps replacing nodes post-restart
+
+        def census_whole():
+            from kubernetes_tpu.core.apiserver import fetch_paged
+            names = [w["name"]
+                     for w in fetch_paged(fleet.base, "nodes")]
+            slots = sorted(_slot(n) for n in names)
+            return len(names) == N_NODES and slots == list(range(N_NODES))
+        # zero duplicates, zero holes: the EXACT range recovered
+        assert _wait_true(census_whole, timeout=60), \
+            "hollow range did not recover exactly"
+
+    def test_shard_kill9_left_to_adoption_not_respawned(self, fleet):
+        victim = fleet.members_of("shard")[1]
+        survivor = fleet.members_of("shard")[0]
+        victim.proc.send_signal(signal.SIGKILL)
+        victim.proc.wait()
+        assert _wait_true(
+            lambda: any(e["member"] == victim.name
+                        and e["action"] == "left-to-adoption"
+                        for e in fleet.events), timeout=60)
+        # the conductor did NOT respawn (that would race lease adoption)
+        assert victim.restarts == 0 and not victim.alive()
+        # the ring successor adopts the dead shard's slot (2s lease)
+        assert _wait_true(
+            lambda: scrape_metrics(survivor.url).get(
+                "scheduler_shard_owned_shards", 0.0) >= 2, timeout=60), \
+            "survivor never adopted the dead shard's lease"
+        # and the plane still binds: exactly-once holds across the loss
+        from kubernetes_tpu.core.apiserver import fetch_paged, pod_to_wire
+        from kubernetes_tpu.testing.wrappers import make_pod
+        proto = make_pod().name("proto2").req(
+            {"cpu": "100m", "memory": "64Mi"}).labels(
+            {"app": "post-adopt"}).obj()
+        _call(fleet.base, "POST", "/api/v1/pods",
+              [pod_to_wire(proto.clone_from_template(f"adopt-{i}"))
+               for i in range(20)], timeout=120)
+
+        def adopted_bound():
+            pods = [w for w in fetch_paged(fleet.base, "pods")
+                    if w["name"].startswith("adopt-")]
+            return (len(pods) == 20
+                    and all(w.get("nodeName") for w in pods))
+        assert _wait_true(adopted_bound, timeout=120)
+
+    def test_consolidated_detail_schema(self, fleet):
+        d = fleet.detail()
+        assert d["name"] == "t1-smoke"
+        assert [s["stage"] for s in d["stages"]] == [
+            "leader", "followers", "shards", "hollow"]
+        assert all(set(s) == {"stage", "elapsed_s", "members"}
+                   for s in d["stages"])
+        for m in d["members"]:
+            assert {"name", "role", "index", "pid", "alive", "url",
+                    "restarts", "rss_peak_mb"} <= set(m)
+        rss = d["rss_mb"]
+        assert rss["apiserver"] > 0
+        assert len(rss["shards"]) == 2 and len(rss["followers"]) == 1
+        assert rss["hollow"] > 0 and len(rss["hollow_members"]) == 2
+        # the supervision ledger is consolidated, never silent
+        assert d["restarts"] >= 1
+        actions = {e["action"] for e in d["events"]}
+        assert {"restarted", "left-to-adoption"} <= actions
+        # throughput window from the bind test's note_bound samples
+        assert d["throughput"] is not None
+        assert d["throughput"]["bound"] >= N_PODS
+        assert isinstance(d["flightrec_artifacts"], int)
+
+    def test_sigusr2_fanout_hits_handler_roles_only(self, fleet):
+        live_targets = [m for m in fleet.members
+                        if m.role in SIGUSR2_ROLES and m.alive()]
+        assert fleet.signal_flightrec() == len(live_targets)
+        # flight records actually land (apiserver/follower/shard dumps)
+        assert _wait_true(lambda: len(fleet.artifacts()) >= 1, timeout=30)
+        time.sleep(0.3)
+        assert all(m.alive() for m in live_targets), \
+            "SIGUSR2 killed a member that should have a handler"
+
+
+# ---------------------------------------------------------------------------
+# the entrypoint: python -m kubernetes_tpu.fleet --spec ... --pods N
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_entrypoint_drives_measured_pods(tmp_path, capsys):
+    from kubernetes_tpu.fleet.__main__ import main
+
+    spec = FleetSpec(
+        name="entry", shards=1,
+        hollow={"count": 24, "zones": 4, "heartbeat_s": 30.0},
+        startup_timeout_s=300.0)
+    path = tmp_path / "fleet.json"
+    path.write_text(json.dumps(spec.to_dict()))
+    rc = main(["--spec", str(path), "--pods", "24", "--warm", "8",
+               "--timeout", "600"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["all_bound"] is True
+    assert out["distinct_bound_pods"] == 24 + 8
+    # the consolidated fleet detail rides the result line
+    assert out["fleet"]["name"] == "entry"
+    assert [s["stage"] for s in out["fleet"]["stages"]] == [
+        "leader", "shards", "hollow"]

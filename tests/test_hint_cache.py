@@ -643,3 +643,81 @@ def test_churn_equivalence_fuzz(seed):
                 for i in range(n)])
     _assert_identical(oracle, dev, ctx=f"(seed {seed})")
     assert dev.hint_hits > 0, "fuzz never engaged the hint path"
+
+
+class TestHintLru:
+    """The 2-way signature-keyed LRU (ISSUE 19 satellite): alternating
+    deployment shapes keep BOTH on the host path; TPU_SCHED_HINT_LRU=1 is
+    the single-slot A/B baseline. Exactness is non-negotiable either way —
+    every scenario holds the always-dispatch oracle equivalence."""
+
+    def test_two_shapes_alternate_without_thrash(self):
+        """Two replica shapes interleaving through one queue bind with
+        ZERO device dispatches after seeding — the single-slot cache would
+        thrash (each shape evicting the other every pod). Cross-entry
+        coherence rides along: both entries place onto the SAME nodes, so
+        any stale sibling row would diverge from the oracle here."""
+        oracle, dev = _pair()
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"seed-a-{i}", cpu="200m")) for i in range(6)])
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"seed-b-{i}", cpu="400m")) for i in range(6)])
+        assert len(dev._hints.entries) == 2
+        b0, h0 = dev.device_batches, dev.hint_hits
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"alt-{i}", cpu=("200m" if i % 2 == 0 else "400m")))
+            for i in range(40)])
+        _assert_identical(oracle, dev)
+        assert dev.device_batches == b0, "alternating shapes thrashed"
+        assert dev.hint_hits - h0 >= 40
+
+    def test_lru_capacity_one_is_the_single_slot_baseline(self, monkeypatch):
+        """TPU_SCHED_HINT_LRU=1 (the A/B seam): the second shape's install
+        evicts the first (counted, labeled lru_evict) and only one entry is
+        ever live — the historical behavior, still oracle-exact."""
+        monkeypatch.setenv("TPU_SCHED_HINT_LRU", "1")
+        oracle, dev = _pair()
+        assert dev._hints.capacity == 1
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"seed-a-{i}", cpu="200m")) for i in range(6)])
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"seed-b-{i}", cpu="400m")) for i in range(6)])
+        assert len(dev._hints.entries) == 1
+        assert dev.metrics.hint_cache_invalidations.value("lru_evict") >= 1
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"alt-{i}", cpu=("200m" if i % 2 == 0 else "400m")))
+            for i in range(20)])
+        _assert_identical(oracle, dev)
+
+    def test_third_shape_evicts_coldest(self):
+        """At capacity 2 a third shape pushes out the least-recently-used
+        entry; the two survivors keep serving dispatch-free."""
+        oracle, dev = _pair()
+        for shape, cpu in (("a", "200m"), ("b", "400m"), ("c", "600m")):
+            _both(oracle, dev, lambda s, shape=shape, cpu=cpu: [
+                s.clientset.create_pod(_pod(f"seed-{shape}-{i}", cpu=cpu))
+                for i in range(6)])
+        assert len(dev._hints.entries) == 2
+        assert dev.metrics.hint_cache_invalidations.value("lru_evict") >= 1
+        b0 = dev.device_batches
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"rep-c-{i}", cpu="600m")) for i in range(10)])
+        _assert_identical(oracle, dev)
+        assert dev.device_batches == b0
+
+    def test_conflict_blocks_row_on_every_entry(self):
+        """Bind-409 semantics under the LRU: the conflicted NODE is blocked
+        on every live entry (each one's view understates the winner's
+        usage), and every entry survives with just that row fenced."""
+        oracle, dev = _pair()
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"seed-a-{i}", cpu="200m")) for i in range(6)])
+        _both(oracle, dev, lambda s: [s.clientset.create_pod(
+            _pod(f"seed-b-{i}", cpu="400m")) for i in range(6)])
+        es = list(dev._hints.entries)
+        assert len(es) == 2
+        dev._hints.note_conflict("node-3")
+        assert len(dev._hints.entries) == 2
+        for e in es:
+            row = e.row_of["node-3"]
+            assert e.blocked[row] and not e.ok[row]

@@ -1018,7 +1018,8 @@ def test_every_checker_registered_and_described():
     assert ids == ["eviction-discipline", "hint-freshness", "index-dtype",
                    "jit-purity", "lock-discipline", "metrics-discipline",
                    "reconcile-discipline", "sharding-discipline",
-                   "shed-discipline", "span-discipline", "thread-hygiene",
+                   "shed-discipline", "span-discipline",
+                   "supervision-discipline", "thread-hygiene",
                    "wire-discipline"]
     assert all(c.description for c in checkers)
 
@@ -1454,3 +1455,95 @@ def test_cli_seeded_naked_delete_exits_nonzero(tmp_path):
     report = json.loads(proc.stdout)
     rules = {(f["checker"], f["rule"]) for f in report["findings"]}
     assert ("eviction-discipline", "eviction-outside-funnel") in rules
+
+
+class TestSupervisionDisciplineFixtures:
+    """fleet/ child spawn sites must sit on a call-graph slice holding
+    BOTH a readiness barrier and drain_pipe wiring (ISSUE 19: a spawn
+    without the barrier races the staged bring-up; without the drain, a
+    chatty child wedges on a full 64KB pipe — the PR-8 stall class)."""
+
+    def test_flags_naked_popen_both_rules(self):
+        bad = textwrap.dedent("""
+            import subprocess
+
+            class Conductor:
+                def launch(self, cmd):
+                    return subprocess.Popen(cmd)
+        """)
+        fs = check_source(checker_by_id("supervision-discipline"), bad,
+                          "kubernetes_tpu/fleet/conductor.py")
+        rules = {f.rule for f in fs}
+        assert rules == {"spawn-no-barrier", "spawn-no-drain"}
+
+    def test_flags_spawn_ready_without_drain(self):
+        """spawn_ready IS the readiness barrier (it blocks on the child's
+        first ready line) — but the drain still has to be wired."""
+        bad = textwrap.dedent("""
+            from ..testing.faults import spawn_ready
+
+            class Conductor:
+                def launch(self, member):
+                    member.proc = spawn_ready(member.cmd, member.pattern)
+        """)
+        fs = check_source(checker_by_id("supervision-discipline"), bad,
+                          "kubernetes_tpu/fleet/conductor.py")
+        assert {f.rule for f in fs} == {"spawn-no-drain"}
+
+    def test_passes_full_discipline_in_one_def(self):
+        good = textwrap.dedent("""
+            from ..testing.faults import drain_pipe, spawn_ready
+
+            class Conductor:
+                def launch(self, member):
+                    member.proc = spawn_ready(member.cmd, member.pattern)
+                    member.tail = drain_pipe(member.proc)
+        """)
+        assert check_source(checker_by_id("supervision-discipline"), good,
+                            "kubernetes_tpu/fleet/conductor.py") == []
+
+    def test_passes_barrier_one_frame_above_the_spawn(self):
+        """The start → _start_shards → _spawn shape: a raw Popen in a
+        helper is covered when a caller's slice holds the lease barrier
+        and the drain wiring."""
+        good = textwrap.dedent("""
+            import subprocess
+
+            class Conductor:
+                def _spawn(self, cmd):
+                    proc = subprocess.Popen(cmd)
+                    self._tails.append(drain_pipe(proc))
+                    return proc
+
+                def start_shards(self):
+                    for cmd in self.cmds:
+                        self._spawn(cmd)
+                    self._wait_shards_leased()
+
+                def _wait_shards_leased(self):
+                    pass
+        """)
+        assert check_source(checker_by_id("supervision-discipline"), good,
+                            "kubernetes_tpu/fleet/conductor.py") == []
+
+    def test_scope_is_fleet_only(self):
+        ck = checker_by_id("supervision-discipline")
+        assert ck.applies_to("kubernetes_tpu/fleet/conductor.py")
+        assert ck.applies_to("fleet/__main__.py")
+        assert not ck.applies_to("kubernetes_tpu/shard/harness.py")
+        assert not ck.applies_to("kubernetes_tpu/testing/faults.py")
+        assert not ck.applies_to("tests/test_fleet.py")
+
+    def test_real_conductor_module_is_clean(self):
+        import inspect
+
+        import kubernetes_tpu.fleet.conductor as cond
+        src = inspect.getsource(cond)
+        assert check_source(checker_by_id("supervision-discipline"), src,
+                            "kubernetes_tpu/fleet/conductor.py") == []
+
+    def test_lock_discipline_scope_covers_fleet(self):
+        """Satellite: the lock-discipline scan walks fleet/ too — a sleep
+        under a held lock in the conductor must flag."""
+        ck = checker_by_id("lock-discipline")
+        assert ck.applies_to("kubernetes_tpu/fleet/conductor.py")

@@ -184,6 +184,107 @@ class TestPDBPrecondition:
         assert ev.run_once() == 1
         assert ev.evictions_total == 1
 
+    # -- budget arithmetic: maxUnavailable + percentage forms (ISSUE 19) --
+
+    def test_max_unavailable_int_budget(self, plane):
+        """maxUnavailable=1 over 5 bound: exactly one eviction commits;
+        the denial payload names the resolved ceiling and the census."""
+        _api, cs = plane
+        _add_node(cs)
+        self._seed(cs, n=5)
+        cs.create_workload("pdbs", {"name": "web-pdb", "maxUnavailable": 1,
+                                    "matchLabels": {"app": "web"}})
+        time.sleep(0.1)
+        assert cs.evict_pod("w0", "n1", "i-1").get("evicted") is True
+        with pytest.raises(HTTPError) as ei:
+            cs.evict_pod("w1", "n1", "i-2")
+        assert ei.value.code == 429
+        # an evicted pod is UNBOUND, not deleted (it re-queues for
+        # rescheduling), so the matched census still counts it
+        body = ei.value.read().decode()
+        assert '"maxUnavailable":1' in body and '"matched":5' in body
+
+    def test_min_available_percentage_rounds_up(self, plane):
+        """minAvailable='60%' over 5 matched resolves to ceil(3.0)=3:
+        exactly two evictions commit (4>=3, 3>=3) and the third would dip
+        the bound count to 2 < 3."""
+        _api, cs = plane
+        _add_node(cs)
+        self._seed(cs, n=5)
+        cs.create_workload("pdbs", {"name": "web-pdb",
+                                    "minAvailable": "60%",
+                                    "matchLabels": {"app": "web"}})
+        time.sleep(0.1)
+        assert cs.evict_pod("w0", "n1", "i-1").get("evicted") is True
+        assert cs.evict_pod("w1", "n1", "i-2").get("evicted") is True
+        with pytest.raises(HTTPError) as ei:
+            cs.evict_pod("w2", "n1", "i-3")
+        assert ei.value.code == 429
+        assert '"minAvailable":3' in ei.value.read().decode()
+
+    def test_max_unavailable_percentage_rounds_down(self, plane):
+        """maxUnavailable='30%' over 8 matched resolves to floor(2.4)=2 —
+        the conservative direction (never disrupt MORE than the share):
+        two evictions commit, the third answers 429."""
+        _api, cs = plane
+        _add_node(cs)
+        self._seed(cs, n=8)
+        cs.create_workload("pdbs", {"name": "web-pdb",
+                                    "maxUnavailable": "30%",
+                                    "matchLabels": {"app": "web"}})
+        time.sleep(0.1)
+        assert cs.evict_pod("w0", "n1", "i-1").get("evicted") is True
+        assert cs.evict_pod("w1", "n1", "i-2").get("evicted") is True
+        with pytest.raises(HTTPError) as ei:
+            cs.evict_pod("w2", "n1", "i-3")
+        assert ei.value.code == 429
+
+    def test_percentage_base_counts_unbound_matched_pods(self, plane):
+        """The percent base is the full matched census (the workload's
+        size), not just bound pods: 4 bound + 2 pending matched pods with
+        minAvailable='50%' resolve the floor to ceil(3.0)=3 over 6 — one
+        eviction commits (3>=3), the second dips to 2 and is denied. The
+        evicted pod stays in the census (unbound, requeued), so the base
+        holds at 6 throughout."""
+        _api, cs = plane
+        _add_node(cs)
+        self._seed(cs, n=4)
+        for i in range(2):
+            cs.create_pod(Pod(name=f"pend{i}", uid=f"pend{i}",
+                              labels={"app": "web"}))
+        cs.create_workload("pdbs", {"name": "web-pdb",
+                                    "minAvailable": "50%",
+                                    "matchLabels": {"app": "web"}})
+        time.sleep(0.1)
+        assert cs.evict_pod("w0", "n1", "i-1").get("evicted") is True
+        with pytest.raises(HTTPError) as ei:
+            cs.evict_pod("w1", "n1", "i-2")
+        assert ei.value.code == 429
+        body = ei.value.read().decode()
+        assert '"matched":6' in body and '"minAvailable":3' in body
+
+    def test_both_budget_forms_must_pass(self, plane):
+        """minAvailable AND maxUnavailable on one PDB: the stricter form
+        gates. 6 bound, minAvailable=1, maxUnavailable=2: the third
+        eviction passes the minAvailable floor (3>=1) but breaches
+        maxUnavailable (3 < 6-2) and is denied — and the voluntary-delete
+        path enforces the same arithmetic."""
+        _api, cs = plane
+        _add_node(cs)
+        self._seed(cs, n=6)
+        cs.create_workload("pdbs", {"name": "web-pdb", "minAvailable": 1,
+                                    "maxUnavailable": 2,
+                                    "matchLabels": {"app": "web"}})
+        time.sleep(0.1)
+        assert cs.evict_pod("w0", "n1", "i-1").get("evicted") is True
+        assert cs.evict_pod("w1", "n1", "i-2").get("evicted") is True
+        with pytest.raises(HTTPError) as ei:
+            cs.evict_pod("w2", "n1", "i-3")
+        assert ei.value.code == 429
+        with pytest.raises(HTTPError) as ei:
+            cs.delete_pod_voluntary("w2")
+        assert ei.value.code == 429
+
 
 # ---------------------------------------------------------------------------
 # ReplicaSet / Deployment reconcile (single ACTIVE manager, in-process)
