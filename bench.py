@@ -111,9 +111,9 @@ def make_pods(n, name_prefix):
 
 
 def main_sharded(n_shards: int, trace: bool = False,
-                 replicas: int = 0) -> None:
-    """`bench.py --shards N [--trace] [--replicas R]`: the same
-    SchedulingBasic shape through the multi-process shard plane
+                 replicas: int = 0, deschedule: bool = False) -> None:
+    """`bench.py --shards N [--trace] [--replicas R] [--deschedule]`: the
+    same SchedulingBasic shape through the multi-process shard plane
     (kubernetes_tpu/shard/harness.py) — one apiserver process + N scheduler
     processes over HTTP. N=1 is the like-for-like single-scheduler baseline
     (same transport, same store); the acceptance comparison is N=2 vs N=1
@@ -123,7 +123,11 @@ def main_sharded(n_shards: int, trace: bool = False,
     (docs/OBSERVABILITY.md). With --replicas R, R follower apiservers tail
     the leader's WAL and serve each shard's read plane
     (kubernetes_tpu/replication/); the detail line carries per-replica
-    role/lag and the leader's replication counters."""
+    role/lag and the leader's replication counters. With --deschedule, an
+    HA descheduler pair rides the run (docs/DESCHEDULE.md) and the detail
+    line carries each manager's final stats — moves by strategy,
+    blocked-by-reason, what-if batch timings — next to the apiserver's
+    eviction counters (the "api" filter includes eviction series)."""
     import tempfile
 
     from kubernetes_tpu.shard.harness import run_sharded_cluster
@@ -141,6 +145,9 @@ def main_sharded(n_shards: int, trace: bool = False,
     out = run_sharded_cluster(
         n_shards, n_nodes, n_pods, warm_pods=warmup,
         flightrec_dir=flightrec_dir, replicas=replicas,
+        deschedule={"managers": 2} if deschedule else None,
+        settle_s=(float(os.environ.get("BENCH_SETTLE_S", 10.0))
+                  if deschedule else 0.0),
         # 15s, not the chaos tests' 2-3s: the renewer is a Python thread,
         # and on an oversubscribed box (N shards + apiserver on few cores)
         # a tight lease flaps — a starved renewer misses one period, a peer
@@ -168,6 +175,11 @@ def main_sharded(n_shards: int, trace: bool = False,
     if replicas:
         detail["replicas"] = out["replicas"]
         detail["replication"] = out["replication"]
+    if deschedule:
+        # Descheduler manager final stats (per process): moves_total by
+        # strategy, moves_blocked by reason (pdb/budget/gang/hysteresis),
+        # what-if batch count + seconds, final utilization stddev.
+        detail["deschedule"] = out.get("deschedule")
     detail["shard_metrics"] = out["shard_metrics"]
     # Peak per-process RSS (MiB), sampled by the harness poll loop — the
     # paged read plane's bounded-memory claim as a number.
@@ -327,6 +339,7 @@ if __name__ == "__main__":
         _replicas = (int(sys.argv[sys.argv.index("--replicas") + 1])
                      if "--replicas" in sys.argv else 0)
         main_sharded(int(sys.argv[sys.argv.index("--shards") + 1]),
-                     trace=_trace, replicas=_replicas)
+                     trace=_trace, replicas=_replicas,
+                     deschedule="--deschedule" in sys.argv)
         sys.exit(0)
     main(trace=_trace)

@@ -90,12 +90,12 @@ def register(cls: Type[Checker]) -> Type[Checker]:
 
 def all_checkers() -> List[Checker]:
     # Import the checker modules for their registration side effect.
-    from . import (eviction_discipline, hint_freshness,  # noqa: F401
-                   index_dtype, jit_purity, lock_discipline,
-                   metrics_discipline, reconcile_discipline,
-                   shed_discipline, sharding_discipline,
-                   span_discipline, supervision_discipline,
-                   thread_hygiene, wire_discipline)
+    from . import (deschedule_discipline, eviction_discipline,  # noqa: F401
+                   hint_freshness, index_dtype, jit_purity,
+                   lock_discipline, metrics_discipline,
+                   reconcile_discipline, shed_discipline,
+                   sharding_discipline, span_discipline,
+                   supervision_discipline, thread_hygiene, wire_discipline)
     return [cls() for _, cls in sorted(_REGISTRY.items())]
 
 

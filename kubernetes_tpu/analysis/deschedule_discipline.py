@@ -1,0 +1,150 @@
+"""deschedule-discipline checker: descheduler moves are scored and intended.
+
+Incident class (ISSUE 20): the descheduler's whole value proposition is
+that it only *improves* placements. An eviction call site in a
+descheduler module that is not downstream of the scored-improvement
+gate is a churn generator — it will happily evict a pod into an equal
+or worse seat, and two near-balanced nodes will trade the same pod
+forever (the ping-pong the hysteresis floor exists to break). And a
+move emitted without the deterministic intent record breaks the
+standby-replay contract: a takeover mid-wave re-plans the wave, and
+only identical ``uid@node`` intents let the apiserver ledger absorb the
+duplicates.
+
+Rule ``move-without-scored-gate``: in a descheduler module under
+``controllers/``, every function that emits an eviction — the funnel
+verbs ``.enqueue(...)`` / ``.evict_pod(...)`` / ``.delete_pod(...)`` —
+must sit on a same-module call-graph slice that contains BOTH
+
+- the scored-improvement gate (``clears_hysteresis(...)``), and
+- the deterministic intent source (``intent_for(...)``).
+
+This COMPOSES with ``eviction-discipline`` (which covers all of
+``controllers/``): the funnel checker guarantees evictions are
+throttled and idempotent; this one guarantees a descheduler's are also
+*justified by score*. Slice semantics are identical (own def, callee
+closure, or a caller whose closure holds both the call site and the
+sinks — the ``reconcile_once → _emit`` shape, where the gate runs one
+frame above the intent stamp).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .base import Checker, Finding, ModuleSource, attr_chain, register
+
+SCOPE_DIR = "controllers/"
+
+EMIT_VERBS = {"enqueue", "evict_pod", "delete_pod"}
+GATE_SINKS = {"clears_hysteresis"}
+INTENT_SINKS = {"intent_for"}
+
+
+def _fn_facts(fn: ast.AST) -> Tuple[List[int], bool, bool, Set[str]]:
+    """(emit-call linenos, has_gate, has_intent, same-module callee names)
+    for one def."""
+    emits: List[int] = []
+    has_gate = False
+    has_intent = False
+    calls: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in EMIT_VERBS:
+                emits.append(node.lineno)
+            if func.attr in GATE_SINKS:
+                has_gate = True
+            if func.attr in INTENT_SINKS:
+                has_intent = True
+        elif isinstance(func, ast.Name):
+            if func.id in GATE_SINKS:
+                has_gate = True
+            if func.id in INTENT_SINKS:
+                has_intent = True
+        chain = attr_chain(func)
+        if chain and (len(chain) == 1
+                      or (len(chain) == 2 and chain[0] == "self")):
+            calls.add(chain[-1])
+    return emits, has_gate, has_intent, calls
+
+
+@register
+class DescheduleDisciplineChecker(Checker):
+    id = "deschedule-discipline"
+    description = ("descheduler eviction call sites stay on a call-graph "
+                   "slice containing both the scored-improvement gate "
+                   "(clears_hysteresis) and the deterministic intent "
+                   "source (intent_for)")
+
+    def applies_to(self, relpath: str) -> bool:
+        in_scope = (relpath.startswith(SCOPE_DIR)
+                    or ("/" + SCOPE_DIR) in relpath)
+        name = relpath.rsplit("/", 1)[-1]
+        return in_scope and "deschedul" in name
+
+    def check(self, mod: ModuleSource) -> List[Finding]:
+        tree = mod.tree
+        if tree is None:
+            return []
+        defs: List[Tuple[str, List[int], bool, bool, Set[str]]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.append((node.name, *_fn_facts(node)))
+        name_gate: Dict[str, bool] = {}
+        name_intent: Dict[str, bool] = {}
+        name_calls: Dict[str, Set[str]] = {}
+        for name, _e, gate, intent, calls in defs:
+            name_gate[name] = name_gate.get(name, False) or gate
+            name_intent[name] = name_intent.get(name, False) or intent
+            name_calls.setdefault(name, set()).update(calls)
+        reach_memo: Dict[str, Set[str]] = {}
+
+        def reach(name: str) -> Set[str]:
+            got = reach_memo.get(name)
+            if got is not None:
+                return got
+            reach_memo[name] = out = set()
+            stack = [name]
+            while stack:
+                for callee in name_calls.get(stack.pop(), ()):
+                    if callee not in out and callee in name_calls:
+                        out.add(callee)
+                        stack.append(callee)
+            return out
+
+        def slice_ok(names: Set[str]) -> bool:
+            return (any(name_gate.get(n, False) for n in names)
+                    and any(name_intent.get(n, False) for n in names))
+
+        def def_covered(name: str, calls: Set[str]) -> bool:
+            down = {name}
+            for c in calls:
+                if c in name_calls:
+                    down.add(c)
+                    down |= reach(c)
+            if slice_ok(down):
+                return True
+            for g, _e, _g2, _i, _c in defs:
+                gr = reach(g)
+                if name in gr and slice_ok(gr | {g}):
+                    return True
+            return False
+
+        out: List[Finding] = []
+        for name, emits, _gate, _intent, calls in defs:
+            if not emits or def_covered(name, calls):
+                continue
+            for line in emits:
+                out.append(Finding(
+                    self.id, "move-without-scored-gate", mod.path, line,
+                    f"{name}() emits a descheduler eviction but no "
+                    "call-graph slice through it clears the scored-"
+                    "improvement gate (clears_hysteresis) AND mints the "
+                    "deterministic intent (intent_for) — an unjustified "
+                    "move: churn instead of repair, and unreplayable "
+                    "across a takeover"))
+        return out

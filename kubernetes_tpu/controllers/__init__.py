@@ -8,14 +8,26 @@ Residents:
 - workload controller-manager — ReplicaSet/Deployment reconcile +
   rolling updates, gang lifecycle over PodGroups, cluster autoscaler,
   Borg-style trace-profile feed, all behind one HA PUT-CAS lease
-  (docs/RESILIENCE.md § workload controllers).
+  (docs/RESILIENCE.md § workload controllers);
+- descheduler — drift-repair plane: pluggable strategies nominate
+  misplaced bound pods, one dense what-if matrix (ops/whatif.py)
+  rescores them with the scheduler's own arithmetic, and gang-whole
+  hysteresis-gated moves drain through the PR-16 eviction funnel
+  (docs/DESCHEDULE.md).
 
-Both run as their own processes: ``python -m kubernetes_tpu.controllers
---mode {node-lifecycle,workload} --api-url ...`` against the real
-apiserver via HTTPClientset.
+Each runs as its own process: ``python -m kubernetes_tpu.controllers
+--mode {node-lifecycle,workload,deschedule} --api-url ...`` against the
+real apiserver via HTTPClientset.
 """
 
 from .autoscaler import ClusterAutoscaler
+from .descheduler import (
+    DeschedulerController,
+    DuplicateReplicas,
+    LowNodeUtilization,
+    TaintViolation,
+    clears_hysteresis,
+)
 from .evictor import RateLimitedEvictor, TokenBucket
 from .node_lifecycle import NodeLifecycleController
 from .traceprofile import WorkloadProfile
@@ -31,13 +43,18 @@ from .workload import (
 __all__ = [
     "ClusterAutoscaler",
     "DeploymentController",
+    "DeschedulerController",
+    "DuplicateReplicas",
     "GangController",
+    "LowNodeUtilization",
     "NodeLifecycleController",
     "RateLimitedEvictor",
     "ReplicaSetController",
+    "TaintViolation",
     "TokenBucket",
     "WorkloadControllerManager",
     "WorkloadProfile",
+    "clears_hysteresis",
     "gang_member_name",
     "replica_name",
 ]

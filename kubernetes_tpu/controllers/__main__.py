@@ -12,13 +12,21 @@
         [--trace-deployments N --trace-gangs N --trace-seed N ...] \
         [--metrics-port P]
 
-Either mode connects an HTTPClientset (reads may land on follower
+    python -m kubernetes_tpu.controllers --mode deschedule \
+        --api-url http://127.0.0.1:PORT [--fallback URL ...] \
+        [--identity NAME] [--lease-ttl S] [--tick S] \
+        [--hysteresis N] [--margin F] [--max-moves N] \
+        [--deschedule-device] \
+        [--primary-qps Q] [--secondary-qps Q] [--metrics-port P]
+
+Every mode connects an HTTPClientset (reads may land on follower
 replicas via --fallback; writes and the heartbeat-ages poll
 leader-route), prints the ready line the spawn harness keys on, serves
 its own /metrics on an ephemeral port, reconciles until SIGTERM/SIGINT,
-then prints one JSON stats line. Two `--mode workload` processes with
-distinct --identity race the shared lease: one runs ACTIVE, the other
-STANDBY with warm informers, taking over inside --lease-ttl of a kill9.
+then prints one JSON stats line. Two `--mode workload` (or `--mode
+deschedule`) processes with distinct --identity race the shared lease:
+one runs ACTIVE, the other STANDBY with warm informers, taking over
+inside --lease-ttl of a kill9.
 """
 
 from __future__ import annotations
@@ -61,7 +69,8 @@ def _serve_metrics(ctrl, port: int):
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kubernetes-tpu-controllers")
-    ap.add_argument("--mode", choices=("node-lifecycle", "workload"),
+    ap.add_argument("--mode",
+                    choices=("node-lifecycle", "workload", "deschedule"),
                     default="node-lifecycle")
     ap.add_argument("--api-url", required=True,
                     help="apiserver base URL (reads; writes leader-route)")
@@ -94,9 +103,36 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-rate", type=float, default=2.0)
     ap.add_argument("--trace-lifetime", type=float, default=0.0)
     ap.add_argument("--trace-seed", type=int, default=0)
+    # descheduler knobs
+    ap.add_argument("--hysteresis", type=int, default=5,
+                    help="minimum scored improvement a move must clear")
+    ap.add_argument("--margin", type=float, default=0.10,
+                    help="low-node-utilization: how far above the mean "
+                         "cpu-request utilization a node must sit to "
+                         "nominate movers")
+    ap.add_argument("--max-moves", type=int, default=64,
+                    help="eviction budget per reconcile tick")
+    ap.add_argument("--deschedule-device", action="store_true",
+                    help="dispatch the what-if matrix through the jitted "
+                         "mirror instead of the host walker")
     args = ap.parse_args(argv)
 
-    if args.mode == "node-lifecycle":
+    if args.mode == "deschedule":
+        from .descheduler import DeschedulerController, default_strategies
+
+        cs = HTTPClientset(args.api_url, fallbacks=args.fallback)
+        ctrl = DeschedulerController(
+            cs, identity=args.identity, lease_ttl=args.lease_ttl,
+            tick=args.tick if args.tick is not None else 0.25,
+            hysteresis=args.hysteresis,
+            strategies=default_strategies(margin=args.margin),
+            primary_qps=args.primary_qps, secondary_qps=args.secondary_qps,
+            unhealthy_threshold=args.unhealthy_threshold,
+            max_moves_per_tick=args.max_moves,
+            device=args.deschedule_device)
+        ready = (f"descheduler [{args.identity}]: "
+                 f"watching {args.api_url}")
+    elif args.mode == "node-lifecycle":
         cs = HTTPClientset(args.api_url, fallbacks=args.fallback)
         ctrl = NodeLifecycleController(
             cs, grace=args.grace, noexec_after=args.noexec_after,

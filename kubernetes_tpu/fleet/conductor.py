@@ -317,7 +317,8 @@ class FleetConductor:
 
     def _start_controllers(self) -> None:
         spec = self.spec
-        if spec.node_lifecycle is None and spec.workload is None:
+        if (spec.node_lifecycle is None and spec.workload is None
+                and spec.deschedule is None):
             return
         t0 = time.monotonic()
         n = 0
@@ -368,6 +369,28 @@ class FleetConductor:
                             str(trace.get("lifetime", 0.0)),
                             "--trace-seed", str(trace.get("seed", 0))]
                 m = FleetMember("workload", i, cmd, self._env, READY_METRICS)
+                self.members.append(self._spawn(m))
+                n += 1
+        if spec.deschedule is not None:
+            ds = spec.deschedule
+            for i in range(int(ds.get("managers", 2))):
+                cmd = [sys.executable, "-m", "kubernetes_tpu.controllers",
+                       "--mode", "deschedule", "--api-url", self.base,
+                       "--identity", f"dm-{i}",
+                       "--lease-ttl", str(ds.get("lease_ttl", 2.0)),
+                       "--tick", str(ds.get("tick", 0.25)),
+                       "--hysteresis", str(ds.get("hysteresis", 5)),
+                       "--margin", str(ds.get("margin", 0.10)),
+                       "--max-moves", str(ds.get("max_moves", 64)),
+                       "--primary-qps", str(ds.get("primary_qps", 20.0)),
+                       "--secondary-qps",
+                       str(ds.get("secondary_qps", 0.1))]
+                if ds.get("device"):
+                    cmd += ["--deschedule-device"]
+                for url in self.follower_urls:
+                    cmd += ["--fallback", url]
+                m = FleetMember("deschedule", i, cmd, self._env,
+                                READY_METRICS)
                 self.members.append(self._spawn(m))
                 n += 1
         self._stage("controllers", t0, n)
@@ -440,7 +463,8 @@ class FleetConductor:
         consumers (scalar leader, lists for the scaled-out roles)."""
         self.sample()
         hollows = self.members_of("hollow")
-        ctrls = self.members_of("controller") + self.members_of("workload")
+        ctrls = (self.members_of("controller") + self.members_of("workload")
+                 + self.members_of("deschedule"))
         leader = self.members_of("apiserver")
         out: Dict[str, object] = {
             "apiserver": leader[0].rss_peak_mb if leader else 0.0,
@@ -555,10 +579,21 @@ class FleetConductor:
             out.append(self._final_stats(m, "controller_stats"))
         return out
 
+    def stop_deschedulers(self) -> Optional[list]:
+        """SIGTERM the descheduler managers; per-process final stats."""
+        managers = self.members_of("deschedule")
+        if not managers:
+            return None
+        out = []
+        for m in managers:
+            self.stop_member(m)
+            out.append(self._final_stats(m, "controller_stats"))
+        return out
+
     def _teardown_procs(self) -> None:
         """Reverse-stage teardown: controllers → hollow → shards →
         followers → leader."""
-        order = ("workload", "controller", "hollow", "shard",
+        order = ("deschedule", "workload", "controller", "hollow", "shard",
                  "follower", "apiserver")
         for role in order:
             for m in self.members_of(role):

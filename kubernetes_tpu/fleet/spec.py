@@ -43,6 +43,7 @@ DEFAULT_RESTART = {
     "hollow": "restart",
     "controller": "restart",
     "workload": "restart",
+    "deschedule": "restart",
 }
 
 
@@ -70,6 +71,10 @@ class FleetSpec:
     # "autoscale": {...}, "trace": {...}}).
     node_lifecycle: Optional[dict] = None
     workload: Optional[dict] = None
+    # Descheduler managers (drift-repair plane, docs/DESCHEDULE.md):
+    # {"managers": 2, "lease_ttl": s, "tick": s, "hysteresis": n,
+    #  "max_moves": n, "device": bool}.
+    deschedule: Optional[dict] = None
     # Env seams every child inherits (wire plane TPU_SCHED_WIRE, hint
     # A/B TPU_SCHED_HINT_LRU / TPU_SCHED_SCORE_HINTS, ...); shard_env
     # lands on shard schedulers only.
@@ -105,6 +110,8 @@ class FleetSpec:
             node_lifecycle=(dict(d["node_lifecycle"])
                             if d.get("node_lifecycle") else None),
             workload=(dict(d["workload"]) if d.get("workload") else None),
+            deschedule=(dict(d["deschedule"])
+                        if d.get("deschedule") else None),
             env={str(k): str(v) for k, v in dict(d.get("env", {})).items()},
             shard_env={str(k): str(v)
                        for k, v in dict(d.get("shard_env", {})).items()},
@@ -132,6 +139,8 @@ class FleetSpec:
             "node_lifecycle": (dict(self.node_lifecycle)
                                if self.node_lifecycle else None),
             "workload": dict(self.workload) if self.workload else None,
+            "deschedule": (dict(self.deschedule)
+                           if self.deschedule else None),
             "env": dict(self.env),
             "shard_env": dict(self.shard_env),
             "flightrec_dir": self.flightrec_dir,
@@ -182,4 +191,7 @@ class FleetSpec:
         if self.workload is not None \
                 and int(self.workload.get("managers", 2)) < 1:
             raise ValueError("spec.workload.managers must be >= 1")
+        if self.deschedule is not None \
+                and int(self.deschedule.get("managers", 2)) < 1:
+            raise ValueError("spec.deschedule.managers must be >= 1")
         return self

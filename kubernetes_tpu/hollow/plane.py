@@ -100,6 +100,11 @@ class HollowNodePlane:
         # half of the proof that it left JSON.
         self.hb_wire_posts = 0
         self.hb_json_posts = 0
+        # Imbalance knob (profile.imbalance): capacity-skewed churn
+        # replacements, and the achieved mean |factor-1| for the stats
+        # line — the reproducibility oracle a seeded run asserts against.
+        self.skewed = 0
+        self._skew_sum = 0.0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -245,6 +250,9 @@ class HollowNodePlane:
                 "silenced_beats": self.silenced_beats,
                 "hb_wire_posts": self.hb_wire_posts,
                 "hb_json_posts": self.hb_json_posts,
+                "skewed": self.skewed,
+                "achieved_skew": round(
+                    self._skew_sum / max(1, self.skewed), 4),
                 "errors": self.errors}
 
     # -- failure injection (silence / flap / zone outage) -------------------
@@ -410,6 +418,23 @@ class HollowNodePlane:
         except Exception:  # noqa: BLE001
             self.errors += 1
 
+    def _skew_capacity(self, wire: dict) -> dict:
+        """Capacity-skew one churn replacement (profile.imbalance): scale
+        the replacement's cpu/memory by a factor in [1-imbalance,
+        1+imbalance] keyed off (seed, replacement name) alone — NOT the
+        shared drift/churn rng — so the skew any given replacement gets
+        is reproducible from the profile regardless of how heartbeat and
+        churn threads interleave their rng draws. Caller holds _lock."""
+        prof = self.profile
+        rnd = random.Random(f"{prof.seed or 0x5ca1e}:{wire['name']}")
+        factor = 1.0 + prof.imbalance * (2.0 * rnd.random() - 1.0)
+        alloc = dict(wire["allocatable"])
+        alloc["cpu"] = max(1000, int(alloc["cpu"] * factor))
+        alloc["memory"] = max(1 << 20, int(alloc["memory"] * factor))
+        self.skewed += 1
+        self._skew_sum += abs(factor - 1.0)
+        return dict(wire, allocatable=alloc)
+
     def _delete_and_replace(self, name: str) -> None:
         try:
             self._client.call("DELETE", f"/api/v1/nodes/{name}")
@@ -421,6 +446,8 @@ class HollowNodePlane:
             self._nodes.pop(name, None)
             ix = self._shape_ix.pop(name, 0)
             wire = self.profile.node_wire(ix, name=self._replacement_name(ix))
+            if self.profile.imbalance > 0:
+                wire = self._skew_capacity(wire)
             self._nodes[wire["name"]] = wire
             self._shape_ix[wire["name"]] = ix
             try:

@@ -82,6 +82,14 @@ class HollowProfile:
     drift: float = 0.0          # fraction of heartbeats that drift capacity
     churn_per_s: float = 0.0    # cordon->delete->re-register waves
     churn_cordon_s: float = 0.5  # dwell between cordon and delete
+    # Capacity imbalance (the descheduler's standing prey,
+    # docs/DESCHEDULE.md): churn re-registrations land with cpu/memory
+    # scaled by a factor in [1-imbalance, 1+imbalance], keyed off
+    # (seed, replacement name) alone so the skew any given replacement
+    # gets is reproducible from the profile — bound pods stay put while
+    # capacity migrates between nodes, so utilization drifts apart until
+    # rebalance moves repair it. 0.0 = replacements land at spec shape.
+    imbalance: float = 0.0
     threads: int = 4            # register/heartbeat worker threads
     register_chunk: int = 500   # nodes per bulk-create POST
     seed: int = 0               # drift/churn victim selection
@@ -113,6 +121,7 @@ class HollowProfile:
                    drift=float(d.get("drift", 0.0)),
                    churn_per_s=float(d.get("churn_per_s", 0.0)),
                    churn_cordon_s=float(d.get("churn_cordon_s", 0.5)),
+                   imbalance=float(d.get("imbalance", 0.0)),
                    threads=int(d.get("threads", 4)),
                    register_chunk=int(d.get("register_chunk", 500)),
                    seed=int(d.get("seed", 0)),
@@ -131,6 +140,7 @@ class HollowProfile:
                 "heartbeat_s": self.heartbeat_s, "drift": self.drift,
                 "churn_per_s": self.churn_per_s,
                 "churn_cordon_s": self.churn_cordon_s,
+                "imbalance": self.imbalance,
                 "threads": self.threads,
                 "register_chunk": self.register_chunk, "seed": self.seed,
                 "silence": self.silence,

@@ -566,7 +566,71 @@ def run_hollow_workload(wl: Workload) -> PerfResult:
         "flap_period_s": float(params.get("hollowFlapPeriodS", 2.0)),
         "outage_zone": int(params.get("hollowOutageZone", -1)),
         "outage_after_s": float(params.get("hollowOutageAfterS", 0.0)),
+        # Capacity-imbalance knob (profile.imbalance, docs/DESCHEDULE.md):
+        # churn re-registrations land capacity-skewed off the one seed —
+        # the descheduler rows' drift source.
+        "imbalance": float(params.get("hollowImbalance", 0.0)),
+        "seed": int(params.get("hollowSeed", 0)),
     }
+    # Standing workload-manager row (ROADMAP: trace profile at hollow
+    # scale): `workloadManagers` spawns the HA manager pair; trace*
+    # params feed the seeded Borg-marginal deployment/gang arrival feed.
+    workload = None
+    if params.get("workloadManagers"):
+        workload = {"managers": int(params["workloadManagers"]),
+                    "lease_ttl": float(params.get("workloadLeaseTtlS", 2.0))}
+        if params.get("traceDeployments") or params.get("traceGangs"):
+            workload["trace"] = {
+                "deployments": int(params.get("traceDeployments", 0)),
+                "gangs": int(params.get("traceGangs", 0)),
+                "rate": float(params.get("traceRate", 2.0)),
+                "lifetime": float(params.get("traceLifetimeS", 0.0)),
+                "seed": int(params.get("traceSeed", 0))}
+    # Descheduler rows (docs/DESCHEDULE.md): `deschedule: true` spawns
+    # the HA descheduler pair; the rebalance happens inside the
+    # `settleS` window after the last measured pod binds.
+    deschedule = None
+    if params.get("deschedule"):
+        deschedule = {
+            "managers": int(params.get("descheduleManagers", 2)),
+            "lease_ttl": float(params.get("descheduleLeaseTtlS", 2.0)),
+            "tick": float(params.get("descheduleTickS", 0.5)),
+            "hysteresis": int(params.get("descheduleHysteresis", 5)),
+            "margin": float(params.get("descheduleMargin", 0.10)),
+            "max_moves": int(params.get("descheduleMaxMoves", 64))}
+    # PDB-cleanliness oracle: `pdbMinAvailable` posts one PDB over the
+    # measured pods' {app: sharded} selector before rebalance starts;
+    # every progress poll then asserts the bound count never dips below
+    # the floor once it has been reached — a dip means an eviction the
+    # server should have 429'd (the zero-violations-at-every-poll
+    # contract). The count rides the existing summary poll: no extra
+    # read traffic.
+    pdb_min = int(params.get("pdbMinAvailable", 0))
+    warm_pods = int(params.get("warmPods", min(256, max(1, n_pods // 8))))
+    pdb_state = {"created": False, "armed": False, "polls": 0,
+                 "violations": 0}
+
+    def _pdb_cb(bound: int, cluster) -> None:
+        from ..shard.harness import _call
+        if not pdb_state["created"]:
+            try:
+                _call(cluster.base, "POST", "/api/v1/pdbs",
+                      {"name": "measured-pdb", "namespace": "default",
+                       "minAvailable": pdb_min,
+                       "matchLabels": {"app": "sharded"}})
+            except Exception:  # noqa: BLE001 - next poll retries
+                return
+            pdb_state["created"] = True
+        # The cb's `bound` excludes warm pods; the server's PDB gate
+        # counts the whole {app: sharded} matched set (warm + measured),
+        # so compare the same total the gate compares.
+        total_bound = bound + warm_pods
+        pdb_state["polls"] += 1
+        if total_bound >= pdb_min:
+            pdb_state["armed"] = True
+        elif pdb_state["armed"]:
+            pdb_state["violations"] += 1
+
     out = run_sharded_cluster(
         int(params.get("shards", 1)), n_nodes, n_pods,
         hollow=profile,
@@ -580,8 +644,12 @@ def run_hollow_workload(wl: Workload) -> PerfResult:
                    if params.get("hintLru") else None),
         replicas=int(params.get("replicas", 0)),
         lease_duration=float(params.get("leaseDuration", 15.0)),
-        warm_pods=int(params.get("warmPods", min(256, max(1, n_pods // 8)))),
+        warm_pods=warm_pods,
         timeout=float(params.get("timeoutS", 3600.0)),
+        workload=workload,
+        deschedule=deschedule,
+        settle_s=float(params.get("settleS", 0.0)),
+        progress_cb=(_pdb_cb if pdb_min else None),
         pod_request={"cpu": pod_tpl.get("cpu", "100m"),
                      "memory": pod_tpl.get("memory", "128Mi")})
     result = PerfResult(workload=wl, scheduled=out["bound"],
@@ -617,7 +685,53 @@ def run_hollow_workload(wl: Workload) -> PerfResult:
     # off the cache ring and re-LISTed — at 100k nodes that is a paged
     # but still fleet-sized read. The fusion row pins it to zero.
     result.metrics["MaxRelistedWatches"] = {"Average": relisted}
+    if workload is not None:
+        # Standing trace-row floors: the trace profile really fed
+        # (profile_fed counts deployment/gang arrivals minted) and the
+        # reconcilers really created pods through the deterministic-name
+        # /409 seam (summed over both managers — only the active one
+        # creates, but a takeover splits the count).
+        wls = [s for s in (out.get("workload") or []) if s]
+        result.metrics["WorkloadTraceFed"] = {"Average": float(
+            sum(int(s.get("profile_fed", 0)) for s in wls))}
+        result.metrics["WorkloadPodsCreated"] = {"Average": float(sum(
+            int((s.get("replicasets") or {}).get("pods_created", 0))
+            + int((s.get("gangs") or {}).get("pods_created", 0))
+            for s in wls))}
+    if deschedule is not None:
+        dss = [s for s in (out.get("deschedule") or []) if s]
+        # Post-rebalance utilization stddev (milli-cpu): the ACTIVE
+        # manager's last reconcile computed it; standbys report 0.0, so
+        # take the max over managers that actually held the lease.
+        # MaxUtilizationStddevMilli is the convergence CEILING the
+        # ChurnDriftRebalance row pins.
+        result.metrics["MaxUtilizationStddevMilli"] = {"Average": max(
+            [float(s.get("util_stddev_milli", 0.0)) for s in dss
+             if int(s.get("active_ticks", 0))] or [0.0])}
+        # DescheduleMoves floor: the rebalance actually moved pods (a
+        # zero here means the drift never formed or hysteresis ate it).
+        result.metrics["DescheduleMoves"] = {"Average": float(sum(
+            sum(int(v) for v in (s.get("moves") or {}).values())
+            for s in dss))}
+        # Exactly-once contract as a ceiling: every eviction the server
+        # committed came back around as exactly one scheduler requeue —
+        # a gap either way means a lost or double-counted move.
+        api = out.get("api") or {}
+        requeues = sum(
+            float(sm.get("scheduler_eviction_requeues_total", 0.0))
+            for sm in out.get("shard_metrics") or [])
+        evictions = float(api.get("apiserver_pod_evictions_total", 0.0))
+        result.metrics["MaxEvictionRequeueGap"] = {
+            "Average": abs(requeues - evictions)}
+    if pdb_min:
+        # Zero-PDB-violations-at-every-poll: once the bound count reached
+        # the PDB floor it never dipped below it again — every rebalance
+        # eviction was budget-gated server-side.
+        result.metrics["MaxPdbViolations"] = {
+            "Average": float(pdb_state["violations"])}
     result.detail = dict(out)
+    if pdb_min:
+        result.detail["pdb"] = dict(pdb_state)
     return result
 
 

@@ -396,6 +396,39 @@ def start_workload_manager(base: str, cwd: str, env: dict,
     return proc, f"http://{m.group(1)}"
 
 
+def start_descheduler(base: str, cwd: str, env: dict,
+                      identity: str = "descheduler-0",
+                      fallbacks=(), lease_ttl: float = 2.0,
+                      tick: float = 0.25, hysteresis: int = 5,
+                      margin: float = 0.10,
+                      max_moves: int = 64, primary_qps: float = 20.0,
+                      secondary_qps: float = 0.1, device: bool = False,
+                      timeout: float = 120.0):
+    """Spawn one descheduler process (`python -m kubernetes_tpu.controllers
+    --mode deschedule`) against `base` and block until its ready line.
+    Spawn TWO with distinct identities for the HA pair — they race the
+    `descheduler` lease, one ACTIVE, one STANDBY; the standby re-derives
+    the ACTIVE's `uid@node` intents after a kill9 (docs/DESCHEDULE.md).
+    Returns (proc, metrics_url) — `metrics_url` serves the
+    `descheduler_*` series."""
+    from ..testing.faults import spawn_ready
+
+    cmd = [sys.executable, "-m", "kubernetes_tpu.controllers",
+           "--mode", "deschedule", "--api-url", base,
+           "--identity", identity, "--lease-ttl", str(lease_ttl),
+           "--tick", str(tick), "--hysteresis", str(hysteresis),
+           "--margin", str(margin), "--max-moves", str(max_moves),
+           "--primary-qps", str(primary_qps),
+           "--secondary-qps", str(secondary_qps)]
+    if device:
+        cmd += ["--deschedule-device"]
+    for url in fallbacks:
+        cmd += ["--fallback", url]
+    proc, m = spawn_ready(cmd, r"metrics on (127\.0\.0\.1:\d+)", cwd=cwd,
+                          env=env, timeout=timeout)
+    return proc, f"http://{m.group(1)}"
+
+
 def stop_controller(proc, tail=None):
     """SIGTERM the controller and collect its final stats line
     (`{"controller_stats": ...}`) from a drained tail, if one was kept."""
@@ -440,6 +473,8 @@ def run_sharded_cluster(
     node_lifecycle=None,
     flood=None,
     workload=None,
+    deschedule=None,
+    settle_s: float = 0.0,
     spec=None,
 ) -> dict:
     """The sharded SchedulingBasic shape end to end: create `n_nodes`,
@@ -470,6 +505,18 @@ def run_sharded_cluster(
     optional cluster autoscaler and Borg-style trace feed — and the
     result carries each process's final stats (docs/RESILIENCE.md
     § workload controllers).
+
+    With ``deschedule`` set (``{"managers": 2, "lease_ttl": s, "tick": s,
+    "hysteresis": n, "margin": f, "max_moves": n}``), that many
+    descheduler processes
+    run as an HA pair racing their own lease — drift detection, what-if
+    scored rebalance moves through the eviction subresource
+    (docs/DESCHEDULE.md) — and the result carries each process's final
+    stats plus the apiserver's eviction counters (the ``api`` filter
+    includes ``eviction`` series). ``settle_s`` holds the cluster up for
+    that many extra seconds AFTER the last measured pod binds — the
+    rebalance window — still firing ``progress_cb`` on every poll so
+    callers can assert invariants (e.g. PDB cleanliness) mid-rebalance.
 
     Returns the one-line-JSON-able result dict: pods/s, per-shard metric
     scrapes, apiserver conflict counters, peak per-process RSS, and a
@@ -508,6 +555,7 @@ def run_sharded_cluster(
             # race the shared PUT-CAS lease; drained tails keep their
             # SIGTERM stats lines collectable at teardown.
             workload=workload,
+            deschedule=deschedule,
             env=dict(child_env or {}),
             fair_tenants=flood is not None,
             # A tightened workload lane makes shedding demonstrable at
@@ -521,6 +569,7 @@ def run_sharded_cluster(
     else:
         hollow = spec.hollow if spec.hollow is not None else hollow
         workload = spec.workload
+        deschedule = spec.deschedule
         n_shards = spec.shards
         replicas = spec.replicas
         flightrec_dir = spec.flightrec_dir
@@ -710,6 +759,16 @@ def run_sharded_cluster(
             cb=(lambda b: progress_cb(b - warm_pods, cluster))
             if progress_cb is not None else None)
         elapsed = time.perf_counter() - t0
+        if settle_s > 0:
+            # Rebalance window: binds are done; keep the fleet up so the
+            # descheduler can repair drift, polling progress_cb so chaos /
+            # invariant callbacks (PDB cleanliness, exactly-once ledgers)
+            # keep firing through the window.
+            settle_deadline = time.monotonic() + settle_s
+            while time.monotonic() < settle_deadline:
+                if progress_cb is not None:
+                    progress_cb(got - warm_pods, cluster)
+                time.sleep(0.5)
         flood_result = None
         if flood is not None:
             flood_stop.set()
@@ -731,6 +790,8 @@ def run_sharded_cluster(
         hollow_stats = cluster.stop_hollow() if hollow is not None else None
         workload_stats = (cluster.conductor.stop_workload()
                           if workload is not None else None)
+        deschedule_stats = (cluster.conductor.stop_deschedulers()
+                            if deschedule is not None else None)
         shard_metrics = []
         e2e_hists = []
         watch_decode = []
@@ -897,6 +958,11 @@ def run_sharded_cluster(
             # final stats lines — active/standby split, takeovers,
             # reconcile counters, autoscaler adds/removes.
             "workload": workload_stats,
+            # Descheduler manager stats (HA pair): moves by strategy,
+            # blocked-by-reason, what-if batch timings, final utilization
+            # stddev — the drift-repair plane's exactly-once story pairs
+            # with the "eviction" series in the api filter below.
+            "deschedule": deschedule_stats,
             # Where the progress/summary reads landed (follower-served read
             # plane) + one follower /metrics/resources scrape's series count.
             "read_plane": dict(read_counts,
@@ -918,12 +984,13 @@ def run_sharded_cluster(
                     or "replication" in k or "failover" in k
                     or "watch" in k or "list" in k
                     or "snapshot" in k or "heartbeat" in k
-                    or "flowcontrol" in k},
+                    or "flowcontrol" in k or "eviction" in k},
             "shard_metrics": [
                 {k: v for k, v in sm.items()
                  if k.startswith(("scheduler_shard_",
                                   "scheduler_bind_conflict",
                                   "scheduler_hint_",
+                                  "scheduler_eviction_requeues",
                                   "scheduler_queue_starvation"))}
                 for sm in shard_metrics],
         }

@@ -460,3 +460,37 @@ class DeviceFaults:
         if self.calls[site] in self._plan.get(site, ()):
             self.injected[site] = self.injected.get(site, 0) + 1
             raise self._exc_factory()
+
+
+def scrape_metric(base_url: str, name: str, timeout: float = 5.0) -> float:
+    """Fetch `base_url`/metrics and return the value of the un-labelled
+    series `name`. Chaos tests poll counters across a kill9 with this;
+    raises AssertionError if the series is not exposed (a typo'd series
+    name must fail loudly, not read as 0.0)."""
+    from urllib import request as _rq
+
+    with _rq.urlopen(base_url + "/metrics", timeout=timeout) as resp:
+        text = resp.read().decode()
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    raise AssertionError(f"series {name} not exposed by {base_url}")
+
+
+def wait_metric(base_url: str, name: str, pred, timeout: float = 60.0,
+                poll: float = 0.1) -> float:
+    """Poll `scrape_metric` until `pred(value)` holds; returns the value
+    that satisfied it. Scrape errors (the target may be mid-kill9) are
+    swallowed and retried until the deadline."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            last = scrape_metric(base_url, name, timeout=poll * 50)
+        except Exception:  # noqa: BLE001 - target racing a death
+            last = None
+        if last is not None and pred(last):
+            return last
+        time.sleep(poll)
+    raise AssertionError(
+        f"timed out waiting for {name} (last observed: {last})")

@@ -1,0 +1,448 @@
+"""Descheduler (PR 20): what-if scorer bit-parity, strategies, gang-whole
+and hysteresis gating, controller lifecycle, fleet-spec wiring.
+
+The load-bearing claim is determinism: a standby manager re-deriving a
+dead ACTIVE's plan must mint the SAME ``uid@node`` intent set, so the
+exactly-once eviction ledger absorbs the replay. Everything here feeds
+that — bit-identical host/device scoring, uid-ordered tie-breaks,
+identical plans from identical snapshots.
+"""
+
+import numpy as np
+import pytest
+from urllib.error import HTTPError
+
+from kubernetes_tpu.controllers.descheduler import (
+    BLOCK_REASONS, DeschedulerController, DuplicateReplicas,
+    LowNodeUtilization, Snapshot, TaintViolation, clears_hysteresis,
+    default_strategies)
+from kubernetes_tpu.core import FakeClientset
+from kubernetes_tpu.core.node_info import NodeInfo, PodInfo
+from kubernetes_tpu.ops import whatif
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+class EvictingClientset(FakeClientset):
+    """FakeClientset + the eviction subresource contract the descheduler
+    funnel needs: intent-ledgered exactly-once, replay -> already=True,
+    node mismatch -> 409, eviction = unbind-to-pending (the real server
+    deletes + recreates pending; reading cs.pods directly the effect is
+    the same: node_name clears, uid survives)."""
+
+    def __init__(self):
+        super().__init__()
+        self.eviction_ledger = {}          # uid -> intent
+        self.evictions_committed = 0
+
+    def evict_pod(self, uid, node, intent):
+        pod = self.pods.get(uid)
+        if pod is None:
+            raise HTTPError("", 404, "gone", {}, None)
+        if self.eviction_ledger.get(uid) == intent:
+            return {"evicted": True, "already": True}
+        if not pod.node_name:
+            return {"evicted": False, "pending": True}
+        if pod.node_name != node:
+            raise HTTPError("", 409, "NodeMismatch", {}, None)
+        self.eviction_ledger[uid] = intent
+        pod.node_name = ""
+        self.evictions_committed += 1
+        return {"evicted": True}
+
+
+def _cluster(n_nodes=4, cpu="8", pods_on_first=6, pod_cpu="1"):
+    """n_nodes identical nodes; `pods_on_first` pods piled on node 0."""
+    cs = EvictingClientset()
+    for i in range(n_nodes):
+        cs.create_node(make_node().name(f"n{i}")
+                       .capacity({"cpu": cpu, "memory": "16Gi",
+                                  "pods": 32}).obj())
+    for i in range(pods_on_first):
+        p = make_pod().name(f"p{i}").uid(f"p{i}").req({"cpu": pod_cpu}).obj()
+        cs.create_pod(p)
+        cs.bind(p, "n0")
+    return cs
+
+
+def _snapshot_of(cs) -> Snapshot:
+    nodes = sorted(cs.nodes.values(), key=lambda n: n.name)
+    infos = [NodeInfo(n) for n in nodes]
+    row = {ni.name: i for i, ni in enumerate(infos)}
+    bound = sorted((p for p in cs.pods.values()
+                    if p.node_name in row and p.deletion_ts is None),
+                   key=lambda p: p.uid)
+    gangs = {}
+    for p in bound:
+        infos[row[p.node_name]].add_pod(PodInfo.of(p))
+        if p.pod_group:
+            gangs.setdefault(p.pod_group, []).append(p)
+    return Snapshot(infos, row, bound, gangs)
+
+
+def _random_batch(rng, n_nodes, n_pods, n_res=3) -> whatif.WhatIfBatch:
+    alloc_r = rng.integers(0, 64_000, (n_nodes, n_res)).astype(np.int64)
+    alloc_pods = rng.integers(1, 40, n_nodes).astype(np.int64)
+    req_r = np.minimum(
+        rng.integers(0, 48_000, (n_nodes, n_res)).astype(np.int64), alloc_r)
+    nonzero = np.maximum(req_r[:, :2], 1)
+    pod_count = rng.integers(0, 20, n_nodes).astype(np.int64)
+    request = rng.integers(0, 8_000, (n_pods, n_res)).astype(np.int64)
+    nz_request = np.maximum(request[:, :2], 100)
+    src = rng.integers(0, n_nodes, n_pods).astype(np.int64)
+    mask = rng.random((n_pods, n_nodes)) < 0.9
+    return whatif.WhatIfBatch(alloc_r, alloc_pods, req_r, nonzero,
+                              pod_count, request, nz_request, src, mask)
+
+
+# ---------------------------------------------------------------------------
+# what-if scorer
+# ---------------------------------------------------------------------------
+
+
+def test_whatif_host_device_bit_parity_fuzz():
+    """The acceptance contract: host walker and jitted device mirror are
+    bit-identical on fuzzed batches — fit masks AND int64 scores. Padding
+    to power-of-two tiers must never leak into the sliced-back result."""
+    rng = np.random.default_rng(0xD35C)
+    for _ in range(12):
+        n_nodes = int(rng.integers(1, 40))
+        n_pods = int(rng.integers(1, 20))
+        b = _random_batch(rng, n_nodes, n_pods)
+        fit_h, sc_h = whatif.whatif_scores(b, device=False)
+        fit_d, sc_d = whatif.whatif_scores(b, device=True)
+        np.testing.assert_array_equal(fit_h, fit_d)
+        np.testing.assert_array_equal(sc_h, sc_d)
+        assert sc_h.dtype == np.int64
+
+
+def test_whatif_empty_batch():
+    b = whatif.encode_batch([], [])
+    fit, sc = whatif.whatif_scores(b)
+    assert fit.shape == (0, 0) and sc.shape == (0, 0)
+    assert whatif.best_moves(b, fit, sc) == []
+
+
+def test_encode_batch_masks_taints_and_unschedulable():
+    tainted = make_node().name("bad").capacity({"cpu": "8", "pods": 10}) \
+        .taint("dedicated", "infra").obj()
+    cordoned = make_node().name("cordon").capacity(
+        {"cpu": "8", "pods": 10}).unschedulable().obj()
+    clean = make_node().name("ok").capacity({"cpu": "8", "pods": 10}).obj()
+    infos = [NodeInfo(n) for n in (tainted, cordoned, clean)]
+    plain = make_pod().name("plain").req({"cpu": "1"}).node("ok").obj()
+    tol = make_pod().name("tol").req({"cpu": "1"}).node("ok") \
+        .toleration("dedicated", "infra").obj()
+    b = whatif.encode_batch(infos, [plain, tol])
+    # rows: 0=tainted, 1=cordoned, 2=clean
+    assert list(b.mask[0]) == [False, False, True]
+    assert list(b.mask[1]) == [True, False, True]
+
+
+def test_encode_batch_row_encoding_and_nonzero_defaults():
+    n = make_node().name("n0").capacity(
+        {"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+    ni = NodeInfo(n)
+    bound = make_pod().name("b0").req({"cpu": "1"}).node("n0").obj()
+    ni.add_pod(PodInfo.of(bound))
+    zero = make_pod().name("z0").node("n0").obj()   # no explicit request
+    b = whatif.encode_batch([ni], [zero])
+    assert b.alloc_r[0, whatif.SLOT_CPU] == 4000
+    assert b.alloc_pods[0] == 10
+    assert b.req_r[0, whatif.SLOT_CPU] == 1000
+    assert b.pod_count[0] == 1
+    # zero-request candidates score with the scheduler's non-zero defaults
+    assert b.nz_request[0, 0] == NodeInfo.DEFAULT_MILLI_CPU
+    assert b.nz_request[0, 1] == NodeInfo.DEFAULT_MEMORY
+
+
+def test_best_moves_tie_breaks_to_lowest_row():
+    """Equal-scored landing rows pick the LOWEST index on every manager —
+    the determinism the exactly-once replay depends on."""
+    fit = np.ones((1, 4), bool)
+    score = np.array([[10, 50, 50, 50]], np.int64)
+    b = whatif.WhatIfBatch(*[None] * 5, np.zeros((1, 3), np.int64),
+                           np.zeros((1, 2), np.int64),
+                           np.array([0], np.int64), fit)
+    (mv,) = whatif.best_moves(b, fit, score)
+    assert (mv.src, mv.dst, mv.improvement) == (0, 1, 40)
+
+
+def test_best_moves_unfit_source_scores_current_minus_one():
+    """Drift shrank the node under a bound pod: its seat no longer fits,
+    so a merely-equal landing still registers a positive improvement."""
+    fit = np.array([[False, True]])
+    score = np.array([[50, 50]], np.int64)
+    b = whatif.WhatIfBatch(*[None] * 5, np.zeros((1, 3), np.int64),
+                           np.zeros((1, 2), np.int64),
+                           np.array([0], np.int64), fit)
+    (mv,) = whatif.best_moves(b, fit, score)
+    assert mv.dst == 1 and mv.improvement == 1
+
+
+def test_best_moves_no_feasible_other_row_is_none():
+    fit = np.array([[True, False]])
+    score = np.array([[50, 99]], np.int64)
+    b = whatif.WhatIfBatch(*[None] * 5, np.zeros((1, 3), np.int64),
+                           np.zeros((1, 2), np.int64),
+                           np.array([0], np.int64), fit)
+    assert whatif.best_moves(b, fit, score) == [None]
+
+
+# ---------------------------------------------------------------------------
+# hysteresis + strategies
+# ---------------------------------------------------------------------------
+
+
+def test_clears_hysteresis():
+    assert clears_hysteresis(5, 5)
+    assert not clears_hysteresis(4, 5)
+    # must_move (illegal seat) waives the floor, even negative improvement
+    assert clears_hysteresis(-3, 5, must_move=True)
+
+
+def test_low_node_utilization_nominates_largest_first():
+    cs = _cluster(n_nodes=3, pods_on_first=0)
+    sizes = {"pa": "4", "pb": "1", "pc": "2"}
+    for name, cpu in sizes.items():
+        p = make_pod().name(name).uid(name).req({"cpu": cpu}).obj()
+        cs.create_pod(p)
+        cs.bind(p, "n0")
+    snap = _snapshot_of(cs)
+    got = LowNodeUtilization(margin=0.10, per_node=2).candidates(snap)
+    assert [p.uid for p in got] == ["pa", "pc"]   # largest first, capped
+
+
+def test_duplicate_replicas_keeps_lowest_uid():
+    cs = _cluster(n_nodes=2, pods_on_first=0)
+    for name in ("r2", "r0", "r1"):
+        p = make_pod().name(name).uid(name).req({"cpu": "1"}) \
+            .labels({"app": "web"}).obj()
+        cs.create_pod(p)
+        cs.bind(p, "n0")
+    lone = make_pod().name("solo").uid("solo").req({"cpu": "1"}) \
+        .labels({"app": "web"}).obj()
+    cs.create_pod(lone)
+    cs.bind(lone, "n1")
+    got = DuplicateReplicas().candidates(_snapshot_of(cs))
+    assert sorted(p.uid for p in got) == ["r1", "r2"]
+
+
+def test_taint_violation_detects_untolerated_seat():
+    cs = _cluster(n_nodes=2, pods_on_first=1)
+    # churn re-registered n0 with a taint the bound pod never tolerated
+    tainted = make_node().name("n0").capacity(
+        {"cpu": "8", "memory": "16Gi", "pods": 32}) \
+        .taint("maintenance", "true", "NoExecute").obj()
+    cs.update_node(tainted)
+    strat = TaintViolation()
+    got = strat.candidates(_snapshot_of(cs))
+    assert [p.uid for p in got] == ["p0"]
+    assert strat.must_move
+
+
+def test_default_strategies_order_is_violations_first():
+    names = [s.name for s in default_strategies()]
+    assert names == ["taint-violation", "duplicate-replicas",
+                     "low-node-utilization"]
+
+
+# ---------------------------------------------------------------------------
+# controller
+# ---------------------------------------------------------------------------
+
+
+def test_controller_converges_imbalanced_cluster():
+    """6 pods piled on one of 4 nodes: reconcile ticks drain the hot node
+    through the eviction funnel until the spread repairs (evicted pods go
+    pending; stddev over the remaining bound set falls monotonically to
+    the empty fixpoint here — rebinding is the scheduler's job)."""
+    cs = _cluster()
+    ctrl = DeschedulerController(
+        cs, strategies=[LowNodeUtilization()], hysteresis=1,
+        primary_qps=1000.0, burst=16.0)
+    before = None
+    for _ in range(8):
+        ctrl.tick_once()
+        if before is None:
+            before = ctrl.util_stddev_milli
+        if not any(p.node_name for p in cs.pods.values()):
+            break
+    assert ctrl.active and ctrl.takeovers == 1
+    assert cs.evictions_committed > 0
+    assert sum(ctrl.moves_total.values()) == cs.evictions_committed
+    assert before > 0
+    # every committed eviction is ledgered under its deterministic intent
+    for uid, intent in cs.eviction_ledger.items():
+        assert intent == f"{uid}@n0"
+        assert ctrl.planned_intents[uid] == intent
+
+
+def test_two_managers_plan_identical_intents():
+    """The failover contract, minus the processes: two managers over
+    identical snapshots derive the same uid@node intent map."""
+    plans = []
+    for _ in range(2):
+        cs = _cluster()
+        ctrl = DeschedulerController(
+            cs, strategies=[LowNodeUtilization()], hysteresis=1)
+        ctrl.reconcile_once()
+        plans.append(dict(ctrl.planned_intents))
+    assert plans[0] == plans[1] and plans[0]
+
+
+def test_replayed_intent_counts_already_not_double_evict():
+    cs = _cluster()
+    ctrl = DeschedulerController(
+        cs, strategies=[LowNodeUtilization()], hysteresis=1,
+        primary_qps=1000.0, burst=16.0)
+    ctrl.tick_once()
+    first = cs.evictions_committed
+    assert first > 0
+    # replay the exact intents (the standby's duplicate emission)
+    for uid, intent in list(cs.eviction_ledger.items()):
+        got = cs.evict_pod(uid, intent.split("@", 1)[1], intent)
+        assert got == {"evicted": True, "already": True}
+    assert cs.evictions_committed == first
+
+
+def test_hysteresis_floor_blocks_churn_moves():
+    cs = _cluster()
+    ctrl = DeschedulerController(
+        cs, strategies=[LowNodeUtilization()], hysteresis=10_000)
+    ctrl.reconcile_once()
+    assert sum(ctrl.moves_total.values()) == 0
+    assert ctrl.blocked_total["hysteresis"] > 0
+    assert cs.evictions_committed == 0
+
+
+def test_gang_moves_whole_or_not_at_all():
+    """One member with no feasible landing pins the entire PodGroup."""
+    cs = _cluster(n_nodes=2, pods_on_first=0)
+    # n1 is tainted: the gang's pods (no tolerations) have nowhere to go
+    tainted = make_node().name("n1").capacity(
+        {"cpu": "8", "memory": "16Gi", "pods": 32}) \
+        .taint("dedicated", "infra").obj()
+    cs.update_node(tainted)
+    for i in range(3):
+        p = make_pod().name(f"g{i}").uid(f"g{i}").req({"cpu": "2"}).obj()
+        p.pod_group = "team"
+        cs.create_pod(p)
+        cs.bind(p, "n0")
+    ctrl = DeschedulerController(
+        cs, strategies=[LowNodeUtilization()], hysteresis=1)
+    ctrl.reconcile_once()
+    assert cs.evictions_committed == 0
+    assert ctrl.blocked_total["gang"] >= 1
+    assert all(p.node_name == "n0" for p in cs.pods.values())
+
+
+def test_gang_with_feasible_landings_moves_every_member():
+    cs = _cluster(n_nodes=3, pods_on_first=0)
+    for i in range(2):
+        p = make_pod().name(f"g{i}").uid(f"g{i}").req({"cpu": "3"}).obj()
+        p.pod_group = "team"
+        cs.create_pod(p)
+        cs.bind(p, "n0")
+    ctrl = DeschedulerController(
+        cs, strategies=[LowNodeUtilization()], hysteresis=1,
+        primary_qps=1000.0, burst=16.0)
+    ctrl.tick_once()
+    assert cs.evictions_committed == 2
+    assert ctrl.blocked_total["gang"] == 0
+    assert all(not p.node_name for p in cs.pods.values())
+
+
+def test_standby_idles_until_lease_expires_then_takes_over():
+    cs = _cluster(pods_on_first=0)
+    clock = {"t": 100.0}
+    cs.lease_now = lambda: clock["t"]
+    a = DeschedulerController(cs, identity="dm-0", lease_ttl=2.0,
+                              now=lambda: clock["t"])
+    b = DeschedulerController(cs, identity="dm-1", lease_ttl=2.0,
+                              now=lambda: clock["t"])
+    a.tick_once()
+    b.tick_once()
+    assert a.active and not b.active and b.standby_ticks == 1
+    clock["t"] += 5.0           # dm-0 dies: its lease expires
+    b.tick_once()
+    assert b.active and b.takeovers == 1
+
+
+def test_must_move_strategy_waives_hysteresis():
+    """A taint-violating seat moves even under a floor that blocks every
+    utilization move — the seat is illegal, staying is not an option."""
+    cs = _cluster(n_nodes=2, pods_on_first=1)
+    tainted = make_node().name("n0").capacity(
+        {"cpu": "8", "memory": "16Gi", "pods": 32}) \
+        .taint("maintenance", "true", "NoExecute").obj()
+    cs.update_node(tainted)
+    ctrl = DeschedulerController(cs, hysteresis=10_000,
+                                 primary_qps=1000.0, burst=16.0)
+    ctrl.tick_once()
+    assert cs.evictions_committed == 1
+    assert ctrl.moves_total["taint-violation"] == 1
+
+
+def test_metrics_text_carries_every_series():
+    cs = _cluster()
+    ctrl = DeschedulerController(cs, strategies=[LowNodeUtilization()],
+                                 hysteresis=1, primary_qps=1000.0,
+                                 burst=16.0)
+    ctrl.tick_once()
+    text = ctrl.metrics_text()
+    for series in ("descheduler_moves_total{strategy=",
+                   "descheduler_whatif_batch_duration_seconds_sum",
+                   "descheduler_whatif_batch_duration_seconds_count",
+                   "descheduler_drift_candidates{strategy=",
+                   "descheduler_ticks_total",
+                   "descheduler_util_stddev_milli",
+                   "descheduler_manager_active 1"):
+        assert series in text, series
+    for reason in BLOCK_REASONS:
+        assert f'descheduler_moves_blocked_total{{reason="{reason}"}}' \
+            in text
+
+
+def test_stats_shape():
+    cs = _cluster(pods_on_first=0)
+    ctrl = DeschedulerController(cs)
+    ctrl.tick_once()
+    st = ctrl.stats()
+    for key in ("identity", "active", "ticks", "moves", "blocked",
+                "planned_intents", "whatif_batches", "drift",
+                "util_stddev_milli", "evictions_total",
+                "evictions_replayed", "pending_evictions"):
+        assert key in st, key
+
+
+# ---------------------------------------------------------------------------
+# fleet wiring
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_spec_deschedule_round_trip_and_validate():
+    from kubernetes_tpu.fleet import FleetSpec
+
+    spec = FleetSpec.from_dict({
+        "deschedule": {"managers": 2, "lease_ttl": 1.5, "tick": 0.25,
+                       "hysteresis": 7, "max_moves": 32}})
+    assert spec.deschedule["hysteresis"] == 7
+    again = FleetSpec.from_dict(spec.to_dict())
+    assert again.deschedule == spec.deschedule
+    spec.validate()
+    with pytest.raises(ValueError, match="deschedule.managers"):
+        FleetSpec.from_dict({"deschedule": {"managers": 0}}).validate()
+
+
+def test_controllers_package_exports():
+    from kubernetes_tpu import controllers
+
+    for name in ("DeschedulerController", "LowNodeUtilization",
+                 "DuplicateReplicas", "TaintViolation",
+                 "clears_hysteresis"):
+        assert hasattr(controllers, name)

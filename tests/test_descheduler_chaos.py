@@ -1,0 +1,219 @@
+"""Descheduler HA chaos (PR 20, docs/DESCHEDULE.md § exactly-once): two
+descheduler PROCESSES race the shared `descheduler` lease over a
+3-replica control plane, and we ``kill -9`` the ACTIVE one mid-eviction-
+wave. The standby must take over inside the lease TTL and finish the
+wave exactly-once: intents are a pure function of the snapshot
+(`uid@node`), so the survivor re-derives the dead incumbent's plan
+verbatim and the server-side eviction ledger absorbs any overlap as
+`already=True` replays. The gang moves whole or not at all — quiesce may
+not leave a PodGroup partially evicted."""
+
+import json
+import time
+from urllib import request as urlrequest
+from urllib.error import HTTPError, URLError
+
+import pytest
+
+from kubernetes_tpu.controllers.evictor import intent_for
+from kubernetes_tpu.core.apiserver import (EVICTED_ANNOTATION,
+                                           node_to_wire, pod_to_wire)
+from kubernetes_tpu.shard.harness import (_env, _repo_root,
+                                          start_descheduler,
+                                          stop_controller)
+from kubernetes_tpu.testing.faults import ReplicaSet, drain_pipe
+from kubernetes_tpu.testing.wrappers import make_node, make_pod
+
+LEASE = 1.2
+HOT = "hot"
+GANG = ("gang-0", "gang-1", "gang-2")
+
+
+def _call(base, method, path, body=None, timeout=30.0):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urlrequest.Request(base + path, data=data, method=method,
+                            headers={"Content-Type": "application/json"})
+    with urlrequest.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+    return json.loads(raw) if raw else None
+
+
+def _any(urls, method, path, body=None, timeout=10.0):
+    last = None
+    for url in urls:
+        try:
+            return _call(url, method, path, body, timeout=timeout)
+        except HTTPError as e:
+            if e.code in (421, 503):
+                last = e
+                continue
+            raise
+        except URLError as e:
+            last = e
+            continue
+    raise last if last is not None else AssertionError("no replicas")
+
+
+def _get_text(base, path, timeout=10.0):
+    with urlrequest.urlopen(base + path, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _metric(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    raise AssertionError(f"series {name} not exposed")
+
+
+def _wait(pred, timeout=60.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _active_manager(managers):
+    """(proc, metrics_url) of the manager whose gauge reads ACTIVE."""
+    for proc, url in managers:
+        if proc.poll() is not None:
+            continue
+        try:
+            text = _get_text(url, "/metrics", timeout=5.0)
+        except Exception:  # noqa: BLE001 - scrape raced a death
+            continue
+        if _metric(text, "descheduler_manager_active") == 1:
+            return proc, url
+    return None
+
+
+def _evictions_total(base):
+    return _metric(_get_text(base, "/metrics"),
+                   "apiserver_pod_evictions_total")
+
+
+@pytest.mark.chaos
+def test_active_kill9_mid_wave_exactly_once_gang_whole(tmp_path):
+    """SIGKILL the ACTIVE descheduler mid-eviction-wave. The standby
+    CASes the lease inside the TTL, re-derives the SAME `uid@node`
+    intents from its own snapshot, and finishes draining the hot node.
+    Quiesce invariants: every evicted pod was evicted exactly ONCE
+    (census == counter), replaying every committed intent answers
+    `already=True` without moving the counter, the 3-pod gang is all-
+    pending or all-bound (never split), and every intent the survivor
+    planned matches the deterministic derivation."""
+    rs = ReplicaSet(str(tmp_path / "replicas"), followers=2,
+                    repl_lease=1.5, snapshot_every=100_000)
+    urls = [rs.leader_url] + list(rs.follower_urls)
+    managers, tails = [], []
+    try:
+        # One hot node + six empty spares, identical shape. 19 pods of
+        # 2 CPU pile on `hot` (util .59 vs fleet mean .08): the
+        # low-node-utilization strategy drains it toward the mean —
+        # equilibrium leaves ~3 pods, so the wave is ~16 evictions, far
+        # longer than the kill + takeover window at 4 evictions/s.
+        for name in [HOT] + [f"s{i}" for i in range(6)]:
+            node = (make_node().name(name)
+                    .capacity({"cpu": 64, "memory": "256Gi", "pods": 110})
+                    .obj())
+            _any(urls, "POST", "/api/v1/nodes", node_to_wire(node))
+        uids = []
+        for i in range(16):
+            uid = f"solo-{i:02d}"
+            p = (make_pod().name(uid).uid(uid)
+                 .labels({"app": uid}).req({"cpu": "2"}).obj())
+            _any(urls, "POST", "/api/v1/pods", pod_to_wire(p))
+            uids.append(uid)
+        for uid in GANG:
+            p = (make_pod().name(uid).uid(uid)
+                 .labels({"app": uid}).req({"cpu": "2"}).obj())
+            p.pod_group = "team"
+            _any(urls, "POST", "/api/v1/pods", pod_to_wire(p))
+            uids.append(uid)
+        for uid in uids:
+            _any(urls, "POST", f"/api/v1/pods/{uid}/binding",
+                 {"node": HOT})
+
+        repo, env = _repo_root(), _env()
+        for i in range(2):
+            proc, murl = start_descheduler(
+                rs.follower_urls[0], repo, env, identity=f"dm-{i}",
+                fallbacks=[rs.follower_urls[1], rs.leader_url],
+                lease_ttl=LEASE, tick=0.1, hysteresis=1,
+                primary_qps=4.0)
+            managers.append((proc, murl))
+            tails.append(drain_pipe(proc))
+
+        _wait(lambda: _active_manager(managers) is not None,
+              timeout=30, msg="an ACTIVE descheduler")
+        _wait(lambda: _evictions_total(rs.leader_url) >= 3,
+              timeout=30, msg="eviction wave under way")
+        active_proc, _ = _active_manager(managers)
+        active_proc.kill()  # SIGKILL: no lease release, no goodbye
+        t_kill = time.monotonic()
+        at_kill = _evictions_total(rs.leader_url)
+        survivor = next((p, u) for p, u in managers
+                        if p is not active_proc)
+
+        _wait(lambda: _active_manager(managers) == survivor,
+              timeout=LEASE * 8, msg="standby takeover")
+        assert time.monotonic() - t_kill <= LEASE * 6  # inside TTL window
+
+        # Quiesce: the counter stops moving for 3s straight AND the
+        # survivor demonstrably continued the dead incumbent's wave.
+        state = {"last": at_kill, "since": time.monotonic()}
+
+        def _quiesced():
+            now = _evictions_total(rs.leader_url)
+            if now != state["last"]:
+                state["last"], state["since"] = now, time.monotonic()
+                return False
+            return (now > at_kill
+                    and time.monotonic() - state["since"] >= 3.0)
+        _wait(_quiesced, timeout=90, msg="wave quiesce after takeover")
+        final = _evictions_total(rs.leader_url)
+
+        # Exactly-once: the census of evicted (pending, annotated) pods
+        # IS the counter — nothing double-evicted, nothing lost.
+        pods = {p["uid"]: p for p in _any(urls, "GET", "/api/v1/pods")}
+        assert set(pods) == set(uids)  # eviction recreates, never drops
+        evicted = {u for u, p in pods.items()
+                   if not p.get("nodeName")
+                   and (p.get("annotations") or {}).get(EVICTED_ANNOTATION)}
+        assert len(evicted) == int(final) and len(evicted) > int(at_kill)
+
+        # Gang-whole: never split at quiesce (here the gang's pods sort
+        # first among equals, so the whole PodGroup moved).
+        gang_evicted = {u for u in GANG if u in evicted}
+        assert gang_evicted in (set(), set(GANG)), gang_evicted
+        assert gang_evicted == set(GANG)
+
+        # The ledger absorbs duplicates: replay every committed intent —
+        # derived from NOTHING but (uid, node), exactly as the standby
+        # re-derived them — and the counter must not move.
+        replayed_before = _metric(_get_text(rs.leader_url, "/metrics"),
+                                  "apiserver_pod_evictions_replayed_total")
+        for uid in sorted(evicted):
+            got = _any(urls, "POST", f"/api/v1/pods/{uid}/eviction",
+                       {"intent": intent_for(uid, HOT), "node": HOT})
+            assert got == {"evicted": True, "already": True}, (uid, got)
+        end_text = _get_text(rs.leader_url, "/metrics")
+        assert _metric(end_text, "apiserver_pod_evictions_total") == final
+        assert (_metric(end_text, "apiserver_pod_evictions_replayed_total")
+                - replayed_before) == len(evicted)
+
+        stats = stop_controller(survivor[0],
+                                tails[managers.index(survivor)])
+        assert stats is not None
+        assert stats["takeovers"] == 1 and stats["standby_ticks"] > 0
+        # every intent the survivor planned is the deterministic one
+        for uid, intent in stats["planned_intents"].items():
+            assert intent == intent_for(uid, HOT), (uid, intent)
+        assert stats["evictions_total"] >= 1  # it worked, not just held
+    finally:
+        for proc, _ in managers:
+            if proc.poll() is None:
+                proc.kill()
+        rs.stop()

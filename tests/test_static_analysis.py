@@ -1015,7 +1015,8 @@ def test_tree_runs_clean():
 def test_every_checker_registered_and_described():
     checkers = all_checkers()
     ids = sorted(c.id for c in checkers)
-    assert ids == ["eviction-discipline", "hint-freshness", "index-dtype",
+    assert ids == ["deschedule-discipline", "eviction-discipline",
+                   "hint-freshness", "index-dtype",
                    "jit-purity", "lock-discipline", "metrics-discipline",
                    "reconcile-discipline", "sharding-discipline",
                    "shed-discipline", "span-discipline",
@@ -1322,6 +1323,88 @@ class TestEvictionDisciplineFixtures:
         assert ck.applies_to("kubernetes_tpu/controllers/node_lifecycle.py")
 
 
+class TestDescheduleDisciplineFixtures:
+    """Descheduler modules under controllers/ may only emit evictions on
+    a call-graph slice holding BOTH the scored-improvement gate and the
+    deterministic intent record (ISSUE 20: an ungated move is churn —
+    ping-pong between near-balanced nodes — and an unintended one is
+    unreplayable across a standby takeover)."""
+
+    def test_flags_ungated_unintended_move(self):
+        bad = textwrap.dedent("""
+            class Descheduler:
+                def rebalance(self, plan):
+                    for pod, node in plan:
+                        self.evictor.enqueue("z", node, pod.uid)
+        """)
+        fs = check_source(checker_by_id("deschedule-discipline"), bad)
+        assert _rules(fs) == ["move-without-scored-gate"]
+        assert len(fs) == 1
+
+    def test_flags_gate_without_intent(self):
+        """Scored but anonymous: the takeover's re-derived wave cannot
+        replay into the ledger. Still a finding."""
+        bad = textwrap.dedent("""
+            class Descheduler:
+                def rebalance(self, moves, floor):
+                    for mv in moves:
+                        if clears_hysteresis(mv.improvement, floor):
+                            self.evictor.enqueue("z", mv.node, mv.uid)
+        """)
+        fs = check_source(checker_by_id("deschedule-discipline"), bad)
+        assert _rules(fs) == ["move-without-scored-gate"]
+
+    def test_flags_intent_without_gate(self):
+        bad = textwrap.dedent("""
+            class Descheduler:
+                def rebalance(self, moves):
+                    for mv in moves:
+                        intent = intent_for(mv.uid, mv.node)
+                        self.cs.evict_pod(mv.uid, mv.node, intent)
+        """)
+        fs = check_source(checker_by_id("deschedule-discipline"), bad)
+        assert _rules(fs) == ["move-without-scored-gate"]
+
+    def test_passes_reconcile_emit_shape(self):
+        """The real controller's shape: the gate runs in reconcile_once,
+        the intent is minted one frame below in _emit — the caller's
+        closure holds both sinks plus the emit site."""
+        good = textwrap.dedent("""
+            class Descheduler:
+                def reconcile_once(self, cands, floor):
+                    for c in cands:
+                        if clears_hysteresis(c.improvement, floor):
+                            self._emit(c)
+                def _emit(self, c):
+                    intent = intent_for(c.uid, c.node)
+                    self.planned[c.uid] = intent
+                    self.evictor.enqueue(c.zone, c.node, c.uid)
+        """)
+        assert check_source(checker_by_id("deschedule-discipline"),
+                            good) == []
+
+    def test_scope_is_descheduler_modules_only(self):
+        """Composes with eviction-discipline: that one covers ALL of
+        controllers/; this one only bites descheduler modules (the
+        node-lifecycle evictor legitimately moves pods ungated — its
+        seats are ILLEGAL, there is no score to clear)."""
+        ck = checker_by_id("deschedule-discipline")
+        assert ck.applies_to("kubernetes_tpu/controllers/descheduler.py")
+        assert ck.applies_to("controllers/descheduler.py")
+        assert not ck.applies_to(
+            "kubernetes_tpu/controllers/node_lifecycle.py")
+        assert not ck.applies_to("kubernetes_tpu/ops/whatif.py")
+        assert not ck.applies_to("tests/test_descheduler.py")
+
+    def test_real_descheduler_module_is_clean(self):
+        import kubernetes_tpu.controllers.descheduler as ds
+        import inspect
+        src = inspect.getsource(ds)
+        assert check_source(
+            checker_by_id("deschedule-discipline"), src,
+            "kubernetes_tpu/controllers/descheduler.py") == []
+
+
 class TestReconcileDisciplineFixtures:
     """controllers/ pod create sites must sit on a call-graph slice
     holding BOTH a deterministic-name source and a create-409-is-success
@@ -1455,6 +1538,24 @@ def test_cli_seeded_naked_delete_exits_nonzero(tmp_path):
     report = json.loads(proc.stdout)
     rules = {(f["checker"], f["rule"]) for f in report["findings"]}
     assert ("eviction-discipline", "eviction-outside-funnel") in rules
+
+
+def test_cli_seeded_ungated_move_exits_nonzero(tmp_path):
+    """Acceptance (ISSUE 20): `deschedule-discipline` exits 1 on a seeded
+    ungated-move fixture under controllers/."""
+    ctl = tmp_path / "controllers"
+    ctl.mkdir()
+    (ctl / "descheduler.py").write_text(
+        "class Descheduler:\n"
+        "    def rebalance(self, plan):\n"
+        "        for pod, node in plan:\n"
+        "            self.evictor.enqueue('z', node, pod.uid)\n")
+    proc = _run_cli("--root", str(tmp_path), "--checker",
+                    "deschedule-discipline", "--json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    rules = {(f["checker"], f["rule"]) for f in report["findings"]}
+    assert ("deschedule-discipline", "move-without-scored-gate") in rules
 
 
 class TestSupervisionDisciplineFixtures:
